@@ -1,0 +1,29 @@
+"""Shared test configuration.
+
+JAX-importing tests run on a virtual 8-device CPU mesh so multi-chip sharding
+is exercised without TPU hardware (mirrors how the reference tests multi-GPU
+hosts purely from sysfs fixtures, SURVEY.md §4).  The env must be set before
+the first ``import jax`` anywhere in the test process.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def testdata(request):
+    """Absolute path to the repo-root testdata/ fixture directory."""
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "testdata"
+    )

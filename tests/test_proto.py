@@ -1,0 +1,124 @@
+"""Protocol layer tests: message roundtrips + gRPC wiring over a unix socket."""
+
+import concurrent.futures
+import os
+
+import grpc
+import pytest
+
+from tpu_k8s_device_plugin.proto import (
+    deviceplugin_pb2 as pb,
+    deviceplugin_pb2_grpc as pb_grpc,
+    tpuhealth_pb2 as hpb,
+    tpuhealth_pb2_grpc as hpb_grpc,
+)
+
+
+def test_device_message_roundtrip():
+    d = pb.Device(
+        ID="tpu-0000:00:04.0",
+        health="Healthy",
+        topology=pb.TopologyInfo(nodes=[pb.NUMANode(ID=1)]),
+    )
+    d2 = pb.Device.FromString(d.SerializeToString())
+    assert d2.ID == "tpu-0000:00:04.0"
+    assert d2.topology.nodes[0].ID == 1
+
+
+def test_allocate_response_roundtrip():
+    resp = pb.AllocateResponse(
+        container_responses=[
+            pb.ContainerAllocateResponse(
+                envs={"TPU_VISIBLE_CHIPS": "0,1"},
+                devices=[
+                    pb.DeviceSpec(
+                        container_path="/dev/accel0",
+                        host_path="/dev/accel0",
+                        permissions="rw",
+                    )
+                ],
+            )
+        ]
+    )
+    r2 = pb.AllocateResponse.FromString(resp.SerializeToString())
+    assert r2.container_responses[0].envs["TPU_VISIBLE_CHIPS"] == "0,1"
+    assert r2.container_responses[0].devices[0].host_path == "/dev/accel0"
+
+
+class _EchoPlugin(pb_grpc.DevicePluginServicer):
+    def GetDevicePluginOptions(self, request, context):
+        return pb.DevicePluginOptions(get_preferred_allocation_available=True)
+
+    def ListAndWatch(self, request, context):
+        yield pb.ListAndWatchResponse(
+            devices=[pb.Device(ID="chip0", health="Healthy")]
+        )
+
+    def Allocate(self, request, context):
+        out = pb.AllocateResponse()
+        for creq in request.container_requests:
+            cres = out.container_responses.add()
+            for did in creq.devices_ids:
+                cres.devices.add(
+                    container_path=f"/dev/{did}", host_path=f"/dev/{did}",
+                    permissions="rw",
+                )
+        return out
+
+
+@pytest.fixture
+def uds_server(tmp_path):
+    sock = str(tmp_path / "plugin.sock")
+    server = grpc.server(concurrent.futures.ThreadPoolExecutor(max_workers=4))
+    pb_grpc.add_DevicePluginServicer_to_server(_EchoPlugin(), server)
+    server.add_insecure_port(f"unix://{sock}")
+    server.start()
+    yield sock
+    server.stop(0)
+
+
+def test_grpc_unary_and_stream_over_unix_socket(uds_server):
+    with grpc.insecure_channel(f"unix://{uds_server}") as ch:
+        stub = pb_grpc.DevicePluginStub(ch)
+        opts = stub.GetDevicePluginOptions(pb.Empty())
+        assert opts.get_preferred_allocation_available
+
+        stream = stub.ListAndWatch(pb.Empty())
+        first = next(iter(stream))
+        assert first.devices[0].ID == "chip0"
+
+        resp = stub.Allocate(
+            pb.AllocateRequest(
+                container_requests=[
+                    pb.ContainerAllocateRequest(devices_ids=["accel0", "accel1"])
+                ]
+            )
+        )
+        paths = [d.host_path for d in resp.container_responses[0].devices]
+        assert paths == ["/dev/accel0", "/dev/accel1"]
+
+
+def test_tpuhealth_roundtrip():
+    s = hpb.TpuState(
+        id="0000:00:05.0", accel_index=1, health="Unhealthy",
+        device="/dev/accel1",
+    )
+    s2 = hpb.TpuState.FromString(s.SerializeToString())
+    assert s2.accel_index == 1 and s2.health == "Unhealthy"
+    assert hpb.TpuHealth.Name(hpb.UNHEALTHY) == "UNHEALTHY"
+    assert hpb_grpc is not None
+
+
+def test_method_paths_match_kubelet_abi():
+    """The gRPC method paths are an ABI with the kubelet — pin them."""
+    fd = pb.DESCRIPTOR
+    assert fd.package == "v1beta1"
+    svc = fd.services_by_name["DevicePlugin"]
+    assert sorted(m.name for m in svc.methods) == [
+        "Allocate",
+        "GetDevicePluginOptions",
+        "GetPreferredAllocation",
+        "ListAndWatch",
+        "PreStartContainer",
+    ]
+    assert "Registration" in fd.services_by_name

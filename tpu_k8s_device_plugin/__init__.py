@@ -1,0 +1,8 @@
+"""Kubernetes device plugin and node labeller for Google Cloud TPUs.
+
+A TPU-native rebuild of ROCm/k8s-device-plugin (see SURVEY.md): the kubelet-facing
+agents are Python + grpcio, hardware probing is a C++ shim (native/tpuprobe), and
+example workloads are JAX/XLA.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,18 @@
+"""Protocol stubs: kubelet deviceplugin v1beta1 + tpuhealth.
+
+Message classes are protoc-generated (see gen.sh); the *_pb2_grpc modules are
+hand-written in grpc_tools style because the build image has grpcio but not
+grpcio-tools.
+"""
+
+from . import deviceplugin_pb2
+from . import deviceplugin_pb2_grpc
+from . import tpuhealth_pb2
+from . import tpuhealth_pb2_grpc
+
+__all__ = [
+    "deviceplugin_pb2",
+    "deviceplugin_pb2_grpc",
+    "tpuhealth_pb2",
+    "tpuhealth_pb2_grpc",
+]

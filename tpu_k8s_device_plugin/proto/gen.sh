@@ -1,0 +1,6 @@
+#!/bin/sh
+# Regenerate protobuf message modules.  The *_pb2_grpc.py files are
+# hand-maintained (no grpcio-tools in the build image) — do not overwrite.
+set -e
+cd "$(dirname "$0")"
+protoc --python_out=. deviceplugin.proto tpuhealth.proto

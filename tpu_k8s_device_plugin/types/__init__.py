@@ -1,0 +1,10 @@
+"""Contract layer: the DeviceImpl interface and TPU constants.
+
+TPU-native analog of the reference's ``internal/pkg/types``
+(/root/reference/internal/pkg/types/api.go:25-56, constants.go:21-93).
+"""
+
+from .api import DeviceImpl, DevicePluginContext
+from . import constants
+
+__all__ = ["DeviceImpl", "DevicePluginContext", "constants"]

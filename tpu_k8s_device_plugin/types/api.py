@@ -1,0 +1,85 @@
+"""DeviceImpl: the contract between the plugin adapter and device implementations.
+
+Mirrors the seven-method interface of the reference
+(/root/reference/internal/pkg/types/api.go:25-47) and its per-resource plugin
+context (api.go:49-56).  Each kubelet RPC on the plugin adapter delegates to
+exactly one DeviceImpl method; a single DeviceImpl instance may back several
+resource names (mixed naming strategy), distinguished via the context.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import List, Optional, TYPE_CHECKING
+
+if TYPE_CHECKING:  # only for type hints; avoids a hard import cycle
+    from tpu_k8s_device_plugin.allocator.allocator import Policy
+    from tpu_k8s_device_plugin.proto import deviceplugin_pb2 as pluginapi
+
+
+class DevicePluginContext:
+    """Per-resource state handed to every DeviceImpl call.
+
+    Reference: DevicePluginContext interface (api.go:49-56).  Holds the resource
+    name this plugin instance serves, the preferred-allocation policy, and a
+    sticky flag recording that allocator initialisation failed (in which case
+    GetPreferredAllocation degrades to kubelet-default allocation, the graceful
+    degradation of reference amdgpu.go:111-117).
+    """
+
+    def __init__(self, resource_name: str, allocator: Optional["Policy"] = None):
+        self._resource_name = resource_name
+        self._allocator = allocator
+        self._allocator_error = False
+
+    def resource_name(self) -> str:
+        return self._resource_name
+
+    def get_allocator(self) -> Optional["Policy"]:
+        return self._allocator
+
+    def set_allocator_error(self, err: bool) -> None:
+        self._allocator_error = err
+
+    def get_allocator_error(self) -> bool:
+        return self._allocator_error
+
+
+class DeviceImpl(abc.ABC):
+    """Device implementation interface (reference api.go:25-47).
+
+    Implementations: TpuKfdStyleImpl (container workloads via /dev/accel),
+    TpuVfImpl (VM passthrough via VFIO VFs), TpuPfImpl (PF passthrough).
+    """
+
+    @abc.abstractmethod
+    def start(self, ctx: DevicePluginContext) -> None:
+        """Called after plugin init and before registration with the kubelet."""
+
+    @abc.abstractmethod
+    def get_resource_names(self) -> List[str]:
+        """Resource names (without namespace) this impl advertises."""
+
+    @abc.abstractmethod
+    def get_options(self, ctx: DevicePluginContext) -> "pluginapi.DevicePluginOptions":
+        """Device plugin options for the resource."""
+
+    @abc.abstractmethod
+    def enumerate(self, ctx: DevicePluginContext) -> List["pluginapi.Device"]:
+        """List of devices for the resource, with NUMA topology hints."""
+
+    @abc.abstractmethod
+    def allocate(
+        self, ctx: DevicePluginContext, req: "pluginapi.AllocateRequest"
+    ) -> "pluginapi.AllocateResponse":
+        """Allocation artifacts (device nodes, mounts, env) for a request."""
+
+    @abc.abstractmethod
+    def get_preferred_allocation(
+        self, ctx: DevicePluginContext, req: "pluginapi.PreferredAllocationRequest"
+    ) -> "pluginapi.PreferredAllocationResponse":
+        """Topology-preferred device subset for an admission-time request."""
+
+    @abc.abstractmethod
+    def update_health(self, ctx: DevicePluginContext) -> List["pluginapi.Device"]:
+        """Re-probed device list with current Healthy/Unhealthy states."""

@@ -1,0 +1,138 @@
+"""TPU constants: resource names, driver types, sysfs/devfs paths, flags.
+
+TPU-native analog of /root/reference/internal/pkg/types/constants.go:21-93.
+Where the reference keys off the AMD vendor id / KFD / GIM driver paths, this
+build keys off the Google vendor id, the Linux ``accel`` class that the TPU
+driver registers chips under, and VFIO for VM passthrough.
+"""
+
+# ---------------------------------------------------------------------------
+# Node labels the labeller can emit (flag-gated, one bool flag per entry).
+# Reference: SupportedLabels, constants.go:21.
+# ---------------------------------------------------------------------------
+SUPPORTED_LABELS = [
+    "mode",                          # container / vf-passthrough / pf-passthrough
+    "accelerator-type",              # e.g. v5litepod-8
+    "topology",                      # ICI mesh, e.g. 2x4 or 2x2x1
+    "chips-per-host",                # local chip count
+    "cores-per-chip",                # TensorCores per chip (1 on v5e, 2 on v4/v5p)
+    "worker-id",                     # this host's index within a multi-host slice
+    "num-workers",                   # hosts in the slice
+    "firmware",                      # TPU firmware version
+    "driver-version",                # accel/TPU kernel driver version
+    "device-id",                     # PCI device id of the chips
+    "product-name",                  # marketing name, e.g. "TPU v5e"
+    "hbm",                           # HBM bytes per chip
+    "partitioning-supported",        # whether per-core partitioning is available
+    "core-partition",                # current partition granularity (chip / core)
+]
+
+# Label prefixes.  The reference emits both amd.com/gpu.* and a legacy
+# beta.amd.com/gpu.* prefix (cmd/k8s-node-labeller/main.go:85-116); we mirror
+# that with google.com/tpu.* plus a legacy beta prefix.
+LABEL_PREFIX = "google.com/tpu"
+LABEL_PREFIX_BETA = "beta.google.com/tpu"
+
+# ---------------------------------------------------------------------------
+# Command-line parameter names (constants.go:24-33).
+# ---------------------------------------------------------------------------
+CMDLINE_PULSE = "pulse"
+CMDLINE_DRIVER_TYPE = "driver_type"
+CMDLINE_RES_NAMING_STRATEGY = "resource_naming_strategy"
+
+# Resource naming strategies (constants.go:36-42).
+RESOURCE_NAMING_STRATEGY_SINGLE = "single"
+RESOURCE_NAMING_STRATEGY_MIXED = "mixed"
+
+# Driver types (constants.go:45-54).
+CONTAINER = "container"
+VF_PASSTHROUGH = "vf-passthrough"
+PF_PASSTHROUGH = "pf-passthrough"
+
+# ---------------------------------------------------------------------------
+# TPU hardware constants (≈ AMDGPU constants, constants.go:57-93).
+# ---------------------------------------------------------------------------
+
+# Google PCI vendor id (reference uses AMD 0x1002, constants.go:80).
+GOOGLE_VENDOR_ID = "0x1ae0"
+
+# Known TPU PCI device ids → generation (probed from config space; used by
+# discovery fallback and the labeller's device-id/product-name generators).
+TPU_PCI_DEVICE_IDS = {
+    "0x0027": "v2/v3",
+    "0x005e": "v4",
+    "0x0062": "v5e",
+    "0x0063": "v5p",
+    "0x006f": "v6e",
+}
+
+# Linux accel class: one entry per chip, accel/accel%d, with device/ symlink
+# into the PCI device (the TPU analog of /sys/module/amdgpu/drivers/pci:amdgpu).
+ACCEL_CLASS_PATH = "/sys/class/accel"
+
+# Character device nodes the container path mounts (≈ /dev/kfd + /dev/dri/*).
+ACCEL_DEV_DIR = "/dev/accel"          # /dev/accel0, /dev/accel1, ...
+VFIO_DEV_DIR = "/dev/vfio"            # /dev/vfio/<iommu-group> + /dev/vfio/vfio
+
+# PCI scan root for VF/PF passthrough discovery (constants.go:74).
+PCI_DEVICE_PATH = "/sys/bus/pci/devices/"
+
+# VFIO driver paths (constants.go:59-62).
+VFIO_DRIVER_PATH = "/sys/bus/pci/drivers/vfio-pci"
+VFIO_DRIVER_NAME = "vfio-pci"
+
+# TPU VF driver (SR-IOV host driver for TPU VMs; ≈ AMD's gim driver,
+# constants.go:65-71).
+TPU_VF_DRIVER_PATH = "/sys/bus/pci/drivers/tpu-vf"
+TPU_VF_MODULE_PATH = "/sys/module/tpu_vf"
+TPU_VF_DRIVER_NAME = "tpu-vf"
+
+# Env var prefix announcing allocated passthrough PCI addresses to the
+# virt-launcher (≈ PCI_RESOURCE_AMD_COM, constants.go:77).
+PCI_TPU_PREFIX = "PCI_RESOURCE_GOOGLE_COM"
+
+# Resource namespace + device types reported to the kubelet
+# (≈ amd.com / gpu / gpu_vf / gpu_pf, constants.go:83-89).
+RESOURCE_NAMESPACE = "google.com"
+DEVICE_TYPE_TPU = "tpu"
+DEVICE_TYPE_TPU_VF = "tpu_vf"
+DEVICE_TYPE_TPU_PF = "tpu_pf"
+
+# Per-core partition resource name (mixed strategy on 2-core chips; the TPU
+# analog of MI300 partition-typed resources like cpx_nps1).
+DEVICE_TYPE_TPU_CORE = "tpucore"
+
+# Exporter health check timeout, seconds (constants.go:92).
+EXPORTER_HEALTH_CHECK_TIMEOUT_S = 10.0
+
+# Unix socket of the companion tpu-metrics-exporter daemon
+# (≈ /var/lib/amd-metrics-exporter/..., exporter/health.go:35-37).
+METRICS_EXPORTER_SOCKET = (
+    "/var/lib/tpu-metrics-exporter/tpu_device_metrics_exporter_grpc.socket"
+)
+
+# ---------------------------------------------------------------------------
+# Kubelet device-plugin API surface (vendored constants in the reference:
+# k8s.io/kubelet/pkg/apis/deviceplugin/v1beta1/constants.go).
+# ---------------------------------------------------------------------------
+KUBELET_DP_VERSION = "v1beta1"
+DEVICE_PLUGIN_PATH = "/var/lib/kubelet/device-plugins/"
+KUBELET_SOCKET = DEVICE_PLUGIN_PATH + "kubelet.sock"
+HEALTHY = "Healthy"
+UNHEALTHY = "Unhealthy"
+
+# ---------------------------------------------------------------------------
+# TPU runtime environment: how allocated chips are announced to the workload
+# (libtpu reads these; the analog of exposing only selected /dev/dri nodes).
+# ---------------------------------------------------------------------------
+ENV_TPU_VISIBLE_CHIPS = "TPU_VISIBLE_CHIPS"
+ENV_TPU_CHIPS_PER_HOST_BOUNDS = "TPU_CHIPS_PER_HOST_BOUNDS"
+ENV_TPU_PROCESS_BOUNDS = "TPU_PROCESS_BOUNDS"
+ENV_TPU_WORKER_ID = "TPU_WORKER_ID"
+ENV_TPU_SKIP_MDS_QUERY = "TPU_SKIP_MDS_QUERY"
+ENV_TPU_ACCELERATOR_TYPE = "TPU_ACCELERATOR_TYPE"
+ENV_TPU_TOPOLOGY = "TPU_TOPOLOGY"
+
+# Host-local metadata file written by the TPU VM runtime / GKE (fixture-able
+# stand-in for the GCE metadata server's tpu-env attribute).
+TPU_ENV_FILE = "/run/tpu/tpu-env"
