@@ -1,0 +1,173 @@
+#!/usr/bin/env python3
+"""Generate the fixture sysfs/tpu-env trees under testdata/.
+
+The reference's tests run every discovery/allocator function against captured
+sysfs trees from real machines (testdata/topology-parsing*/README.md documents
+the `find ... cat` capture recipe).  TPU hosts in this build's CI have no
+/sys/class/accel, so the trees are *synthesised* to the same shape a real
+v5e / v5p host exposes; this script is the reproducible "capture recipe".
+
+Run from the repo root:  python testdata/make_fixtures.py
+"""
+
+import os
+import shutil
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def w(path, content):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(content if content.endswith("\n") else content + "\n")
+
+
+def ln(link, target):
+    os.makedirs(os.path.dirname(link), exist_ok=True)
+    if os.path.islink(link):
+        os.remove(link)
+    os.symlink(target, link)
+
+
+def make_host(
+    name,
+    n_chips,
+    device_id,
+    tpu_env,
+    numa_split=True,
+    firmware="2.12.1",
+    driver_version="1.8.0",
+    with_accel_class=True,
+    driver=None,            # bind PCI devs to this driver (vfio-pci / tpu-vf)
+    virtfns_per_pf=0,       # SR-IOV VFs hanging off each PF
+):
+    root = os.path.join(HERE, name)
+    if os.path.isdir(root):
+        shutil.rmtree(root)
+    sys_root = os.path.join(root, "sys")
+
+    for i in range(n_chips):
+        addr = f"0000:00:{4 + i:02x}.0"
+        pci_dir = os.path.join(sys_root, "devices", "pci0000:00", addr)
+        w(os.path.join(pci_dir, "vendor"), "0x1ae0")
+        w(os.path.join(pci_dir, "device"), device_id)
+        w(os.path.join(pci_dir, "class"), "0x120000")
+        numa = (i >= n_chips // 2) if numa_split and n_chips > 1 else 0
+        w(os.path.join(pci_dir, "numa_node"), str(int(numa)))
+        w(os.path.join(pci_dir, "firmware_version"), firmware)
+        # iommu group per chip
+        group = str(8 + i)
+        os.makedirs(os.path.join(sys_root, "kernel", "iommu_groups", group),
+                    exist_ok=True)
+        ln(os.path.join(pci_dir, "iommu_group"),
+           f"../../../kernel/iommu_groups/{group}")
+        # bus/pci/devices entry
+        ln(os.path.join(sys_root, "bus", "pci", "devices", addr),
+           f"../../../devices/pci0000:00/{addr}")
+        if with_accel_class:
+            accel_dir = os.path.join(sys_root, "class", "accel", f"accel{i}")
+            w(os.path.join(accel_dir, "dev"), f"236:{i}")
+            ln(os.path.join(accel_dir, "device"),
+               f"../../../devices/pci0000:00/{addr}")
+        if driver:
+            drv_dir = os.path.join(sys_root, "bus", "pci", "drivers", driver)
+            os.makedirs(drv_dir, exist_ok=True)
+            ln(os.path.join(pci_dir, "driver"),
+               f"../../../bus/pci/drivers/{driver}")
+            ln(os.path.join(drv_dir, addr), f"../../devices/pci0000:00/{addr}")
+        for vf in range(virtfns_per_pf):
+            vf_addr = f"0000:01:{4 + i:02x}.{vf + 1}"
+            vf_dir = os.path.join(sys_root, "devices", "pci0000:00", addr,
+                                  f"virtfn{vf}_dev")
+            # real sysfs puts VFs at bus level; model the PF->VF link precisely:
+            vf_real = os.path.join(sys_root, "devices", "pci0000:01", vf_addr)
+            w(os.path.join(vf_real, "vendor"), "0x1ae0")
+            w(os.path.join(vf_real, "device"), device_id)
+            vf_group = str(100 + i * 8 + vf)
+            os.makedirs(os.path.join(sys_root, "kernel", "iommu_groups",
+                                     vf_group), exist_ok=True)
+            ln(os.path.join(vf_real, "iommu_group"),
+               f"../../../kernel/iommu_groups/{vf_group}")
+            ln(os.path.join(sys_root, "bus", "pci", "devices", vf_addr),
+               f"../../../devices/pci0000:01/{vf_addr}")
+            ln(os.path.join(pci_dir, f"virtfn{vf}"),
+               f"../../pci0000:01/{vf_addr}")
+            del vf_dir
+
+    # driver module info
+    if driver == "tpu-vf":
+        w(os.path.join(sys_root, "module", "tpu_vf", "version"), driver_version)
+        w(os.path.join(sys_root, "module", "tpu_vf", "srcversion"),
+          "A1B2C3D4E5F60718TPUVF")
+    else:
+        w(os.path.join(sys_root, "module", "tpu", "version"), driver_version)
+        w(os.path.join(sys_root, "module", "tpu", "srcversion"),
+          "9F8E7D6C5B4A3921TPU")
+
+    if tpu_env is not None:
+        w(os.path.join(root, "run", "tpu", "tpu-env"), tpu_env)
+    return root
+
+
+def main():
+    # v5e single host, full 8-chip pod-slice on one machine (2x4 mesh).
+    make_host(
+        "v5e-8", 8, "0x0062",
+        "ACCELERATOR_TYPE: 'v5litepod-8'\n"
+        "CHIPS_PER_HOST_BOUNDS: '2,4,1'\n"
+        "HOST_BOUNDS: '1,1,1'\n"
+        "WORKER_ID: '0'\n",
+    )
+    # One host (worker 0) of a two-host v5e-16 slice (4x4 global mesh:
+    # each host holds a 2x4 sub-grid, hosts side by side on the x axis).
+    make_host(
+        "v5e-16-host0", 8, "0x0062",
+        "ACCELERATOR_TYPE: 'v5litepod-16'\n"
+        "CHIPS_PER_HOST_BOUNDS: '2,4,1'\n"
+        "HOST_BOUNDS: '2,1,1'\n"
+        "WORKER_ID: '0'\n",
+    )
+    # v5p host: 4 chips (2x2x1), 2 TensorCores each, whole-chip granularity.
+    make_host(
+        "v5p-8", 4, "0x0063",
+        "ACCELERATOR_TYPE: 'v5p-8'\n"
+        "CHIPS_PER_HOST_BOUNDS: '2,2,1'\n"
+        "HOST_BOUNDS: '1,1,1'\n"
+        "WORKER_ID: '0'\n",
+    )
+    # Same host partitioned per-core (the MI300-CPX analog).
+    make_host(
+        "v5p-8-core", 4, "0x0063",
+        "ACCELERATOR_TYPE: 'v5p-8'\n"
+        "CHIPS_PER_HOST_BOUNDS: '2,2,1'\n"
+        "HOST_BOUNDS: '1,1,1'\n"
+        "WORKER_ID: '0'\n"
+        "TPU_PARTITION_MODE: 'core'\n",
+    )
+    # Heterogeneous: chips 2,3 per-core, chips 0,1 whole-chip (mixed naming).
+    make_host(
+        "v5p-8-hetero", 4, "0x0063",
+        "ACCELERATOR_TYPE: 'v5p-8'\n"
+        "CHIPS_PER_HOST_BOUNDS: '2,2,1'\n"
+        "HOST_BOUNDS: '1,1,1'\n"
+        "WORKER_ID: '0'\n"
+        "TPU_PARTITION_MODE_OVERRIDES: '2:core,3:core'\n",
+    )
+    # No tpu-env metadata at all: discovery must fall back to sysfs only.
+    make_host("v5e-4-nometa", 4, "0x0062", None, numa_split=False)
+    # PF passthrough host: 4 chips bound to vfio-pci, no accel class.
+    make_host(
+        "vfio-pf", 4, "0x0063", None,
+        with_accel_class=False, driver="vfio-pci",
+    )
+    # SR-IOV host: 2 PFs on tpu-vf driver, 2 VFs each, no accel class.
+    make_host(
+        "vfio-vf", 2, "0x0062", None,
+        with_accel_class=False, driver="tpu-vf", virtfns_per_pf=2,
+    )
+    print("fixtures written under", HERE)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,208 @@
+"""Discovery + topology tests against fixture sysfs/tpu-env trees.
+
+Mirrors the reference's fixture-driven parser tests
+(amdgpu_test.go:122-287) with the TPU fixture trees under testdata/.
+"""
+
+import os
+
+import pytest
+
+from tpu_k8s_device_plugin.tpu import (
+    get_tpu_chips,
+    is_homogeneous,
+    parse_accelerator_type,
+    read_tpu_env,
+    unique_partition_config_count,
+)
+from tpu_k8s_device_plugin.tpu.discovery import (
+    get_driver_versions,
+    get_firmware_version,
+    list_accel_nodes,
+    list_tpu_pci_devices,
+)
+from tpu_k8s_device_plugin.tpu.topology import (
+    IciTopology,
+    partition_modes_from_env,
+    topology_from_env,
+)
+
+
+def fixture(testdata, name):
+    root = os.path.join(testdata, name)
+    return (
+        os.path.join(root, "sys"),
+        os.path.join(root, "run", "tpu", "tpu-env"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# tpu-env parsing + accelerator types
+# ---------------------------------------------------------------------------
+
+def test_parse_accelerator_type():
+    spec, chips = parse_accelerator_type("v5litepod-8")
+    assert spec.generation == "v5e" and chips == 8 and spec.cores_per_chip == 1
+    spec, chips = parse_accelerator_type("v5p-8")
+    assert spec.generation == "v5p" and chips == 4 and spec.cores_per_chip == 2
+    spec, chips = parse_accelerator_type("v4-32")
+    assert spec.generation == "v4" and chips == 16
+    with pytest.raises(ValueError):
+        parse_accelerator_type("h100-8")
+    with pytest.raises(ValueError):
+        parse_accelerator_type("not a type")
+
+
+def test_read_tpu_env_formats(tmp_path):
+    p = tmp_path / "tpu-env"
+    p.write_text(
+        "ACCELERATOR_TYPE: 'v5litepod-8'\n"
+        "# comment\n"
+        "WORKER_ID=3\n"
+        "garbage line without separator\n"
+        'HOST_BOUNDS: "1,1,1"\n'
+    )
+    env = read_tpu_env(str(p))
+    assert env["ACCELERATOR_TYPE"] == "v5litepod-8"
+    assert env["WORKER_ID"] == "3"
+    assert env["HOST_BOUNDS"] == "1,1,1"
+
+
+def test_read_tpu_env_missing_file():
+    assert read_tpu_env("/nonexistent/tpu-env") == {}
+
+
+# ---------------------------------------------------------------------------
+# sysfs enumeration
+# ---------------------------------------------------------------------------
+
+def test_list_accel_nodes(testdata):
+    sys_root, _ = fixture(testdata, "v5e-8")
+    nodes = list_accel_nodes(sys_root)
+    assert [i for i, _ in nodes] == list(range(8))
+    # the device symlink resolves into the PCI tree
+    assert nodes[0][1].endswith("0000:00:04.0")
+
+
+def test_pci_fallback_enumeration(testdata):
+    sys_root, _ = fixture(testdata, "vfio-pf")
+    assert list_accel_nodes(sys_root) == []
+    pci = list_tpu_pci_devices(sys_root)
+    assert len(pci) == 4
+    assert all(p.endswith(".0") for p in pci)
+
+
+def test_get_tpu_chips_v5e8(testdata):
+    sys_root, env_path = fixture(testdata, "v5e-8")
+    devs, topo = get_tpu_chips(sys_root, "/dev", env_path)
+    assert len(devs) == 8
+    assert topo.topology_str == "2x4"
+    assert topo.local_chip_count == 8 and topo.num_workers == 1
+    d0 = devs["0000:00:04.0"]
+    assert d0.accel_index == 0 and d0.coords == (0, 0, 0)
+    assert d0.device_id == "0x0062" and d0.vendor_id == "0x1ae0"
+    assert d0.dev_path == "/dev/accel0"
+    # NUMA split: first four chips node 0, last four node 1
+    by_idx = sorted(devs.values(), key=lambda d: d.accel_index)
+    assert [d.numa_node for d in by_idx] == [0, 0, 0, 0, 1, 1, 1, 1]
+    # x-fastest coordinate assignment on the 2x4 grid
+    assert by_idx[1].coords == (1, 0, 0)
+    assert by_idx[2].coords == (0, 1, 0)
+    assert by_idx[7].coords == (1, 3, 0)
+    assert is_homogeneous(devs)
+    assert unique_partition_config_count(devs) == {"tpu": 8}
+
+
+def test_get_tpu_chips_multihost_worker0(testdata):
+    sys_root, env_path = fixture(testdata, "v5e-16-host0")
+    devs, topo = get_tpu_chips(sys_root, "/dev", env_path)
+    assert len(devs) == 8
+    assert topo.topology_str == "4x4"
+    assert topo.num_workers == 2 and topo.worker_id == 0
+    # worker 0 occupies x in [0,2); global == local here
+    assert topo.global_chip_coords(7) == (1, 3, 0)
+
+
+def test_get_tpu_chips_v5p_partitioning(testdata):
+    sys_root, env_path = fixture(testdata, "v5p-8")
+    devs, topo = get_tpu_chips(sys_root, "/dev", env_path)
+    assert len(devs) == 4
+    assert topo.spec.cores_per_chip == 2
+    assert {d.partition_mode for d in devs.values()} == {"chip"}
+
+    sys_root, env_path = fixture(testdata, "v5p-8-core")
+    devs, _ = get_tpu_chips(sys_root, "/dev", env_path)
+    assert {d.partition_mode for d in devs.values()} == {"core"}
+    assert unique_partition_config_count(devs) == {"tpucore": 4}
+
+    sys_root, env_path = fixture(testdata, "v5p-8-hetero")
+    devs, _ = get_tpu_chips(sys_root, "/dev", env_path)
+    assert not is_homogeneous(devs)
+    assert unique_partition_config_count(devs) == {"tpu": 2, "tpucore": 2}
+
+
+def test_get_tpu_chips_no_metadata_fallback(testdata):
+    """Without tpu-env, generation comes from the PCI device id and the grid
+    from a squarish factorisation of the chip count."""
+    sys_root, env_path = fixture(testdata, "v5e-4-nometa")
+    devs, topo = get_tpu_chips(sys_root, "/dev", env_path)
+    assert len(devs) == 4
+    assert topo.spec is not None and topo.spec.generation == "v5e"
+    assert topo.chips_per_host_bounds == (2, 2, 1)
+
+
+def test_iommu_groups_discovered(testdata):
+    sys_root, env_path = fixture(testdata, "v5e-8")
+    devs, _ = get_tpu_chips(sys_root, "/dev", env_path)
+    assert devs["0000:00:04.0"].iommu_group == "8"
+    assert devs["0000:00:0b.0"].iommu_group == "15"
+
+
+# ---------------------------------------------------------------------------
+# ICI distance model
+# ---------------------------------------------------------------------------
+
+def test_ici_distance_mesh():
+    topo = IciTopology(chips_per_host_bounds=(2, 4, 1))
+    assert topo.ici_distance(0, 1) == 1     # (0,0)-(1,0)
+    assert topo.ici_distance(0, 2) == 1     # (0,0)-(0,1)
+    assert topo.ici_distance(0, 7) == 4     # (0,0)-(1,3)
+    assert topo.ici_distance(3, 3) == 0
+
+
+def test_ici_distance_torus_wrap():
+    topo = IciTopology(chips_per_host_bounds=(4, 4, 1), wrap=(True, True, False))
+    # (0,0) to (3,0): 3 hops unwrapped, 1 hop around the torus
+    assert topo.ici_distance(0, 3) == 1
+    # (0,0) to (3,3): 1 + 1 with both wraps
+    assert topo.ici_distance(0, 15) == 2
+
+
+def test_partition_modes_overrides():
+    env = {"TPU_PARTITION_MODE_OVERRIDES": "1:core, 3:core, 9:core, x:core"}
+    assert partition_modes_from_env(env, 4) == ["chip", "core", "chip", "core"]
+    env = {"TPU_PARTITION_MODE": "core"}
+    assert partition_modes_from_env(env, 2) == ["core", "core"]
+
+
+def test_topology_from_env_derives_host_grid():
+    env = {"ACCELERATOR_TYPE": "v5litepod-16", "CHIPS_PER_HOST_BOUNDS": "2,4,1"}
+    topo = topology_from_env(env)
+    assert topo.num_workers == 2
+
+
+# ---------------------------------------------------------------------------
+# version probing (labeller inputs)
+# ---------------------------------------------------------------------------
+
+def test_driver_versions(testdata):
+    sys_root, _ = fixture(testdata, "v5e-8")
+    v = get_driver_versions(sys_root)
+    assert v["driver-version"] == "1.8.0"
+    assert v["driver-src-version"].endswith("TPU")
+
+
+def test_firmware_version(testdata):
+    sys_root, _ = fixture(testdata, "v5e-8")
+    assert get_firmware_version(sys_root, accel_index=0) == "2.12.1"
+    assert get_firmware_version("/nonexistent", accel_index=0) == ""

@@ -1,0 +1,22 @@
+"""Topology-aware preferred-allocation policies.
+
+TPU-native analog of the reference's ``internal/pkg/allocator``
+(/root/reference/internal/pkg/allocator/): same Policy contract and
+best-effort pairwise-weight shape, but the weights come from ICI hop
+distance on the chip grid instead of KFD XGMI/PCIe link parsing, and
+candidate generation prefers contiguous rectangular ICI sub-meshes —
+the shapes XLA collectives ride efficiently.
+"""
+
+from .allocator import AllocationError, Policy
+from .device import AllocDevice, WeightModel, devices_from_discovery
+from .besteffort import BestEffortPolicy
+
+__all__ = [
+    "AllocationError",
+    "AllocDevice",
+    "BestEffortPolicy",
+    "Policy",
+    "WeightModel",
+    "devices_from_discovery",
+]

@@ -1,0 +1,39 @@
+"""Policy interface (≈ reference allocator/allocator.go:27-30)."""
+
+from __future__ import annotations
+
+import abc
+from typing import List, Optional, Sequence, TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from tpu_k8s_device_plugin.tpu.topology import IciTopology
+    from .device import AllocDevice
+
+
+class AllocationError(Exception):
+    """Raised when a preferred allocation cannot be computed; the plugin
+    surfaces this to the kubelet, which falls back to default allocation."""
+
+
+class Policy(abc.ABC):
+    """Preferred-allocation policy: precompute weights at init, answer
+    admission-time subset queries from memory only (the precompute-at-init
+    shape that keeps GetPreferredAllocation fast, SURVEY.md §3.3/§3.4)."""
+
+    @abc.abstractmethod
+    def init(
+        self,
+        devices: Sequence["AllocDevice"],
+        topology: Optional["IciTopology"] = None,
+    ) -> None:
+        """Build the pairwise weight table for *devices*."""
+
+    @abc.abstractmethod
+    def allocate(
+        self,
+        available_ids: Sequence[str],
+        required_ids: Sequence[str],
+        size: int,
+    ) -> List[str]:
+        """Pick *size* device ids from *available_ids* including all
+        *required_ids*, minimising total pairwise weight."""

@@ -1,0 +1,211 @@
+"""Best-effort topology-aware allocation policy.
+
+Keeps the reference's contract and validation semantics
+(besteffort_policy.go:88-151) with a TPU-first candidate search:
+
+1. **Sub-mesh pass** — enumerate contiguous rectangular boxes on the ICI
+   grid that exactly cover the request (squarest first).  These are the
+   shapes XLA's ICI collectives want; on a grid they are also the global
+   pairwise-weight minima.
+2. **Anti-fragmentation fill** — for partitioned chips, try to satisfy the
+   request from the fewest chips, preferring chips with the fewest free
+   partitions (hole-filling, ≈ device.go:375-440).
+3. **Greedy multi-seed fallback** — grow sets by minimum added pairwise
+   weight from every seed; covers irregular sizes and fragmented
+   availability.  Polynomial, unlike the reference's BFS subset combine.
+
+The lowest total pairwise weight wins; ties break to fewer distinct chips,
+then lowest chip/core indices, keeping results deterministic for the
+table-driven tests (≈ besteffort_policy_test.go's exact expected subsets).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tpu_k8s_device_plugin.tpu.topology import IciTopology
+from .allocator import AllocationError, Policy
+from .device import (
+    AllocDevice,
+    WeightModel,
+    enumerate_submesh_candidates,
+    group_by_parent,
+)
+
+
+class BestEffortPolicy(Policy):
+    def __init__(self) -> None:
+        self._model: Optional[WeightModel] = None
+        self._topology: Optional[IciTopology] = None
+        self._by_coord: Dict[Tuple[int, int, int], List[AllocDevice]] = {}
+        self._groups: Dict[str, List[AllocDevice]] = {}
+
+    def init(
+        self,
+        devices: Sequence[AllocDevice],
+        topology: Optional[IciTopology] = None,
+    ) -> None:
+        if not devices:
+            raise AllocationError("no devices to initialise policy with")
+        ids = [d.id for d in devices]
+        if len(set(ids)) != len(ids):
+            raise AllocationError("duplicate device ids")
+        self._topology = topology
+        self._model = WeightModel(devices, topology)
+        self._by_coord = {}
+        for d in devices:
+            self._by_coord.setdefault(d.coords, []).append(d)
+        for devs in self._by_coord.values():
+            devs.sort(key=lambda d: d.core_index)
+        # Parent grouping is static after init; only availability-dependent
+        # free counts are derived per call (precompute-at-init, SURVEY §3.3).
+        self._groups = group_by_parent(devices)
+
+    # -- validation mirrors besteffort_policy.go:88-124 ---------------------
+    def allocate(
+        self,
+        available_ids: Sequence[str],
+        required_ids: Sequence[str],
+        size: int,
+    ) -> List[str]:
+        if self._model is None:
+            raise AllocationError("policy not initialised")
+        if size <= 0:
+            raise AllocationError("allocation size must be a positive integer")
+        if len(available_ids) < size:
+            raise AllocationError(
+                f"allocation size {size} exceeds {len(available_ids)} available"
+            )
+        if len(required_ids) > size:
+            raise AllocationError("more required devices than allocation size")
+        model = self._model
+        unknown = [i for i in list(available_ids) + list(required_ids)
+                   if i not in model.by_id]
+        if unknown:
+            raise AllocationError(f"unknown device ids: {unknown}")
+        if not set(required_ids) <= set(available_ids):
+            raise AllocationError("required devices not all available")
+        if len(available_ids) == size:
+            return self._ordered(available_ids)
+        if len(required_ids) == size:
+            return self._ordered(required_ids)
+
+        available = frozenset(available_ids)
+        required = frozenset(required_ids)
+
+        # Free-partition counts per chip under *this* availability, for the
+        # hole-filling tie-break (≈ filterPartitions' fewest-free-first sort,
+        # device.go:342-349).
+        free_count = {
+            p: sum(1 for d in devs if d.id in available)
+            for p, devs in self._groups.items()
+        }
+
+        # Contiguous rectangular sub-meshes take strict priority: an
+        # L-shaped blob can score marginally lower on pairwise weight than a
+        # 1xN strip, but only the contiguous shape gives the workload a real
+        # ICI sub-mesh for XLA collectives.
+        candidates = self._submesh_candidates(size, available, required)
+        if not candidates:
+            candidates = self._fill_candidates(size, available, required)
+            candidates.extend(self._greedy_candidates(size, available, required))
+        if not candidates:
+            raise AllocationError("no candidate subsets found")
+
+        best = min(candidates, key=lambda c: self._candidate_key(c, free_count))
+        return self._ordered([d.id for d in best])
+
+    # -- candidate generators ----------------------------------------------
+
+    def _submesh_candidates(self, size, available, required):
+        if self._topology is None:
+            return []
+        return enumerate_submesh_candidates(
+            self._by_coord,
+            self._topology.chips_per_host_bounds,
+            size,
+            available,
+            required,
+        )
+
+    def _fill_candidates(self, size, available, required):
+        """Satisfy from as few chips as possible, filling the least-free
+        chips first (anti-fragmentation, ≈ device.go:310-442)."""
+        model = self._model
+        req_devs = [model.by_id[i] for i in required]
+        req_parents = {d.parent_id for d in req_devs}
+
+        free: List[Tuple[str, List[AllocDevice]]] = []
+        for parent, devs in self._groups.items():
+            f = [d for d in devs if d.id in available and d.id not in required]
+            if f:
+                free.append((parent, f))
+        # fewest free partitions first; required chips' leftovers before
+        # untouched chips; parent id as final deterministic tie-break
+        free.sort(key=lambda pf: (pf[0] not in req_parents, len(pf[1]), pf[0]))
+
+        chosen = list(req_devs)
+        for _parent, devs in free:
+            for d in devs:
+                if len(chosen) == size:
+                    break
+                chosen.append(d)
+            if len(chosen) == size:
+                break
+        return [chosen] if len(chosen) == size else []
+
+    def _greedy_candidates(self, size, available, required):
+        model = self._model
+        req_devs = [model.by_id[i] for i in required]
+        pool = [model.by_id[i] for i in available if i not in required]
+        free_count = {
+            p: sum(1 for d in devs if d.id in available)
+            for p, devs in self._groups.items()
+        }
+
+        def grow(seed: List[AllocDevice]) -> Optional[List[AllocDevice]]:
+            chosen = list(seed)
+            chosen_ids = {d.id for d in chosen}
+            while len(chosen) < size:
+                best_d, best_key = None, None
+                for d in pool:
+                    if d.id in chosen_ids:
+                        continue
+                    delta = sum(model.weight(d.id, c.id) for c in chosen)
+                    key = (delta, free_count[d.parent_id], d.sort_key)
+                    if best_key is None or key < best_key:
+                        best_d, best_key = d, key
+                if best_d is None:
+                    return None
+                chosen.append(best_d)
+                chosen_ids.add(best_d.id)
+            return chosen
+
+        out = []
+        if req_devs:
+            grown = grow(req_devs)
+            if grown:
+                out.append(grown)
+        else:
+            for seed in pool:
+                grown = grow([seed])
+                if grown:
+                    out.append(grown)
+        return out
+
+    # -- selection ----------------------------------------------------------
+
+    def _candidate_key(self, devs: List[AllocDevice], free_count):
+        ids = [d.id for d in devs]
+        parents = {d.parent_id for d in devs}
+        return (
+            self._model.set_weight(ids),
+            len(parents),
+            # hole-filling: prefer chips with fewer free partitions left
+            sum(free_count.get(p, 0) for p in parents),
+            sorted(d.sort_key for d in devs),
+        )
+
+    def _ordered(self, ids) -> List[str]:
+        model = self._model
+        return sorted(ids, key=lambda i: model.by_id[i].sort_key)

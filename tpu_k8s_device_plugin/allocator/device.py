@@ -1,0 +1,218 @@
+"""Allocator device model, ICI weight computation, sub-mesh enumeration.
+
+TPU-native analog of reference allocator/device.go.  The reference derives
+pairwise weights from KFD io_links/p2p_links (XGMI type 11 = 10, PCIe type 2
+= 40, NUMA affinity ±10; device.go:37-54,135-218).  TPU chips on a host are
+all ICI-connected in a grid, so the weight is the ICI hop count itself, and
+the structural trick (device.go:310-442's per-GPU grouping) becomes stronger:
+only contiguous rectangular sub-meshes are worth enumerating first.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from tpu_k8s_device_plugin.tpu.discovery import TpuDevice
+from tpu_k8s_device_plugin.tpu.topology import IciTopology
+
+# Weight constants.  Same scale as the reference's (device.go:37-54) so the
+# design doc's worked examples translate: intra-chip partitions are nearly
+# free, each ICI hop costs one XGMI-unit, PCIe-only (no ICI info) is 2-4x.
+WEIGHT_SAME_CHIP = 5          # two TensorCore partitions of one chip
+WEIGHT_PER_ICI_HOP = 10       # per ICI hop between chips
+WEIGHT_NUMA_PENALTY = 2       # added when chips sit under different NUMA nodes
+WEIGHT_PCIE_SAME_NUMA = 20    # no ICI data: same-NUMA PCIe
+WEIGHT_PCIE_DIFF_NUMA = 40    # no ICI data: cross-NUMA PCIe
+
+
+@dataclass(frozen=True)
+class AllocDevice:
+    """One allocatable device: a whole chip, or one TensorCore partition."""
+
+    id: str                   # kubelet device id
+    parent_id: str            # PCI address of the owning chip
+    chip_index: int           # discovery ordinal of the owning chip (NOT the
+                              # raw accel index, which is -1 on passthrough
+                              # hosts; the ordinal keeps ordering deterministic)
+    core_index: int = 0       # partition index within the chip (0 for whole)
+    coords: Tuple[int, int, int] = (0, 0, 0)
+    numa_node: int = 0
+
+    @property
+    def sort_key(self) -> Tuple[int, int]:
+        return (self.chip_index, self.core_index)
+
+
+def devices_from_discovery(
+    chips: Dict[str, TpuDevice], partitioned: Optional[bool] = None
+) -> List[AllocDevice]:
+    """Expand discovered chips into allocatable devices.
+
+    Chips in "core" partition mode contribute one AllocDevice per TensorCore
+    with ids ``<pci>#core<k>`` (the partition-device analog of the
+    reference's amdgpu_xcp_* ids); whole chips contribute themselves.  When
+    *partitioned* is given, only chips of that granularity are included
+    (mixed naming runs one policy per resource).
+    """
+    out: List[AllocDevice] = []
+    ordered = sorted(
+        chips.values(), key=lambda c: (c.accel_index < 0, c.accel_index, c.id)
+    )
+    for ordinal, chip in enumerate(ordered):
+        is_core = chip.partition_mode == "core"
+        if partitioned is not None and is_core != partitioned:
+            continue
+        if is_core:
+            for k in range(chip.cores_per_chip):
+                out.append(
+                    AllocDevice(
+                        id=f"{chip.id}#core{k}",
+                        parent_id=chip.id,
+                        chip_index=ordinal,
+                        core_index=k,
+                        coords=chip.coords,
+                        numa_node=chip.numa_node,
+                    )
+                )
+        else:
+            out.append(
+                AllocDevice(
+                    id=chip.id,
+                    parent_id=chip.id,
+                    chip_index=ordinal,
+                    coords=chip.coords,
+                    numa_node=chip.numa_node,
+                )
+            )
+    return out
+
+
+class WeightModel:
+    """Precomputed pairwise weights between devices
+    (≈ fetchAllPairWeights, device.go:220-252)."""
+
+    def __init__(
+        self,
+        devices: Sequence[AllocDevice],
+        topology: Optional[IciTopology] = None,
+    ):
+        self.devices = list(devices)
+        self.by_id: Dict[str, AllocDevice] = {d.id: d for d in devices}
+        self.topology = topology
+        self._weights: Dict[Tuple[str, str], int] = {}
+        for a, b in itertools.combinations(self.devices, 2):
+            w = self._pair_weight(a, b)
+            self._weights[(a.id, b.id)] = w
+            self._weights[(b.id, a.id)] = w
+
+    def _pair_weight(self, a: AllocDevice, b: AllocDevice) -> int:
+        if a.parent_id == b.parent_id:
+            return WEIGHT_SAME_CHIP
+        topo = self.topology
+        if topo is not None and topo.local_chip_count > 0:
+            hops = topo.coord_distance(a.coords, b.coords)
+            w = WEIGHT_PER_ICI_HOP * max(hops, 1)
+            if a.numa_node != b.numa_node:
+                w += WEIGHT_NUMA_PENALTY
+            return w
+        return (
+            WEIGHT_PCIE_SAME_NUMA
+            if a.numa_node == b.numa_node
+            else WEIGHT_PCIE_DIFF_NUMA
+        )
+
+    def weight(self, a_id: str, b_id: str) -> int:
+        if a_id == b_id:
+            return 0
+        return self._weights[(a_id, b_id)]
+
+    def set_weight(self, subset: Iterable[str]) -> int:
+        ids = list(subset)
+        return sum(
+            self.weight(x, y) for x, y in itertools.combinations(ids, 2)
+        )
+
+
+def enumerate_submesh_candidates(
+    devices_by_coord: Dict[Tuple[int, int, int], List[AllocDevice]],
+    bounds: Tuple[int, int, int],
+    size: int,
+    available: frozenset,
+    required: frozenset,
+) -> List[List[AllocDevice]]:
+    """All axis-aligned boxes on the chip grid whose devices exactly cover
+    *size*, are fully available, and contain every required device.
+
+    This is the TPU-structural replacement for the reference's BFS subset
+    combine (device.go:405-440): on an ICI grid only contiguous rectangles
+    minimise collective latency, and there are only O(X²Y²Z²) of them —
+    SURVEY.md §7 "hard parts" notes the sub-mesh constraint shrinks the
+    search space; exploit it.
+    """
+    out: List[List[AllocDevice]] = []
+    per_chip = 0
+    for devs in devices_by_coord.values():
+        per_chip = max(per_chip, len(devs))
+    if per_chip == 0 or size % per_chip != 0:
+        return out
+    target_chips = size // per_chip
+    X, Y, Z = (max(b, 1) for b in bounds)
+    for w, h, d in _box_shapes(target_chips, (X, Y, Z)):
+        for x0 in range(X - w + 1):
+            for y0 in range(Y - h + 1):
+                for z0 in range(Z - d + 1):
+                    chosen: List[AllocDevice] = []
+                    ok = True
+                    for x in range(x0, x0 + w):
+                        for y in range(y0, y0 + h):
+                            for z in range(z0, z0 + d):
+                                devs = devices_by_coord.get((x, y, z), [])
+                                if len(devs) != per_chip or any(
+                                    dev.id not in available for dev in devs
+                                ):
+                                    ok = False
+                                    break
+                                chosen.extend(devs)
+                            if not ok:
+                                break
+                        if not ok:
+                            break
+                    if ok and required <= {dev.id for dev in chosen}:
+                        out.append(chosen)
+    return out
+
+
+def _box_shapes(
+    n: int, limits: Tuple[int, int, int]
+) -> List[Tuple[int, int, int]]:
+    """Factorisations of n into (w,h,d) fitting inside *limits*, squarest
+    (smallest max-dimension, i.e. lowest-diameter sub-mesh) first."""
+    shapes = []
+    X, Y, Z = limits
+    for w in range(1, min(n, X) + 1):
+        if n % w:
+            continue
+        rest = n // w
+        for h in range(1, min(rest, Y) + 1):
+            if rest % h:
+                continue
+            d = rest // h
+            if d <= Z:
+                shapes.append((w, h, d))
+    shapes.sort(key=lambda s: (max(s), sorted(s, reverse=True)))
+    return shapes
+
+
+def group_by_parent(
+    devices: Iterable[AllocDevice],
+) -> Dict[str, List[AllocDevice]]:
+    """Partitions grouped by owning chip (≈ groupPartitionsByDevId,
+    device.go:287-304)."""
+    out: Dict[str, List[AllocDevice]] = {}
+    for d in devices:
+        out.setdefault(d.parent_id, []).append(d)
+    for devs in out.values():
+        devs.sort(key=lambda d: d.core_index)
+    return out

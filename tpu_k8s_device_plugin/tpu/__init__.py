@@ -1,0 +1,27 @@
+"""TPU chip discovery and device implementations.
+
+TPU-native analog of the reference's ``internal/pkg/amdgpu`` package
+(/root/reference/internal/pkg/amdgpu/): where AMD discovers GPUs through the
+KFD/amdgpu sysfs trees and libdrm ioctls, this package discovers TPU chips
+through the Linux ``accel`` class + PCI sysfs, the host ``tpu-env`` metadata
+file, and (optionally) the native tpuprobe shim.
+"""
+
+from .topology import (
+    AcceleratorSpec,
+    IciTopology,
+    parse_accelerator_type,
+    read_tpu_env,
+)
+from .discovery import TpuDevice, get_tpu_chips, is_homogeneous, unique_partition_config_count
+
+__all__ = [
+    "AcceleratorSpec",
+    "IciTopology",
+    "TpuDevice",
+    "get_tpu_chips",
+    "is_homogeneous",
+    "parse_accelerator_type",
+    "read_tpu_env",
+    "unique_partition_config_count",
+]

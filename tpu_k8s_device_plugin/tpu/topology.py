@@ -1,0 +1,309 @@
+"""ICI topology model: tpu-env parsing, accelerator-type table, chip coordinates.
+
+The reference reads per-link topology from the KFD sysfs tree
+(/root/reference/internal/pkg/amdgpu/amdgpu.go:406-445,821-863 and
+allocator/device.go:159-218).  TPU hosts have no KFD analog: the ICI mesh is
+described indirectly by the host metadata the TPU runtime publishes (the GCE
+metadata server's ``tpu-env`` attribute, mirrored to a host file by the VM
+runtime / GKE).  This module turns that metadata into explicit chip grid
+coordinates, which drive both the allocator's ICI-distance weights and the
+node labeller's topology labels.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from tpu_k8s_device_plugin.types import constants
+
+
+@dataclass(frozen=True)
+class AcceleratorSpec:
+    """Static per-generation chip properties."""
+
+    generation: str          # "v4", "v5e", "v5p", "v6e", ...
+    product_name: str        # marketing name for the labeller
+    cores_per_chip: int      # TensorCores per chip (1 on v5e/v6e, 2 on v4/v5p)
+    hbm_bytes_per_chip: int
+    default_chips_per_host: Tuple[int, int, int]
+    torus_3d: bool           # 3D torus ICI (v4/v5p) vs 2D mesh (v5e/v6e)
+
+
+_GIB = 1024 ** 3
+
+# Keyed by the accelerator-type prefix used in ACCELERATOR_TYPE strings
+# (e.g. "v5litepod-8" → prefix "v5litepod").
+ACCELERATOR_SPECS: Dict[str, AcceleratorSpec] = {
+    "v2": AcceleratorSpec("v2", "TPU v2", 2, 8 * _GIB, (2, 2, 1), False),
+    "v3": AcceleratorSpec("v3", "TPU v3", 2, 16 * _GIB, (2, 2, 1), False),
+    "v4": AcceleratorSpec("v4", "TPU v4", 2, 32 * _GIB, (2, 2, 1), True),
+    "v5litepod": AcceleratorSpec("v5e", "TPU v5e", 1, 16 * _GIB, (2, 4, 1), False),
+    "v5p": AcceleratorSpec("v5p", "TPU v5p", 2, 95 * _GIB, (2, 2, 1), True),
+    "v6e": AcceleratorSpec("v6e", "TPU v6e (Trillium)", 1, 32 * _GIB, (2, 4, 1), False),
+}
+
+# PCI device id → accelerator-type prefix, for sysfs-only fallback when no
+# tpu-env metadata is present (≈ the reference's AMDGPU_FAMILY_* table read
+# via libdrm ioctls, amdgpu.go:349-404).
+PCI_DEVICE_TO_PREFIX = {
+    "0x0027": "v3",
+    "0x005e": "v4",
+    "0x0062": "v5litepod",
+    "0x0063": "v5p",
+    "0x006f": "v6e",
+}
+
+
+def parse_accelerator_type(accel_type: str) -> Tuple[AcceleratorSpec, int]:
+    """Split an ACCELERATOR_TYPE string like ``v5litepod-16`` into
+    (generation spec, total chip count in the slice)."""
+    m = re.fullmatch(r"([a-z0-9]+)-(\d+)", accel_type.strip())
+    if not m:
+        raise ValueError(f"unparseable accelerator type: {accel_type!r}")
+    prefix, count = m.group(1), int(m.group(2))
+    if prefix not in ACCELERATOR_SPECS:
+        raise ValueError(f"unknown accelerator generation: {prefix!r}")
+    spec = ACCELERATOR_SPECS[prefix]
+    # v2/v3/v5p accelerator types historically count TensorCores, not chips
+    # (v5p-8 = 4 chips × 2 cores); v4 types count chips directly in the
+    # "v4-8" = 4 chips sense as well.  Normalise to chips.
+    chips = count // spec.cores_per_chip if spec.cores_per_chip > 1 else count
+    return spec, max(chips, 1)
+
+
+def read_tpu_env(path: str = constants.TPU_ENV_FILE) -> Dict[str, str]:
+    """Parse the host tpu-env metadata file.
+
+    Format is one ``KEY: 'value'`` or ``KEY=value`` pair per line (the GCE
+    metadata attribute uses the former; some runtimes write plain env style).
+    Unknown lines are ignored.  Returns {} if the file is absent — discovery
+    then falls back to pure sysfs probing.
+    """
+    env: Dict[str, str] = {}
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            raw = f.read()
+    except OSError:
+        return env
+    for line in raw.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        # Split on whichever separator appears first: values may themselves
+        # contain the other character (e.g. TPU_PARTITION_MODE_OVERRIDES=2:core).
+        ci, ei = line.find(":"), line.find("=")
+        if ci == -1 and ei == -1:
+            continue
+        sep = ":" if (ei == -1 or (ci != -1 and ci < ei)) else "="
+        key, _, val = line.partition(sep)
+        env[key.strip()] = val.strip().strip("'\"")
+    return env
+
+
+def _parse_bounds(s: str) -> Optional[Tuple[int, int, int]]:
+    """Parse "x,y,z" bounds; None on malformed input (callers fall back to
+    derived bounds rather than failing discovery on bad metadata)."""
+    try:
+        parts = [int(p) for p in s.split(",")]
+    except ValueError:
+        return None
+    if not parts or any(p <= 0 for p in parts):
+        return None
+    while len(parts) < 3:
+        parts.append(1)
+    return tuple(parts[:3])  # type: ignore[return-value]
+
+
+@dataclass
+class IciTopology:
+    """The host's view of the ICI mesh it belongs to.
+
+    ``chips_per_host_bounds`` is the local chip grid (e.g. (2,4,1) for a v5e
+    host with 8 chips); ``host_bounds`` the grid of hosts in the slice;
+    ``worker_id`` this host's index.  Chip grid coordinates are assigned
+    x-fastest (matching the TPU runtime's TPU_VISIBLE_CHIPS ordering).
+    """
+
+    accelerator_type: str = ""
+    spec: Optional[AcceleratorSpec] = None
+    chips_per_host_bounds: Tuple[int, int, int] = (0, 0, 0)
+    host_bounds: Tuple[int, int, int] = (1, 1, 1)
+    worker_id: int = 0
+    wrap: Tuple[bool, bool, bool] = (False, False, False)
+    raw_env: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def local_chip_count(self) -> int:
+        x, y, z = self.chips_per_host_bounds
+        return x * y * z
+
+    @property
+    def num_workers(self) -> int:
+        x, y, z = self.host_bounds
+        return x * y * z
+
+    @property
+    def topology_str(self) -> str:
+        """Slice-global topology label, e.g. ``2x4`` or ``4x4x4``."""
+        dims = [c * h for c, h in zip(self.chips_per_host_bounds, self.host_bounds)]
+        if dims[2] == 1 and not (self.spec and self.spec.torus_3d):
+            dims = dims[:2]
+        return "x".join(str(d) for d in dims)
+
+    def chip_coords(self, index: int) -> Tuple[int, int, int]:
+        """Local grid coordinates of a chip by accel index (x-fastest)."""
+        x, y, _z = self.chips_per_host_bounds
+        if x <= 0 or y <= 0:
+            return (index, 0, 0)
+        return (index % x, (index // x) % y, index // (x * y))
+
+    def global_chip_coords(self, index: int) -> Tuple[int, int, int]:
+        """Slice-global coordinates of a local chip (host offset + local)."""
+        hx, hy, _hz = self.host_bounds
+        wx = self.worker_id % hx if hx > 0 else 0
+        wy = (self.worker_id // hx) % hy if hx > 0 and hy > 0 else 0
+        wz = self.worker_id // (hx * hy) if hx > 0 and hy > 0 else 0
+        cx, cy, cz = self.chip_coords(index)
+        bx, by, bz = self.chips_per_host_bounds
+        return (wx * bx + cx, wy * by + cy, wz * bz + cz)
+
+    def coord_distance(
+        self, a: Tuple[int, int, int], b: Tuple[int, int, int]
+    ) -> int:
+        """Torus-aware manhattan ICI hop count between two grid coordinates.
+        The single source of truth for ICI distance (the allocator's weight
+        model and the labeller both call this)."""
+        total_dims = [c * h for c, h in zip(self.chips_per_host_bounds, self.host_bounds)]
+        dist = 0
+        for axis in range(3):
+            d = abs(a[axis] - b[axis])
+            if self.wrap[axis] and total_dims[axis] > 0:
+                d = min(d, total_dims[axis] - d)
+            dist += d
+        return dist
+
+    def ici_distance(self, a: int, b: int) -> int:
+        """ICI hop count between two local chips by accel index."""
+        return self.coord_distance(
+            self.global_chip_coords(a), self.global_chip_coords(b)
+        )
+
+
+def topology_from_env(
+    env: Dict[str, str], fallback_chip_count: int = 0, pci_device_id: str = ""
+) -> IciTopology:
+    """Build an IciTopology from tpu-env metadata, with sysfs fallbacks.
+
+    Recognised keys (GCE metadata spelling first, plain-env spelling second):
+    ACCELERATOR_TYPE, TPU_ACCELERATOR_TYPE; CHIPS_PER_HOST_BOUNDS,
+    TPU_CHIPS_PER_HOST_BOUNDS; HOST_BOUNDS, TPU_HOST_BOUNDS; WORKER_ID,
+    TPU_WORKER_ID; WRAP, TPU_WRAP.
+    """
+
+    def get(*names: str) -> str:
+        for n in names:
+            if n in env:
+                return env[n]
+        return ""
+
+    topo = IciTopology(raw_env=dict(env))
+
+    accel_type = get("ACCELERATOR_TYPE", constants.ENV_TPU_ACCELERATOR_TYPE)
+    spec: Optional[AcceleratorSpec] = None
+    slice_chips = 0
+    if accel_type:
+        try:
+            spec, slice_chips = parse_accelerator_type(accel_type)
+        except ValueError:
+            spec = None
+    if spec is None and pci_device_id in PCI_DEVICE_TO_PREFIX:
+        spec = ACCELERATOR_SPECS[PCI_DEVICE_TO_PREFIX[pci_device_id]]
+    topo.accelerator_type = accel_type
+    topo.spec = spec
+
+    bounds = _parse_bounds(
+        get("CHIPS_PER_HOST_BOUNDS", constants.ENV_TPU_CHIPS_PER_HOST_BOUNDS)
+    )
+    if bounds is not None:
+        topo.chips_per_host_bounds = bounds
+    elif spec is not None and fallback_chip_count in (0, _volume(spec.default_chips_per_host)):
+        topo.chips_per_host_bounds = spec.default_chips_per_host
+    elif fallback_chip_count > 0:
+        topo.chips_per_host_bounds = _linear_bounds(fallback_chip_count)
+
+    host_bounds = _parse_bounds(
+        get("HOST_BOUNDS", constants.ENV_TPU_PROCESS_BOUNDS, "TPU_HOST_BOUNDS")
+    )
+    if host_bounds is not None:
+        topo.host_bounds = host_bounds
+    elif spec is not None and slice_chips and topo.local_chip_count:
+        # Derive host grid from slice size when only ACCELERATOR_TYPE is given.
+        hosts = max(1, slice_chips // topo.local_chip_count)
+        topo.host_bounds = _linear_bounds(hosts)
+
+    wid = get("WORKER_ID", constants.ENV_TPU_WORKER_ID, "AGENT_WORKER_NUMBER")
+    if wid:
+        try:
+            topo.worker_id = int(wid)
+        except ValueError:
+            pass
+
+    wrap = get("WRAP", "TPU_WRAP")
+    if wrap:
+        vals = [v.strip().lower() in ("1", "true", "t") for v in wrap.split(",")]
+        while len(vals) < 3:
+            vals.append(False)
+        topo.wrap = tuple(vals[:3])  # type: ignore[assignment]
+    elif spec is not None and spec.torus_3d:
+        # Full v4/v5p pods wrap each axis; conservatively only claim wrap when
+        # an axis spans >= 4 chips (matches TPU wraparound availability).
+        total = [c * h for c, h in zip(topo.chips_per_host_bounds, topo.host_bounds)]
+        topo.wrap = tuple(t >= 4 for t in total)  # type: ignore[assignment]
+
+    return topo
+
+
+def _volume(b: Tuple[int, int, int]) -> int:
+    return b[0] * b[1] * b[2]
+
+
+def _linear_bounds(n: int) -> Tuple[int, int, int]:
+    """Factor n into a roughly-square 2D grid (x-major)."""
+    best = (n, 1, 1)
+    for x in range(1, n + 1):
+        if n % x == 0:
+            y = n // x
+            if abs(x - y) <= abs(best[0] - best[1]) and x <= y:
+                best = (x, y, 1)
+    return best
+
+
+def partition_modes_from_env(env: Dict[str, str], chip_count: int) -> List[str]:
+    """Per-chip partition granularity: "chip" (whole chip) or "core"
+    (per-TensorCore sub-device; only meaningful on 2-core generations).
+
+    The TPU analog of the per-GPU compute/memory partition styles the
+    reference reads from sysfs (amdgpu.go:464-495).  Global default from
+    TPU_PARTITION_MODE, per-chip overrides from TPU_PARTITION_MODE_OVERRIDES
+    (e.g. "4:core,5:core"), letting fixtures model heterogeneous hosts.
+    """
+    default = env.get("TPU_PARTITION_MODE", "chip").strip().lower()
+    if default not in ("chip", "core"):
+        default = "chip"
+    modes = [default] * chip_count
+    overrides = env.get("TPU_PARTITION_MODE_OVERRIDES", "")
+    for item in overrides.split(","):
+        item = item.strip()
+        if not item or ":" not in item:
+            continue
+        idx_s, _, mode = item.partition(":")
+        try:
+            idx = int(idx_s)
+        except ValueError:
+            continue
+        if 0 <= idx < chip_count and mode in ("chip", "core"):
+            modes[idx] = mode
+    return modes
