@@ -70,6 +70,8 @@ def make_host(
             w(os.path.join(accel_dir, "dev"), f"236:{i}")
             ln(os.path.join(accel_dir, "device"),
                f"../../../devices/pci0000:00/{addr}")
+            # stand-in for the /dev/accelN char device node
+            w(os.path.join(root, "dev", f"accel{i}"), "")
         if driver:
             drv_dir = os.path.join(sys_root, "bus", "pci", "drivers", driver)
             os.makedirs(drv_dir, exist_ok=True)

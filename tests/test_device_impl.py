@@ -1,0 +1,276 @@
+"""DeviceImpl tests: container impl and VFIO passthrough impls against
+fixture trees (≈ reference amdgpu_test.go + the VF/PF coverage it lacks)."""
+
+import os
+
+import pytest
+
+from tpu_k8s_device_plugin.allocator import BestEffortPolicy
+from tpu_k8s_device_plugin.proto import deviceplugin_pb2 as pluginapi
+from tpu_k8s_device_plugin.types import DevicePluginContext, constants
+from tpu_k8s_device_plugin.tpu.device_impl import TpuContainerImpl
+from tpu_k8s_device_plugin.tpu.device_impl_vfio import TpuPfImpl, TpuVfImpl
+from tpu_k8s_device_plugin.tpu.vfio import (
+    get_pf_mapping,
+    get_tpu_vf_module_versions,
+    get_vf_mapping,
+)
+
+
+def make_impl(testdata, name, **kwargs):
+    root = os.path.join(testdata, name)
+    return TpuContainerImpl(
+        sysfs_root=os.path.join(root, "sys"),
+        dev_root=os.path.join(root, "dev"),
+        tpu_env_path=os.path.join(root, "run", "tpu", "tpu-env"),
+        **kwargs,
+    )
+
+
+def ctx_for(impl, resource=None):
+    resource = resource or impl.get_resource_names()[0]
+    ctx = DevicePluginContext(resource, BestEffortPolicy())
+    impl.start(ctx)
+    return ctx
+
+
+def addr(i):
+    return f"0000:00:{4 + i:02x}.0"
+
+
+class TestContainerImpl:
+    def test_resource_names_single(self, testdata):
+        impl = make_impl(testdata, "v5e-8")
+        assert impl.get_resource_names() == ["tpu"]
+
+    def test_enumerate_with_numa_topology(self, testdata):
+        impl = make_impl(testdata, "v5e-8")
+        ctx = ctx_for(impl)
+        devs = impl.enumerate(ctx)
+        assert len(devs) == 8
+        assert all(d.health == constants.HEALTHY for d in devs)
+        by_id = {d.ID: d for d in devs}
+        assert by_id[addr(0)].topology.nodes[0].ID == 0
+        assert by_id[addr(7)].topology.nodes[0].ID == 1
+
+    def test_allocate_mounts_and_env(self, testdata):
+        impl = make_impl(testdata, "v5e-8")
+        ctx = ctx_for(impl)
+        req = pluginapi.AllocateRequest(
+            container_requests=[
+                pluginapi.ContainerAllocateRequest(
+                    devices_ids=[addr(0), addr(1)]
+                )
+            ]
+        )
+        resp = impl.allocate(ctx, req)
+        car = resp.container_responses[0]
+        assert [os.path.basename(d.host_path) for d in car.devices] == [
+            "accel0", "accel1"
+        ]
+        assert all(d.permissions == "rw" for d in car.devices)
+        assert car.envs[constants.ENV_TPU_VISIBLE_CHIPS] == "0,1"
+        assert car.envs[constants.ENV_TPU_SKIP_MDS_QUERY] == "true"
+        # sub-host allocation: the slice-wide accelerator type is omitted
+        # (it would imply a chip count the container is not granted)
+        assert constants.ENV_TPU_ACCELERATOR_TYPE not in car.envs
+        # 2 adjacent chips on the x axis -> 2x1x1 bounding box
+        assert car.envs[constants.ENV_TPU_CHIPS_PER_HOST_BOUNDS] == "2,1,1"
+        assert car.envs[constants.ENV_TPU_PROCESS_BOUNDS] == "1,1,1"
+
+    def test_allocate_full_host_propagates_slice_identity(self, testdata):
+        """A whole-host allocation on a multi-host slice must carry the
+        slice-level identity so JAX/libtpu can initialise distributed
+        training (worker 0 of the 2-host v5e-16 fixture)."""
+        impl = make_impl(testdata, "v5e-16-host0")
+        ctx = ctx_for(impl)
+        req = pluginapi.AllocateRequest(
+            container_requests=[
+                pluginapi.ContainerAllocateRequest(
+                    devices_ids=[addr(i) for i in range(8)]
+                )
+            ]
+        )
+        car = impl.allocate(ctx, req).container_responses[0]
+        assert car.envs[constants.ENV_TPU_ACCELERATOR_TYPE] == "v5litepod-16"
+        assert car.envs[constants.ENV_TPU_CHIPS_PER_HOST_BOUNDS] == "2,4,1"
+        assert car.envs[constants.ENV_TPU_PROCESS_BOUNDS] == "2,1,1"
+        assert car.envs[constants.ENV_TPU_WORKER_ID] == "0"
+        assert car.envs[constants.ENV_TPU_TOPOLOGY] == "4x4"
+
+    def test_allocate_noncontiguous_bounds_degrade_linear(self, testdata):
+        """Fragmented kubelet-default sets must not claim a bounding box
+        whose volume exceeds the chip count."""
+        impl = make_impl(testdata, "v5e-8")
+        ctx = ctx_for(impl)
+        req = pluginapi.AllocateRequest(
+            container_requests=[
+                # coords (0,0) and (1,1): box volume 4 != 2 chips
+                pluginapi.ContainerAllocateRequest(
+                    devices_ids=[addr(0), addr(3)]
+                )
+            ]
+        )
+        car = impl.allocate(ctx, req).container_responses[0]
+        assert car.envs[constants.ENV_TPU_CHIPS_PER_HOST_BOUNDS] == "2,1,1"
+
+    def test_allocate_unknown_device(self, testdata):
+        impl = make_impl(testdata, "v5e-8")
+        ctx = ctx_for(impl)
+        req = pluginapi.AllocateRequest(
+            container_requests=[
+                pluginapi.ContainerAllocateRequest(devices_ids=["bogus"])
+            ]
+        )
+        with pytest.raises(RuntimeError, match="unknown device"):
+            impl.allocate(ctx, req)
+
+    def test_preferred_allocation_uses_policy(self, testdata):
+        impl = make_impl(testdata, "v5e-8")
+        ctx = ctx_for(impl)
+        req = pluginapi.PreferredAllocationRequest(
+            container_requests=[
+                pluginapi.ContainerPreferredAllocationRequest(
+                    available_deviceIDs=[addr(i) for i in range(8)],
+                    allocation_size=4,
+                )
+            ]
+        )
+        resp = impl.get_preferred_allocation(ctx, req)
+        assert list(resp.container_responses[0].deviceIDs) == [
+            addr(0), addr(1), addr(2), addr(3)
+        ]
+
+    def test_options_reflect_allocator_state(self, testdata):
+        impl = make_impl(testdata, "v5e-8")
+        ctx = ctx_for(impl)
+        assert impl.get_options(ctx).get_preferred_allocation_available
+        ctx.set_allocator_error(True)
+        assert not impl.get_options(ctx).get_preferred_allocation_available
+
+    def test_update_health_simple_check(self, testdata):
+        impl = make_impl(testdata, "v5e-8")
+        ctx = ctx_for(impl)
+        devs = impl.update_health(ctx)
+        # fixture sysfs still enumerates all chips -> healthy
+        assert all(d.health == constants.HEALTHY for d in devs)
+
+    def test_update_health_exporter_overlay(self, testdata):
+        impl = make_impl(
+            testdata, "v5e-8",
+            health_fn=lambda: {addr(3): constants.UNHEALTHY},
+        )
+        ctx = ctx_for(impl)
+        health = {d.ID: d.health for d in impl.update_health(ctx)}
+        assert health[addr(3)] == constants.UNHEALTHY
+        assert health[addr(0)] == constants.HEALTHY
+
+    def test_update_health_exporter_failure_degrades(self, testdata):
+        def boom():
+            raise RuntimeError("exporter down")
+        impl = make_impl(testdata, "v5e-8", health_fn=boom)
+        ctx = ctx_for(impl)
+        devs = impl.update_health(ctx)
+        assert all(d.health == constants.HEALTHY for d in devs)
+
+    def test_heterogeneous_requires_mixed(self, testdata):
+        with pytest.raises(RuntimeError, match="mixed"):
+            make_impl(testdata, "v5p-8-hetero")
+
+    def test_heterogeneous_mixed_resources(self, testdata):
+        impl = make_impl(
+            testdata, "v5p-8-hetero",
+            resource_naming_strategy=constants.RESOURCE_NAMING_STRATEGY_MIXED,
+        )
+        assert impl.get_resource_names() == ["tpu", "tpucore"]
+        ctx_tpu = ctx_for(impl, "tpu")
+        ctx_core = ctx_for(impl, "tpucore")
+        assert len(impl.enumerate(ctx_tpu)) == 2
+        core_devs = impl.enumerate(ctx_core)
+        assert sorted(d.ID for d in core_devs) == [
+            f"{addr(2)}#core0", f"{addr(2)}#core1",
+            f"{addr(3)}#core0", f"{addr(3)}#core1",
+        ]
+
+    def test_core_partition_allocate(self, testdata):
+        impl = make_impl(
+            testdata, "v5p-8-core",
+            resource_naming_strategy=constants.RESOURCE_NAMING_STRATEGY_MIXED,
+        )
+        assert impl.get_resource_names() == ["tpucore"]
+        ctx = ctx_for(impl, "tpucore")
+        req = pluginapi.AllocateRequest(
+            container_requests=[
+                pluginapi.ContainerAllocateRequest(
+                    devices_ids=[f"{addr(0)}#core0", f"{addr(0)}#core1"]
+                )
+            ]
+        )
+        car = impl.allocate(ctx, req).container_responses[0]
+        # both cores live on one chip: one device node, not two
+        assert [os.path.basename(d.host_path) for d in car.devices] == ["accel0"]
+        assert car.envs["TPU_VISIBLE_CORES"] == "0,1"
+
+    def test_no_accel_class_raises(self, testdata):
+        with pytest.raises(RuntimeError, match="accel"):
+            make_impl(testdata, "vfio-pf")
+
+
+class TestVfioImpls:
+    def test_pf_mapping(self, testdata):
+        m = get_pf_mapping(os.path.join(testdata, "vfio-pf", "sys"))
+        assert len(m) == 4
+        assert m["8"].pci_address == addr(0)
+
+    def test_vf_mapping(self, testdata):
+        m = get_vf_mapping(os.path.join(testdata, "vfio-vf", "sys"))
+        assert len(m) == 4  # 2 PFs x 2 VFs
+        groups = sorted(m, key=int)
+        assert m[groups[0]].pf_pci_address == addr(0)
+        assert m[groups[0]].pci_address.startswith("0000:01:")
+
+    def test_vf_module_versions(self, testdata):
+        v = get_tpu_vf_module_versions(os.path.join(testdata, "vfio-vf", "sys"))
+        assert v["version"] == "1.8.0"
+
+    def test_pf_impl_enumerate_allocate(self, testdata):
+        impl = TpuPfImpl(sysfs_root=os.path.join(testdata, "vfio-pf", "sys"))
+        ctx = DevicePluginContext(impl.get_resource_names()[0])
+        impl.start(ctx)
+        assert ctx.get_allocator_error()  # no topology policy for passthrough
+        devs = impl.enumerate(ctx)
+        assert [d.ID for d in devs] == ["8", "9", "10", "11"]
+        req = pluginapi.AllocateRequest(
+            container_requests=[
+                pluginapi.ContainerAllocateRequest(devices_ids=["8", "9"])
+            ]
+        )
+        car = impl.allocate(ctx, req).container_responses[0]
+        assert [d.host_path for d in car.devices] == [
+            "/dev/vfio/8", "/dev/vfio/9", "/dev/vfio/vfio"
+        ]
+        assert car.envs["PCI_RESOURCE_GOOGLE_COM_TPU"] == f"{addr(0)},{addr(1)}"
+
+    def test_pf_impl_health(self, testdata):
+        impl = TpuPfImpl(sysfs_root=os.path.join(testdata, "vfio-pf", "sys"))
+        ctx = DevicePluginContext("tpu")
+        devs = impl.update_health(ctx)
+        assert all(d.health == constants.HEALTHY for d in devs)
+
+    def test_vf_impl_health_maps_pf(self, testdata):
+        sys_root = os.path.join(testdata, "vfio-vf", "sys")
+        impl = TpuVfImpl(
+            sysfs_root=sys_root,
+            resource_naming_strategy=constants.RESOURCE_NAMING_STRATEGY_MIXED,
+            health_fn=lambda: {addr(0): constants.UNHEALTHY},
+        )
+        assert impl.get_resource_names() == ["tpu_vf"]
+        ctx = DevicePluginContext("tpu_vf")
+        health = {d.ID: d.health for d in impl.update_health(ctx)}
+        # both VFs of PF0 inherit its unhealthiness; PF1's VFs stay healthy
+        unhealthy = [g for g, h in health.items() if h == constants.UNHEALTHY]
+        assert len(unhealthy) == 2
+
+    def test_vf_impl_missing_driver_raises(self, testdata):
+        with pytest.raises(RuntimeError):
+            TpuVfImpl(sysfs_root=os.path.join(testdata, "vfio-pf", "sys"))

@@ -1,0 +1,50 @@
+"""Health subsystem tests: probe server + client over a unix socket."""
+
+import os
+
+from tpu_k8s_device_plugin.health import TpuHealthServer, get_tpu_health
+from tpu_k8s_device_plugin.health.server import probe_chip_states
+from tpu_k8s_device_plugin.types import constants
+
+
+def roots(testdata, name):
+    root = os.path.join(testdata, name)
+    return os.path.join(root, "sys"), os.path.join(root, "dev")
+
+
+def test_probe_chip_states(testdata):
+    sys_root, dev_root = roots(testdata, "v5e-8")
+    states = probe_chip_states(sys_root, dev_root)
+    assert len(states) == 8
+    s = states["0000:00:04.0"]
+    assert s.health == "Healthy" and s.accel_index == 0
+    assert s.device.endswith("accel0")
+
+
+def test_probe_detects_missing_dev_node(testdata, tmp_path):
+    sys_root, _ = roots(testdata, "v5e-8")
+    # empty dev root: every chip's node is missing -> Unhealthy
+    states = probe_chip_states(sys_root, str(tmp_path))
+    assert all(s.health == "Unhealthy" for s in states.values())
+
+
+def test_client_server_roundtrip(testdata, tmp_path):
+    sys_root, dev_root = roots(testdata, "v5e-8")
+    sock = str(tmp_path / "exporter.sock")
+    server = TpuHealthServer(sock, sys_root, dev_root).start()
+    try:
+        health = get_tpu_health(sock, timeout_s=5.0)
+        assert len(health) == 8
+        assert all(v == constants.HEALTHY for v in health.values())
+    finally:
+        server.stop()
+
+
+def test_client_missing_socket_returns_empty(tmp_path):
+    assert get_tpu_health(str(tmp_path / "nope.sock")) == {}
+
+
+def test_client_dead_socket_returns_empty(tmp_path):
+    sock = str(tmp_path / "dead.sock")
+    open(sock, "w").close()  # a plain file, not a listening socket
+    assert get_tpu_health(sock, timeout_s=0.5) == {}

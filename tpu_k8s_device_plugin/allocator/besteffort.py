@@ -108,7 +108,9 @@ class BestEffortPolicy(Policy):
         candidates = self._submesh_candidates(size, available, required)
         if not candidates:
             candidates = self._fill_candidates(size, available, required)
-            candidates.extend(self._greedy_candidates(size, available, required))
+            candidates.extend(
+                self._greedy_candidates(size, available, required, free_count)
+            )
         if not candidates:
             raise AllocationError("no candidate subsets found")
 
@@ -154,14 +156,10 @@ class BestEffortPolicy(Policy):
                 break
         return [chosen] if len(chosen) == size else []
 
-    def _greedy_candidates(self, size, available, required):
+    def _greedy_candidates(self, size, available, required, free_count):
         model = self._model
         req_devs = [model.by_id[i] for i in required]
         pool = [model.by_id[i] for i in available if i not in required]
-        free_count = {
-            p: sum(1 for d in devs if d.id in available)
-            for p, devs in self._groups.items()
-        }
 
         def grow(seed: List[AllocDevice]) -> Optional[List[AllocDevice]]:
             chosen = list(seed)

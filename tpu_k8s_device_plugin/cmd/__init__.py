@@ -1,0 +1,1 @@
+"""CLI entrypoints (≈ reference cmd/)."""

@@ -1,0 +1,138 @@
+"""k8s-tpu-device-plugin entrypoint.
+
+TPU-native analog of /root/reference/cmd/k8s-device-plugin/main.go:34-120:
+flag parsing/validation, device-impl selection (explicit driver type or the
+container→vf→pf fallback chain), then the plugin manager lifecycle.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import logging
+import sys
+
+from tpu_k8s_device_plugin import __version__
+from tpu_k8s_device_plugin.health import get_tpu_health
+from tpu_k8s_device_plugin.manager import PluginManager
+from tpu_k8s_device_plugin.tpu.device_impl import TpuContainerImpl
+from tpu_k8s_device_plugin.tpu.device_impl_vfio import TpuPfImpl, TpuVfImpl
+from tpu_k8s_device_plugin.types import constants
+
+log = logging.getLogger("k8s-tpu-device-plugin")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="k8s-tpu-device-plugin",
+        description="Kubernetes device plugin for Google Cloud TPUs",
+    )
+    p.add_argument(
+        "--pulse", type=int, default=0, metavar="SECONDS",
+        help="time between health check polling; 0 disables (default 0)",
+    )
+    p.add_argument(
+        "--driver_type", "--driver-type", dest="driver_type",
+        choices=[constants.CONTAINER, constants.VF_PASSTHROUGH,
+                 constants.PF_PASSTHROUGH],
+        default=None,
+        help="device driver mode; omit to autodetect "
+             "(container, then vf-passthrough, then pf-passthrough)",
+    )
+    p.add_argument(
+        "--resource_naming_strategy", "--resource-naming-strategy",
+        dest="naming_strategy",
+        choices=[constants.RESOURCE_NAMING_STRATEGY_SINGLE,
+                 constants.RESOURCE_NAMING_STRATEGY_MIXED],
+        default=constants.RESOURCE_NAMING_STRATEGY_SINGLE,
+        help="single: everything under google.com/tpu; "
+             "mixed: partition-typed resource names",
+    )
+    p.add_argument(
+        "--kubelet-dir", default=constants.DEVICE_PLUGIN_PATH,
+        help="kubelet device-plugin directory",
+    )
+    p.add_argument("--sysfs-root", default="/sys", help=argparse.SUPPRESS)
+    p.add_argument("--dev-root", default="/dev", help=argparse.SUPPRESS)
+    p.add_argument(
+        "--tpu-env", default=constants.TPU_ENV_FILE, help=argparse.SUPPRESS
+    )
+    p.add_argument(
+        "--exporter-socket", default=constants.METRICS_EXPORTER_SOCKET,
+        help="tpu-metrics-exporter unix socket for granular health",
+    )
+    p.add_argument("-v", "--verbose", action="count", default=0)
+    p.add_argument("--version", action="version", version=__version__)
+    return p
+
+
+def select_device_impl(args):
+    """Explicit driver type, or the fallback chain
+    (≈ main.go:85-115: container → vf → pf)."""
+    health_fn = functools.partial(get_tpu_health, args.exporter_socket)
+    builders = {
+        constants.CONTAINER: lambda: TpuContainerImpl(
+            resource_naming_strategy=args.naming_strategy,
+            sysfs_root=args.sysfs_root,
+            dev_root=args.dev_root,
+            tpu_env_path=args.tpu_env,
+            health_fn=health_fn,
+        ),
+        constants.VF_PASSTHROUGH: lambda: TpuVfImpl(
+            resource_naming_strategy=args.naming_strategy,
+            sysfs_root=args.sysfs_root,
+            dev_root=args.dev_root,
+            health_fn=health_fn,
+        ),
+        constants.PF_PASSTHROUGH: lambda: TpuPfImpl(
+            resource_naming_strategy=args.naming_strategy,
+            sysfs_root=args.sysfs_root,
+            dev_root=args.dev_root,
+            health_fn=health_fn,
+        ),
+    }
+    if args.driver_type:
+        return builders[args.driver_type](), args.driver_type
+    last_err = None
+    for driver_type in (constants.CONTAINER, constants.VF_PASSTHROUGH,
+                        constants.PF_PASSTHROUGH):
+        try:
+            impl = builders[driver_type]()
+            log.info("autodetected driver type: %s", driver_type)
+            return impl, driver_type
+        except Exception as e:
+            log.info("driver type %s not usable: %s", driver_type, e)
+            last_err = e
+    raise SystemExit(f"no usable TPU driver mode found: {last_err}")
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
+    )
+    log.info("k8s-tpu-device-plugin %s starting", __version__)
+    if args.pulse < 0:
+        log.error("invalid pulse %d; must be >= 0", args.pulse)
+        return 2
+
+    impl, driver_type = select_device_impl(args)
+    resources = impl.get_resource_names()
+    log.info("driver=%s resources=%s", driver_type,
+             [f"{constants.RESOURCE_NAMESPACE}/{r}" for r in resources])
+
+    manager = PluginManager(
+        impl,
+        pulse_seconds=args.pulse,
+        kubelet_dir=args.kubelet_dir,
+    )
+    try:
+        manager.run(block=True)
+    finally:
+        manager.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,46 @@
+"""tpu-metrics-exporter entrypoint: the standalone health probe daemon.
+
+The AMD analog is a separate project the reference only consumes
+(docs/user-guide/installation.md); this build ships it so the health DS
+variant works out of the box.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+from tpu_k8s_device_plugin import __version__
+from tpu_k8s_device_plugin.health import TpuHealthServer
+from tpu_k8s_device_plugin.types import constants
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tpu-metrics-exporter")
+    p.add_argument(
+        "--socket", default=constants.METRICS_EXPORTER_SOCKET,
+        help="unix socket to serve the TpuHealthService on",
+    )
+    p.add_argument("--sysfs-root", default="/sys", help=argparse.SUPPRESS)
+    p.add_argument("--dev-root", default="/dev", help=argparse.SUPPRESS)
+    p.add_argument("--version", action="version", version=__version__)
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    server = TpuHealthServer(
+        socket_path=args.socket,
+        sysfs_root=args.sysfs_root,
+        dev_root=args.dev_root,
+    ).start()
+    try:
+        server.wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

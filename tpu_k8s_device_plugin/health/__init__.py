@@ -1,0 +1,6 @@
+"""Health subsystem: exporter client + probe server (≈ internal/pkg/exporter)."""
+
+from .client import get_tpu_health
+from .server import TpuHealthServer
+
+__all__ = ["get_tpu_health", "TpuHealthServer"]

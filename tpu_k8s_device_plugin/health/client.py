@@ -1,0 +1,50 @@
+"""Client to the tpu-metrics-exporter health service.
+
+TPU-native analog of the reference's exporter client
+(/root/reference/internal/pkg/exporter/health.go:35-79): a short-lived
+insecure gRPC connection over the exporter's unix socket per poll, mapping
+device id → Healthy/Unhealthy.  Unreachable exporter returns {} — the
+plugin then falls back to its own simple health check.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict
+
+import grpc
+
+from tpu_k8s_device_plugin.proto import (
+    tpuhealth_pb2 as hpb,
+    tpuhealth_pb2_grpc as hpb_grpc,
+)
+from tpu_k8s_device_plugin.types import constants
+
+log = logging.getLogger(__name__)
+
+
+def get_tpu_health(
+    socket_path: str = constants.METRICS_EXPORTER_SOCKET,
+    timeout_s: float = constants.EXPORTER_HEALTH_CHECK_TIMEOUT_S,
+) -> Dict[str, str]:
+    """Chip PCI address → "Healthy"/"Unhealthy" from the exporter daemon."""
+    if not os.path.exists(socket_path):
+        return {}
+    out: Dict[str, str] = {}
+    try:
+        with grpc.insecure_channel(f"unix://{socket_path}") as ch:
+            stub = hpb_grpc.TpuHealthServiceStub(ch)
+            resp = stub.List(hpb.ListTpuStateRequest(), timeout=timeout_s)
+        for state in resp.states:
+            health = state.health.strip().lower()
+            out[state.id] = (
+                constants.HEALTHY
+                if health == "healthy"
+                else constants.UNHEALTHY
+            )
+    except grpc.RpcError as e:
+        log.warning("tpu-metrics-exporter unreachable at %s: %s",
+                    socket_path, e)
+        return {}
+    return out

@@ -1,0 +1,114 @@
+"""tpu-metrics-exporter: the probe daemon the plugin's health client talks to.
+
+The reference only ships the *client* half (the AMD device-metrics-exporter
+is a separate project); this build provides a working server too, so the
+health path is testable end-to-end and deployable from one image.  The probe
+re-enumerates the accel class and verifies each chip's device node is
+openable — a libtpu-free check that doesn't steal chip access from running
+workloads (SURVEY §7 'health without privileged /dev/kfd': the probe must be
+non-exclusive).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import os
+from typing import Dict, Optional
+
+import grpc
+
+from tpu_k8s_device_plugin.proto import (
+    tpuhealth_pb2 as hpb,
+    tpuhealth_pb2_grpc as hpb_grpc,
+)
+from tpu_k8s_device_plugin.tpu import discovery
+from tpu_k8s_device_plugin.types import constants
+
+log = logging.getLogger(__name__)
+
+
+def probe_chip_states(
+    sysfs_root: str = "/sys", dev_root: str = "/dev"
+) -> Dict[str, hpb.TpuState]:
+    """Probe every chip's presence + device-node accessibility."""
+    states: Dict[str, hpb.TpuState] = {}
+    chips, _ = discovery.get_tpu_chips(sysfs_root, dev_root, "/nonexistent")
+    for chip in chips.values():
+        healthy = True
+        if chip.accel_index >= 0:
+            healthy = os.path.exists(chip.dev_path) and os.access(
+                chip.dev_path, os.R_OK | os.W_OK
+            )
+        states[chip.id] = hpb.TpuState(
+            id=chip.id,
+            accel_index=chip.accel_index,
+            health="Healthy" if healthy else "Unhealthy",
+            device=chip.dev_path,
+        )
+    return states
+
+
+class _Servicer(hpb_grpc.TpuHealthServiceServicer):
+    def __init__(self, sysfs_root: str, dev_root: str):
+        self._sysfs_root = sysfs_root
+        self._dev_root = dev_root
+
+    def GetTpuState(self, request, context):
+        states = probe_chip_states(self._sysfs_root, self._dev_root)
+        state = states.get(request.id)
+        if state is None:
+            context.abort(
+                grpc.StatusCode.NOT_FOUND, f"unknown chip {request.id}"
+            )
+        return hpb.GetTpuStateResponse(state=state)
+
+    def List(self, request, context):
+        states = probe_chip_states(self._sysfs_root, self._dev_root)
+        return hpb.ListTpuStateResponse(
+            states=[states[k] for k in sorted(states)]
+        )
+
+
+class TpuHealthServer:
+    """Serves TpuHealthService on a unix socket."""
+
+    def __init__(
+        self,
+        socket_path: str = constants.METRICS_EXPORTER_SOCKET,
+        sysfs_root: str = "/sys",
+        dev_root: str = "/dev",
+    ):
+        self.socket_path = socket_path
+        self._sysfs_root = sysfs_root
+        self._dev_root = dev_root
+        self._server: Optional[grpc.Server] = None
+
+    def start(self) -> "TpuHealthServer":
+        os.makedirs(os.path.dirname(self.socket_path), exist_ok=True)
+        if os.path.exists(self.socket_path):
+            os.remove(self.socket_path)
+        self._server = grpc.server(
+            concurrent.futures.ThreadPoolExecutor(max_workers=4)
+        )
+        hpb_grpc.add_TpuHealthServiceServicer_to_server(
+            _Servicer(self._sysfs_root, self._dev_root), self._server
+        )
+        self._server.add_insecure_port(f"unix://{self.socket_path}")
+        self._server.start()
+        log.info("tpu-metrics-exporter serving on %s", self.socket_path)
+        return self
+
+    def wait(self) -> None:
+        if self._server is not None:
+            self._server.wait_for_termination()
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=1.0).wait()
+            self._server = None
+        if os.path.exists(self.socket_path):
+            try:
+                os.remove(self.socket_path)
+            except OSError:
+                pass

@@ -1,0 +1,5 @@
+"""Plugin lifecycle manager (≈ internal/pkg/manager + kubevirt/dpm reimpl)."""
+
+from .manager import PluginManager
+
+__all__ = ["PluginManager"]

@@ -1,0 +1,5 @@
+"""Kubelet-facing plugin adapter (≈ internal/pkg/plugin)."""
+
+from .plugin import TpuDevicePlugin
+
+__all__ = ["TpuDevicePlugin"]

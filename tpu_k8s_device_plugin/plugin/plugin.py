@@ -1,0 +1,125 @@
+"""Plugin adapter: implements the kubelet DevicePluginServer, delegating
+every RPC to a DeviceImpl.
+
+TPU-native analog of AMDGPUPlugin
+(/root/reference/internal/pkg/plugin/plugin.go:44-186): owns the heartbeat
+and stop signalling for the ListAndWatch stream; all device knowledge lives
+behind the DeviceImpl contract.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import List, Optional
+
+import grpc
+
+from tpu_k8s_device_plugin.proto import (
+    deviceplugin_pb2 as pluginapi,
+    deviceplugin_pb2_grpc as pluginapi_grpc,
+)
+from tpu_k8s_device_plugin.types import DeviceImpl, DevicePluginContext
+
+log = logging.getLogger(__name__)
+
+_BEAT = "beat"
+_STOP = "stop"
+
+
+class TpuDevicePlugin(pluginapi_grpc.DevicePluginServicer):
+    """One instance serves one resource name."""
+
+    def __init__(self, device_impl: DeviceImpl, ctx: DevicePluginContext):
+        self.impl = device_impl
+        self.ctx = ctx
+        self._lock = threading.Lock()
+        self._watchers: List[queue.Queue] = []
+        self._stopped = False
+
+    # -- lifecycle signalling (≈ plugin.go heartbeat/signal channels) -------
+
+    def beat(self) -> None:
+        """Pulse: every open ListAndWatch stream re-probes health and
+        resends its device list."""
+        with self._lock:
+            for q in self._watchers:
+                q.put(_BEAT)
+
+    def stop(self) -> None:
+        """Terminate all ListAndWatch streams (plugin shutdown)."""
+        with self._lock:
+            self._stopped = True
+            for q in self._watchers:
+                q.put(_STOP)
+
+    def start(self) -> None:
+        """Called after construction, before kubelet registration
+        (≈ AMDGPUPlugin.Start → DeviceImpl.Start, plugin.go:116-120)."""
+        self.impl.start(self.ctx)
+
+    # -- DevicePluginServer RPCs -------------------------------------------
+
+    def GetDevicePluginOptions(self, request, context):
+        try:
+            return self.impl.get_options(self.ctx)
+        except Exception as e:
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+    def ListAndWatch(self, request, context):
+        """Initial device list, then health-refreshed resends on every
+        heartbeat (≈ plugin.go:146-170)."""
+        try:
+            devices = self.impl.enumerate(self.ctx)
+        except Exception as e:
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+            return
+        # register the watcher before the first send so a beat() arriving
+        # while the initial frame is in flight is never dropped
+        q: queue.Queue = queue.Queue()
+        with self._lock:
+            if self._stopped:
+                return
+            self._watchers.append(q)
+        # client disconnect must unblock q.get() — otherwise every kubelet
+        # restart leaks one executor thread parked in get() forever
+        context.add_callback(lambda: q.put(_STOP))
+        yield pluginapi.ListAndWatchResponse(devices=devices)
+        try:
+            while context.is_active():
+                msg = q.get()
+                if msg == _STOP:
+                    log.info(
+                        "ListAndWatch(%s): stop signal, closing stream",
+                        self.ctx.resource_name(),
+                    )
+                    return
+                try:
+                    devices = self.impl.update_health(self.ctx)
+                except Exception as e:
+                    log.error("UpdateHealth failed: %s", e)
+                    continue
+                yield pluginapi.ListAndWatchResponse(devices=devices)
+        finally:
+            with self._lock:
+                if q in self._watchers:
+                    self._watchers.remove(q)
+
+    def GetPreferredAllocation(self, request, context):
+        try:
+            return self.impl.get_preferred_allocation(self.ctx, request)
+        except Exception as e:
+            log.error("GetPreferredAllocation failed: %s", e)
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+    def Allocate(self, request, context):
+        try:
+            return self.impl.allocate(self.ctx, request)
+        except Exception as e:
+            log.error("Allocate failed: %s", e)
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+    def PreStartContainer(self, request, context):
+        # Not required (pre_start_required=false), but answer gracefully.
+        return pluginapi.PreStartContainerResponse()

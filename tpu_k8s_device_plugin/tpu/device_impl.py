@@ -1,0 +1,286 @@
+"""Container-workload device implementation (the KFD-impl analog).
+
+TPU-native analog of AMDGPUKFDImpl
+(/root/reference/internal/pkg/amdgpu/amdgpu.go:56-345): discovers chips at
+init, precomputes per-resource device lists, answers every kubelet RPC from
+memory, and hands containers the allocated /dev/accel* nodes plus the
+TPU runtime env (TPU_VISIBLE_CHIPS & friends) — the TPU equivalent of
+mounting only the allocated /dev/dri nodes for isolation.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Callable, Dict, List, Optional
+
+from tpu_k8s_device_plugin.allocator import (
+    AllocationError,
+    devices_from_discovery,
+)
+from tpu_k8s_device_plugin.proto import deviceplugin_pb2 as pluginapi
+from tpu_k8s_device_plugin.types import DeviceImpl, DevicePluginContext, constants
+from . import discovery
+from .discovery import TpuDevice
+from .topology import IciTopology
+
+log = logging.getLogger(__name__)
+
+# Signature of the granular health overlay (wired to the tpu-metrics-exporter
+# client; injected so the impl is testable without a running exporter).
+HealthFn = Callable[[], Dict[str, str]]
+
+
+class TpuContainerImpl(DeviceImpl):
+    """DeviceImpl for container workloads via the accel driver."""
+
+    def __init__(
+        self,
+        resource_naming_strategy: str = constants.RESOURCE_NAMING_STRATEGY_SINGLE,
+        sysfs_root: str = "/sys",
+        dev_root: str = "/dev",
+        tpu_env_path: str = constants.TPU_ENV_FILE,
+        health_fn: Optional[HealthFn] = None,
+    ):
+        self._strategy = resource_naming_strategy
+        self._sysfs_root = sysfs_root
+        self._dev_root = dev_root
+        self._tpu_env_path = tpu_env_path
+        self._health_fn = health_fn
+
+        self.chips: Dict[str, TpuDevice] = {}
+        self.topology: Optional[IciTopology] = None
+        self._homogeneous = True
+        self._dev_list: Dict[str, List[pluginapi.Device]] = {}
+        self._chips_by_dev_id: Dict[str, TpuDevice] = {}
+
+        self._init()
+
+    # -- init (≈ AMDGPUKFDImpl.Init, amdgpu.go:68-88) -----------------------
+
+    def _init(self) -> None:
+        accel_dir = os.path.join(self._sysfs_root, "class", "accel")
+        if not os.path.isdir(accel_dir):
+            raise RuntimeError("no TPU accel driver loaded")
+        self.chips, self.topology = discovery.get_tpu_chips(
+            self._sysfs_root, self._dev_root, self._tpu_env_path
+        )
+        # The container path serves chips through the accel driver only; a
+        # chip discovered via the raw PCI fallback (accel_index -1) has no
+        # /dev/accelN node to mount — advertising it would admit pods that
+        # get zero usable TPUs.  (Such chips belong to the vf/pf impls.)
+        self.chips = {
+            cid: c for cid, c in self.chips.items() if c.accel_index >= 0
+        }
+        if not self.chips:
+            raise RuntimeError("accel class present but no TPU chips found")
+        self._homogeneous = discovery.is_homogeneous(self.chips)
+        if (
+            not self._homogeneous
+            and self._strategy == constants.RESOURCE_NAMING_STRATEGY_SINGLE
+        ):
+            raise RuntimeError(
+                "chips with different partition modes on one node require "
+                "resource_naming_strategy=mixed"
+            )
+        for resource in self.get_resource_names():
+            self._dev_list[resource] = self._plugin_device_list(resource)
+
+    # -- resource naming (≈ GetResourceNames, amdgpu.go:122-162) ------------
+
+    def get_resource_names(self) -> List[str]:
+        if not self.chips:
+            return []
+        counts = discovery.unique_partition_config_count(self.chips)
+        if self._homogeneous:
+            if self._strategy == constants.RESOURCE_NAMING_STRATEGY_SINGLE:
+                return [constants.DEVICE_TYPE_TPU]
+            # mixed on a homogeneous node: partition-typed names, falling
+            # back to plain "tpu" when partitioning isn't in play
+            if counts == {constants.DEVICE_TYPE_TPU: len(self.chips)}:
+                return [constants.DEVICE_TYPE_TPU]
+            return sorted(r for r, c in counts.items() if c > 0)
+        return sorted(r for r, c in counts.items() if c > 0)
+
+    def _alloc_devices_for(self, resource: str):
+        partitioned = resource == constants.DEVICE_TYPE_TPU_CORE
+        if self._homogeneous:
+            return devices_from_discovery(self.chips)
+        return devices_from_discovery(self.chips, partitioned=partitioned)
+
+    def _plugin_device_list(self, resource: str) -> List[pluginapi.Device]:
+        devs = []
+        for ad in self._alloc_devices_for(resource):
+            chip = self.chips[ad.parent_id]
+            self._chips_by_dev_id[ad.id] = chip
+            devs.append(
+                pluginapi.Device(
+                    ID=ad.id,
+                    health=constants.HEALTHY,
+                    topology=pluginapi.TopologyInfo(
+                        nodes=[pluginapi.NUMANode(ID=chip.numa_node)]
+                    ),
+                )
+            )
+        return devs
+
+    # -- DeviceImpl RPC surface ---------------------------------------------
+
+    def start(self, ctx: DevicePluginContext) -> None:
+        """Initialise this resource's allocator (≈ Start, amdgpu.go:90-119).
+        Allocator failure degrades to kubelet-default allocation."""
+        policy = ctx.get_allocator()
+        if policy is None:
+            ctx.set_allocator_error(True)
+            return
+        try:
+            policy.init(self._alloc_devices_for(ctx.resource_name()), self.topology)
+        except AllocationError as e:
+            log.error(
+                "allocator init failed for %s; falling back to kubelet "
+                "default allocation: %s", ctx.resource_name(), e,
+            )
+            ctx.set_allocator_error(True)
+
+    def get_options(self, ctx: DevicePluginContext) -> pluginapi.DevicePluginOptions:
+        if ctx.get_allocator_error():
+            return pluginapi.DevicePluginOptions()
+        return pluginapi.DevicePluginOptions(get_preferred_allocation_available=True)
+
+    def enumerate(self, ctx: DevicePluginContext) -> List[pluginapi.Device]:
+        return list(self._dev_list.get(ctx.resource_name(), []))
+
+    def allocate(
+        self, ctx: DevicePluginContext, req: pluginapi.AllocateRequest
+    ) -> pluginapi.AllocateResponse:
+        """Device nodes + TPU runtime env for each container
+        (≈ Allocate, amdgpu.go:255-297; pure map lookups, no sysfs I/O)."""
+        resp = pluginapi.AllocateResponse()
+        for creq in req.container_requests:
+            car = resp.container_responses.add()
+            chips: List[TpuDevice] = []
+            core_ids: List[str] = []
+            for dev_id in creq.devices_ids:
+                chip = self._chips_by_dev_id.get(dev_id)
+                if chip is None:
+                    raise RuntimeError(f"allocate for unknown device {dev_id}")
+                if chip not in chips:
+                    chips.append(chip)
+                if "#core" in dev_id:
+                    core_ids.append(dev_id)
+            for chip in chips:
+                if chip.accel_index < 0:
+                    continue
+                spec = car.devices.add()
+                spec.host_path = chip.dev_path
+                spec.container_path = chip.dev_path
+                spec.permissions = "rw"
+            self._populate_env(car, chips, core_ids)
+        return resp
+
+    def _populate_env(self, car, chips: List[TpuDevice], core_ids: List[str]):
+        """TPU runtime env: restrict libtpu to the allocated chips.  This is
+        the isolation mechanism — libtpu grabs every local chip unless
+        TPU_VISIBLE_CHIPS narrows it (SURVEY §7 'per-container chip
+        isolation')."""
+        visible = ",".join(
+            str(c.accel_index) for c in chips if c.accel_index >= 0
+        )
+        car.envs[constants.ENV_TPU_VISIBLE_CHIPS] = visible
+        car.envs[constants.ENV_TPU_SKIP_MDS_QUERY] = "true"
+        topo = self.topology
+        if topo is None or not chips:
+            return
+        full_host = len({c.id for c in chips}) == len(self.chips)
+        if full_host:
+            # Whole host allocated: the pod is (potentially) one worker of a
+            # multi-host slice — propagate the slice-level identity so JAX /
+            # libtpu can initialise distributed training across hosts.
+            if topo.accelerator_type:
+                car.envs[constants.ENV_TPU_ACCELERATOR_TYPE] = topo.accelerator_type
+            car.envs[constants.ENV_TPU_CHIPS_PER_HOST_BOUNDS] = ",".join(
+                str(b) for b in topo.chips_per_host_bounds
+            )
+            car.envs[constants.ENV_TPU_PROCESS_BOUNDS] = ",".join(
+                str(b) for b in topo.host_bounds
+            )
+            car.envs[constants.ENV_TPU_WORKER_ID] = str(topo.worker_id)
+            car.envs[constants.ENV_TPU_TOPOLOGY] = topo.topology_str
+        else:
+            # Sub-host allocation: a standalone single-process slice.  The
+            # slice-wide accelerator type would mislead libtpu (it implies a
+            # chip count we are not granting), so it is deliberately omitted.
+            car.envs[constants.ENV_TPU_CHIPS_PER_HOST_BOUNDS] = _bounds_of(
+                chips, topo
+            )
+            car.envs[constants.ENV_TPU_PROCESS_BOUNDS] = "1,1,1"
+            car.envs[constants.ENV_TPU_WORKER_ID] = "0"
+        if core_ids:
+            # per-core partitions: tell the runtime which TensorCores of the
+            # visible chips belong to this container
+            car.envs["TPU_VISIBLE_CORES"] = ",".join(
+                i.split("#core", 1)[1] for i in sorted(core_ids)
+            )
+
+    def get_preferred_allocation(
+        self, ctx: DevicePluginContext, req: pluginapi.PreferredAllocationRequest
+    ) -> pluginapi.PreferredAllocationResponse:
+        resp = pluginapi.PreferredAllocationResponse()
+        policy = ctx.get_allocator()
+        for creq in req.container_requests:
+            ids = policy.allocate(
+                list(creq.available_deviceIDs),
+                list(creq.must_include_deviceIDs),
+                int(creq.allocation_size),
+            )
+            resp.container_responses.add(deviceIDs=ids)
+        return resp
+
+    # -- health (≈ UpdateHealth + simpleHealthCheck, amdgpu.go:322-345,
+    #    865-910, exporter overlay :954-974) --------------------------------
+
+    def simple_health_check(self) -> bool:
+        """Cheap whole-node probe: the accel class still enumerates every
+        chip we advertised and the device nodes exist."""
+        found = {idx for idx, _ in discovery.list_accel_nodes(self._sysfs_root)}
+        for chip in self.chips.values():
+            if chip.accel_index not in found:
+                return False
+            if chip.dev_path and not os.path.exists(chip.dev_path):
+                return False
+        return True
+
+    def update_health(self, ctx: DevicePluginContext) -> List[pluginapi.Device]:
+        node_health = (
+            constants.HEALTHY if self.simple_health_check() else constants.UNHEALTHY
+        )
+        per_chip: Dict[str, str] = {}
+        if self._health_fn is not None:
+            try:
+                per_chip = self._health_fn()
+            except Exception as e:
+                log.warning("granular health probe failed: %s", e)
+        devs = self._dev_list.get(ctx.resource_name(), [])
+        for dev in devs:
+            chip = self._chips_by_dev_id[dev.ID]
+            dev.health = per_chip.get(chip.id, node_health)
+        return list(devs)
+
+
+def _bounds_of(chips: List[TpuDevice], topo: IciTopology) -> str:
+    """Bounding box of the allocated chips on the host grid, as the
+    TPU_CHIPS_PER_HOST_BOUNDS value for the container.
+
+    When the set is non-contiguous (kubelet default allocation under
+    fragmentation), the box volume would exceed the chip count and libtpu's
+    bounds/chip-count consistency check would fail — degrade to a linear
+    shape instead."""
+    xs = [c.coords[0] for c in chips]
+    ys = [c.coords[1] for c in chips]
+    zs = [c.coords[2] for c in chips]
+    w = max(xs) - min(xs) + 1
+    h = max(ys) - min(ys) + 1
+    d = max(zs) - min(zs) + 1
+    if w * h * d != len(chips):
+        return f"{len(chips)},1,1"
+    return f"{w},{h},{d}"

@@ -1,0 +1,213 @@
+"""VM-passthrough device implementations (VF and PF).
+
+TPU-native analogs of AMDGPUVFImpl and AMDGPUPFImpl
+(/root/reference/internal/pkg/amdgpu/amdgpu_sriov.go:55-308,
+amdgpu_pf.go:51-229): devices are keyed by IOMMU group, allocation mounts
+/dev/vfio/<group> + /dev/vfio/vfio and announces the passthrough PCI
+addresses via PCI_RESOURCE_GOOGLE_COM_<RESOURCE> env for the virt-launcher.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Callable, Dict, List, Optional
+
+from tpu_k8s_device_plugin.proto import deviceplugin_pb2 as pluginapi
+from tpu_k8s_device_plugin.types import DeviceImpl, DevicePluginContext, constants
+from . import vfio
+
+log = logging.getLogger(__name__)
+
+HealthFn = Callable[[], Dict[str, str]]
+
+
+class _VfioImplBase(DeviceImpl):
+    """Shared VFIO allocation/enumeration shape for VF and PF impls."""
+
+    resource_single = constants.DEVICE_TYPE_TPU
+    resource_mixed = constants.DEVICE_TYPE_TPU
+
+    def __init__(
+        self,
+        resource_naming_strategy: str = constants.RESOURCE_NAMING_STRATEGY_SINGLE,
+        sysfs_root: str = "/sys",
+        dev_root: str = "/dev",
+        health_fn: Optional[HealthFn] = None,
+    ):
+        self._strategy = resource_naming_strategy
+        self._sysfs_root = sysfs_root
+        self._dev_root = dev_root
+        self._health_fn = health_fn
+        # iommu group -> pci address of the passthrough function
+        self._group_to_pci: Dict[str, str] = {}
+        self._numa: Dict[str, int] = {}
+        self._discover()
+        if not self._group_to_pci:
+            raise RuntimeError(f"no devices found for {type(self).__name__}")
+
+    def _discover(self) -> None:
+        raise NotImplementedError
+
+    # -- DeviceImpl ---------------------------------------------------------
+
+    def start(self, ctx: DevicePluginContext) -> None:
+        # VFIO passthrough has no topology-aware allocator: VMs take whole
+        # functions; kubelet-default selection is fine (matches reference,
+        # which only wires the best-effort policy into the KFD impl).
+        ctx.set_allocator_error(True)
+
+    def get_resource_names(self) -> List[str]:
+        if self._strategy == constants.RESOURCE_NAMING_STRATEGY_MIXED:
+            return [self.resource_mixed]
+        return [self.resource_single]
+
+    def get_options(self, ctx: DevicePluginContext) -> pluginapi.DevicePluginOptions:
+        return pluginapi.DevicePluginOptions()
+
+    def enumerate(self, ctx: DevicePluginContext) -> List[pluginapi.Device]:
+        return [
+            pluginapi.Device(
+                ID=group,
+                health=constants.HEALTHY,
+                topology=pluginapi.TopologyInfo(
+                    nodes=[pluginapi.NUMANode(ID=self._numa.get(group, 0))]
+                ),
+            )
+            for group in sorted(self._group_to_pci, key=_group_key)
+        ]
+
+    def allocate(
+        self, ctx: DevicePluginContext, req: pluginapi.AllocateRequest
+    ) -> pluginapi.AllocateResponse:
+        """Mount the VFIO group nodes and announce PCI addresses
+        (≈ amdgpu_sriov.go:150-204, amdgpu_pf.go:146-197)."""
+        resp = pluginapi.AllocateResponse()
+        vfio_dir = os.path.join(self._dev_root, "vfio")
+        for creq in req.container_requests:
+            car = resp.container_responses.add()
+            pci_addrs = []
+            for group in creq.devices_ids:
+                pci = self._group_to_pci.get(group)
+                if pci is None:
+                    raise RuntimeError(f"allocate for unknown IOMMU group {group}")
+                pci_addrs.append(pci)
+                spec = car.devices.add()
+                spec.host_path = os.path.join(vfio_dir, group)
+                spec.container_path = os.path.join(vfio_dir, group)
+                spec.permissions = "rw"
+            # the VFIO container node, once per container
+            spec = car.devices.add()
+            spec.host_path = os.path.join(vfio_dir, "vfio")
+            spec.container_path = os.path.join(vfio_dir, "vfio")
+            spec.permissions = "rw"
+            res_suffix = ctx.resource_name().upper().replace("-", "_")
+            car.envs[f"{constants.PCI_TPU_PREFIX}_{res_suffix}"] = ",".join(
+                pci_addrs
+            )
+        return resp
+
+    def get_preferred_allocation(
+        self, ctx: DevicePluginContext, req: pluginapi.PreferredAllocationRequest
+    ) -> pluginapi.PreferredAllocationResponse:
+        # Not advertised in options; kubelet shouldn't call it.  Answer
+        # defensively with first-fit.
+        resp = pluginapi.PreferredAllocationResponse()
+        for creq in req.container_requests:
+            ids = list(creq.must_include_deviceIDs)
+            for dev_id in creq.available_deviceIDs:
+                if len(ids) >= creq.allocation_size:
+                    break
+                if dev_id not in ids:
+                    ids.append(dev_id)
+            resp.container_responses.add(deviceIDs=ids)
+        return resp
+
+    def update_health(self, ctx: DevicePluginContext) -> List[pluginapi.Device]:
+        devs = self.enumerate(ctx)
+        node_health = (
+            constants.HEALTHY if self._node_healthy() else constants.UNHEALTHY
+        )
+        per_func: Dict[str, str] = {}
+        if self._health_fn is not None:
+            try:
+                per_func = self._health_fn()
+            except Exception as e:
+                log.warning("granular health probe failed: %s", e)
+        for dev in devs:
+            pci = self._group_to_pci.get(dev.ID, "")
+            dev.health = per_func.get(pci, node_health)
+        return devs
+
+    def _node_healthy(self) -> bool:
+        raise NotImplementedError
+
+
+def _group_key(group: str):
+    try:
+        return (0, int(group))
+    except ValueError:
+        return (1, group)
+
+
+class TpuVfImpl(_VfioImplBase):
+    """SR-IOV virtual functions for TPU VMs (≈ AMDGPUVFImpl).  Health of a
+    VF maps from its parent PF's health (amdgpu_sriov.go:217-308)."""
+
+    resource_single = constants.DEVICE_TYPE_TPU
+    resource_mixed = constants.DEVICE_TYPE_TPU_VF
+
+    def _discover(self) -> None:
+        self._vf_mapping = vfio.get_vf_mapping(self._sysfs_root)
+        for group, info in self._vf_mapping.items():
+            self._group_to_pci[group] = info.pci_address
+            self._numa[group] = info.numa_node
+
+    def _node_healthy(self) -> bool:
+        return os.path.isdir(
+            os.path.join(
+                self._sysfs_root, "bus", "pci", "drivers",
+                constants.TPU_VF_DRIVER_NAME,
+            )
+        )
+
+    def update_health(self, ctx: DevicePluginContext) -> List[pluginapi.Device]:
+        devs = self.enumerate(ctx)
+        node_health = (
+            constants.HEALTHY if self._node_healthy() else constants.UNHEALTHY
+        )
+        pf_health: Dict[str, str] = {}
+        if self._health_fn is not None:
+            try:
+                pf_health = self._health_fn()
+            except Exception as e:
+                log.warning("granular health probe failed: %s", e)
+        for dev in devs:
+            info = self._vf_mapping.get(dev.ID)
+            dev.health = (
+                pf_health.get(info.pf_pci_address, node_health)
+                if info
+                else node_health
+            )
+        return devs
+
+
+class TpuPfImpl(_VfioImplBase):
+    """Whole-function passthrough via vfio-pci (≈ AMDGPUPFImpl).  Node
+    health is the presence of the vfio-pci driver (amdgpu_pf.go:210-229)."""
+
+    resource_single = constants.DEVICE_TYPE_TPU
+    resource_mixed = constants.DEVICE_TYPE_TPU_PF
+
+    def _discover(self) -> None:
+        for group, info in vfio.get_pf_mapping(self._sysfs_root).items():
+            self._group_to_pci[group] = info.pci_address
+            self._numa[group] = info.numa_node
+
+    def _node_healthy(self) -> bool:
+        return os.path.isdir(
+            os.path.join(
+                self._sysfs_root, "bus", "pci", "drivers",
+                constants.VFIO_DRIVER_NAME,
+            )
+        )

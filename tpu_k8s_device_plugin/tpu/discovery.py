@@ -20,6 +20,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from tpu_k8s_device_plugin.types import constants
+from . import sysfs
 from .topology import (
     IciTopology,
     partition_modes_from_env,
@@ -59,22 +60,6 @@ class TpuDevice:
         )
 
 
-def _read_file(path: str) -> str:
-    try:
-        with open(path, "r", encoding="utf-8") as f:
-            return f.read().strip()
-    except OSError:
-        return ""
-
-
-def _read_int(path: str, default: int = 0) -> int:
-    s = _read_file(path)
-    try:
-        return int(s, 0)
-    except ValueError:
-        return default
-
-
 def list_accel_nodes(sysfs_root: str = "/sys") -> List[Tuple[int, str]]:
     """Enumerate accel class entries → [(accel_index, pci_device_dir)].
 
@@ -101,7 +86,7 @@ def list_tpu_pci_devices(sysfs_root: str = "/sys") -> List[str]:
     out = []
     pci_dir = os.path.join(sysfs_root, "bus", "pci", "devices")
     for entry in sorted(glob.glob(os.path.join(pci_dir, "*"))):
-        if _read_file(os.path.join(entry, "vendor")) == constants.GOOGLE_VENDOR_ID:
+        if sysfs.read_file(os.path.join(entry, "vendor")) == constants.GOOGLE_VENDOR_ID:
             out.append(os.path.realpath(entry))
     return out
 
@@ -136,7 +121,7 @@ def get_tpu_chips(
         pci_dirs = [(-1, p) for p in list_tpu_pci_devices(sysfs_root)]
 
     for accel_index, pci_dir in pci_dirs:
-        vendor = _read_file(os.path.join(pci_dir, "vendor"))
+        vendor = sysfs.read_file(os.path.join(pci_dir, "vendor"))
         if vendor and vendor != constants.GOOGLE_VENDOR_ID:
             log.warning("accel%d at %s has non-TPU vendor %s; skipping",
                         accel_index, pci_dir, vendor)
@@ -152,13 +137,11 @@ def get_tpu_chips(
             accel_index=accel_index,
             pci_address=pci_addr,
             vendor_id=vendor or constants.GOOGLE_VENDOR_ID,
-            device_id=_read_file(os.path.join(pci_dir, "device")),
-            numa_node=max(_read_int(os.path.join(pci_dir, "numa_node"), 0), 0),
+            device_id=sysfs.read_file(os.path.join(pci_dir, "device")),
+            numa_node=sysfs.numa_node(pci_dir),
             dev_path=dev_path,
         )
-        group_link = os.path.join(pci_dir, "iommu_group")
-        if os.path.exists(group_link):
-            dev.iommu_group = os.path.basename(os.path.realpath(group_link))
+        dev.iommu_group = sysfs.iommu_group(pci_dir)
         devices[dev.id] = dev
 
     env = read_tpu_env(tpu_env_path)
@@ -216,8 +199,8 @@ def get_driver_versions(sysfs_root: str = "/sys") -> Dict[str, str]:
         base = os.path.join(sysfs_root, "module", mod)
         if not os.path.isdir(base):
             continue
-        ver = _read_file(os.path.join(base, "version"))
-        src = _read_file(os.path.join(base, "srcversion"))
+        ver = sysfs.read_file(os.path.join(base, "version"))
+        src = sysfs.read_file(os.path.join(base, "srcversion"))
         if ver:
             out["driver-version"] = ver
         if src:
@@ -236,4 +219,4 @@ def get_firmware_version(pci_dir_or_sysfs_root: str, accel_index: int = -1) -> s
         )
     else:
         path = os.path.join(pci_dir_or_sysfs_root, "firmware_version")
-    return _read_file(path)
+    return sysfs.read_file(path)

@@ -1,0 +1,42 @@
+"""Shared sysfs parsing helpers used by discovery and vfio scanning."""
+
+from __future__ import annotations
+
+import os
+
+
+def read_file(path: str) -> str:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return f.read().strip()
+    except OSError:
+        return ""
+
+
+def read_int(path: str, default: int = 0) -> int:
+    s = read_file(path)
+    try:
+        return int(s, 0)
+    except ValueError:
+        return default
+
+
+def numa_node(dev_dir: str) -> int:
+    """NUMA node of a PCI device dir, clamped to >= 0 (-1 means unknown)."""
+    return max(read_int(os.path.join(dev_dir, "numa_node"), 0), 0)
+
+
+def iommu_group(dev_dir: str) -> str:
+    """IOMMU group number of a PCI device dir, "" when absent."""
+    link = os.path.join(dev_dir, "iommu_group")
+    if not os.path.exists(link):
+        return ""
+    return os.path.basename(os.path.realpath(link))
+
+
+def driver_name(dev_dir: str) -> str:
+    """Bound driver of a PCI device dir, "" when unbound."""
+    link = os.path.join(dev_dir, "driver")
+    if not os.path.exists(link):
+        return ""
+    return os.path.basename(os.path.realpath(link))

@@ -1,0 +1,103 @@
+"""VFIO discovery for VM-passthrough TPU hosts.
+
+TPU-native analog of the reference's SR-IOV VF and PF scanners
+(/root/reference/internal/pkg/amdgpu/amdgpu_sriov.go:323-402 and
+amdgpu_pf.go:244-305): scan /sys/bus/pci/devices for Google-vendor
+functions, resolve driver binding and IOMMU groups, and key devices by
+IOMMU group (the unit VFIO exposes to VMs).
+"""
+
+from __future__ import annotations
+
+import glob
+import logging
+import os
+from dataclasses import dataclass
+from typing import Dict
+
+from tpu_k8s_device_plugin.types import constants
+from . import sysfs
+
+log = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class VfInfo:
+    """One virtual function exposed for VM passthrough."""
+
+    pci_address: str      # VF PCI address (DBDF)
+    pf_pci_address: str   # parent physical function
+    iommu_group: str      # device id reported to kubelet
+    numa_node: int = 0
+
+
+@dataclass(frozen=True)
+class PfInfo:
+    """One physical function bound to vfio-pci for whole-chip passthrough."""
+
+    pci_address: str
+    iommu_group: str
+    numa_node: int = 0
+
+
+def get_vf_mapping(sysfs_root: str = "/sys") -> Dict[str, VfInfo]:
+    """IOMMU group → VfInfo for every VF of a TPU PF bound to the tpu-vf
+    host driver (≈ GetVFMapping, amdgpu_sriov.go:323-402)."""
+    out: Dict[str, VfInfo] = {}
+    pci_dir = os.path.join(sysfs_root, "bus", "pci", "devices")
+    for pf_dir in sorted(glob.glob(os.path.join(pci_dir, "*"))):
+        if sysfs.read_file(os.path.join(pf_dir, "vendor")) != constants.GOOGLE_VENDOR_ID:
+            continue
+        if sysfs.driver_name(pf_dir) != constants.TPU_VF_DRIVER_NAME:
+            continue
+        pf_addr = os.path.basename(os.path.realpath(pf_dir))
+        for vf_link in sorted(glob.glob(os.path.join(pf_dir, "virtfn*"))):
+            vf_dir = os.path.realpath(vf_link)
+            vf_addr = os.path.basename(vf_dir)
+            group = sysfs.iommu_group(vf_dir)
+            if not group:
+                log.warning("VF %s has no IOMMU group; skipping", vf_addr)
+                continue
+            out[group] = VfInfo(
+                pci_address=vf_addr,
+                pf_pci_address=pf_addr,
+                iommu_group=group,
+                numa_node=sysfs.numa_node(vf_dir),
+            )
+    return out
+
+
+def get_pf_mapping(sysfs_root: str = "/sys") -> Dict[str, PfInfo]:
+    """IOMMU group → PfInfo for every TPU PF bound to vfio-pci
+    (≈ GetPFMapping, amdgpu_pf.go:244-305)."""
+    out: Dict[str, PfInfo] = {}
+    pci_dir = os.path.join(sysfs_root, "bus", "pci", "devices")
+    for dev_dir in sorted(glob.glob(os.path.join(pci_dir, "*"))):
+        if sysfs.read_file(os.path.join(dev_dir, "vendor")) != constants.GOOGLE_VENDOR_ID:
+            continue
+        if sysfs.driver_name(dev_dir) != constants.VFIO_DRIVER_NAME:
+            continue
+        addr = os.path.basename(os.path.realpath(dev_dir))
+        group = sysfs.iommu_group(dev_dir)
+        if not group:
+            log.warning("PF %s has no IOMMU group; skipping", addr)
+            continue
+        out[group] = PfInfo(
+            pci_address=addr, iommu_group=group, numa_node=sysfs.numa_node(dev_dir)
+        )
+    return out
+
+
+def get_tpu_vf_module_versions(sysfs_root: str = "/sys") -> Dict[str, str]:
+    """tpu-vf host driver version info (≈ GetGIMVersions,
+    amdgpu_sriov.go:404-422)."""
+    out: Dict[str, str] = {}
+    base = os.path.join(sysfs_root, "module",
+                        constants.TPU_VF_DRIVER_NAME.replace("-", "_"))
+    ver = sysfs.read_file(os.path.join(base, "version"))
+    src = sysfs.read_file(os.path.join(base, "srcversion"))
+    if ver:
+        out["version"] = ver
+    if src:
+        out["srcversion"] = src
+    return out
