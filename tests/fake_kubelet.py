@@ -65,9 +65,17 @@ class FakeKubelet:
         if remove_socket and os.path.exists(self.socket_path):
             os.remove(self.socket_path)
 
-    def restart(self) -> None:
-        """Simulate a kubelet restart (socket re-creation)."""
+    def restart(self, wipe_dir: bool = False) -> None:
+        """Simulate a kubelet restart (socket re-creation).  With
+        ``wipe_dir`` the device-plugin dir is cleared first, matching the
+        real kubelet's removeContents on startup."""
         self.stop()
+        if wipe_dir:
+            for name in os.listdir(self.dir):
+                try:
+                    os.remove(os.path.join(self.dir, name))
+                except OSError:
+                    pass
         self.start()
 
     def wait_for_registration(self, timeout: float = 5.0) -> bool:

@@ -126,6 +126,25 @@ def test_kubelet_restart_triggers_reregistration(kubelet, manager):
     assert len(kubelet.registrations) == 2
 
 
+def test_kubelet_restart_wiping_dp_dir_reserves_sockets(kubelet, manager):
+    """Real kubelet clears the device-plugin dir on startup; the plugin must
+    re-create its endpoint socket before re-registering, or the kubelet's
+    dial to the advertised endpoint fails and capacity drops to 0."""
+    assert kubelet.wait_for_registration()
+    sock = os.path.join(kubelet.dir, "google.com_tpu")
+    assert os.path.exists(sock)
+    kubelet.restart(wipe_dir=True)
+    assert kubelet.wait_for_registration(timeout=10.0)
+    deadline = time.time() + 5.0
+    while not os.path.exists(sock) and time.time() < deadline:
+        time.sleep(0.05)
+    assert os.path.exists(sock)
+    # and the re-served endpoint actually answers
+    stub = kubelet.plugin_stub("google.com_tpu")
+    devs = next(iter(stub.ListAndWatch(pluginapi.Empty()))).devices
+    assert len(devs) == 8
+
+
 def test_resource_diffing_stops_removed_plugins(kubelet, manager):
     assert kubelet.wait_for_registration()
     sock = os.path.join(kubelet.dir, "google.com_tpu")

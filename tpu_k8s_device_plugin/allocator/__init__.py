@@ -8,7 +8,7 @@ candidate generation prefers contiguous rectangular ICI sub-meshes —
 the shapes XLA collectives ride efficiently.
 """
 
-from .allocator import AllocationError, Policy
+from .allocator import AllocationError, Policy, first_fit
 from .device import AllocDevice, WeightModel, devices_from_discovery
 from .besteffort import BestEffortPolicy
 
@@ -17,6 +17,7 @@ __all__ = [
     "AllocDevice",
     "BestEffortPolicy",
     "Policy",
+    "first_fit",
     "WeightModel",
     "devices_from_discovery",
 ]

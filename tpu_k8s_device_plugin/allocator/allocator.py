@@ -15,6 +15,23 @@ class AllocationError(Exception):
     surfaces this to the kubelet, which falls back to default allocation."""
 
 
+def first_fit(
+    available_ids: Sequence[str],
+    required_ids: Sequence[str],
+    size: int,
+) -> List[str]:
+    """Kubelet-default selection: required ids first, then available ones in
+    order until *size*.  The degraded answer every impl gives when no
+    topology-aware policy is usable."""
+    ids = list(required_ids)
+    for dev_id in available_ids:
+        if len(ids) >= size:
+            break
+        if dev_id not in ids:
+            ids.append(dev_id)
+    return ids[:size]
+
+
 class Policy(abc.ABC):
     """Preferred-allocation policy: precompute weights at init, answer
     admission-time subset queries from memory only (the precompute-at-init

@@ -10,6 +10,7 @@ from __future__ import annotations
 import argparse
 import functools
 import logging
+import signal
 import sys
 
 from tpu_k8s_device_plugin import __version__
@@ -127,6 +128,10 @@ def main(argv=None) -> int:
         pulse_seconds=args.pulse,
         kubelet_dir=args.kubelet_dir,
     )
+    # k8s sends SIGTERM on pod shutdown; route it through the same cleanup
+    # path as Ctrl-C so streams get the stop signal and the endpoint socket
+    # is unlinked (≈ main.go signal handling)
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
     try:
         manager.run(block=True)
     finally:

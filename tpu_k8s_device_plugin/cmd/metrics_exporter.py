@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import signal
 import sys
 
 from tpu_k8s_device_plugin import __version__
@@ -28,6 +29,9 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
+    # pod shutdown sends SIGTERM; exit through the finally so the socket is
+    # removed rather than left stale for the next incarnation
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
     server = TpuHealthServer(
         socket_path=args.socket,
         sysfs_root=args.sysfs_root,

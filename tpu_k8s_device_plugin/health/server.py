@@ -35,11 +35,14 @@ def probe_chip_states(
     states: Dict[str, hpb.TpuState] = {}
     chips, _ = discovery.get_tpu_chips(sysfs_root, dev_root, "/nonexistent")
     for chip in chips.values():
-        healthy = True
-        if chip.accel_index >= 0:
-            healthy = os.path.exists(chip.dev_path) and os.access(
-                chip.dev_path, os.R_OK | os.W_OK
-            )
+        if chip.accel_index < 0:
+            # raw-PCI fallback chips (vfio passthrough) have no accel node to
+            # probe; reporting them Healthy would mask the plugin's own
+            # node-health fallback, so leave them out of the map entirely
+            continue
+        healthy = os.path.exists(chip.dev_path) and os.access(
+            chip.dev_path, os.R_OK | os.W_OK
+        )
         states[chip.id] = hpb.TpuState(
             id=chip.id,
             accel_index=chip.accel_index,

@@ -9,8 +9,9 @@ wrapper (internal/pkg/manager/manager.go:31-104):
 - one unix socket + gRPC server per resource, named ``google.com_<res>`` in
   the kubelet device-plugin dir
 - Register RPC to kubelet.sock with 3x3s retries
-- watch the kubelet socket: on re-create, restart + re-register every plugin
-  (kubelet-restart recovery); on remove, stop serving
+- watch the kubelet socket: on re-create, re-serve every plugin's endpoint
+  socket (a restarting kubelet wipes the device-plugin dir) and re-register;
+  on remove, keep serving and wait for the socket to come back
 - pulse thread driving UpdateHealth → ListAndWatch resends
 - resource-list diffing: start/stop plugin servers as the advertised
   resource set changes
@@ -70,6 +71,16 @@ class _ServedPlugin:
         self.server.start()
         log.info("serving %s on %s", self.resource, self.socket_path)
 
+    def restart_server(self) -> None:
+        """Tear down and re-create the gRPC server + socket, keeping the
+        plugin (and its DeviceImpl state) alive.  Needed after a kubelet
+        restart: kubelet wipes the device-plugin dir on startup, unlinking
+        our socket while the old server keeps listening on a dead inode."""
+        if self.server is not None:
+            self.server.stop(grace=0.5).wait()
+            self.server = None
+        self.serve()
+
     def shutdown(self) -> None:
         self.plugin.stop()
         if self.server is not None:
@@ -100,6 +111,9 @@ class PluginManager:
         self.namespace = resource_namespace
         self._watch_interval = kubelet_watch_interval_s
         self._plugins: Dict[str, _ServedPlugin] = {}
+        # guards _plugins: mutated by update_resources()/stop() on caller
+        # threads while the kubelet-watch thread iterates it to re-register
+        self._plugins_lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
 
@@ -130,9 +144,11 @@ class PluginManager:
 
     def stop(self) -> None:
         self._stop.set()
-        for sp in list(self._plugins.values()):
+        with self._plugins_lock:
+            plugins = list(self._plugins.values())
+            self._plugins.clear()
+        for sp in plugins:
             sp.shutdown()
-        self._plugins.clear()
 
     def update_resources(self, resources: List[str]) -> None:
         """Diff the advertised resource set, starting/stopping plugin
@@ -147,10 +163,12 @@ class PluginManager:
 
     def _sync_plugins(self, resources: List[str]) -> None:
         wanted = set(resources)
-        current = set(self._plugins)
-        for resource in current - wanted:
-            log.info("resource %s no longer advertised; stopping", resource)
-            self._plugins.pop(resource).shutdown()
+        with self._plugins_lock:
+            current = set(self._plugins)
+            removed = [self._plugins.pop(r) for r in current - wanted]
+        for sp in removed:
+            log.info("resource %s no longer advertised; stopping", sp.resource)
+            sp.shutdown()
         for resource in sorted(wanted - current):
             ctx = DevicePluginContext(resource, BestEffortPolicy())
             plugin = TpuDevicePlugin(self.impl, ctx)
@@ -161,10 +179,13 @@ class PluginManager:
                 os.path.join(self.kubelet_dir, self._endpoint(resource)),
             )
             sp.serve()
-            self._plugins[resource] = sp
+            with self._plugins_lock:
+                self._plugins[resource] = sp
 
     def _register_all(self) -> None:
-        for resource, sp in self._plugins.items():
+        with self._plugins_lock:
+            plugins = list(self._plugins.items())
+        for resource, sp in plugins:
             self._register(resource, sp)
 
     def _register(self, resource: str, sp: _ServedPlugin) -> bool:
@@ -222,9 +243,23 @@ class PluginManager:
             if cur is None:
                 log.warning("kubelet socket disappeared; waiting for restart")
             else:
-                log.info("kubelet socket (re)created; re-registering plugins")
+                log.info(
+                    "kubelet socket (re)created; re-serving and "
+                    "re-registering plugins"
+                )
                 # small grace: kubelet needs a moment to start serving
                 time.sleep(1.0)
+                if self._stop.is_set():
+                    return
+                # snapshot after the sleep, and re-serve under the lock so a
+                # concurrent stop()/_sync_plugins shutdown can't be undone by
+                # resurrecting a server the manager no longer tracks
+                with self._plugins_lock:
+                    for sp in self._plugins.values():
+                        # kubelet wipes the dp dir on restart; our endpoint
+                        # socket must exist before Register advertises it
+                        if not os.path.exists(sp.socket_path):
+                            sp.restart_server()
                 self._register_all()
             last_stat = cur
 
@@ -241,5 +276,7 @@ class PluginManager:
         """Heartbeat: trigger health refresh on every plugin
         (≈ manager.go:39-46)."""
         while not self._stop.wait(self.pulse):
-            for sp in list(self._plugins.values()):
+            with self._plugins_lock:
+                plugins = list(self._plugins.values())
+            for sp in plugins:
                 sp.plugin.beat()

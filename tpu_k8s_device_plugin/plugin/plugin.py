@@ -85,8 +85,8 @@ class TpuDevicePlugin(pluginapi_grpc.DevicePluginServicer):
         # client disconnect must unblock q.get() — otherwise every kubelet
         # restart leaks one executor thread parked in get() forever
         context.add_callback(lambda: q.put(_STOP))
-        yield pluginapi.ListAndWatchResponse(devices=devices)
         try:
+            yield pluginapi.ListAndWatchResponse(devices=devices)
             while context.is_active():
                 msg = q.get()
                 if msg == _STOP:

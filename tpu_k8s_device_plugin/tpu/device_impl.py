@@ -17,6 +17,7 @@ from typing import Callable, Dict, List, Optional
 from tpu_k8s_device_plugin.allocator import (
     AllocationError,
     devices_from_discovery,
+    first_fit,
 )
 from tpu_k8s_device_plugin.proto import deviceplugin_pb2 as pluginapi
 from tpu_k8s_device_plugin.types import DeviceImpl, DevicePluginContext, constants
@@ -228,11 +229,20 @@ class TpuContainerImpl(DeviceImpl):
         resp = pluginapi.PreferredAllocationResponse()
         policy = ctx.get_allocator()
         for creq in req.container_requests:
-            ids = policy.allocate(
-                list(creq.available_deviceIDs),
-                list(creq.must_include_deviceIDs),
-                int(creq.allocation_size),
-            )
+            if policy is None or ctx.get_allocator_error():
+                # no policy / failed init is a supported degraded state
+                # (see start()): answer first-fit like the kubelet would
+                ids = first_fit(
+                    list(creq.available_deviceIDs),
+                    list(creq.must_include_deviceIDs),
+                    int(creq.allocation_size),
+                )
+            else:
+                ids = policy.allocate(
+                    list(creq.available_deviceIDs),
+                    list(creq.must_include_deviceIDs),
+                    int(creq.allocation_size),
+                )
             resp.container_responses.add(deviceIDs=ids)
         return resp
 
@@ -260,11 +270,17 @@ class TpuContainerImpl(DeviceImpl):
                 per_chip = self._health_fn()
             except Exception as e:
                 log.warning("granular health probe failed: %s", e)
-        devs = self._dev_list.get(ctx.resource_name(), [])
-        for dev in devs:
+        # fresh messages, not in-place mutation: the cached _dev_list entries
+        # are shared with every open ListAndWatch stream, and concurrent
+        # health writes would race with their serialization
+        out: List[pluginapi.Device] = []
+        for dev in self._dev_list.get(ctx.resource_name(), []):
             chip = self._chips_by_dev_id[dev.ID]
-            dev.health = per_chip.get(chip.id, node_health)
-        return list(devs)
+            fresh = pluginapi.Device()
+            fresh.CopyFrom(dev)
+            fresh.health = per_chip.get(chip.id, node_health)
+            out.append(fresh)
+        return out
 
 
 def _bounds_of(chips: List[TpuDevice], topo: IciTopology) -> str:

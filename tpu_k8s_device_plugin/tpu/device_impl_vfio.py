@@ -13,6 +13,7 @@ import logging
 import os
 from typing import Callable, Dict, List, Optional
 
+from tpu_k8s_device_plugin.allocator import first_fit
 from tpu_k8s_device_plugin.proto import deviceplugin_pb2 as pluginapi
 from tpu_k8s_device_plugin.types import DeviceImpl, DevicePluginContext, constants
 from . import vfio
@@ -114,12 +115,11 @@ class _VfioImplBase(DeviceImpl):
         # defensively with first-fit.
         resp = pluginapi.PreferredAllocationResponse()
         for creq in req.container_requests:
-            ids = list(creq.must_include_deviceIDs)
-            for dev_id in creq.available_deviceIDs:
-                if len(ids) >= creq.allocation_size:
-                    break
-                if dev_id not in ids:
-                    ids.append(dev_id)
+            ids = first_fit(
+                list(creq.available_deviceIDs),
+                list(creq.must_include_deviceIDs),
+                int(creq.allocation_size),
+            )
             resp.container_responses.add(deviceIDs=ids)
         return resp
 
@@ -135,9 +135,12 @@ class _VfioImplBase(DeviceImpl):
             except Exception as e:
                 log.warning("granular health probe failed: %s", e)
         for dev in devs:
-            pci = self._group_to_pci.get(dev.ID, "")
-            dev.health = per_func.get(pci, node_health)
+            dev.health = per_func.get(self._health_key(dev.ID), node_health)
         return devs
+
+    def _health_key(self, dev_id: str) -> str:
+        """PCI address the health map is keyed by for this device."""
+        return self._group_to_pci.get(dev_id, "")
 
     def _node_healthy(self) -> bool:
         raise NotImplementedError
@@ -171,25 +174,10 @@ class TpuVfImpl(_VfioImplBase):
             )
         )
 
-    def update_health(self, ctx: DevicePluginContext) -> List[pluginapi.Device]:
-        devs = self.enumerate(ctx)
-        node_health = (
-            constants.HEALTHY if self._node_healthy() else constants.UNHEALTHY
-        )
-        pf_health: Dict[str, str] = {}
-        if self._health_fn is not None:
-            try:
-                pf_health = self._health_fn()
-            except Exception as e:
-                log.warning("granular health probe failed: %s", e)
-        for dev in devs:
-            info = self._vf_mapping.get(dev.ID)
-            dev.health = (
-                pf_health.get(info.pf_pci_address, node_health)
-                if info
-                else node_health
-            )
-        return devs
+    def _health_key(self, dev_id: str) -> str:
+        # a VF inherits its parent PF's health (amdgpu_sriov.go:217-308)
+        info = self._vf_mapping.get(dev_id)
+        return info.pf_pci_address if info else ""
 
 
 class TpuPfImpl(_VfioImplBase):
