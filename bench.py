@@ -1,0 +1,113 @@
+"""Headline benchmark: AlexNet-JAX training throughput on the allocated chip.
+
+The reference's headline harness is the AlexNet pod running
+``tf_cnn_benchmarks.py --model=alexnet`` with results read from pod logs
+(/root/reference/example/pod/alexnet-gpu.yaml:16, README.md:45-67); it
+publishes no numbers (SURVEY.md §6), so BASELINE.json records
+``published: {}``.  When no baseline number exists, vs_baseline is null —
+there is nothing honest to compare against.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Secondary numbers (Allocate p50 — the latency-sensitive kubelet RPC) ride
+in "extra".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def bench_alexnet(platform: str) -> float:
+    """images/sec of the jit-compiled train step, synthetic data."""
+    import functools
+    from tpu_k8s_device_plugin.workloads.alexnet import (
+        create_train_state, synthetic_batch, train_step,
+    )
+
+    on_accel = platform != "cpu"
+    batch = 256 if on_accel else 16
+    warmup, steps = (5, 30) if on_accel else (1, 3)
+
+    rng = jax.random.PRNGKey(0)
+    model, state = create_train_state(rng, batch_size=batch)
+    params, opt_state, tx = state["params"], state["opt_state"], state["tx"]
+    images, labels = synthetic_batch(rng, batch)
+    step = jax.jit(
+        functools.partial(train_step, model, tx), donate_argnums=(0, 1)
+    )
+
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state, images, labels)
+    float(loss)  # value transfer, not block_until_ready: the transfer has a
+    # hard data dependency on the whole dispatched chain, which some remote
+    # TPU transports honor more faithfully than buffer-ready events
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, images, labels)
+    float(loss)
+    dt = time.perf_counter() - t0
+    return batch * steps / dt
+
+
+def bench_allocate_p50_us() -> float:
+    """p50 latency of the kubelet Allocate path (in-memory, per SURVEY §3.3
+    the precompute-at-init shape keeps this in microseconds)."""
+    from tpu_k8s_device_plugin.proto import deviceplugin_pb2 as pluginapi
+    from tpu_k8s_device_plugin.tpu.device_impl import TpuContainerImpl
+    from tpu_k8s_device_plugin.types import DevicePluginContext
+
+    root = os.path.join(os.path.dirname(__file__), "testdata", "v5e-8")
+    impl = TpuContainerImpl(
+        sysfs_root=os.path.join(root, "sys"),
+        dev_root=os.path.join(root, "dev"),
+        tpu_env_path=os.path.join(root, "run", "tpu", "tpu-env"),
+    )
+    ctx = DevicePluginContext("tpu", None)
+    ids = [d.ID for d in impl.enumerate(ctx)][:4]
+    req = pluginapi.AllocateRequest(
+        container_requests=[pluginapi.ContainerAllocateRequest(devices_ids=ids)]
+    )
+    samples = []
+    for _ in range(2000):
+        t0 = time.perf_counter_ns()
+        impl.allocate(ctx, req)
+        samples.append((time.perf_counter_ns() - t0) / 1000.0)
+    return statistics.median(samples)
+
+
+def main() -> None:
+    platform = jax.devices()[0].platform
+    images_per_sec = bench_alexnet(platform)
+    alloc_p50 = bench_allocate_p50_us()
+
+    baseline = None
+    try:
+        with open(os.path.join(os.path.dirname(__file__), "BASELINE.json")) as f:
+            baseline = json.load(f).get("published", {}).get(
+                "alexnet_jax_images_per_sec"
+            )
+    except (OSError, ValueError):
+        pass
+
+    print(json.dumps({
+        "metric": "alexnet_jax_images_per_sec_per_chip",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(images_per_sec / baseline, 3) if baseline else None,
+        "extra": {
+            "platform": platform,
+            "n_devices": len(jax.devices()),
+            "allocate_p50_us": round(alloc_p50, 2),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

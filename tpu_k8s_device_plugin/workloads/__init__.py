@@ -1,0 +1,20 @@
+"""JAX/XLA example workloads for the TPU device plugin.
+
+The reference ships TF/vLLM GPU workloads as proof the plugin works
+(/root/reference/example/pod/alexnet-gpu.yaml:16 runs
+``tf_cnn_benchmarks.py --model=alexnet``); these are their TPU-native
+replacements: an AlexNet image-classification benchmark written for the
+MXU (bf16 matmuls/convs, static shapes, jit-compiled train step) and a
+sharded variant that scales over a ``jax.sharding.Mesh``.
+"""
+
+from .alexnet import AlexNet, create_train_state, train_step
+from .parallel import make_mesh, make_sharded_train_step
+
+__all__ = [
+    "AlexNet",
+    "create_train_state",
+    "train_step",
+    "make_mesh",
+    "make_sharded_train_step",
+]

@@ -1,0 +1,105 @@
+"""AlexNet in flax, written TPU-first.
+
+Replaces the reference's AlexNet TF benchmark workload
+(/root/reference/example/pod/alexnet-gpu.yaml:16,
+/root/reference/README.md:45-67) with a JAX implementation shaped for the
+MXU: bf16 activations/weights for the systolic array, NHWC layout, static
+shapes throughout, and a single jit-compiled train step so XLA fuses the
+elementwise tail of every conv/matmul.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+import optax
+
+# bf16 compute, f32 params/optimizer state: the standard TPU mixed-precision
+# recipe — matmuls/convs hit the MXU at bf16, updates accumulate in f32.
+COMPUTE_DTYPE = jnp.bfloat16
+
+NUM_CLASSES = 1000
+IMAGE_SIZE = 224
+
+
+class AlexNet(nn.Module):
+    """Canonical 5-conv / 3-dense AlexNet (single-tower)."""
+
+    num_classes: int = NUM_CLASSES
+    dtype: Any = COMPUTE_DTYPE
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = True) -> jax.Array:
+        conv = functools.partial(nn.Conv, dtype=self.dtype, padding="SAME")
+        x = x.astype(self.dtype)
+        x = conv(features=64, kernel_size=(11, 11), strides=(4, 4))(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, window_shape=(3, 3), strides=(2, 2))
+        x = conv(features=192, kernel_size=(5, 5))(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, window_shape=(3, 3), strides=(2, 2))
+        x = conv(features=384, kernel_size=(3, 3))(x)
+        x = nn.relu(x)
+        x = conv(features=256, kernel_size=(3, 3))(x)
+        x = nn.relu(x)
+        x = conv(features=256, kernel_size=(3, 3))(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, window_shape=(3, 3), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(4096, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(4096, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def create_train_state(
+    rng: jax.Array,
+    batch_size: int = 128,
+    image_size: int = IMAGE_SIZE,
+    num_classes: int = NUM_CLASSES,
+    learning_rate: float = 0.01,
+) -> Tuple[AlexNet, Dict[str, Any]]:
+    """Model + initial (params, opt_state) pytree."""
+    model = AlexNet(num_classes=num_classes)
+    dummy = jnp.zeros((batch_size, image_size, image_size, 3), jnp.float32)
+    params = model.init(rng, dummy, train=False)["params"]
+    tx = optax.sgd(learning_rate, momentum=0.9)
+    opt_state = tx.init(params)
+    return model, {"params": params, "opt_state": opt_state, "tx": tx}
+
+
+def loss_fn(model: AlexNet, params, images: jax.Array, labels: jax.Array):
+    logits = model.apply({"params": params}, images, train=True)
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    return loss.mean()
+
+
+def train_step(model: AlexNet, tx, params, opt_state, images, labels):
+    """One SGD step.  Pure function of its inputs — jit/shard it from the
+    caller; no Python control flow depends on traced values."""
+    loss, grads = jax.value_and_grad(functools.partial(loss_fn, model))(
+        params, images, labels
+    )
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss
+
+
+def synthetic_batch(
+    rng: jax.Array, batch_size: int, image_size: int = IMAGE_SIZE,
+    num_classes: int = NUM_CLASSES,
+) -> Tuple[jax.Array, jax.Array]:
+    """Synthetic data matching tf_cnn_benchmarks' default mode (no dataset
+    flag → synthetic images), so throughput numbers are comparable."""
+    k1, k2 = jax.random.split(rng)
+    images = jax.random.normal(
+        k1, (batch_size, image_size, image_size, 3), jnp.float32
+    )
+    labels = jax.random.randint(k2, (batch_size,), 0, num_classes)
+    return images, labels

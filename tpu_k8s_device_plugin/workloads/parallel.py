@@ -1,0 +1,94 @@
+"""Sharded AlexNet training over a jax.sharding.Mesh.
+
+The reference leaves parallelism entirely to workloads (SURVEY.md §2.4) —
+this module is that workload side, done the TPU way: a ``Mesh`` with
+``data`` × ``model`` axes, ``NamedSharding`` annotations on the pytrees,
+and a single ``jit`` of the whole train step so XLA places the collectives
+(psum for data-parallel grads, all-gather/reduce-scatter for the sharded
+dense layers) on ICI.  No NCCL/MPI analog exists or is needed: the
+communication backend is XLA itself.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .alexnet import AlexNet, train_step
+
+
+def make_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    model_parallel: Optional[int] = None,
+) -> Mesh:
+    """``data`` × ``model`` mesh over the given (default: all) devices.
+
+    Model-axis size defaults to 2 when the device count allows it, so the
+    big dense layers exercise tensor parallelism; pass 1 for pure DP.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if model_parallel is None:
+        model_parallel = 2 if n % 2 == 0 and n >= 2 else 1
+    if n % model_parallel:
+        raise ValueError(f"{n} devices not divisible by model={model_parallel}")
+    grid = mesh_utils.create_device_mesh(
+        (n // model_parallel, model_parallel), devices=devices
+    )
+    return Mesh(grid, axis_names=("data", "model"))
+
+
+def _path_str(path) -> str:
+    return "/".join(
+        str(getattr(p, "key", getattr(p, "name", getattr(p, "idx", p))))
+        for p in path
+    )
+
+
+def _pspec(path, leaf) -> P:
+    """Sharding rule: dense-layer weights split on ``model`` (tensor
+    parallelism for the 4096-wide FC layers — AlexNet's parameter mass),
+    everything else replicated.  Conv kernels are small; replicating them
+    keeps their gradients a pure-DP psum."""
+    name = _path_str(path)
+    if "Dense" in name and name.endswith("kernel") and leaf.ndim == 2:
+        return P(None, "model")
+    if "Dense" in name and name.endswith("bias") and leaf.ndim == 1:
+        return P("model")
+    return P()
+
+
+def tree_shardings(mesh: Mesh, tree):
+    """NamedSharding pytree mirroring *tree* under the ``_pspec`` rule."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, _pspec(path, leaf)), tree
+    )
+
+
+def make_sharded_train_step(model: AlexNet, tx, mesh: Mesh, params, opt_state):
+    """jit the full train step over *mesh*; returns (step_fn, placed_state).
+
+    Batch is split on ``data``; params/opt_state follow ``_pspec``.  XLA
+    derives every collective from these annotations — grads psum over
+    ``data``, activations gather over ``model`` where needed.
+    """
+    param_sh = tree_shardings(mesh, params)
+    opt_sh = tree_shardings(mesh, opt_state)
+    img_sh = NamedSharding(mesh, P("data", None, None, None))
+    lbl_sh = NamedSharding(mesh, P("data"))
+    loss_sh = NamedSharding(mesh, P())
+
+    params = jax.device_put(params, param_sh)
+    opt_state = jax.device_put(opt_state, opt_sh)
+
+    step = jax.jit(
+        functools.partial(train_step, model, tx),
+        in_shardings=(param_sh, opt_sh, img_sh, lbl_sh),
+        out_shardings=(param_sh, opt_sh, loss_sh),
+        donate_argnums=(0, 1),
+    )
+    return step, params, opt_state, (img_sh, lbl_sh)
