@@ -1,0 +1,255 @@
+"""Labeller tests: generators against fixtures, controller against a fake
+API server.
+
+The reference tests only label-key inventory and stale-removal on
+constructed Node objects (main_test.go:42-125); this adds what it lacks —
+an end-to-end reconcile against a live (local, fake) API server asserting
+the actual PATCH bodies.
+"""
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tpu_k8s_device_plugin.labeller import (
+    LabelContext,
+    NodeClient,
+    NodeLabelController,
+    generate_labels,
+)
+from tpu_k8s_device_plugin.labeller.controller import label_delta
+from tpu_k8s_device_plugin.types import constants
+
+
+def ctx_for(testdata, name, driver_type=constants.CONTAINER):
+    root = os.path.join(testdata, name)
+    return LabelContext.collect(
+        driver_type=driver_type,
+        sysfs_root=os.path.join(root, "sys"),
+        dev_root=os.path.join(root, "dev"),
+        tpu_env_path=os.path.join(root, "run", "tpu", "tpu-env"),
+    )
+
+
+class TestGenerators:
+    def test_v5e8_labels(self, testdata):
+        labels = generate_labels(ctx_for(testdata, "v5e-8"))
+        base = constants.LABEL_PREFIX
+        assert labels[f"{base}.accelerator-type"] == "v5litepod-8"
+        assert labels[f"{base}.topology"] == "2x4"
+        assert labels[f"{base}.chips-per-host"] == "8"
+        assert labels[f"{base}.cores-per-chip"] == "1"
+        assert labels[f"{base}.worker-id"] == "0"
+        assert labels[f"{base}.num-workers"] == "1"
+        assert labels[f"{base}.product-name"] == "TPU-v5e"
+        assert labels[f"{base}.hbm"] == "16Gi"
+        assert labels[f"{base}.partitioning-supported"] == "false"
+        assert labels[f"{base}.core-partition"] == "chip"
+        assert labels[f"{base}.mode"] == "container"
+        # every label is mirrored under the beta prefix
+        beta = constants.LABEL_PREFIX_BETA
+        for key, val in list(labels.items()):
+            if key.startswith(base + "."):
+                assert labels[key.replace(base, beta, 1)] == val
+
+    def test_v5p_partitioned_host(self, testdata):
+        labels = generate_labels(ctx_for(testdata, "v5p-8-core"))
+        base = constants.LABEL_PREFIX
+        assert labels[f"{base}.partitioning-supported"] == "true"
+        assert labels[f"{base}.cores-per-chip"] == "2"
+        assert labels[f"{base}.core-partition"] == "core"
+
+    def test_hetero_host_reports_mixed(self, testdata):
+        labels = generate_labels(ctx_for(testdata, "v5p-8-hetero"))
+        assert labels[f"{constants.LABEL_PREFIX}.core-partition"] == "mixed"
+
+    def test_enabled_subset(self, testdata):
+        labels = generate_labels(
+            ctx_for(testdata, "v5e-8"), enabled=["topology"]
+        )
+        assert set(labels) == {
+            f"{constants.LABEL_PREFIX}.topology",
+            f"{constants.LABEL_PREFIX_BETA}.topology",
+        }
+
+    def test_empty_values_dropped(self, testdata):
+        # v5e-4-nometa has no tpu-env: no accelerator-type/worker labels,
+        # but sysfs-derived ones still appear
+        labels = generate_labels(ctx_for(testdata, "v5e-4-nometa"))
+        assert f"{constants.LABEL_PREFIX}.accelerator-type" not in labels
+        assert labels[f"{constants.LABEL_PREFIX}.chips-per-host"] == "4"
+
+
+class TestLabelDelta:
+    def test_delta_sets_removes_and_keeps(self):
+        current = {
+            f"{constants.LABEL_PREFIX}.topology": "2x4",
+            f"{constants.LABEL_PREFIX}.stale": "old",
+            f"{constants.LABEL_PREFIX_BETA}.stale": "old",
+            "kubernetes.io/hostname": "n1",
+        }
+        desired = {
+            f"{constants.LABEL_PREFIX}.topology": "4x4",
+            f"{constants.LABEL_PREFIX}.chips-per-host": "8",
+        }
+        delta = label_delta(current, desired)
+        assert delta == {
+            f"{constants.LABEL_PREFIX}.topology": "4x4",
+            f"{constants.LABEL_PREFIX}.chips-per-host": "8",
+            f"{constants.LABEL_PREFIX}.stale": None,
+            f"{constants.LABEL_PREFIX_BETA}.stale": None,
+        }
+        # foreign labels are never touched
+        assert "kubernetes.io/hostname" not in delta
+
+    def test_in_sync_is_empty(self):
+        labels = {f"{constants.LABEL_PREFIX}.topology": "2x4"}
+        assert label_delta(dict(labels), dict(labels)) == {}
+
+    def test_event_filter_skips_self_induced_and_heartbeats(self):
+        desired = {f"{constants.LABEL_PREFIX}.topology": "2x4"}
+        in_sync = {
+            "type": "MODIFIED",
+            "object": {"metadata": {"labels": dict(desired)}},
+        }
+        assert not NodeLabelController._event_needs_reconcile(in_sync, desired)
+        drifted = {
+            "type": "MODIFIED",
+            "object": {"metadata": {"labels": {}}},
+        }
+        assert NodeLabelController._event_needs_reconcile(drifted, desired)
+        deleted = {"type": "DELETED", "object": {}}
+        assert not NodeLabelController._event_needs_reconcile(deleted, desired)
+
+
+class FakeApiServer:
+    """Serves one Node object; records PATCH bodies and applies merge-patch
+    label semantics."""
+
+    def __init__(self, node_name="test-node", labels=None):
+        self.node = {
+            "metadata": {"name": node_name, "labels": dict(labels or {})}
+        }
+        self.patches = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                self._send(outer.node)
+
+            def do_PATCH(self):
+                length = int(self.headers["Content-Length"])
+                patch = json.loads(self.rfile.read(length))
+                outer.patches.append(patch)
+                labels = outer.node["metadata"]["labels"]
+                for k, v in patch["metadata"]["labels"].items():
+                    if v is None:
+                        labels.pop(k, None)
+                    else:
+                        labels[k] = v
+                self._send(outer.node)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def url(self):
+        host, port = self._server.server_address
+        return f"http://{host}:{port}"
+
+    def stop(self):
+        self._server.shutdown()
+
+
+@pytest.fixture
+def fake_api():
+    srv = FakeApiServer(
+        labels={
+            f"{constants.LABEL_PREFIX}.stale": "gone",
+            "kubernetes.io/hostname": "test-node",
+        }
+    )
+    yield srv
+    srv.stop()
+
+
+class TestController:
+    def test_reconcile_applies_and_cleans(self, testdata, fake_api):
+        compute = lambda: generate_labels(ctx_for(testdata, "v5e-8"))
+        c = NodeLabelController(
+            NodeClient(base_url=fake_api.url), "test-node", compute
+        )
+        delta = c.reconcile()
+        assert delta[f"{constants.LABEL_PREFIX}.stale"] is None
+        assert delta[f"{constants.LABEL_PREFIX}.topology"] == "2x4"
+        applied = fake_api.node["metadata"]["labels"]
+        assert f"{constants.LABEL_PREFIX}.stale" not in applied
+        assert applied[f"{constants.LABEL_PREFIX}.topology"] == "2x4"
+        assert applied["kubernetes.io/hostname"] == "test-node"
+        # second pass: in sync, no PATCH issued
+        n = len(fake_api.patches)
+        assert c.reconcile() == {}
+        assert len(fake_api.patches) == n
+
+    def test_reconcile_recomputes(self, testdata, fake_api):
+        """Labels must track live state (the reference computes once at
+        startup — SURVEY §7 'What NOT to copy')."""
+        state = {"fixture": "v5e-8"}
+        compute = lambda: generate_labels(ctx_for(testdata, state["fixture"]))
+        c = NodeLabelController(
+            NodeClient(base_url=fake_api.url), "test-node", compute
+        )
+        c.reconcile()
+        assert (
+            fake_api.node["metadata"]["labels"][
+                f"{constants.LABEL_PREFIX}.chips-per-host"
+            ]
+            == "8"
+        )
+        state["fixture"] = "v5e-4-nometa"
+        c.reconcile()
+        labels = fake_api.node["metadata"]["labels"]
+        assert labels[f"{constants.LABEL_PREFIX}.chips-per-host"] == "4"
+        # accelerator-type came from v5e-8 metadata only; must be cleaned up
+        assert f"{constants.LABEL_PREFIX}.accelerator-type" not in labels
+
+
+class TestCli:
+    def test_oneshot(self, testdata, fake_api, monkeypatch):
+        from tpu_k8s_device_plugin.cmd import node_labeller
+
+        root = os.path.join(testdata, "v5e-8")
+        rc = node_labeller.main([
+            "--oneshot",
+            "--node-name", "test-node",
+            "--kube-api", fake_api.url,
+            "--sysfs-root", os.path.join(root, "sys"),
+            "--dev-root", os.path.join(root, "dev"),
+            "--tpu-env", os.path.join(root, "run", "tpu", "tpu-env"),
+        ])
+        assert rc == 0
+        labels = fake_api.node["metadata"]["labels"]
+        assert labels[f"{constants.LABEL_PREFIX}.accelerator-type"] == "v5litepod-8"
+
+    def test_requires_node_name(self, monkeypatch):
+        from tpu_k8s_device_plugin.cmd import node_labeller
+
+        monkeypatch.delenv("DS_NODE_NAME", raising=False)
+        assert node_labeller.main(["--oneshot"]) == 2

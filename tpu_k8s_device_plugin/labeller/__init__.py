@@ -1,0 +1,21 @@
+"""Node labeller: publishes TPU properties as Kubernetes node labels.
+
+TPU-native analog of cmd/k8s-node-labeller
+(/root/reference/cmd/k8s-node-labeller/main.go:507-590, controller.go:23-58):
+a generator map computes labels from discovery + topology, a small stdlib
+API-server client applies them, and a reconcile controller keeps them
+fresh — recomputing on every reconcile rather than once at startup (the
+reference computes once, flagged in SURVEY.md §7 "What NOT to copy").
+"""
+
+from .generators import LabelContext, generate_labels, LABEL_GENERATORS
+from .k8s_client import NodeClient
+from .controller import NodeLabelController
+
+__all__ = [
+    "LabelContext",
+    "LABEL_GENERATORS",
+    "NodeClient",
+    "NodeLabelController",
+    "generate_labels",
+]

@@ -1,0 +1,118 @@
+"""Reconcile controller: keep this node's TPU labels in sync.
+
+≈ the reference's controller-runtime Reconcile
+(/root/reference/cmd/k8s-node-labeller/controller.go:23-58) plus its
+stale-label sweep (main.go:64-83), with two deliberate upgrades flagged in
+SURVEY.md §7: labels are recomputed on every reconcile (the reference
+computes once at startup, so partition changes need a pod restart), and the
+whole delta — removals included — lands in one merge-patch request instead
+of a read-modify-update of the full Node object.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, Optional
+
+from tpu_k8s_device_plugin.types import constants
+from .k8s_client import ApiError, NodeClient
+
+log = logging.getLogger(__name__)
+
+_PREFIXES = (f"{constants.LABEL_PREFIX}.", f"{constants.LABEL_PREFIX_BETA}.")
+
+
+def label_delta(
+    current: Dict[str, str], desired: Dict[str, str]
+) -> Dict[str, Optional[str]]:
+    """Merge-patch delta from a node's current labels to the desired set:
+    stale labels under our prefixes → None (delete), changed/new → value."""
+    delta: Dict[str, Optional[str]] = {}
+    for key in current:
+        if key.startswith(_PREFIXES) and key not in desired:
+            delta[key] = None
+    for key, val in desired.items():
+        if current.get(key) != val:
+            delta[key] = val
+    return delta
+
+
+class NodeLabelController:
+    """Periodic (and watch-triggered) reconciliation of one node's labels."""
+
+    def __init__(
+        self,
+        client: NodeClient,
+        node_name: str,
+        compute_labels: Callable[[], Dict[str, str]],
+        interval_s: float = 60.0,
+    ):
+        self.client = client
+        self.node_name = node_name
+        self.compute_labels = compute_labels
+        self.interval = interval_s
+        self._stop = threading.Event()
+
+    def reconcile(
+        self, desired: Optional[Dict[str, str]] = None
+    ) -> Dict[str, Optional[str]]:
+        """One pass; returns the applied delta (empty = already in sync).
+        *desired* skips recomputation when the caller already has it."""
+        node = self.client.get_node(self.node_name)
+        current = (node.get("metadata") or {}).get("labels") or {}
+        if desired is None:
+            desired = self.compute_labels()
+        delta = label_delta(current, desired)
+        if delta:
+            self.client.patch_node_labels(self.node_name, delta)
+            log.info(
+                "reconciled %s: %d set, %d removed",
+                self.node_name,
+                sum(1 for v in delta.values() if v is not None),
+                sum(1 for v in delta.values() if v is None),
+            )
+        return delta
+
+    @staticmethod
+    def _event_needs_reconcile(event: dict, desired: Dict[str, str]) -> bool:
+        """Cheap filter before paying a discovery pass: skip watch events
+        whose label state already matches what we last computed.  Weeds out
+        the watch's initial replay of the current object, the MODIFIED we
+        cause with our own PATCH, and kubelet status heartbeats."""
+        if event.get("type") not in ("ADDED", "MODIFIED"):
+            return False
+        obj = event.get("object") or {}
+        current = (obj.get("metadata") or {}).get("labels") or {}
+        return bool(label_delta(current, desired))
+
+    def run(self) -> None:
+        """Reconcile loop: immediate pass, then watch the node for changes
+        with the interval as both watch timeout and error backoff.  The
+        watch replaces the reference's controller-runtime Node informer
+        (main.go:551-577) — filtered to our own node by field selector."""
+        while not self._stop.is_set():
+            try:
+                desired = self.compute_labels()
+                self.reconcile(desired)
+            except (ApiError, OSError) as e:
+                log.error("reconcile failed: %s", e)
+                self._stop.wait(min(self.interval, 10.0))
+                continue
+            try:
+                for event in self.client.watch_node(
+                    self.node_name, timeout_s=int(self.interval)
+                ):
+                    if self._stop.is_set():
+                        return
+                    if self._event_needs_reconcile(event, desired):
+                        # recompute: the divergence may reflect new hardware
+                        # state, not just someone deleting our labels
+                        desired = self.compute_labels()
+                        self.reconcile(desired)
+            except (ApiError, OSError) as e:
+                log.warning("watch failed (%s); falling back to poll", e)
+                self._stop.wait(self.interval)
+
+    def stop(self) -> None:
+        self._stop.set()

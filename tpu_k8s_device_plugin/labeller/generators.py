@@ -1,0 +1,180 @@
+"""Label generators: one small function per label key.
+
+Mirrors the reference's generator-map design
+(/root/reference/cmd/k8s-node-labeller/main.go:123-385) with TPU content:
+where AMD reads libdrm ioctls and KFD topology for family/vram/cu-count,
+these read the accel sysfs tree and tpu-env metadata already parsed by the
+discovery layer.  Every generator takes the same precomputed context so a
+reconcile is pure in-memory work after one discovery pass.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from tpu_k8s_device_plugin.tpu import discovery, vfio
+from tpu_k8s_device_plugin.tpu.discovery import TpuDevice
+from tpu_k8s_device_plugin.tpu.topology import IciTopology
+from tpu_k8s_device_plugin.types import constants
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class LabelContext:
+    """Inputs every generator works from (one discovery pass per reconcile)."""
+
+    driver_type: str
+    chips: Dict[str, TpuDevice] = field(default_factory=dict)
+    topology: Optional[IciTopology] = None
+    sysfs_root: str = "/sys"
+
+    @classmethod
+    def collect(
+        cls,
+        driver_type: str = constants.CONTAINER,
+        sysfs_root: str = "/sys",
+        dev_root: str = "/dev",
+        tpu_env_path: str = constants.TPU_ENV_FILE,
+    ) -> "LabelContext":
+        chips, topo = discovery.get_tpu_chips(sysfs_root, dev_root, tpu_env_path)
+        return cls(
+            driver_type=driver_type,
+            chips=chips,
+            topology=topo,
+            sysfs_root=sysfs_root,
+        )
+
+
+def _mode(ctx: LabelContext) -> str:
+    return ctx.driver_type
+
+
+def _accelerator_type(ctx: LabelContext) -> str:
+    return ctx.topology.accelerator_type if ctx.topology else ""
+
+
+def _topology(ctx: LabelContext) -> str:
+    return ctx.topology.topology_str if ctx.topology else ""
+
+
+def _chips_per_host(ctx: LabelContext) -> str:
+    return str(len(ctx.chips)) if ctx.chips else ""
+
+
+def _cores_per_chip(ctx: LabelContext) -> str:
+    spec = ctx.topology.spec if ctx.topology else None
+    return str(spec.cores_per_chip) if spec else ""
+
+
+def _worker_id(ctx: LabelContext) -> str:
+    return str(ctx.topology.worker_id) if ctx.topology else ""
+
+
+def _num_workers(ctx: LabelContext) -> str:
+    return str(ctx.topology.num_workers) if ctx.topology else ""
+
+
+def _firmware(ctx: LabelContext) -> str:
+    for chip in sorted(ctx.chips.values(), key=lambda c: c.id):
+        if chip.accel_index >= 0:
+            fw = discovery.get_firmware_version(ctx.sysfs_root, chip.accel_index)
+        else:
+            fw = ""
+        if fw:
+            return fw
+    return ""
+
+
+def _driver_version(ctx: LabelContext) -> str:
+    if ctx.driver_type == constants.VF_PASSTHROUGH:
+        vers = vfio.get_tpu_vf_module_versions(ctx.sysfs_root)
+        return vers.get("version", "")
+    return discovery.get_driver_versions(ctx.sysfs_root).get("driver-version", "")
+
+
+def _device_id(ctx: LabelContext) -> str:
+    # "_" separator: "," is not legal in a k8s label value, and one bad
+    # value would get the whole merge patch rejected
+    ids = sorted({c.device_id for c in ctx.chips.values() if c.device_id})
+    return ids[0] if len(ids) == 1 else "_".join(ids)
+
+
+def _product_name(ctx: LabelContext) -> str:
+    spec = ctx.topology.spec if ctx.topology else None
+    # label values cannot contain spaces or parens; slugify
+    if spec is None:
+        return ""
+    return spec.product_name.replace(" ", "-").replace("(", "").replace(")", "")
+
+
+def _hbm(ctx: LabelContext) -> str:
+    spec = ctx.topology.spec if ctx.topology else None
+    if spec is None:
+        return ""
+    gib = spec.hbm_bytes_per_chip // (1024 ** 3)
+    return f"{gib}Gi"
+
+
+def _partitioning_supported(ctx: LabelContext) -> str:
+    spec = ctx.topology.spec if ctx.topology else None
+    if spec is None:
+        return ""
+    return "true" if spec.cores_per_chip > 1 else "false"
+
+
+def _core_partition(ctx: LabelContext) -> str:
+    if not ctx.chips:
+        return ""
+    modes = {c.partition_mode for c in ctx.chips.values()}
+    return "mixed" if len(modes) > 1 else next(iter(modes))
+
+
+# key → generator; keys are the SUPPORTED_LABELS flag names
+# (≈ labelGenerators, main.go:123).
+LABEL_GENERATORS: Dict[str, Callable[[LabelContext], str]] = {
+    "mode": _mode,
+    "accelerator-type": _accelerator_type,
+    "topology": _topology,
+    "chips-per-host": _chips_per_host,
+    "cores-per-chip": _cores_per_chip,
+    "worker-id": _worker_id,
+    "num-workers": _num_workers,
+    "firmware": _firmware,
+    "driver-version": _driver_version,
+    "device-id": _device_id,
+    "product-name": _product_name,
+    "hbm": _hbm,
+    "partitioning-supported": _partitioning_supported,
+    "core-partition": _core_partition,
+}
+
+assert set(LABEL_GENERATORS) == set(constants.SUPPORTED_LABELS)
+
+
+def generate_labels(
+    ctx: LabelContext, enabled: Optional[List[str]] = None
+) -> Dict[str, str]:
+    """Fully-qualified label map for the enabled generators, under both the
+    primary and legacy prefixes (≈ createLabelPrefix + generation loop,
+    main.go:85-116, 410-430).  Empty values are dropped — absent data must
+    not become an empty label."""
+    keys = enabled if enabled is not None else list(LABEL_GENERATORS)
+    out: Dict[str, str] = {}
+    for key in keys:
+        gen = LABEL_GENERATORS.get(key)
+        if gen is None:
+            log.warning("unknown label %s; skipping", key)
+            continue
+        try:
+            val = gen(ctx)
+        except Exception as e:
+            log.error("label generator %s failed: %s", key, e)
+            continue
+        if not val:
+            continue
+        out[f"{constants.LABEL_PREFIX}.{key}"] = val
+        out[f"{constants.LABEL_PREFIX_BETA}.{key}"] = val
+    return out

@@ -1,0 +1,131 @@
+"""Minimal in-cluster Kubernetes API client for Node objects.
+
+The reference leans on controller-runtime for its Node updates
+(/root/reference/cmd/k8s-node-labeller/main.go:529-577); this build needs
+only three verbs against one resource, so a stdlib HTTPS client keeps the
+image dependency-free: GET node, PATCH labels (JSON merge patch — a null
+value deletes a label, which makes stale-label cleanup a single request),
+and a long-poll WATCH for the controller loop.
+
+In-cluster config is the standard service-account mount; every path and the
+API base URL are injectable so tests drive it against a local fake.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import ssl
+import urllib.error
+import urllib.request
+from typing import Dict, Iterator, Optional
+
+log = logging.getLogger(__name__)
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, body: str):
+        super().__init__(f"API server returned {status}: {body[:200]}")
+        self.status = status
+        self.body = body
+
+
+class NodeClient:
+    """Talks to ``/api/v1/nodes`` with service-account credentials."""
+
+    def __init__(
+        self,
+        base_url: Optional[str] = None,
+        token_path: str = os.path.join(SA_DIR, "token"),
+        ca_path: str = os.path.join(SA_DIR, "ca.crt"),
+        timeout_s: float = 10.0,
+    ):
+        if base_url is None:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            base_url = f"https://{host}:{port}"
+        self.base_url = base_url.rstrip("/")
+        self._token_path = token_path
+        self._timeout = timeout_s
+        self._ssl_ctx: Optional[ssl.SSLContext] = None
+        if self.base_url.startswith("https") and os.path.exists(ca_path):
+            self._ssl_ctx = ssl.create_default_context(cafile=ca_path)
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _token(self) -> str:
+        # re-read per request: projected SA tokens rotate
+        try:
+            with open(self._token_path, "r", encoding="utf-8") as f:
+                return f.read().strip()
+        except OSError:
+            return ""
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[dict] = None,
+        content_type: str = "application/json",
+        timeout: Optional[float] = None,
+    ):
+        req = urllib.request.Request(
+            self.base_url + path,
+            method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+        )
+        token = self._token()
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
+        req.add_header("Accept", "application/json")
+        if body is not None:
+            req.add_header("Content-Type", content_type)
+        try:
+            return urllib.request.urlopen(
+                req, timeout=timeout or self._timeout, context=self._ssl_ctx
+            )
+        except urllib.error.HTTPError as e:
+            raise ApiError(e.code, e.read().decode(errors="replace")) from e
+
+    # -- node verbs ---------------------------------------------------------
+
+    def get_node(self, name: str) -> dict:
+        with self._request("GET", f"/api/v1/nodes/{name}") as resp:
+            return json.load(resp)
+
+    def patch_node_labels(
+        self, name: str, labels: Dict[str, Optional[str]]
+    ) -> dict:
+        """Apply a label delta; a None value removes that label (JSON merge
+        patch semantics, RFC 7386)."""
+        patch = {"metadata": {"labels": labels}}
+        with self._request(
+            "PATCH",
+            f"/api/v1/nodes/{name}",
+            body=patch,
+            content_type="application/merge-patch+json",
+        ) as resp:
+            return json.load(resp)
+
+    def watch_node(
+        self, name: str, timeout_s: int = 60
+    ) -> Iterator[dict]:
+        """Yield watch events for one node until the server closes the
+        long-poll (bounded by ``timeoutSeconds``)."""
+        path = (
+            f"/api/v1/nodes?watch=true"
+            f"&fieldSelector=metadata.name%3D{name}"
+            f"&timeoutSeconds={timeout_s}"
+        )
+        with self._request("GET", path, timeout=timeout_s + 5) as resp:
+            for line in resp:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    yield json.loads(line)
+                except json.JSONDecodeError:
+                    log.warning("unparseable watch line: %r", line[:120])
