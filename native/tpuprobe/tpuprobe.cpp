@@ -1,0 +1,122 @@
+// tpuprobe: native host-interface shim for the TPU device plugin.
+//
+// The reference's native surface is two cgo->C boundaries: libdrm ioctls
+// for device probing (/root/reference/internal/pkg/amdgpu/amdgpu.go:21-27,
+// 646-736) and hwloc for NUMA lookup
+// (/root/reference/internal/pkg/hwloc/hwloc.go:21-97), plus fsnotify for
+// the kubelet-socket watch in the vendored dpm
+// (vendor/.../dpm/manager.go:52-55).  This shim provides the TPU-native
+// equivalents behind a flat C ABI consumed from Python via ctypes:
+//
+//   - inotify directory watcher (kubelet socket create/remove detection
+//     without polling)
+//   - device-node probe (open/stat the chardev as the kernel sees it --
+//     an access(2) check can lie under capability-based permissions)
+//   - NUMA node lookup for a PCI function (sysfs read, the hwloc subset
+//     the plugin actually needs)
+//
+// Built as libtpuprobe.so with no dependencies beyond libc/libstdc++.
+
+#include <cerrno>
+#include <cstdio>
+#include <cstring>
+
+#include <fcntl.h>
+#include <poll.h>
+#include <sys/inotify.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#define TP_API extern "C" __attribute__((visibility("default")))
+
+static const char kVersion[] = "tpuprobe 1.0.0";
+
+TP_API const char* tp_version(void) { return kVersion; }
+
+// ---------------------------------------------------------------------------
+// inotify directory watcher
+// ---------------------------------------------------------------------------
+
+struct tp_watch {
+  int ifd;
+  int wd;
+};
+
+// Returns a watcher handle for create/delete/move events in `dir`, or
+// nullptr (errno left set) when inotify is unavailable.
+TP_API tp_watch* tp_watch_create(const char* dir) {
+  int ifd = inotify_init1(IN_NONBLOCK | IN_CLOEXEC);
+  if (ifd < 0) return nullptr;
+  int wd = inotify_add_watch(
+      ifd, dir, IN_CREATE | IN_DELETE | IN_MOVED_TO | IN_MOVED_FROM);
+  if (wd < 0) {
+    int saved = errno;
+    close(ifd);
+    errno = saved;
+    return nullptr;
+  }
+  tp_watch* w = new tp_watch{ifd, wd};
+  return w;
+}
+
+// Blocks up to timeout_ms for a filesystem event in the watched dir.
+// Returns 1 if at least one event arrived, 0 on timeout, -errno on error.
+TP_API int tp_watch_wait(tp_watch* w, int timeout_ms) {
+  if (!w) return -EINVAL;
+  struct pollfd pfd = {w->ifd, POLLIN, 0};
+  int rc = poll(&pfd, 1, timeout_ms);
+  if (rc < 0) return -errno;
+  if (rc == 0) return 0;
+  // drain the queue; the caller re-stats the socket regardless
+  char buf[4096];
+  while (read(w->ifd, buf, sizeof buf) > 0) {
+  }
+  return 1;
+}
+
+TP_API void tp_watch_destroy(tp_watch* w) {
+  if (!w) return;
+  inotify_rm_watch(w->ifd, w->wd);
+  close(w->ifd);
+  delete w;
+}
+
+// ---------------------------------------------------------------------------
+// device-node probe
+// ---------------------------------------------------------------------------
+
+// Probes a TPU device node the way a workload would consume it: stat that
+// it is a character device, then open it read-write without blocking.
+// Returns 0 when healthy, -errno on the first failing step.  O_NONBLOCK
+// keeps the probe non-exclusive -- it must never steal the chip from a
+// running workload (SURVEY.md section 7, "health without privileged
+// /dev/kfd").
+TP_API int tp_probe_device(const char* path) {
+  struct stat st;
+  if (stat(path, &st) != 0) return -errno;
+  if (!S_ISCHR(st.st_mode)) return -ENODEV;
+  int fd = open(path, O_RDWR | O_NONBLOCK | O_CLOEXEC);
+  if (fd < 0) return -errno;
+  close(fd);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// NUMA lookup (the hwloc subset the plugin needs)
+// ---------------------------------------------------------------------------
+
+// NUMA node of a PCI function from its sysfs directory.  Returns the node
+// id (>= 0), 0 when the kernel reports -1 (unknown), or -errno.
+TP_API int tp_numa_node(const char* pci_sysfs_dir) {
+  char path[4096];
+  int n = snprintf(path, sizeof path, "%s/numa_node", pci_sysfs_dir);
+  if (n < 0 || static_cast<size_t>(n) >= sizeof path) return -ENAMETOOLONG;
+  FILE* f = fopen(path, "re");
+  if (!f) return -errno;
+  int node = -1;
+  int rc = fscanf(f, "%d", &node);
+  fclose(f);
+  if (rc != 1) return -EINVAL;
+  return node < 0 ? 0 : node;
+}

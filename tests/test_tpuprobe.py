@@ -1,0 +1,95 @@
+"""Native tpuprobe shim tests (build + ctypes binding).
+
+The reference's native boundary has no tests at all (its cgo paths are
+only exercised by hardware-gated tests, SURVEY.md §4.2); here the shim's
+full C ABI is covered: inotify watch semantics, the chardev probe's errno
+contract, and the NUMA sysfs read against fixtures.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+tpuprobe = pytest.importorskip(
+    "tpu_k8s_device_plugin.hostinfo.tpuprobe",
+    reason="native shim unbuildable (no C++ toolchain)",
+)
+
+
+def test_version_banner():
+    assert tpuprobe.version().startswith("tpuprobe ")
+
+
+class TestProbeDevice:
+    def test_chardev_ok(self):
+        assert tpuprobe.probe_device_node("/dev/null") == 0
+
+    def test_missing_is_enoent(self):
+        assert tpuprobe.probe_device_node("/nonexistent/accel0") == -2
+
+    def test_regular_file_is_enodev(self, tmp_path):
+        p = tmp_path / "accel0"
+        p.write_text("")
+        assert tpuprobe.probe_device_node(str(p)) == -19
+
+
+class TestNumaNode:
+    def test_fixture_read(self, testdata):
+        d = os.path.join(
+            testdata, "v5e-8", "sys", "devices", "pci0000:00", "0000:00:04.0"
+        )
+        assert tpuprobe.numa_node(d) >= 0
+
+    def test_missing_dir(self):
+        assert tpuprobe.numa_node("/nonexistent") < 0
+
+
+class TestDirWatcher:
+    def test_create_event(self, tmp_path):
+        with tpuprobe.DirWatcher(str(tmp_path)) as w:
+            t = threading.Timer(
+                0.1, lambda: (tmp_path / "kubelet.sock").write_text("")
+            )
+            t.start()
+            t0 = time.monotonic()
+            assert w.wait(5.0)
+            # event-driven: must fire well before the timeout
+            assert time.monotonic() - t0 < 2.0
+
+    def test_timeout_without_event(self, tmp_path):
+        with tpuprobe.DirWatcher(str(tmp_path)) as w:
+            assert not w.wait(0.1)
+
+    def test_delete_event(self, tmp_path):
+        f = tmp_path / "sock"
+        f.write_text("")
+        with tpuprobe.DirWatcher(str(tmp_path)) as w:
+            w.wait(0.05)  # drain the create we just did
+            threading.Timer(0.1, f.unlink).start()
+            assert w.wait(5.0)
+
+    def test_missing_dir_raises(self):
+        with pytest.raises(OSError):
+            tpuprobe.DirWatcher("/nonexistent-dir-xyz")
+
+    def test_closed_watcher_raises(self, tmp_path):
+        w = tpuprobe.DirWatcher(str(tmp_path))
+        w.close()
+        with pytest.raises(ValueError):
+            w.wait(0.01)
+
+
+def test_health_server_uses_native_probe(testdata):
+    """probe_chip_states goes through the native path when available and
+    still accepts fixture trees (regular-file device nodes)."""
+    from tpu_k8s_device_plugin.health import server as hs
+
+    assert hs._tpuprobe is not None
+    root = os.path.join(testdata, "v5e-8")
+    states = hs.probe_chip_states(
+        os.path.join(root, "sys"), os.path.join(root, "dev")
+    )
+    assert len(states) == 8
+    assert all(s.health == "Healthy" for s in states.values())

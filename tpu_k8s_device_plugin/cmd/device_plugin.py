@@ -114,6 +114,12 @@ def main(argv=None) -> int:
         format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
     )
     log.info("k8s-tpu-device-plugin %s starting", __version__)
+    # native shim banner (≈ the hwloc version banner, main.go:40)
+    try:
+        from tpu_k8s_device_plugin.hostinfo import tpuprobe
+        log.info("native shim: %s", tpuprobe.version())
+    except Exception as e:
+        log.warning("native shim unavailable (%s); using portable paths", e)
     if args.pulse < 0:
         log.error("invalid pulse %d; must be >= 0", args.pulse)
         return 2

@@ -12,6 +12,7 @@ non-exclusive).
 from __future__ import annotations
 
 import concurrent.futures
+import errno
 import logging
 import os
 from typing import Dict, Optional
@@ -27,6 +28,28 @@ from tpu_k8s_device_plugin.types import constants
 
 log = logging.getLogger(__name__)
 
+try:
+    from tpu_k8s_device_plugin.hostinfo import tpuprobe as _tpuprobe
+except Exception as _e:  # no native shim / no toolchain: portable fallback
+    _tpuprobe = None
+    log.warning(
+        "native tpuprobe unavailable (%s); health probe degrades to "
+        "access(2) checks", _e,
+    )
+
+
+def _node_openable(path: str) -> bool:
+    """Is the device node consumable by a workload?  The native probe
+    actually opens the chardev (non-exclusive); access(2) can lie under
+    capability-based permission schemes."""
+    if _tpuprobe is not None:
+        rc = _tpuprobe.probe_device_node(path)
+        if rc != -errno.ENODEV:
+            return rc == 0
+        # not a chardev: captured fixture trees model /dev/accelN as
+        # regular files — fall through to the portable check
+    return os.path.exists(path) and os.access(path, os.R_OK | os.W_OK)
+
 
 def probe_chip_states(
     sysfs_root: str = "/sys", dev_root: str = "/dev"
@@ -40,9 +63,7 @@ def probe_chip_states(
             # probe; reporting them Healthy would mask the plugin's own
             # node-health fallback, so leave them out of the map entirely
             continue
-        healthy = os.path.exists(chip.dev_path) and os.access(
-            chip.dev_path, os.R_OK | os.W_OK
-        )
+        healthy = _node_openable(chip.dev_path)
         states[chip.id] = hpb.TpuState(
             id=chip.id,
             accel_index=chip.accel_index,

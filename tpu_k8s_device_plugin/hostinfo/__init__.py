@@ -1,0 +1,10 @@
+"""Native host-interface bindings (the cgo-boundary analog).
+
+``tpuprobe`` loads ``libtpuprobe.so`` (built from ``native/tpuprobe/``)
+via ctypes — the same division the reference draws with its cgo blocks
+(/root/reference/internal/pkg/amdgpu/amdgpu.go:21-27,
+internal/pkg/hwloc/hwloc.go:21-24): Python/Go owns policy, the native
+shim owns kernel interfaces.  Import of ``tpuprobe`` raises when the
+library is missing and can't be built; callers treat that as "no native
+support" and fall back to portable paths.
+"""

@@ -1,0 +1,126 @@
+"""ctypes binding for libtpuprobe.so.
+
+Importing this module loads (building on first use if a toolchain is
+present) the native shim; ImportError signals "no native support" and
+callers fall back to portable Python (e.g. the manager's stat-polling
+kubelet watch, manager.py:_kubelet_watch_loop).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import errno
+import logging
+import os
+import shutil
+import subprocess
+import threading
+
+log = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_HERE, "libtpuprobe.so")
+_SRC = os.path.normpath(
+    os.path.join(_HERE, "..", "..", "native", "tpuprobe", "tpuprobe.cpp")
+)
+_build_lock = threading.Lock()
+
+
+def _build() -> bool:
+    cxx = os.environ.get("CXX") or shutil.which("g++") or shutil.which("c++")
+    if not cxx or not os.path.exists(_SRC):
+        return False
+    cmd = [
+        cxx, "-O2", "-Wall", "-fPIC", "-fvisibility=hidden", "-std=c++17",
+        "-shared", "-o", _SO_PATH, _SRC,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, OSError) as e:
+        log.warning("tpuprobe build failed: %s", e)
+        return False
+
+
+def _load() -> ctypes.CDLL:
+    with _build_lock:
+        if not os.path.exists(_SO_PATH) and not _build():
+            raise ImportError("libtpuprobe.so unavailable and unbuildable")
+    lib = ctypes.CDLL(_SO_PATH, use_errno=True)
+    lib.tp_version.restype = ctypes.c_char_p
+    lib.tp_watch_create.restype = ctypes.c_void_p
+    lib.tp_watch_create.argtypes = [ctypes.c_char_p]
+    lib.tp_watch_wait.restype = ctypes.c_int
+    lib.tp_watch_wait.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.tp_watch_destroy.argtypes = [ctypes.c_void_p]
+    lib.tp_probe_device.restype = ctypes.c_int
+    lib.tp_probe_device.argtypes = [ctypes.c_char_p]
+    lib.tp_numa_node.restype = ctypes.c_int
+    lib.tp_numa_node.argtypes = [ctypes.c_char_p]
+    return lib
+
+
+_lib = _load()
+
+
+def version() -> str:
+    """Shim version banner (≈ hwloc GetVersions used at startup,
+    cmd/k8s-device-plugin/main.go:40)."""
+    return _lib.tp_version().decode()
+
+
+def probe_device_node(path: str) -> int:
+    """0 when *path* is an openable character device, else -errno.
+    Non-exclusive (O_NONBLOCK): never steals the chip from a workload."""
+    return _lib.tp_probe_device(path.encode())
+
+
+def numa_node(pci_sysfs_dir: str) -> int:
+    """NUMA node of a PCI function (>= 0; unknown collapses to 0), -errno
+    on read failure."""
+    return _lib.tp_numa_node(pci_sysfs_dir.encode())
+
+
+class DirWatcher:
+    """inotify watch on a directory (the fsnotify analog the plugin
+    manager uses for kubelet-socket create/remove detection)."""
+
+    def __init__(self, directory: str):
+        ctypes.set_errno(0)
+        self._handle = _lib.tp_watch_create(directory.encode())
+        if not self._handle:
+            err = ctypes.get_errno()
+            raise OSError(
+                err,
+                f"inotify watch failed for {directory}: {os.strerror(err)}",
+            )
+
+    def wait(self, timeout_s: float = 1.0) -> bool:
+        """True when a filesystem event arrived before the timeout; raises
+        OSError when the watch itself is broken (callers fall back to
+        polling rather than spinning on a dead fd)."""
+        if self._handle is None:
+            raise ValueError("watcher is closed")
+        rc = _lib.tp_watch_wait(self._handle, int(timeout_s * 1000))
+        if rc < 0:
+            if rc == -errno.EINTR:
+                return False  # signal during poll: just a spurious wakeup
+            raise OSError(-rc, f"inotify wait failed: {os.strerror(-rc)}")
+        return rc > 0
+
+    def close(self) -> None:
+        if self._handle is not None:
+            _lib.tp_watch_destroy(self._handle)
+            self._handle = None
+
+    def __enter__(self) -> "DirWatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -234,7 +234,13 @@ class PluginManager:
         last_stat = self._socket_stat()
         while not self._stop.is_set():
             if watcher is not None:
-                watcher.wait(timeout_s=self._watch_interval)
+                try:
+                    watcher.wait(timeout_s=self._watch_interval)
+                except OSError as e:
+                    log.warning(
+                        "inotify watch broke (%s); falling back to polling", e
+                    )
+                    watcher = None
             else:
                 time.sleep(self._watch_interval)
             cur = self._socket_stat()

@@ -5,6 +5,22 @@ from __future__ import annotations
 import os
 
 
+def _native():
+    """The tpuprobe shim, or None when unbuildable (cached after first
+    attempt; import cost includes a one-time g++ build)."""
+    global _NATIVE
+    if _NATIVE is False:
+        try:
+            from tpu_k8s_device_plugin.hostinfo import tpuprobe
+            _NATIVE = tpuprobe
+        except Exception:
+            _NATIVE = None
+    return _NATIVE
+
+
+_NATIVE = False
+
+
 def read_file(path: str) -> str:
     try:
         with open(path, "r", encoding="utf-8") as f:
@@ -22,7 +38,14 @@ def read_int(path: str, default: int = 0) -> int:
 
 
 def numa_node(dev_dir: str) -> int:
-    """NUMA node of a PCI device dir, clamped to >= 0 (-1 means unknown)."""
+    """NUMA node of a PCI device dir, clamped to >= 0 (-1 means unknown).
+    Prefers the native shim (≈ the reference routing NUMA through hwloc
+    cgo, internal/pkg/hwloc/hwloc.go:69-97) with a pure-Python fallback."""
+    native = _native()
+    if native is not None:
+        rc = native.numa_node(dev_dir)
+        if rc >= 0:
+            return rc
     return max(read_int(os.path.join(dev_dir, "numa_node"), 0), 0)
 
 
