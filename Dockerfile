@@ -1,0 +1,32 @@
+# Multi-target image build (≈ the reference's Alpine multi-stage
+# Dockerfile + labeller.Dockerfile, collapsed so the builder stage exists
+# once):
+#
+#   docker build -t k8s-tpu-device-plugin .                  # plugin (default)
+#   docker build --target labeller -t k8s-tpu-node-labeller .
+#
+# GIT_DESCRIBE stamps the version the CLI banner prints, mirroring the
+# reference's -ldflags -X main.gitDescribe.
+FROM python:3.11-slim AS builder
+ARG GIT_DESCRIBE=unknown
+RUN apt-get update && apt-get install -y --no-install-recommends g++ make \
+    && rm -rf /var/lib/apt/lists/*
+WORKDIR /src
+COPY pyproject.toml README.md ./
+COPY tpu_k8s_device_plugin/ tpu_k8s_device_plugin/
+COPY native/ native/
+RUN make -C native/tpuprobe \
+    && pip install --no-cache-dir --prefix=/install . \
+    && cp tpu_k8s_device_plugin/hostinfo/libtpuprobe.so \
+         /install/lib/python3.11/site-packages/tpu_k8s_device_plugin/hostinfo/ \
+    && echo "${GIT_DESCRIBE}" > /install/git-describe
+
+FROM python:3.11-slim AS labeller
+COPY --from=builder /install /usr/local
+ENTRYPOINT ["k8s-tpu-node-labeller"]
+
+# plugin image last so it is the default target
+FROM python:3.11-slim AS dp
+COPY --from=builder /install /usr/local
+ENTRYPOINT ["k8s-tpu-device-plugin"]
+CMD ["--pulse=0"]

@@ -24,36 +24,14 @@ import jax.numpy as jnp
 
 
 def bench_alexnet(platform: str) -> float:
-    """images/sec of the jit-compiled train step, synthetic data."""
-    import functools
-    from tpu_k8s_device_plugin.workloads.alexnet import (
-        create_train_state, synthetic_batch, train_step,
-    )
+    """images/sec of the jit-compiled train step, synthetic data (one
+    timing harness shared with the example pods' bench_main)."""
+    from tpu_k8s_device_plugin.workloads.bench_main import run_single
 
     on_accel = platform != "cpu"
     batch = 256 if on_accel else 16
     warmup, steps = (5, 30) if on_accel else (1, 3)
-
-    rng = jax.random.PRNGKey(0)
-    model, state = create_train_state(rng, batch_size=batch)
-    params, opt_state, tx = state["params"], state["opt_state"], state["tx"]
-    images, labels = synthetic_batch(rng, batch)
-    step = jax.jit(
-        functools.partial(train_step, model, tx), donate_argnums=(0, 1)
-    )
-
-    for _ in range(warmup):
-        params, opt_state, loss = step(params, opt_state, images, labels)
-    float(loss)  # value transfer, not block_until_ready: the transfer has a
-    # hard data dependency on the whole dispatched chain, which some remote
-    # TPU transports honor more faithfully than buffer-ready events
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, loss = step(params, opt_state, images, labels)
-    float(loss)
-    dt = time.perf_counter() - t0
-    return batch * steps / dt
+    return run_single(batch, steps, warmup)
 
 
 def bench_allocate_p50_us() -> float:

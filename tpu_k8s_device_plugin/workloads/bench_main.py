@@ -1,0 +1,96 @@
+"""Pod-runnable AlexNet benchmark (what the example pods execute).
+
+≈ the reference pod's ``tf_cnn_benchmarks.py --model=alexnet`` invocation
+(/root/reference/example/pod/alexnet-gpu.yaml:16): runs on whatever chips
+the device plugin granted (TPU_VISIBLE_CHIPS) and prints images/sec to the
+pod log.  ``--sharded`` trains over a mesh of all visible devices instead
+of a single one.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import time
+
+import jax
+
+
+def _timed_loop(step, params, opt_state, images, labels, batch, steps, warmup):
+    """Shared timing harness.  Syncs via value transfer, not
+    block_until_ready: the transfer has a hard data dependency on the whole
+    dispatched chain, which some remote TPU transports honor more
+    faithfully than buffer-ready events."""
+    loss = None
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state, images, labels)
+    if loss is not None:
+        float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, images, labels)
+    float(loss)
+    return batch * steps / (time.perf_counter() - t0)
+
+
+def run_single(batch: int, steps: int, warmup: int) -> float:
+    from .alexnet import create_train_state, synthetic_batch, train_step
+
+    rng = jax.random.PRNGKey(0)
+    model, state = create_train_state(rng, batch_size=batch)
+    params, opt_state, tx = state["params"], state["opt_state"], state["tx"]
+    images, labels = synthetic_batch(rng, batch)
+    step = jax.jit(
+        functools.partial(train_step, model, tx), donate_argnums=(0, 1)
+    )
+    return _timed_loop(
+        step, params, opt_state, images, labels, batch, steps, warmup
+    )
+
+
+def run_sharded(batch: int, steps: int, warmup: int) -> float:
+    from .alexnet import create_train_state, synthetic_batch
+    from .parallel import make_mesh, make_sharded_train_step
+
+    mesh = make_mesh()
+    # keep per-device batch constant so chips stay MXU-bound as we scale
+    batch *= mesh.shape["data"]
+    rng = jax.random.PRNGKey(0)
+    model, state = create_train_state(rng, batch_size=batch)
+    step, params, opt_state, (img_sh, lbl_sh) = make_sharded_train_step(
+        model, state["tx"], mesh, state["params"], state["opt_state"]
+    )
+    images, labels = synthetic_batch(rng, batch)
+    images = jax.device_put(images, img_sh)
+    labels = jax.device_put(labels, lbl_sh)
+    return _timed_loop(
+        step, params, opt_state, images, labels, batch, steps, warmup
+    )
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="alexnet-jax-bench")
+    p.add_argument("--batch", type=int, default=256,
+                   help="per-device batch size (default 256)")
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--sharded", action="store_true",
+                   help="train over a mesh of all visible devices")
+    args = p.parse_args(argv)
+    if args.steps < 1:
+        p.error("--steps must be >= 1")
+
+    devs = jax.devices()
+    print(f"devices: {len(devs)} x {devs[0].platform}", flush=True)
+    if args.sharded:
+        ips = run_sharded(args.batch, args.steps, args.warmup)
+    else:
+        ips = run_single(args.batch, args.steps, args.warmup)
+    n = len(devs) if args.sharded else 1
+    print(f"total images/sec: {ips:.1f}")
+    print(f"images/sec/chip:  {ips / n:.1f}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,22 @@
+# UBI-based device-plugin image (≈ ubi-dp.Dockerfile): Red Hat base for
+# OpenShift environments; defaults the health pulse on (-pulse=30 in the
+# reference's UBI variant).
+FROM registry.access.redhat.com/ubi9/python-311 AS builder
+ARG GIT_DESCRIBE=unknown
+USER 0
+RUN dnf install -y gcc-c++ make && dnf clean all
+WORKDIR /src
+COPY pyproject.toml README.md ./
+COPY tpu_k8s_device_plugin/ tpu_k8s_device_plugin/
+COPY native/ native/
+RUN make -C native/tpuprobe \
+    && pip install --no-cache-dir --prefix=/install . \
+    && cp tpu_k8s_device_plugin/hostinfo/libtpuprobe.so \
+         /install/lib/python3.11/site-packages/tpu_k8s_device_plugin/hostinfo/ \
+    && echo "${GIT_DESCRIBE}" > /install/git-describe
+
+FROM registry.access.redhat.com/ubi9/python-311
+COPY --from=builder /install /usr/local
+ENV PYTHONPATH=/usr/local/lib/python3.11/site-packages
+ENTRYPOINT ["/usr/local/bin/k8s-tpu-device-plugin"]
+CMD ["--pulse=30"]
