@@ -164,3 +164,94 @@ class TestNoTopologyFallback:
 def test_empty_init_rejected():
     with pytest.raises(AllocationError):
         BestEffortPolicy().init([], None)
+
+
+# ---------------------------------------------------------------------------
+# Optimality contract, verified against brute force over all C(8,k)
+# subsets (stronger than the reference's argmin-over-candidates,
+# besteffort_policy.go:133-150): when a contiguous box covering the
+# request exists it takes strict priority (only a real sub-mesh gives the
+# workload ICI collectives — an L-shape can score lower on raw pairwise
+# weight but is the worse grant); when no box exists, the pick must match
+# the true pairwise-weight optimum.
+# ---------------------------------------------------------------------------
+
+class TestV5e8BruteForceOptimality:
+    @pytest.fixture(autouse=True)
+    def _setup(self, testdata):
+        self.policy, self.devs = make_policy(testdata, "v5e-8")
+        self.all_ids = [d.id for d in self.devs]
+        self.model = self.policy._model
+
+    def expected_weight(self, ids, size):
+        import itertools
+        boxes = self.policy._submesh_candidates(
+            size, frozenset(ids), frozenset()
+        )
+        if boxes:
+            return min(
+                self.model.set_weight([d.id for d in b]) for b in boxes
+            )
+        return min(
+            self.model.set_weight(c)
+            for c in itertools.combinations(ids, size)
+        )
+
+    @pytest.mark.parametrize("size", range(1, 9))
+    def test_full_availability(self, size):
+        got = self.policy.allocate(self.all_ids, [], size)
+        assert len(got) == size
+        assert self.model.set_weight(got) == self.expected_weight(
+            self.all_ids, size
+        )
+
+    @pytest.mark.parametrize("size", range(1, 6))
+    def test_fragmented_availability(self, size):
+        # chips 1 and 6 taken: holes at (1,0) and (0,3)
+        avail = [i for i in self.all_ids if i not in (addr(1), addr(6))]
+        got = self.policy.allocate(avail, [], size)
+        assert len(got) == size
+        assert set(got) <= set(avail)
+        assert self.model.set_weight(got) == self.expected_weight(avail, size)
+
+
+# ---------------------------------------------------------------------------
+# Torus wrap (v4/v5p-style): opposite grid edges are ICI neighbours
+# ---------------------------------------------------------------------------
+
+class TestTorusWrap:
+    @pytest.fixture(autouse=True)
+    def _setup(self):
+        from tpu_k8s_device_plugin.allocator.device import AllocDevice
+        from tpu_k8s_device_plugin.tpu.topology import IciTopology
+
+        # one host row of a 4x1 torus ring: x wraps, so chip 0 and chip 3
+        # are 1 hop apart
+        self.topo = IciTopology(
+            chips_per_host_bounds=(4, 1, 1),
+            host_bounds=(1, 1, 1),
+            wrap=(True, False, False),
+        )
+        self.devs = [
+            AllocDevice(id=f"c{i}", parent_id=f"c{i}", chip_index=i,
+                        coords=(i, 0, 0))
+            for i in range(4)
+        ]
+        self.policy = BestEffortPolicy()
+        self.policy.init(self.devs, self.topo)
+
+    def test_wrap_edge_is_one_hop(self):
+        assert self.topo.ici_distance(0, 3) == 1
+        assert self.topo.ici_distance(0, 2) == 2
+
+    def test_pair_across_the_seam(self):
+        # only chips 0 and 3 plus the distant 1 available: the seam pair
+        # (1 hop via wrap) must beat 0+1? (0,3 wrap=1 hop; 0,1 not avail)
+        got = self.policy.allocate(["c0", "c2", "c3"], [], 2)
+        assert sorted(got) == ["c0", "c3"]
+
+    def test_required_uses_wrap_neighbor(self):
+        got = self.policy.allocate(["c0", "c1", "c3"], ["c3"], 2)
+        # c3's wrap neighbour c0 ties with linear neighbour... c3-c0 is
+        # 1 hop (wrap) and c3-c1 is 2 hops: c0 must win
+        assert sorted(got) == ["c0", "c3"]

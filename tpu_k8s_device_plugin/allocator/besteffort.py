@@ -120,14 +120,22 @@ class BestEffortPolicy(Policy):
     # -- candidate generators ----------------------------------------------
 
     def _submesh_candidates(self, size, available, required):
-        if self._topology is None:
+        topo = self._topology
+        if topo is None:
             return []
+        # slice wraparound reaches the local grid only on axes this host
+        # spans entirely (host_bounds 1): otherwise the seam is between
+        # hosts, not between our local edge chips
+        wrap = tuple(
+            topo.wrap[i] and topo.host_bounds[i] == 1 for i in range(3)
+        )
         return enumerate_submesh_candidates(
             self._by_coord,
-            self._topology.chips_per_host_bounds,
+            topo.chips_per_host_bounds,
             size,
             available,
             required,
+            wrap=wrap,
         )
 
     def _fill_candidates(self, size, available, required):

@@ -141,6 +141,7 @@ def enumerate_submesh_candidates(
     size: int,
     available: frozenset,
     required: frozenset,
+    wrap: Tuple[bool, bool, bool] = (False, False, False),
 ) -> List[List[AllocDevice]]:
     """All axis-aligned boxes on the chip grid whose devices exactly cover
     *size*, are fully available, and contain every required device.
@@ -149,7 +150,9 @@ def enumerate_submesh_candidates(
     combine (device.go:405-440): on an ICI grid only contiguous rectangles
     minimise collective latency, and there are only O(X²Y²Z²) of them —
     SURVEY.md §7 "hard parts" notes the sub-mesh constraint shrinks the
-    search space; exploit it.
+    search space; exploit it.  On torus axes (v4/v5p) boxes may cross the
+    wraparound seam: a segment spanning the edge is just as contiguous in
+    ICI terms as an interior one.
     """
     out: List[List[AllocDevice]] = []
     per_chip = 0
@@ -159,16 +162,29 @@ def enumerate_submesh_candidates(
         return out
     target_chips = size // per_chip
     X, Y, Z = (max(b, 1) for b in bounds)
+
+    def origins(extent: int, length: int, wraps: bool) -> range:
+        # full-axis boxes have one distinct placement; wrap axes slide the
+        # origin all the way around, others stop at the edge
+        if length == extent:
+            return range(1)
+        return range(extent) if wraps else range(extent - length + 1)
+
     for w, h, d in _box_shapes(target_chips, (X, Y, Z)):
-        for x0 in range(X - w + 1):
-            for y0 in range(Y - h + 1):
-                for z0 in range(Z - d + 1):
+        for x0 in origins(X, w, wrap[0]):
+            for y0 in origins(Y, h, wrap[1]):
+                for z0 in origins(Z, d, wrap[2]):
                     chosen: List[AllocDevice] = []
                     ok = True
-                    for x in range(x0, x0 + w):
-                        for y in range(y0, y0 + h):
-                            for z in range(z0, z0 + d):
-                                devs = devices_by_coord.get((x, y, z), [])
+                    for dx in range(w):
+                        for dy in range(h):
+                            for dz in range(d):
+                                coord = (
+                                    (x0 + dx) % X,
+                                    (y0 + dy) % Y,
+                                    (z0 + dz) % Z,
+                                )
+                                devs = devices_by_coord.get(coord, [])
                                 if len(devs) != per_chip or any(
                                     dev.id not in available for dev in devs
                                 ):
