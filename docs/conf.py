@@ -1,0 +1,11 @@
+# Sphinx configuration (≈ the reference's docs/conf.py ReadTheDocs setup).
+project = "k8s-tpu-device-plugin"
+author = "k8s-tpu-device-plugin contributors"
+copyright = "2026, " + author
+
+extensions = ["myst_parser"]
+source_suffix = {".md": "markdown", ".rst": "restructuredtext"}
+master_doc = "index"
+
+html_theme = "sphinx_rtd_theme"
+exclude_patterns = ["_build"]

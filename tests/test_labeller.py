@@ -55,6 +55,17 @@ class TestGenerators:
             if key.startswith(base + "."):
                 assert labels[key.replace(base, beta, 1)] == val
 
+    def test_multi_host_slice_identity(self, testdata):
+        """Worker 0 of a 2-host v5e-16: the scheduler-facing slice shape
+        must be the global topology, not the local grid."""
+        labels = generate_labels(ctx_for(testdata, "v5e-16-host0"))
+        base = constants.LABEL_PREFIX
+        assert labels[f"{base}.accelerator-type"] == "v5litepod-16"
+        assert labels[f"{base}.topology"] == "4x4"
+        assert labels[f"{base}.chips-per-host"] == "8"
+        assert labels[f"{base}.worker-id"] == "0"
+        assert labels[f"{base}.num-workers"] == "2"
+
     def test_v5p_partitioned_host(self, testdata):
         labels = generate_labels(ctx_for(testdata, "v5p-8-core"))
         base = constants.LABEL_PREFIX
