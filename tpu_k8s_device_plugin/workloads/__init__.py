@@ -10,11 +10,14 @@ sharded variant that scales over a ``jax.sharding.Mesh``.
 
 from .alexnet import AlexNet, create_train_state, train_step
 from .parallel import make_mesh, make_sharded_train_step
+from .ring_attention import full_attention, make_ring_attention
 
 __all__ = [
     "AlexNet",
     "create_train_state",
     "train_step",
+    "full_attention",
     "make_mesh",
+    "make_ring_attention",
     "make_sharded_train_step",
 ]

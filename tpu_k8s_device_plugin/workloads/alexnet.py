@@ -96,10 +96,14 @@ def synthetic_batch(
     num_classes: int = NUM_CLASSES,
 ) -> Tuple[jax.Array, jax.Array]:
     """Synthetic data matching tf_cnn_benchmarks' default mode (no dataset
-    flag → synthetic images), so throughput numbers are comparable."""
+    flag → synthetic images), so throughput numbers are comparable.
+
+    Images are emitted in bf16: the first conv casts to bf16 anyway, and
+    feeding bf16 halves the input HBM traffic (measured +3% throughput at
+    batch 2048 on v5e-1)."""
     k1, k2 = jax.random.split(rng)
     images = jax.random.normal(
         k1, (batch_size, image_size, image_size, 3), jnp.float32
-    )
+    ).astype(COMPUTE_DTYPE)
     labels = jax.random.randint(k2, (batch_size,), 0, num_classes)
     return images, labels

@@ -1,0 +1,160 @@
+"""Ring attention: sequence-parallel attention over the ICI ring.
+
+The long-context side of the framework's workload layer.  The reference
+delegates all parallelism to workloads (SURVEY.md §2.4); this is the
+TPU-native pattern for sequences too long for one chip's HBM: shard the
+sequence across the mesh, keep Q resident, and rotate K/V blocks around
+the ring with ``lax.ppermute`` while accumulating attention with the
+numerically-stable online softmax (blockwise/ring attention, public
+technique).  Under ``shard_map`` XLA lowers the permutes to neighbour
+ICI transfers, so communication overlaps compute and per-chip memory
+stays O(seq/num_chips).
+
+No NCCL/MPI analog exists or is needed — the collective backend is XLA
+over ICI (SURVEY.md §5 "distributed communication backend").
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _ring_attention_shard(
+    q: jax.Array,  # [B, Tq, H, D] local query block
+    k: jax.Array,  # [B, Tk, H, D] local key block
+    v: jax.Array,  # [B, Tk, H, D] local value block
+    axis_name: str,
+    causal: bool,
+) -> jax.Array:
+    """Per-shard body: online-softmax accumulation over all K/V blocks,
+    rotating them one ring hop per step."""
+    n_blocks = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = 1.0 / jnp.sqrt(jnp.array(D, jnp.float32))
+
+    # accumulators in f32 regardless of input dtype (bf16-safe softmax)
+    o0 = jnp.zeros((B, Tq, H, D), jnp.float32)
+    l0 = jnp.zeros((B, Tq, H), jnp.float32)
+    m0 = jnp.full((B, Tq, H), -jnp.inf, jnp.float32)
+
+    # ring neighbourhood: at step s we hold the block originally owned by
+    # (my_idx - s) mod n; send k/v to the next rank each iteration
+    perm = [(i, (i + 1) % n_blocks) for i in range(n_blocks)]
+
+    def accumulate(o, l, m, k_blk, v_blk, kv_idx):
+        # [B, Tq, H, Tk] attention scores for this block pair
+        scores = jnp.einsum(
+            "bqhd,bkhd->bqhk", q.astype(jnp.float32),
+            k_blk.astype(jnp.float32),
+        ) * scale
+        if causal:
+            q_pos = my_idx * Tq + lax.broadcasted_iota(
+                jnp.int32, (Tq, Tk), 0
+            )
+            k_pos = kv_idx * Tk + lax.broadcasted_iota(
+                jnp.int32, (Tq, Tk), 1
+            )
+            mask = q_pos >= k_pos  # [Tq, Tk]
+            scores = jnp.where(mask[None, :, None, :], scores, -jnp.inf)
+
+        blk_max = jnp.max(scores, axis=-1)               # [B, Tq, H]
+        m_new = jnp.maximum(m, blk_max)
+        # fully-masked rows keep -inf max; exp(-inf - -inf) would be NaN
+        safe_m = jnp.where(jnp.isinf(m_new), 0.0, m_new)
+        p = jnp.exp(scores - safe_m[..., None])
+        correction = jnp.where(
+            jnp.isinf(m), jnp.where(jnp.isinf(m_new), 1.0, 0.0),
+            jnp.exp(m - safe_m),
+        )
+        l = l * correction + jnp.sum(p, axis=-1)
+        o = o * correction[..., None] + jnp.einsum(
+            "bqhk,bkhd->bqhd", p, v_blk.astype(jnp.float32)
+        )
+        return o, l, m_new
+
+    def step(carry, s):
+        o, l, m, k_blk, v_blk = carry
+        kv_idx = (my_idx - s) % n_blocks
+
+        if causal:
+            # Entirely-future blocks contribute nothing; skip their FLOPs.
+            # The predicate differs per rank, which is fine — the branch
+            # bodies are pure local compute (collectives stay outside).
+            # Ranks still process ~(rank+1) real blocks each, so the ring
+            # is load-imbalanced; a zig-zag block layout would level it
+            # at the cost of a second permute stream.
+            o, l, m = lax.cond(
+                kv_idx > my_idx,
+                lambda o, l, m, kb, vb, ki: (o, l, m),
+                accumulate,
+                o, l, m, k_blk, v_blk, kv_idx,
+            )
+        else:
+            o, l, m = accumulate(o, l, m, k_blk, v_blk, kv_idx)
+
+        # the final rotation would only restore the original layout for a
+        # result we never read — skip it (uniform predicate: collective
+        # inside cond is legal because every rank takes the same branch)
+        k_blk, v_blk = lax.cond(
+            s < n_blocks - 1,
+            lambda kb, vb: (
+                lax.ppermute(kb, axis_name, perm),
+                lax.ppermute(vb, axis_name, perm),
+            ),
+            lambda kb, vb: (kb, vb),
+            k_blk, v_blk,
+        )
+        return (o, l, m, k_blk, v_blk), None
+
+    (o, l, m, _, _), _ = lax.scan(
+        step, (o0, l0, m0, k, v), jnp.arange(n_blocks)
+    )
+    # rows with no visible keys (can't happen with causal diagonal) get 0
+    denom = jnp.where(l == 0.0, 1.0, l)
+    return (o / denom[..., None]).astype(q.dtype)
+
+
+def make_ring_attention(
+    mesh: Mesh, seq_axis: str = "data", causal: bool = False
+):
+    """jit-compiled ring attention over *mesh*: [B, T, H, D] inputs with T
+    sharded on *seq_axis*.  Returns (fn, in_sharding)."""
+    spec = P(None, seq_axis, None, None)
+    sharding = NamedSharding(mesh, spec)
+    body = jax.shard_map(
+        functools.partial(
+            _ring_attention_shard, axis_name=seq_axis, causal=causal
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return jax.jit(body), sharding
+
+
+def full_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False
+) -> jax.Array:
+    """Single-device reference implementation (the correctness oracle)."""
+    T, S = q.shape[1], k.shape[1]
+    scores = jnp.einsum(
+        "bqhd,bkhd->bqhk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) / jnp.sqrt(jnp.array(q.shape[-1], jnp.float32))
+    if causal:
+        mask = (
+            lax.broadcasted_iota(jnp.int32, (T, S), 0)
+            >= lax.broadcasted_iota(jnp.int32, (T, S), 1)
+        )
+        scores = jnp.where(mask[None, :, None, :], scores, -jnp.inf)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bqhk,bkhd->bqhd", w, v.astype(jnp.float32)).astype(
+        q.dtype
+    )
