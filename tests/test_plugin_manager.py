@@ -126,23 +126,29 @@ def test_kubelet_restart_triggers_reregistration(kubelet, manager):
     assert len(kubelet.registrations) == 2
 
 
+def assert_wipe_restart_recovers(kubelet, n_devices=8):
+    """Wipe-restart the kubelet, then assert the plugin re-registers,
+    re-creates its endpoint socket, and answers ListAndWatch."""
+    kubelet.register_event.clear()
+    kubelet.restart(wipe_dir=True)
+    assert kubelet.wait_for_registration(timeout=10.0)
+    sock = os.path.join(kubelet.dir, "google.com_tpu")
+    deadline = time.time() + 5.0
+    while not os.path.exists(sock) and time.time() < deadline:
+        time.sleep(0.05)
+    assert os.path.exists(sock)
+    stub = kubelet.plugin_stub("google.com_tpu")
+    devs = next(iter(stub.ListAndWatch(pluginapi.Empty()))).devices
+    assert len(devs) == n_devices
+
+
 def test_kubelet_restart_wiping_dp_dir_reserves_sockets(kubelet, manager):
     """Real kubelet clears the device-plugin dir on startup; the plugin must
     re-create its endpoint socket before re-registering, or the kubelet's
     dial to the advertised endpoint fails and capacity drops to 0."""
     assert kubelet.wait_for_registration()
-    sock = os.path.join(kubelet.dir, "google.com_tpu")
-    assert os.path.exists(sock)
-    kubelet.restart(wipe_dir=True)
-    assert kubelet.wait_for_registration(timeout=10.0)
-    deadline = time.time() + 5.0
-    while not os.path.exists(sock) and time.time() < deadline:
-        time.sleep(0.05)
-    assert os.path.exists(sock)
-    # and the re-served endpoint actually answers
-    stub = kubelet.plugin_stub("google.com_tpu")
-    devs = next(iter(stub.ListAndWatch(pluginapi.Empty()))).devices
-    assert len(devs) == 8
+    assert os.path.exists(os.path.join(kubelet.dir, "google.com_tpu"))
+    assert_wipe_restart_recovers(kubelet)
 
 
 def test_resource_diffing_stops_removed_plugins(kubelet, manager):
@@ -163,6 +169,56 @@ def test_stop_removes_sockets(kubelet, impl):
     assert os.path.exists(sock)
     m.stop()
     assert not os.path.exists(sock)
+
+
+def test_concurrent_lifecycle_stress(kubelet, impl):
+    """Race-detector analog (SURVEY §5: the reference never runs -race;
+    its concurrent surface is the plugin map + channels).  Hammer the
+    manager's three mutating surfaces — resource diffing, kubelet
+    restarts, pulse beats — from concurrent threads and assert the
+    manager ends consistent and serving."""
+    import threading
+
+    m = PluginManager(
+        impl, pulse_seconds=1, kubelet_dir=kubelet.dir,
+        kubelet_watch_interval_s=0.05,
+    )
+    try:
+        m.run(block=False)
+        assert kubelet.wait_for_registration()
+        errors = []
+
+        def diff_loop():
+            try:
+                for _ in range(10):
+                    m.update_resources([])
+                    m.update_resources(["tpu"])
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        def restart_loop():
+            try:
+                for _ in range(5):
+                    kubelet.restart(wipe_dir=True)
+                    time.sleep(0.05)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=diff_loop),
+            threading.Thread(target=restart_loop),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive()
+        assert not errors, errors
+        # watch thread must still be alive (no dict-changed-during-
+        # iteration death) and the endpoint must end up served + answering
+        assert_wipe_restart_recovers(kubelet)
+    finally:
+        m.stop()
 
 
 def test_registration_survives_kubelet_downtime(impl, tmp_path):
