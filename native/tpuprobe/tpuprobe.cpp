@@ -30,7 +30,7 @@
 
 #define TP_API extern "C" __attribute__((visibility("default")))
 
-static const char kVersion[] = "tpuprobe 1.0.0";
+static const char kVersion[] = "tpuprobe 1.1.0";
 
 TP_API const char* tp_version(void) { return kVersion; }
 
@@ -62,17 +62,31 @@ TP_API tp_watch* tp_watch_create(const char* dir) {
 
 // Blocks up to timeout_ms for a filesystem event in the watched dir.
 // Returns 1 if at least one event arrived, 0 on timeout, -errno on error.
+// A deleted-and-recreated watch directory delivers IN_IGNORED /
+// IN_DELETE_SELF and then goes silent forever; surface that as -ESTALE so
+// the caller re-creates the watch (or falls back to polling) instead of
+// believing it still has an event-driven watch.
 TP_API int tp_watch_wait(tp_watch* w, int timeout_ms) {
   if (!w) return -EINVAL;
   struct pollfd pfd = {w->ifd, POLLIN, 0};
   int rc = poll(&pfd, 1, timeout_ms);
   if (rc < 0) return -errno;
   if (rc == 0) return 0;
-  // drain the queue; the caller re-stats the socket regardless
-  char buf[4096];
-  while (read(w->ifd, buf, sizeof buf) > 0) {
+  // drain the queue, scanning for watch-death events; the caller re-stats
+  // the socket regardless, so individual event payloads are not returned
+  char buf[4096] __attribute__((aligned(8)));
+  bool stale = false;
+  ssize_t n;
+  while ((n = read(w->ifd, buf, sizeof buf)) > 0) {
+    for (ssize_t off = 0; off + (ssize_t)sizeof(inotify_event) <= n;) {
+      const inotify_event* ev =
+          reinterpret_cast<const inotify_event*>(buf + off);
+      if (ev->mask & (IN_IGNORED | IN_DELETE_SELF | IN_MOVE_SELF | IN_UNMOUNT))
+        stale = true;
+      off += sizeof(inotify_event) + ev->len;
+    }
   }
-  return 1;
+  return stale ? -ESTALE : 1;
 }
 
 TP_API void tp_watch_destroy(tp_watch* w) {
@@ -86,19 +100,23 @@ TP_API void tp_watch_destroy(tp_watch* w) {
 // device-node probe
 // ---------------------------------------------------------------------------
 
-// Probes a TPU device node the way a workload would consume it: stat that
-// it is a character device, then open it read-write without blocking.
-// Returns 0 when healthy, -errno on the first failing step.  O_NONBLOCK
-// keeps the probe non-exclusive -- it must never steal the chip from a
-// running workload (SURVEY.md section 7, "health without privileged
-// /dev/kfd").
+// Probes that a TPU device node exists as a character device.  Returns 0
+// when present, -errno on stat failure, -ENOTSUP when the path exists but
+// is not a chardev (reserved so callers can tell fixture trees — regular
+// files — apart from real errors).
+//
+// Deliberately stat-only, no open(2): the TPU accel driver enforces a
+// single-open policy, so an open-based probe (a) reports -EBUSY for every
+// chip a workload is actively using — health flapping on each pulse — and
+// (b) can itself win the race against a launching workload's open and make
+// the *workload* fail with EBUSY.  Granular wedged-chip state comes from
+// the driver's sysfs attributes (chip_state / uncorrectable_errors, read
+// by health/server.py) instead, which sees strictly more than an open
+// probe could (SURVEY.md section 7, "health without privileged /dev/kfd").
 TP_API int tp_probe_device(const char* path) {
   struct stat st;
   if (stat(path, &st) != 0) return -errno;
-  if (!S_ISCHR(st.st_mode)) return -ENODEV;
-  int fd = open(path, O_RDWR | O_NONBLOCK | O_CLOEXEC);
-  if (fd < 0) return -errno;
-  close(fd);
+  if (!S_ISCHR(st.st_mode)) return -ENOTSUP;
   return 0;
 }
 

@@ -56,10 +56,15 @@ def make_host(
         numa = (i >= n_chips // 2) if numa_split and n_chips > 1 else 0
         w(os.path.join(pci_dir, "numa_node"), str(int(numa)))
         w(os.path.join(pci_dir, "firmware_version"), firmware)
+        # per-chip driver health attrs (the granular state the exporter's
+        # probe reads; a wedged chip flips chip_state / bumps the UE count
+        # while its chardev still opens fine)
+        w(os.path.join(pci_dir, "chip_state"), "alive")
+        w(os.path.join(pci_dir, "uncorrectable_errors"), "0")
         # iommu group per chip
         group = str(8 + i)
-        os.makedirs(os.path.join(sys_root, "kernel", "iommu_groups", group),
-                    exist_ok=True)
+        w(os.path.join(sys_root, "kernel", "iommu_groups", group, "type"),
+          "DMA")
         ln(os.path.join(pci_dir, "iommu_group"),
            f"../../../kernel/iommu_groups/{group}")
         # bus/pci/devices entry
@@ -87,8 +92,8 @@ def make_host(
             w(os.path.join(vf_real, "vendor"), "0x1ae0")
             w(os.path.join(vf_real, "device"), device_id)
             vf_group = str(100 + i * 8 + vf)
-            os.makedirs(os.path.join(sys_root, "kernel", "iommu_groups",
-                                     vf_group), exist_ok=True)
+            w(os.path.join(sys_root, "kernel", "iommu_groups", vf_group,
+                           "type"), "DMA")
             ln(os.path.join(vf_real, "iommu_group"),
                f"../../../kernel/iommu_groups/{vf_group}")
             ln(os.path.join(sys_root, "bus", "pci", "devices", vf_addr),

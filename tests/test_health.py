@@ -1,8 +1,13 @@
 """Health subsystem tests: probe server + client over a unix socket."""
 
+import errno
 import os
+import shutil
+
+import pytest
 
 from tpu_k8s_device_plugin.health import TpuHealthServer, get_tpu_health
+from tpu_k8s_device_plugin.health import server as health_server
 from tpu_k8s_device_plugin.health.server import probe_chip_states
 from tpu_k8s_device_plugin.types import constants
 
@@ -10,6 +15,15 @@ from tpu_k8s_device_plugin.types import constants
 def roots(testdata, name):
     root = os.path.join(testdata, name)
     return os.path.join(root, "sys"), os.path.join(root, "dev")
+
+
+@pytest.fixture
+def v5e8_copy(testdata, tmp_path):
+    """Mutable copy of the v5e-8 tree (symlinks preserved — they're
+    relative, so the copied sysfs stays internally consistent)."""
+    dst = tmp_path / "v5e-8"
+    shutil.copytree(os.path.join(testdata, "v5e-8"), dst, symlinks=True)
+    return str(dst)
 
 
 def test_probe_chip_states(testdata):
@@ -26,6 +40,82 @@ def test_probe_detects_missing_dev_node(testdata, tmp_path):
     # empty dev root: every chip's node is missing -> Unhealthy
     states = probe_chip_states(sys_root, str(tmp_path))
     assert all(s.health == "Unhealthy" for s in states.values())
+
+
+def test_probe_detects_wedged_chip_via_sysfs_state(v5e8_copy):
+    """A chip whose chardev still opens but whose driver reports it dead
+    must go Unhealthy — the state open(2) can't see (VERDICT 'health probe
+    depth')."""
+    attr = os.path.join(
+        v5e8_copy, "sys", "devices", "pci0000:00", "0000:00:06.0",
+        constants.SYSFS_CHIP_STATE,
+    )
+    with open(attr, "w") as f:
+        f.write("dead\n")
+    states = probe_chip_states(
+        os.path.join(v5e8_copy, "sys"), os.path.join(v5e8_copy, "dev")
+    )
+    assert states["0000:00:06.0"].health == "Unhealthy"
+    healthy = [s for s in states.values() if s.health == "Healthy"]
+    assert len(healthy) == 7
+
+
+def test_probe_detects_uncorrectable_errors(v5e8_copy):
+    attr = os.path.join(
+        v5e8_copy, "sys", "devices", "pci0000:00", "0000:00:09.0",
+        constants.SYSFS_UE_COUNT,
+    )
+    with open(attr, "w") as f:
+        f.write("3\n")
+    states = probe_chip_states(
+        os.path.join(v5e8_copy, "sys"), os.path.join(v5e8_copy, "dev")
+    )
+    assert states["0000:00:09.0"].health == "Unhealthy"
+    assert sum(s.health == "Healthy" for s in states.values()) == 7
+
+
+def test_missing_health_attrs_is_no_verdict(v5e8_copy):
+    """Older drivers expose neither attr: absence must not demote."""
+    for chip in range(8):
+        base = os.path.join(
+            v5e8_copy, "sys", "devices", "pci0000:00", f"0000:00:{4+chip:02x}.0"
+        )
+        os.remove(os.path.join(base, constants.SYSFS_CHIP_STATE))
+        os.remove(os.path.join(base, constants.SYSFS_UE_COUNT))
+    states = probe_chip_states(
+        os.path.join(v5e8_copy, "sys"), os.path.join(v5e8_copy, "dev")
+    )
+    assert all(s.health == "Healthy" for s in states.values())
+
+
+class TestNodeOpenableErrnoPolicy:
+    """ADVICE (high): the TPU accel driver is single-open — a busy chip
+    returns EBUSY from the probe's open(2) and MUST stay Healthy, or health
+    flaps on every pulse exactly when chips are in use."""
+
+    def _probe_with_rc(self, monkeypatch, rc):
+        class FakeProbe:
+            @staticmethod
+            def probe_device_node(path):
+                return rc
+        monkeypatch.setattr(health_server, "_tpuprobe", FakeProbe)
+        return health_server._node_openable("/dev/accel0")
+
+    def test_busy_chip_is_healthy(self, monkeypatch):
+        assert self._probe_with_rc(monkeypatch, -errno.EBUSY) is True
+
+    def test_permission_denied_is_healthy(self, monkeypatch):
+        # probe lacking privilege says nothing about the silicon
+        assert self._probe_with_rc(monkeypatch, -errno.EACCES) is True
+
+    @pytest.mark.parametrize(
+        "err", [errno.ENOENT, errno.ENXIO, errno.ENODEV, errno.EIO]
+    )
+    def test_gone_chip_is_unhealthy(self, monkeypatch, err):
+        assert self._probe_with_rc(monkeypatch, -err) is False
+
+    def test_openable_is_healthy(self, monkeypatch):
+        assert self._probe_with_rc(monkeypatch, 0) is True
 
 
 def test_client_server_roundtrip(testdata, tmp_path):

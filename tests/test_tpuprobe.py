@@ -6,6 +6,7 @@ full C ABI is covered: inotify watch semantics, the chardev probe's errno
 contract, and the NUMA sysfs read against fixtures.
 """
 
+import errno
 import os
 import threading
 import time
@@ -29,10 +30,12 @@ class TestProbeDevice:
     def test_missing_is_enoent(self):
         assert tpuprobe.probe_device_node("/nonexistent/accel0") == -2
 
-    def test_regular_file_is_enodev(self, tmp_path):
+    def test_regular_file_is_enotsup(self, tmp_path):
+        # -ENOTSUP is the reserved "exists but not a chardev" sentinel so
+        # callers can tell fixture trees from a driver-reported ENODEV
         p = tmp_path / "accel0"
         p.write_text("")
-        assert tpuprobe.probe_device_node(str(p)) == -19
+        assert tpuprobe.probe_device_node(str(p)) == -errno.ENOTSUP
 
 
 class TestNumaNode:
@@ -73,6 +76,21 @@ class TestDirWatcher:
     def test_missing_dir_raises(self):
         with pytest.raises(OSError):
             tpuprobe.DirWatcher("/nonexistent-dir-xyz")
+
+    def test_deleted_watch_dir_raises_estale(self, tmp_path):
+        """A deleted watch directory must surface as an error, not silent
+        timeouts — the manager needs to know its watch went poll-only so it
+        can re-create it (some kubelet restarts recreate the dp dir)."""
+        d = tmp_path / "device-plugins"
+        d.mkdir()
+        with tpuprobe.DirWatcher(str(d)) as w:
+            threading.Timer(0.1, d.rmdir).start()
+            with pytest.raises(OSError) as ei:
+                # first wait may return the IN_DELETE event batch as stale
+                # already; loop a bounded number of times to absorb timing
+                for _ in range(50):
+                    w.wait(0.2)
+            assert ei.value.errno == errno.ESTALE
 
     def test_closed_watcher_raises(self, tmp_path):
         w = tpuprobe.DirWatcher(str(tmp_path))

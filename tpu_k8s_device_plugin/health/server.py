@@ -3,9 +3,12 @@
 The reference only ships the *client* half (the AMD device-metrics-exporter
 is a separate project); this build provides a working server too, so the
 health path is testable end-to-end and deployable from one image.  The probe
-re-enumerates the accel class and verifies each chip's device node is
-openable — a libtpu-free check that doesn't steal chip access from running
-workloads (SURVEY §7 'health without privileged /dev/kfd': the probe must be
+re-enumerates the accel class, reads each chip's driver-reported health
+attributes from sysfs (chip_state / uncorrectable_errors — the wedged-chip
+state an open(2) could never see), and stat-checks the device node.  It
+never open(2)s the chardev: the TPU accel driver is single-open, so an open
+probe would flap busy chips Unhealthy and could race a launching workload's
+own open (SURVEY §7 'health without privileged /dev/kfd': the probe must be
 non-exclusive).
 """
 
@@ -23,7 +26,7 @@ from tpu_k8s_device_plugin.proto import (
     tpuhealth_pb2 as hpb,
     tpuhealth_pb2_grpc as hpb_grpc,
 )
-from tpu_k8s_device_plugin.tpu import discovery
+from tpu_k8s_device_plugin.tpu import discovery, sysfs
 from tpu_k8s_device_plugin.types import constants
 
 log = logging.getLogger(__name__)
@@ -38,23 +41,55 @@ except Exception as _e:  # no native shim / no toolchain: portable fallback
     )
 
 
+# Probe errnos that genuinely mean "the chip is gone or the driver is
+# broken".  Everything else is NOT a health verdict: -EBUSY would mean a
+# workload holds the single-open chardev (alive and consumed — demoting it
+# would drop allocatable capacity exactly when chips are busy and flap
+# health on every pulse); -EACCES/-EPERM mean the probe lacks privilege,
+# which says nothing about the silicon.  The native probe is stat-only and
+# can't see EBUSY at all, but the policy is encoded here so any future
+# probe mechanism inherits it.
+_DEMOTE_ERRNOS = frozenset({errno.ENOENT, errno.ENXIO, errno.ENODEV, errno.EIO})
+
+
 def _node_openable(path: str) -> bool:
-    """Is the device node consumable by a workload?  The native probe
-    actually opens the chardev (non-exclusive); access(2) can lie under
-    capability-based permission schemes."""
+    """Does the device node exist for a workload to consume?  Stat-only —
+    see tp_probe_device: an open(2) probe on the single-open TPU chardev
+    would flap busy chips and race workload launches."""
     if _tpuprobe is not None:
         rc = _tpuprobe.probe_device_node(path)
-        if rc != -errno.ENODEV:
-            return rc == 0
-        # not a chardev: captured fixture trees model /dev/accelN as
-        # regular files — fall through to the portable check
+        if rc != -errno.ENOTSUP:
+            return rc == 0 or -rc not in _DEMOTE_ERRNOS
+        # exists but not a chardev: captured fixture trees model /dev/accelN
+        # as regular files — fall through to the portable check
     return os.path.exists(path) and os.access(path, os.R_OK | os.W_OK)
+
+
+def _sysfs_chip_fault(sysfs_root: str, pci_address: str) -> Optional[str]:
+    """Granular driver-reported chip state from sysfs — the signal an
+    open(2) probe cannot see (a wedged chip whose chardev still opens).
+    Returns a human-readable fault reason, or None when healthy / the attrs
+    are absent (older drivers expose neither; absence is not a verdict)."""
+    pci_dir = os.path.join(sysfs_root, "bus", "pci", "devices", pci_address)
+    state = sysfs.read_file(os.path.join(pci_dir, constants.SYSFS_CHIP_STATE))
+    if state and state != constants.CHIP_STATE_ALIVE:
+        return f"chip_state={state}"
+    ue = sysfs.read_file(os.path.join(pci_dir, constants.SYSFS_UE_COUNT))
+    if ue:
+        try:
+            if int(ue) > 0:
+                return f"uncorrectable_errors={int(ue)}"
+        except ValueError:
+            log.warning("unparseable %s for %s: %r",
+                        constants.SYSFS_UE_COUNT, pci_address, ue)
+    return None
 
 
 def probe_chip_states(
     sysfs_root: str = "/sys", dev_root: str = "/dev"
 ) -> Dict[str, hpb.TpuState]:
-    """Probe every chip's presence + device-node accessibility."""
+    """Probe every chip: driver-reported sysfs state first (sees wedged
+    chips), then device-node accessibility (sees missing/broken nodes)."""
     states: Dict[str, hpb.TpuState] = {}
     chips, _ = discovery.get_tpu_chips(sysfs_root, dev_root, "/nonexistent")
     for chip in chips.values():
@@ -63,7 +98,12 @@ def probe_chip_states(
             # probe; reporting them Healthy would mask the plugin's own
             # node-health fallback, so leave them out of the map entirely
             continue
-        healthy = _node_openable(chip.dev_path)
+        fault = _sysfs_chip_fault(sysfs_root, chip.pci_address)
+        if fault is not None:
+            log.warning("chip %s unhealthy: %s", chip.id, fault)
+            healthy = False
+        else:
+            healthy = _node_openable(chip.dev_path)
         states[chip.id] = hpb.TpuState(
             id=chip.id,
             accel_index=chip.accel_index,

@@ -42,10 +42,27 @@ def _build() -> bool:
         return False
 
 
+def _stale() -> bool:
+    """True when the shared object is missing or older than its source."""
+    if not os.path.exists(_SO_PATH):
+        return True
+    try:
+        return os.path.getmtime(_SRC) > os.path.getmtime(_SO_PATH)
+    except OSError:
+        return False
+
+
 def _load() -> ctypes.CDLL:
     with _build_lock:
-        if not os.path.exists(_SO_PATH) and not _build():
-            raise ImportError("libtpuprobe.so unavailable and unbuildable")
+        if _stale() and not _build():
+            # Never load a shim older than its source: the errno contract
+            # (ENOTSUP sentinel, ESTALE watch death) is part of the ABI and
+            # callers hard-code it.  ImportError degrades callers to their
+            # portable Python fallbacks, which is strictly safer than
+            # mismatched native semantics.
+            raise ImportError(
+                "libtpuprobe.so is stale (or missing) and cannot be rebuilt"
+            )
     lib = ctypes.CDLL(_SO_PATH, use_errno=True)
     lib.tp_version.restype = ctypes.c_char_p
     lib.tp_watch_create.restype = ctypes.c_void_p
@@ -70,8 +87,10 @@ def version() -> str:
 
 
 def probe_device_node(path: str) -> int:
-    """0 when *path* is an openable character device, else -errno.
-    Non-exclusive (O_NONBLOCK): never steals the chip from a workload."""
+    """0 when *path* exists as a character device, -ENOTSUP when it exists
+    but isn't one (fixture trees), else -errno.  Stat-only — it never
+    open(2)s the single-open TPU chardev, so it cannot steal the chip from
+    (or race the launch of) a workload."""
     return _lib.tp_probe_device(path.encode())
 
 

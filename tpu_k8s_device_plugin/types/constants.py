@@ -102,6 +102,15 @@ DEVICE_TYPE_TPU_PF = "tpu_pf"
 # analog of MI300 partition-typed resources like cpx_nps1).
 DEVICE_TYPE_TPU_CORE = "tpucore"
 
+# Per-chip health attributes the TPU driver exposes in the chip's PCI sysfs
+# directory (the granular state an open(2) probe cannot see — a wedged chip
+# whose chardev still opens).  Modelled in the synthesized fixture trees
+# (testdata/make_fixtures.py); both files are optional on real hosts — a
+# missing attribute contributes no verdict.
+SYSFS_CHIP_STATE = "chip_state"             # "alive" when operational
+CHIP_STATE_ALIVE = "alive"
+SYSFS_UE_COUNT = "uncorrectable_errors"     # fatal (uncorrectable) error count
+
 # Exporter health check timeout, seconds (constants.go:92).
 EXPORTER_HEALTH_CHECK_TIMEOUT_S = 10.0
 
