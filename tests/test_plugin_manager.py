@@ -5,11 +5,15 @@ flow, ListAndWatch over the wire, Allocate responses, kubelet-restart
 re-registration, and resource-list diffing.
 """
 
+import functools
 import os
+import queue
+import shutil
 import time
 
 import pytest
 
+from tpu_k8s_device_plugin.health import TpuHealthServer, get_tpu_health
 from tpu_k8s_device_plugin.manager import PluginManager
 from tpu_k8s_device_plugin.proto import deviceplugin_pb2 as pluginapi
 from tpu_k8s_device_plugin.types import constants
@@ -219,6 +223,155 @@ def test_concurrent_lifecycle_stress(kubelet, impl):
         assert_wipe_restart_recovers(kubelet)
     finally:
         m.stop()
+
+
+def wait_for_frame(consumer, predicate, timeout=15.0):
+    """Drain ListAndWatch frames until one satisfies *predicate*."""
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            last = consumer.next_frame(timeout=max(0.1, deadline - time.time()))
+        except queue.Empty:
+            break
+        if predicate(last):
+            return last
+    raise AssertionError(f"no matching frame within {timeout}s; last: {last}")
+
+
+def test_health_transition_observed_over_wire(testdata, tmp_path, kubelet):
+    """The reference's core health loop, end-to-end (VERDICT r1 #1b /
+    BASELINE config #5): exporter daemon probes sysfs → pulse → plugin's
+    next ListAndWatch frame to the kubelet flips the device Unhealthy,
+    then back to Healthy on recovery — all over real gRPC sockets.
+    Matches plugin.go:146-170 + amdgpu.go:954-974 + exporter/health.go."""
+    tree = str(tmp_path / "v5e-8")
+    shutil.copytree(os.path.join(testdata, "v5e-8"), tree, symlinks=True)
+    sysr, devr = os.path.join(tree, "sys"), os.path.join(tree, "dev")
+    exporter_sock = str(tmp_path / "exporter.sock")
+    exporter = TpuHealthServer(exporter_sock, sysr, devr).start()
+    impl = TpuContainerImpl(
+        sysfs_root=sysr, dev_root=devr,
+        tpu_env_path=os.path.join(tree, "run", "tpu", "tpu-env"),
+        health_fn=functools.partial(get_tpu_health, exporter_sock),
+    )
+    m = PluginManager(impl, pulse_seconds=1, kubelet_dir=kubelet.dir,
+                      kubelet_watch_interval_s=0.1)
+    m.run(block=False)
+    sick = addr(3)
+    attr = os.path.join(sysr, "devices", "pci0000:00", sick,
+                        constants.SYSFS_CHIP_STATE)
+    try:
+        assert kubelet.wait_for_registration()
+        consumer = ListAndWatchConsumer(kubelet.plugin_stub("google.com_tpu"))
+        first = consumer.next_frame()
+        assert all(d.health == constants.HEALTHY for d in first.devices)
+
+        with open(attr, "w") as f:
+            f.write("dead\n")
+        frame = wait_for_frame(
+            consumer,
+            lambda fr: any(d.ID == sick and d.health == constants.UNHEALTHY
+                           for d in fr.devices),
+        )
+        # only the wedged chip is demoted — no collateral flapping
+        assert sum(d.health == constants.HEALTHY for d in frame.devices) == 7
+
+        with open(attr, "w") as f:
+            f.write("alive\n")
+        wait_for_frame(
+            consumer,
+            lambda fr: all(d.health == constants.HEALTHY for d in fr.devices),
+        )
+        consumer.cancel()
+    finally:
+        m.stop()
+        exporter.stop()
+
+
+def test_partition_mode_change_readvertised_without_restart(
+    testdata, tmp_path, kubelet
+):
+    """Runtime rediscovery e2e (VERDICT r1 #2): flipping the host's
+    partition mode re-advertises resources through the running manager —
+    no process restart — including the new resource's socket, registration,
+    and a working allocation path."""
+    tree = str(tmp_path / "v5p-8")
+    shutil.copytree(os.path.join(testdata, "v5p-8"), tree, symlinks=True)
+    env_path = os.path.join(tree, "run", "tpu", "tpu-env")
+    base_env = open(env_path).read()
+    impl = TpuContainerImpl(
+        resource_naming_strategy=constants.RESOURCE_NAMING_STRATEGY_MIXED,
+        sysfs_root=os.path.join(tree, "sys"),
+        dev_root=os.path.join(tree, "dev"),
+        tpu_env_path=env_path,
+    )
+    assert impl.get_resource_names() == ["tpu"]
+    m = PluginManager(impl, pulse_seconds=1, kubelet_dir=kubelet.dir,
+                      kubelet_watch_interval_s=0.1)
+    m.run(block=False)
+    try:
+        assert kubelet.wait_for_registration()
+        assert kubelet.registrations[-1].resource_name == "google.com/tpu"
+
+        with open(env_path, "w") as f:
+            f.write(base_env + "TPU_PARTITION_MODE: 'core'\n")
+
+        deadline = time.time() + 15.0
+        core_sock = os.path.join(kubelet.dir, "google.com_tpucore")
+        while time.time() < deadline and not os.path.exists(core_sock):
+            time.sleep(0.1)
+        assert os.path.exists(core_sock), "tpucore endpoint never served"
+        assert not os.path.exists(os.path.join(kubelet.dir, "google.com_tpu")), \
+            "stale tpu endpoint still served after mode change"
+        while time.time() < deadline and not any(
+            r.resource_name == "google.com/tpucore"
+            for r in kubelet.registrations
+        ):
+            time.sleep(0.1)
+        assert any(r.resource_name == "google.com/tpucore"
+                   for r in kubelet.registrations), "tpucore never registered"
+
+        # the new resource answers: 4 chips x 2 TensorCores = 8 devices
+        stub = kubelet.plugin_stub("google.com_tpucore")
+        devs = next(iter(stub.ListAndWatch(pluginapi.Empty()))).devices
+        assert len(devs) == 8
+        chosen = [devs[0].ID, devs[1].ID]
+        alloc = stub.Allocate(pluginapi.AllocateRequest(
+            container_requests=[
+                pluginapi.ContainerAllocateRequest(devices_ids=chosen)
+            ]
+        ))
+        car = alloc.container_responses[0]
+        assert "TPU_VISIBLE_CORES" in car.envs
+    finally:
+        m.stop()
+
+
+def test_rediscover_no_change_is_noop(impl):
+    assert impl.rediscover() is False
+
+
+def test_rediscover_device_count_change_single_strategy(testdata, tmp_path):
+    """Under single naming the resource name is stable but the device count
+    changes (4 whole chips -> 8 cores) — enumerate must follow."""
+    tree = str(tmp_path / "v5p-8")
+    shutil.copytree(os.path.join(testdata, "v5p-8"), tree, symlinks=True)
+    env_path = os.path.join(tree, "run", "tpu", "tpu-env")
+    impl = TpuContainerImpl(
+        sysfs_root=os.path.join(tree, "sys"),
+        dev_root=os.path.join(tree, "dev"),
+        tpu_env_path=env_path,
+    )
+    from tpu_k8s_device_plugin.types import DevicePluginContext
+    ctx = DevicePluginContext("tpu")
+    assert len(impl.enumerate(ctx)) == 4
+    with open(env_path, "a") as f:
+        f.write("TPU_PARTITION_MODE: 'core'\n")
+    assert impl.rediscover() is True
+    assert impl.get_resource_names() == ["tpu"]
+    assert len(impl.enumerate(ctx)) == 8
+    assert impl.rediscover() is False  # idempotent
 
 
 def test_registration_survives_kubelet_downtime(impl, tmp_path):

