@@ -12,6 +12,7 @@ import pytest
 
 from tpu_k8s_device_plugin.workloads.alexnet import (
     create_train_state,
+    space_to_depth,
     synthetic_batch,
     train_step,
 )
@@ -39,6 +40,58 @@ def test_alexnet_trains_single_device():
         losses.append(float(loss))
     assert all(jnp.isfinite(l) for l in losses)
     # same synthetic batch every step: loss must go down
+    assert losses[-1] < losses[0]
+
+
+def test_space_to_depth_conv_is_exact_oracle():
+    """The MXU-friendly formulation is the *same computation*: any
+    11x11/stride-4 conv equals a 3x3/stride-1 conv on the space-to-depth
+    input with the kernel taps rearranged (zero-padded 12x12 -> blocks).
+    Verified against lax.conv directly, f32, VALID padding on both sides
+    so the tap alignment is unambiguous."""
+    rng = jax.random.PRNGKey(7)
+    k1, k2 = jax.random.split(rng)
+    B, H, W, C, F = 2, 32, 32, 3, 5
+    x = jax.random.normal(k1, (B, H, W, C), jnp.float32)
+    w11 = jax.random.normal(k2, (11, 11, C, F), jnp.float32)
+
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w11.shape, ("NHWC", "HWIO", "NHWC")
+    )
+    ref = jax.lax.conv_general_dilated(
+        x, w11, window_strides=(4, 4), padding="VALID", dimension_numbers=dn
+    )
+
+    # rearrange: w3[ki,kj,(i4*4+j4)*C+c,f] = pad12(w11)[ki*4+i4, kj*4+j4, c, f]
+    w12 = jnp.pad(w11, ((0, 1), (0, 1), (0, 0), (0, 0)))
+    w3 = (
+        w12.reshape(3, 4, 3, 4, C, F)        # (ki, i4, kj, j4, c, f)
+        .transpose(0, 2, 1, 3, 4, 5)          # (ki, kj, i4, j4, c, f)
+        .reshape(3, 3, 16 * C, F)
+    )
+    xs = space_to_depth(x)
+    dn3 = jax.lax.conv_dimension_numbers(
+        xs.shape, w3.shape, ("NHWC", "HWIO", "NHWC")
+    )
+    got = jax.lax.conv_general_dilated(
+        xs, w3, window_strides=(1, 1), padding="VALID", dimension_numbers=dn3
+    )
+    assert ref.shape == got.shape == (B, 6, 6, F)
+    assert jnp.allclose(ref, got, atol=1e-4, rtol=1e-4)
+
+
+def test_alexnet_s2d_trains():
+    rng = jax.random.PRNGKey(0)
+    model, state = create_train_state(rng, batch_size=4, s2d=True, **TINY)
+    params, opt_state, tx = state["params"], state["opt_state"], state["tx"]
+    images, labels = synthetic_batch(rng, 4, s2d=True, **TINY)
+    assert images.shape == (4, 16, 16, 48)
+    step = jax.jit(functools.partial(train_step, model, tx))
+    losses = []
+    for _ in range(4):
+        params, opt_state, loss = step(params, opt_state, images, labels)
+        losses.append(float(loss))
+    assert all(jnp.isfinite(l) for l in losses)
     assert losses[-1] < losses[0]
 
 

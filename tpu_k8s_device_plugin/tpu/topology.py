@@ -30,20 +30,49 @@ class AcceleratorSpec:
     hbm_bytes_per_chip: int
     default_chips_per_host: Tuple[int, int, int]
     torus_3d: bool           # 3D torus ICI (v4/v5p) vs 2D mesh (v5e/v6e)
+    peak_bf16_flops: int = 0  # published per-chip bf16 peak (MFU denominator)
 
 
 _GIB = 1024 ** 3
+_TFLOPS = 10 ** 12
 
 # Keyed by the accelerator-type prefix used in ACCELERATOR_TYPE strings
-# (e.g. "v5litepod-8" → prefix "v5litepod").
+# (e.g. "v5litepod-8" → prefix "v5litepod").  Peak bf16 FLOP/s are the
+# published per-chip figures (v2/v3 predate bf16 marketing splits; their
+# listed peak is used).
 ACCELERATOR_SPECS: Dict[str, AcceleratorSpec] = {
-    "v2": AcceleratorSpec("v2", "TPU v2", 2, 8 * _GIB, (2, 2, 1), False),
-    "v3": AcceleratorSpec("v3", "TPU v3", 2, 16 * _GIB, (2, 2, 1), False),
-    "v4": AcceleratorSpec("v4", "TPU v4", 2, 32 * _GIB, (2, 2, 1), True),
-    "v5litepod": AcceleratorSpec("v5e", "TPU v5e", 1, 16 * _GIB, (2, 4, 1), False),
-    "v5p": AcceleratorSpec("v5p", "TPU v5p", 2, 95 * _GIB, (2, 2, 1), True),
-    "v6e": AcceleratorSpec("v6e", "TPU v6e (Trillium)", 1, 32 * _GIB, (2, 4, 1), False),
+    "v2": AcceleratorSpec("v2", "TPU v2", 2, 8 * _GIB, (2, 2, 1), False,
+                          45 * _TFLOPS),
+    "v3": AcceleratorSpec("v3", "TPU v3", 2, 16 * _GIB, (2, 2, 1), False,
+                          123 * _TFLOPS),
+    "v4": AcceleratorSpec("v4", "TPU v4", 2, 32 * _GIB, (2, 2, 1), True,
+                          275 * _TFLOPS),
+    "v5litepod": AcceleratorSpec("v5e", "TPU v5e", 1, 16 * _GIB, (2, 4, 1),
+                                 False, 197 * _TFLOPS),
+    "v5p": AcceleratorSpec("v5p", "TPU v5p", 2, 95 * _GIB, (2, 2, 1), True,
+                           459 * _TFLOPS),
+    "v6e": AcceleratorSpec("v6e", "TPU v6e (Trillium)", 1, 32 * _GIB,
+                           (2, 4, 1), False, 918 * _TFLOPS),
 }
+
+
+def spec_for_device_kind(device_kind: str) -> Optional[AcceleratorSpec]:
+    """Map a jax Device.device_kind string (e.g. "TPU v5 lite", "TPU v4")
+    onto the spec table — how the bench finds its MFU denominator on the
+    real chip, where no tpu-env fixture is in play."""
+    kind = device_kind.lower()
+    if "v5 lite" in kind or "v5e" in kind or "v5litepod" in kind:
+        return ACCELERATOR_SPECS["v5litepod"]
+    if "v6" in kind or "trillium" in kind:
+        return ACCELERATOR_SPECS["v6e"]
+    for prefix in ("v5p", "v4", "v3", "v2"):
+        if prefix in kind:
+            return ACCELERATOR_SPECS[prefix]
+    if "v5" in kind:
+        # libtpu reports plain "TPU v5" for v5p (the lite variant always
+        # carries "lite"); without this fallback v5p hosts get no MFU
+        return ACCELERATOR_SPECS["v5p"]
+    return None
 
 # PCI device id → accelerator-type prefix, for sysfs-only fallback when no
 # tpu-env metadata is present (≈ the reference's AMDGPU_FAMILY_* table read

@@ -33,22 +33,56 @@ def _timed_loop(step, params, opt_state, images, labels, batch, steps, warmup):
     return batch * steps / (time.perf_counter() - t0)
 
 
-def run_single(batch: int, steps: int, warmup: int) -> float:
+def run_single(
+    batch: int, steps: int, warmup: int, s2d: bool = True,
+    want_flops: bool = False,
+):
+    """Returns images/sec (and, with ``want_flops``, XLA's per-step FLOP
+    count for MFU accounting).  ``s2d`` is on by default: the
+    space-to-depth first conv is how this model should meet the MXU."""
     from .alexnet import create_train_state, synthetic_batch, train_step
 
     rng = jax.random.PRNGKey(0)
-    model, state = create_train_state(rng, batch_size=batch)
+    model, state = create_train_state(rng, batch_size=batch, s2d=s2d)
     params, opt_state, tx = state["params"], state["opt_state"], state["tx"]
-    images, labels = synthetic_batch(rng, batch)
+    images, labels = synthetic_batch(rng, batch, s2d=s2d)
     step = jax.jit(
         functools.partial(train_step, model, tx), donate_argnums=(0, 1)
     )
-    return _timed_loop(
+    flops = None
+    if want_flops:
+        flops, compiled = _step_flops(step, params, opt_state, images, labels)
+        if compiled is not None:
+            # reuse the AOT compilation for the timed loop: the jit
+            # dispatch cache doesn't share entries with lower().compile(),
+            # so timing through `step` would compile the model twice
+            step = compiled
+    ips = _timed_loop(
         step, params, opt_state, images, labels, batch, steps, warmup
     )
+    return (ips, flops) if want_flops else ips
 
 
-def run_sharded(batch: int, steps: int, warmup: int) -> float:
+def _step_flops(step, *args):
+    """(per-step FLOPs, compiled executable).  FLOPs as XLA's compiler
+    cost model counts them (the honest numerator for MFU — an analytic
+    count would drift from what actually runs).  (None, None) when the
+    backend doesn't expose AOT compilation / cost analysis."""
+    try:
+        compiled = step.lower(*args).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, list):  # older jax returns one dict per device
+            ca = ca[0] if ca else None
+        flops = ca.get("flops") if ca else None
+        return (
+            float(flops) if flops and flops > 0 else None,
+            compiled,
+        )
+    except Exception:
+        return None, None
+
+
+def run_sharded(batch: int, steps: int, warmup: int, s2d: bool = True) -> float:
     from .alexnet import create_train_state, synthetic_batch
     from .parallel import make_mesh, make_sharded_train_step
 
@@ -56,11 +90,11 @@ def run_sharded(batch: int, steps: int, warmup: int) -> float:
     # keep per-device batch constant so chips stay MXU-bound as we scale
     batch *= mesh.shape["data"]
     rng = jax.random.PRNGKey(0)
-    model, state = create_train_state(rng, batch_size=batch)
+    model, state = create_train_state(rng, batch_size=batch, s2d=s2d)
     step, params, opt_state, (img_sh, lbl_sh) = make_sharded_train_step(
         model, state["tx"], mesh, state["params"], state["opt_state"]
     )
-    images, labels = synthetic_batch(rng, batch)
+    images, labels = synthetic_batch(rng, batch, s2d=s2d)
     images = jax.device_put(images, img_sh)
     labels = jax.device_put(labels, lbl_sh)
     return _timed_loop(
