@@ -98,6 +98,26 @@ class TestContainerImpl:
         assert car.envs[constants.ENV_TPU_WORKER_ID] == "0"
         assert car.envs[constants.ENV_TPU_TOPOLOGY] == "4x4"
 
+    def test_allocate_full_host_propagates_worker1_identity(self, testdata):
+        """The second worker's full-host grant must carry TPU_WORKER_ID=1
+        and the same slice-global identity as worker 0 — libtpu derives
+        each process's slice offset from exactly this pair."""
+        impl = make_impl(testdata, "v5e-16-host1")
+        ctx = ctx_for(impl)
+        req = pluginapi.AllocateRequest(
+            container_requests=[
+                pluginapi.ContainerAllocateRequest(
+                    devices_ids=[addr(i) for i in range(8)]
+                )
+            ]
+        )
+        car = impl.allocate(ctx, req).container_responses[0]
+        assert car.envs[constants.ENV_TPU_ACCELERATOR_TYPE] == "v5litepod-16"
+        assert car.envs[constants.ENV_TPU_CHIPS_PER_HOST_BOUNDS] == "2,4,1"
+        assert car.envs[constants.ENV_TPU_PROCESS_BOUNDS] == "2,1,1"
+        assert car.envs[constants.ENV_TPU_WORKER_ID] == "1"
+        assert car.envs[constants.ENV_TPU_TOPOLOGY] == "4x4"
+
     def test_allocate_noncontiguous_bounds_degrade_linear(self, testdata):
         """Fragmented kubelet-default sets must not claim a bounding box
         whose volume exceeds the chip count."""

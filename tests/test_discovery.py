@@ -123,6 +123,20 @@ def test_get_tpu_chips_multihost_worker0(testdata):
     assert topo.global_chip_coords(7) == (1, 3, 0)
 
 
+def test_get_tpu_chips_multihost_worker1(testdata):
+    """Worker 1's chips sit at x in [2,4) of the global 4x4 mesh: local
+    coords match worker 0's, global coords carry the host offset."""
+    sys_root, env_path = fixture(testdata, "v5e-16-host1")
+    devs, topo = get_tpu_chips(sys_root, "/dev", env_path)
+    assert len(devs) == 8
+    assert topo.topology_str == "4x4"
+    assert topo.num_workers == 2 and topo.worker_id == 1
+    assert topo.global_chip_coords(0) == (2, 0, 0)
+    assert topo.global_chip_coords(7) == (3, 3, 0)
+    by_idx = sorted(devs.values(), key=lambda d: d.accel_index)
+    assert by_idx[0].coords == (0, 0, 0)  # local grid is worker-relative
+
+
 def test_get_tpu_chips_v5p_partitioning(testdata):
     sys_root, env_path = fixture(testdata, "v5p-8")
     devs, topo = get_tpu_chips(sys_root, "/dev", env_path)

@@ -66,6 +66,17 @@ class TestGenerators:
         assert labels[f"{base}.worker-id"] == "0"
         assert labels[f"{base}.num-workers"] == "2"
 
+    def test_multi_host_slice_identity_worker1(self, testdata):
+        """Worker 1 of the same slice must emit the SAME global topology —
+        the label is slice-scoped, not host-scoped — with its own id."""
+        labels = generate_labels(ctx_for(testdata, "v5e-16-host1"))
+        base = constants.LABEL_PREFIX
+        assert labels[f"{base}.accelerator-type"] == "v5litepod-16"
+        assert labels[f"{base}.topology"] == "4x4"
+        assert labels[f"{base}.chips-per-host"] == "8"
+        assert labels[f"{base}.worker-id"] == "1"
+        assert labels[f"{base}.num-workers"] == "2"
+
     def test_v5p_partitioned_host(self, testdata):
         labels = generate_labels(ctx_for(testdata, "v5p-8-core"))
         base = constants.LABEL_PREFIX
