@@ -10,7 +10,12 @@ sharded variant that scales over a ``jax.sharding.Mesh``.
 
 from .alexnet import AlexNet, create_train_state, train_step
 from .parallel import make_mesh, make_sharded_train_step
-from .ring_attention import full_attention, make_ring_attention
+from .ring_attention import (
+    full_attention,
+    make_ring_attention,
+    zigzag_permute,
+    zigzag_unpermute,
+)
 
 __all__ = [
     "AlexNet",
@@ -20,4 +25,6 @@ __all__ = [
     "make_mesh",
     "make_ring_attention",
     "make_sharded_train_step",
+    "zigzag_permute",
+    "zigzag_unpermute",
 ]

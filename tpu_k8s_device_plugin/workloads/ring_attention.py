@@ -20,8 +20,37 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _online_softmax_update(o, l, m, q_blk, k_blk, v_blk, scale, mask=None):
+    """One K/V block's numerically-stable online-softmax accumulation, in
+    f32.  *mask* is an optional [Tq, Tk] boolean of visible positions.
+    Fully-masked rows keep a -inf running max; the isinf-guarded
+    correction keeps exp(-inf - -inf) from producing NaN.  This is the
+    subtle part of ring attention — the single source of truth shared by
+    both the contiguous and zig-zag shard bodies."""
+    scores = jnp.einsum(
+        "bqhd,bkhd->bqhk", q_blk.astype(jnp.float32),
+        k_blk.astype(jnp.float32),
+    ) * scale
+    if mask is not None:
+        scores = jnp.where(mask[None, :, None, :], scores, -jnp.inf)
+    blk_max = jnp.max(scores, axis=-1)               # [B, Tq, H]
+    m_new = jnp.maximum(m, blk_max)
+    safe_m = jnp.where(jnp.isinf(m_new), 0.0, m_new)
+    p = jnp.exp(scores - safe_m[..., None])
+    correction = jnp.where(
+        jnp.isinf(m), jnp.where(jnp.isinf(m_new), 1.0, 0.0),
+        jnp.exp(m - safe_m),
+    )
+    l = l * correction + jnp.sum(p, axis=-1)
+    o = o * correction[..., None] + jnp.einsum(
+        "bqhk,bkhd->bqhd", p, v_blk.astype(jnp.float32)
+    )
+    return o, l, m_new
 
 
 def _ring_attention_shard(
@@ -49,11 +78,7 @@ def _ring_attention_shard(
     perm = [(i, (i + 1) % n_blocks) for i in range(n_blocks)]
 
     def accumulate(o, l, m, k_blk, v_blk, kv_idx):
-        # [B, Tq, H, Tk] attention scores for this block pair
-        scores = jnp.einsum(
-            "bqhd,bkhd->bqhk", q.astype(jnp.float32),
-            k_blk.astype(jnp.float32),
-        ) * scale
+        mask = None
         if causal:
             q_pos = my_idx * Tq + lax.broadcasted_iota(
                 jnp.int32, (Tq, Tk), 0
@@ -62,22 +87,7 @@ def _ring_attention_shard(
                 jnp.int32, (Tq, Tk), 1
             )
             mask = q_pos >= k_pos  # [Tq, Tk]
-            scores = jnp.where(mask[None, :, None, :], scores, -jnp.inf)
-
-        blk_max = jnp.max(scores, axis=-1)               # [B, Tq, H]
-        m_new = jnp.maximum(m, blk_max)
-        # fully-masked rows keep -inf max; exp(-inf - -inf) would be NaN
-        safe_m = jnp.where(jnp.isinf(m_new), 0.0, m_new)
-        p = jnp.exp(scores - safe_m[..., None])
-        correction = jnp.where(
-            jnp.isinf(m), jnp.where(jnp.isinf(m_new), 1.0, 0.0),
-            jnp.exp(m - safe_m),
-        )
-        l = l * correction + jnp.sum(p, axis=-1)
-        o = o * correction[..., None] + jnp.einsum(
-            "bqhk,bkhd->bqhd", p, v_blk.astype(jnp.float32)
-        )
-        return o, l, m_new
+        return _online_softmax_update(o, l, m, q, k_blk, v_blk, scale, mask)
 
     def step(carry, s):
         o, l, m, k_blk, v_blk = carry
@@ -87,9 +97,9 @@ def _ring_attention_shard(
             # Entirely-future blocks contribute nothing; skip their FLOPs.
             # The predicate differs per rank, which is fine — the branch
             # bodies are pure local compute (collectives stay outside).
-            # Ranks still process ~(rank+1) real blocks each, so the ring
-            # is load-imbalanced; a zig-zag block layout would level it
-            # at the cost of a second permute stream.
+            # Ranks still process ~(rank+1) real blocks each, so this
+            # layout is load-imbalanced under causal masking; use
+            # layout="zigzag" (below) for rank-uniform work.
             o, l, m = lax.cond(
                 kv_idx > my_idx,
                 lambda o, l, m, kb, vb, ki: (o, l, m),
@@ -121,17 +131,170 @@ def _ring_attention_shard(
     return (o / denom[..., None]).astype(q.dtype)
 
 
+# ---------------------------------------------------------------------------
+# Zig-zag layout: balanced causal ring attention
+#
+# With contiguous sequence chunks, causal masking makes rank r process ~r+1
+# real block-pairs per sweep — rank n-1 does n× rank 0's work and the ring's
+# step time is the worst rank's (the imbalance the contiguous path documents
+# below).  The zig-zag layout (public technique, a.k.a. zigzag ring / flash
+# attention) splits the sequence into 2n chunks and gives rank r chunks
+# {r, 2n-1-r}: every rank then owns one "early" and one "late" chunk, and
+# for any K/V block pair exactly half the quarter-interactions are causally
+# visible — per-step work becomes uniform (2 C×C score tiles per step, 3 on
+# the diagonal step, identical for every rank).
+# ---------------------------------------------------------------------------
+
+
+def zigzag_permute(x: jax.Array, n_shards: int, axis: int = 1) -> jax.Array:
+    """Reorder a contiguous sequence into zig-zag layout: chunk order
+    (0, 2n-1, 1, 2n-2, …) so an even split over n ranks gives rank r
+    chunks {r, 2n-1-r}.  Training loops keep tensors permuted end-to-end,
+    so this runs once at ingress, not per step."""
+    idx = _zigzag_indices(x.shape[axis], n_shards)
+    return jnp.take(x, jnp.asarray(idx), axis=axis)
+
+
+def zigzag_unpermute(x: jax.Array, n_shards: int, axis: int = 1) -> jax.Array:
+    """Inverse of zigzag_permute (egress back to natural token order)."""
+    fwd = _zigzag_indices(x.shape[axis], n_shards)
+    inv = np.empty_like(fwd)
+    inv[fwd] = np.arange(len(fwd))
+    return jnp.take(x, jnp.asarray(inv), axis=axis)
+
+
+def _zigzag_indices(T: int, n_shards: int) -> np.ndarray:
+    n_chunks = 2 * n_shards
+    if T % n_chunks:
+        raise ValueError(f"sequence length {T} not divisible by {n_chunks}")
+    C = T // n_chunks
+    order = []
+    for r in range(n_shards):
+        order.extend((r, n_chunks - 1 - r))
+    return np.concatenate([np.arange(c * C, (c + 1) * C) for c in order])
+
+
+def _ring_attention_shard_zigzag(
+    q: jax.Array,  # [B, Tq, H, D] local: [chunk i ; chunk 2n-1-i]
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+) -> jax.Array:
+    """Per-shard body for the causal zig-zag layout.  Each K/V rotation
+    step computes only the causally visible half of the local score tile:
+      holder i vs block owner j:
+        i < j : only q_hi attends (to all of k)        — 2 C×C tiles
+        i > j : both q halves attend k_lo only         — 2 C×C tiles
+        i == j: lo×lo diag, hi×lo full, hi×hi diag     — 3 C×C tiles
+    so per-step FLOPs are rank-uniform (vs ~(r+1)/n utilisation in the
+    contiguous layout)."""
+    n_blocks = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    C = Tq // 2
+    scale = 1.0 / jnp.sqrt(jnp.array(D, jnp.float32))
+    perm = [(i, (i + 1) % n_blocks) for i in range(n_blocks)]
+
+    def halves(x):
+        return x[:, :C], x[:, C:]
+
+    q_lo, q_hi = halves(q)
+
+    def acc_tile(o, l, m, q_blk, k_blk, v_blk, diag_mask):
+        """Online-softmax update of one (q half × k half/full) tile.
+        diag_mask=True applies the within-chunk causal diagonal (only ever
+        needed for equal-position chunks, where q and k positions align)."""
+        mask = None
+        if diag_mask:
+            Tq_b, Tk_b = q_blk.shape[1], k_blk.shape[1]
+            mask = (
+                lax.broadcasted_iota(jnp.int32, (Tq_b, Tk_b), 0)
+                >= lax.broadcasted_iota(jnp.int32, (Tq_b, Tk_b), 1)
+            )
+        return _online_softmax_update(
+            o, l, m, q_blk, k_blk, v_blk, scale, mask
+        )
+
+    def step(carry, s):
+        (lo, hi, k_blk, v_blk) = carry
+        j = (my_idx - s) % n_blocks
+        k_lo, k_hi = halves(k_blk)
+        v_lo, v_hi = halves(v_blk)
+
+        def on_lt(lo, hi):  # i < j: only the late half attends, unmasked
+            o, l, m = acc_tile(*hi, q_hi, k_blk, v_blk, diag_mask=False)
+            return lo, (o, l, m)
+
+        def on_gt(lo, hi):  # i > j: both halves attend the early K half
+            lo = acc_tile(*lo, q_lo, k_lo, v_lo, diag_mask=False)
+            hi = acc_tile(*hi, q_hi, k_lo, v_lo, diag_mask=False)
+            return lo, hi
+
+        def on_eq(lo, hi):  # diagonal step
+            lo = acc_tile(*lo, q_lo, k_lo, v_lo, diag_mask=True)
+            hi = acc_tile(*hi, q_hi, k_lo, v_lo, diag_mask=False)
+            hi = acc_tile(*hi, q_hi, k_hi, v_hi, diag_mask=True)
+            return lo, hi
+
+        branch = jnp.where(j == my_idx, 0, jnp.where(my_idx < j, 1, 2))
+        lo, hi = lax.switch(branch, (on_eq, on_lt, on_gt), lo, hi)
+
+        k_blk, v_blk = lax.cond(
+            s < n_blocks - 1,
+            lambda kb, vb: (
+                lax.ppermute(kb, axis_name, perm),
+                lax.ppermute(vb, axis_name, perm),
+            ),
+            lambda kb, vb: (kb, vb),
+            k_blk, v_blk,
+        )
+        return (lo, hi, k_blk, v_blk), None
+
+    def zeros():
+        return (
+            jnp.zeros((B, C, H, D), jnp.float32),
+            jnp.zeros((B, C, H), jnp.float32),
+            jnp.full((B, C, H), -jnp.inf, jnp.float32),
+        )
+
+    (lo, hi, _, _), _ = lax.scan(
+        step, (zeros(), zeros(), k, v), jnp.arange(n_blocks)
+    )
+    outs = []
+    for o, l, m in (lo, hi):
+        denom = jnp.where(l == 0.0, 1.0, l)
+        outs.append((o / denom[..., None]).astype(q.dtype))
+    return jnp.concatenate(outs, axis=1)
+
+
 def make_ring_attention(
-    mesh: Mesh, seq_axis: str = "data", causal: bool = False
+    mesh: Mesh, seq_axis: str = "data", causal: bool = False,
+    layout: str = "contiguous",
 ):
     """jit-compiled ring attention over *mesh*: [B, T, H, D] inputs with T
-    sharded on *seq_axis*.  Returns (fn, in_sharding)."""
+    sharded on *seq_axis*.  Returns (fn, in_sharding).
+
+    ``layout="zigzag"`` (causal only) expects inputs permuted with
+    :func:`zigzag_permute` over ``mesh.shape[seq_axis]`` shards and returns
+    the output in the same order — per-rank causal work is then uniform
+    instead of growing with rank index.  Keep tensors permuted across the
+    whole training loop; permute once at ingress/egress."""
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown layout {layout!r}")
+    if layout == "zigzag" and not causal:
+        raise ValueError("zigzag layout only pays off for causal attention")
     spec = P(None, seq_axis, None, None)
     sharding = NamedSharding(mesh, spec)
-    body = jax.shard_map(
-        functools.partial(
+    if layout == "zigzag":
+        shard_fn = functools.partial(
+            _ring_attention_shard_zigzag, axis_name=seq_axis
+        )
+    else:
+        shard_fn = functools.partial(
             _ring_attention_shard, axis_name=seq_axis, causal=causal
-        ),
+        )
+    body = jax.shard_map(
+        shard_fn,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
