@@ -1,0 +1,84 @@
+"""Debug endpoint tests: /healthz, /debug/status, /debug/threads against a
+live manager (SURVEY §5's 'optional pprof endpoint' plan item)."""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from tpu_k8s_device_plugin.manager import PluginManager
+from tpu_k8s_device_plugin.observability import DebugServer
+from tpu_k8s_device_plugin.proto import deviceplugin_pb2 as pluginapi
+from tpu_k8s_device_plugin.tpu.device_impl import TpuContainerImpl
+
+from fake_kubelet import FakeKubelet
+
+
+@pytest.fixture
+def served(testdata, tmp_path):
+    root = os.path.join(testdata, "v5e-8")
+    impl = TpuContainerImpl(
+        sysfs_root=os.path.join(root, "sys"),
+        dev_root=os.path.join(root, "dev"),
+        tpu_env_path=os.path.join(root, "run", "tpu", "tpu-env"),
+    )
+    kubelet = FakeKubelet(str(tmp_path / "device-plugins")).start()
+    manager = PluginManager(impl, kubelet_dir=kubelet.dir,
+                            kubelet_watch_interval_s=0.1)
+    manager.run(block=False)
+    debug = DebugServer(manager, port=0).start()  # ephemeral port
+    yield manager, debug, kubelet
+    debug.stop()
+    manager.stop()
+    kubelet.stop()
+
+
+def get(debug, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{debug.port}{path}", timeout=5
+    ) as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_healthz(served):
+    _, debug, _ = served
+    status, body = get(debug, "/healthz")
+    assert status == 200 and body == "ok\n"
+
+
+def test_status_reports_resources_and_counters(served):
+    manager, debug, kubelet = served
+    # drive one Allocate through the real gRPC socket so counters move
+    assert kubelet.wait_for_registration()
+    stub = kubelet.plugin_stub("google.com_tpu")
+    stub.Allocate(pluginapi.AllocateRequest(
+        container_requests=[pluginapi.ContainerAllocateRequest(
+            devices_ids=["0000:00:04.0"]
+        )]
+    ))
+    status, body = get(debug, "/debug/status")
+    assert status == 200
+    data = json.loads(body)
+    res = data["resources"]["tpu"]
+    assert res["healthy"] == 8 and res["unhealthy"] == 0
+    assert res["rpc_counts"]["allocate"] == 1
+    assert res["allocator_degraded"] is False
+    assert data["topology"]["global_mesh"] == "2x4"
+    assert data["topology"]["accelerator_type"] == "v5litepod-8"
+
+
+def test_thread_dump_shows_manager_threads(served):
+    _, debug, _ = served
+    status, body = get(debug, "/debug/threads")
+    assert status == 200
+    assert "kubelet-watch" in body
+    assert "MainThread" in body
+
+
+def test_unknown_path_404(served):
+    _, debug, _ = served
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        get(debug, "/nope")
+    assert ei.value.code == 404

@@ -62,6 +62,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--exporter-socket", default=constants.METRICS_EXPORTER_SOCKET,
         help="tpu-metrics-exporter unix socket for granular health",
     )
+    p.add_argument(
+        "--debug-port", type=int, default=0, metavar="PORT",
+        help="serve /healthz, /debug/status, /debug/threads on loopback "
+             "at PORT; 0 disables (default)",
+    )
     p.add_argument("-v", "--verbose", action="count", default=0)
     p.add_argument("--version", action="version", version=__version__)
     return p
@@ -134,6 +139,10 @@ def main(argv=None) -> int:
         pulse_seconds=args.pulse,
         kubelet_dir=args.kubelet_dir,
     )
+    debug_server = None
+    if args.debug_port:
+        from tpu_k8s_device_plugin.observability import DebugServer
+        debug_server = DebugServer(manager, args.debug_port).start()
     # k8s sends SIGTERM on pod shutdown; route it through the same cleanup
     # path as Ctrl-C so streams get the stop signal and the endpoint socket
     # is unlinked (≈ main.go signal handling)
@@ -142,6 +151,8 @@ def main(argv=None) -> int:
         manager.run(block=True)
     finally:
         manager.stop()
+        if debug_server is not None:
+            debug_server.stop()
     return 0
 
 

@@ -156,6 +156,33 @@ class PluginManager:
         self._sync_plugins(resources)
         self._register_all()
 
+    def status_snapshot(self) -> Dict[str, dict]:
+        """Per-resource serving state for the debug endpoint.  Health comes
+        from each plugin's last ListAndWatch frame (no hardware probing on
+        this path — request rate stays decoupled from probe rate), falling
+        back to the precomputed enumerate list before any stream opened."""
+        with self._plugins_lock:
+            plugins = list(self._plugins.items())
+        out: Dict[str, dict] = {}
+        for resource, sp in plugins:
+            plugin = sp.plugin
+            devices = plugin.last_devices
+            if devices is None:
+                try:
+                    devices = self.impl.enumerate(plugin.ctx)
+                except Exception as e:
+                    out[resource] = {"error": str(e)}
+                    continue
+            out[resource] = {
+                "endpoint": sp.socket_path,
+                "devices": {d.ID: d.health for d in devices},
+                "healthy": sum(d.health == constants.HEALTHY for d in devices),
+                "unhealthy": sum(d.health != constants.HEALTHY for d in devices),
+                "allocator_degraded": plugin.ctx.get_allocator_error(),
+                "rpc_counts": plugin.counters(),
+            }
+        return out
+
     # -- internals ----------------------------------------------------------
 
     def _endpoint(self, resource: str) -> str:

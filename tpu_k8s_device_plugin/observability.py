@@ -1,0 +1,122 @@
+"""Opt-in debug/observability HTTP endpoint.
+
+The SURVEY §5 plan item the reference never had (its only observability is
+glog verbosity): a flag-gated localhost HTTP server exposing the pprof-style
+introspection a Go binary would get for free —
+
+  GET /healthz        liveness (200 "ok")
+  GET /debug/status   JSON: served resources, per-device health, RPC
+                      counters, topology summary
+  GET /debug/threads  all-thread stack dump (the goroutine-dump analog)
+
+Disabled unless --debug-port is set; binds loopback only (it exposes
+internal state and has no auth — same posture as Go's default pprof
+guidance).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, TYPE_CHECKING
+
+from tpu_k8s_device_plugin import __version__
+
+if TYPE_CHECKING:
+    from tpu_k8s_device_plugin.manager import PluginManager
+
+log = logging.getLogger(__name__)
+
+
+def thread_dump() -> str:
+    """Stack traces of every live thread (≈ a Go goroutine dump)."""
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in frames.items():
+        out.append(f"--- thread {names.get(ident, '?')} ({ident}) ---")
+        out.extend(line.rstrip() for line in traceback.format_stack(frame))
+    return "\n".join(out) + "\n"
+
+
+def manager_status(manager: "PluginManager") -> dict:
+    """Snapshot of what the manager is serving, for /debug/status.  All
+    plugin/lock discipline lives behind PluginManager.status_snapshot()."""
+    status: dict = {
+        "version": __version__,
+        "pulse_seconds": manager.pulse,
+        "kubelet_dir": manager.kubelet_dir,
+        "resources": manager.status_snapshot(),
+    }
+    topo = getattr(manager.impl, "topology", None)
+    if topo is not None:
+        status["topology"] = {
+            "accelerator_type": topo.accelerator_type,
+            "global_mesh": topo.topology_str,
+            "worker_id": topo.worker_id,
+            "num_workers": topo.num_workers,
+        }
+    return status
+
+
+class DebugServer:
+    """Loopback HTTP server for the debug surface."""
+
+    def __init__(self, manager: "PluginManager", port: int,
+                 host: str = "127.0.0.1"):
+        self._manager = manager
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._host = host
+        self._port = port
+
+    @property
+    def port(self) -> int:
+        """Actual bound port (differs from the requested one for port 0)."""
+        return self._httpd.server_address[1] if self._httpd else self._port
+
+    def start(self) -> "DebugServer":
+        manager = self._manager
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path == "/healthz":
+                    self._send(200, "text/plain", "ok\n")
+                elif self.path == "/debug/status":
+                    try:
+                        body = json.dumps(manager_status(manager), indent=2)
+                        self._send(200, "application/json", body + "\n")
+                    except Exception as e:
+                        self._send(500, "text/plain", f"{e}\n")
+                elif self.path == "/debug/threads":
+                    self._send(200, "text/plain", thread_dump())
+                else:
+                    self._send(404, "text/plain", "not found\n")
+
+            def _send(self, code, ctype, body: str):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, fmt, *args):
+                log.debug("debug-http: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        t = threading.Thread(
+            target=self._httpd.serve_forever, name="debug-http", daemon=True
+        )
+        t.start()
+        log.info("debug endpoint on http://%s:%d", self._host, self.port)
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -37,6 +37,26 @@ class TpuDevicePlugin(pluginapi_grpc.DevicePluginServicer):
         self._lock = threading.Lock()
         self._watchers: List[queue.Queue] = []
         self._stopped = False
+        # RPC counters for the debug endpoint (SURVEY §5 observability);
+        # ints mutated under _lock so the debug reader sees consistent values
+        self.rpc_counts = {
+            "allocate": 0,
+            "get_preferred_allocation": 0,
+            "list_and_watch_streams": 0,
+        }
+        # last device list sent down any ListAndWatch stream — the debug
+        # endpoint serves this instead of re-probing hardware per request
+        # (published by reference assignment; lists are never mutated)
+        self.last_devices: Optional[List] = None
+
+    def _count(self, rpc: str) -> None:
+        with self._lock:
+            self.rpc_counts[rpc] += 1
+
+    def counters(self) -> dict:
+        """Consistent copy of the RPC counters (debug surface)."""
+        with self._lock:
+            return dict(self.rpc_counts)
 
     # -- lifecycle signalling (≈ plugin.go heartbeat/signal channels) -------
 
@@ -82,10 +102,12 @@ class TpuDevicePlugin(pluginapi_grpc.DevicePluginServicer):
             if self._stopped:
                 return
             self._watchers.append(q)
+            self.rpc_counts["list_and_watch_streams"] += 1
         # client disconnect must unblock q.get() — otherwise every kubelet
         # restart leaks one executor thread parked in get() forever
         context.add_callback(lambda: q.put(_STOP))
         try:
+            self.last_devices = devices
             yield pluginapi.ListAndWatchResponse(devices=devices)
             while context.is_active():
                 msg = q.get()
@@ -100,6 +122,7 @@ class TpuDevicePlugin(pluginapi_grpc.DevicePluginServicer):
                 except Exception as e:
                     log.error("UpdateHealth failed: %s", e)
                     continue
+                self.last_devices = devices
                 yield pluginapi.ListAndWatchResponse(devices=devices)
         finally:
             with self._lock:
@@ -107,6 +130,7 @@ class TpuDevicePlugin(pluginapi_grpc.DevicePluginServicer):
                     self._watchers.remove(q)
 
     def GetPreferredAllocation(self, request, context):
+        self._count("get_preferred_allocation")
         try:
             return self.impl.get_preferred_allocation(self.ctx, request)
         except Exception as e:
@@ -114,6 +138,7 @@ class TpuDevicePlugin(pluginapi_grpc.DevicePluginServicer):
             context.abort(grpc.StatusCode.INTERNAL, str(e))
 
     def Allocate(self, request, context):
+        self._count("allocate")
         try:
             return self.impl.allocate(self.ctx, request)
         except Exception as e:
