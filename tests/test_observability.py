@@ -63,7 +63,7 @@ def test_status_reports_resources_and_counters(served):
     res = data["resources"]["tpu"]
     assert res["healthy"] == 8 and res["unhealthy"] == 0
     assert res["rpc_counts"]["allocate"] == 1
-    assert res["allocator_degraded"] is False
+    assert res["preferred_allocation_enabled"] is True
     assert data["topology"]["global_mesh"] == "2x4"
     assert data["topology"]["accelerator_type"] == "v5litepod-8"
 

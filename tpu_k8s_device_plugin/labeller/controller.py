@@ -11,6 +11,7 @@ of a read-modify-update of the full Node object.
 
 from __future__ import annotations
 
+import http.client
 import logging
 import threading
 from typing import Callable, Dict, Optional
@@ -53,6 +54,9 @@ class NodeLabelController:
         self.compute_labels = compute_labels
         self.interval = interval_s
         self._stop = threading.Event()
+        # resourceVersion to resume the watch from (informer semantics);
+        # None forces the next watch to start fresh after a re-list
+        self._last_rv: Optional[str] = None
 
     def reconcile(
         self, desired: Optional[Dict[str, str]] = None
@@ -60,12 +64,20 @@ class NodeLabelController:
         """One pass; returns the applied delta (empty = already in sync).
         *desired* skips recomputation when the caller already has it."""
         node = self.client.get_node(self.node_name)
-        current = (node.get("metadata") or {}).get("labels") or {}
+        meta = node.get("metadata") or {}
+        self._last_rv = meta.get("resourceVersion")
+        current = meta.get("labels") or {}
         if desired is None:
             desired = self.compute_labels()
         delta = label_delta(current, desired)
         if delta:
-            self.client.patch_node_labels(self.node_name, delta)
+            updated = self.client.patch_node_labels(self.node_name, delta)
+            # resume the watch from the PATCH response's version: it IS our
+            # own update, so starting there also skips the self-induced
+            # MODIFIED event a replay from the GET's version would deliver
+            rv = (updated.get("metadata") or {}).get("resourceVersion")
+            if rv:
+                self._last_rv = rv
             log.info(
                 "reconciled %s: %d set, %d removed",
                 self.node_name,
@@ -95,24 +107,67 @@ class NodeLabelController:
             try:
                 desired = self.compute_labels()
                 self.reconcile(desired)
-            except (ApiError, OSError) as e:
+            except (ApiError, OSError, http.client.HTTPException) as e:
                 log.error("reconcile failed: %s", e)
                 self._stop.wait(min(self.interval, 10.0))
                 continue
             try:
                 for event in self.client.watch_node(
-                    self.node_name, timeout_s=int(self.interval)
+                    self.node_name, timeout_s=int(self.interval),
+                    resource_version=self._last_rv,
                 ):
                     if self._stop.is_set():
                         return
-                    if self._event_needs_reconcile(event, desired):
-                        # recompute: the divergence may reflect new hardware
-                        # state, not just someone deleting our labels
-                        desired = self.compute_labels()
-                        self.reconcile(desired)
-            except (ApiError, OSError) as e:
+                    if self._handle_gone(event):
+                        break  # clean re-list via the outer loop, no backoff
+                    desired = self._process_event(event, desired)
+            except ApiError as e:
+                if e.status == 410:
+                    # history compacted past our resourceVersion: re-list
+                    # immediately (informer semantics), not generic backoff
+                    log.info("watch expired (410 Gone); re-listing")
+                    self._last_rv = None
+                    continue
                 log.warning("watch failed (%s); falling back to poll", e)
                 self._stop.wait(self.interval)
+            except (OSError, http.client.HTTPException) as e:
+                # HTTPException: a dropped chunked stream mid-read raises
+                # IncompleteRead and friends, which are NOT OSErrors — an
+                # apiserver restart must not kill the reconcile loop
+                log.warning("watch failed (%s); falling back to poll", e)
+                self._stop.wait(self.interval)
+
+    def _process_event(
+        self, event: dict, desired: Dict[str, str]
+    ) -> Dict[str, str]:
+        """One non-ERROR watch event: advance the resume point to the
+        event's resourceVersion (so a mid-stream reconnect doesn't replay
+        it), then reconcile if the labels drifted.  Returns the possibly
+        recomputed desired set."""
+        rv = (
+            (event.get("object") or {}).get("metadata") or {}
+        ).get("resourceVersion")
+        if rv:
+            self._last_rv = rv
+        if self._event_needs_reconcile(event, desired):
+            # recompute: the divergence may reflect new hardware
+            # state, not just someone deleting our labels
+            desired = self.compute_labels()
+            self.reconcile(desired)
+        return desired
+
+    def _handle_gone(self, event: dict) -> bool:
+        """True for a 410 Gone ERROR event (etcd compacted past our
+        resourceVersion) — the watch must be restarted from a fresh list."""
+        if event.get("type") != "ERROR":
+            return False
+        code = (event.get("object") or {}).get("code")
+        if code == 410:
+            log.info("watch event 410 Gone; re-listing")
+            self._last_rv = None
+            return True
+        log.warning("watch ERROR event: %s", event)
+        return False
 
     def stop(self) -> None:
         self._stop.set()

@@ -11,6 +11,7 @@ reconcile is pure in-memory work after one discovery pass.
 from __future__ import annotations
 
 import logging
+import re
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
@@ -18,6 +19,14 @@ from tpu_k8s_device_plugin.tpu import discovery, vfio
 from tpu_k8s_device_plugin.tpu.discovery import TpuDevice
 from tpu_k8s_device_plugin.tpu.topology import IciTopology
 from tpu_k8s_device_plugin.types import constants
+
+# k8s label value rules: <= 63 chars, alphanumeric ends, [-A-Za-z0-9_.] middle.
+MAX_LABEL_VALUE_LEN = 63
+_LABEL_VALUE_RE = re.compile(r"^[A-Za-z0-9]([-A-Za-z0-9_.]*[A-Za-z0-9])?$")
+
+
+def is_valid_label_value(val: str) -> bool:
+    return len(val) <= MAX_LABEL_VALUE_LEN and bool(_LABEL_VALUE_RE.match(val))
 
 log = logging.getLogger(__name__)
 
@@ -97,9 +106,26 @@ def _driver_version(ctx: LabelContext) -> str:
 
 def _device_id(ctx: LabelContext) -> str:
     # "_" separator: "," is not legal in a k8s label value, and one bad
-    # value would get the whole merge patch rejected
+    # value would get the whole merge patch rejected.  A heterogeneous
+    # host with many distinct ids could also blow the 63-char value limit
+    # (same whole-patch rejection), so cap the join and summarise the rest.
     ids = sorted({c.device_id for c in ctx.chips.values() if c.device_id})
-    return ids[0] if len(ids) == 1 else "_".join(ids)
+    if len(ids) == 1:
+        return ids[0]
+    joined = "_".join(ids)
+    if len(joined) <= MAX_LABEL_VALUE_LEN:
+        return joined
+    kept: List[str] = []
+    for i in ids:
+        tail = f"_and-{len(ids) - len(kept)}-more"
+        if len("_".join(kept + [i])) + len(tail) > MAX_LABEL_VALUE_LEN:
+            break
+        kept.append(i)
+    if not kept:
+        # even the first id + summary tail won't fit: a bare count is
+        # still a valid label value ("_and-N-more" alone would not be)
+        return f"{len(ids)}-device-ids"
+    return "_".join(kept) + f"_and-{len(ids) - len(kept)}-more"
 
 
 def _product_name(ctx: LabelContext) -> str:
@@ -174,6 +200,14 @@ def generate_labels(
             log.error("label generator %s failed: %s", key, e)
             continue
         if not val:
+            continue
+        if not is_valid_label_value(val):
+            # one invalid value rejects the ENTIRE merge patch — every
+            # other label would stop reconciling with it.  Drop and log.
+            log.error(
+                "label %s value %r is not a valid k8s label value; dropping",
+                key, val,
+            )
             continue
         out[f"{constants.LABEL_PREFIX}.{key}"] = val
         out[f"{constants.LABEL_PREFIX_BETA}.{key}"] = val

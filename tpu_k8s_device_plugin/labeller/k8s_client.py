@@ -111,15 +111,24 @@ class NodeClient:
             return json.load(resp)
 
     def watch_node(
-        self, name: str, timeout_s: int = 60
+        self, name: str, timeout_s: int = 60,
+        resource_version: Optional[str] = None,
     ) -> Iterator[dict]:
         """Yield watch events for one node until the server closes the
-        long-poll (bounded by ``timeoutSeconds``)."""
+        long-poll (bounded by ``timeoutSeconds``).
+
+        With *resource_version* the server only sends events newer than
+        that version (informer semantics — no replay of the current
+        object on every reconnect).  A too-old version surfaces as HTTP
+        410 (ApiError) or an ERROR event with ``object.code == 410``;
+        callers must then re-list and restart the watch fresh."""
         path = (
             f"/api/v1/nodes?watch=true"
             f"&fieldSelector=metadata.name%3D{name}"
             f"&timeoutSeconds={timeout_s}"
         )
+        if resource_version:
+            path += f"&resourceVersion={resource_version}"
         with self._request("GET", path, timeout=timeout_s + 5) as resp:
             for line in resp:
                 line = line.strip()

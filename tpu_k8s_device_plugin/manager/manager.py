@@ -178,7 +178,13 @@ class PluginManager:
                 "devices": {d.ID: d.health for d in devices},
                 "healthy": sum(d.health == constants.HEALTHY for d in devices),
                 "unhealthy": sum(d.health != constants.HEALTHY for d in devices),
-                "allocator_degraded": plugin.ctx.get_allocator_error(),
+                # capability, not failure: False covers both "allocator
+                # init failed, degraded to kubelet default" AND "no
+                # topology allocator by design" (VFIO passthrough) —
+                # either way GetPreferredAllocation answers first-fit
+                "preferred_allocation_enabled": (
+                    not plugin.ctx.get_allocator_error()
+                ),
                 "rpc_counts": plugin.counters(),
             }
         return out

@@ -25,6 +25,25 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def _shard_map(fn, mesh, in_specs, out_specs):
+    """jax.shard_map with the no-replication-check knob, across jax
+    versions: the top-level API (with check_vma) only exists in recent
+    releases; older ones ship jax.experimental.shard_map (check_rep).
+    pyproject's [workloads] extra pins jax>=0.7, but the fallback keeps
+    the module importable on hosts with an older preinstalled jax."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map as legacy_shard_map
+
+    return legacy_shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+
+
 def _online_softmax_update(o, l, m, q_blk, k_blk, v_blk, scale, mask=None):
     """One K/V block's numerically-stable online-softmax accumulation, in
     f32.  *mask* is an optional [Tq, Tk] boolean of visible positions.
@@ -293,13 +312,7 @@ def make_ring_attention(
         shard_fn = functools.partial(
             _ring_attention_shard, axis_name=seq_axis, causal=causal
         )
-    body = jax.shard_map(
-        shard_fn,
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        check_vma=False,
-    )
+    body = _shard_map(shard_fn, mesh, (spec, spec, spec), spec)
     return jax.jit(body), sharding
 
 
