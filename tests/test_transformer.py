@@ -1,0 +1,122 @@
+"""Transformer LM tests on the virtual 8-device mesh: single-device
+training, the sharded data×seq×model step, and exact agreement between
+ring-attention (both layouts) and local-attention forward passes."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_k8s_device_plugin.workloads.transformer import (
+    TransformerLM,
+    local_causal_attention,
+    lm_loss,
+    lm_train_step,
+    make_lm_mesh,
+    make_lm_train_step,
+    synthetic_lm_batch,
+)
+
+TINY = dict(vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64)
+
+
+def build(attn_fn=local_causal_attention, batch=2, seq_len=32):
+    rng = jax.random.PRNGKey(1)
+    model = TransformerLM(attn_fn=attn_fn, **TINY)
+    tokens, labels, positions = synthetic_lm_batch(
+        rng, batch, seq_len, TINY["vocab"]
+    )
+    params = model.init(rng, tokens, positions)["params"]
+    return model, params, (tokens, labels, positions)
+
+
+def test_forward_shapes_and_finite():
+    model, params, (tokens, _, positions) = build()
+    logits = model.apply({"params": params}, tokens, positions)
+    assert logits.shape == (2, 32, TINY["vocab"])
+    assert logits.dtype == jnp.float32
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_single_device_training_reduces_loss():
+    import optax
+
+    model, params, batch = build()
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(params)
+    step = jax.jit(functools.partial(lm_train_step, model, tx))
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = step(params, opt_state, *batch)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_causality_is_position_driven():
+    """Permuting tokens+positions together must not change per-token
+    logits — the property that makes the zig-zag layout legal end-to-end."""
+    model, params, (tokens, _, positions) = build(batch=1, seq_len=16)
+    logits = model.apply({"params": params}, tokens, positions)
+    perm = np.random.RandomState(0).permutation(16)
+    logits_p = model.apply(
+        {"params": params}, tokens[:, perm], positions[:, perm]
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits[:, perm]), np.asarray(logits_p), atol=2e-2,
+        rtol=2e-2,
+    )
+
+
+class TestShardedLM:
+    @pytest.mark.parametrize("layout", ["contiguous", "zigzag"])
+    def test_sharded_loss_matches_local_oracle(self, layout):
+        mesh = make_lm_mesh(jax.devices(), seq=2, model=2)
+        step, state, place = make_lm_train_step(
+            mesh, seq_len=32, batch=4, attn_layout=layout, **TINY
+        )
+        tokens, labels, positions = state["batch"]
+        placed = place(tokens, labels, positions)
+        # oracle: same params, local attention, natural order
+        local_model = TransformerLM(attn_fn=local_causal_attention, **TINY)
+        host_params = jax.device_get(state["params"])
+        want = float(lm_loss(
+            local_model, host_params, tokens, labels, positions
+        ))
+        params, opt_state, loss = step(
+            state["params"], state["opt_state"], *placed
+        )
+        assert np.isclose(float(loss), want, rtol=2e-2), (float(loss), want)
+
+    def test_sharded_training_reduces_loss_and_keeps_layout(self):
+        mesh = make_lm_mesh(jax.devices(), seq=2, model=2)
+        step, state, place = make_lm_train_step(
+            mesh, seq_len=32, batch=4, **TINY
+        )
+        placed = place(*state["batch"])
+        params, opt_state = state["params"], state["opt_state"]
+        losses = []
+        for _ in range(5):
+            params, opt_state, loss = step(params, opt_state, *placed)
+            losses.append(float(loss))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+        # tensor-parallel layout survives the update loop
+        qkv = params["block_0"]["qkv"]["kernel"]
+        assert tuple(qkv.sharding.spec) == (None, "model")
+        assert (
+            qkv.addressable_shards[0].data.shape[1]
+            == qkv.shape[1] // mesh.shape["model"]
+        )
+
+    def test_pure_data_parallel_fallback(self):
+        """seq_axis=None: plain DP+TP without sequence parallelism."""
+        mesh = make_lm_mesh(jax.devices(), seq=1, model=2)
+        step, state, place = make_lm_train_step(
+            mesh, seq_len=32, batch=4, seq_axis=None, **TINY
+        )
+        placed = place(*state["batch"])
+        _, _, loss = step(state["params"], state["opt_state"], *placed)
+        assert np.isfinite(float(loss))

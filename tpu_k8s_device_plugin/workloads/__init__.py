@@ -16,12 +16,16 @@ from .ring_attention import (
     zigzag_permute,
     zigzag_unpermute,
 )
+from .transformer import TransformerLM, make_lm_mesh, make_lm_train_step
 
 __all__ = [
     "AlexNet",
+    "TransformerLM",
     "create_train_state",
     "train_step",
     "full_attention",
+    "make_lm_mesh",
+    "make_lm_train_step",
     "make_mesh",
     "make_ring_attention",
     "make_sharded_train_step",

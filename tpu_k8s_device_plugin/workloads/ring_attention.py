@@ -17,6 +17,7 @@ over ICI (SURVEY.md §5 "distributed communication backend").
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -288,7 +289,7 @@ def _ring_attention_shard_zigzag(
 
 def make_ring_attention(
     mesh: Mesh, seq_axis: str = "data", causal: bool = False,
-    layout: str = "contiguous",
+    layout: str = "contiguous", spec: Optional[P] = None,
 ):
     """jit-compiled ring attention over *mesh*: [B, T, H, D] inputs with T
     sharded on *seq_axis*.  Returns (fn, in_sharding).
@@ -297,12 +298,19 @@ def make_ring_attention(
     :func:`zigzag_permute` over ``mesh.shape[seq_axis]`` shards and returns
     the output in the same order — per-rank causal work is then uniform
     instead of growing with rank index.  Keep tensors permuted across the
-    whole training loop; permute once at ingress/egress."""
+    whole training loop; permute once at ingress/egress.
+
+    *spec* overrides the partitioning of the [B, T, H, D] operands (default:
+    only T on *seq_axis*) so batch/heads can ride other mesh axes — e.g.
+    ``P("data", "seq", "model", None)`` inside a 3-axis LM step.  The ring
+    only ever communicates over *seq_axis*; other axes just shrink the
+    local block."""
     if layout not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown layout {layout!r}")
     if layout == "zigzag" and not causal:
         raise ValueError("zigzag layout only pays off for causal attention")
-    spec = P(None, seq_axis, None, None)
+    if spec is None:
+        spec = P(None, seq_axis, None, None)
     sharding = NamedSharding(mesh, spec)
     if layout == "zigzag":
         shard_fn = functools.partial(

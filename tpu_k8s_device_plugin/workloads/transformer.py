@@ -1,0 +1,310 @@
+"""Decoder-only transformer LM, TPU-first, with sequence parallelism.
+
+The reference proves its plugin with opaque workload images (TF AlexNet,
+vLLM — /root/reference/example/pod/alexnet-gpu.yaml:16,
+example/vllm-serve/deployment.yaml:19-38); this build ships the workload
+layer natively.  This module is the long-context half: a GPT-style LM
+whose attention is pluggable between
+
+  * local causal attention (single shard, the oracle), and
+  * ring attention over a mesh ``seq`` axis (contiguous or zig-zag
+    layout, from ring_attention.py) — K/V rotating on ICI while
+    activations stay sequence-sharded, so per-chip memory is
+    O(T / seq_parallelism).
+
+Design choices are MXU/XLA-shaped: bf16 activations with f32 params and
+softmax, static shapes, one jit of the whole train step, RoPE driven by
+an explicit *positions* array (which is what makes the zig-zag permuted
+layout work end-to-end: tokens, labels, and positions permute together,
+and nothing else in the model cares about token order).  Sharding is the
+scaling-book recipe: annotate params/inputs on a ``data × seq × model``
+mesh and let XLA place the collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+import optax
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+COMPUTE_DTYPE = jnp.bfloat16
+
+# attention callable: (q, k, v, positions) -> out, all [B, T, H, D] (+ [B, T])
+AttnFn = Callable[[jax.Array, jax.Array, jax.Array, jax.Array], jax.Array]
+
+
+def apply_rope(x: jax.Array, positions: jax.Array) -> jax.Array:
+    """Rotary position embedding on [B, T, H, D] with explicit positions
+    [B, T] — explicit so sequence-permuted layouts (zig-zag) stay correct."""
+    d_half = x.shape[-1] // 2
+    freqs = 1.0 / (10000.0 ** (jnp.arange(d_half, dtype=jnp.float32) / d_half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, T, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def local_causal_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, positions: jax.Array
+) -> jax.Array:
+    """Whole-sequence causal attention on one shard (the oracle path).
+    Causality comes from the positions array, not the storage order, so
+    it is also correct on permuted layouts."""
+    scale = 1.0 / jnp.sqrt(jnp.array(q.shape[-1], jnp.float32))
+    scores = jnp.einsum(
+        "bqhd,bkhd->bqhk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    mask = positions[:, :, None] >= positions[:, None, :]  # [B, Tq, Tk]
+    scores = jnp.where(mask[:, :, None, :], scores, -jnp.inf)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum(
+        "bqhk,bkhd->bqhd", w, v.astype(jnp.float32)
+    ).astype(q.dtype)
+
+
+class Block(nn.Module):
+    """Pre-norm transformer block: RMSNorm → attention → residual,
+    RMSNorm → GELU MLP → residual."""
+
+    d_model: int
+    n_heads: int
+    d_ff: int
+    dtype: Any = COMPUTE_DTYPE
+    attn_fn: AttnFn = staticmethod(local_causal_attention)
+
+    @nn.compact
+    def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
+        B, T, _ = x.shape
+        head_dim = self.d_model // self.n_heads
+        h = nn.RMSNorm(dtype=self.dtype, name="attn_norm")(x)
+        qkv = nn.Dense(3 * self.d_model, use_bias=False, dtype=self.dtype,
+                       name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, T, self.n_heads, head_dim)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        q = apply_rope(q, positions)
+        k = apply_rope(k, positions)
+        att = self.attn_fn(q, k, v, positions)
+        att = att.reshape(B, T, self.d_model)
+        x = x + nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
+                         name="out_proj")(att)
+
+        h = nn.RMSNorm(dtype=self.dtype, name="mlp_norm")(x)
+        h = nn.Dense(self.d_ff, use_bias=False, dtype=self.dtype,
+                     name="mlp_up")(h)
+        h = nn.gelu(h)
+        x = x + nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
+                         name="mlp_down")(h)
+        return x
+
+
+class TransformerLM(nn.Module):
+    """Next-token LM.  ``attn_fn`` swaps local attention for ring
+    attention without touching any other part of the model."""
+
+    vocab: int
+    d_model: int = 256
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 1024
+    dtype: Any = COMPUTE_DTYPE
+    attn_fn: AttnFn = staticmethod(local_causal_attention)
+
+    @nn.compact
+    def __call__(
+        self, tokens: jax.Array, positions: Optional[jax.Array] = None
+    ) -> jax.Array:
+        B, T = tokens.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+        x = nn.Embed(self.vocab, self.d_model, dtype=self.dtype,
+                     name="embed")(tokens)
+        for i in range(self.n_layers):
+            x = Block(
+                self.d_model, self.n_heads, self.d_ff, dtype=self.dtype,
+                attn_fn=self.attn_fn, name=f"block_{i}",
+            )(x, positions)
+        x = nn.RMSNorm(dtype=self.dtype, name="final_norm")(x)
+        logits = nn.Dense(self.vocab, use_bias=False, dtype=self.dtype,
+                          name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# training
+# ---------------------------------------------------------------------------
+
+
+def lm_loss(model: TransformerLM, params, tokens, labels, positions):
+    """Mean next-token cross entropy; label -1 marks ignored slots (the
+    final token of each sequence, which has no successor)."""
+    logits = model.apply({"params": params}, tokens, positions)
+    valid = labels >= 0
+    raw = optax.softmax_cross_entropy_with_integer_labels(
+        logits, jnp.maximum(labels, 0)
+    )
+    return jnp.sum(raw * valid) / jnp.maximum(jnp.sum(valid), 1)
+
+
+def lm_train_step(model, tx, params, opt_state, tokens, labels, positions):
+    loss, grads = jax.value_and_grad(
+        functools.partial(lm_loss, model)
+    )(params, tokens, labels, positions)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss
+
+
+def synthetic_lm_batch(
+    rng: jax.Array, batch: int, seq_len: int, vocab: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(tokens, labels, positions) in natural order; labels are tokens
+    shifted left with -1 in the ignored last slot."""
+    tokens = jax.random.randint(rng, (batch, seq_len), 0, vocab)
+    labels = jnp.concatenate(
+        [tokens[:, 1:], jnp.full((batch, 1), -1, tokens.dtype)], axis=1
+    )
+    positions = jnp.broadcast_to(
+        jnp.arange(seq_len, dtype=jnp.int32), (batch, seq_len)
+    )
+    return tokens, labels, positions
+
+
+# -- sharded training over a data × seq × model mesh ------------------------
+
+
+def make_lm_mesh(
+    devices=None, seq: int = 2, model: int = 2
+) -> Mesh:
+    """``data × seq × model`` mesh: data parallelism outermost (its psum
+    gradients tolerate the slowest links), sequence and tensor parallelism
+    on the inner, physically-closest axes."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n % (seq * model):
+        raise ValueError(f"{n} devices not divisible by seq*model={seq * model}")
+    grid = mesh_utils.create_device_mesh(
+        (n // (seq * model), seq, model), devices=devices
+    )
+    return Mesh(grid, axis_names=("data", "seq", "model"))
+
+
+def _lm_pspec(path, leaf) -> P:
+    """Megatron-style tensor parallelism on the ``model`` axis: qkv/up
+    projections column-split, out/down projections row-split, lm_head
+    vocab-split; embeddings and norms replicated (vocab stays small in the
+    example configs; a production config would vocab-split the embedding
+    the same way as lm_head)."""
+    name = "/".join(
+        str(getattr(p, "key", getattr(p, "name", p))) for p in path
+    )
+    if leaf.ndim == 2:
+        if "qkv" in name or "mlp_up" in name or "lm_head" in name:
+            return P(None, "model")
+        if "out_proj" in name or "mlp_down" in name:
+            return P("model", None)
+    return P()
+
+
+def lm_tree_shardings(mesh: Mesh, tree):
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, _lm_pspec(path, leaf)), tree
+    )
+
+
+def make_lm_train_step(
+    mesh: Mesh,
+    vocab: int = 512,
+    d_model: int = 256,
+    n_heads: int = 4,
+    n_layers: int = 2,
+    d_ff: int = 1024,
+    seq_axis: Optional[str] = "seq",
+    attn_layout: str = "zigzag",
+    learning_rate: float = 1e-2,
+    rng: Optional[jax.Array] = None,
+    batch: int = 4,
+    seq_len: int = 64,
+):
+    """Build a fully sharded LM train step over *mesh*.
+
+    With *seq_axis* set, attention runs as causal ring attention over that
+    mesh axis (``attn_layout``: "contiguous" or the balanced "zigzag");
+    activations are [data, seq]-sharded, parameters model-split per
+    ``_lm_pspec``.  Returns (step, state, place) where ``place(tokens,
+    labels, positions)`` applies the ingress layout (zig-zag permutation
+    when selected) and device placement.
+    """
+    from .ring_attention import make_ring_attention, zigzag_permute
+
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+    n_seq = mesh.shape[seq_axis] if seq_axis else 1
+
+    if seq_axis:
+        # heads ride the model axis too (qkv is model-split; leaving H
+        # replicated would all-gather q/k/v and redo attention on every
+        # model rank) — unless head count doesn't divide the axis
+        head_axis = (
+            "model" if n_heads % mesh.shape.get("model", 1) == 0 else None
+        )
+        spec = P("data", seq_axis, head_axis, None)
+        ring_fn, _ = make_ring_attention(
+            mesh, seq_axis, causal=True, layout=attn_layout, spec=spec
+        )
+
+        def attn(q, k, v, positions):
+            del positions  # causality comes from the ring layout
+            return ring_fn(q, k, v)
+    else:
+        attn = local_causal_attention
+
+    model = TransformerLM(
+        vocab=vocab, d_model=d_model, n_heads=n_heads, n_layers=n_layers,
+        d_ff=d_ff, attn_fn=attn,
+    )
+    tokens, labels, positions = synthetic_lm_batch(rng, batch, seq_len, vocab)
+    params = model.init(rng, tokens, positions)["params"]
+    tx = optax.adam(learning_rate)
+    opt_state = tx.init(params)
+
+    param_sh = lm_tree_shardings(mesh, params)
+    opt_sh = lm_tree_shardings(mesh, opt_state)
+    tok_spec = P("data", seq_axis) if seq_axis else P("data", None)
+    tok_sh = NamedSharding(mesh, tok_spec)
+    loss_sh = NamedSharding(mesh, P())
+
+    params = jax.device_put(params, param_sh)
+    opt_state = jax.device_put(opt_state, opt_sh)
+
+    step = jax.jit(
+        functools.partial(lm_train_step, model, tx),
+        in_shardings=(param_sh, opt_sh, tok_sh, tok_sh, tok_sh),
+        out_shardings=(param_sh, opt_sh, loss_sh),
+        donate_argnums=(0, 1),
+    )
+
+    def place(tokens, labels, positions):
+        if seq_axis and attn_layout == "zigzag":
+            tokens = zigzag_permute(tokens, n_seq, axis=1)
+            labels = zigzag_permute(labels, n_seq, axis=1)
+            positions = zigzag_permute(positions, n_seq, axis=1)
+        return tuple(
+            jax.device_put(x, tok_sh) for x in (tokens, labels, positions)
+        )
+
+    state: Dict[str, Any] = {
+        "model": model, "tx": tx, "params": params, "opt_state": opt_state,
+        "batch": (tokens, labels, positions),
+    }
+    return step, state, place
