@@ -1,0 +1,234 @@
+"""Mixture-of-experts FFN with expert parallelism, TPU-first.
+
+The reference delegates every parallelism strategy to its workload images
+(SURVEY.md §2.4 — DP/TP/PP/SP/EP all "absent; parallelism lives in
+workloads"); this build ships the workload layer natively, and this module
+is the expert-parallel (EP) member of that set.
+
+TPU-first design constraints drive the whole shape:
+
+* **Static shapes only.**  Token routing is data-dependent, which XLA
+  cannot tile; the classic TPU answer (GShard/Switch, public technique)
+  is *dense dispatch*: a fixed per-expert capacity ``C`` and one-hot
+  dispatch/combine tensors, so every einsum has a static shape and the
+  MXU sees large batched matmuls (`jnp.einsum` over an ``E``-leading
+  expert weight stack) instead of gather/scatter.
+* **EP via sharding annotations, not hand-written all-to-all.**  Expert
+  weight stacks ``[E, D, F]`` are sharded on an ``expert`` mesh axis
+  (`transformer._lm_pspec`); tokens arrive data-sharded.  XLA's SPMD
+  partitioner derives the dispatch/combine all-to-alls from those two
+  annotations — the scaling-book recipe, no NCCL analog anywhere
+  (SURVEY.md §5 "distributed communication backend").
+* **Router math in f32** (softmax + top-k on bf16 logits loses routing
+  determinism); expert matmuls in the model's compute dtype (bf16).
+
+Capacity overflow drops tokens (they ride the residual connection, the
+standard Switch behavior); the Switch load-balancing auxiliary loss is
+sown into the ``losses`` collection so ``transformer.lm_loss`` can add it
+without threading an extra return value through every layer.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .transformer import COMPUTE_DTYPE  # single compute-dtype knob
+
+
+def moe_capacity(
+    tokens: int, n_experts: int, k: int, capacity_factor: float
+) -> int:
+    """Per-expert capacity slots: ceil(k·T/E · factor), at least 1."""
+    return max(1, math.ceil(k * tokens / n_experts * capacity_factor))
+
+
+def top_k_routing(
+    router_logits: jax.Array,  # [B, T, E] (any float dtype; cast to f32)
+    k: int,
+    capacity: int,
+    priority: Optional[jax.Array] = None,  # [B, T] lower claims slots first
+):
+    """Dense top-k dispatch plan from router logits.
+
+    Returns ``(dispatch, combine, aux_loss)`` where
+
+    * ``dispatch`` — [B, T, E, C] one-hot: token t occupies capacity slot
+      c of expert e (at most k ones per token, fewer when an expert
+      overflows its capacity),
+    * ``combine`` — same shape, dispatch weighted by the token's
+      normalized gate value for that expert,
+    * ``aux_loss`` — the Switch load-balancing loss
+      E · Σ_e (token fraction routed to e) · (mean router prob of e),
+      which is 1.0 at perfect balance.
+
+    Capacity slots are granted in token order, earlier choice ranks
+    first — deterministic and shape-static, so the whole plan jits.
+    *priority* overrides the token order (lower value = earlier claim):
+    the LM passes its *positions* array so overflow drops the same tokens
+    no matter how the sequence is laid out in storage — without it, the
+    zig-zag ring-attention layout (sequence permuted at ingress) would
+    silently route/drop a different token subset than the natural-order
+    model.
+    """
+    B, T, E = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)  # [B, T, k]
+    # renormalize the kept gates so the combine weights sum to 1 per token
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9
+    )
+
+    # Flatten (token, choice) in priority order: token-major, then choice
+    # rank — token 0's 2nd choice beats token 1's 1st for a capacity slot
+    # iff it comes earlier in this flattened order.  (Choice-rank-major
+    # within a token keeps top-1 routes from being starved by later
+    # tokens' top-1s no matter what; token-major is the simpler, standard
+    # layout and the difference washes out at realistic capacities.)
+    choice_onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)
+    if priority is not None:
+        # queue-position computation in priority order, results scattered
+        # back to storage order (argsort is stable, shapes stay static)
+        order = jnp.argsort(priority, axis=1)  # [B, T]
+        inv = jnp.argsort(order, axis=1)
+
+        def by_token(a, idx):
+            return jnp.take_along_axis(a, idx[:, :, None, None], axis=1)
+
+        flat_sorted = by_token(choice_onehot, order).reshape(B, T * k, E)
+        pos_sorted = jnp.cumsum(flat_sorted, axis=1) - flat_sorted
+        pos = by_token(
+            pos_sorted.reshape(B, T, k, E), inv
+        ).reshape(B, T * k, E)
+    else:
+        flat_sorted = choice_onehot.reshape(B, T * k, E)
+        pos = jnp.cumsum(flat_sorted, axis=1) - flat_sorted
+    flat = choice_onehot.reshape(B, T * k, E)
+    # pos = position of each (token, choice) in its expert's queue.  Each
+    # route targets exactly one expert, so reduce E out *before* building
+    # the capacity one-hot — the intermediate is [B, T, k, C], a factor E
+    # smaller than the naive [B, T, k, E, C] slot tensor.
+    pos_route = jnp.sum(pos * flat, axis=-1)  # [B, T*k]
+    kept = jnp.sum((pos < capacity) * flat, axis=-1)  # [B, T*k] ∈ {0, 1}
+    slot_route = (
+        jax.nn.one_hot(pos_route.astype(jnp.int32), capacity)
+        * kept[..., None]
+    ).reshape(B, T, k, capacity)
+    dispatch = jnp.einsum(
+        "btke,btkc->btec", choice_onehot, slot_route
+    )  # [B, T, E, C]
+    combine = jnp.einsum(
+        "btke,btkc->btec", choice_onehot,
+        slot_route * gate_vals[..., None].astype(jnp.float32),
+    )
+
+    # Switch aux loss: fraction of (token, choice) routes per expert ×
+    # mean router probability per expert, summed and scaled by E
+    route_frac = jnp.mean(jnp.sum(choice_onehot, axis=2), axis=(0, 1)) / k
+    prob_mean = jnp.mean(probs, axis=(0, 1))
+    aux_loss = E * jnp.sum(route_frac * prob_mean)
+    return dispatch, combine, aux_loss
+
+
+class MoEFFN(nn.Module):
+    """Top-k routed expert FFN, drop-in for the dense MLP in a
+    transformer block: ``[B, T, D] -> [B, T, D]``.
+
+    Expert weights are stacked with a leading ``E`` axis (``experts_up``
+    [E, D, F], ``experts_down`` [E, F, D]) so the per-expert matmuls are
+    two batched einsums — the layout the ``expert`` mesh axis shards
+    (see ``transformer._lm_pspec``).  The aux loss is sown into the
+    ``losses`` collection (scaled by ``aux_weight``).
+    """
+
+    n_experts: int
+    d_model: int
+    d_ff: int
+    k: int = 2
+    capacity_factor: float = 1.25
+    capacity: Optional[int] = None  # explicit override (tests/oracles)
+    aux_weight: float = 1e-2
+    dtype: Any = COMPUTE_DTYPE
+
+    @nn.compact
+    def __call__(
+        self, x: jax.Array, positions: Optional[jax.Array] = None
+    ) -> jax.Array:
+        B, T, D = x.shape
+        E, F = self.n_experts, self.d_ff
+        cap = (
+            self.capacity
+            if self.capacity is not None
+            else moe_capacity(T, E, self.k, self.capacity_factor)
+        )
+
+        # router in f32 end-to-end; tiny [D, E] matmul, not MXU-bound
+        w_router = self.param(
+            "router", nn.initializers.lecun_normal(), (D, E), jnp.float32
+        )
+        logits = jnp.einsum("btd,de->bte", x.astype(jnp.float32), w_router)
+        dispatch, combine, aux = top_k_routing(
+            logits, self.k, cap, priority=positions
+        )
+        self.sow("losses", "moe_aux", self.aux_weight * aux)
+
+        w_up = self.param(
+            "experts_up",
+            nn.initializers.lecun_normal(batch_axis=(0,)),
+            (E, D, F),
+            jnp.float32,
+        )
+        w_down = self.param(
+            "experts_down",
+            nn.initializers.lecun_normal(batch_axis=(0,)),
+            (E, F, D),
+            jnp.float32,
+        )
+
+        # dense dispatch → batched expert matmuls → weighted combine.
+        # [B,T,E,C]×[B,T,D] → [B,E,C,D]: with tokens data-sharded and
+        # experts expert-sharded, XLA lowers this contraction pair to the
+        # EP all-to-all.
+        xin = jnp.einsum(
+            "btec,btd->becd", dispatch.astype(self.dtype), x.astype(self.dtype)
+        )
+        h = jnp.einsum("becd,edf->becf", xin, w_up.astype(self.dtype))
+        h = nn.gelu(h)
+        out = jnp.einsum("becf,efd->becd", h, w_down.astype(self.dtype))
+        y = jnp.einsum(
+            "btec,becd->btd", combine.astype(jnp.float32),
+            out.astype(jnp.float32),
+        )
+        return y.astype(x.dtype)
+
+
+def moe_ffn_oracle(params, x, k: int, capacity: Optional[int] = None):
+    """Per-token reference implementation (no dense dispatch): each token
+    runs through its top-k experts directly, gates renormalized — the
+    correctness oracle for :class:`MoEFFN` when no token exceeds
+    capacity.  f32 throughout."""
+    w_router = params["router"]
+    w_up = params["experts_up"].astype(jnp.float32)
+    w_down = params["experts_down"].astype(jnp.float32)
+    B, T, D = x.shape
+    xf = x.astype(jnp.float32)
+    logits = jnp.einsum("btd,de->bte", xf, w_router)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9
+    )
+    # every token through every expert, then select (oracle-only cost)
+    h = jnp.einsum("btd,edf->betf", xf, w_up)
+    h = jax.nn.gelu(h)
+    all_out = jnp.einsum("betf,efd->betd", h, w_down)  # [B, E, T, D]
+    sel = jnp.take_along_axis(
+        jnp.moveaxis(all_out, 1, 2),  # [B, T, E, D]
+        gate_idx[..., None, None].repeat(D, -1).reshape(B, T, k, D),
+        axis=2,
+    )  # -> [B, T, k, D]
+    return jnp.einsum("btk,btkd->btd", gate_vals, sel)

@@ -72,13 +72,18 @@ def local_causal_attention(
 
 class Block(nn.Module):
     """Pre-norm transformer block: RMSNorm → attention → residual,
-    RMSNorm → GELU MLP → residual."""
+    RMSNorm → FFN → residual.  The FFN is the dense GELU MLP, or a
+    top-k routed mixture-of-experts (``n_experts > 0``, expert-parallel
+    over the mesh's ``expert`` axis — see moe.py)."""
 
     d_model: int
     n_heads: int
     d_ff: int
     dtype: Any = COMPUTE_DTYPE
     attn_fn: AttnFn = staticmethod(local_causal_attention)
+    n_experts: int = 0
+    moe_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
@@ -101,11 +106,23 @@ class Block(nn.Module):
                          name="out_proj")(att)
 
         h = nn.RMSNorm(dtype=self.dtype, name="mlp_norm")(x)
-        h = nn.Dense(self.d_ff, use_bias=False, dtype=self.dtype,
-                     name="mlp_up")(h)
-        h = nn.gelu(h)
-        x = x + nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
-                         name="mlp_down")(h)
+        if self.n_experts > 0:
+            from .moe import MoEFFN
+
+            # positions drive capacity-slot priority so overflow drops
+            # the same tokens under any storage layout (zig-zag included)
+            x = x + MoEFFN(
+                n_experts=self.n_experts, d_model=self.d_model,
+                d_ff=self.d_ff, k=self.moe_k,
+                capacity_factor=self.moe_capacity_factor, dtype=self.dtype,
+                name="moe",
+            )(h, positions)
+        else:
+            h = nn.Dense(self.d_ff, use_bias=False, dtype=self.dtype,
+                         name="mlp_up")(h)
+            h = nn.gelu(h)
+            x = x + nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
+                             name="mlp_down")(h)
         return x
 
 
@@ -120,6 +137,9 @@ class TransformerLM(nn.Module):
     d_ff: int = 1024
     dtype: Any = COMPUTE_DTYPE
     attn_fn: AttnFn = staticmethod(local_causal_attention)
+    n_experts: int = 0  # >0 swaps the MLP for a routed MoE FFN (moe.py)
+    moe_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(
@@ -133,7 +153,10 @@ class TransformerLM(nn.Module):
         for i in range(self.n_layers):
             x = Block(
                 self.d_model, self.n_heads, self.d_ff, dtype=self.dtype,
-                attn_fn=self.attn_fn, name=f"block_{i}",
+                attn_fn=self.attn_fn, n_experts=self.n_experts,
+                moe_k=self.moe_k,
+                moe_capacity_factor=self.moe_capacity_factor,
+                name=f"block_{i}",
             )(x, positions)
         x = nn.RMSNorm(dtype=self.dtype, name="final_norm")(x)
         logits = nn.Dense(self.vocab, use_bias=False, dtype=self.dtype,
@@ -148,13 +171,21 @@ class TransformerLM(nn.Module):
 
 def lm_loss(model: TransformerLM, params, tokens, labels, positions):
     """Mean next-token cross entropy; label -1 marks ignored slots (the
-    final token of each sequence, which has no successor)."""
-    logits = model.apply({"params": params}, tokens, positions)
+    final token of each sequence, which has no successor).  Auxiliary
+    losses sown into the ``losses`` collection (the MoE load-balancing
+    term, pre-scaled by its weight) are added on top."""
+    logits, mut = model.apply(
+        {"params": params}, tokens, positions, mutable="losses"
+    )
     valid = labels >= 0
     raw = optax.softmax_cross_entropy_with_integer_labels(
         logits, jnp.maximum(labels, 0)
     )
-    return jnp.sum(raw * valid) / jnp.maximum(jnp.sum(valid), 1)
+    ce = jnp.sum(raw * valid) / jnp.maximum(jnp.sum(valid), 1)
+    aux = sum(
+        jnp.sum(leaf) for leaf in jax.tree_util.tree_leaves(mut)
+    )
+    return ce + aux
 
 
 def lm_train_step(model, tx, params, opt_state, tokens, labels, positions):
@@ -185,41 +216,60 @@ def synthetic_lm_batch(
 
 
 def make_lm_mesh(
-    devices=None, seq: int = 2, model: int = 2
+    devices=None, seq: int = 2, model: int = 2, expert: int = 1
 ) -> Mesh:
-    """``data × seq × model`` mesh: data parallelism outermost (its psum
-    gradients tolerate the slowest links), sequence and tensor parallelism
-    on the inner, physically-closest axes."""
+    """``data × expert × seq × model`` mesh: data parallelism outermost
+    (its psum gradients tolerate the slowest links), expert next (the EP
+    all-to-all rides with the batch split — tokens are sharded over
+    ``(data, expert)`` jointly), sequence and tensor parallelism on the
+    inner, physically-closest axes."""
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
-    if n % (seq * model):
-        raise ValueError(f"{n} devices not divisible by seq*model={seq * model}")
+    if n % (seq * model * expert):
+        raise ValueError(
+            f"{n} devices not divisible by "
+            f"expert*seq*model={expert * seq * model}"
+        )
     grid = mesh_utils.create_device_mesh(
-        (n // (seq * model), seq, model), devices=devices
+        (n // (expert * seq * model), expert, seq, model), devices=devices
     )
-    return Mesh(grid, axis_names=("data", "seq", "model"))
+    return Mesh(grid, axis_names=("data", "expert", "seq", "model"))
 
 
-def _lm_pspec(path, leaf) -> P:
+def _lm_pspec(path, leaf, axes=("data", "expert", "seq", "model")) -> P:
     """Megatron-style tensor parallelism on the ``model`` axis: qkv/up
     projections column-split, out/down projections row-split, lm_head
     vocab-split; embeddings and norms replicated (vocab stays small in the
     example configs; a production config would vocab-split the embedding
-    the same way as lm_head)."""
+    the same way as lm_head).  MoE expert stacks ([E, D, F] / [E, F, D])
+    are expert-split on their leading axis and model-split on the FFN
+    hidden dim — EP × TP within each expert.  *axes* is the mesh's axis
+    set; any split whose axis the mesh lacks degrades to replication, so
+    legacy 3-axis meshes still work with MoE params."""
     name = "/".join(
         str(getattr(p, "key", getattr(p, "name", p))) for p in path
     )
+    ex = "expert" if "expert" in axes else None
+    mdl = "model" if "model" in axes else None
+    if leaf.ndim == 3 and "experts" in name:
+        if "experts_up" in name:
+            return P(ex, None, mdl)
+        return P(ex, mdl, None)
     if leaf.ndim == 2:
         if "qkv" in name or "mlp_up" in name or "lm_head" in name:
-            return P(None, "model")
+            return P(None, mdl)
         if "out_proj" in name or "mlp_down" in name:
-            return P("model", None)
+            return P(mdl, None)
     return P()
 
 
 def lm_tree_shardings(mesh: Mesh, tree):
+    axes = tuple(mesh.axis_names)
     return jax.tree_util.tree_map_with_path(
-        lambda path, leaf: NamedSharding(mesh, _lm_pspec(path, leaf)), tree
+        lambda path, leaf: NamedSharding(
+            mesh, _lm_pspec(path, leaf, axes)
+        ),
+        tree,
     )
 
 
@@ -236,20 +286,37 @@ def make_lm_train_step(
     rng: Optional[jax.Array] = None,
     batch: int = 4,
     seq_len: int = 64,
+    n_experts: int = 0,
+    moe_k: int = 2,
+    moe_capacity_factor: float = 1.25,
 ):
     """Build a fully sharded LM train step over *mesh*.
 
     With *seq_axis* set, attention runs as causal ring attention over that
     mesh axis (``attn_layout``: "contiguous" or the balanced "zigzag");
-    activations are [data, seq]-sharded, parameters model-split per
-    ``_lm_pspec``.  Returns (step, state, place) where ``place(tokens,
-    labels, positions)`` applies the ingress layout (zig-zag permutation
-    when selected) and device placement.
+    activations are [data(,expert), seq]-sharded, parameters model-split
+    per ``_lm_pspec``.  With ``n_experts > 0`` the MLPs become routed
+    MoE FFNs whose expert stacks shard on the mesh's ``expert`` axis
+    (tokens ride ``(data, expert)`` jointly, so the dispatch/combine
+    einsums lower to the EP all-to-all).  Returns (step, state, place)
+    where ``place(tokens, labels, positions)`` applies the ingress layout
+    (zig-zag permutation when selected) and device placement.
+
+    The returned ``step`` **donates** its params/opt_state arguments (the
+    standard training-loop contract — on TPU the old buffers are freed in
+    place): always rebind to the returned values, and take any host
+    snapshot of ``state["params"]`` (``jax.device_get``) *before* the
+    first call.
     """
     from .ring_attention import make_ring_attention, zigzag_permute
 
     rng = jax.random.PRNGKey(0) if rng is None else rng
     n_seq = mesh.shape[seq_axis] if seq_axis else 1
+    # tokens shard over data and (when present) the expert axis jointly —
+    # EP is a second batch split outside the MoE layers
+    batch_axes = (
+        ("data", "expert") if "expert" in mesh.axis_names else "data"
+    )
 
     if seq_axis:
         # heads ride the model axis too (qkv is model-split; leaving H
@@ -258,7 +325,7 @@ def make_lm_train_step(
         head_axis = (
             "model" if n_heads % mesh.shape.get("model", 1) == 0 else None
         )
-        spec = P("data", seq_axis, head_axis, None)
+        spec = P(batch_axes, seq_axis, head_axis, None)
         ring_fn, _ = make_ring_attention(
             mesh, seq_axis, causal=True, layout=attn_layout, spec=spec
         )
@@ -271,7 +338,8 @@ def make_lm_train_step(
 
     model = TransformerLM(
         vocab=vocab, d_model=d_model, n_heads=n_heads, n_layers=n_layers,
-        d_ff=d_ff, attn_fn=attn,
+        d_ff=d_ff, attn_fn=attn, n_experts=n_experts, moe_k=moe_k,
+        moe_capacity_factor=moe_capacity_factor,
     )
     tokens, labels, positions = synthetic_lm_batch(rng, batch, seq_len, vocab)
     params = model.init(rng, tokens, positions)["params"]
@@ -280,7 +348,7 @@ def make_lm_train_step(
 
     param_sh = lm_tree_shardings(mesh, params)
     opt_sh = lm_tree_shardings(mesh, opt_state)
-    tok_spec = P("data", seq_axis) if seq_axis else P("data", None)
+    tok_spec = P(batch_axes, seq_axis) if seq_axis else P(batch_axes, None)
     tok_sh = NamedSharding(mesh, tok_spec)
     loss_sh = NamedSharding(mesh, P())
 
