@@ -1,0 +1,155 @@
+"""Pipeline parallelism: GPipe-style microbatch pipeline over a mesh axis.
+
+The last member of the workload layer's parallelism set (SURVEY.md §2.4 —
+the reference delegates DP/TP/PP/SP/EP wholesale to its workload images;
+this build ships them natively).  TPU-first shape:
+
+* **One SPMD program, no per-stage processes.**  The pipeline is a
+  ``shard_map`` over a ``pipe`` mesh axis: layer parameters are stacked
+  on a leading layer axis and sharded ``P("pipe", …)``, so stage *s*
+  physically holds only its ``L/S`` layers; activations hop stages with
+  ``lax.ppermute`` — a neighbour ICI transfer, exactly like the ring
+  attention's K/V rotation.
+* **Static schedule.**  The classic GPipe fill/steady/drain schedule is
+  a single ``lax.scan`` over ``n_micro + n_stages - 1`` ticks; every
+  tick does the same work on every rank (inject → local layers →
+  record → shift), so XLA sees one compiled body with no data-dependent
+  control flow.
+* **Backward for free.**  The schedule is written forward-only;
+  ``jax.grad`` transposes it — ``ppermute`` reverses direction, the scan
+  runs backward — into the mirror-image backward pipeline, no hand-rolled
+  schedule needed.
+
+Composition: the batch dimension of the microbatches can stay sharded on
+other mesh axes (``data``), giving DP×PP from one jit; the layer body is
+an arbitrary ``layer_fn`` so TP/MoE layers nest inside stages.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .ring_attention import _shard_map
+
+# layer_fn: (single layer's params pytree, activations) -> activations
+LayerFn = Callable
+
+
+def stack_layer_params(per_layer_params: Sequence) -> object:
+    """Stack per-layer parameter pytrees along a new leading layer axis
+    (the axis the ``pipe`` mesh dimension shards)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_layer_params
+    )
+
+
+def _apply_local_layers(layer_fn: LayerFn, params_local, x):
+    """Run the stage's local layer stack sequentially (scan over the
+    leading layer axis of every params leaf)."""
+    def body(h, layer_params):
+        return layer_fn(layer_params, h), None
+
+    out, _ = lax.scan(body, x, params_local)
+    return out
+
+
+def _pipeline_shard(
+    params_local,
+    inputs,  # [n_micro, mb, ...] local block (batch dims may be sharded)
+    *,
+    layer_fn: LayerFn,
+    axis_name: str,
+    n_stages: int,
+):
+    """Per-rank GPipe schedule: n_micro + n_stages - 1 ticks of
+    inject → local layers → record → ppermute."""
+    n_micro = inputs.shape[0]  # static at trace time — no way to drift
+    stage = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    state0 = jnp.zeros(inputs.shape[1:], inputs.dtype)
+    outputs0 = jnp.zeros_like(inputs)
+
+    def tick(carry, t):
+        state, outputs = carry
+        # stage 0 ingests microbatch t (clamped re-reads past the end are
+        # processed but never recorded — drain-phase bubbles)
+        inject = lax.dynamic_index_in_dim(
+            inputs, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
+        )
+        state = jnp.where(stage == 0, inject, state)
+        state = _apply_local_layers(layer_fn, params_local, state)
+        # the last stage finishes microbatch t-(n_stages-1) at tick t
+        out_idx = t - (n_stages - 1)
+        recorded = lax.dynamic_update_index_in_dim(
+            outputs, state, jnp.clip(out_idx, 0, n_micro - 1), 0
+        )
+        write = jnp.logical_and(stage == n_stages - 1, out_idx >= 0)
+        outputs = jnp.where(write, recorded, outputs)
+        state = lax.ppermute(state, axis_name, perm)
+        return (state, outputs), None
+
+    (_, outputs), _ = lax.scan(
+        tick, (state0, outputs0), jnp.arange(n_micro + n_stages - 1)
+    )
+    # only the last stage holds real outputs; broadcast them to every
+    # rank (psum of a one-hot-by-stage tensor), which also gives the
+    # backward pass its entry point on the last stage
+    return lax.psum(
+        jnp.where(stage == n_stages - 1, outputs, jnp.zeros_like(outputs)),
+        axis_name,
+    )
+
+
+def make_pipeline(
+    mesh: Mesh,
+    layer_fn: LayerFn,
+    stacked_params,
+    pipe_axis: str = "pipe",
+    batch_axes: Optional[str] = "data",
+):
+    """Build a pipelined forward: ``apply(stacked_params, microbatches)``.
+
+    *stacked_params* — pytree with a leading layer axis on every leaf
+    (see :func:`stack_layer_params`); the layer count must divide evenly
+    by ``mesh.shape[pipe_axis]``.  *microbatches* — ``[n_micro, mb, …]``
+    (the microbatch count is read off the input's leading dim at trace
+    time); dimension 1 may additionally be sharded on *batch_axes*
+    (DP×PP).
+
+    Returns ``(apply, params_sharded, in_sharding)`` where ``apply`` is
+    jit-compiled with the stage sharding baked in.
+    """
+    n_stages = mesh.shape[pipe_axis]
+    n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if n_layers % n_stages:
+        raise ValueError(
+            f"{n_layers} layers not divisible by {n_stages} pipeline stages"
+        )
+
+    param_specs = jax.tree_util.tree_map(
+        lambda leaf: P(pipe_axis, *([None] * (leaf.ndim - 1))),
+        stacked_params,
+    )
+    in_spec = P(
+        None, batch_axes if batch_axes in mesh.axis_names else None
+    )
+    body = _shard_map(
+        functools.partial(
+            _pipeline_shard, layer_fn=layer_fn, axis_name=pipe_axis,
+            n_stages=n_stages,
+        ),
+        mesh,
+        in_specs=(param_specs, in_spec),
+        out_specs=in_spec,
+    )
+    params_sharded = jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        stacked_params, param_specs,
+    )
+    return jax.jit(body), params_sharded, NamedSharding(mesh, in_spec)
