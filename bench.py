@@ -33,11 +33,13 @@ def bench_alexnet(platform: str):
     on_accel = platform != "cpu"
     # batch 4096 is the measured throughput knee on v5e-1 with the
     # space-to-depth first conv (29.3k img/s vs 27.3k at 2048, 28.0k at
-    # 3072) — large batches keep the MXU fed and amortize the pooling
-    # memory traffic
+    # 3072, flat 28.2-28.5k through 8192) — large batches keep the MXU
+    # fed and amortize the pooling memory traffic
     batch = 4096 if on_accel else 16
-    warmup, steps = (3, 15) if on_accel else (1, 3)
-    ips, flops = run_single(batch, steps, warmup, want_flops=True)
+    warmup, steps, rounds = (3, 10, 3) if on_accel else (1, 3, 1)
+    ips, flops = run_single(
+        batch, steps, warmup, want_flops=True, rounds=rounds
+    )
     return ips, batch, flops
 
 

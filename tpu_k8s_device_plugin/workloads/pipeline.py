@@ -120,7 +120,8 @@ def make_pipeline(
     by ``mesh.shape[pipe_axis]``.  *microbatches* — ``[n_micro, mb, …]``
     (the microbatch count is read off the input's leading dim at trace
     time); dimension 1 may additionally be sharded on *batch_axes*
-    (DP×PP).
+    (DP×PP).  The default ``"data"`` degrades to replication on meshes
+    without a data axis; an explicitly passed axis must exist.
 
     Returns ``(apply, params_sharded, in_sharding)`` where ``apply`` is
     jit-compiled with the stage sharding baked in.
@@ -136,9 +137,14 @@ def make_pipeline(
         lambda leaf: P(pipe_axis, *([None] * (leaf.ndim - 1))),
         stacked_params,
     )
-    in_spec = P(
-        None, batch_axes if batch_axes in mesh.axis_names else None
-    )
+    if batch_axes is not None and batch_axes not in mesh.axis_names:
+        if batch_axes != "data":  # only the default degrades silently
+            raise ValueError(
+                f"batch_axes {batch_axes!r} is not a mesh axis "
+                f"{tuple(mesh.axis_names)}"
+            )
+        batch_axes = None
+    in_spec = P(None, batch_axes)
     body = _shard_map(
         functools.partial(
             _pipeline_shard, layer_fn=layer_fn, axis_name=pipe_axis,
