@@ -34,6 +34,25 @@ except ImportError:  # pragma: no cover
 
 import pytest  # noqa: E402
 
+# Race-detector analog (SURVEY.md §5: the reference never runs `go test
+# -race`; CI should).  Python has no data-race sanitizer, so the CI
+# race-stress job approximates one: TPU_DP_RACE_STRESS=1 shrinks the
+# interpreter's thread switch interval ~1000x (from 5ms to 5us), forcing
+# preemption inside critical sections that a default-cadence run would
+# almost never interleave, and arms faulthandler so a deadlock dumps all
+# thread stacks instead of timing out silently.  The concurrency-heavy
+# suites (plugin manager lifecycle, health exporter, inotify watcher) are
+# then run repeatedly — see .github/workflows/test.yml `race-stress`.
+if os.environ.get("TPU_DP_RACE_STRESS"):
+    import faulthandler
+
+    sys.setswitchinterval(5e-6)
+    faulthandler.enable()
+    # a deadlock (the event this mode exists to provoke) must dump all
+    # thread stacks and kill the run, not hang CI until the job timeout:
+    # enable() alone only covers fatal signals, not hangs
+    faulthandler.dump_traceback_later(600, exit=True)
+
 
 @pytest.fixture
 def testdata(request):

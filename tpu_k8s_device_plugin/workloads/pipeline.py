@@ -28,7 +28,7 @@ an arbitrary ``layer_fn`` so TP/MoE layers nest inside stages.
 from __future__ import annotations
 
 import functools
-from typing import Callable, Optional, Sequence
+from typing import Callable, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -39,6 +39,8 @@ from .ring_attention import _shard_map
 
 # layer_fn: (single layer's params pytree, activations) -> activations
 LayerFn = Callable
+
+_DEFAULT_BATCH_AXES = object()  # sentinel: only the true default degrades
 
 
 def stack_layer_params(per_layer_params: Sequence) -> object:
@@ -111,7 +113,7 @@ def make_pipeline(
     layer_fn: LayerFn,
     stacked_params,
     pipe_axis: str = "pipe",
-    batch_axes: Optional[str] = "data",
+    batch_axes=_DEFAULT_BATCH_AXES,
 ):
     """Build a pipelined forward: ``apply(stacked_params, microbatches)``.
 
@@ -137,13 +139,13 @@ def make_pipeline(
         lambda leaf: P(pipe_axis, *([None] * (leaf.ndim - 1))),
         stacked_params,
     )
-    if batch_axes is not None and batch_axes not in mesh.axis_names:
-        if batch_axes != "data":  # only the default degrades silently
-            raise ValueError(
-                f"batch_axes {batch_axes!r} is not a mesh axis "
-                f"{tuple(mesh.axis_names)}"
-            )
-        batch_axes = None
+    if batch_axes is _DEFAULT_BATCH_AXES:
+        batch_axes = "data" if "data" in mesh.axis_names else None
+    elif batch_axes is not None and batch_axes not in mesh.axis_names:
+        raise ValueError(
+            f"batch_axes {batch_axes!r} is not a mesh axis "
+            f"{tuple(mesh.axis_names)}"
+        )
     in_spec = P(None, batch_axes)
     body = _shard_map(
         functools.partial(
