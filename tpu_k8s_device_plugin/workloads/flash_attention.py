@@ -1,0 +1,234 @@
+"""Fused flash attention as a Pallas TPU kernel.
+
+The workload layer's hot op, written for the hardware instead of left to
+XLA: the einsum attention in transformer.py materializes the [B, H, T, T]
+score matrix in HBM (O(T²) memory traffic); this kernel streams K/V
+blocks through VMEM and keeps the online-softmax state (running max,
+normalizer, output accumulator) in registers, so HBM traffic is O(T·D)
+and the two matmuls per block stay on the MXU back-to-back
+(flash/blockwise attention, public technique — same math as
+ring_attention._online_softmax_update, one chip instead of a ring).
+
+Design notes, per /opt/skills/guides/pallas_guide.md:
+
+* grid = (B, H, T/block_q): one program per query block; K/V arrive as
+  whole [T, D] VMEM blocks per (batch, head) and are sliced with
+  ``pl.ds`` inside the loop (T·D·2B ≤ ~0.5 MB at T=2k, D=128 — well
+  inside the ~16 MB VMEM budget; block-grid K/V is the next step up).
+* accumulators ride the ``fori_loop`` carry in f32; both matmuls use
+  ``preferred_element_type=f32`` (pitfall #5).
+* causal masking skips entirely-future K blocks by bounding the loop at
+  the query block's diagonal — the FLOP skipping that makes causal
+  flash ~2x the naive masked form; the diagonal block itself is masked
+  with 2D ``broadcasted_iota`` (pitfall #4).
+* backward is recompute-based XLA math: the saved residuals are
+  (q, k, v, o) and ``_reference_bwd`` rebuilds the full softmax from
+  them (the einsum memory profile), wired through ``jax.custom_vjp``
+  (guide "Patterns: Custom VJP"); a Pallas backward kernel working from
+  a saved logsumexp is the next increment.
+
+Layout is [B, T, H, D] to match the rest of the workload layer; the
+kernel itself runs [B, H, T, D] (transposes fuse into neighbours).  On
+non-TPU backends the kernel runs in interpreter mode automatically, so
+the CPU test mesh exercises the identical code path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # TPU memory spaces; absent on some non-TPU installs
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+_NEG_INF = float("-inf")
+
+
+def _block_spec(shape, index_map):
+    """BlockSpec pinned to VMEM (guide pitfall #1) when the TPU memory
+    spaces are importable; plain spec otherwise (interpreter fallback)."""
+    if _VMEM is not None:
+        return pl.BlockSpec(shape, index_map, memory_space=_VMEM)
+    return pl.BlockSpec(shape, index_map)
+
+
+def _attn_kernel(
+    q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, scale: float
+):
+    """One query block vs all (visible) key blocks, online softmax."""
+    qi = pl.program_id(2)
+    block_q, head_dim = q_ref.shape[-2], q_ref.shape[-1]
+    seq_len = k_ref.shape[-2]
+    n_kblocks = seq_len // block_k
+
+    q = q_ref[0, 0]  # [bq, D], input dtype — bf16 feeds the MXU at
+    # full rate; both dots accumulate in f32 via preferred_element_type
+
+    o0 = jnp.zeros((block_q, head_dim), jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+
+    if causal:
+        # visible K blocks: all with start <= this q block's last row
+        hi = lax.div(qi * block_q + block_q + block_k - 1, block_k)
+    else:
+        hi = n_kblocks
+
+    def body(j, carry):
+        o, l, m = carry
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [bq, bk] f32
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        blk_max = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, blk_max)
+        # fully-masked rows keep m=-inf; guard the exp like the ring path
+        safe_m = jnp.where(jnp.isinf(m_new), 0.0, m_new)
+        p = jnp.exp(s - safe_m[:, None])
+        corr = jnp.where(
+            jnp.isinf(m), jnp.where(jnp.isinf(m_new), 1.0, 0.0),
+            jnp.exp(m - safe_m),
+        )
+        l = l * corr + jnp.sum(p, axis=-1)
+        o = o * corr[:, None] + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return o, l, m_new
+
+    o, l, m = lax.fori_loop(0, hi, body, (o0, l0, m0))
+    denom = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (o / denom[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd_bhtd(
+    q, k, v, causal: bool, block_q: int, block_k: int,
+    interpret: bool,
+):
+    """Forward on [B, H, T, D] layout; returns [B, H, T, D]."""
+    B, H, T, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    grid = (B, H, T // block_q)
+    q_spec = _block_spec(
+        (1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)
+    )
+    kv_spec = _block_spec((1, 1, T, D), lambda b, h, i: (b, h, 0, 0))
+    kernel = functools.partial(
+        _attn_kernel, block_k=block_k, causal=causal, scale=scale
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _reference_bwd(q, k, v, o, g, causal: bool):
+    """Standard flash backward from recomputed scores, full-matrix XLA
+    math in f32 (the einsum attention's memory profile — a Pallas
+    backward kernel is the planned next increment)."""
+    qf, kf, vf, of, gf = (
+        t.astype(jnp.float32) for t in (q, k, v, o, g)
+    )
+    D = q.shape[-1]
+    scale = 1.0 / (D ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+    if causal:
+        T, S = s.shape[-2], s.shape[-1]
+        mask = (
+            lax.broadcasted_iota(jnp.int32, (T, S), 0)
+            >= lax.broadcasted_iota(jnp.int32, (T, S), 1)
+        )
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf)
+    delta = jnp.sum(gf * of, axis=-1, keepdims=True)  # [B,H,T,1]
+    ds = p * (dp - delta)
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * scale
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bhtd(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_fwd_bhtd(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_bhtd_fwd(q, k, v, causal, block_q, block_k, interpret):
+    o = _flash_fwd_bhtd(q, k, v, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o)
+
+
+def _flash_bhtd_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, o = res
+    return _reference_bwd(q, k, v, o, g, causal)
+
+
+_flash_bhtd.defvjp(_flash_bhtd_fwd, _flash_bhtd_bwd)
+
+
+def flash_attention(
+    q: jax.Array,  # [B, T, H, D]
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    block_q: int = 256,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Fused attention on [B, T, H, D]; drop-in for
+    ``transformer.local_causal_attention``'s math (natural token order —
+    causality is storage-order-driven here, so zig-zag-permuted layouts
+    must keep using the ring path).
+
+    Block sizes clamp to the sequence length; T must divide by both.
+    ``interpret`` defaults to "compiled on TPU, interpreter elsewhere",
+    so CPU test meshes run the identical kernel.
+    """
+    B, T, H, D = q.shape
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    if T % block_q or T % block_k:
+        raise ValueError(
+            f"seq_len {T} not divisible by block sizes "
+            f"({block_q}, {block_k})"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    out = _flash_bhtd(qt, kt, vt, causal, block_q, block_k, interpret)
+    return out.transpose(0, 2, 1, 3)
+
+
+def flash_causal_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, positions: jax.Array
+) -> jax.Array:
+    """``transformer.AttnFn``-shaped causal adapter: positions must be
+    the natural 0..T-1 order (flash causality is storage-order-driven);
+    use ring attention for permuted layouts."""
+    del positions
+    return flash_attention(q, k, v, causal=True)
