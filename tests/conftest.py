@@ -46,9 +46,9 @@ import pytest  # noqa: E402
 if os.environ.get("TPU_DP_RACE_STRESS"):
     sys.setswitchinterval(5e-6)
     # hang diagnostics come from pytest's built-in faulthandler plugin
-    # (capture-safe, per-test timer): the CI job passes
-    # `-o faulthandler_timeout=120` so a provoked deadlock dumps all
-    # thread stacks instead of silently eating the job timeout
+    # (capture-safe, per-test timer): pyproject sets
+    # faulthandler_timeout=300 for every run — CI tightens it to 120 —
+    # so a provoked deadlock dumps all thread stacks, locally too
 
 
 @pytest.fixture

@@ -9,7 +9,10 @@ sharded variant that scales over a ``jax.sharding.Mesh``.
 """
 
 from .alexnet import AlexNet, create_train_state, train_step
+from .flash_attention import flash_attention, flash_causal_attention
+from .moe import MoEFFN, top_k_routing
 from .parallel import make_mesh, make_sharded_train_step
+from .pipeline import make_pipeline, stack_layer_params
 from .ring_attention import (
     full_attention,
     make_ring_attention,
@@ -20,15 +23,21 @@ from .transformer import TransformerLM, make_lm_mesh, make_lm_train_step
 
 __all__ = [
     "AlexNet",
+    "MoEFFN",
     "TransformerLM",
     "create_train_state",
-    "train_step",
+    "flash_attention",
+    "flash_causal_attention",
     "full_attention",
     "make_lm_mesh",
     "make_lm_train_step",
     "make_mesh",
+    "make_pipeline",
     "make_ring_attention",
     "make_sharded_train_step",
+    "stack_layer_params",
+    "top_k_routing",
+    "train_step",
     "zigzag_permute",
     "zigzag_unpermute",
 ]

@@ -54,6 +54,15 @@ except ImportError:  # pragma: no cover
 _NEG_INF = float("-inf")
 
 
+def _fit_block(T: int, want: int) -> int:
+    """Largest divisor of T at or below *want* (trace-time Python ints;
+    hardware-aligned when T is a multiple of the requested block)."""
+    b = min(want, T)
+    while T % b:
+        b -= 1
+    return b
+
+
 def _block_spec(shape, index_map):
     """BlockSpec pinned to VMEM (guide pitfall #1) when the TPU memory
     spaces are importable; plain spec otherwise (interpreter fallback)."""
@@ -205,18 +214,15 @@ def flash_attention(
     causality is storage-order-driven here, so zig-zag-permuted layouts
     must keep using the ring path).
 
-    Block sizes clamp to the sequence length; T must divide by both.
-    ``interpret`` defaults to "compiled on TPU, interpreter elsewhere",
-    so CPU test meshes run the identical kernel.
+    Block sizes degrade to the largest divisor of T at or below the
+    requested size (T=384 with the 256 default runs at block 192), so
+    any sequence length works; pick power-of-two T for the aligned fast
+    path.  ``interpret`` defaults to "compiled on TPU, interpreter
+    elsewhere", so CPU test meshes run the identical kernel.
     """
     B, T, H, D = q.shape
-    block_q = min(block_q, T)
-    block_k = min(block_k, T)
-    if T % block_q or T % block_k:
-        raise ValueError(
-            f"seq_len {T} not divisible by block sizes "
-            f"({block_q}, {block_k})"
-        )
+    block_q = _fit_block(T, block_q)
+    block_k = _fit_block(T, block_k)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
