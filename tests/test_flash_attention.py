@@ -61,11 +61,24 @@ class TestForward:
 
 class TestBackward:
     @pytest.mark.parametrize("causal", [False, True])
-    def test_gradients_match_oracle(self, causal):
-        q, k, v = qkv((1, 128, 2, 16), seed=3)
+    @pytest.mark.parametrize(
+        "shape,blocks",
+        [
+            ((1, 128, 2, 16), (64, 64)),
+            ((2, 256, 1, 8), (128, 64)),   # bq != bk: dkv diagonal lower
+            ((1, 256, 2, 16), (64, 128)),  # bound exercised both ways
+        ],
+    )
+    def test_gradients_match_oracle(self, causal, shape, blocks):
+        q, k, v = qkv(shape, seed=3)
 
         def flash_loss(q, k, v):
-            return jnp.sum(flash_attention(q, k, v, causal=causal) ** 2)
+            return jnp.sum(
+                flash_attention(
+                    q, k, v, causal=causal,
+                    block_q=blocks[0], block_k=blocks[1],
+                ) ** 2
+            )
 
         def oracle_loss(q, k, v):
             return jnp.sum(full_attention(q, k, v, causal=causal) ** 2)
@@ -75,6 +88,31 @@ class TestBackward:
         for g, w in zip(got, want):
             np.testing.assert_allclose(
                 np.asarray(g), np.asarray(w), atol=5e-4, rtol=5e-4
+            )
+
+    def test_bf16_gradients(self):
+        """bf16 end-to-end: the kernel casts P/dS to bf16 for the MXU
+        (same rounding as the forward's P·V), so compare loosely."""
+        q, k, v = qkv((1, 128, 2, 16), jnp.bfloat16, seed=5)
+
+        def flash_loss(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, causal=True).astype(jnp.float32)
+                ** 2
+            )
+
+        def oracle_loss(q, k, v):
+            return jnp.sum(
+                full_attention(q, k, v, causal=True).astype(jnp.float32)
+                ** 2
+            )
+
+        got = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(oracle_loss, argnums=(0, 1, 2))(q, k, v)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(
+                np.asarray(g, np.float32), np.asarray(w, np.float32),
+                atol=5e-2, rtol=5e-2,
             )
 
 

@@ -21,11 +21,15 @@ Design notes, per /opt/skills/guides/pallas_guide.md:
   the query block's diagonal — the FLOP skipping that makes causal
   flash ~2x the naive masked form; the diagonal block itself is masked
   with 2D ``broadcasted_iota`` (pitfall #4).
-* backward is recompute-based XLA math: the saved residuals are
-  (q, k, v, o) and ``_reference_bwd`` rebuilds the full softmax from
-  them (the einsum memory profile), wired through ``jax.custom_vjp``
-  (guide "Patterns: Custom VJP"); a Pallas backward kernel working from
-  a saved logsumexp is the next increment.
+* backward is Pallas too: the forward saves (q, k, v, o, logsumexp),
+  and two kernels rebuild probabilities blockwise from the logsumexp —
+  ``_dq_kernel`` (grid over query blocks, streams K/V) and
+  ``_dkv_kernel`` (grid over key blocks, streams Q/dO) — so the
+  backward never materializes the [T, T] score matrix either.  The
+  per-row correction term delta = Σ_d dO·O is one cheap XLA
+  elementwise pass.  Causal FLOP skipping mirrors the forward: dq
+  bounds its K loop at the diagonal, dkv *starts* its Q loop there.
+  Wired through ``jax.custom_vjp`` (guide "Patterns: Custom VJP").
 
 Layout is [B, T, H, D] to match the rest of the workload layer; the
 kernel itself runs [B, H, T, D] (transposes fuse into neighbours).  On
@@ -53,6 +57,13 @@ except ImportError:  # pragma: no cover
 
 _NEG_INF = float("-inf")
 
+# Per-row residuals (logsumexp, delta) are stored lane-broadcast as
+# [..., T, _ROW_LANES]: Mosaic requires the last two block dims to be
+# (8, 128)-aligned or whole-array, so a bare [T] row vector cannot be a
+# kernel output; 128 lanes is the minimum aligned tile (same layout as
+# jax.experimental.pallas.ops.tpu.flash_attention's l/m residuals).
+_ROW_LANES = 128
+
 
 def _fit_block(T: int, want: int) -> int:
     """Largest divisor of T at or below *want* (trace-time Python ints;
@@ -71,10 +82,31 @@ def _block_spec(shape, index_map):
     return pl.BlockSpec(shape, index_map)
 
 
+def _causal_mask(s, q_start, k_start):
+    """Mask score block *s* to the causal lower triangle: entry (a, b)
+    survives iff global row q_start+a >= global column k_start+b
+    (2D ``broadcasted_iota`` — guide pitfall #4)."""
+    bq, bk = s.shape
+    q_pos = q_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = k_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+
+def _causal_hi(qi, block_q: int, block_k: int):
+    """First K block strictly past query block *qi*'s diagonal — the
+    exclusive upper bound of the visible K range: ceil((qi+1)·bq / bk)."""
+    return lax.div(qi * block_q + block_q + block_k - 1, block_k)
+
+
 def _attn_kernel(
-    q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, scale: float
+    q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k: int,
+    causal: bool, scale: float,
 ):
-    """One query block vs all (visible) key blocks, online softmax."""
+    """One query block vs all (visible) key blocks, online softmax.
+
+    ``lse_ref`` is only bound when the caller asked for residuals (the
+    custom-VJP forward); the inference path has a single output and
+    skips the logsumexp write entirely."""
     qi = pl.program_id(2)
     block_q, head_dim = q_ref.shape[-2], q_ref.shape[-1]
     seq_len = k_ref.shape[-2]
@@ -87,11 +119,7 @@ def _attn_kernel(
     l0 = jnp.zeros((block_q,), jnp.float32)
     m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
 
-    if causal:
-        # visible K blocks: all with start <= this q block's last row
-        hi = lax.div(qi * block_q + block_q + block_k - 1, block_k)
-    else:
-        hi = n_kblocks
+    hi = _causal_hi(qi, block_q, block_k) if causal else n_kblocks
 
     def body(j, carry):
         o, l, m = carry
@@ -102,13 +130,7 @@ def _attn_kernel(
             preferred_element_type=jnp.float32,
         ) * scale  # [bq, bk] f32
         if causal:
-            q_pos = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = j * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            s = _causal_mask(s, qi * block_q, j * block_k)
         blk_max = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m, blk_max)
         # fully-masked rows keep m=-inf; guard the exp like the ring path
@@ -128,13 +150,26 @@ def _attn_kernel(
     o, l, m = lax.fori_loop(0, hi, body, (o0, l0, m0))
     denom = jnp.where(l == 0.0, 1.0, l)
     o_ref[0, 0] = (o / denom[:, None]).astype(o_ref.dtype)
+    if lse_ref is not None:
+        # logsumexp residual for the Pallas backward: P = exp(S - lse)
+        lse = jnp.where(l == 0.0, _NEG_INF, m + jnp.log(denom))
+        lse_ref[0, 0] = jnp.broadcast_to(
+            lse[:, None], (block_q, _ROW_LANES)
+        )
 
 
 def _flash_fwd_bhtd(
     q, k, v, causal: bool, block_q: int, block_k: int,
-    interpret: bool,
+    interpret: bool, save_residuals: bool = False,
 ):
-    """Forward on [B, H, T, D] layout; returns [B, H, T, D]."""
+    """Forward on [B, H, T, D].
+
+    Returns ``out [B, H, T, D]``, or ``(out, lse)`` when
+    ``save_residuals`` — lse is the per-row logsumexp stored
+    lane-broadcast as ``[B, H, T, _ROW_LANES]`` f32 (see the
+    ``_ROW_LANES`` note; consumers read lane 0).  The inference path
+    leaves residuals off so no lse HBM write is paid.
+    """
     B, H, T, D = q.shape
     scale = 1.0 / (D ** 0.5)
     grid = (B, H, T // block_q)
@@ -145,56 +180,227 @@ def _flash_fwd_bhtd(
     kernel = functools.partial(
         _attn_kernel, block_k=block_k, causal=causal, scale=scale
     )
-    return pl.pallas_call(
+    out_specs = [q_spec]
+    out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
+    if save_residuals:
+        out_specs.append(_block_spec(
+            (1, 1, block_q, _ROW_LANES), lambda b, h, i: (b, h, i, 0)
+        ))
+        out_shape.append(
+            jax.ShapeDtypeStruct((B, H, T, _ROW_LANES), jnp.float32)
+        )
+    result = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[q_spec, kv_spec, kv_spec],
-        out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
     )(q, k, v)
+    return tuple(result) if save_residuals else result[0]
 
 
-def _reference_bwd(q, k, v, o, g, causal: bool):
-    """Standard flash backward from recomputed scores, full-matrix XLA
-    math in f32 (the einsum attention's memory profile — a Pallas
-    backward kernel is the planned next increment)."""
-    qf, kf, vf, of, gf = (
-        t.astype(jnp.float32) for t in (q, k, v, o, g)
-    )
-    D = q.shape[-1]
-    scale = 1.0 / (D ** 0.5)
-    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
-    if causal:
-        T, S = s.shape[-2], s.shape[-1]
-        mask = (
-            lax.broadcasted_iota(jnp.int32, (T, S), 0)
-            >= lax.broadcasted_iota(jnp.int32, (T, S), 1)
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    *, block_k: int, causal: bool, scale: float,
+):
+    """dQ for one query block: stream K/V blocks, rebuild P from lse.
+
+    dS = P ∘ (dO·Vᵀ − delta); dQ = scale · dS·K.  Same causal loop
+    bound as the forward (K blocks past the diagonal contribute 0).
+    """
+    qi = pl.program_id(2)
+    block_q, head_dim = q_ref.shape[-2], q_ref.shape[-1]
+    seq_len = k_ref.shape[-2]
+    n_kblocks = seq_len // block_k
+
+    q = q_ref[0, 0]
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0][:, :1]      # [bq, 1] f32 (lane-broadcast store)
+    delta = delta_ref[0, 0][:, :1]  # [bq, 1] f32
+    hi = _causal_hi(qi, block_q, block_k) if causal else n_kblocks
+
+    def body(j, dq):
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            s = _causal_mask(s, qi * block_q, j * block_k)
+        p = jnp.exp(s - lse)  # masked/-inf rows → exactly 0
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
-        s = jnp.where(mask[None, None], s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
-    dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf)
-    delta = jnp.sum(gf * of, axis=-1, keepdims=True)  # [B,H,T,1]
-    ds = p * (dp - delta)
-    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * scale
-    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * scale
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq = lax.fori_loop(
+        0, hi, body, jnp.zeros((block_q, head_dim), jnp.float32)
+    )
+    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, block_q: int, block_k: int, causal: bool, scale: float,
+):
+    """dK/dV for one key block, one query block per grid step.
+
+    dV = P^T.dO; dK = scale * dS^T.Q.  The query blocks are the innermost
+    (sequential) grid dimension and dk/dv accumulate in f32 directly in
+    the output refs, which stay VMEM-resident across the revisits
+    because their index map ignores that dimension - so VMEM holds one
+    (Q, K, V, dO) block tuple at a time regardless of T.  The causal
+    lower bound mirrors the forward's upper bound: the first query
+    block whose last row reaches this key block is
+    floor(kj*block_k / block_q); earlier query blocks skip the matmuls
+    via ``pl.when`` (FLOPs only — the pipeline still DMAs their Q/dO
+    blocks in; remapping the grid to start at the diagonal would also
+    skip the fetches).
+    """
+    kj, i = pl.program_id(2), pl.program_id(3)
+    block_ksz, head_dim = k_ref.shape[-2], k_ref.shape[-1]
+
+    @pl.when(i == 0)
+    def _init():
+        dk_ref[0, 0] = jnp.zeros((block_ksz, head_dim), jnp.float32)
+        dv_ref[0, 0] = jnp.zeros((block_ksz, head_dim), jnp.float32)
+
+    lo = lax.div(kj * block_k, block_q) if causal else 0
+
+    @pl.when(i >= lo)
+    def _accum():
+        k_blk = k_ref[0, 0]
+        v_blk = v_ref[0, 0]
+        q_blk = q_ref[0, 0]
+        do_blk = do_ref[0, 0]
+        lse = lse_ref[0, 0][:, :1]      # [bq, 1] f32 (lane-broadcast)
+        delta = delta_ref[0, 0][:, :1]  # [bq, 1] f32
+        s = jax.lax.dot_general(
+            q_blk, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            s = _causal_mask(s, i * block_q, kj * block_k)
+        p = jnp.exp(s - lse)
+        dv_ref[0, 0] += jax.lax.dot_general(
+            p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do_blk, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        dk_ref[0, 0] += scale * jax.lax.dot_general(
+            ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+
+def _flash_bwd_bhtd(
+    q, k, v, o, lse, g, causal: bool, block_q: int, block_k: int,
+    interpret: bool,
+):
+    """Pallas backward on [B, H, T, D]: one dq pass (grid over query
+    blocks) + one fused dk/dv pass (grid over key blocks)."""
+    B, H, T, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    # delta_i = Σ_d dO·O per row — one elementwise HBM pass, f32;
+    # stored lane-broadcast like lse so both feed the kernels directly
+    delta = jnp.broadcast_to(
+        jnp.sum(
+            g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+        )[..., None],
+        (B, H, T, _ROW_LANES),
+    )
+
+    blk_spec = lambda bs: _block_spec(  # noqa: E731
+        (1, 1, bs, D), lambda b, h, i: (b, h, i, 0)
+    )
+    full_spec = _block_spec((1, 1, T, D), lambda b, h, i: (b, h, 0, 0))
+    row_blk = lambda bs: _block_spec(  # noqa: E731
+        (1, 1, bs, _ROW_LANES), lambda b, h, i: (b, h, i, 0)
+    )
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, block_k=block_k, causal=causal, scale=scale
+        ),
+        grid=(B, H, T // block_q),
+        in_specs=[
+            blk_spec(block_q), full_spec, full_spec, blk_spec(block_q),
+            row_blk(block_q), row_blk(block_q),
+        ],
+        out_specs=blk_spec(block_q),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    # dkv: 4D grid, query blocks innermost; that dimension must be
+    # sequential ("arbitrary") because dk/dv accumulate across it
+    kblk4 = _block_spec(
+        (1, 1, block_k, D), lambda b, h, kj, i: (b, h, kj, 0)
+    )
+    qblk4 = _block_spec(
+        (1, 1, block_q, D), lambda b, h, kj, i: (b, h, i, 0)
+    )
+    row4 = _block_spec(
+        (1, 1, block_q, _ROW_LANES), lambda b, h, kj, i: (b, h, i, 0)
+    )
+    compiler_params = None
+    if pltpu is not None and not interpret:
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=(
+                "parallel", "parallel", "parallel", "arbitrary"
+            )
+        )
+    dkv_call = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, block_q=block_q, block_k=block_k,
+            causal=causal, scale=scale,
+        ),
+        grid=(B, H, T // block_k, T // block_q),
+        in_specs=[qblk4, kblk4, kblk4, qblk4, row4, row4],
+        out_specs=[kblk4, kblk4],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, jnp.float32),
+            jax.ShapeDtypeStruct(v.shape, jnp.float32),
+        ],
+        interpret=interpret,
+        **(
+            {"compiler_params": compiler_params}
+            if compiler_params is not None else {}
+        ),
+    )
+    dk, dv = dkv_call(q, k, v, g, lse, delta)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_bhtd(q, k, v, causal, block_q, block_k, interpret):
+    # primal (inference) path: no residuals, no lse HBM write
     return _flash_fwd_bhtd(q, k, v, causal, block_q, block_k, interpret)
 
 
 def _flash_bhtd_fwd(q, k, v, causal, block_q, block_k, interpret):
-    o = _flash_fwd_bhtd(q, k, v, causal, block_q, block_k, interpret)
-    return o, (q, k, v, o)
+    o, lse = _flash_fwd_bhtd(
+        q, k, v, causal, block_q, block_k, interpret, save_residuals=True
+    )
+    return o, (q, k, v, o, lse)
 
 
 def _flash_bhtd_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v, o = res
-    return _reference_bwd(q, k, v, o, g, causal)
+    q, k, v, o, lse = res
+    return _flash_bwd_bhtd(
+        q, k, v, o, lse, g, causal, block_q, block_k, interpret
+    )
 
 
 _flash_bhtd.defvjp(_flash_bhtd_fwd, _flash_bhtd_bwd)
