@@ -329,6 +329,15 @@ def _flash_bwd_bhtd(
         (1, 1, bs, _ROW_LANES), lambda b, h, i: (b, h, i, 0)
     )
 
+    # dimension_semantics lets Mosaic split "parallel" grid dims across
+    # TensorCores on megacore parts; dkv's innermost (query-block) dim
+    # must stay sequential ("arbitrary") because dk/dv accumulate
+    # across it.  compiler_params stays None in interpreter mode.
+    def _semantics(*dims):
+        if pltpu is None or interpret:
+            return None
+        return pltpu.CompilerParams(dimension_semantics=dims)
+
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, block_k=block_k, causal=causal, scale=scale
@@ -341,10 +350,9 @@ def _flash_bwd_bhtd(
         out_specs=blk_spec(block_q),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=interpret,
+        compiler_params=_semantics("parallel", "parallel", "parallel"),
     )(q, k, v, g, lse, delta)
 
-    # dkv: 4D grid, query blocks innermost; that dimension must be
-    # sequential ("arbitrary") because dk/dv accumulate across it
     kblk4 = _block_spec(
         (1, 1, block_k, D), lambda b, h, kj, i: (b, h, kj, 0)
     )
@@ -354,14 +362,7 @@ def _flash_bwd_bhtd(
     row4 = _block_spec(
         (1, 1, block_q, _ROW_LANES), lambda b, h, kj, i: (b, h, i, 0)
     )
-    compiler_params = None
-    if pltpu is not None and not interpret:
-        compiler_params = pltpu.CompilerParams(
-            dimension_semantics=(
-                "parallel", "parallel", "parallel", "arbitrary"
-            )
-        )
-    dkv_call = pl.pallas_call(
+    dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, block_q=block_q, block_k=block_k,
             causal=causal, scale=scale,
@@ -374,12 +375,10 @@ def _flash_bwd_bhtd(
             jax.ShapeDtypeStruct(v.shape, jnp.float32),
         ],
         interpret=interpret,
-        **(
-            {"compiler_params": compiler_params}
-            if compiler_params is not None else {}
+        compiler_params=_semantics(
+            "parallel", "parallel", "parallel", "arbitrary"
         ),
-    )
-    dk, dv = dkv_call(q, k, v, g, lse, delta)
+    )(q, k, v, g, lse, delta)
     return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 

@@ -15,7 +15,14 @@ RUN make -C native/tpuprobe \
          /install/lib/python3.11/site-packages/tpu_k8s_device_plugin/hostinfo/ \
     && echo "${GIT_DESCRIBE}" > /install/git-describe
 
-FROM registry.access.redhat.com/ubi9/python-311
+FROM registry.access.redhat.com/ubi9/python-311 AS labeller
+COPY --from=builder /install /usr/local
+ENV PYTHONPATH=/usr/local/lib/python3.11/site-packages
+ENTRYPOINT ["/usr/local/bin/k8s-tpu-node-labeller"]
+
+# plugin image last so it is the default target (≈ ubi-dp.Dockerfile;
+# the labeller stage above ≈ the reference's ubi-labeller.Dockerfile)
+FROM registry.access.redhat.com/ubi9/python-311 AS dp
 COPY --from=builder /install /usr/local
 ENV PYTHONPATH=/usr/local/lib/python3.11/site-packages
 ENTRYPOINT ["/usr/local/bin/k8s-tpu-device-plugin"]
