@@ -305,21 +305,22 @@ def _dkv_kernel(
 
 
 def _flash_bwd_bhtd(
-    q, k, v, o, lse, g, causal: bool, block_q: int, block_k: int,
-    interpret: bool,
+    q, k, v, lse, delta, g, causal: bool, block_q: int, block_k: int,
+    interpret: bool, keep_f32: bool = False,
 ):
     """Pallas backward on [B, H, T, D]: one dq pass (grid over query
-    blocks) + one fused dk/dv pass (grid over key blocks)."""
+    blocks) + one fused dk/dv pass (grid over key blocks).
+
+    ``lse``/``delta`` are the per-row logsumexp and Σ_d dO·O in the
+    lane-broadcast [B, H, T, _ROW_LANES] layout.  They need not come
+    from *this* q/k/v — ring attention passes the GLOBAL lse/delta with
+    per-ring-step blocks, which decomposes the exact backward blockwise.
+
+    ``keep_f32`` returns all three gradients in f32 (for callers that
+    accumulate partials, like the ring) instead of the input dtypes.
+    """
     B, H, T, D = q.shape
     scale = 1.0 / (D ** 0.5)
-    # delta_i = Σ_d dO·O per row — one elementwise HBM pass, f32;
-    # stored lane-broadcast like lse so both feed the kernels directly
-    delta = jnp.broadcast_to(
-        jnp.sum(
-            g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
-        )[..., None],
-        (B, H, T, _ROW_LANES),
-    )
 
     blk_spec = lambda bs: _block_spec(  # noqa: E731
         (1, 1, bs, D), lambda b, h, i: (b, h, i, 0)
@@ -348,7 +349,9 @@ def _flash_bwd_bhtd(
             row_blk(block_q), row_blk(block_q),
         ],
         out_specs=blk_spec(block_q),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct(
+            q.shape, jnp.float32 if keep_f32 else q.dtype
+        ),
         interpret=interpret,
         compiler_params=_semantics("parallel", "parallel", "parallel"),
     )(q, k, v, g, lse, delta)
@@ -379,6 +382,8 @@ def _flash_bwd_bhtd(
             "parallel", "parallel", "parallel", "arbitrary"
         ),
     )(q, k, v, g, lse, delta)
+    if keep_f32:
+        return dq, dk, dv
     return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
@@ -397,8 +402,17 @@ def _flash_bhtd_fwd(q, k, v, causal, block_q, block_k, interpret):
 
 def _flash_bhtd_bwd(causal, block_q, block_k, interpret, res, g):
     q, k, v, o, lse = res
+    B, H, T, _ = q.shape
+    # delta_i = Σ_d dO·O per row — one elementwise HBM pass, f32;
+    # stored lane-broadcast like lse so both feed the kernels directly
+    delta = jnp.broadcast_to(
+        jnp.sum(
+            g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+        )[..., None],
+        (B, H, T, _ROW_LANES),
+    )
     return _flash_bwd_bhtd(
-        q, k, v, o, lse, g, causal, block_q, block_k, interpret
+        q, k, v, lse, delta, g, causal, block_q, block_k, interpret
     )
 
 
@@ -443,3 +457,85 @@ def flash_causal_attention(
     use ring attention for permuted layouts."""
     del positions
     return flash_attention(q, k, v, causal=True)
+
+
+# ---------------------------------------------------------------------------
+# Block-level building blocks for ring attention (``ring_attention.py``
+# ``impl="flash"``).  Ring attention composes attention over rotating K/V
+# blocks; these expose the kernels in the composable form: the forward
+# returns the per-block (normalized output, logsumexp) pair that the ring
+# merges across steps, and the backward takes the ring's GLOBAL
+# lse/delta, under which the exact gradient decomposes blockwise.
+# They are not differentiable themselves — the ring wraps the whole
+# rotation in one ``jax.custom_vjp``.
+# ---------------------------------------------------------------------------
+
+
+def _prep_blocks(Tq: int, Tk: int, block_q: int, block_k: int,
+                 interpret: Optional[bool]):
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _fit_block(Tq, block_q), _fit_block(Tk, block_k), interpret
+
+
+def flash_block_forward(
+    q: jax.Array,  # [B, T, H, D]
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    block_q: int = 256,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+):
+    """One attention block pair on [B, T, H, D]: returns
+    ``(o, lse)`` where *o* is normalized over *this* K/V block only and
+    *lse* is the per-row logsumexp ``[B, T, H]`` f32 (−inf for rows with
+    no visible keys).  Partials with these semantics merge exactly:
+    ``o = Σ_s exp(lse_s − lse_tot)·o_s``, ``lse_tot = logaddexp_s``.
+    """
+    if q.shape[1] != k.shape[1]:
+        raise ValueError("flash_block_forward requires Tq == Tk")
+    bq, bk, interpret = _prep_blocks(
+        q.shape[1], k.shape[1], block_q, block_k, interpret
+    )
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    o, lse = _flash_fwd_bhtd(
+        qt, kt, vt, causal, bq, bk, interpret, save_residuals=True
+    )
+    return o.transpose(0, 2, 1, 3), lse[..., 0].transpose(0, 2, 1)
+
+
+def flash_block_grads(
+    q: jax.Array,   # [B, T, H, D]
+    k: jax.Array,
+    v: jax.Array,
+    do: jax.Array,  # [B, T, H, D] upstream gradient
+    lse: jax.Array,    # [B, T, H] f32 — GLOBAL logsumexp
+    delta: jax.Array,  # [B, T, H] f32 — GLOBAL Σ_d dO·O per row
+    causal: bool = False,
+    block_q: int = 256,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+):
+    """Gradient contributions of one block pair given the global
+    softmax statistics: returns ``(dq, dk, dv)`` on [B, T, H, D] in
+    **f32** (callers accumulate partials across blocks — one downcast
+    at the end beats n per-block roundings) — the exact per-block terms
+    of the full backward, so summing dq over K/V blocks and dk/dv over
+    query blocks reproduces the dense gradient.
+    """
+    if q.shape[1] != k.shape[1]:
+        raise ValueError("flash_block_grads requires Tq == Tk")
+    bq, bk, interpret = _prep_blocks(
+        q.shape[1], k.shape[1], block_q, block_k, interpret
+    )
+    qt, kt, vt, dot = (x.transpose(0, 2, 1, 3) for x in (q, k, v, do))
+    lane = lambda r: jnp.broadcast_to(  # noqa: E731 — [B,T,H]→[B,H,T,L]
+        r.transpose(0, 2, 1)[..., None].astype(jnp.float32),
+        (*r.transpose(0, 2, 1).shape, _ROW_LANES),
+    )
+    dq, dk, dv = _flash_bwd_bhtd(
+        qt, kt, vt, lane(lse), lane(delta), dot, causal, bq, bk,
+        interpret, keep_f32=True,
+    )
+    return tuple(x.transpose(0, 2, 1, 3) for x in (dq, dk, dv))

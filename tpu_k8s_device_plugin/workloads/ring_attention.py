@@ -287,9 +287,177 @@ def _ring_attention_shard_zigzag(
     return jnp.concatenate(outs, axis=1)
 
 
+# ---------------------------------------------------------------------------
+# Flash-fused ring attention (impl="flash")
+#
+# Same ring schedule as the contiguous einsum path, but each rank×block
+# interaction runs the Pallas flash kernel (flash_attention.py) instead of
+# materializing the [Tq, Tk] score tile: per-step partials (o_s, lse_s)
+# merge through logsumexp algebra, so per-step HBM traffic is O(T·D) and
+# the score matrix never exists at any scale.  The whole rotation is one
+# jax.custom_vjp: the backward re-rotates K/V around the ring and, per
+# step, reuses the Pallas dq/dkv kernels with the GLOBAL lse/delta (under
+# which the exact gradient decomposes blockwise — see flash_block_grads);
+# dK/dV partial sums ride the ring alongside K/V and arrive home after n
+# rotations.
+# ---------------------------------------------------------------------------
+
+
+def _lse_merge(o_acc, lse_acc, o_s, lse_s):
+    """Merge a new normalized partial (o_s, lse_s) into the running
+    (o_acc, lse_acc).  −inf lse (no visible keys) contributes weight 0;
+    all-−inf rows stay (0, −inf) without producing NaN."""
+    m = jnp.maximum(lse_acc, lse_s)
+    safe_m = jnp.where(jnp.isinf(m), 0.0, m)
+    a = jnp.where(jnp.isinf(lse_acc), 0.0, jnp.exp(lse_acc - safe_m))
+    b = jnp.where(jnp.isinf(lse_s), 0.0, jnp.exp(lse_s - safe_m))
+    tot = a + b
+    denom = jnp.where(tot == 0.0, 1.0, tot)
+    o = (
+        o_acc * (a / denom)[..., None]
+        + o_s.astype(jnp.float32) * (b / denom)[..., None]
+    )
+    lse = jnp.where(tot == 0.0, -jnp.inf, safe_m + jnp.log(denom))
+    return o, lse
+
+
+def _causal_branch(kv_idx, my_idx):
+    """Ring-step branch selector shared by the flash forward and
+    backward: 0 = future block (skip), 1 = diagonal (causal kernel),
+    2 = past (unmasked kernel).  Both directions must agree on which
+    held block is the masked diagonal."""
+    return jnp.where(kv_idx > my_idx, 0, jnp.where(kv_idx == my_idx, 1, 2))
+
+
+def _ring_flash_fwd_impl(q, k, v, axis_name, causal):
+    from .flash_attention import flash_block_forward
+
+    n_blocks = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    perm = [(i, (i + 1) % n_blocks) for i in range(n_blocks)]
+
+    o0 = jnp.zeros((B, Tq, H, D), jnp.float32)
+    lse0 = jnp.full((B, Tq, H), -jnp.inf, jnp.float32)
+
+    def step(carry, s):
+        o_acc, lse_acc, k_blk, v_blk = carry
+        kv_idx = (my_idx - s) % n_blocks
+
+        def merged(blk_causal):
+            o_s, lse_s = flash_block_forward(
+                q, k_blk, v_blk, causal=blk_causal
+            )
+            return _lse_merge(o_acc, lse_acc, o_s, lse_s)
+
+        if causal:
+            # future block: skip; diagonal: causal kernel (local storage
+            # order == global order offset, so the mask aligns); past:
+            # unmasked kernel
+            o_acc, lse_acc = lax.switch(
+                _causal_branch(kv_idx, my_idx),
+                (
+                    lambda: (o_acc, lse_acc),
+                    lambda: merged(True),
+                    lambda: merged(False),
+                ),
+            )
+        else:
+            o_acc, lse_acc = merged(False)
+
+        k_blk, v_blk = lax.cond(
+            s < n_blocks - 1,
+            lambda kb, vb: (
+                lax.ppermute(kb, axis_name, perm),
+                lax.ppermute(vb, axis_name, perm),
+            ),
+            lambda kb, vb: (kb, vb),
+            k_blk, v_blk,
+        )
+        return (o_acc, lse_acc, k_blk, v_blk), None
+
+    (o, lse, _, _), _ = lax.scan(
+        step, (o0, lse0, k, v), jnp.arange(n_blocks)
+    )
+    return o.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ring_flash(q, k, v, axis_name, causal):
+    out, _ = _ring_flash_fwd_impl(q, k, v, axis_name, causal)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal):
+    out, lse = _ring_flash_fwd_impl(q, k, v, axis_name, causal)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, res, g):
+    from .flash_attention import flash_block_grads
+
+    q, k, v, out, lse = res
+    n_blocks = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n_blocks) for i in range(n_blocks)]
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # [B, Tq, H] — global, like lse
+
+    zeros_q = jnp.zeros(q.shape, jnp.float32)
+    zeros_kv = jnp.zeros(k.shape, jnp.float32)
+
+    def step(carry, s):
+        dq_acc, k_blk, v_blk, dk_blk, dv_blk = carry
+        kv_idx = (my_idx - s) % n_blocks
+
+        def grads(blk_causal):
+            # flash_block_grads returns f32 partials — accumulate as-is
+            return flash_block_grads(
+                q, k_blk, v_blk, g, lse, delta, causal=blk_causal
+            )
+
+        if causal:
+            dq_c, dk_c, dv_c = lax.switch(
+                _causal_branch(kv_idx, my_idx),
+                (
+                    lambda: (zeros_q, zeros_kv, zeros_kv),
+                    lambda: grads(True),
+                    lambda: grads(False),
+                ),
+            )
+        else:
+            dq_c, dk_c, dv_c = grads(False)
+        dq_acc = dq_acc + dq_c
+        dk_blk = dk_blk + dk_c
+        dv_blk = dv_blk + dv_c
+
+        # rotate every step (n total): block j's dK/dV partial sums ride
+        # with the block and are home at rank j after the final rotation
+        k_blk, v_blk, dk_blk, dv_blk = (
+            lax.ppermute(x, axis_name, perm)
+            for x in (k_blk, v_blk, dk_blk, dv_blk)
+        )
+        return (dq_acc, k_blk, v_blk, dk_blk, dv_blk), None
+
+    (dq, _, _, dk, dv), _ = lax.scan(
+        step, (zeros_q, k, v, zeros_kv, zeros_kv), jnp.arange(n_blocks)
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def _ring_attention_shard_flash(q, k, v, axis_name, causal):
+    """Per-shard body for impl="flash" (contiguous layout)."""
+    return _ring_flash(q, k, v, axis_name, causal)
+
+
 def make_ring_attention(
     mesh: Mesh, seq_axis: str = "data", causal: bool = False,
     layout: str = "contiguous", spec: Optional[P] = None,
+    impl: str = "einsum",
 ):
     """jit-compiled ring attention over *mesh*: [B, T, H, D] inputs with T
     sharded on *seq_axis*.  Returns (fn, in_sharding).
@@ -304,17 +472,35 @@ def make_ring_attention(
     only T on *seq_axis*) so batch/heads can ride other mesh axes — e.g.
     ``P("data", "seq", "model", None)`` inside a 3-axis LM step.  The ring
     only ever communicates over *seq_axis*; other axes just shrink the
-    local block."""
+    local block.
+
+    ``impl="flash"`` (contiguous layout only) runs each rank×block
+    interaction through the Pallas flash kernel instead of the einsum
+    online-softmax update: no [Tq, Tk] score tile is ever materialized,
+    and the backward re-rotates K/V reusing the Pallas dq/dkv kernels
+    with the global logsumexp.  Differentiable end-to-end like the
+    einsum path."""
     if layout not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown layout {layout!r}")
     if layout == "zigzag" and not causal:
         raise ValueError("zigzag layout only pays off for causal attention")
+    if impl not in ("einsum", "flash"):
+        raise ValueError(f"unknown impl {impl!r}")
+    if impl == "flash" and layout == "zigzag":
+        raise ValueError(
+            "impl='flash' supports the contiguous layout only (the flash "
+            "kernel's causal mask is storage-order-driven)"
+        )
     if spec is None:
         spec = P(None, seq_axis, None, None)
     sharding = NamedSharding(mesh, spec)
     if layout == "zigzag":
         shard_fn = functools.partial(
             _ring_attention_shard_zigzag, axis_name=seq_axis
+        )
+    elif impl == "flash":
+        shard_fn = functools.partial(
+            _ring_attention_shard_flash, axis_name=seq_axis, causal=causal
         )
     else:
         shard_fn = functools.partial(
