@@ -153,3 +153,37 @@ def test_graft_entry_dryrun_multichip():
     import __graft_entry__ as g
 
     g.dryrun_multichip(8)
+
+
+def test_maybe_init_distributed(monkeypatch):
+    """Env-driven multi-host join: no env → no-op; with the
+    example/multihost/jobset.yaml env triple set, initialize() gets the
+    parsed coordinator/process identity."""
+    from tpu_k8s_device_plugin.workloads import bench_main
+
+    for k in (
+        "JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID"
+    ):
+        monkeypatch.delenv(k, raising=False)
+    assert bench_main._maybe_init_distributed() is False
+
+    seen = {}
+    monkeypatch.setattr(
+        jax.distributed, "initialize", lambda **kw: seen.update(kw)
+    )
+    monkeypatch.setenv(
+        "JAX_COORDINATOR_ADDRESS", "alexnet-jax-multihost-0.tpu-slice:8476"
+    )
+    # partial triple: an actionable error naming the missing vars, not a
+    # bare KeyError traceback
+    with pytest.raises(SystemExit, match="JAX_NUM_PROCESSES"):
+        bench_main._maybe_init_distributed()
+
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "2")
+    monkeypatch.setenv("JAX_PROCESS_ID", "1")
+    assert bench_main._maybe_init_distributed() is True
+    assert seen == {
+        "coordinator_address": "alexnet-jax-multihost-0.tpu-slice:8476",
+        "num_processes": 2,
+        "process_id": 1,
+    }

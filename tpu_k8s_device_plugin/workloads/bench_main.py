@@ -116,6 +116,40 @@ def run_sharded(batch: int, steps: int, warmup: int, s2d: bool = True) -> float:
     )
 
 
+def _maybe_init_distributed() -> bool:
+    """Join a multi-host slice when the deployment wired one up.
+
+    example/multihost/jobset.yaml sets JAX_COORDINATOR_ADDRESS (headless
+    Service DNS of the index-0 pod), JAX_NUM_PROCESSES (hosts in the
+    slice), and JAX_PROCESS_ID (the Job completion index); with them
+    present, jax.distributed.initialize() forms the global mesh so
+    jax.devices() spans every host's chips.  Single-host runs leave the
+    env unset and skip this entirely.
+    """
+    import os
+
+    addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if not addr:
+        return False
+    missing = [
+        k for k in ("JAX_NUM_PROCESSES", "JAX_PROCESS_ID")
+        if k not in os.environ
+    ]
+    if missing:
+        raise SystemExit(
+            "JAX_COORDINATOR_ADDRESS is set but "
+            f"{' and '.join(missing)} "
+            "is not; the three variables must be set together "
+            "(see example/multihost/jobset.yaml)"
+        )
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
+        process_id=int(os.environ["JAX_PROCESS_ID"]),
+    )
+    return True
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="alexnet-jax-bench")
     p.add_argument("--batch", type=int, default=256,
@@ -128,6 +162,12 @@ def main(argv=None) -> int:
     if args.steps < 1:
         p.error("--steps must be >= 1")
 
+    distributed = _maybe_init_distributed()
+    if distributed:
+        print(
+            f"joined multi-host slice: process "
+            f"{jax.process_index()}/{jax.process_count()}", flush=True,
+        )
     devs = jax.devices()
     print(f"devices: {len(devs)} x {devs[0].platform}", flush=True)
     if args.sharded:

@@ -432,11 +432,22 @@ def _ring_flash_bwd(axis_name, causal, res, g):
         dk_blk = dk_blk + dk_c
         dv_blk = dv_blk + dv_c
 
-        # rotate every step (n total): block j's dK/dV partial sums ride
-        # with the block and are home at rank j after the final rotation
-        k_blk, v_blk, dk_blk, dv_blk = (
-            lax.ppermute(x, axis_name, perm)
-            for x in (k_blk, v_blk, dk_blk, dv_blk)
+        # dK/dV rotate every step (n total): block j's partial sums ride
+        # with the block and are home at rank j after the final rotation.
+        # K/V skip the last rotation like the forward — their final
+        # position is never read (uniform predicate, so the collective
+        # inside cond is legal).
+        dk_blk, dv_blk = (
+            lax.ppermute(x, axis_name, perm) for x in (dk_blk, dv_blk)
+        )
+        k_blk, v_blk = lax.cond(
+            s < n_blocks - 1,
+            lambda kb, vb: (
+                lax.ppermute(kb, axis_name, perm),
+                lax.ppermute(vb, axis_name, perm),
+            ),
+            lambda kb, vb: (kb, vb),
+            k_blk, v_blk,
         )
         return (dq_acc, k_blk, v_blk, dk_blk, dv_blk), None
 
