@@ -10,6 +10,12 @@ sharded variant that scales over a ``jax.sharding.Mesh``.
 
 from .alexnet import AlexNet, create_train_state, train_step
 from .flash_attention import flash_attention, flash_causal_attention
+from .inference import (
+    DecodeTransformerLM,
+    decode_throughput,
+    greedy_generate,
+    make_decoder,
+)
 from .moe import MoEFFN, top_k_routing
 from .parallel import make_mesh, make_sharded_train_step
 from .pipeline import make_pipeline, stack_layer_params
@@ -23,12 +29,16 @@ from .transformer import TransformerLM, make_lm_mesh, make_lm_train_step
 
 __all__ = [
     "AlexNet",
+    "DecodeTransformerLM",
     "MoEFFN",
     "TransformerLM",
     "create_train_state",
+    "decode_throughput",
     "flash_attention",
     "flash_causal_attention",
     "full_attention",
+    "greedy_generate",
+    "make_decoder",
     "make_lm_mesh",
     "make_lm_train_step",
     "make_mesh",

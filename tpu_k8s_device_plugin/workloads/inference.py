@@ -1,0 +1,318 @@
+"""Autoregressive inference with a KV cache: the serving-side workload.
+
+The reference proves multi-chip serving with an opaque vLLM image
+(/root/reference/example/vllm-serve/deployment.yaml:19-38); this module
+is the native counterpart — a decode engine for the training stack's
+``TransformerLM`` shaped for how TPUs serve:
+
+* **static shapes end-to-end**: the KV cache is a fixed ``[B, T_max, H,
+  Dh]`` buffer per layer written with ``lax.dynamic_update_slice``; one
+  compiled prefill and one compiled decode step serve any request
+  length, so XLA never recompiles as sequences grow.
+* **prefill ≠ decode**: prefill is the MXU-bound pass (whole prompt,
+  causal attention — the same math the train step runs) and fills the
+  cache in one shot; decode is the HBM-bound matvec pass (one token
+  against the cache) driven by ``lax.scan``, so the whole generation
+  loop is a single jit with no host round-trips per token.
+* **same parameters, same math**: the decode graph mirrors
+  ``transformer.TransformerLM``'s module tree name-for-name, so trained
+  params drop in unchanged; the equality is oracle-tested (prefill
+  logits vs the training model, cached greedy decode vs the naive
+  recompute-everything loop) in tests/test_inference.py.
+* **tensor parallelism by sharding**: params shard with the training
+  side's ``lm_tree_shardings`` (Megatron-style splits on the mesh's
+  ``model`` axis); the cache shards on the head axis alongside them.
+  No collectives are written here — XLA places them (SURVEY.md §5
+  "distributed communication backend").
+
+MoE decode (expert caches) is not implemented — dense-FFN configs only,
+matching the flagship single-chip serving bench.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+from jax import lax
+
+from .transformer import COMPUTE_DTYPE, apply_rope, local_causal_attention
+
+
+class CachedBlock(nn.Module):
+    """Transformer block with a decode-mode KV cache.
+
+    Parameter tree is name-identical to ``transformer.Block`` (dense FFN
+    path) so trained params load unchanged.  The cache lives in the flax
+    ``cache`` collection: ``cached_k``/``cached_v`` ``[B, T_max, H, Dh]``
+    plus a scalar ``cache_index`` (the number of valid positions).
+
+    Modes:
+      * prefill (``decode=False``): full-prompt causal attention; writes
+        the prompt's K/V into the cache head and sets ``cache_index``.
+      * decode (``decode=True``): T == 1; appends this step's K/V at
+        ``cache_index`` and attends the query against the whole cache,
+        masked to the valid prefix.
+    """
+
+    d_model: int
+    n_heads: int
+    d_ff: int
+    max_len: int
+    dtype: Any = COMPUTE_DTYPE
+
+    @nn.compact
+    def __call__(
+        self, x: jax.Array, positions: jax.Array, decode: bool = False
+    ) -> jax.Array:
+        B, T, _ = x.shape
+        head_dim = self.d_model // self.n_heads
+        h = nn.RMSNorm(dtype=self.dtype, name="attn_norm")(x)
+        qkv = nn.Dense(3 * self.d_model, use_bias=False, dtype=self.dtype,
+                       name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, T, self.n_heads, head_dim)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        q = apply_rope(q, positions)
+        k = apply_rope(k, positions)
+
+        cache_kwargs = dict(
+            shape=(B, self.max_len, self.n_heads, head_dim),
+            dtype=self.dtype,
+        )
+        cached_k = self.variable(
+            "cache", "cached_k", jnp.zeros, cache_kwargs["shape"],
+            cache_kwargs["dtype"],
+        )
+        cached_v = self.variable(
+            "cache", "cached_v", jnp.zeros, cache_kwargs["shape"],
+            cache_kwargs["dtype"],
+        )
+        cache_index = self.variable(
+            "cache", "cache_index", jnp.zeros, (), jnp.int32
+        )
+
+        if not decode:
+            # prefill: cache head <- prompt K/V; plain causal attention
+            # over the prompt (positions are the natural 0..T-1 here)
+            cached_k.value = lax.dynamic_update_slice(
+                cached_k.value, k, (0, 0, 0, 0)
+            )
+            cached_v.value = lax.dynamic_update_slice(
+                cached_v.value, v, (0, 0, 0, 0)
+            )
+            cache_index.value = jnp.int32(T)
+            # same math as training (the natural prompt order makes the
+            # positions mask == the storage-order causal mask)
+            att = local_causal_attention(q, k, v, positions)
+        else:
+            if T != 1:
+                raise ValueError(f"decode mode expects T == 1, got {T}")
+            idx = cache_index.value
+            cached_k.value = lax.dynamic_update_slice(
+                cached_k.value, k, (0, idx, 0, 0)
+            )
+            cached_v.value = lax.dynamic_update_slice(
+                cached_v.value, v, (0, idx, 0, 0)
+            )
+            cache_index.value = idx + 1
+            att = _decode_attention(
+                q, cached_k.value, cached_v.value, idx + 1
+            )
+
+        att = att.reshape(B, T, self.d_model)
+        x = x + nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
+                         name="out_proj")(att)
+        h = nn.RMSNorm(dtype=self.dtype, name="mlp_norm")(x)
+        h = nn.Dense(self.d_ff, use_bias=False, dtype=self.dtype,
+                     name="mlp_up")(h)
+        h = nn.gelu(h)
+        x = x + nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
+                         name="mlp_down")(h)
+        return x
+
+
+def _decode_attention(q, k_cache, v_cache, length):
+    """One query position against the cache: [B, 1, H, Dh] x
+    [B, T_max, H, Dh], masked to the valid ``length`` prefix.  This is
+    the HBM-bound serving matvec — one cache read per token."""
+    scale = 1.0 / jnp.sqrt(jnp.array(q.shape[-1], jnp.float32))
+    scores = jnp.einsum(
+        "bqhd,bkhd->bqhk", q.astype(jnp.float32),
+        k_cache.astype(jnp.float32),
+    ) * scale
+    valid = jnp.arange(k_cache.shape[1]) < length  # [T_max]
+    scores = jnp.where(valid[None, None, None, :], scores, -jnp.inf)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum(
+        "bqhk,bkhd->bqhd", w, v_cache.astype(jnp.float32)
+    ).astype(q.dtype)
+
+
+class DecodeTransformerLM(nn.Module):
+    """Inference twin of ``transformer.TransformerLM`` (dense FFN):
+    identical parameter tree, plus the KV cache collection."""
+
+    vocab: int
+    d_model: int = 256
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 1024
+    max_len: int = 512
+    dtype: Any = COMPUTE_DTYPE
+
+    @nn.compact
+    def __call__(
+        self, tokens: jax.Array, positions: jax.Array,
+        decode: bool = False,
+    ) -> jax.Array:
+        x = nn.Embed(self.vocab, self.d_model, dtype=self.dtype,
+                     name="embed")(tokens)
+        for i in range(self.n_layers):
+            x = CachedBlock(
+                self.d_model, self.n_heads, self.d_ff,
+                max_len=self.max_len, dtype=self.dtype,
+                name=f"block_{i}",
+            )(x, positions, decode=decode)
+        x = nn.RMSNorm(dtype=self.dtype, name="final_norm")(x)
+        logits = nn.Dense(self.vocab, use_bias=False, dtype=self.dtype,
+                          name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+def make_decoder(
+    vocab: int,
+    d_model: int = 256,
+    n_heads: int = 4,
+    n_layers: int = 2,
+    d_ff: int = 1024,
+    max_len: int = 512,
+) -> "DecodeTransformerLM":
+    return DecodeTransformerLM(
+        vocab=vocab, d_model=d_model, n_heads=n_heads,
+        n_layers=n_layers, d_ff=d_ff, max_len=max_len,
+    )
+
+
+def init_cache(model: DecodeTransformerLM, batch: int):
+    """Fresh all-zero cache pytree (the ``cache`` collection) for a
+    *batch*-sized request — built directly from the config so no tracing
+    of the model is needed to start serving."""
+    head_dim = model.d_model // model.n_heads
+    kv = (batch, model.max_len, model.n_heads, head_dim)
+    return {
+        f"block_{i}": {
+            "cached_k": jnp.zeros(kv, model.dtype),
+            "cached_v": jnp.zeros(kv, model.dtype),
+            "cache_index": jnp.zeros((), jnp.int32),
+        }
+        for i in range(model.n_layers)
+    }
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _prefill(model: DecodeTransformerLM, params, prompt, positions):
+    """Compiled once per (model config, prompt shape) — flax modules are
+    frozen/hashable, so they key the jit cache as static arguments and
+    repeat requests hit the compiled executable."""
+    cache = init_cache(model, prompt.shape[0])
+    logits, mut = model.apply(
+        {"params": params, "cache": cache}, prompt, positions,
+        mutable=["cache"],
+    )
+    first = jnp.argmax(logits[:, -1, :], axis=-1).astype(prompt.dtype)
+    return logits, first, mut["cache"]
+
+
+@functools.partial(jax.jit, static_argnums=(0, 4))
+def _decode(model: DecodeTransformerLM, params, cache, first,
+            n_steps: int, pos0):
+    """The whole generation loop as ONE executable: ``lax.scan`` over
+    decode steps, no per-token host round-trips or retraces.
+
+    The first generated token comes from the prefill logits, so only
+    ``n_steps - 1`` decode forwards run and each step emits the token it
+    just computed — no trailing forward whose output is discarded.
+    """
+
+    def step(carry, _):
+        cache, tok, pos = carry
+        logits, mut = model.apply(
+            {"params": params, "cache": cache},
+            tok[:, None], pos[:, None], decode=True,
+            mutable=["cache"],
+        )
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(tok.dtype)
+        return (mut["cache"], nxt, pos + 1), nxt
+
+    (_, _, _), toks = lax.scan(
+        step, (cache, first, pos0), None, length=n_steps - 1
+    )
+    return jnp.concatenate(
+        [first[:, None], toks.transpose(1, 0)], axis=1
+    )  # [B, n_steps]
+
+
+def greedy_generate(
+    model: DecodeTransformerLM,
+    params,
+    prompt: jax.Array,   # [B, T_prompt] int32
+    n_steps: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Greedy decoding: one jitted prefill + one jitted ``lax.scan`` over
+    ``n_steps`` decode steps.  The executables are cached at module
+    level (model config is a static jit arg), so repeated requests with
+    the same shapes never recompile.
+
+    Returns ``(generated [B, n_steps], prefill_logits [B, T_p, V])``.
+    """
+    B, T_p = prompt.shape
+    if T_p + n_steps > model.max_len:
+        raise ValueError(
+            f"prompt {T_p} + steps {n_steps} exceeds max_len {model.max_len}"
+        )
+    positions = jnp.broadcast_to(
+        jnp.arange(T_p, dtype=jnp.int32), (B, T_p)
+    )
+    logits, first, cache = _prefill(model, params, prompt, positions)
+    pos0 = jnp.full((B,), T_p, jnp.int32)
+    return _decode(model, params, cache, first, n_steps, pos0), logits
+
+
+def decode_throughput(
+    model: DecodeTransformerLM, params, prompt: jax.Array, n_steps: int,
+    rounds: int = 3,
+) -> Dict[str, float]:
+    """tokens/sec of the compiled decode loop — prefill runs once
+    outside the timed region, so this really is the per-token serving
+    rate; best of *rounds* (same de-noising rationale as
+    bench_main._timed_loop)."""
+    import time
+
+    B, T_p = prompt.shape
+    positions = jnp.broadcast_to(
+        jnp.arange(T_p, dtype=jnp.int32), (B, T_p)
+    )
+    _, first, cache = _prefill(model, params, prompt, positions)
+    pos0 = jnp.full((B,), T_p, jnp.int32)
+    generated = _decode(model, params, cache, first, n_steps, pos0)  # warm
+    int(generated[0, -1])  # value-transfer sync (bench_main notes)
+    best = None
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        generated = _decode(model, params, cache, first, n_steps, pos0)
+        int(generated[0, -1])
+        dt = time.perf_counter() - t0
+        best = dt if best is None or dt < best else best
+    return {
+        "tokens_per_sec": B * n_steps / best,
+        "tokens_per_sec_per_seq": n_steps / best,
+        "batch": float(B),
+        "steps": float(n_steps),
+    }
