@@ -15,6 +15,7 @@ from .inference import (
     decode_throughput,
     greedy_generate,
     make_decoder,
+    sample_generate,
 )
 from .moe import MoEFFN, top_k_routing
 from .parallel import make_mesh, make_sharded_train_step
@@ -39,6 +40,7 @@ __all__ = [
     "full_attention",
     "greedy_generate",
     "make_decoder",
+    "sample_generate",
     "make_lm_mesh",
     "make_lm_train_step",
     "make_mesh",

@@ -193,10 +193,11 @@ def make_decoder(
     n_layers: int = 2,
     d_ff: int = 1024,
     max_len: int = 512,
+    dtype: Any = COMPUTE_DTYPE,
 ) -> "DecodeTransformerLM":
     return DecodeTransformerLM(
         vocab=vocab, d_model=d_model, n_heads=n_heads,
-        n_layers=n_layers, d_ff=d_ff, max_len=max_len,
+        n_layers=n_layers, d_ff=d_ff, max_len=max_len, dtype=dtype,
     )
 
 
@@ -226,15 +227,34 @@ def _prefill(model: DecodeTransformerLM, params, prompt, positions):
         {"params": params, "cache": cache}, prompt, positions,
         mutable=["cache"],
     )
-    first = jnp.argmax(logits[:, -1, :], axis=-1).astype(prompt.dtype)
-    return logits, first, mut["cache"]
+    return logits, mut["cache"]
 
 
-@functools.partial(jax.jit, static_argnums=(0, 4))
-def _decode(model: DecodeTransformerLM, params, cache, first,
-            n_steps: int, pos0):
+def _greedy_pick(logits, key, top_k, temperature):
+    """Deterministic next-token rule (ignores the PRNG key)."""
+    del key, top_k, temperature
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def _sample_pick(logits, key, top_k, temperature):
+    """Temperature-scaled, optionally top-k truncated sampling.
+    ``lax.top_k`` (the TPU-lowered primitive — no full vocab sort) gives
+    the k-th value as the truncation threshold."""
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+    if top_k is not None:
+        kth = lax.top_k(scaled, top_k)[0][..., -1:]
+        scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+    return jax.random.categorical(key, scaled).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 4, 6, 7))
+def _decode_loop(model: DecodeTransformerLM, params, cache,
+                 prefill_logits_last, n_steps: int, pos0, top_k, pick,
+                 temperature, rng):
     """The whole generation loop as ONE executable: ``lax.scan`` over
-    decode steps, no per-token host round-trips or retraces.
+    decode steps, no per-token host round-trips or retraces.  One loop
+    serves both decoding modes — *pick* (a static arg) is the
+    next-token rule, greedy or sampled.
 
     The first generated token comes from the prefill logits, so only
     ``n_steps - 1`` decode forwards run and each step emits the token it
@@ -242,17 +262,20 @@ def _decode(model: DecodeTransformerLM, params, cache, first,
     """
 
     def step(carry, _):
-        cache, tok, pos = carry
+        cache, tok, pos, key = carry
+        key, sub = jax.random.split(key)
         logits, mut = model.apply(
             {"params": params, "cache": cache},
             tok[:, None], pos[:, None], decode=True,
             mutable=["cache"],
         )
-        nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(tok.dtype)
-        return (mut["cache"], nxt, pos + 1), nxt
+        nxt = pick(logits[:, -1, :], sub, top_k, temperature)
+        return (mut["cache"], nxt, pos + 1, key), nxt
 
-    (_, _, _), toks = lax.scan(
-        step, (cache, first, pos0), None, length=n_steps - 1
+    rng, sub = jax.random.split(rng)
+    first = pick(prefill_logits_last, sub, top_k, temperature)
+    (_, _, _, _), toks = lax.scan(
+        step, (cache, first, pos0, rng), None, length=n_steps - 1
     )
     return jnp.concatenate(
         [first[:, None], toks.transpose(1, 0)], axis=1
@@ -272,17 +295,55 @@ def greedy_generate(
 
     Returns ``(generated [B, n_steps], prefill_logits [B, T_p, V])``.
     """
+    B, T_p = _check_request(model, prompt, n_steps)
+    positions = jnp.broadcast_to(
+        jnp.arange(T_p, dtype=jnp.int32), (B, T_p)
+    )
+    logits, cache = _prefill(model, params, prompt, positions)
+    pos0 = jnp.full((B,), T_p, jnp.int32)
+    toks = _decode_loop(
+        model, params, cache, logits[:, -1, :], n_steps, pos0, None,
+        _greedy_pick, jnp.float32(1.0), jax.random.PRNGKey(0),
+    )
+    return toks, logits
+
+
+def _check_request(model, prompt, n_steps: int):
     B, T_p = prompt.shape
+    if n_steps < 1:
+        raise ValueError(f"n_steps must be >= 1, got {n_steps}")
     if T_p + n_steps > model.max_len:
         raise ValueError(
             f"prompt {T_p} + steps {n_steps} exceeds max_len {model.max_len}"
         )
+    return B, T_p
+
+
+def sample_generate(
+    model: DecodeTransformerLM,
+    params,
+    prompt: jax.Array,
+    n_steps: int,
+    rng: jax.Array,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+) -> jax.Array:
+    """Stochastic decoding (temperature / top-k), cache-backed and
+    single-scan like :func:`greedy_generate` (same ``_decode_loop``, a
+    sampling pick rule); returns ``generated [B, n_steps]``,
+    reproducible from *rng*.  ``temperature → 0`` recovers greedy."""
+    if top_k is not None and not 1 <= top_k <= model.vocab:
+        raise ValueError(f"top_k {top_k} outside [1, vocab={model.vocab}]")
+    B, T_p = _check_request(model, prompt, n_steps)
     positions = jnp.broadcast_to(
         jnp.arange(T_p, dtype=jnp.int32), (B, T_p)
     )
-    logits, first, cache = _prefill(model, params, prompt, positions)
+    logits, cache = _prefill(model, params, prompt, positions)
     pos0 = jnp.full((B,), T_p, jnp.int32)
-    return _decode(model, params, cache, first, n_steps, pos0), logits
+    return _decode_loop(
+        model, params, cache, logits[:, -1, :], n_steps, pos0, top_k,
+        _sample_pick, jnp.float32(temperature), rng,
+    )
 
 
 def decode_throughput(
@@ -299,14 +360,22 @@ def decode_throughput(
     positions = jnp.broadcast_to(
         jnp.arange(T_p, dtype=jnp.int32), (B, T_p)
     )
-    _, first, cache = _prefill(model, params, prompt, positions)
+    logits, cache = _prefill(model, params, prompt, positions)
+    last = logits[:, -1, :]
     pos0 = jnp.full((B,), T_p, jnp.int32)
-    generated = _decode(model, params, cache, first, n_steps, pos0)  # warm
+
+    def decode():
+        return _decode_loop(
+            model, params, cache, last, n_steps, pos0, None,
+            _greedy_pick, jnp.float32(1.0), jax.random.PRNGKey(0),
+        )
+
+    generated = decode()  # warm/compile
     int(generated[0, -1])  # value-transfer sync (bench_main notes)
     best = None
     for _ in range(rounds):
         t0 = time.perf_counter()
-        generated = _decode(model, params, cache, first, n_steps, pos0)
+        generated = decode()
         int(generated[0, -1])
         dt = time.perf_counter() - t0
         best = dt if best is None or dt < best else best
