@@ -162,21 +162,26 @@ def _flash_fwd_bhtd(
     q, k, v, causal: bool, block_q: int, block_k: int,
     interpret: bool, save_residuals: bool = False,
 ):
-    """Forward on [B, H, T, D].
+    """Forward on [B, H, Tq, D] × [B, H, Tk, D] (Tq may differ from Tk
+    for unmasked cross-block tiles; ``causal`` requires Tq == Tk since
+    the mask is storage-order-aligned).
 
-    Returns ``out [B, H, T, D]``, or ``(out, lse)`` when
+    Returns ``out [B, H, Tq, D]``, or ``(out, lse)`` when
     ``save_residuals`` — lse is the per-row logsumexp stored
-    lane-broadcast as ``[B, H, T, _ROW_LANES]`` f32 (see the
+    lane-broadcast as ``[B, H, Tq, _ROW_LANES]`` f32 (see the
     ``_ROW_LANES`` note; consumers read lane 0).  The inference path
     leaves residuals off so no lse HBM write is paid.
     """
-    B, H, T, D = q.shape
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    if causal and Tq != Tk:
+        raise ValueError("causal flash requires Tq == Tk")
     scale = 1.0 / (D ** 0.5)
-    grid = (B, H, T // block_q)
+    grid = (B, H, Tq // block_q)
     q_spec = _block_spec(
         (1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)
     )
-    kv_spec = _block_spec((1, 1, T, D), lambda b, h, i: (b, h, 0, 0))
+    kv_spec = _block_spec((1, 1, Tk, D), lambda b, h, i: (b, h, 0, 0))
     kernel = functools.partial(
         _attn_kernel, block_k=block_k, causal=causal, scale=scale
     )
@@ -187,7 +192,7 @@ def _flash_fwd_bhtd(
             (1, 1, block_q, _ROW_LANES), lambda b, h, i: (b, h, i, 0)
         ))
         out_shape.append(
-            jax.ShapeDtypeStruct((B, H, T, _ROW_LANES), jnp.float32)
+            jax.ShapeDtypeStruct((B, H, Tq, _ROW_LANES), jnp.float32)
         )
     result = pl.pallas_call(
         kernel,
@@ -308,8 +313,9 @@ def _flash_bwd_bhtd(
     q, k, v, lse, delta, g, causal: bool, block_q: int, block_k: int,
     interpret: bool, keep_f32: bool = False,
 ):
-    """Pallas backward on [B, H, T, D]: one dq pass (grid over query
-    blocks) + one fused dk/dv pass (grid over key blocks).
+    """Pallas backward on [B, H, Tq, D] x [B, H, Tk, D]: one dq pass
+    (grid over query blocks) + one fused dk/dv pass (grid over key
+    blocks).
 
     ``lse``/``delta`` are the per-row logsumexp and Σ_d dO·O in the
     lane-broadcast [B, H, T, _ROW_LANES] layout.  They need not come
@@ -319,13 +325,16 @@ def _flash_bwd_bhtd(
     ``keep_f32`` returns all three gradients in f32 (for callers that
     accumulate partials, like the ring) instead of the input dtypes.
     """
-    B, H, T, D = q.shape
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    if causal and Tq != Tk:
+        raise ValueError("causal flash requires Tq == Tk")
     scale = 1.0 / (D ** 0.5)
 
     blk_spec = lambda bs: _block_spec(  # noqa: E731
         (1, 1, bs, D), lambda b, h, i: (b, h, i, 0)
     )
-    full_spec = _block_spec((1, 1, T, D), lambda b, h, i: (b, h, 0, 0))
+    full_spec = _block_spec((1, 1, Tk, D), lambda b, h, i: (b, h, 0, 0))
     row_blk = lambda bs: _block_spec(  # noqa: E731
         (1, 1, bs, _ROW_LANES), lambda b, h, i: (b, h, i, 0)
     )
@@ -343,7 +352,7 @@ def _flash_bwd_bhtd(
         functools.partial(
             _dq_kernel, block_k=block_k, causal=causal, scale=scale
         ),
-        grid=(B, H, T // block_q),
+        grid=(B, H, Tq // block_q),
         in_specs=[
             blk_spec(block_q), full_spec, full_spec, blk_spec(block_q),
             row_blk(block_q), row_blk(block_q),
@@ -370,7 +379,7 @@ def _flash_bwd_bhtd(
             _dkv_kernel, block_q=block_q, block_k=block_k,
             causal=causal, scale=scale,
         ),
-        grid=(B, H, T // block_k, T // block_q),
+        grid=(B, H, Tk // block_k, Tq // block_q),
         in_specs=[qblk4, kblk4, kblk4, qblk4, row4, row4],
         out_specs=[kblk4, kblk4],
         out_shape=[
@@ -487,14 +496,13 @@ def flash_block_forward(
     block_k: int = 512,
     interpret: Optional[bool] = None,
 ):
-    """One attention block pair on [B, T, H, D]: returns
+    """One attention block pair, [B, Tq, H, D] x [B, Tk, H, D]
+    (Tq != Tk allowed for unmasked tiles; causal needs Tq == Tk): returns
     ``(o, lse)`` where *o* is normalized over *this* K/V block only and
     *lse* is the per-row logsumexp ``[B, T, H]`` f32 (−inf for rows with
     no visible keys).  Partials with these semantics merge exactly:
     ``o = Σ_s exp(lse_s − lse_tot)·o_s``, ``lse_tot = logaddexp_s``.
     """
-    if q.shape[1] != k.shape[1]:
-        raise ValueError("flash_block_forward requires Tq == Tk")
     bq, bk, interpret = _prep_blocks(
         q.shape[1], k.shape[1], block_q, block_k, interpret
     )
@@ -524,8 +532,6 @@ def flash_block_grads(
     of the full backward, so summing dq over K/V blocks and dk/dv over
     query blocks reproduces the dense gradient.
     """
-    if q.shape[1] != k.shape[1]:
-        raise ValueError("flash_block_grads requires Tq == Tk")
     bq, bk, interpret = _prep_blocks(
         q.shape[1], k.shape[1], block_q, block_k, interpret
     )

@@ -132,14 +132,8 @@ def _ring_attention_shard(
         # the final rotation would only restore the original layout for a
         # result we never read — skip it (uniform predicate: collective
         # inside cond is legal because every rank takes the same branch)
-        k_blk, v_blk = lax.cond(
-            s < n_blocks - 1,
-            lambda kb, vb: (
-                lax.ppermute(kb, axis_name, perm),
-                lax.ppermute(vb, axis_name, perm),
-            ),
-            lambda kb, vb: (kb, vb),
-            k_blk, v_blk,
+        k_blk, v_blk = _rotate_kv(
+            k_blk, v_blk, s, n_blocks, axis_name, perm
         )
         return (o, l, m, k_blk, v_blk), None
 
@@ -256,17 +250,11 @@ def _ring_attention_shard_zigzag(
             hi = acc_tile(*hi, q_hi, k_hi, v_hi, diag_mask=True)
             return lo, hi
 
-        branch = jnp.where(j == my_idx, 0, jnp.where(my_idx < j, 1, 2))
+        branch = _zigzag_branch(j, my_idx)
         lo, hi = lax.switch(branch, (on_eq, on_lt, on_gt), lo, hi)
 
-        k_blk, v_blk = lax.cond(
-            s < n_blocks - 1,
-            lambda kb, vb: (
-                lax.ppermute(kb, axis_name, perm),
-                lax.ppermute(vb, axis_name, perm),
-            ),
-            lambda kb, vb: (kb, vb),
-            k_blk, v_blk,
+        k_blk, v_blk = _rotate_kv(
+            k_blk, v_blk, s, n_blocks, axis_name, perm
         )
         return (lo, hi, k_blk, v_blk), None
 
@@ -329,6 +317,30 @@ def _causal_branch(kv_idx, my_idx):
     return jnp.where(kv_idx > my_idx, 0, jnp.where(kv_idx == my_idx, 1, 2))
 
 
+def _zigzag_branch(j, my_idx):
+    """Zig-zag step branch selector shared by every zig-zag body
+    (einsum, flash forward, flash backward): 0 = diagonal (own block),
+    1 = holder-earlier (only the late q half attends, unmasked),
+    2 = holder-later (both q halves attend the early K half)."""
+    return jnp.where(j == my_idx, 0, jnp.where(my_idx < j, 1, 2))
+
+
+def _rotate_kv(k_blk, v_blk, s, n_blocks, axis_name, perm):
+    """One ring hop for the K/V pair, skipping the dead final rotation
+    (its result is never read).  The uniform predicate makes the
+    collective inside ``lax.cond`` legal — every rank takes the same
+    branch at every step."""
+    return lax.cond(
+        s < n_blocks - 1,
+        lambda kb, vb: (
+            lax.ppermute(kb, axis_name, perm),
+            lax.ppermute(vb, axis_name, perm),
+        ),
+        lambda kb, vb: (kb, vb),
+        k_blk, v_blk,
+    )
+
+
 def _ring_flash_fwd_impl(q, k, v, axis_name, causal):
     from .flash_attention import flash_block_forward
 
@@ -365,14 +377,8 @@ def _ring_flash_fwd_impl(q, k, v, axis_name, causal):
         else:
             o_acc, lse_acc = merged(False)
 
-        k_blk, v_blk = lax.cond(
-            s < n_blocks - 1,
-            lambda kb, vb: (
-                lax.ppermute(kb, axis_name, perm),
-                lax.ppermute(vb, axis_name, perm),
-            ),
-            lambda kb, vb: (kb, vb),
-            k_blk, v_blk,
+        k_blk, v_blk = _rotate_kv(
+            k_blk, v_blk, s, n_blocks, axis_name, perm
         )
         return (o_acc, lse_acc, k_blk, v_blk), None
 
@@ -440,14 +446,8 @@ def _ring_flash_bwd(axis_name, causal, res, g):
         dk_blk, dv_blk = (
             lax.ppermute(x, axis_name, perm) for x in (dk_blk, dv_blk)
         )
-        k_blk, v_blk = lax.cond(
-            s < n_blocks - 1,
-            lambda kb, vb: (
-                lax.ppermute(kb, axis_name, perm),
-                lax.ppermute(vb, axis_name, perm),
-            ),
-            lambda kb, vb: (kb, vb),
-            k_blk, v_blk,
+        k_blk, v_blk = _rotate_kv(
+            k_blk, v_blk, s, n_blocks, axis_name, perm
         )
         return (dq_acc, k_blk, v_blk, dk_blk, dv_blk), None
 
@@ -463,6 +463,200 @@ _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 def _ring_attention_shard_flash(q, k, v, axis_name, causal):
     """Per-shard body for impl="flash" (contiguous layout)."""
     return _ring_flash(q, k, v, axis_name, causal)
+
+
+# -- zig-zag layout with the flash kernels ----------------------------------
+#
+# Every zig-zag tile is either unmasked (cross-chunk, fully visible) or a
+# locally-aligned causal diagonal — exactly the two modes the flash
+# kernels provide — so the balanced layout composes with the Pallas path
+# tile-by-tile: per-tile (o, lse) partials merge with _lse_merge per q
+# half, and the backward reuses flash_block_grads with the global
+# lse/delta halves, zero-padding each branch's dK/dV contribution to the
+# full rotating block so the three causal branches stay shape-uniform.
+
+
+def _ring_flash_zz_fwd_impl(q, k, v, axis_name):
+    from .flash_attention import flash_block_forward
+
+    n_blocks = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    C = Tq // 2
+    perm = [(i, (i + 1) % n_blocks) for i in range(n_blocks)]
+
+    def halves(x):
+        return x[:, :C], x[:, C:]
+
+    q_lo, q_hi = halves(q)
+
+    def tile(acc, q_half, k_part, v_part, diag):
+        o_s, lse_s = flash_block_forward(
+            q_half, k_part, v_part, causal=diag
+        )
+        return _lse_merge(*acc, o_s, lse_s)
+
+    def step(carry, s):
+        lo, hi, k_blk, v_blk = carry
+        j = (my_idx - s) % n_blocks
+        k_lo, k_hi = halves(k_blk)
+        v_lo, v_hi = halves(v_blk)
+
+        def on_eq(lo, hi):
+            lo = tile(lo, q_lo, k_lo, v_lo, True)
+            hi = tile(hi, q_hi, k_lo, v_lo, False)
+            hi = tile(hi, q_hi, k_hi, v_hi, True)
+            return lo, hi
+
+        def on_lt(lo, hi):  # i < j: only the late half attends, unmasked
+            return lo, tile(hi, q_hi, k_blk, v_blk, False)
+
+        def on_gt(lo, hi):  # i > j: both halves attend the early K half —
+            # one kernel launch over the concatenated query (both tiles
+            # are unmasked against the same k_lo), halves split after
+            o_s, lse_s = flash_block_forward(q, k_lo, v_lo, causal=False)
+            o_l, o_h = halves(o_s)
+            l_l, l_h = halves(lse_s)
+            return (
+                _lse_merge(*lo, o_l, l_l),
+                _lse_merge(*hi, o_h, l_h),
+            )
+
+        branch = _zigzag_branch(j, my_idx)
+        lo, hi = lax.switch(branch, (on_eq, on_lt, on_gt), lo, hi)
+
+        k_blk, v_blk = _rotate_kv(
+            k_blk, v_blk, s, n_blocks, axis_name, perm
+        )
+        return (lo, hi, k_blk, v_blk), None
+
+    def zeros():
+        return (
+            jnp.zeros((B, C, H, D), jnp.float32),
+            jnp.full((B, C, H), -jnp.inf, jnp.float32),
+        )
+
+    (lo, hi, _, _), _ = lax.scan(
+        step, (zeros(), zeros(), k, v), jnp.arange(n_blocks)
+    )
+    out = jnp.concatenate([lo[0], hi[0]], axis=1).astype(q.dtype)
+    lse = jnp.concatenate([lo[1], hi[1]], axis=1)  # [B, Tq, H]
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _ring_flash_zigzag(q, k, v, axis_name):
+    out, _ = _ring_flash_zz_fwd_impl(q, k, v, axis_name)
+    return out
+
+
+def _ring_flash_zz_fwd(q, k, v, axis_name):
+    out, lse = _ring_flash_zz_fwd_impl(q, k, v, axis_name)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_zz_bwd(axis_name, res, g):
+    from .flash_attention import flash_block_grads
+
+    q, k, v, out, lse = res
+    n_blocks = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    C = Tq // 2
+    perm = [(i, (i + 1) % n_blocks) for i in range(n_blocks)]
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # [B, Tq, H]
+
+    def halves(x):
+        return x[:, :C], x[:, C:]
+
+    q_lo, q_hi = halves(q)
+    g_lo, g_hi = halves(g)
+    lse_lo, lse_hi = halves(lse)
+    delta_lo, delta_hi = halves(delta)
+    zc = jnp.zeros((B, C, H, D), jnp.float32)
+
+    def tile(q_half, k_part, v_part, g_half, lse_half, delta_half, diag):
+        return flash_block_grads(
+            q_half, k_part, v_part, g_half, lse_half, delta_half,
+            causal=diag,
+        )
+
+    def step(carry, s):
+        dq_lo, dq_hi, k_blk, v_blk, dk_blk, dv_blk = carry
+        j = (my_idx - s) % n_blocks
+        k_lo, k_hi = halves(k_blk)
+        v_lo, v_hi = halves(v_blk)
+
+        # each branch returns shape-uniform (dq_lo+, dq_hi+, dk+, dv+)
+        # with dk/dv zero-padded to the full [B, 2C, H, D] block
+        def on_eq():
+            dql, dkl1, dvl1 = tile(
+                q_lo, k_lo, v_lo, g_lo, lse_lo, delta_lo, True
+            )
+            dqh1, dkl2, dvl2 = tile(
+                q_hi, k_lo, v_lo, g_hi, lse_hi, delta_hi, False
+            )
+            dqh2, dkh, dvh = tile(
+                q_hi, k_hi, v_hi, g_hi, lse_hi, delta_hi, True
+            )
+            return (
+                dql, dqh1 + dqh2,
+                jnp.concatenate([dkl1 + dkl2, dkh], axis=1),
+                jnp.concatenate([dvl1 + dvl2, dvh], axis=1),
+            )
+
+        def on_lt():
+            dqh, dkf, dvf = tile(
+                q_hi, k_blk, v_blk, g_hi, lse_hi, delta_hi, False
+            )
+            return jnp.zeros_like(zc), dqh, dkf, dvf
+
+        def on_gt():
+            # one kernel launch over the concatenated query (both tiles
+            # unmasked vs the same k_lo) — dq comes back pre-split and
+            # the two dk_lo/dv_lo partials are already summed inside
+            dq_c, dkl, dvl = tile(
+                q, k_lo, v_lo, g, lse, delta, False
+            )
+            dql, dqh = halves(dq_c)
+            return (
+                dql, dqh,
+                jnp.concatenate([dkl, jnp.zeros_like(zc)], axis=1),
+                jnp.concatenate([dvl, jnp.zeros_like(zc)], axis=1),
+            )
+
+        branch = _zigzag_branch(j, my_idx)
+        dql_c, dqh_c, dk_c, dv_c = lax.switch(branch, (on_eq, on_lt, on_gt))
+        dq_lo = dq_lo + dql_c
+        dq_hi = dq_hi + dqh_c
+        dk_blk = dk_blk + dk_c
+        dv_blk = dv_blk + dv_c
+
+        # dK/dV ride all n rotations home; K/V skip the dead last one
+        dk_blk, dv_blk = (
+            lax.ppermute(x, axis_name, perm) for x in (dk_blk, dv_blk)
+        )
+        k_blk, v_blk = _rotate_kv(
+            k_blk, v_blk, s, n_blocks, axis_name, perm
+        )
+        return (dq_lo, dq_hi, k_blk, v_blk, dk_blk, dv_blk), None
+
+    zkv = jnp.zeros((B, 2 * C, H, D), jnp.float32)
+    (dq_lo, dq_hi, _, _, dk, dv), _ = lax.scan(
+        step, (zc, zc, k, v, zkv, zkv), jnp.arange(n_blocks)
+    )
+    dq = jnp.concatenate([dq_lo, dq_hi], axis=1)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash_zigzag.defvjp(_ring_flash_zz_fwd, _ring_flash_zz_bwd)
+
+
+def _ring_attention_shard_zigzag_flash(q, k, v, axis_name):
+    """Per-shard body for impl="flash", layout="zigzag"."""
+    return _ring_flash_zigzag(q, k, v, axis_name)
 
 
 def make_ring_attention(
@@ -485,27 +679,27 @@ def make_ring_attention(
     only ever communicates over *seq_axis*; other axes just shrink the
     local block.
 
-    ``impl="flash"`` (contiguous layout only) runs each rank×block
-    interaction through the Pallas flash kernel instead of the einsum
-    online-softmax update: no [Tq, Tk] score tile is ever materialized,
-    and the backward re-rotates K/V reusing the Pallas dq/dkv kernels
-    with the global logsumexp.  Differentiable end-to-end like the
-    einsum path."""
+    ``impl="flash"`` runs each rank×block interaction through the
+    Pallas flash kernels instead of the einsum online-softmax update:
+    no [Tq, Tk] score tile is ever materialized, and the backward
+    re-rotates K/V reusing the Pallas dq/dkv kernels with the global
+    logsumexp.  Differentiable end-to-end like the einsum path; composes
+    with both layouts (the zig-zag tiles are all either unmasked or
+    locally-aligned causal, which are exactly the kernels' two modes)."""
     if layout not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown layout {layout!r}")
     if layout == "zigzag" and not causal:
         raise ValueError("zigzag layout only pays off for causal attention")
     if impl not in ("einsum", "flash"):
         raise ValueError(f"unknown impl {impl!r}")
-    if impl == "flash" and layout == "zigzag":
-        raise ValueError(
-            "impl='flash' supports the contiguous layout only (the flash "
-            "kernel's causal mask is storage-order-driven)"
-        )
     if spec is None:
         spec = P(None, seq_axis, None, None)
     sharding = NamedSharding(mesh, spec)
-    if layout == "zigzag":
+    if layout == "zigzag" and impl == "flash":
+        shard_fn = functools.partial(
+            _ring_attention_shard_zigzag_flash, axis_name=seq_axis
+        )
+    elif layout == "zigzag":
         shard_fn = functools.partial(
             _ring_attention_shard_zigzag, axis_name=seq_axis
         )
