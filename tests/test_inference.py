@@ -234,3 +234,74 @@ def test_top_k_out_of_range_rejected(trained):
             dec, params, jnp.zeros((1, 4), jnp.int32), 4,
             jax.random.PRNGKey(0), top_k=CFG["vocab"] + 1,
         )
+
+
+class TestQuantized:
+    def test_quantize_roundtrip_error_bounded(self, trained):
+        """Symmetric per-channel int8: dequantized kernels within half a
+        quantization step of the original, elementwise."""
+        from tpu_k8s_device_plugin.workloads.inference import (
+            quantize_lm_params,
+        )
+
+        _, params = trained
+        qp = quantize_lm_params(params)
+        w = np.asarray(params["block_0"]["qkv"]["kernel"], np.float32)
+        wq = np.asarray(qp["block_0"]["qkv"]["kernel_int8"], np.float32)
+        sc = np.asarray(qp["block_0"]["qkv"]["scale"], np.float32)
+        np.testing.assert_allclose(wq * sc, w, atol=float(sc.max()) / 2 + 1e-7)
+        # untouched leaves pass through unchanged (norms + embeddings)
+        np.testing.assert_array_equal(
+            np.asarray(qp["block_0"]["attn_norm"]["scale"]),
+            np.asarray(params["block_0"]["attn_norm"]["scale"]),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(qp["embed"]["embedding"]),
+            np.asarray(params["embed"]["embedding"]),
+        )
+
+    def test_quantized_decode_close_to_bf16(self, trained):
+        """int8 weight-only decode tracks the unquantized engine: prefill
+        logits within quantization tolerance and generation runs with
+        the converted tree (same request API)."""
+        from tpu_k8s_device_plugin.workloads.inference import (
+            quantize_lm_params,
+        )
+
+        _, params = trained
+        dec = make_decoder(**CFG, max_len=32)
+        qdec = make_decoder(**CFG, max_len=32, quantized=True)
+        qparams = quantize_lm_params(params)
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(11), (2, 6), 0, CFG["vocab"]
+        )
+        toks, logits = greedy_generate(dec, params, prompt, 8)
+        qtoks, qlogits = greedy_generate(qdec, qparams, prompt, 8)
+        assert qtoks.shape == toks.shape
+        assert bool(jnp.all(jnp.isfinite(qlogits)))
+        # int8 error is ~0.4% of each channel's max; logits stay close
+        np.testing.assert_allclose(
+            np.asarray(qlogits), np.asarray(logits), atol=0.1, rtol=0.1
+        )
+
+    def test_quantized_param_structure_matches_init(self, trained):
+        """quantize_lm_params produces exactly the tree the quantized
+        model initializes — drop-in load, like the bf16 path."""
+        from tpu_k8s_device_plugin.workloads.inference import (
+            quantize_lm_params,
+        )
+
+        _, params = trained
+        qdec = make_decoder(**CFG, max_len=32, quantized=True)
+        init_q = qdec.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32),
+            jnp.zeros((1, 4), jnp.int32),
+        )["params"]
+        want = jax.tree_util.tree_map(
+            lambda x: (x.shape, str(x.dtype)), init_q
+        )
+        got = jax.tree_util.tree_map(
+            lambda x: (x.shape, str(x.dtype)),
+            quantize_lm_params(params),
+        )
+        assert want == got

@@ -15,6 +15,7 @@ from .inference import (
     decode_throughput,
     greedy_generate,
     make_decoder,
+    quantize_lm_params,
     sample_generate,
 )
 from .moe import MoEFFN, top_k_routing
@@ -40,6 +41,7 @@ __all__ = [
     "full_attention",
     "greedy_generate",
     "make_decoder",
+    "quantize_lm_params",
     "sample_generate",
     "make_lm_mesh",
     "make_lm_train_step",
