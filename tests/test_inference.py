@@ -305,3 +305,53 @@ class TestQuantized:
             quantize_lm_params(params),
         )
         assert want == got
+
+
+class TestMoEDecode:
+    """MoE configs serve through the same cache engine: the decode twin
+    reuses the training MoEFFN, so expert stacks and router load
+    unchanged.  A dropless capacity factor (cf >= E/k) makes routing
+    identical between the growing-sequence oracle and single-token
+    decode, so token agreement is exact."""
+
+    MOE = dict(
+        vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        n_experts=4, moe_capacity_factor=4.0,  # dropless: cap >= T
+    )
+
+    @pytest.fixture(scope="class")
+    def moe_trained(self):
+        rng = jax.random.PRNGKey(12)
+        model = TransformerLM(**self.MOE)
+        tokens = jnp.zeros((2, 8), jnp.int32)
+        params = model.init(rng, tokens)["params"]
+        return model, params
+
+    def test_params_load_unchanged(self, moe_trained):
+        _, params = moe_trained
+        dec = make_decoder(**self.MOE, max_len=32)
+        dec_params = dec.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32),
+            jnp.zeros((1, 4), jnp.int32),
+        )["params"]
+        want = jax.tree_util.tree_map(lambda x: x.shape, params)
+        got = jax.tree_util.tree_map(lambda x: x.shape, dec_params)
+        assert want == got
+
+    def test_cached_moe_decode_matches_recompute_oracle(self, moe_trained):
+        model, params = moe_trained
+        dec = make_decoder(**self.MOE, max_len=32)
+        B, T_p, steps = 2, 6, 10
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(13), (B, T_p), 0, self.MOE["vocab"]
+        )
+        got, _ = greedy_generate(dec, params, prompt, steps)
+
+        seq = prompt
+        for _ in range(steps):
+            logits = model.apply({"params": params}, seq)
+            nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(seq.dtype)
+            seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(seq[:, T_p:])
+        )

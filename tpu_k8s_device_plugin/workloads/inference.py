@@ -25,8 +25,14 @@ is the native counterpart — a decode engine for the training stack's
   No collectives are written here — XLA places them (SURVEY.md §5
   "distributed communication backend").
 
-MoE decode (expert caches) is not implemented — dense-FFN configs only,
-matching the flagship single-chip serving bench.
+MoE configs serve too: ``CachedBlock`` swaps its MLP for the training
+stack's ``MoEFFN`` when ``n_experts > 0`` (same expert stacks, same
+router).  One semantic note — decode routes each token at T=1, so no
+token ever loses a capacity slot to a later one (dropless serving, the
+standard MoE inference behavior); training configs with tight capacity
+factors can drop tokens the decode path keeps.  Use a dropless
+capacity factor (``cf >= n_experts / k``) when exact training/serving
+routing parity matters (the oracle tests do).
 """
 
 from __future__ import annotations
@@ -71,8 +77,11 @@ class QuantDense(nn.Dense):
             lambda rng, shape: jnp.ones(shape, jnp.float32),
             (self.features,),
         )
-        kernel = kernel_q.astype(self.dtype) * scale.astype(self.dtype)
-        return jnp.dot(x.astype(self.dtype), kernel)
+        # scale on the dot OUTPUT, not the kernel: exact f32 per-channel
+        # scaling (no bf16 rounding of the scales), F multiplies instead
+        # of D·F, and HBM still reads int8
+        out = jnp.dot(x.astype(self.dtype), kernel_q.astype(self.dtype))
+        return (out * scale).astype(self.dtype)
 
 
 def quantize_lm_params(params, dtype=jnp.int8):
@@ -125,6 +134,9 @@ class CachedBlock(nn.Module):
     max_len: int
     dtype: Any = COMPUTE_DTYPE
     quantized: bool = False  # weight-only int8 projections (QuantDense)
+    n_experts: int = 0      # >0: MoE FFN (same MoEFFN as training)
+    moe_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(
@@ -193,11 +205,24 @@ class CachedBlock(nn.Module):
         x = x + dense(self.d_model, use_bias=False, dtype=self.dtype,
                       name="out_proj")(att)
         h = nn.RMSNorm(dtype=self.dtype, name="mlp_norm")(x)
-        h = dense(self.d_ff, use_bias=False, dtype=self.dtype,
-                  name="mlp_up")(h)
-        h = nn.gelu(h)
-        x = x + dense(self.d_model, use_bias=False, dtype=self.dtype,
-                      name="mlp_down")(h)
+        if self.n_experts > 0:
+            from .moe import MoEFFN
+
+            # same module as training (param tree matches Block's); at
+            # decode T=1 the token always keeps its top-k slots, so
+            # serving is dropless regardless of capacity_factor
+            x = x + MoEFFN(
+                n_experts=self.n_experts, d_model=self.d_model,
+                d_ff=self.d_ff, k=self.moe_k,
+                capacity_factor=self.moe_capacity_factor,
+                dtype=self.dtype, name="moe",
+            )(h, positions)
+        else:
+            h = dense(self.d_ff, use_bias=False, dtype=self.dtype,
+                      name="mlp_up")(h)
+            h = nn.gelu(h)
+            x = x + dense(self.d_model, use_bias=False, dtype=self.dtype,
+                          name="mlp_down")(h)
         return x
 
 
@@ -230,6 +255,9 @@ class DecodeTransformerLM(nn.Module):
     max_len: int = 512
     dtype: Any = COMPUTE_DTYPE
     quantized: bool = False  # weight-only int8 projections (QuantDense)
+    n_experts: int = 0
+    moe_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(
@@ -242,7 +270,9 @@ class DecodeTransformerLM(nn.Module):
             x = CachedBlock(
                 self.d_model, self.n_heads, self.d_ff,
                 max_len=self.max_len, dtype=self.dtype,
-                quantized=self.quantized,
+                quantized=self.quantized, n_experts=self.n_experts,
+                moe_k=self.moe_k,
+                moe_capacity_factor=self.moe_capacity_factor,
                 name=f"block_{i}",
             )(x, positions, decode=decode)
         x = nn.RMSNorm(dtype=self.dtype, name="final_norm")(x)
@@ -261,11 +291,15 @@ def make_decoder(
     max_len: int = 512,
     dtype: Any = COMPUTE_DTYPE,
     quantized: bool = False,
+    n_experts: int = 0,
+    moe_k: int = 2,
+    moe_capacity_factor: float = 1.25,
 ) -> "DecodeTransformerLM":
     return DecodeTransformerLM(
         vocab=vocab, d_model=d_model, n_heads=n_heads,
         n_layers=n_layers, d_ff=d_ff, max_len=max_len, dtype=dtype,
-        quantized=quantized,
+        quantized=quantized, n_experts=n_experts, moe_k=moe_k,
+        moe_capacity_factor=moe_capacity_factor,
     )
 
 

@@ -47,6 +47,30 @@ def moe_capacity(
     return max(1, math.ceil(k * tokens / n_experts * capacity_factor))
 
 
+def _top_k_gates(router_logits: jax.Array, k: int):
+    """Shared gate computation: softmax probs, top-k (distinct experts),
+    and per-token renormalized gate values — the single source of truth
+    for both the dense dispatch plan and the single-token serving path,
+    so the two routes agree exactly."""
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)
+    # renormalize the kept gates so the combine weights sum to 1 per token
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9
+    )
+    return probs, gate_vals, gate_idx
+
+
+def _aux_loss(probs: jax.Array, gate_idx: jax.Array, k: int) -> jax.Array:
+    """Switch load-balancing loss from probs + chosen experts (shared by
+    both paths): E · Σ_e route-fraction(e) · mean-prob(e)."""
+    E = probs.shape[-1]
+    choice_onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)
+    route_frac = jnp.mean(jnp.sum(choice_onehot, axis=2), axis=(0, 1)) / k
+    prob_mean = jnp.mean(probs, axis=(0, 1))
+    return E * jnp.sum(route_frac * prob_mean)
+
+
 def top_k_routing(
     router_logits: jax.Array,  # [B, T, E] (any float dtype; cast to f32)
     k: int,
@@ -76,12 +100,7 @@ def top_k_routing(
     model.
     """
     B, T, E = router_logits.shape
-    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
-    gate_vals, gate_idx = jax.lax.top_k(probs, k)  # [B, T, k]
-    # renormalize the kept gates so the combine weights sum to 1 per token
-    gate_vals = gate_vals / jnp.maximum(
-        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9
-    )
+    probs, gate_vals, gate_idx = _top_k_gates(router_logits, k)
 
     # Flatten (token, choice) in priority order: token-major, then choice
     # rank — token 0's 2nd choice beats token 1's 1st for a capacity slot
@@ -126,12 +145,7 @@ def top_k_routing(
         slot_route * gate_vals[..., None].astype(jnp.float32),
     )
 
-    # Switch aux loss: fraction of (token, choice) routes per expert ×
-    # mean router probability per expert, summed and scaled by E
-    route_frac = jnp.mean(jnp.sum(choice_onehot, axis=2), axis=(0, 1)) / k
-    prob_mean = jnp.mean(probs, axis=(0, 1))
-    aux_loss = E * jnp.sum(route_frac * prob_mean)
-    return dispatch, combine, aux_loss
+    return dispatch, combine, _aux_loss(probs, gate_idx, k)
 
 
 class MoEFFN(nn.Module):
@@ -171,10 +185,6 @@ class MoEFFN(nn.Module):
             "router", nn.initializers.lecun_normal(), (D, E), jnp.float32
         )
         logits = jnp.einsum("btd,de->bte", x.astype(jnp.float32), w_router)
-        dispatch, combine, aux = top_k_routing(
-            logits, self.k, cap, priority=positions
-        )
-        self.sow("losses", "moe_aux", self.aux_weight * aux)
 
         w_up = self.param(
             "experts_up",
@@ -188,6 +198,35 @@ class MoEFFN(nn.Module):
             (E, F, D),
             jnp.float32,
         )
+
+        if T == 1:
+            # Single-token serving path (decode steps): gather ONLY the k
+            # routed experts' stacks instead of streaming all E through
+            # the dense dispatch — at T=1 every route keeps its slot
+            # (dropless), so this is exactly the dense result at k/E of
+            # the weight HBM traffic.  T is static, so the branch is
+            # resolved at trace time; training (T > 1) never takes it.
+            probs, gate_vals, gate_idx = _top_k_gates(logits, self.k)
+            self.sow(
+                "losses", "moe_aux",
+                self.aux_weight * _aux_loss(probs, gate_idx, self.k),
+            )
+            idx = gate_idx[:, 0]  # [B, k]
+            up_sel = w_up[idx].astype(self.dtype)      # [B, k, D, F]
+            down_sel = w_down[idx].astype(self.dtype)  # [B, k, F, D]
+            x_tok = x[:, 0].astype(self.dtype)         # [B, D]
+            h = nn.gelu(jnp.einsum("bd,bkdf->bkf", x_tok, up_sel))
+            out = jnp.einsum("bkf,bkfd->bkd", h, down_sel)
+            y = jnp.einsum(
+                "bk,bkd->bd", gate_vals[:, 0],
+                out.astype(jnp.float32),
+            )
+            return y[:, None].astype(x.dtype)
+
+        dispatch, combine, aux = top_k_routing(
+            logits, self.k, cap, priority=positions
+        )
+        self.sow("losses", "moe_aux", self.aux_weight * aux)
 
         # dense dispatch → batched expert matmuls → weighted combine.
         # [B,T,E,C]×[B,T,D] → [B,E,C,D]: with tokens data-sharded and
