@@ -314,9 +314,12 @@ class TestMoEDecode:
     identical between the growing-sequence oracle and single-token
     decode, so token agreement is exact."""
 
+    # f32 so the exact-token assertion can't flip on an argmax
+    # near-tie between the two (differently-contracted) FFN routes
     MOE = dict(
         vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
         n_experts=4, moe_capacity_factor=4.0,  # dropless: cap >= T
+        dtype=jnp.float32,
     )
 
     @pytest.fixture(scope="class")
@@ -355,3 +358,29 @@ class TestMoEDecode:
         np.testing.assert_array_equal(
             np.asarray(got), np.asarray(seq[:, T_p:])
         )
+
+
+def test_flash_prefill_matches_einsum_prefill(trained, monkeypatch):
+    """Long prompts prefill through the Pallas flash kernel; lowering
+    the threshold forces that path on a short prompt and the logits
+    must match the einsum prefill."""
+    from tpu_k8s_device_plugin.workloads import inference
+
+    _, params = trained
+    dec = make_decoder(**CFG, max_len=64, dtype=jnp.float32)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(15), (2, 16), 0, CFG["vocab"]
+    )
+    pos = jnp.broadcast_to(jnp.arange(16, dtype=jnp.int32), (2, 16))
+    want, _ = dec.apply(
+        {"params": params, "cache": init_cache(dec, 2)}, prompt, pos,
+        mutable=["cache"],
+    )
+    monkeypatch.setattr(inference, "_FLASH_PREFILL_MIN_T", 8)
+    got, _ = dec.apply(
+        {"params": params, "cache": init_cache(dec, 2)}, prompt, pos,
+        mutable=["cache"],
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )

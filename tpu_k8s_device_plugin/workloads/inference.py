@@ -47,6 +47,10 @@ from jax import lax
 
 from .transformer import COMPUTE_DTYPE, apply_rope, local_causal_attention
 
+# prompts at or above this length prefill through the Pallas flash
+# kernel (no [T, T] score materialization); shorter ones use the einsum
+_FLASH_PREFILL_MIN_T = 512
+
 
 class QuantDense(nn.Dense):
     """Weight-only int8 Dense: the kernel is stored as int8 with a
@@ -115,8 +119,10 @@ def quantize_lm_params(params, dtype=jnp.int8):
 class CachedBlock(nn.Module):
     """Transformer block with a decode-mode KV cache.
 
-    Parameter tree is name-identical to ``transformer.Block`` (dense FFN
-    path) so trained params load unchanged.  The cache lives in the flax
+    Parameter tree is name-identical to ``transformer.Block`` (dense or
+    MoE FFN — the MoE branch reuses the training ``MoEFFN`` under the
+    same ``moe`` scope) so trained params load unchanged.  The cache
+    lives in the flax
     ``cache`` collection: ``cached_k``/``cached_v`` ``[B, T_max, H, Dh]``
     plus a scalar ``cache_index`` (the number of valid positions).
 
@@ -184,8 +190,18 @@ class CachedBlock(nn.Module):
             )
             cache_index.value = jnp.int32(T)
             # same math as training (the natural prompt order makes the
-            # positions mask == the storage-order causal mask)
-            att = local_causal_attention(q, k, v, positions)
+            # positions mask == the storage-order causal mask).  Long
+            # prompts take the Pallas flash kernel — O(T·Dh) prefill
+            # memory instead of the [T, T] score matrix; short ones
+            # keep the einsum (kernel launch isn't worth it, and tests
+            # compare against the einsum oracle exactly).  T is static,
+            # so the choice is resolved at trace time.
+            if T >= _FLASH_PREFILL_MIN_T:
+                from .flash_attention import flash_attention
+
+                att = flash_attention(q, k, v, causal=True)
+            else:
+                att = local_causal_attention(q, k, v, positions)
         else:
             if T != 1:
                 raise ValueError(f"decode mode expects T == 1, got {T}")
@@ -244,8 +260,15 @@ def _decode_attention(q, k_cache, v_cache, length):
 
 
 class DecodeTransformerLM(nn.Module):
-    """Inference twin of ``transformer.TransformerLM`` (dense FFN):
-    identical parameter tree, plus the KV cache collection."""
+    """Inference twin of ``transformer.TransformerLM`` (dense or MoE
+    FFN): identical parameter tree, plus the KV cache collection.
+
+    The whole engine assumes natural token order: prefill writes the
+    cache at slots 0..T-1 and decode masks by cache length, so
+    *positions* must be the natural 0..T-1 (which also makes the flash
+    prefill's storage-order causal mask equivalent to the positions
+    mask).  Permuted layouts belong to the training side's ring paths,
+    not serving."""
 
     vocab: int
     d_model: int = 256

@@ -199,13 +199,16 @@ class MoEFFN(nn.Module):
             jnp.float32,
         )
 
-        if T == 1:
+        if T == 1 and B * self.k <= E:
             # Single-token serving path (decode steps): gather ONLY the k
             # routed experts' stacks instead of streaming all E through
             # the dense dispatch — at T=1 every route keeps its slot
             # (dropless), so this is exactly the dense result at k/E of
-            # the weight HBM traffic.  T is static, so the branch is
-            # resolved at trace time; training (T > 1) never takes it.
+            # the weight HBM traffic.  Only taken while B·k ≤ E: the
+            # gather materializes per-token weight copies [B, k, D, F],
+            # so past that point dense dispatch reads fewer bytes.  All
+            # of B/T/k/E are static, so the branch resolves at trace
+            # time; training (T > 1) never takes it.
             probs, gate_vals, gate_idx = _top_k_gates(logits, self.k)
             self.sow(
                 "losses", "moe_aux",
