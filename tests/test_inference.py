@@ -384,3 +384,58 @@ def test_flash_prefill_matches_einsum_prefill(trained, monkeypatch):
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
     )
+
+
+class TestQuantizedMoE:
+    MOE = dict(
+        vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        n_experts=4, moe_capacity_factor=4.0, dtype=jnp.float32,
+    )
+
+    def test_quantized_moe_structure_matches_init(self):
+        """quantize_lm_params converts expert stacks too, matching the
+        quantized MoE model's init tree exactly."""
+        from tpu_k8s_device_plugin.workloads.inference import (
+            quantize_lm_params,
+        )
+
+        model = TransformerLM(**self.MOE)
+        params = model.init(
+            jax.random.PRNGKey(17), jnp.zeros((2, 8), jnp.int32)
+        )["params"]
+        qdec = make_decoder(**self.MOE, max_len=32, quantized=True)
+        init_q = qdec.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32),
+            jnp.zeros((1, 4), jnp.int32),
+        )["params"]
+        want = jax.tree_util.tree_map(
+            lambda x: (x.shape, str(x.dtype)), init_q
+        )
+        got = jax.tree_util.tree_map(
+            lambda x: (x.shape, str(x.dtype)), quantize_lm_params(params)
+        )
+        assert want == got
+
+    def test_quantized_moe_decode_close_to_unquantized(self):
+        from tpu_k8s_device_plugin.workloads.inference import (
+            quantize_lm_params,
+        )
+
+        model = TransformerLM(**self.MOE)
+        params = model.init(
+            jax.random.PRNGKey(18), jnp.zeros((2, 8), jnp.int32)
+        )["params"]
+        dec = make_decoder(**self.MOE, max_len=32)
+        qdec = make_decoder(**self.MOE, max_len=32, quantized=True)
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(19), (2, 6), 0, self.MOE["vocab"]
+        )
+        toks, logits = greedy_generate(dec, params, prompt, 8)
+        qtoks, qlogits = greedy_generate(
+            qdec, quantize_lm_params(params), prompt, 8
+        )
+        assert qtoks.shape == toks.shape
+        assert bool(jnp.all(jnp.isfinite(qlogits)))
+        np.testing.assert_allclose(
+            np.asarray(qlogits), np.asarray(logits), atol=0.1, rtol=0.1
+        )

@@ -90,13 +90,25 @@ class QuantDense(nn.Dense):
 
 def quantize_lm_params(params, dtype=jnp.int8):
     """Convert a trained LM param tree to the weight-only integer layout
-    ``QuantDense`` consumes: every projection ``kernel`` (qkv, out_proj,
-    mlp_up, mlp_down, lm_head) becomes ``{kernel_int8, scale}`` with
-    symmetric per-output-channel scales (``scale = max|w| / qmax``,
-    qmax from ``jnp.iinfo(dtype)``); embeddings and norms stay as-is
-    (a lookup and tiny vectors — not where the bandwidth goes)."""
+    the quantized decode model consumes: every projection ``kernel``
+    (qkv, out_proj, mlp_up, mlp_down, lm_head) becomes
+    ``{kernel_int8, scale}`` and MoE expert stacks become
+    ``{experts_*_int8, experts_*_scale}``, all with symmetric
+    per-output-channel scales (``scale = max|w| / qmax``, qmax from
+    ``jnp.iinfo(dtype)``; expert scales are per (expert, out-channel)).
+    Embeddings, norms, and the router stay as-is (lookups and tiny
+    vectors — not where the bandwidth goes)."""
     quant_names = ("qkv", "out_proj", "mlp_up", "mlp_down", "lm_head")
     qmax = float(jnp.iinfo(dtype).max)
+
+    def quant(w, reduce_axis):
+        w = jnp.asarray(w, jnp.float32)
+        scale = jnp.max(jnp.abs(w), axis=reduce_axis) / qmax
+        scale = jnp.where(scale == 0.0, 1.0, scale)
+        wq = jnp.round(
+            w / jnp.expand_dims(scale, reduce_axis)
+        ).astype(dtype)
+        return wq, scale
 
     def convert(tree, under_quant):
         out = {}
@@ -104,11 +116,15 @@ def quantize_lm_params(params, dtype=jnp.int8):
             if isinstance(sub, dict):
                 out[name] = convert(sub, name in quant_names)
             elif under_quant and name == "kernel":
-                w = jnp.asarray(sub, jnp.float32)
-                scale = jnp.max(jnp.abs(w), axis=0) / qmax
-                scale = jnp.where(scale == 0.0, 1.0, scale)
-                out["kernel_int8"] = jnp.round(w / scale).astype(dtype)
+                wq, scale = quant(sub, 0)
+                out["kernel_int8"] = wq
                 out["scale"] = scale
+            elif name in ("experts_up", "experts_down"):
+                # [E, D, F] / [E, F, D]: contraction axis is 1, so the
+                # per-(expert, out-channel) scale reduces over it
+                wq, scale = quant(sub, 1)
+                out[f"{name}_int8"] = wq
+                out[f"{name}_scale"] = scale
             else:
                 out[name] = sub
         return out
@@ -231,7 +247,7 @@ class CachedBlock(nn.Module):
                 n_experts=self.n_experts, d_model=self.d_model,
                 d_ff=self.d_ff, k=self.moe_k,
                 capacity_factor=self.moe_capacity_factor,
-                dtype=self.dtype, name="moe",
+                dtype=self.dtype, quantized=self.quantized, name="moe",
             )(h, positions)
         else:
             h = dense(self.d_ff, use_bias=False, dtype=self.dtype,

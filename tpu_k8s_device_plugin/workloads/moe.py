@@ -167,6 +167,38 @@ class MoEFFN(nn.Module):
     capacity: Optional[int] = None  # explicit override (tests/oracles)
     aux_weight: float = 1e-2
     dtype: Any = COMPUTE_DTYPE
+    quantized: bool = False  # serving: int8 expert stacks + f32 scales
+
+    def _expert_weights(self, E: int, D: int, F: int):
+        """Expert stacks in one of two layouts: trained f32 (default) or
+        weight-only int8 with per-(expert, output-channel) f32 scales
+        (``quantized`` — serving; convert a trained tree with
+        ``inference.quantize_lm_params``, which converts expert stacks
+        unconditionally alongside the projections).
+        Returns ``(w_up, w_down, up_scale, down_scale)`` where the
+        scales are None in the unquantized layout."""
+        if not self.quantized:
+            w_up = self.param(
+                "experts_up",
+                nn.initializers.lecun_normal(batch_axis=(0,)),
+                (E, D, F),
+                jnp.float32,
+            )
+            w_down = self.param(
+                "experts_down",
+                nn.initializers.lecun_normal(batch_axis=(0,)),
+                (E, F, D),
+                jnp.float32,
+            )
+            return w_up, w_down, None, None
+        zeros_i8 = lambda rng, shape: jnp.zeros(shape, jnp.int8)  # noqa: E731
+        ones_f32 = lambda rng, shape: jnp.ones(shape, jnp.float32)  # noqa: E731
+        return (
+            self.param("experts_up_int8", zeros_i8, (E, D, F)),
+            self.param("experts_down_int8", zeros_i8, (E, F, D)),
+            self.param("experts_up_scale", ones_f32, (E, F)),
+            self.param("experts_down_scale", ones_f32, (E, D)),
+        )
 
     @nn.compact
     def __call__(
@@ -186,18 +218,7 @@ class MoEFFN(nn.Module):
         )
         logits = jnp.einsum("btd,de->bte", x.astype(jnp.float32), w_router)
 
-        w_up = self.param(
-            "experts_up",
-            nn.initializers.lecun_normal(batch_axis=(0,)),
-            (E, D, F),
-            jnp.float32,
-        )
-        w_down = self.param(
-            "experts_down",
-            nn.initializers.lecun_normal(batch_axis=(0,)),
-            (E, F, D),
-            jnp.float32,
-        )
+        w_up, w_down, up_scale, down_scale = self._expert_weights(E, D, F)
 
         if T == 1 and B * self.k <= E:
             # Single-token serving path (decode steps): gather ONLY the k
@@ -218,8 +239,13 @@ class MoEFFN(nn.Module):
             up_sel = w_up[idx].astype(self.dtype)      # [B, k, D, F]
             down_sel = w_down[idx].astype(self.dtype)  # [B, k, F, D]
             x_tok = x[:, 0].astype(self.dtype)         # [B, D]
-            h = nn.gelu(jnp.einsum("bd,bkdf->bkf", x_tok, up_sel))
+            h = jnp.einsum("bd,bkdf->bkf", x_tok, up_sel)
+            if up_scale is not None:  # dequant on the dot output, f32
+                h = (h * up_scale[idx]).astype(self.dtype)
+            h = nn.gelu(h)
             out = jnp.einsum("bkf,bkfd->bkd", h, down_sel)
+            if down_scale is not None:
+                out = (out * down_scale[idx]).astype(self.dtype)
             y = jnp.einsum(
                 "bk,bkd->bd", gate_vals[:, 0],
                 out.astype(jnp.float32),
@@ -239,8 +265,12 @@ class MoEFFN(nn.Module):
             "btec,btd->becd", dispatch.astype(self.dtype), x.astype(self.dtype)
         )
         h = jnp.einsum("becd,edf->becf", xin, w_up.astype(self.dtype))
+        if up_scale is not None:  # dequant on the dot output, f32
+            h = (h * up_scale[None, :, None, :]).astype(self.dtype)
         h = nn.gelu(h)
         out = jnp.einsum("becf,efd->becd", h, w_down.astype(self.dtype))
+        if down_scale is not None:
+            out = (out * down_scale[None, :, None, :]).astype(self.dtype)
         y = jnp.einsum(
             "btec,becd->btd", combine.astype(jnp.float32),
             out.astype(jnp.float32),
