@@ -255,11 +255,24 @@ def _lm_pspec(path, leaf, axes=("data", "expert", "seq", "model")) -> P:
         if "experts_up" in name:
             return P(ex, None, mdl)
         return P(ex, mdl, None)
+    if leaf.ndim == 2 and "experts" in name:
+        # int8 quant scales, per (expert, out-channel): split like the
+        # out-channel of the stack they dequantize ([E,D,F] up → [E,F]
+        # model-split scale; [E,F,D] down → [E,D] unsplit out dim)
+        if "experts_up" in name:
+            return P(ex, mdl)
+        return P(ex, None)
     if leaf.ndim == 2:
         if "qkv" in name or "mlp_up" in name or "lm_head" in name:
             return P(None, mdl)
         if "out_proj" in name or "mlp_down" in name:
             return P(mdl, None)
+    if leaf.ndim == 1 and name.endswith("scale"):
+        # QuantDense per-out-channel scales: follow the kernel's output
+        # dim — column-split projections carry a model-split scale, the
+        # row-split ones an unsplit (replicated) scale
+        if "qkv" in name or "mlp_up" in name or "lm_head" in name:
+            return P(mdl)
     return P()
 
 
