@@ -1,0 +1,181 @@
+"""Llama-family (GQA + SwiGLU + big-theta RoPE) through train + serve.
+
+Oracle strategy mirrors test_inference.py: the cached decode engine
+must match recompute-from-scratch exactly, and the training/serving
+twins must agree on the same parameter tree.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_k8s_device_plugin.workloads import llama
+from tpu_k8s_device_plugin.workloads.inference import (
+    greedy_generate,
+    init_cache,
+    quantize_lm_params,
+)
+from tpu_k8s_device_plugin.workloads.transformer import (
+    lm_tree_shardings,
+    make_lm_mesh,
+    repeat_kv,
+    split_qkv_heads,
+)
+
+CFG = llama.TINY_LLAMA
+DT = jnp.float32  # exactness oracles want f32
+
+
+def _models():
+    train = llama.train_model(CFG, dtype=DT)
+    serve = llama.decoder(CFG, dtype=DT)
+    return train, serve
+
+
+def _init(model, batch=2, seq=16):
+    rng = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(rng, (batch, seq), 0, CFG.vocab)
+    positions = jnp.broadcast_to(
+        jnp.arange(seq, dtype=jnp.int32), (batch, seq))
+    params = model.init(rng, tokens, positions)["params"]
+    return params, tokens, positions
+
+
+def test_param_tree_has_llama_shapes():
+    train, _ = _models()
+    params, _, _ = _init(train)
+    blk = params["block_0"]
+    hd = CFG.head_dim
+    assert blk["qkv"]["kernel"].shape == (
+        CFG.d_model, (CFG.n_heads + 2 * CFG.n_kv_heads) * hd)
+    assert blk["mlp_gate"]["kernel"].shape == (CFG.d_model, CFG.d_ff)
+    assert blk["mlp_up"]["kernel"].shape == (CFG.d_model, CFG.d_ff)
+    assert blk["mlp_down"]["kernel"].shape == (CFG.d_ff, CFG.d_model)
+
+
+def test_train_serve_param_trees_identical():
+    train, serve = _models()
+    p_train, tokens, positions = _init(train)
+    p_serve = serve.init(
+        jax.random.PRNGKey(0), tokens, positions, decode=False)["params"]
+    t1 = jax.tree_util.tree_structure(p_train)
+    t2 = jax.tree_util.tree_structure(p_serve)
+    assert t1 == t2
+    for a, b in zip(jax.tree_util.tree_leaves(p_train),
+                    jax.tree_util.tree_leaves(p_serve)):
+        assert a.shape == b.shape and a.dtype == b.dtype
+
+
+def test_prefill_matches_training_forward():
+    train, serve = _models()
+    params, tokens, positions = _init(train)
+    ref = train.apply({"params": params}, tokens, positions)
+    got, _ = serve.apply(
+        {"params": params, "cache": init_cache(serve, tokens.shape[0])},
+        tokens, positions, decode=False, mutable=["cache"],
+    )
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_cached_decode_matches_recompute_oracle():
+    train, serve = _models()
+    params, tokens, _ = _init(train, batch=2, seq=8)
+    out, _ = greedy_generate(serve, params, tokens, n_steps=6)
+    # oracle: recompute the full forward for every generated token
+    cur = tokens
+    for _ in range(6):
+        T = cur.shape[1]
+        pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32),
+                               (cur.shape[0], T))
+        logits = train.apply({"params": params}, cur, pos)
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(cur.dtype)
+        cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.asarray(cur[:, tokens.shape[1]:]))
+
+
+def test_gqa_cache_is_compact():
+    _, serve = _models()
+    cache = init_cache(serve, batch=2)
+    k = cache["block_0"]["cached_k"]
+    assert k.shape == (2, CFG.max_len, CFG.n_kv_heads, CFG.head_dim)
+
+
+def test_repeat_kv_and_split_helpers():
+    x = jnp.arange(2 * 4 * 2 * 3, dtype=jnp.float32).reshape(2, 4, 2, 3)
+    r = repeat_kv(x, 6)
+    assert r.shape == (2, 4, 6, 3)
+    # each kv head serves a contiguous group of query heads
+    np.testing.assert_array_equal(np.asarray(r[:, :, 0]),
+                                  np.asarray(r[:, :, 1]))
+    qkv = jnp.arange(1 * 2 * (4 + 2 + 2) * 3,
+                     dtype=jnp.float32).reshape(1, 2, 24)
+    q, k, v = split_qkv_heads(qkv, 4, 2, 3)
+    assert q.shape == (1, 2, 4, 3)
+    assert k.shape == (1, 2, 2, 3)
+    assert v.shape == (1, 2, 2, 3)
+
+
+def test_quantized_llama_tree_loads_and_decodes():
+    train, _ = _models()
+    params, tokens, _ = _init(train, batch=1, seq=8)
+    qparams = quantize_lm_params(params)
+    blk = qparams["block_0"]
+    assert "kernel_int8" in blk["mlp_gate"]
+    assert "scale" in blk["mlp_gate"]
+    qserve = llama.decoder(CFG, dtype=DT, quantized=True)
+    out, _ = greedy_generate(qserve, qparams, tokens, n_steps=4)
+    assert out.shape == (1, 4)
+    # int8 path must agree closely with the bf16/f32 path on logits;
+    # greedy tokens can differ in principle, so compare prefill logits
+    serve = llama.decoder(CFG, dtype=DT)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+    ref, _ = serve.apply(
+        {"params": params, "cache": init_cache(serve, 1)},
+        tokens, pos, decode=False, mutable=["cache"])
+    got, _ = qserve.apply(
+        {"params": qparams, "cache": init_cache(qserve, 1)},
+        tokens, pos, decode=False, mutable=["cache"])
+    err = np.max(np.abs(np.asarray(ref) - np.asarray(got)))
+    scale = np.max(np.abs(np.asarray(ref))) + 1e-6
+    assert err / scale < 0.05
+
+
+def test_tp_shardings_cover_llama_params():
+    train, _ = _models()
+    params, _, _ = _init(train)
+    mesh = make_lm_mesh(seq=1, model=2, expert=1)
+    sh = lm_tree_shardings(mesh, params)
+    gate = sh["block_0"]["mlp_gate"]["kernel"].spec
+    assert tuple(gate) == (None, "model")
+    qparams = quantize_lm_params(params)
+    qsh = lm_tree_shardings(mesh, qparams)
+    assert tuple(qsh["block_0"]["mlp_gate"]["scale"].spec) == ("model",)
+    assert tuple(
+        qsh["block_0"]["mlp_gate"]["kernel_int8"].spec) == (None, "model")
+
+
+def test_config_param_count_llama3_8b():
+    # the 8B config must actually be ~8.03B params — guards the config
+    # numbers (a transposed d_ff or head count would show here)
+    n = llama.LLAMA3_8B.n_params()
+    assert 7.9e9 < n < 8.1e9, n
+
+
+def test_rope_theta_changes_long_range_behavior():
+    # same params, different theta ⇒ different logits (theta is wired)
+    a = llama.train_model(CFG, dtype=DT)
+    b = llama.train_model(
+        dataclasses_replace(CFG, rope_theta=10000.0), dtype=DT)
+    params, tokens, positions = _init(a)
+    la = a.apply({"params": params}, tokens, positions)
+    lb = b.apply({"params": params}, tokens, positions)
+    assert float(jnp.max(jnp.abs(la - lb))) > 1e-6
+
+
+def dataclasses_replace(cfg, **kw):
+    import dataclasses
+
+    return dataclasses.replace(cfg, **kw)

@@ -1,0 +1,107 @@
+"""Pallas max-pool: exactness vs flax nn.max_pool / XLA autodiff.
+
+The kernel's contract is bit-exactness — forward values AND gradients,
+including select_and_scatter's first-match tie-break — so every check
+here is equality, not tolerance.  Runs in interpreter mode on the CPU
+test mesh (same code path as the compiled TPU kernel; the compiled
+path is additionally exercised on real hardware by bench.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+import pytest
+
+from tpu_k8s_device_plugin.workloads.pool import max_pool
+
+
+def _ref(x, window, stride):
+    return nn.max_pool(x, (window, window), (stride, stride))
+
+
+def _grads(fn, x):
+    return jax.grad(lambda a: jnp.sum(fn(a).astype(jnp.float32) ** 2))(x)
+
+
+CASES = [
+    ((2, 56, 56, 64), 3, 2),   # AlexNet seg1
+    ((2, 27, 27, 192), 3, 2),  # AlexNet seg2 (odd spatial)
+    ((2, 13, 13, 256), 3, 2),  # AlexNet seg5
+    ((3, 10, 10, 16), 2, 2),   # non-overlapping window
+    ((1, 9, 9, 8), 3, 3),      # stride == window
+    ((2, 8, 12, 4), 3, 1),     # stride 1 (fully overlapping)
+]
+
+
+@pytest.mark.parametrize("shape,window,stride", CASES)
+def test_forward_exact(shape, window, stride):
+    x = jax.random.normal(jax.random.PRNGKey(0), shape, jnp.float32)
+    got = max_pool(x, window, stride, interpret=True)
+    ref = _ref(x, window, stride)
+    assert got.shape == ref.shape
+    assert jnp.array_equal(got, ref)
+
+
+@pytest.mark.parametrize("shape,window,stride", CASES)
+def test_gradient_exact(shape, window, stride):
+    x = jax.random.normal(jax.random.PRNGKey(1), shape, jnp.float32)
+    g_got = _grads(lambda a: max_pool(a, window, stride, interpret=True), x)
+    g_ref = _grads(lambda a: _ref(a, window, stride), x)
+    assert jnp.array_equal(g_got, g_ref)
+
+
+def test_gradient_tie_break_matches_select_and_scatter():
+    # quantized values force many exact ties inside windows; the
+    # gradient must still route every dp element to the same winner
+    # XLA's select_and_scatter picks (first max in row-major order)
+    x = jnp.round(
+        jax.random.normal(jax.random.PRNGKey(2), (4, 20, 20, 8)) * 2
+    ).astype(jnp.float32)
+    g_got = _grads(lambda a: max_pool(a, 3, 2, interpret=True), x)
+    g_ref = _grads(lambda a: _ref(a, 3, 2), x)
+    assert jnp.array_equal(g_got, g_ref)
+
+
+def test_constant_plateau_routes_to_first_offset():
+    # all-equal input: every window is one big tie; the whole pooled
+    # gradient must land on each window's (0, 0) corner
+    x = jnp.ones((1, 5, 5, 4), jnp.float32)
+    g = _grads(lambda a: max_pool(a, 3, 2, interpret=True), x)
+    g_ref = _grads(lambda a: _ref(a, 3, 2), x)
+    assert jnp.array_equal(g, g_ref)
+
+
+def test_bfloat16_exact():
+    x = jax.random.normal(
+        jax.random.PRNGKey(3), (2, 27, 27, 64)).astype(jnp.bfloat16)
+    got = max_pool(x, 3, 2, interpret=True)
+    ref = _ref(x, 3, 2)
+    assert got.dtype == jnp.bfloat16
+    assert jnp.array_equal(
+        got.astype(jnp.float32), ref.astype(jnp.float32))
+    g_got = _grads(lambda a: max_pool(a, 3, 2, interpret=True), x)
+    g_ref = _grads(lambda a: _ref(a, 3, 2), x)
+    assert jnp.array_equal(
+        g_got.astype(jnp.float32), g_ref.astype(jnp.float32))
+
+
+def test_neg_inf_data_survives_padding():
+    # the kernel pads parity planes with -inf; real -inf data must
+    # still pool to -inf and not corrupt neighbours
+    x = jnp.full((1, 7, 7, 8), -jnp.inf, jnp.float32)
+    got = max_pool(x, 3, 2, interpret=True)
+    assert jnp.array_equal(got, _ref(x, 3, 2))
+
+
+def test_jit_and_vmap_compose():
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 13, 13, 8))
+    jitted = jax.jit(lambda a: max_pool(a, 3, 2, interpret=True))
+    assert jnp.array_equal(jitted(x), _ref(x, 3, 2))
+
+
+def test_batch_not_multiple_of_128_padded_correctly():
+    # lane padding path: batch 5 pads to 128 internally, result slices
+    # back losslessly
+    x = jax.random.normal(jax.random.PRNGKey(5), (5, 12, 12, 8))
+    assert jnp.array_equal(
+        max_pool(x, 3, 2, interpret=True), _ref(x, 3, 2))

@@ -45,7 +45,14 @@ import jax.numpy as jnp
 import flax.linen as nn
 from jax import lax
 
-from .transformer import COMPUTE_DTYPE, apply_rope, local_causal_attention
+from .transformer import (
+    COMPUTE_DTYPE,
+    _validate_attn_ffn,
+    apply_rope,
+    local_causal_attention,
+    repeat_kv,
+    split_qkv_heads,
+)
 
 # prompts at or above this length prefill through the Pallas flash
 # kernel (no [T, T] score materialization); shorter ones use the einsum
@@ -91,14 +98,16 @@ class QuantDense(nn.Dense):
 def quantize_lm_params(params, dtype=jnp.int8):
     """Convert a trained LM param tree to the weight-only integer layout
     the quantized decode model consumes: every projection ``kernel``
-    (qkv, out_proj, mlp_up, mlp_down, lm_head) becomes
+    (qkv, out_proj, mlp_up, mlp_gate, mlp_down, lm_head) becomes
     ``{kernel_int8, scale}`` and MoE expert stacks become
     ``{experts_*_int8, experts_*_scale}``, all with symmetric
     per-output-channel scales (``scale = max|w| / qmax``, qmax from
     ``jnp.iinfo(dtype)``; expert scales are per (expert, out-channel)).
     Embeddings, norms, and the router stay as-is (lookups and tiny
     vectors — not where the bandwidth goes)."""
-    quant_names = ("qkv", "out_proj", "mlp_up", "mlp_down", "lm_head")
+    quant_names = (
+        "qkv", "out_proj", "mlp_up", "mlp_gate", "mlp_down", "lm_head"
+    )
     qmax = float(jnp.iinfo(dtype).max)
 
     def quant(w, reduce_axis):
@@ -138,8 +147,9 @@ class CachedBlock(nn.Module):
     Parameter tree is name-identical to ``transformer.Block`` (dense or
     MoE FFN — the MoE branch reuses the training ``MoEFFN`` under the
     same ``moe`` scope) so trained params load unchanged.  The cache
-    lives in the flax
-    ``cache`` collection: ``cached_k``/``cached_v`` ``[B, T_max, H, Dh]``
+    lives in the flax ``cache`` collection: ``cached_k``/``cached_v``
+    ``[B, T_max, Hkv, Dh]`` (the GROUPED head count — with GQA the
+    cache is n_heads/n_kv_heads smaller than the query head count)
     plus a scalar ``cache_index`` (the number of valid positions).
 
     Modes:
@@ -159,6 +169,9 @@ class CachedBlock(nn.Module):
     n_experts: int = 0      # >0: MoE FFN (same MoEFFN as training)
     moe_k: int = 2
     moe_capacity_factor: float = 1.25
+    n_kv_heads: Optional[int] = None  # < n_heads → GQA: cache shrinks H/Hkv
+    ffn: str = "gelu"  # "swiglu" for the Llama MLP
+    rope_theta: float = 10000.0
 
     @nn.compact
     def __call__(
@@ -167,20 +180,20 @@ class CachedBlock(nn.Module):
         B, T, _ = x.shape
         dense = QuantDense if self.quantized else nn.Dense
         head_dim = self.d_model // self.n_heads
+        n_kv = self.n_kv_heads or self.n_heads
+        _validate_attn_ffn(self.n_heads, n_kv, self.ffn)
         h = nn.RMSNorm(dtype=self.dtype, name="attn_norm")(x)
-        qkv = dense(3 * self.d_model, use_bias=False, dtype=self.dtype,
-                    name="qkv")(h)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        qkv = dense((self.n_heads + 2 * n_kv) * head_dim, use_bias=False,
+                    dtype=self.dtype, name="qkv")(h)
+        q, k, v = split_qkv_heads(qkv, self.n_heads, n_kv, head_dim)
+        q = apply_rope(q, positions, self.rope_theta)
+        k = apply_rope(k, positions, self.rope_theta)
 
-        def heads(t):
-            return t.reshape(B, T, self.n_heads, head_dim)
-
-        q, k, v = heads(q), heads(k), heads(v)
-        q = apply_rope(q, positions)
-        k = apply_rope(k, positions)
-
+        # the cache stores the GROUPED heads — the whole point of GQA
+        # serving: cache reads (the decode bandwidth bound) shrink by
+        # n_heads / n_kv_heads
         cache_kwargs = dict(
-            shape=(B, self.max_len, self.n_heads, head_dim),
+            shape=(B, self.max_len, n_kv, head_dim),
             dtype=self.dtype,
         )
         cached_k = self.variable(
@@ -212,12 +225,15 @@ class CachedBlock(nn.Module):
             # keep the einsum (kernel launch isn't worth it, and tests
             # compare against the einsum oracle exactly).  T is static,
             # so the choice is resolved at trace time.
+            # prefill attends at full head count (MXU-bound; the
+            # grouped layout only matters for what the cache STORES)
+            kf, vf = repeat_kv(k, self.n_heads), repeat_kv(v, self.n_heads)
             if T >= _FLASH_PREFILL_MIN_T:
                 from .flash_attention import flash_attention
 
-                att = flash_attention(q, k, v, causal=True)
+                att = flash_attention(q, kf, vf, causal=True)
             else:
-                att = local_causal_attention(q, k, v, positions)
+                att = local_causal_attention(q, kf, vf, positions)
         else:
             if T != 1:
                 raise ValueError(f"decode mode expects T == 1, got {T}")
@@ -249,6 +265,13 @@ class CachedBlock(nn.Module):
                 capacity_factor=self.moe_capacity_factor,
                 dtype=self.dtype, quantized=self.quantized, name="moe",
             )(h, positions)
+        elif self.ffn == "swiglu":
+            gate = dense(self.d_ff, use_bias=False, dtype=self.dtype,
+                         name="mlp_gate")(h)
+            up = dense(self.d_ff, use_bias=False, dtype=self.dtype,
+                       name="mlp_up")(h)
+            x = x + dense(self.d_model, use_bias=False, dtype=self.dtype,
+                          name="mlp_down")(nn.silu(gate) * up)
         else:
             h = dense(self.d_ff, use_bias=False, dtype=self.dtype,
                       name="mlp_up")(h)
@@ -260,19 +283,26 @@ class CachedBlock(nn.Module):
 
 def _decode_attention(q, k_cache, v_cache, length):
     """One query position against the cache: [B, 1, H, Dh] x
-    [B, T_max, H, Dh], masked to the valid ``length`` prefix.  This is
-    the HBM-bound serving matvec — one cache read per token."""
-    scale = 1.0 / jnp.sqrt(jnp.array(q.shape[-1], jnp.float32))
+    [B, T_max, Hkv, Dh], masked to the valid ``length`` prefix.  This
+    is the HBM-bound serving matvec — one cache read per token.  With
+    grouped K/V heads (GQA) the query reshapes to [B, 1, Hkv, G, Dh]
+    and the einsums run grouped, so the cache is read once at its
+    compact size instead of being broadcast to H heads in HBM."""
+    B, Tq, H, Dh = q.shape
+    n_kv = k_cache.shape[2]
+    g = H // n_kv
+    scale = 1.0 / jnp.sqrt(jnp.array(Dh, jnp.float32))
+    qg = q.reshape(B, Tq, n_kv, g, Dh).astype(jnp.float32)
     scores = jnp.einsum(
-        "bqhd,bkhd->bqhk", q.astype(jnp.float32),
-        k_cache.astype(jnp.float32),
+        "bqhgd,bkhd->bqhgk", qg, k_cache.astype(jnp.float32)
     ) * scale
     valid = jnp.arange(k_cache.shape[1]) < length  # [T_max]
-    scores = jnp.where(valid[None, None, None, :], scores, -jnp.inf)
+    scores = jnp.where(valid[None, None, None, None, :], scores, -jnp.inf)
     w = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum(
-        "bqhk,bkhd->bqhd", w, v_cache.astype(jnp.float32)
-    ).astype(q.dtype)
+    out = jnp.einsum(
+        "bqhgk,bkhd->bqhgd", w, v_cache.astype(jnp.float32)
+    )
+    return out.reshape(B, Tq, H, Dh).astype(q.dtype)
 
 
 class DecodeTransformerLM(nn.Module):
@@ -297,6 +327,9 @@ class DecodeTransformerLM(nn.Module):
     n_experts: int = 0
     moe_k: int = 2
     moe_capacity_factor: float = 1.25
+    n_kv_heads: Optional[int] = None  # < n_heads → GQA (Llama family)
+    ffn: str = "gelu"  # "swiglu" for the Llama MLP
+    rope_theta: float = 10000.0
 
     @nn.compact
     def __call__(
@@ -312,6 +345,8 @@ class DecodeTransformerLM(nn.Module):
                 quantized=self.quantized, n_experts=self.n_experts,
                 moe_k=self.moe_k,
                 moe_capacity_factor=self.moe_capacity_factor,
+                n_kv_heads=self.n_kv_heads, ffn=self.ffn,
+                rope_theta=self.rope_theta,
                 name=f"block_{i}",
             )(x, positions, decode=decode)
         x = nn.RMSNorm(dtype=self.dtype, name="final_norm")(x)
@@ -333,12 +368,16 @@ def make_decoder(
     n_experts: int = 0,
     moe_k: int = 2,
     moe_capacity_factor: float = 1.25,
+    n_kv_heads: Optional[int] = None,
+    ffn: str = "gelu",
+    rope_theta: float = 10000.0,
 ) -> "DecodeTransformerLM":
     return DecodeTransformerLM(
         vocab=vocab, d_model=d_model, n_heads=n_heads,
         n_layers=n_layers, d_ff=d_ff, max_len=max_len, dtype=dtype,
         quantized=quantized, n_experts=n_experts, moe_k=moe_k,
-        moe_capacity_factor=moe_capacity_factor,
+        moe_capacity_factor=moe_capacity_factor, n_kv_heads=n_kv_heads,
+        ffn=ffn, rope_theta=rope_theta,
     )
 
 
@@ -347,7 +386,8 @@ def init_cache(model: DecodeTransformerLM, batch: int):
     *batch*-sized request — built directly from the config so no tracing
     of the model is needed to start serving."""
     head_dim = model.d_model // model.n_heads
-    kv = (batch, model.max_len, model.n_heads, head_dim)
+    n_kv = model.n_kv_heads or model.n_heads
+    kv = (batch, model.max_len, n_kv, head_dim)
     return {
         f"block_{i}": {
             "cached_k": jnp.zeros(kv, model.dtype),

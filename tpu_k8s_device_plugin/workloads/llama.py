@@ -1,0 +1,118 @@
+"""Llama-family model configs over the native transformer stack.
+
+The reference's serving example deploys Llama-class models through an
+opaque vLLM image (/root/reference/example/vllm-serve/deployment.yaml:
+28-56 serves Mistral-7B; our example/vllm-serve-tpu targets
+Llama-3-8B).  This module makes that model family a first-class citizen
+of the native stack instead: the same ``TransformerLM`` /
+``DecodeTransformerLM`` modules, configured with the three Llama
+architecture ingredients —
+
+* **GQA** (``n_kv_heads < n_heads``): K/V project to 8 heads serving
+  32 query heads, so the serving KV cache (the decode-bandwidth bound)
+  shrinks 4x;
+* **SwiGLU MLP** (``ffn="swiglu"``): down(silu(gate) ⊙ up);
+* **RoPE theta 500000** (Llama-3's long-context base).
+
+RMSNorm and rotary embeddings were already the stack's defaults.
+
+Configs are plain frozen dataclasses; ``train_model(cfg)`` /
+``decoder(cfg)`` build the training and serving twins with identical
+parameter trees, so a trained tree (or converted checkpoint) drops
+into serving unchanged, and ``inference.quantize_lm_params`` applies
+as-is (mlp_gate quantizes with the other projections).
+
+Memory note for the 8B config on one v5e (16 GB HBM): bf16 weights are
+~16 GB — does not fit; weight-only int8 (~8 GB + bf16 embed) fits with
+room for the GQA cache (8 kv-heads × 128 = 131 kB/token/layer... 32
+layers ≈ 64 kB/token total at bf16, so 4k context ≈ 0.26 GB).  That is
+the single-chip serving configuration; bf16 serving of 8B wants a
+2-chip ``model``-axis mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from .inference import DecodeTransformerLM, make_decoder
+from .transformer import COMPUTE_DTYPE, TransformerLM
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab: int
+    d_model: int
+    n_heads: int
+    n_kv_heads: int
+    n_layers: int
+    d_ff: int
+    rope_theta: float = 500000.0
+    max_len: int = 8192
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def n_params(self) -> int:
+        """Parameter count (embed + blocks + head), for sizing checks."""
+        d, f, v = self.d_model, self.d_ff, self.vocab
+        kv = self.n_kv_heads * self.head_dim
+        per_block = (
+            d * (d + 2 * kv)      # qkv
+            + d * d               # out_proj
+            + 3 * d * f           # gate, up, down
+            + 2 * d               # two RMSNorm scales
+        )
+        return v * d + self.n_layers * per_block + d + d * v
+
+
+# Llama-3-8B (meta-llama/Meta-Llama-3-8B): 32 layers, d=4096, 32 heads /
+# 8 KV heads, d_ff=14336, vocab 128256, rope theta 500000
+LLAMA3_8B = LlamaConfig(
+    vocab=128256, d_model=4096, n_heads=32, n_kv_heads=8,
+    n_layers=32, d_ff=14336,
+)
+
+# Llama-2-7B-shaped: MHA (n_kv == n_heads), theta 10000, vocab 32000
+LLAMA2_7B = LlamaConfig(
+    vocab=32000, d_model=4096, n_heads=32, n_kv_heads=32,
+    n_layers=32, d_ff=11008, rope_theta=10000.0, max_len=4096,
+)
+
+# scaled-down config with the full Llama shape grammar (GQA 4:1, SwiGLU,
+# big theta) for tests and CPU meshes
+TINY_LLAMA = LlamaConfig(
+    vocab=256, d_model=128, n_heads=8, n_kv_heads=2,
+    n_layers=2, d_ff=352, max_len=128,
+)
+
+
+def train_model(
+    cfg: LlamaConfig, dtype: Any = COMPUTE_DTYPE, **overrides
+) -> TransformerLM:
+    """Training-side model for *cfg* (attn_fn et al. via overrides)."""
+    return TransformerLM(
+        vocab=cfg.vocab, d_model=cfg.d_model, n_heads=cfg.n_heads,
+        n_layers=cfg.n_layers, d_ff=cfg.d_ff, dtype=dtype,
+        n_kv_heads=cfg.n_kv_heads, ffn="swiglu",
+        rope_theta=cfg.rope_theta, **overrides,
+    )
+
+
+def decoder(
+    cfg: LlamaConfig,
+    max_len: Optional[int] = None,
+    quantized: bool = False,
+    dtype: Any = COMPUTE_DTYPE,
+) -> DecodeTransformerLM:
+    """Serving-side twin (KV-cached; same param tree as train_model)."""
+    return make_decoder(
+        vocab=cfg.vocab, d_model=cfg.d_model, n_heads=cfg.n_heads,
+        n_layers=cfg.n_layers, d_ff=cfg.d_ff,
+        max_len=max_len or cfg.max_len, dtype=dtype,
+        quantized=quantized, n_kv_heads=cfg.n_kv_heads, ffn="swiglu",
+        rope_theta=cfg.rope_theta,
+    )

@@ -1,0 +1,310 @@
+"""Pallas max-pool with an argmax-index backward (no select_and_scatter).
+
+Why this exists: the AlexNet conv head is HBM-bandwidth-bound, and the
+single most expensive op in it is not a conv — it is the max-pool
+*backward*, which XLA lowers to ``select_and_scatter`` (~10 ms of the
+~35 ms seg1 fwd+bwd at batch 4096 on v5e-1; see BASELINE.md).  Every
+HLO-level reformulation measured worse: shifted strided slices OOM,
+a custom-vjp argmax pool in pure XLA materializes its slices/pads
+(2.4x slower), separable 1D pools lose to the 2D window.  So the pool
+is the one op in the model worth a hand kernel:
+
+* forward: one pass computes the window max AND a compact int8
+  "which window offset won" index (first-match tie-break — the same
+  ge-select semantics ``select_and_scatter`` uses);
+* backward: a pure scatter of the pooled gradient through that index —
+  reads dp + idx, writes dy, touching each element once.  No
+  select_and_scatter, no re-read of the pre-pool activation.
+
+Layout note (measured, not guessed): XLA keeps these big NHWC conv
+activations in *batch-minor* tiling on TPU (batch rides the 128-lane
+dim — that is how its convs stay MXU-efficient at 48..64 channels), so
+the kernels here block over (H, W, C, B) with batch as the minor dim
+and slice H/W as untiled major dims.  Feeding them the logical
+``(B, H, W, C)`` array through a transpose costs nothing when the
+producer already carries the batch-minor physical layout.
+
+Strides/windows are static Python ints; VALID padding only (what the
+model uses — flax ``nn.max_pool`` default).  On non-TPU backends the
+kernels run in interpreter mode so CPU test meshes exercise the same
+code path (convention from flash_attention.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # TPU memory spaces; absent on some non-TPU installs
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+
+def _block_spec(shape, index_map):
+    if _VMEM is not None:
+        return pl.BlockSpec(shape, index_map, memory_space=_VMEM)
+    return pl.BlockSpec(shape, index_map)
+
+
+def _compiler_params(interpret):
+    """Both grid dims are embarrassingly parallel (distinct channel and
+    batch slabs), and the full-spatial blocks plus their parity-plane
+    temporaries need more than the default 16 MB scoped-VMEM stack —
+    raise it (v5e has 128 MB VMEM; the blocks are sized so kernel
+    footprint stays ~4x block, well under)."""
+    if pltpu is None or interpret:
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel"),
+        vmem_limit_bytes=100 * 1024 * 1024,
+    )
+
+
+def _offsets(window: int):
+    return [(di, dj) for di in range(window) for dj in range(window)]
+
+
+def _out_dim(size: int, window: int, stride: int) -> int:
+    return (size - window) // stride + 1
+
+
+_LANES = 128
+
+
+def _pick_cb(c: int, itemsize: int) -> int:
+    """Channel block = the dtype's sublane tile (16 for 2-byte, 8 for
+    4-byte): the smallest block with zero sublane padding.  Bigger
+    blocks only grow VMEM pressure — each (cb, 128-batch) slab already
+    streams the full spatial extent."""
+    cb = 32 // itemsize
+    while c % cb:
+        cb //= 2
+    return max(cb, 1)
+
+
+def _plane_dims(size: int, window: int, stride: int) -> int:
+    """Rows per parity plane: enough to cover every offset's window."""
+    out = _out_dim(size, window, stride)
+    return max(-(-size // stride), (window - 1) // stride + out)
+
+
+def _parity_planes(x, window, stride):
+    """Split (H, W, ...) into stride x stride parity planes so that every
+    strided window slice becomes a static unit-stride slice (Mosaic has
+    no >2D gather; strided slices on loaded values lower to gathers).
+    Pads with -inf, which never wins a max and never first-matches
+    unless the real data is -inf too."""
+    h, w = x.shape[0], x.shape[1]
+    s = stride
+    hh = _plane_dims(h, window, s)
+    ww = _plane_dims(w, window, s)
+    xp = jnp.pad(x, ((0, hh * s - h), (0, ww * s - w)) +
+                 ((0, 0),) * (x.ndim - 2),
+                 constant_values=_neg_inf(x.dtype))
+    xr = xp.reshape((hh, s, ww, s) + x.shape[2:])
+    # one int index at a time: multi-axis integer indexing lowers to
+    # gather/scatter, which Mosaic does not implement beyond 2D
+    return {(pr, pc): xr[:, pr][:, :, pc]
+            for pr in range(s) for pc in range(s)}
+
+
+def _neg_inf(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(-jnp.inf, dtype)
+    return jnp.array(jnp.iinfo(dtype).min, dtype)
+
+
+def _window_slice(planes, di, dj, oh, ow, stride):
+    p = planes[(di % stride, dj % stride)]
+    r0, c0 = di // stride, dj // stride
+    return p[r0:r0 + oh, c0:c0 + ow]
+
+
+def _fwd_kernel(window, stride, oh, ow, x_ref, y_ref, idx_ref):
+    # block shapes: x (H, W, cb, bb), y/idx (oh, ow, cb, bb)
+    planes = _parity_planes(x_ref[...], window, stride)
+    y = None
+    for di, dj in _offsets(window):
+        s = _window_slice(planes, di, dj, oh, ow, stride)
+        y = s if y is None else jnp.maximum(y, s)
+    # The argmax index is computed with same-dtype mask ARITHMETIC, not
+    # boolean algebra: i1 vectors from compares of different-width
+    # dtypes carry different Mosaic layouts, and i1(+)i1 relayouts hit
+    # "Non-singleton logical dimension is replicated" compile bugs.
+    # Compare -> convert to x.dtype -> multiply/add keeps every vector
+    # in one layout family.  hit_k = (s==y)*(1-found) reproduces the
+    # first-match tie-break; idx = sum k*hit_k; 0..window^2-1 is exact
+    # in bf16 for window<=11.
+    # compares run in f32 — the VPU has no bf16 cmpf ("Target does not
+    # support this comparison"), and bf16->f32 is exact
+    f32 = jnp.float32
+    yf = y.astype(f32)
+    one = jnp.ones((), f32)
+    idx = jnp.zeros(y.shape, f32)
+    found = jnp.zeros(y.shape, f32)
+    for k, (di, dj) in enumerate(_offsets(window)):
+        s = _window_slice(planes, di, dj, oh, ow, stride)
+        hit = (s.astype(f32) == yf).astype(f32) * (one - found)
+        idx = idx + jnp.full((), k, f32) * hit
+        found = found + hit
+    y_ref[...] = y
+    idx_ref[...] = idx.astype(jnp.int8)
+
+
+def _bwd_kernel(window, stride, h, w, idx_ref, dp_ref, dy_ref):
+    # block shapes: idx/dp (oh, ow, cb, bb), dy (H, W, cb, bb).
+    #
+    # The scatter "place dp[i,j] at (stride*i+di, stride*j+dj)" is not
+    # expressible as a strided .at[].add under Mosaic (gather/scatter is
+    # 2D-only), so build dy from stride**2 parity planes instead: plane
+    # (pr, pc) holds rows/cols congruent to (pr, pc) mod stride, every
+    # offset's contribution is a static unit-stride pad into its plane,
+    # and the planes interleave back via a static set + reshape.
+    # same layout-homogeneity rule as the forward: compare in f32 (no
+    # bf16/int cmp on the VPU) and use mask multiplication, never i1
+    # selects
+    idx = idx_ref[...].astype(jnp.float32)
+    dp = dp_ref[...]
+    oh, ow = dp.shape[0], dp.shape[1]
+    s = stride
+    hh = max(-(-h // s), (window - 1) // s + oh)
+    ww = max(-(-w // s), (window - 1) // s + ow)
+    planes = {}
+    for k, (di, dj) in enumerate(_offsets(window)):
+        mask = (idx == jnp.full((), k, jnp.float32)).astype(dp.dtype)
+        contrib = mask * dp
+        pr, pc = di % s, dj % s
+        r0, c0 = di // s, dj // s
+        p = jnp.pad(contrib,
+                    ((r0, hh - oh - r0), (c0, ww - ow - c0),
+                     (0, 0), (0, 0)))
+        key = (pr, pc)
+        planes[key] = p if key not in planes else planes[key] + p
+    # Interleave the planes back with stacks + reshapes only: value
+    # updates (.at[].set / dynamic_update_slice) have no Mosaic
+    # lowering, but concatenate/reshape on major dims do.
+    rows = []
+    for pr in range(s):
+        cols = jnp.stack([planes[(pr, pc)] for pc in range(s)], axis=2)
+        rows.append(cols.reshape((hh, ww * s) + dp.shape[2:]))
+    z = jnp.stack(rows, axis=1)
+    dy = z.reshape((hh * s, ww * s) + dp.shape[2:])[:h, :w]
+    dy_ref[...] = dy
+
+
+def _to_hwcb(x, bpad):
+    xt = x.transpose(1, 2, 3, 0)  # (H, W, C, B): batch-minor
+    if bpad:
+        xt = jnp.pad(xt, ((0, 0),) * 3 + ((0, bpad),))
+    return xt
+
+
+def _to_bhwc(x, b):
+    return x.transpose(3, 0, 1, 2)[:b]
+
+
+def _bpad(b: int) -> int:
+    """Pad batch up to a multiple of the 128-lane tile: batch is the
+    minor (lane) dim, and a short minor dim pads to 128 anyway — at
+    16x the memory.  Real training batches are multiples of 128; the
+    pad only triggers on small test shapes."""
+    return (-b) % _LANES
+
+
+def _pool_fwd_impl(x, window, stride, interpret):
+    b, h, w, c = x.shape
+    oh = _out_dim(h, window, stride)
+    ow = _out_dim(w, window, stride)
+    bpad = _bpad(b)
+    bt = b + bpad
+    cb = _pick_cb(c, x.dtype.itemsize)
+    xt = _to_hwcb(x, bpad)
+    grid = (c // cb, bt // _LANES)
+    y, idx = pl.pallas_call(
+        functools.partial(_fwd_kernel, window, stride, oh, ow),
+        grid=grid,
+        in_specs=[
+            _block_spec((h, w, cb, _LANES), lambda ci, bi: (0, 0, ci, bi)),
+        ],
+        out_specs=[
+            _block_spec((oh, ow, cb, _LANES),
+                        lambda ci, bi: (0, 0, ci, bi)),
+            _block_spec((oh, ow, cb, _LANES),
+                        lambda ci, bi: (0, 0, ci, bi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((oh, ow, c, bt), x.dtype),
+            jax.ShapeDtypeStruct((oh, ow, c, bt), jnp.int8),
+        ],
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )(xt)
+    return _to_bhwc(y, b), idx
+
+
+def _pool_bwd_impl(idx, dp, xshape, window, stride, interpret):
+    b, h, w, c = xshape
+    oh = _out_dim(h, window, stride)
+    ow = _out_dim(w, window, stride)
+    bpad = _bpad(b)
+    bt = b + bpad
+    cb = _pick_cb(c, dp.dtype.itemsize)
+    dpt = _to_hwcb(dp, bpad)
+    grid = (c // cb, bt // _LANES)
+    dy = pl.pallas_call(
+        functools.partial(_bwd_kernel, window, stride, h, w),
+        grid=grid,
+        in_specs=[
+            _block_spec((oh, ow, cb, _LANES),
+                        lambda ci, bi: (0, 0, ci, bi)),
+            _block_spec((oh, ow, cb, _LANES),
+                        lambda ci, bi: (0, 0, ci, bi)),
+        ],
+        out_specs=_block_spec(
+            (h, w, cb, _LANES), lambda ci, bi: (0, 0, ci, bi)),
+        out_shape=jax.ShapeDtypeStruct((h, w, c, bt), dp.dtype),
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )(idx, dpt)
+    return _to_bhwc(dy, b)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def max_pool(x, window: int = 3, stride: int = 2,
+             interpret: Optional[bool] = None):
+    """VALID max-pool over NHWC, drop-in for
+    ``flax.linen.max_pool(x, (window, window), (stride, stride))``,
+    with a scatter backward instead of select_and_scatter.  Gradient
+    tie-break matches XLA's: first window offset in row-major order."""
+    y, _ = _pool_fwd_impl(x, window, stride, _resolve(interpret))
+    return y
+
+
+def _resolve(interpret):
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return interpret
+
+
+def _vjp_fwd(x, window, stride, interpret):
+    y, idx = _pool_fwd_impl(x, window, stride, _resolve(interpret))
+    return y, (idx, x.shape)
+
+
+def _vjp_bwd(window, stride, interpret, res, dp):
+    idx, xshape = res
+    dy = _pool_bwd_impl(
+        idx, dp, xshape, window, stride, _resolve(interpret))
+    return (dy,)
+
+
+max_pool.defvjp(_vjp_fwd, _vjp_bwd)

@@ -39,11 +39,15 @@ COMPUTE_DTYPE = jnp.bfloat16
 AttnFn = Callable[[jax.Array, jax.Array, jax.Array, jax.Array], jax.Array]
 
 
-def apply_rope(x: jax.Array, positions: jax.Array) -> jax.Array:
+def apply_rope(
+    x: jax.Array, positions: jax.Array, theta: float = 10000.0
+) -> jax.Array:
     """Rotary position embedding on [B, T, H, D] with explicit positions
-    [B, T] — explicit so sequence-permuted layouts (zig-zag) stay correct."""
+    [B, T] — explicit so sequence-permuted layouts (zig-zag) stay correct.
+    ``theta`` is the frequency base (10000 classic; Llama-3 uses 500000
+    for longer context)."""
     d_half = x.shape[-1] // 2
-    freqs = 1.0 / (10000.0 ** (jnp.arange(d_half, dtype=jnp.float32) / d_half))
+    freqs = 1.0 / (theta ** (jnp.arange(d_half, dtype=jnp.float32) / d_half))
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B, T, D/2]
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
@@ -70,11 +74,50 @@ def local_causal_attention(
     ).astype(q.dtype)
 
 
+def split_qkv_heads(qkv, n_heads: int, n_kv_heads: int, head_dim: int):
+    """Split a fused qkv projection [B, T, (H + 2*Hkv)*Dh] into
+    q [B, T, H, Dh] and k/v [B, T, Hkv, Dh]."""
+    B, T, _ = qkv.shape
+    q_dim = n_heads * head_dim
+    kv_dim = n_kv_heads * head_dim
+    q = qkv[..., :q_dim].reshape(B, T, n_heads, head_dim)
+    k = qkv[..., q_dim:q_dim + kv_dim].reshape(B, T, n_kv_heads, head_dim)
+    v = qkv[..., q_dim + kv_dim:].reshape(B, T, n_kv_heads, head_dim)
+    return q, k, v
+
+
+def _validate_attn_ffn(n_heads: int, n_kv: int, ffn: str) -> None:
+    """Trace-time config validation: a typo'd ffn string or a
+    non-divisible GQA head count would otherwise surface as an opaque
+    shape error (or, worse, silently build the wrong MLP)."""
+    if ffn not in ("gelu", "swiglu"):
+        raise ValueError(f"unknown ffn {ffn!r}: expected 'gelu' or 'swiglu'")
+    if n_kv > n_heads or n_heads % n_kv:
+        raise ValueError(
+            f"n_kv_heads={n_kv} must divide n_heads={n_heads}"
+        )
+
+
+def repeat_kv(k: jax.Array, n_heads: int) -> jax.Array:
+    """Broadcast grouped K/V heads [B, T, Hkv, Dh] to the full query
+    head count (GQA: each KV head serves H/Hkv query heads)."""
+    n_kv = k.shape[2]
+    if n_kv == n_heads:
+        return k
+    return jnp.repeat(k, n_heads // n_kv, axis=2)
+
+
 class Block(nn.Module):
     """Pre-norm transformer block: RMSNorm → attention → residual,
-    RMSNorm → FFN → residual.  The FFN is the dense GELU MLP, or a
-    top-k routed mixture-of-experts (``n_experts > 0``, expert-parallel
-    over the mesh's ``expert`` axis — see moe.py)."""
+    RMSNorm → FFN → residual.
+
+    Attention is multi-head or grouped-query (``n_kv_heads < n_heads``
+    — the Llama-family layout: K/V project to fewer heads and each
+    serves a group of query heads, shrinking the serving KV cache by
+    H/Hkv).  The FFN is the dense GELU MLP, SwiGLU
+    (``ffn="swiglu"`` — gate ⊙ silu, the Llama MLP), or a top-k routed
+    mixture-of-experts (``n_experts > 0``, expert-parallel over the
+    mesh's ``expert`` axis — see moe.py)."""
 
     d_model: int
     n_heads: int
@@ -84,23 +127,31 @@ class Block(nn.Module):
     n_experts: int = 0
     moe_k: int = 2
     moe_capacity_factor: float = 1.25
+    n_kv_heads: Optional[int] = None  # None → multi-head (n_heads)
+    ffn: str = "gelu"  # "gelu" | "swiglu"
+    rope_theta: float = 10000.0
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
         B, T, _ = x.shape
         head_dim = self.d_model // self.n_heads
+        n_kv = self.n_kv_heads or self.n_heads
+        _validate_attn_ffn(self.n_heads, n_kv, self.ffn)
         h = nn.RMSNorm(dtype=self.dtype, name="attn_norm")(x)
-        qkv = nn.Dense(3 * self.d_model, use_bias=False, dtype=self.dtype,
-                       name="qkv")(h)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-
-        def heads(t):
-            return t.reshape(B, T, self.n_heads, head_dim)
-
-        q, k, v = heads(q), heads(k), heads(v)
-        q = apply_rope(q, positions)
-        k = apply_rope(k, positions)
-        att = self.attn_fn(q, k, v, positions)
+        qkv = nn.Dense(
+            (self.n_heads + 2 * n_kv) * head_dim, use_bias=False,
+            dtype=self.dtype, name="qkv",
+        )(h)
+        q, k, v = split_qkv_heads(qkv, self.n_heads, n_kv, head_dim)
+        q = apply_rope(q, positions, self.rope_theta)
+        k = apply_rope(k, positions, self.rope_theta)
+        # training attention runs at full head count (compute-bound on
+        # the MXU either way); the grouped layout pays off in serving,
+        # where the cache stores only the Hkv heads
+        att = self.attn_fn(
+            q, repeat_kv(k, self.n_heads), repeat_kv(v, self.n_heads),
+            positions,
+        )
         att = att.reshape(B, T, self.d_model)
         x = x + nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
                          name="out_proj")(att)
@@ -117,6 +168,13 @@ class Block(nn.Module):
                 capacity_factor=self.moe_capacity_factor, dtype=self.dtype,
                 name="moe",
             )(h, positions)
+        elif self.ffn == "swiglu":
+            gate = nn.Dense(self.d_ff, use_bias=False, dtype=self.dtype,
+                            name="mlp_gate")(h)
+            up = nn.Dense(self.d_ff, use_bias=False, dtype=self.dtype,
+                          name="mlp_up")(h)
+            x = x + nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
+                             name="mlp_down")(nn.silu(gate) * up)
         else:
             h = nn.Dense(self.d_ff, use_bias=False, dtype=self.dtype,
                          name="mlp_up")(h)
@@ -140,6 +198,9 @@ class TransformerLM(nn.Module):
     n_experts: int = 0  # >0 swaps the MLP for a routed MoE FFN (moe.py)
     moe_k: int = 2
     moe_capacity_factor: float = 1.25
+    n_kv_heads: Optional[int] = None  # < n_heads → GQA (Llama family)
+    ffn: str = "gelu"  # "swiglu" for the Llama MLP
+    rope_theta: float = 10000.0
 
     @nn.compact
     def __call__(
@@ -156,6 +217,8 @@ class TransformerLM(nn.Module):
                 attn_fn=self.attn_fn, n_experts=self.n_experts,
                 moe_k=self.moe_k,
                 moe_capacity_factor=self.moe_capacity_factor,
+                n_kv_heads=self.n_kv_heads, ffn=self.ffn,
+                rope_theta=self.rope_theta,
                 name=f"block_{i}",
             )(x, positions)
         x = nn.RMSNorm(dtype=self.dtype, name="final_norm")(x)
@@ -263,7 +326,8 @@ def _lm_pspec(path, leaf, axes=("data", "expert", "seq", "model")) -> P:
             return P(ex, mdl)
         return P(ex, None)
     if leaf.ndim == 2:
-        if "qkv" in name or "mlp_up" in name or "lm_head" in name:
+        if ("qkv" in name or "mlp_up" in name or "mlp_gate" in name
+                or "lm_head" in name):
             return P(None, mdl)
         if "out_proj" in name or "mlp_down" in name:
             return P(mdl, None)
@@ -271,7 +335,8 @@ def _lm_pspec(path, leaf, axes=("data", "expert", "seq", "model")) -> P:
         # QuantDense per-out-channel scales: follow the kernel's output
         # dim — column-split projections carry a model-split scale, the
         # row-split ones an unsplit (replicated) scale
-        if "qkv" in name or "mlp_up" in name or "lm_head" in name:
+        if ("qkv" in name or "mlp_up" in name or "mlp_gate" in name
+                or "lm_head" in name):
             return P(mdl)
     return P()
 
