@@ -80,7 +80,7 @@ def test_cached_decode_matches_recompute_oracle(trained):
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
-def test_cache_index_advances(trained):
+def test_cache_lens_advance(trained):
     _, params = trained
     dec = make_decoder(**CFG, max_len=32)
     prompt = jnp.zeros((1, 4), jnp.int32)
@@ -89,13 +89,13 @@ def test_cache_index_advances(trained):
         {"params": params, "cache": init_cache(dec, 1)}, prompt, pos,
         mutable=["cache"],
     )
-    assert int(mut["cache"]["block_0"]["cache_index"]) == 4
+    assert mut["cache"]["block_0"]["cache_lens"].tolist() == [4]
     _, mut = dec.apply(
         {"params": params, "cache": mut["cache"]},
         jnp.zeros((1, 1), jnp.int32), jnp.full((1, 1), 4, jnp.int32),
         decode=True, mutable=["cache"],
     )
-    assert int(mut["cache"]["block_0"]["cache_index"]) == 5
+    assert mut["cache"]["block_0"]["cache_lens"].tolist() == [5]
 
 
 def test_max_len_overflow_rejected(trained):

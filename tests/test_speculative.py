@@ -1,0 +1,107 @@
+"""Speculative decoding: bit-exactness vs target-only greedy.
+
+The whole value proposition is "same tokens, fewer target passes", so
+the only acceptable test is token-for-token equality with
+``greedy_generate`` on the target — across draft quality (a draft
+sharing the target's params accepts ~everything; a random draft
+accepts ~nothing; both must stay exact), gamma values, and step
+counts that end mid-window.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_k8s_device_plugin.workloads import llama
+from tpu_k8s_device_plugin.workloads.inference import (
+    greedy_generate,
+    make_decoder,
+)
+from tpu_k8s_device_plugin.workloads.speculative import speculative_generate
+
+TARGET_CFG = dict(vocab=96, d_model=64, n_heads=4, n_layers=2, d_ff=128)
+DRAFT_CFG = dict(vocab=96, d_model=32, n_heads=2, n_layers=1, d_ff=64)
+DT = jnp.float32
+
+
+def _init(model, seed):
+    rng = jax.random.PRNGKey(seed)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+    return model.init(rng, tokens, pos)["params"]
+
+
+@pytest.fixture(scope="module")
+def models():
+    target = make_decoder(**TARGET_CFG, max_len=96, dtype=DT)
+    draft = make_decoder(**DRAFT_CFG, max_len=96, dtype=DT)
+    return (target, _init(target, 0)), (draft, _init(draft, 1))
+
+
+def _oracle(target, params, prompt, n):
+    out, _ = greedy_generate(
+        target, params, jnp.asarray(prompt, jnp.int32)[None, :], n)
+    return np.asarray(out)[0].tolist()
+
+
+@pytest.mark.parametrize("gamma", [1, 2, 4, 7])
+def test_exact_vs_greedy_any_gamma(models, gamma):
+    (target, tp), (draft, dp) = models
+    prompt = [5, 17, 3, 70, 2, 41]
+    got, rate = speculative_generate(
+        target, tp, draft, dp, prompt, n_steps=12, gamma=gamma)
+    assert np.asarray(got).tolist() == _oracle(target, tp, prompt, 12)
+    assert 0.0 <= rate <= 1.0
+
+
+def test_exact_when_draft_is_target(models):
+    # a perfect draft: every proposal accepted, still exact, and the
+    # accept rate must be 1.0
+    (target, tp), _ = models
+    prompt = [9, 1, 44, 23]
+    got, rate = speculative_generate(
+        target, tp, target, tp, prompt, n_steps=10, gamma=4)
+    assert np.asarray(got).tolist() == _oracle(target, tp, prompt, 10)
+    assert rate == 1.0
+
+
+def test_exact_when_draft_is_garbage(models):
+    # a draft with different random params: low accept rate, same tokens
+    (target, tp), (draft, _) = models
+    dp_garbage = _init(draft, 1234)
+    prompt = [9, 1, 44, 23, 8]
+    got, rate = speculative_generate(
+        target, tp, draft, dp_garbage, prompt, n_steps=9, gamma=3)
+    assert np.asarray(got).tolist() == _oracle(target, tp, prompt, 9)
+
+
+def test_n_steps_not_multiple_of_window(models):
+    (target, tp), (draft, dp) = models
+    prompt = [2, 2, 7]
+    for n in (1, 2, 5, 11):
+        got, _ = speculative_generate(
+            target, tp, draft, dp, prompt, n_steps=n, gamma=4)
+        assert np.asarray(got).tolist() == _oracle(target, tp, prompt, n)
+
+
+def test_llama_gqa_speculative(models):
+    # GQA/SwiGLU target with an MHA draft — mixed architectures compose
+    cfg = llama.TINY_LLAMA
+    target = llama.decoder(cfg, dtype=DT, max_len=96)
+    tp = _init(target, 7)
+    draft = make_decoder(
+        vocab=cfg.vocab, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_len=96, dtype=DT)
+    dp = _init(draft, 8)
+    prompt = [3, 200, 100, 50]
+    got, _ = speculative_generate(
+        target, tp, draft, dp, prompt, n_steps=8, gamma=3)
+    assert np.asarray(got).tolist() == _oracle(target, tp, prompt, 8)
+
+
+def test_max_len_guard(models):
+    (target, tp), (draft, dp) = models
+    with pytest.raises(ValueError, match="max_len"):
+        speculative_generate(
+            target, tp, draft, dp, list(range(90)), n_steps=10, gamma=2)

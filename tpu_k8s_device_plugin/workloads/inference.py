@@ -27,12 +27,14 @@ is the native counterpart — a decode engine for the training stack's
 
 MoE configs serve too: ``CachedBlock`` swaps its MLP for the training
 stack's ``MoEFFN`` when ``n_experts > 0`` (same expert stacks, same
-router).  One semantic note — decode routes each token at T=1, so no
-token ever loses a capacity slot to a later one (dropless serving, the
-standard MoE inference behavior); training configs with tight capacity
-factors can drop tokens the decode path keeps.  Use a dropless
-capacity factor (``cf >= n_experts / k``) when exact training/serving
-routing parity matters (the oracle tests do).
+router).  One semantic note — every extend (T=1 decode, chunked
+prefill, speculative verify) routes with per-expert capacity pinned to
+T, which is always dropless, so all extend shapes produce identical
+tokens (dropless serving, the standard MoE inference behavior);
+training configs with tight capacity factors can drop tokens the
+serving path keeps.  Use a dropless capacity factor
+(``cf >= n_experts / k``) when exact training/serving routing parity
+matters (the oracle tests do).
 """
 
 from __future__ import annotations
@@ -150,14 +152,19 @@ class CachedBlock(nn.Module):
     lives in the flax ``cache`` collection: ``cached_k``/``cached_v``
     ``[B, T_max, Hkv, Dh]`` (the GROUPED head count — with GQA the
     cache is n_heads/n_kv_heads smaller than the query head count)
-    plus a scalar ``cache_index`` (the number of valid positions).
+    plus per-sequence ``cache_lens [B]`` (valid positions per slot —
+    a vector, not a scalar, so every batch slot can sit at a different
+    depth: that is what makes continuous batching possible).
 
     Modes:
       * prefill (``decode=False``): full-prompt causal attention; writes
-        the prompt's K/V into the cache head and sets ``cache_index``.
-      * decode (``decode=True``): T == 1; appends this step's K/V at
-        ``cache_index`` and attends the query against the whole cache,
-        masked to the valid prefix.
+        the prompt's K/V into the cache head and sets every slot's
+        length to T.
+      * extend (``decode=True``, any T ≥ 1): appends this call's K/V at
+        each slot's own ``cache_lens[b]`` and attends banded-causally —
+        query t of slot b sees cache positions < lens[b] + t + 1.
+        T == 1 is classic token decode; T > 1 is a chunked-prefill /
+        speculative-verify step.
     """
 
     d_model: int
@@ -204,8 +211,8 @@ class CachedBlock(nn.Module):
             "cache", "cached_v", jnp.zeros, cache_kwargs["shape"],
             cache_kwargs["dtype"],
         )
-        cache_index = self.variable(
-            "cache", "cache_index", jnp.zeros, (), jnp.int32
+        cache_lens = self.variable(
+            "cache", "cache_lens", jnp.zeros, (B,), jnp.int32
         )
 
         if not decode:
@@ -217,7 +224,7 @@ class CachedBlock(nn.Module):
             cached_v.value = lax.dynamic_update_slice(
                 cached_v.value, v, (0, 0, 0, 0)
             )
-            cache_index.value = jnp.int32(T)
+            cache_lens.value = jnp.full((B,), T, jnp.int32)
             # same math as training (the natural prompt order makes the
             # positions mask == the storage-order causal mask).  Long
             # prompts take the Pallas flash kernel — O(T·Dh) prefill
@@ -235,18 +242,19 @@ class CachedBlock(nn.Module):
             else:
                 att = local_causal_attention(q, kf, vf, positions)
         else:
-            if T != 1:
-                raise ValueError(f"decode mode expects T == 1, got {T}")
-            idx = cache_index.value
-            cached_k.value = lax.dynamic_update_slice(
-                cached_k.value, k, (0, idx, 0, 0)
-            )
-            cached_v.value = lax.dynamic_update_slice(
-                cached_v.value, v, (0, idx, 0, 0)
-            )
-            cache_index.value = idx + 1
+            # extend: per-slot append at lens[b] (vmapped so every slot
+            # writes at its own depth), then banded attention against
+            # the cache
+            lens = cache_lens.value
+
+            def _append(cache_b, new_b, off):
+                return lax.dynamic_update_slice(cache_b, new_b, (off, 0, 0))
+
+            cached_k.value = jax.vmap(_append)(cached_k.value, k, lens)
+            cached_v.value = jax.vmap(_append)(cached_v.value, v, lens)
+            cache_lens.value = lens + T
             att = _decode_attention(
-                q, cached_k.value, cached_v.value, idx + 1
+                q, cached_k.value, cached_v.value, lens
             )
 
         att = att.reshape(B, T, self.d_model)
@@ -256,13 +264,19 @@ class CachedBlock(nn.Module):
         if self.n_experts > 0:
             from .moe import MoEFFN
 
-            # same module as training (param tree matches Block's); at
-            # decode T=1 the token always keeps its top-k slots, so
-            # serving is dropless regardless of capacity_factor
+            # same module as training (param tree matches Block's).  On
+            # the extend path the per-expert capacity is pinned to T
+            # (a token occupies at most one slot per expert, so C=T is
+            # always dropless): without this, a T>1 chunked-prefill or
+            # speculative-verify extend could drop tokens that the
+            # equivalent sequence of T=1 decodes would keep, silently
+            # diverging from the decode oracle.  Prefill keeps the
+            # training capacity semantics (it IS the training forward).
             x = x + MoEFFN(
                 n_experts=self.n_experts, d_model=self.d_model,
                 d_ff=self.d_ff, k=self.moe_k,
                 capacity_factor=self.moe_capacity_factor,
+                capacity=(T if decode else None),
                 dtype=self.dtype, quantized=self.quantized, name="moe",
             )(h, positions)
         elif self.ffn == "swiglu":
@@ -281,13 +295,16 @@ class CachedBlock(nn.Module):
         return x
 
 
-def _decode_attention(q, k_cache, v_cache, length):
-    """One query position against the cache: [B, 1, H, Dh] x
-    [B, T_max, Hkv, Dh], masked to the valid ``length`` prefix.  This
-    is the HBM-bound serving matvec — one cache read per token.  With
-    grouped K/V heads (GQA) the query reshapes to [B, 1, Hkv, G, Dh]
-    and the einsums run grouped, so the cache is read once at its
-    compact size instead of being broadcast to H heads in HBM."""
+def _decode_attention(q, k_cache, v_cache, lens):
+    """Tq query positions against the cache: [B, Tq, H, Dh] x
+    [B, T_max, Hkv, Dh], banded to each slot's depth — query t of slot
+    b sees cache positions < lens[b] + t + 1 (the queries' own K/V are
+    already appended starting at lens[b]).  Tq == 1 is the HBM-bound
+    serving matvec (one cache read per token); Tq > 1 is a
+    chunked-prefill / verify step.  With grouped K/V heads (GQA) the
+    query reshapes to [B, Tq, Hkv, G, Dh] and the einsums run grouped,
+    so the cache is read once at its compact size instead of being
+    broadcast to H heads in HBM."""
     B, Tq, H, Dh = q.shape
     n_kv = k_cache.shape[2]
     g = H // n_kv
@@ -296,8 +313,13 @@ def _decode_attention(q, k_cache, v_cache, length):
     scores = jnp.einsum(
         "bqhgd,bkhd->bqhgk", qg, k_cache.astype(jnp.float32)
     ) * scale
-    valid = jnp.arange(k_cache.shape[1]) < length  # [T_max]
-    scores = jnp.where(valid[None, None, None, None, :], scores, -jnp.inf)
+    limit = lens[:, None] + jnp.arange(1, Tq + 1)[None, :]  # [B, Tq]
+    valid = (
+        jnp.arange(k_cache.shape[1])[None, None, :] < limit[:, :, None]
+    )  # [B, Tq, T_max]
+    scores = jnp.where(
+        valid[:, :, None, None, :], scores, -jnp.inf
+    )
     w = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(
         "bqhgk,bkhd->bqhgd", w, v_cache.astype(jnp.float32)
@@ -392,10 +414,31 @@ def init_cache(model: DecodeTransformerLM, batch: int):
         f"block_{i}": {
             "cached_k": jnp.zeros(kv, model.dtype),
             "cached_v": jnp.zeros(kv, model.dtype),
-            "cache_index": jnp.zeros((), jnp.int32),
+            "cache_lens": jnp.zeros((batch,), jnp.int32),
         }
         for i in range(model.n_layers)
     }
+
+
+@functools.partial(
+    jax.jit, static_argnums=(0,), donate_argnums=(2,)
+)
+def extend_step(model: "DecodeTransformerLM", params, cache, tokens,
+                positions):
+    """One banded extend (``decode=True``, any T >= 1): returns
+    ``(logits, new cache)``.  THE compiled serving step — the engine
+    (serving.py) and speculative decoding (speculative.py) share this
+    single executable per (model, shape).  The cache argument is
+    DONATED: on TPU the per-layer appends update the KV buffers in
+    place instead of copying the whole cache every token (decode is
+    HBM-bound; an un-donated cache would double its traffic and peak
+    footprint).  Callers must rebind: ``logits, cache = extend_step(
+    model, params, cache, ...)``."""
+    logits, mut = model.apply(
+        {"params": params, "cache": cache},
+        tokens, positions, decode=True, mutable=["cache"],
+    )
+    return logits, mut["cache"]
 
 
 @functools.partial(jax.jit, static_argnums=(0,))

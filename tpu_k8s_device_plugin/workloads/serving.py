@@ -1,0 +1,239 @@
+"""Slot-based continuous batching on the KV-cache decode engine.
+
+What vLLM does for the reference's serving example
+(/root/reference/example/vllm-serve/deployment.yaml:28-56 — continuous
+batching is the feature the image is deployed FOR), built natively on
+``inference.DecodeTransformerLM``.  TPU-shaped: there is exactly ONE
+compiled decode step for the whole engine lifetime — a fixed
+``n_slots``-wide batch whose per-slot cache depths live in the
+``cache_lens [S]`` vector — and request churn never recompiles
+anything.  Admission costs one prefill (chunked for long prompts) plus
+a pure-data cache insert.
+
+Mechanics:
+
+* **slots**: the engine owns a ``[S, T_max, Hkv, Dh]`` cache per layer.
+  A request occupies one slot from admit to completion; free slots keep
+  decoding garbage that nothing reads (static shapes beat conditional
+  compute on TPU — masking, not branching).
+* **admit**: the prompt prefills on a B=1 cache — in one shot, or in
+  fixed-size chunks through the banded *extend* mode
+  (``CachedBlock`` with ``decode=True, T>1``) so peak prefill
+  attention memory is O(chunk · T_max) regardless of prompt length —
+  then the filled rows are spliced into the slot with
+  ``dynamic_update_slice`` and the slot's ``cache_lens`` entry is set
+  to the true prompt length (chunk padding garbage sits beyond it and
+  is overwritten by subsequent decode appends).
+* **step**: one decode step for all S slots at their own depths;
+  the host keeps per-slot bookkeeping (active, emitted tokens, EOS)
+  and harvests only active slots' tokens.
+* **stop handling**: a slot finishes on its stop token or its token
+  budget; it is freed immediately and can be re-admitted into on the
+  same engine without recompilation.
+
+The per-slot depth machinery (vmapped appends + banded masks) is in
+inference.py; this module is the scheduler around it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .inference import DecodeTransformerLM, extend_step, init_cache
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _splice_slot(cache, mini, slot):
+    """Write the B=1 *mini* cache into row *slot* of the engine cache.
+    Pure data movement — per-layer dynamic_update_slice on the k/v
+    buffers plus a scatter into cache_lens."""
+    out = {}
+    for layer, buf in cache.items():
+        mini_l = mini[layer]
+        out[layer] = {
+            "cached_k": lax.dynamic_update_slice(
+                buf["cached_k"], mini_l["cached_k"], (slot, 0, 0, 0)),
+            "cached_v": lax.dynamic_update_slice(
+                buf["cached_v"], mini_l["cached_v"], (slot, 0, 0, 0)),
+            "cache_lens": lax.dynamic_update_slice(
+                buf["cache_lens"], mini_l["cache_lens"], (slot,)),
+        }
+    return out
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _set_len(cache, slot, value):
+    out = {}
+    for layer, buf in cache.items():
+        out[layer] = dict(buf)
+        out[layer]["cache_lens"] = buf["cache_lens"].at[slot].set(value)
+    return out
+
+
+class ServingEngine:
+    """Continuous-batching scheduler over one compiled decode step.
+
+    >>> eng = ServingEngine(decoder_model, params, n_slots=8, eos_id=2)
+    >>> s = eng.admit([5, 17, 99])       # returns a slot id
+    >>> eng.step(); eng.step()           # decode all active slots
+    >>> eng.finished(s), eng.output(s)
+    """
+
+    def __init__(
+        self,
+        model: DecodeTransformerLM,
+        params,
+        n_slots: int,
+        eos_id: Optional[int] = None,
+        chunk: Optional[int] = None,
+        max_new_tokens: Optional[int] = None,
+    ):
+        if n_slots < 1:
+            raise ValueError("n_slots must be >= 1")
+        if chunk is not None and chunk < 1:
+            raise ValueError("chunk must be >= 1 when set")
+        self.model = model
+        self.params = params
+        self.n_slots = n_slots
+        self.eos_id = eos_id
+        self.chunk = chunk
+        self.max_new_tokens = max_new_tokens
+        self.cache = init_cache(model, n_slots)
+        self.lens = [0] * n_slots          # host mirror of cache_lens
+        self.active = [False] * n_slots
+        self.last_token = np.zeros(n_slots, np.int32)
+        self.outputs: List[List[int]] = [[] for _ in range(n_slots)]
+        self._finished: Dict[int, List[int]] = {}
+
+    # -- admission ---------------------------------------------------------
+
+    def free_slots(self) -> List[int]:
+        return [s for s in range(self.n_slots) if not self.active[s]]
+
+    def admit(self, prompt) -> int:
+        """Prefill *prompt* into a free slot; returns the slot id.
+        Raises RuntimeError when the engine is full (callers queue)."""
+        prompt = jnp.asarray(prompt, jnp.int32).reshape(1, -1)
+        t_p = int(prompt.shape[1])
+        if t_p < 1:
+            raise ValueError("empty prompt")
+        budget = self.max_new_tokens or 1
+        if t_p + budget > self.model.max_len:
+            raise ValueError(
+                f"prompt {t_p} + budget {budget} exceeds "
+                f"max_len {self.model.max_len}")
+        free = self.free_slots()
+        if not free:
+            raise RuntimeError("no free slots")
+        slot = free[0]
+
+        mini = init_cache(self.model, 1)
+        if self.chunk is None:
+            # one compiled extend per distinct prompt length — fine for
+            # benchmarks/tests; set ``chunk`` to pin admission to a
+            # single compiled shape
+            pos = jnp.arange(t_p, dtype=jnp.int32)[None, :]
+            logits, mini = extend_step(
+                self.model, self.params, mini, prompt, pos)
+            last = logits[0, t_p - 1]
+        else:
+            # fixed-size chunks: every chunk reuses ONE compiled extend;
+            # the tail chunk pads with zeros whose K/V land beyond the
+            # true length (fixed below) and whose outputs are discarded
+            c = self.chunk
+            padded = ((t_p + c - 1) // c) * c
+            if padded > self.model.max_len:
+                raise ValueError(
+                    f"padded prompt {padded} exceeds max_len "
+                    f"{self.model.max_len} (shrink chunk or prompt)")
+            toks = jnp.concatenate(
+                [prompt,
+                 jnp.zeros((1, padded - t_p), jnp.int32)], axis=1)
+            last = None
+            for i in range(padded // c):
+                chunk_toks = toks[:, i * c:(i + 1) * c]
+                pos = (jnp.arange(c, dtype=jnp.int32) + i * c)[None, :]
+                logits, mini = extend_step(
+                    self.model, self.params, mini, chunk_toks, pos)
+                off = t_p - 1 - i * c
+                if 0 <= off < c:
+                    last = logits[0, off]
+            mini = _set_len(mini, jnp.int32(0), jnp.int32(t_p))
+
+        self.cache = _splice_slot(self.cache, mini, jnp.int32(slot))
+        self.lens[slot] = t_p
+        self.active[slot] = True
+        first = int(jnp.argmax(last))
+        self.last_token[slot] = first
+        self.outputs[slot] = [first]
+        self._maybe_finish(slot, first)
+        return slot
+
+    # -- decoding ----------------------------------------------------------
+
+    def step(self) -> Dict[int, int]:
+        """One greedy decode step for every active slot.  Returns
+        {slot: token} for slots still active after the step."""
+        if not any(self.active):
+            return {}
+        for s in range(self.n_slots):
+            if self.active[s] and self.lens[s] >= self.model.max_len:
+                self._finish(s)
+        if not any(self.active):
+            return {}
+        tokens = jnp.asarray(self.last_token)[:, None]
+        positions = jnp.asarray(self.lens, jnp.int32)[:, None]
+        logits, self.cache = extend_step(
+            self.model, self.params, self.cache, tokens, positions)
+        nxt = np.asarray(jnp.argmax(logits[:, -1, :], axis=-1),
+                         dtype=np.int32)
+        out = {}
+        for s in range(self.n_slots):
+            self.lens[s] += 1  # every slot appended (masking, not branching)
+            if not self.active[s]:
+                continue
+            tok = int(nxt[s])
+            self.last_token[s] = tok
+            self.outputs[s].append(tok)
+            out[s] = tok
+            self._maybe_finish(s, tok)
+        return out
+
+    def run(self, max_steps: int) -> None:
+        for _ in range(max_steps):
+            if not any(self.active):
+                return
+            self.step()
+
+    # -- completion --------------------------------------------------------
+
+    def _maybe_finish(self, slot: int, token: int) -> None:
+        budget_hit = (
+            self.max_new_tokens is not None
+            and len(self.outputs[slot]) >= self.max_new_tokens
+        )
+        if (self.eos_id is not None and token == self.eos_id) or budget_hit:
+            self._finish(slot)
+
+    def _finish(self, slot: int) -> None:
+        self._finished[slot] = self.outputs[slot]
+        self.active[slot] = False
+
+    def finished(self, slot: int) -> bool:
+        return slot in self._finished
+
+    def output(self, slot: int) -> List[int]:
+        """Generated tokens for *slot* (finished or in flight)."""
+        return list(self.outputs[slot])
+
+    def release(self, slot: int) -> None:
+        """Free a slot (abandons any in-flight generation)."""
+        self.active[slot] = False
+        self._finished.pop(slot, None)
+        self.lens[slot] = 0

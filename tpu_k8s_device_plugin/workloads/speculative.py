@@ -1,0 +1,181 @@
+"""Speculative decoding: a small draft model proposes, the target
+verifies in one banded extend — exact greedy output, fewer
+target-model passes.
+
+Serving on TPU is weight-bandwidth-bound: each greedy step reads every
+target weight once to emit ONE token.  Speculative decoding amortizes
+that read: the draft (cheap) proposes ``gamma`` tokens autoregressively,
+then the target scores all of them in a single ``CachedBlock`` extend
+(``decode=True, T=gamma+...``) — one weight read for up to ``gamma+1``
+emitted tokens.  With greedy acceptance the output is PROVABLY
+identical to target-only greedy decoding (each accepted token equals
+the target's argmax given the same prefix; the first mismatch is
+replaced by the target's own argmax, exactly what plain greedy would
+have emitted), which tests/test_speculative.py asserts token-for-token.
+
+The rollback that acceptance needs is free in this engine: rejected
+positions' K/V stay in the cache as garbage beyond ``cache_lens``
+(reset by one scatter) and are overwritten by the next append — no
+copies, no paging.
+
+This is the serving-side counterpart of the reference's vLLM example
+feature set (/root/reference/example/vllm-serve/deployment.yaml:28-56);
+vLLM ships speculative decoding as a core serving optimization.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .inference import DecodeTransformerLM, extend_step, init_cache
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _rollback(cache, new_len):
+    """Reset every layer's cache_lens to *new_len* ([B] or scalar).
+    K/V beyond the new length become dead rows the next append
+    overwrites — rollback is one scatter, not a copy."""
+    out = {}
+    for layer, buf in cache.items():
+        out[layer] = dict(buf)
+        out[layer]["cache_lens"] = jnp.broadcast_to(
+            jnp.asarray(new_len, jnp.int32), buf["cache_lens"].shape
+        )
+    return out
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2), donate_argnums=(3,))
+def _draft_propose(model, params, gamma, cache, first, pos0):
+    """Draft *gamma* tokens greedily from its own cache via lax.scan.
+    Returns (proposed [1, gamma], cache after the proposals).
+
+    The scan's g steps append K/V for [first, props[0..g-2]]; a final
+    logit-discarded extend appends props[g-1] too, so the draft cache
+    always covers every token that can end up committed (the
+    all-accepted case needs props[g-1]'s row on the next round)."""
+
+    def step(carry, _):
+        cache, tok, pos = carry
+        logits, mut = model.apply(
+            {"params": params, "cache": cache},
+            tok[:, None], pos[:, None], decode=True, mutable=["cache"],
+        )
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        return (mut["cache"], nxt, pos + 1), nxt
+
+    (cache, last, pos), toks = lax.scan(
+        step, (cache, first, pos0), None, length=gamma
+    )
+    _, mut = model.apply(
+        {"params": params, "cache": cache},
+        last[:, None], pos[:, None], decode=True, mutable=["cache"],
+    )
+    return toks.transpose(1, 0), mut["cache"]  # [1, gamma]
+
+
+def speculative_generate(
+    target: DecodeTransformerLM,
+    target_params,
+    draft: DecodeTransformerLM,
+    draft_params,
+    prompt: jax.Array,  # [T_p] or [1, T_p] int32
+    n_steps: int,
+    gamma: int = 4,
+) -> Tuple[jax.Array, float]:
+    """Greedy speculative decoding for a single sequence.
+
+    Returns ``(generated [n_steps], accept_rate)`` where the tokens are
+    bit-identical to ``greedy_generate(target, ...)`` and accept_rate
+    is the fraction of draft proposals the target kept (a quality
+    metric for the draft, not a correctness knob).
+    """
+    if gamma < 1:
+        raise ValueError("gamma must be >= 1")
+    prompt = jnp.asarray(prompt, jnp.int32).reshape(1, -1)
+    t_p = int(prompt.shape[1])
+    if t_p + n_steps > target.max_len:
+        raise ValueError(
+            f"prompt {t_p} + steps {n_steps} exceeds target max_len "
+            f"{target.max_len}")
+    if t_p + n_steps + gamma > draft.max_len:
+        raise ValueError(
+            f"draft max_len {draft.max_len} too small for prompt {t_p} "
+            f"+ steps {n_steps} + gamma {gamma}")
+
+    pos_p = jnp.arange(t_p, dtype=jnp.int32)[None, :]
+    t_cache = init_cache(target, 1)
+    d_cache = init_cache(draft, 1)
+    t_logits, t_cache = extend_step(
+        target, target_params, t_cache, prompt, pos_p)
+    _, d_cache = extend_step(draft, draft_params, d_cache, prompt, pos_p)
+
+    out = [int(jnp.argmax(t_logits[0, -1]))]
+    produced = 1
+    length = t_p  # committed tokens in both caches (excl. generated tail)
+    proposed_total = 0
+    accepted_total = 0
+
+    # committed state: caches hold `length` positions; `out[-1]` is the
+    # last committed token, not yet appended to either cache
+    while produced < n_steps:
+        g = min(gamma, n_steps - produced)
+        # can't verify past the target cache: g+1 appends must fit
+        g = min(g, target.max_len - length - 1)
+        if g < 1:
+            break
+        first = jnp.asarray([out[-1]], jnp.int32)
+        pos0 = jnp.asarray([length], jnp.int32)
+        props, d_cache = _draft_propose(
+            draft, draft_params, g, d_cache, first, pos0)
+
+        # target verifies last-committed + proposals in ONE extend:
+        # logits[t] is the target's next-token dist after seeing
+        # out[-1], props[0..t-1]
+        verify_toks = jnp.concatenate([first[:, None], props], axis=1)
+        verify_pos = (
+            jnp.arange(g + 1, dtype=jnp.int32) + length)[None, :]
+        v_logits, t_cache = extend_step(
+            target, target_params, t_cache, verify_toks, verify_pos)
+        choices = np.asarray(
+            jnp.argmax(v_logits[0], axis=-1), dtype=np.int32)  # [g+1]
+        props_h = np.asarray(props[0], dtype=np.int32)
+
+        n_acc = 0
+        while n_acc < g and choices[n_acc] == props_h[n_acc]:
+            n_acc += 1
+        # accepted proposals + the target's own next token (the
+        # correction at the first mismatch, or the bonus token when all
+        # proposals were accepted)
+        new_toks = [int(x) for x in props_h[:n_acc]] + [int(choices[n_acc])]
+        new_toks = new_toks[: n_steps - produced]
+        out.extend(new_toks)
+        produced += len(new_toks)
+        proposed_total += g
+        accepted_total += n_acc
+
+        # commit: both caches advance past out[-1]'s predecessors —
+        # the target cache holds length + g + 1 appended rows, of which
+        # (1 + n_acc) are committed (first + accepted proposals); the
+        # draft holds length + g, same commit point
+        length += 1 + n_acc
+        t_cache = _rollback(t_cache, length)
+        d_cache = _rollback(d_cache, length)
+
+    # if the verify loop stopped early (cache headroom), finish greedy
+    while produced < n_steps:
+        first = jnp.asarray([out[-1]], jnp.int32)
+        pos0 = jnp.asarray([[length]], jnp.int32)
+        logits, t_cache = extend_step(
+            target, target_params, t_cache, first[:, None], pos0)
+        out.append(int(jnp.argmax(logits[0, -1])))
+        produced += 1
+        length += 1
+
+    rate = accepted_total / proposed_total if proposed_total else 0.0
+    return jnp.asarray(out, jnp.int32), rate
