@@ -179,3 +179,25 @@ def dataclasses_replace(cfg, **kw):
     import dataclasses
 
     return dataclasses.replace(cfg, **kw)
+
+
+def test_random_quantized_tree_matches_quantize_layout():
+    # random_quantized_params must produce exactly the tree that
+    # quantize_lm_params(train params) produces — same keys, shapes,
+    # dtypes — so the 8B bench exercises the real serving path
+    train, _ = _models()
+    params, tokens, _ = _init(train, batch=1, seq=8)
+    ref = quantize_lm_params(params)
+    got = llama.random_quantized_params(CFG, dtype=DT)
+    rs = jax.tree_util.tree_structure(ref)
+    gs = jax.tree_util.tree_structure(got)
+    assert rs == gs
+    for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(ref),
+            jax.tree_util.tree_leaves_with_path(got)):
+        assert a.shape == b.shape, (pa, a.shape, b.shape)
+        assert a.dtype == b.dtype, (pa, a.dtype, b.dtype)
+    # and it actually decodes
+    qserve = llama.decoder(CFG, dtype=DT, quantized=True)
+    out, _ = greedy_generate(qserve, got, jnp.asarray([[1, 2, 3]]), 3)
+    assert out.shape == (1, 3)

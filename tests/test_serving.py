@@ -189,3 +189,16 @@ def test_moe_chunked_prefill_matches_unchunked():
     plain.run(6)
     chunked.run(6)
     assert plain.output(sp) == chunked.output(sc)
+
+
+def test_recycled_slot_is_not_finished(setup):
+    model, params = setup
+    eng = ServingEngine(model, params, n_slots=1, max_new_tokens=2)
+    sa = eng.admit([3, 14])
+    eng.run(5)
+    assert eng.finished(sa)
+    sb = eng.admit([7, 7, 2])
+    assert sb == sa
+    assert not eng.finished(sb)  # stale record must not leak
+    eng.run(5)
+    assert eng.finished(sb)

@@ -18,7 +18,11 @@ from .inference import (
     quantize_lm_params,
     sample_generate,
 )
+from . import llama
 from .moe import MoEFFN, top_k_routing
+from .pool import max_pool as pallas_max_pool
+from .serving import ServingEngine
+from .speculative import speculative_generate
 from .parallel import make_mesh, make_sharded_train_step
 from .pipeline import make_pipeline, stack_layer_params
 from .ring_attention import (
@@ -43,6 +47,10 @@ __all__ = [
     "make_decoder",
     "quantize_lm_params",
     "sample_generate",
+    "ServingEngine",
+    "llama",
+    "pallas_max_pool",
+    "speculative_generate",
     "make_lm_mesh",
     "make_lm_train_step",
     "make_mesh",

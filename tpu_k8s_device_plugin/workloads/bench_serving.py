@@ -1,0 +1,76 @@
+"""Pod-runnable serving benchmark: tokens/sec of the native decode
+engine (the counterpart of bench_main.py for BASELINE config #5).
+
+Runs the KV-cache decode loop on whatever chips the plugin granted and
+prints tokens/sec — e.g. Llama-3-8B weight-only int8 on a single v5e
+(the model family the reference's vLLM example deploys, served by the
+native engine instead of an opaque image):
+
+    python -m tpu_k8s_device_plugin.workloads.bench_serving \
+        --config llama3-8b --quantized --batch 1 --steps 64
+
+Weights are random (throughput moves bytes, not meanings) and are
+constructed DIRECTLY in the quantized layout so the 8B config fits on
+one 16 GB chip (see llama.random_quantized_params).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+
+from . import llama
+from .inference import decode_throughput, quantize_lm_params
+
+CONFIGS = {
+    "llama3-8b": llama.LLAMA3_8B,
+    "llama2-7b": llama.LLAMA2_7B,
+    "tiny": llama.TINY_LLAMA,
+}
+
+
+def run(config: str, quantized: bool, batch: int, steps: int,
+        prompt_len: int, max_len: int):
+    cfg = CONFIGS[config]
+    model = llama.decoder(cfg, max_len=max_len, quantized=quantized)
+    if quantized:
+        params = llama.random_quantized_params(cfg)
+    else:
+        # small configs only: materializes the bf16 tree
+        train = llama.train_model(cfg)
+        tokens = jnp.zeros((1, 8), jnp.int32)
+        pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+        params = train.init(jax.random.PRNGKey(0), tokens, pos)["params"]
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab)
+    stats = decode_throughput(model, params, prompt, steps)
+    stats["config"] = config
+    stats["quantized"] = quantized
+    return stats
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tpu-serving-bench")
+    p.add_argument("--config", choices=sorted(CONFIGS), default="tiny")
+    p.add_argument("--quantized", action="store_true")
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--steps", type=int, default=64)
+    p.add_argument("--prompt-len", type=int, default=128)
+    p.add_argument("--max-len", type=int, default=512)
+    args = p.parse_args(argv)
+    if args.prompt_len + args.steps > args.max_len:
+        p.error("--prompt-len + --steps must fit in --max-len")
+
+    devs = jax.devices()
+    print(f"devices: {len(devs)} x {devs[0].platform}", flush=True)
+    stats = run(args.config, args.quantized, args.batch, args.steps,
+                args.prompt_len, args.max_len)
+    for k, v in stats.items():
+        print(f"{k}: {v}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -23,11 +23,12 @@ into serving unchanged, and ``inference.quantize_lm_params`` applies
 as-is (mlp_gate quantizes with the other projections).
 
 Memory note for the 8B config on one v5e (16 GB HBM): bf16 weights are
-~16 GB — does not fit; weight-only int8 (~8 GB + bf16 embed) fits with
-room for the GQA cache (8 kv-heads × 128 = 131 kB/token/layer... 32
-layers ≈ 64 kB/token total at bf16, so 4k context ≈ 0.26 GB).  That is
-the single-chip serving configuration; bf16 serving of 8B wants a
-2-chip ``model``-axis mesh.
+~16 GB — does not fit; weight-only int8 (~8 GB kernels + the 2.1 GB
+f32 embed quantize keeps as-is ≈ 10.4 GB) fits with room for the GQA
+cache (8 kv-heads × 128 dims × 32 layers ≈ 131 kB/token at bf16, so
+4k context ≈ 0.54 GB at batch 1).  That is the single-chip serving
+configuration; bf16 serving of 8B wants a 2-chip ``model``-axis
+mesh.
 """
 
 from __future__ import annotations
@@ -116,3 +117,61 @@ def decoder(
         quantized=quantized, n_kv_heads=cfg.n_kv_heads, ffn="swiglu",
         rope_theta=cfg.rope_theta,
     )
+
+
+def random_quantized_params(
+    cfg: LlamaConfig, seed: int = 0, dtype: Any = COMPUTE_DTYPE
+):
+    """Random weight-only-int8 parameter tree for *cfg*, built DIRECTLY
+    in the quantized layout.
+
+    For throughput benchmarking the weight values are irrelevant — only
+    their bytes move — but the construction path matters a lot at 8B
+    scale: materializing the bf16 tree (~16 GB) and then quantizing
+    would not fit next to the int8 copy on one 16 GB chip.  Each leaf
+    is created at its final dtype — int8 kernels, f32 scales, and an
+    f32 embed/norms exactly like a real ``quantize_lm_params`` output
+    (flax param dtype is f32 regardless of the compute dtype, and
+    quantize keeps embeds/norms as-is) — so peak memory is the true
+    serving footprint (~10.4 GB for the 8B config: 8 GB int8 kernels +
+    2.1 GB f32 embed).  Tree layout matches
+    ``quantize_lm_params(train_model(cfg) params)`` exactly (asserted
+    in tests/test_llama.py)."""
+    import numpy as np
+
+    del dtype  # leaf dtypes are fixed by the real quantized layout
+    rng = np.random.default_rng(seed)
+    d, f, v = cfg.d_model, cfg.d_ff, cfg.vocab
+    hd = cfg.head_dim
+    qkv_out = (cfg.n_heads + 2 * cfg.n_kv_heads) * hd
+
+    def kern(din, dout):
+        return {
+            "kernel_int8": jnp.asarray(
+                rng.integers(-127, 128, (din, dout), dtype=np.int8)),
+            "scale": jnp.full((dout,), 0.01, jnp.float32),
+        }
+
+    def norm():
+        return {"scale": jnp.ones((d,), jnp.float32)}
+
+    params = {
+        "embed": {
+            "embedding": jnp.asarray(
+                rng.standard_normal((v, d), np.float32) * 0.02,
+                jnp.float32)
+        },
+        "final_norm": norm(),
+        "lm_head": kern(d, v),
+    }
+    for i in range(cfg.n_layers):
+        params[f"block_{i}"] = {
+            "attn_norm": norm(),
+            "mlp_norm": norm(),
+            "qkv": kern(d, qkv_out),
+            "out_proj": kern(d, d),
+            "mlp_gate": kern(d, f),
+            "mlp_up": kern(d, f),
+            "mlp_down": kern(f, d),
+        }
+    return params

@@ -132,6 +132,10 @@ class ServingEngine:
         if not free:
             raise RuntimeError("no free slots")
         slot = free[0]
+        # recycling a slot must drop the previous request's finished
+        # record, or finished(slot) would report True for the new
+        # in-flight request
+        self._finished.pop(slot, None)
 
         mini = init_cache(self.model, 1)
         if self.chunk is None:

@@ -124,11 +124,11 @@ def speculative_generate(
     # committed state: caches hold `length` positions; `out[-1]` is the
     # last committed token, not yet appended to either cache
     while produced < n_steps:
+        # the entry guard (t_p + n_steps <= target.max_len) plus the
+        # invariant length == t_p + produced - 1 gives
+        # max_len - length - 1 >= n_steps - produced >= g, so the g+1
+        # verify appends always fit the target cache
         g = min(gamma, n_steps - produced)
-        # can't verify past the target cache: g+1 appends must fit
-        g = min(g, target.max_len - length - 1)
-        if g < 1:
-            break
         first = jnp.asarray([out[-1]], jnp.int32)
         pos0 = jnp.asarray([length], jnp.int32)
         props, d_cache = _draft_propose(
@@ -166,16 +166,6 @@ def speculative_generate(
         length += 1 + n_acc
         t_cache = _rollback(t_cache, length)
         d_cache = _rollback(d_cache, length)
-
-    # if the verify loop stopped early (cache headroom), finish greedy
-    while produced < n_steps:
-        first = jnp.asarray([out[-1]], jnp.int32)
-        pos0 = jnp.asarray([[length]], jnp.int32)
-        logits, t_cache = extend_step(
-            target, target_params, t_cache, first[:, None], pos0)
-        out.append(int(jnp.argmax(logits[0, -1])))
-        produced += 1
-        length += 1
 
     rate = accepted_total / proposed_total if proposed_total else 0.0
     return jnp.asarray(out, jnp.int32), rate
