@@ -202,3 +202,93 @@ def test_recycled_slot_is_not_finished(setup):
     assert not eng.finished(sb)  # stale record must not leak
     eng.run(5)
     assert eng.finished(sb)
+
+
+def test_tensor_parallel_engine_matches_single_device(setup):
+    # TP serving: params Megatron-split, cache sharded on the KV head
+    # axis over a model=2 mesh — tokens must match the meshless engine
+    from tpu_k8s_device_plugin.workloads.transformer import make_lm_mesh
+
+    cfg = llama.TINY_LLAMA  # 2 KV heads: shardable over model=2
+    model = llama.decoder(cfg, dtype=DT, max_len=64)
+    rng = jax.random.PRNGKey(2)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+    params = model.init(rng, tokens, pos)["params"]
+    mesh = make_lm_mesh(seq=1, model=2, expert=1)
+
+    prompts = {"a": [5, 17, 3, 70], "b": [2, 71, 82, 9, 14]}
+    plain = ServingEngine(model, params, n_slots=2)
+    tp = ServingEngine(model, params, n_slots=2, mesh=mesh, chunk=4)
+    slots_p = {k: plain.admit(v) for k, v in prompts.items()}
+    slots_t = {k: tp.admit(v) for k, v in prompts.items()}
+    plain.run(6)
+    tp.run(6)
+    for k in prompts:
+        assert plain.output(slots_p[k]) == tp.output(slots_t[k]), k
+
+
+def test_tp_engine_rejects_unshardable_kv_heads(setup):
+    from tpu_k8s_device_plugin.workloads.transformer import make_lm_mesh
+
+    model, params = setup  # 4 heads, MHA
+    mesh = make_lm_mesh(seq=1, model=8, expert=1)
+    with pytest.raises(ValueError, match="model"):
+        ServingEngine(model, params, n_slots=2, mesh=mesh)
+
+
+def test_prefix_cache_matches_full_admit(setup):
+    model, params = setup
+    system = [7, 7, 7, 12, 90, 3]
+    ua, ub = [5, 9, 3], [44, 1]
+    ref = ServingEngine(model, params, n_slots=2)
+    eng = ServingEngine(model, params, n_slots=2, chunk=4)
+    h = eng.register_prefix(system)
+    sa = eng.admit(system + ua, prefix=h)
+    sb = eng.admit(system + ub, prefix=h)  # prefix reused (copy survives)
+    ra = ref.admit(system + ua)
+    rb = ref.admit(system + ub)
+    eng.run(6)
+    ref.run(6)
+    assert eng.output(sa) == ref.output(ra)
+    assert eng.output(sb) == ref.output(rb)
+
+
+def test_prefix_exact_prompt_equals_prefix(setup):
+    model, params = setup
+    system = [7, 7, 12, 90]
+    eng = ServingEngine(model, params, n_slots=2)
+    ref = ServingEngine(model, params, n_slots=2)
+    h = eng.register_prefix(system)
+    s = eng.admit(system, prefix=h)  # empty suffix: uses stored logits
+    r = ref.admit(system)
+    eng.run(4)
+    ref.run(4)
+    assert eng.output(s) == ref.output(r)
+
+
+def test_prefix_mismatch_rejected(setup):
+    model, params = setup
+    eng = ServingEngine(model, params, n_slots=2)
+    h = eng.register_prefix([1, 2, 3])
+    with pytest.raises(ValueError, match="prefix"):
+        eng.admit([1, 9, 3, 4], prefix=h)
+    with pytest.raises(ValueError, match="prefix"):
+        eng.admit([1, 2], prefix=h)  # shorter than the prefix
+
+
+def test_rejected_prefix_admit_leaves_state_untouched(setup):
+    model, params = setup
+    eng = ServingEngine(model, params, n_slots=1, max_new_tokens=2)
+    h = eng.register_prefix([1, 2, 3])
+    sa = eng.admit([1, 2, 3, 4], prefix=h)
+    eng.run(5)
+    assert eng.finished(sa)
+    with pytest.raises(ValueError, match="prefix"):
+        eng.admit([9, 9, 9, 9], prefix=h)  # mismatch
+    assert eng.finished(sa)  # the finished record must survive
+    with pytest.raises(ValueError, match="unknown prefix"):
+        eng.admit([1, 2, 3, 4], prefix=1234)
+    eng.release_prefix(h)
+    with pytest.raises(ValueError, match="unknown prefix"):
+        eng.admit([1, 2, 3, 4], prefix=h)

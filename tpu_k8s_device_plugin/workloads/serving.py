@@ -93,32 +93,139 @@ class ServingEngine:
         eos_id: Optional[int] = None,
         chunk: Optional[int] = None,
         max_new_tokens: Optional[int] = None,
+        mesh=None,
     ):
         if n_slots < 1:
             raise ValueError("n_slots must be >= 1")
         if chunk is not None and chunk < 1:
             raise ValueError("chunk must be >= 1 when set")
         self.model = model
-        self.params = params
         self.n_slots = n_slots
         self.eos_id = eos_id
         self.chunk = chunk
         self.max_new_tokens = max_new_tokens
-        self.cache = init_cache(model, n_slots)
+        self.mesh = mesh
+        if mesh is not None:
+            # tensor-parallel serving (the native analog of the vLLM
+            # example's --tensor-parallel-size): params take the
+            # training side's Megatron splits on the mesh's ``model``
+            # axis, the KV cache shards on its (grouped) head axis, and
+            # XLA propagates those shardings through every extend —
+            # the engine code is identical, the collectives are placed
+            # by the partitioner (SURVEY.md §5 division of labor)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from .transformer import lm_tree_shardings
+
+            n_kv = model.n_kv_heads or model.n_heads
+            if n_kv % mesh.shape.get("model", 1):
+                raise ValueError(
+                    f"n_kv_heads={n_kv} must divide the mesh's model "
+                    f"axis ({mesh.shape.get('model', 1)}) to shard the "
+                    "KV cache")
+            params = jax.device_put(params, lm_tree_shardings(mesh, params))
+            self._kv_sharding = NamedSharding(
+                mesh, P(None, None, "model", None))
+            self._len_sharding = NamedSharding(mesh, P())
+        else:
+            self._kv_sharding = None
+            self._len_sharding = None
+        self.params = params
+        self.cache = self._place_cache(init_cache(model, n_slots))
         self.lens = [0] * n_slots          # host mirror of cache_lens
         self.active = [False] * n_slots
         self.last_token = np.zeros(n_slots, np.int32)
         self.outputs: List[List[int]] = [[] for _ in range(n_slots)]
         self._finished: Dict[int, List[int]] = {}
+        self._prefixes: Dict[int, tuple] = {}
+        self._next_prefix = 0
+
+    def _place_cache(self, cache):
+        """Apply the TP shardings to a cache pytree (no-op meshless)."""
+        if self._kv_sharding is None:
+            return cache
+        return {
+            layer: {
+                "cached_k": jax.device_put(buf["cached_k"],
+                                           self._kv_sharding),
+                "cached_v": jax.device_put(buf["cached_v"],
+                                           self._kv_sharding),
+                "cache_lens": jax.device_put(buf["cache_lens"],
+                                             self._len_sharding),
+            }
+            for layer, buf in cache.items()
+        }
 
     # -- admission ---------------------------------------------------------
 
     def free_slots(self) -> List[int]:
         return [s for s in range(self.n_slots) if not self.active[s]]
 
-    def admit(self, prompt) -> int:
+    def _extend_prompt(self, mini, toks, start: int):
+        """Push *toks* [1, n] into the B=1 *mini* cache starting at
+        depth *start*; returns (mini, last real token's logits row)."""
+        n = int(toks.shape[1])
+        if self.chunk is None:
+            # one compiled extend per distinct prompt length — fine for
+            # benchmarks/tests; set ``chunk`` to pin admission to a
+            # single compiled shape
+            pos = (jnp.arange(n, dtype=jnp.int32) + start)[None, :]
+            logits, mini = extend_step(
+                self.model, self.params, mini, toks, pos)
+            return mini, logits[0, n - 1]
+        # fixed-size chunks: every chunk reuses ONE compiled extend; the
+        # tail chunk pads with zeros whose K/V land beyond the true
+        # length (fixed below) and whose outputs are discarded
+        c = self.chunk
+        padded = ((n + c - 1) // c) * c
+        if start + padded > self.model.max_len:
+            raise ValueError(
+                f"padded prompt {start + padded} exceeds max_len "
+                f"{self.model.max_len} (shrink chunk or prompt)")
+        toks = jnp.concatenate(
+            [toks, jnp.zeros((1, padded - n), jnp.int32)], axis=1)
+        last = None
+        for i in range(padded // c):
+            chunk_toks = toks[:, i * c:(i + 1) * c]
+            pos = (
+                jnp.arange(c, dtype=jnp.int32) + start + i * c
+            )[None, :]
+            logits, mini = extend_step(
+                self.model, self.params, mini, chunk_toks, pos)
+            off = n - 1 - i * c
+            if 0 <= off < c:
+                last = logits[0, off]
+        return _set_len(mini, jnp.int32(0), jnp.int32(start + n)), last
+
+    def register_prefix(self, tokens) -> int:
+        """Prefill a shared prompt prefix (e.g. a system prompt) ONCE
+        and reuse it across admits: ``admit(prompt, prefix=handle)``
+        skips recomputing the first ``len(tokens)`` positions.  Returns
+        an opaque handle."""
+        toks = jnp.asarray(tokens, jnp.int32).reshape(1, -1)
+        if int(toks.shape[1]) < 1:
+            raise ValueError("empty prefix")
+        mini = self._place_cache(init_cache(self.model, 1))
+        mini, last = self._extend_prompt(mini, toks, start=0)
+        handle = self._next_prefix
+        self._next_prefix += 1
+        self._prefixes[handle] = (
+            np.asarray(toks[0], np.int32), mini, last)
+        return handle
+
+    def release_prefix(self, handle: int) -> None:
+        """Drop a registered prefix.  Each handle retains a full
+        [1, T_max, Hkv, Dh] per-layer cache (sized for max_len, not the
+        prefix — splice and extend need full rows), so long-running
+        engines should release prefixes they no longer admit against."""
+        self._prefixes.pop(handle, None)
+
+    def admit(self, prompt, prefix: Optional[int] = None) -> int:
         """Prefill *prompt* into a free slot; returns the slot id.
-        Raises RuntimeError when the engine is full (callers queue)."""
+        Raises RuntimeError when the engine is full (callers queue).
+        With ``prefix`` (a :meth:`register_prefix` handle), the prompt
+        must start with the registered tokens and only the suffix is
+        prefilled — the prefix K/V is copied from the registry."""
         prompt = jnp.asarray(prompt, jnp.int32).reshape(1, -1)
         t_p = int(prompt.shape[1])
         if t_p < 1:
@@ -132,43 +239,35 @@ class ServingEngine:
         if not free:
             raise RuntimeError("no free slots")
         slot = free[0]
+
+        if prefix is not None:
+            # validate BEFORE touching any slot bookkeeping — a
+            # rejected admit must leave the engine state untouched
+            if prefix not in self._prefixes:
+                raise ValueError(f"unknown prefix handle {prefix}")
+            ptoks, pcache, plast = self._prefixes[prefix]
+            L = len(ptoks)
+            if t_p < L or not np.array_equal(
+                    np.asarray(prompt[0, :L]), ptoks):
+                raise ValueError(
+                    "prompt does not start with the registered prefix")
         # recycling a slot must drop the previous request's finished
         # record, or finished(slot) would report True for the new
         # in-flight request
         self._finished.pop(slot, None)
 
-        mini = init_cache(self.model, 1)
-        if self.chunk is None:
-            # one compiled extend per distinct prompt length — fine for
-            # benchmarks/tests; set ``chunk`` to pin admission to a
-            # single compiled shape
-            pos = jnp.arange(t_p, dtype=jnp.int32)[None, :]
-            logits, mini = extend_step(
-                self.model, self.params, mini, prompt, pos)
-            last = logits[0, t_p - 1]
+        if prefix is not None:
+            # copy before extending: extend_step DONATES its cache, and
+            # the registry entry must survive for the next admit
+            mini = jax.tree_util.tree_map(jnp.copy, pcache)
+            if t_p > L:
+                mini, last = self._extend_prompt(
+                    mini, prompt[:, L:], start=L)
+            else:
+                last = plast
         else:
-            # fixed-size chunks: every chunk reuses ONE compiled extend;
-            # the tail chunk pads with zeros whose K/V land beyond the
-            # true length (fixed below) and whose outputs are discarded
-            c = self.chunk
-            padded = ((t_p + c - 1) // c) * c
-            if padded > self.model.max_len:
-                raise ValueError(
-                    f"padded prompt {padded} exceeds max_len "
-                    f"{self.model.max_len} (shrink chunk or prompt)")
-            toks = jnp.concatenate(
-                [prompt,
-                 jnp.zeros((1, padded - t_p), jnp.int32)], axis=1)
-            last = None
-            for i in range(padded // c):
-                chunk_toks = toks[:, i * c:(i + 1) * c]
-                pos = (jnp.arange(c, dtype=jnp.int32) + i * c)[None, :]
-                logits, mini = extend_step(
-                    self.model, self.params, mini, chunk_toks, pos)
-                off = t_p - 1 - i * c
-                if 0 <= off < c:
-                    last = logits[0, off]
-            mini = _set_len(mini, jnp.int32(0), jnp.int32(t_p))
+            mini = self._place_cache(init_cache(self.model, 1))
+            mini, last = self._extend_prompt(mini, prompt, start=0)
 
         self.cache = _splice_slot(self.cache, mini, jnp.int32(slot))
         self.lens[slot] = t_p
