@@ -292,3 +292,20 @@ def test_rejected_prefix_admit_leaves_state_untouched(setup):
     eng.release_prefix(h)
     with pytest.raises(ValueError, match="unknown prefix"):
         eng.admit([1, 2, 3, 4], prefix=h)
+
+
+def test_chunk_overflow_rejected_before_state_mutation(setup):
+    model, params = setup  # max_len=64
+    eng = ServingEngine(model, params, n_slots=1, chunk=8,
+                        max_new_tokens=1)
+    h = eng.register_prefix([1, 2, 3])
+    sa = eng.admit([1, 2, 3, 4], prefix=h)
+    eng.run(3)
+    assert eng.finished(sa)
+    # t_p=62 passes the budget check (62+1 <= 64) but the padded
+    # suffix (3 + ceil(59/8)*8 = 67) overflows — must reject WITHOUT
+    # erasing the finished record
+    big = [1, 2, 3] + list(range(59))
+    with pytest.raises(ValueError, match="padded"):
+        eng.admit(big, prefix=h)
+    assert eng.finished(sa)

@@ -18,6 +18,10 @@ from .inference import (
     quantize_lm_params,
     sample_generate,
 )
+try:  # checkpointing needs orbax; the rest of the workloads don't
+    from . import checkpoint
+except ImportError:  # pragma: no cover - orbax always in the CI image
+    checkpoint = None
 from . import llama
 from .moe import MoEFFN, top_k_routing
 from .pool import max_pool as pallas_max_pool
@@ -48,6 +52,7 @@ __all__ = [
     "quantize_lm_params",
     "sample_generate",
     "ServingEngine",
+    "checkpoint",
     "llama",
     "pallas_max_pool",
     "speculative_generate",

@@ -240,9 +240,9 @@ class ServingEngine:
             raise RuntimeError("no free slots")
         slot = free[0]
 
+        # validate EVERYTHING before touching any slot bookkeeping — a
+        # rejected admit must leave the engine state untouched
         if prefix is not None:
-            # validate BEFORE touching any slot bookkeeping — a
-            # rejected admit must leave the engine state untouched
             if prefix not in self._prefixes:
                 raise ValueError(f"unknown prefix handle {prefix}")
             ptoks, pcache, plast = self._prefixes[prefix]
@@ -251,20 +251,32 @@ class ServingEngine:
                     np.asarray(prompt[0, :L]), ptoks):
                 raise ValueError(
                     "prompt does not start with the registered prefix")
+            start, n = L, t_p - L
+        else:
+            start, n = 0, t_p
+        if self.chunk is not None and n > 0:
+            padded = ((n + self.chunk - 1) // self.chunk) * self.chunk
+            if start + padded > self.model.max_len:
+                raise ValueError(
+                    f"padded prompt {start + padded} exceeds max_len "
+                    f"{self.model.max_len} (shrink chunk or prompt)")
         # recycling a slot must drop the previous request's finished
         # record, or finished(slot) would report True for the new
         # in-flight request
         self._finished.pop(slot, None)
 
         if prefix is not None:
-            # copy before extending: extend_step DONATES its cache, and
-            # the registry entry must survive for the next admit
-            mini = jax.tree_util.tree_map(jnp.copy, pcache)
-            if t_p > L:
+            if n > 0:
+                # copy before extending: extend_step DONATES its cache,
+                # and the registry entry must survive for the next admit
+                mini = jax.tree_util.tree_map(jnp.copy, pcache)
                 mini, last = self._extend_prompt(
                     mini, prompt[:, L:], start=L)
             else:
-                last = plast
+                # exact-prefix prompt: no extend runs, and _splice_slot
+                # does not donate its mini argument, so the registry
+                # cache splices directly — no copy
+                mini, last = pcache, plast
         else:
             mini = self._place_cache(init_cache(self.model, 1))
             mini, last = self._extend_prompt(mini, prompt, start=0)
