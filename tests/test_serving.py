@@ -309,3 +309,76 @@ def test_chunk_overflow_rejected_before_state_mutation(setup):
     with pytest.raises(ValueError, match="padded"):
         eng.admit(big, prefix=h)
     assert eng.finished(sa)
+
+
+def test_greedy_slot_unaffected_by_sampling_neighbor(setup):
+    model, params = setup
+    pa = [3, 14, 15, 92, 65]
+    eng = ServingEngine(model, params, n_slots=4)
+    sg = eng.admit(pa)                                   # greedy
+    ss = eng.admit([9, 9, 8], temperature=1.5, top_k=8)  # sampled
+    eng.run(6)
+    assert eng.output(sg)[:7] == _solo(model, params, pa, 7)
+    assert len(eng.output(ss)) == 7
+
+
+def test_sampling_reproducible_with_seed(setup):
+    model, params = setup
+    prompt = [5, 17, 3, 70]
+    outs = []
+    for _ in range(2):
+        eng = ServingEngine(model, params, n_slots=2,
+                            rng=jax.random.PRNGKey(42))
+        s = eng.admit(prompt, temperature=1.0, top_k=16)
+        eng.run(6)
+        outs.append(eng.output(s))
+    assert outs[0] == outs[1]
+    other = ServingEngine(model, params, n_slots=2,
+                          rng=jax.random.PRNGKey(7))
+    s = other.admit(prompt, temperature=1.0, top_k=16)
+    other.run(6)
+    # different seed should (overwhelmingly) differ at temp 1.0
+    assert other.output(s) != outs[0]
+
+
+def test_top_k_one_equals_greedy(setup):
+    model, params = setup
+    prompt = [2, 71, 82, 9]
+    eng = ServingEngine(model, params, n_slots=1)
+    s = eng.admit(prompt, temperature=2.0, top_k=1)
+    eng.run(6)
+    assert eng.output(s)[:7] == _solo(model, params, prompt, 7)
+
+
+def test_sampled_tokens_stay_in_top_k(setup):
+    model, params = setup
+    prompt = [5, 9, 3, 3]
+    eng = ServingEngine(model, params, n_slots=1,
+                        rng=jax.random.PRNGKey(3))
+    s = eng.admit(prompt, temperature=3.0, top_k=2)
+    eng.run(8)
+    toks = eng.output(s)
+    # recompute the logits for every step and check membership in top-2
+    cur = jnp.asarray(prompt, jnp.int32)[None, :]
+    from tpu_k8s_device_plugin.workloads.inference import (
+        init_cache as _ic)
+    for tok in toks:
+        T = cur.shape[1]
+        pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (1, T))
+        logits, _ = model.apply(
+            {"params": params, "cache": _ic(model, 1)},
+            cur, pos, decode=False, mutable=["cache"])
+        top2 = set(np.asarray(
+            jax.lax.top_k(logits[0, -1], 2)[1]).tolist())
+        assert tok in top2
+        cur = jnp.concatenate(
+            [cur, jnp.asarray([[tok]], jnp.int32)], axis=1)
+
+
+def test_sampling_params_validated(setup):
+    model, params = setup
+    eng = ServingEngine(model, params, n_slots=1)
+    with pytest.raises(ValueError, match="temperature"):
+        eng.admit([1, 2], temperature=-1.0)
+    with pytest.raises(ValueError, match="top_k"):
+        eng.admit([1, 2], top_k=0)

@@ -543,6 +543,13 @@ def _check_request(model, prompt, n_steps: int):
     return B, T_p
 
 
+def validate_top_k(model, top_k) -> None:
+    """Shared top-k range check for the sampling entry points."""
+    if top_k is not None and not 1 <= top_k <= model.vocab:
+        raise ValueError(
+            f"top_k {top_k} outside [1, vocab={model.vocab}]")
+
+
 def sample_generate(
     model: DecodeTransformerLM,
     params,
@@ -556,8 +563,7 @@ def sample_generate(
     single-scan like :func:`greedy_generate` (same ``_decode_loop``, a
     sampling pick rule); returns ``generated [B, n_steps]``,
     reproducible from *rng*.  ``temperature → 0`` recovers greedy."""
-    if top_k is not None and not 1 <= top_k <= model.vocab:
-        raise ValueError(f"top_k {top_k} outside [1, vocab={model.vocab}]")
+    validate_top_k(model, top_k)
     B, T_p = _check_request(model, prompt, n_steps)
     positions = jnp.broadcast_to(
         jnp.arange(T_p, dtype=jnp.int32), (B, T_p)

@@ -45,7 +45,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from .inference import DecodeTransformerLM, extend_step, init_cache
+from .inference import (
+    DecodeTransformerLM,
+    extend_step,
+    init_cache,
+    validate_top_k,
+)
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -76,6 +81,30 @@ def _set_len(cache, slot, value):
     return out
 
 
+@jax.jit
+def _pick_tokens(logits, temps, topks, key):
+    """Per-slot sampling in one vectorized pass: [S, V] logits with
+    per-slot temperature (0 = greedy) and top-k (0 = unrestricted).
+    The per-slot knobs are DATA, not shapes, so mixed greedy/sampled
+    batches share the engine's one compiled step.  Gumbel-max sampling:
+    argmax(logits/T + G) is a categorical draw from softmax(logits/T),
+    and zeroing the noise where T == 0 recovers exact greedy."""
+    S, V = logits.shape
+    logits = logits.astype(jnp.float32)
+    safe_t = jnp.where(temps > 0, temps, 1.0)
+    scaled = logits / safe_t[:, None]
+    # top-k by thresholding at each row's k-th largest logit (one sort,
+    # the same pattern as inference._sample_pick; ties at the threshold
+    # all stay in, the usual top-k-with-ties behavior)
+    k_eff = jnp.where(topks > 0, topks, V)
+    sorted_desc = -jnp.sort(-logits, axis=-1)
+    kth = sorted_desc[jnp.arange(S), k_eff - 1]
+    masked = jnp.where(logits >= kth[:, None], scaled, -jnp.inf)
+    gumbel = jax.random.gumbel(key, (S, V), jnp.float32)
+    noised = masked + jnp.where(temps[:, None] > 0, gumbel, 0.0)
+    return jnp.argmax(noised, axis=-1).astype(jnp.int32)
+
+
 class ServingEngine:
     """Continuous-batching scheduler over one compiled decode step.
 
@@ -94,6 +123,7 @@ class ServingEngine:
         chunk: Optional[int] = None,
         max_new_tokens: Optional[int] = None,
         mesh=None,
+        rng: Optional[jax.Array] = None,
     ):
         if n_slots < 1:
             raise ValueError("n_slots must be >= 1")
@@ -139,6 +169,12 @@ class ServingEngine:
         self._finished: Dict[int, List[int]] = {}
         self._prefixes: Dict[int, tuple] = {}
         self._next_prefix = 0
+        # sampling: per-slot temperature (0 = greedy) and top-k (0 =
+        # unrestricted), set at admit; one key stream for the engine
+        self._rng = jax.random.PRNGKey(0) if rng is None else rng
+        self._draws = 0
+        self.temps = np.zeros(n_slots, np.float32)
+        self.topks = np.zeros(n_slots, np.int32)
 
     def _place_cache(self, cache):
         """Apply the TP shardings to a cache pytree (no-op meshless)."""
@@ -220,16 +256,23 @@ class ServingEngine:
         engines should release prefixes they no longer admit against."""
         self._prefixes.pop(handle, None)
 
-    def admit(self, prompt, prefix: Optional[int] = None) -> int:
+    def admit(self, prompt, prefix: Optional[int] = None,
+              temperature: float = 0.0,
+              top_k: Optional[int] = None) -> int:
         """Prefill *prompt* into a free slot; returns the slot id.
         Raises RuntimeError when the engine is full (callers queue).
         With ``prefix`` (a :meth:`register_prefix` handle), the prompt
         must start with the registered tokens and only the suffix is
-        prefilled — the prefix K/V is copied from the registry."""
+        prefilled — the prefix K/V is copied from the registry.
+        ``temperature``/``top_k`` select this request's sampling
+        (0 / None = greedy) — per-slot data, never a recompile."""
         prompt = jnp.asarray(prompt, jnp.int32).reshape(1, -1)
         t_p = int(prompt.shape[1])
         if t_p < 1:
             raise ValueError("empty prompt")
+        if temperature < 0:
+            raise ValueError("temperature must be >= 0")
+        validate_top_k(self.model, top_k)
         budget = self.max_new_tokens or 1
         if t_p + budget > self.model.max_len:
             raise ValueError(
@@ -284,17 +327,29 @@ class ServingEngine:
         self.cache = _splice_slot(self.cache, mini, jnp.int32(slot))
         self.lens[slot] = t_p
         self.active[slot] = True
-        first = int(jnp.argmax(last))
+        self.temps[slot] = temperature
+        self.topks[slot] = top_k or 0
+        first = int(self._sample(last[None, :],
+                                 np.asarray([temperature], np.float32),
+                                 np.asarray([top_k or 0], np.int32))[0])
         self.last_token[slot] = first
         self.outputs[slot] = [first]
         self._maybe_finish(slot, first)
         return slot
 
+    def _sample(self, logits, temps, topks):
+        key = jax.random.fold_in(self._rng, self._draws)
+        self._draws += 1
+        return np.asarray(
+            _pick_tokens(logits, jnp.asarray(temps), jnp.asarray(topks),
+                         key), dtype=np.int32)
+
     # -- decoding ----------------------------------------------------------
 
     def step(self) -> Dict[int, int]:
-        """One greedy decode step for every active slot.  Returns
-        {slot: token} for slots still active after the step."""
+        """One decode step for every active slot, each picking its
+        next token with its own temperature/top-k (0/None = greedy).
+        Returns {slot: token} for slots still active after the step."""
         if not any(self.active):
             return {}
         for s in range(self.n_slots):
@@ -306,8 +361,7 @@ class ServingEngine:
         positions = jnp.asarray(self.lens, jnp.int32)[:, None]
         logits, self.cache = extend_step(
             self.model, self.params, self.cache, tokens, positions)
-        nxt = np.asarray(jnp.argmax(logits[:, -1, :], axis=-1),
-                         dtype=np.int32)
+        nxt = self._sample(logits[:, -1, :], self.temps, self.topks)
         out = {}
         for s in range(self.n_slots):
             self.lens[s] += 1  # every slot appended (masking, not branching)
