@@ -463,9 +463,15 @@ def flash_causal_attention(
 ) -> jax.Array:
     """``transformer.AttnFn``-shaped causal adapter: positions must be
     the natural 0..T-1 order (flash causality is storage-order-driven);
-    use ring attention for permuted layouts."""
+    use ring attention for permuted layouts.  Grouped K/V (GQA)
+    expand to the query head count before the kernel."""
     del positions
-    return flash_attention(q, k, v, causal=True)
+    from .transformer import repeat_kv
+
+    return flash_attention(
+        q, repeat_kv(k, q.shape[2]), repeat_kv(v, q.shape[2]),
+        causal=True,
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -51,7 +51,20 @@ def _online_softmax_update(o, l, m, q_blk, k_blk, v_blk, scale, mask=None):
     Fully-masked rows keep a -inf running max; the isinf-guarded
     correction keeps exp(-inf - -inf) from producing NaN.  This is the
     subtle part of ring attention — the single source of truth shared by
-    both the contiguous and zig-zag shard bodies."""
+    both the contiguous and zig-zag shard bodies.
+
+    Grouped-query attention: K/V may carry fewer heads than Q — the
+    expansion to the query head count happens HERE, locally, after the
+    blocks have already rotated, so the ring only ever moves the
+    compact Hkv heads (H/Hkv less ICI traffic per hop).  The einsum
+    ring bodies are plain autodiff code, so the repeat's transpose
+    (a sum over each group) flows dK/dV back around the ring at the
+    grouped size too."""
+    if k_blk.shape[2] != q_blk.shape[2]:
+        from .transformer import repeat_kv
+
+        k_blk = repeat_kv(k_blk, q_blk.shape[2])
+        v_blk = repeat_kv(v_blk, q_blk.shape[2])
     scores = jnp.einsum(
         "bqhd,bkhd->bqhk", q_blk.astype(jnp.float32),
         k_blk.astype(jnp.float32),
@@ -462,6 +475,11 @@ _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 def _ring_attention_shard_flash(q, k, v, axis_name, causal):
     """Per-shard body for impl="flash" (contiguous layout)."""
+    if k.shape[2] != q.shape[2]:
+        raise ValueError(
+            "impl='flash' ring attention requires equal Q/KV head "
+            "counts; repeat_kv before the ring (the einsum impl "
+            "rotates grouped heads natively)")
     return _ring_flash(q, k, v, axis_name, causal)
 
 
@@ -656,6 +674,11 @@ _ring_flash_zigzag.defvjp(_ring_flash_zz_fwd, _ring_flash_zz_bwd)
 
 def _ring_attention_shard_zigzag_flash(q, k, v, axis_name):
     """Per-shard body for impl="flash", layout="zigzag"."""
+    if k.shape[2] != q.shape[2]:
+        raise ValueError(
+            "impl='flash' ring attention requires equal Q/KV head "
+            "counts; repeat_kv before the ring (the einsum impl "
+            "rotates grouped heads natively)")
     return _ring_flash_zigzag(q, k, v, axis_name)
 
 

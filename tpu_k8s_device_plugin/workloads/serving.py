@@ -93,9 +93,9 @@ def _pick_tokens(logits, temps, topks, key):
     logits = logits.astype(jnp.float32)
     safe_t = jnp.where(temps > 0, temps, 1.0)
     scaled = logits / safe_t[:, None]
-    # top-k by thresholding at each row's k-th largest logit (one sort,
-    # the same pattern as inference._sample_pick; ties at the threshold
-    # all stay in, the usual top-k-with-ties behavior)
+    # top-k by thresholding at each row's k-th largest logit (one
+    # descending sort serves every row's k; ties at the threshold all
+    # stay in — the usual top-k-with-ties behavior)
     k_eff = jnp.where(topks > 0, topks, V)
     sorted_desc = -jnp.sort(-logits, axis=-1)
     kth = sorted_desc[jnp.arange(S), k_eff - 1]
@@ -338,6 +338,12 @@ class ServingEngine:
         return slot
 
     def _sample(self, logits, temps, topks):
+        if not temps.any() and not topks.any():
+            # all-greedy batch (the default): plain argmax — no vocab
+            # sort, no Gumbel draw, and the key stream stays untouched
+            # so adding a sampled request never shifts greedy outputs
+            return np.asarray(
+                jnp.argmax(logits, axis=-1), dtype=np.int32)
         key = jax.random.fold_in(self._rng, self._draws)
         self._draws += 1
         return np.asarray(

@@ -61,7 +61,10 @@ def local_causal_attention(
 ) -> jax.Array:
     """Whole-sequence causal attention on one shard (the oracle path).
     Causality comes from the positions array, not the storage order, so
-    it is also correct on permuted layouts."""
+    it is also correct on permuted layouts.  K/V may arrive grouped
+    (GQA) — expanded here to the query head count."""
+    k = repeat_kv(k, q.shape[2])
+    v = repeat_kv(v, q.shape[2])
     scale = 1.0 / jnp.sqrt(jnp.array(q.shape[-1], jnp.float32))
     scores = jnp.einsum(
         "bqhd,bkhd->bqhk", q.astype(jnp.float32), k.astype(jnp.float32)
@@ -145,13 +148,11 @@ class Block(nn.Module):
         q, k, v = split_qkv_heads(qkv, self.n_heads, n_kv, head_dim)
         q = apply_rope(q, positions, self.rope_theta)
         k = apply_rope(k, positions, self.rope_theta)
-        # training attention runs at full head count (compute-bound on
-        # the MXU either way); the grouped layout pays off in serving,
-        # where the cache stores only the Hkv heads
-        att = self.attn_fn(
-            q, repeat_kv(k, self.n_heads), repeat_kv(v, self.n_heads),
-            positions,
-        )
+        # K/V go to the attention GROUPED: every attn impl expands to
+        # the query head count itself — locally for the single-shard
+        # paths, and AFTER the ring rotation for sequence-parallel
+        # attention, so the ICI ring moves H/Hkv less data per hop
+        att = self.attn_fn(q, k, v, positions)
         att = att.reshape(B, T, self.d_model)
         x = x + nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
                          name="out_proj")(att)
@@ -367,6 +368,9 @@ def make_lm_train_step(
     n_experts: int = 0,
     moe_k: int = 2,
     moe_capacity_factor: float = 1.25,
+    n_kv_heads: Optional[int] = None,
+    ffn: str = "gelu",
+    rope_theta: float = 10000.0,
 ):
     """Build a fully sharded LM train step over *mesh*.
 
@@ -400,8 +404,14 @@ def make_lm_train_step(
         # heads ride the model axis too (qkv is model-split; leaving H
         # replicated would all-gather q/k/v and redo attention on every
         # model rank) — unless head count doesn't divide the axis
+        n_kv_cfg = n_kv_heads or n_heads
+        mdl_size = mesh.shape.get("model", 1)
+        # both the query heads AND the (possibly grouped) KV heads must
+        # divide the model axis for head-sharded ring attention
         head_axis = (
-            "model" if n_heads % mesh.shape.get("model", 1) == 0 else None
+            "model"
+            if n_heads % mdl_size == 0 and n_kv_cfg % mdl_size == 0
+            else None
         )
         spec = P(batch_axes, seq_axis, head_axis, None)
         ring_fn, _ = make_ring_attention(
@@ -417,7 +427,8 @@ def make_lm_train_step(
     model = TransformerLM(
         vocab=vocab, d_model=d_model, n_heads=n_heads, n_layers=n_layers,
         d_ff=d_ff, attn_fn=attn, n_experts=n_experts, moe_k=moe_k,
-        moe_capacity_factor=moe_capacity_factor,
+        moe_capacity_factor=moe_capacity_factor, n_kv_heads=n_kv_heads,
+        ffn=ffn, rope_theta=rope_theta,
     )
     tokens, labels, positions = synthetic_lm_batch(rng, batch, seq_len, vocab)
     params = model.init(rng, tokens, positions)["params"]
