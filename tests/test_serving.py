@@ -382,3 +382,43 @@ def test_sampling_params_validated(setup):
         eng.admit([1, 2], temperature=-1.0)
     with pytest.raises(ValueError, match="top_k"):
         eng.admit([1, 2], top_k=0)
+
+
+def test_stats_counters(setup):
+    model, params = setup
+    eng = ServingEngine(model, params, n_slots=3, max_new_tokens=4)
+    assert eng.stats()["active_slots"] == 0
+    sa = eng.admit([1, 2, 3])
+    eng.register_prefix([9, 9])
+    st = eng.stats()
+    assert st["active_slots"] == 1 and st["free_slots"] == 2
+    assert st["registered_prefixes"] == 1
+    assert st["tokens_emitted"] == 1  # the admit's first token
+    eng.run(10)
+    st = eng.stats()
+    assert eng.finished(sa)
+    assert st["finished_requests"] == 1
+    assert st["tokens_emitted"] == 4  # max_new_tokens budget
+    assert st["decode_steps"] == 3   # 3 steps after the admit token
+
+
+def test_finished_requests_counter_is_cumulative(setup):
+    model, params = setup
+    eng = ServingEngine(model, params, n_slots=1, max_new_tokens=2)
+    for prompt in ([1, 2], [3, 4], [5, 6]):
+        eng.admit(prompt)
+        eng.run(5)
+    assert eng.stats()["finished_requests"] == 3
+
+
+def test_greedy_fast_path_restored_after_sampled_request(setup):
+    model, params = setup
+    eng = ServingEngine(model, params, n_slots=2, max_new_tokens=3)
+    ss = eng.admit([9, 9], temperature=1.0, top_k=8)
+    eng.run(5)
+    assert eng.finished(ss)
+    # freed slot must not leave sampling knobs behind
+    assert not eng.temps.any() and not eng.topks.any()
+    sg = eng.admit([3, 14, 15])
+    eng.run(5)
+    assert eng.output(sg) == _solo(model, params, [3, 14, 15], 3)

@@ -12,6 +12,7 @@ from .alexnet import AlexNet, create_train_state, train_step
 from .flash_attention import flash_attention, flash_causal_attention
 from .inference import (
     DecodeTransformerLM,
+    attach_lora,
     decode_throughput,
     greedy_generate,
     make_decoder,
@@ -52,6 +53,7 @@ __all__ = [
     "quantize_lm_params",
     "sample_generate",
     "ServingEngine",
+    "attach_lora",
     "checkpoint",
     "llama",
     "pallas_max_pool",

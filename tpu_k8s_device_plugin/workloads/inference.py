@@ -179,19 +179,61 @@ class CachedBlock(nn.Module):
     n_kv_heads: Optional[int] = None  # < n_heads → GQA: cache shrinks H/Hkv
     ffn: str = "gelu"  # "swiglu" for the Llama MLP
     rope_theta: float = 10000.0
+    n_adapters: int = 0   # >0: per-request LoRA (multi-adapter serving)
+    lora_rank: int = 8
+    lora_scale: float = 1.0
 
     @nn.compact
     def __call__(
-        self, x: jax.Array, positions: jax.Array, decode: bool = False
+        self, x: jax.Array, positions: jax.Array, decode: bool = False,
+        adapter_ids: Optional[jax.Array] = None,  # [B] int32, -1 = base
     ) -> jax.Array:
         B, T, _ = x.shape
         dense = QuantDense if self.quantized else nn.Dense
         head_dim = self.d_model // self.n_heads
         n_kv = self.n_kv_heads or self.n_heads
         _validate_attn_ffn(self.n_heads, n_kv, self.ffn)
+
+        def proj(features: int, name: str, inp: jax.Array) -> jax.Array:
+            """Projection + optional per-request LoRA delta.  With
+            ``n_adapters > 0`` every projection carries stacked
+            low-rank adapters ([n, Din, r] / [n, r, Dout], B zero-init
+            so a fresh adapter is an exact no-op); each batch row
+            gathers ITS adapter by id (-1 gates the delta off), so one
+            compiled step serves any adapter mix — the multi-LoRA
+            pattern vLLM ships, done the TPU way (dense gathers +
+            masking, no per-request branching).  The stacks are params
+            regardless of adapter_ids so the tree is stable across
+            prefill/decode traces."""
+            y = dense(features, use_bias=False, dtype=self.dtype,
+                      name=name)(inp)
+            if self.n_adapters > 0:
+                a_stack = self.param(
+                    f"{name}_lora_A", nn.initializers.normal(0.01),
+                    (self.n_adapters, inp.shape[-1], self.lora_rank),
+                    jnp.float32,
+                )
+                b_stack = self.param(
+                    f"{name}_lora_B", nn.initializers.zeros,
+                    (self.n_adapters, self.lora_rank, features),
+                    jnp.float32,
+                )
+                if adapter_ids is not None:
+                    sel = jnp.maximum(adapter_ids, 0)
+                    gate = (adapter_ids >= 0).astype(jnp.float32) \
+                        * self.lora_scale
+                    mid = jnp.einsum(
+                        "btd,bdr->btr", inp.astype(jnp.float32),
+                        a_stack[sel],
+                    )
+                    delta = jnp.einsum(
+                        "btr,bro->bto", mid, b_stack[sel]
+                    ) * gate[:, None, None]
+                    y = y + delta.astype(y.dtype)
+            return y
+
         h = nn.RMSNorm(dtype=self.dtype, name="attn_norm")(x)
-        qkv = dense((self.n_heads + 2 * n_kv) * head_dim, use_bias=False,
-                    dtype=self.dtype, name="qkv")(h)
+        qkv = proj((self.n_heads + 2 * n_kv) * head_dim, "qkv", h)
         q, k, v = split_qkv_heads(qkv, self.n_heads, n_kv, head_dim)
         q = apply_rope(q, positions, self.rope_theta)
         k = apply_rope(k, positions, self.rope_theta)
@@ -258,8 +300,7 @@ class CachedBlock(nn.Module):
             )
 
         att = att.reshape(B, T, self.d_model)
-        x = x + dense(self.d_model, use_bias=False, dtype=self.dtype,
-                      name="out_proj")(att)
+        x = x + proj(self.d_model, "out_proj", att)
         h = nn.RMSNorm(dtype=self.dtype, name="mlp_norm")(x)
         if self.n_experts > 0:
             from .moe import MoEFFN
@@ -280,18 +321,13 @@ class CachedBlock(nn.Module):
                 dtype=self.dtype, quantized=self.quantized, name="moe",
             )(h, positions)
         elif self.ffn == "swiglu":
-            gate = dense(self.d_ff, use_bias=False, dtype=self.dtype,
-                         name="mlp_gate")(h)
-            up = dense(self.d_ff, use_bias=False, dtype=self.dtype,
-                       name="mlp_up")(h)
-            x = x + dense(self.d_model, use_bias=False, dtype=self.dtype,
-                          name="mlp_down")(nn.silu(gate) * up)
+            gate = proj(self.d_ff, "mlp_gate", h)
+            up = proj(self.d_ff, "mlp_up", h)
+            x = x + proj(self.d_model, "mlp_down", nn.silu(gate) * up)
         else:
-            h = dense(self.d_ff, use_bias=False, dtype=self.dtype,
-                      name="mlp_up")(h)
+            h = proj(self.d_ff, "mlp_up", h)
             h = nn.gelu(h)
-            x = x + dense(self.d_model, use_bias=False, dtype=self.dtype,
-                          name="mlp_down")(h)
+            x = x + proj(self.d_model, "mlp_down", h)
         return x
 
 
@@ -352,11 +388,15 @@ class DecodeTransformerLM(nn.Module):
     n_kv_heads: Optional[int] = None  # < n_heads → GQA (Llama family)
     ffn: str = "gelu"  # "swiglu" for the Llama MLP
     rope_theta: float = 10000.0
+    n_adapters: int = 0   # >0: per-request LoRA stacks on every block
+    lora_rank: int = 8
+    lora_scale: float = 1.0
 
     @nn.compact
     def __call__(
         self, tokens: jax.Array, positions: jax.Array,
         decode: bool = False,
+        adapter_ids: Optional[jax.Array] = None,
     ) -> jax.Array:
         x = nn.Embed(self.vocab, self.d_model, dtype=self.dtype,
                      name="embed")(tokens)
@@ -369,8 +409,10 @@ class DecodeTransformerLM(nn.Module):
                 moe_capacity_factor=self.moe_capacity_factor,
                 n_kv_heads=self.n_kv_heads, ffn=self.ffn,
                 rope_theta=self.rope_theta,
+                n_adapters=self.n_adapters, lora_rank=self.lora_rank,
+                lora_scale=self.lora_scale,
                 name=f"block_{i}",
-            )(x, positions, decode=decode)
+            )(x, positions, decode=decode, adapter_ids=adapter_ids)
         x = nn.RMSNorm(dtype=self.dtype, name="final_norm")(x)
         dense = QuantDense if self.quantized else nn.Dense
         logits = dense(self.vocab, use_bias=False, dtype=self.dtype,
@@ -393,13 +435,17 @@ def make_decoder(
     n_kv_heads: Optional[int] = None,
     ffn: str = "gelu",
     rope_theta: float = 10000.0,
+    n_adapters: int = 0,
+    lora_rank: int = 8,
+    lora_scale: float = 1.0,
 ) -> "DecodeTransformerLM":
     return DecodeTransformerLM(
         vocab=vocab, d_model=d_model, n_heads=n_heads,
         n_layers=n_layers, d_ff=d_ff, max_len=max_len, dtype=dtype,
         quantized=quantized, n_experts=n_experts, moe_k=moe_k,
         moe_capacity_factor=moe_capacity_factor, n_kv_heads=n_kv_heads,
-        ffn=ffn, rope_theta=rope_theta,
+        ffn=ffn, rope_theta=rope_theta, n_adapters=n_adapters,
+        lora_rank=lora_rank, lora_scale=lora_scale,
     )
 
 
@@ -424,7 +470,7 @@ def init_cache(model: DecodeTransformerLM, batch: int):
     jax.jit, static_argnums=(0,), donate_argnums=(2,)
 )
 def extend_step(model: "DecodeTransformerLM", params, cache, tokens,
-                positions):
+                positions, adapter_ids=None):
     """One banded extend (``decode=True``, any T >= 1): returns
     ``(logits, new cache)``.  THE compiled serving step — the engine
     (serving.py) and speculative decoding (speculative.py) share this
@@ -436,7 +482,8 @@ def extend_step(model: "DecodeTransformerLM", params, cache, tokens,
     model, params, cache, ...)``."""
     logits, mut = model.apply(
         {"params": params, "cache": cache},
-        tokens, positions, decode=True, mutable=["cache"],
+        tokens, positions, decode=True, adapter_ids=adapter_ids,
+        mutable=["cache"],
     )
     return logits, mut["cache"]
 
@@ -541,6 +588,37 @@ def _check_request(model, prompt, n_steps: int):
             f"prompt {T_p} + steps {n_steps} exceeds max_len {model.max_len}"
         )
     return B, T_p
+
+
+def attach_lora(params, model: "DecodeTransformerLM", rng,
+                init_scale: float = 0.01):
+    """Add LoRA adapter stacks to an existing (trained or quantized)
+    base tree so it loads into a ``n_adapters > 0`` decoder: every
+    projection dict in every block gains ``{name}_lora_A`` (normal
+    init) and ``{name}_lora_B`` (zeros — a fresh adapter is an exact
+    no-op until trained).  Layout matches what ``model.init`` would
+    create, so serving sees one coherent tree."""
+    if model.n_adapters < 1:
+        raise ValueError("model has n_adapters == 0")
+    proj_names = ("qkv", "out_proj", "mlp_gate", "mlp_up", "mlp_down")
+    out = jax.tree_util.tree_map(lambda x: x, params)  # shallow copy
+    for bname, block in out.items():
+        if not bname.startswith("block_"):
+            continue
+        for name in proj_names:
+            if name not in block:
+                continue
+            kern = block[name].get(
+                "kernel", block[name].get("kernel_int8"))
+            din, dout = kern.shape
+            rng, k1 = jax.random.split(rng)
+            block[f"{name}_lora_A"] = (
+                jax.random.normal(
+                    k1, (model.n_adapters, din, model.lora_rank),
+                    jnp.float32) * init_scale)
+            block[f"{name}_lora_B"] = jnp.zeros(
+                (model.n_adapters, model.lora_rank, dout), jnp.float32)
+    return out
 
 
 def validate_top_k(model, top_k) -> None:

@@ -173,8 +173,14 @@ class ServingEngine:
         # unrestricted), set at admit; one key stream for the engine
         self._rng = jax.random.PRNGKey(0) if rng is None else rng
         self._draws = 0
+        self._steps = 0
+        self._tokens = 0
+        self._completed = 0
         self.temps = np.zeros(n_slots, np.float32)
         self.topks = np.zeros(n_slots, np.int32)
+        # per-slot LoRA adapter ids (-1 = base model); only consulted
+        # when the model was built with n_adapters > 0
+        self.adapters = np.full(n_slots, -1, np.int32)
 
     def _place_cache(self, cache):
         """Apply the TP shardings to a cache pytree (no-op meshless)."""
@@ -197,17 +203,19 @@ class ServingEngine:
     def free_slots(self) -> List[int]:
         return [s for s in range(self.n_slots) if not self.active[s]]
 
-    def _extend_prompt(self, mini, toks, start: int):
+    def _extend_prompt(self, mini, toks, start: int,
+                       adapter: int = -1):
         """Push *toks* [1, n] into the B=1 *mini* cache starting at
         depth *start*; returns (mini, last real token's logits row)."""
         n = int(toks.shape[1])
+        aid = self._adapter_vec(adapter)
         if self.chunk is None:
             # one compiled extend per distinct prompt length — fine for
             # benchmarks/tests; set ``chunk`` to pin admission to a
             # single compiled shape
             pos = (jnp.arange(n, dtype=jnp.int32) + start)[None, :]
             logits, mini = extend_step(
-                self.model, self.params, mini, toks, pos)
+                self.model, self.params, mini, toks, pos, aid)
             return mini, logits[0, n - 1]
         # fixed-size chunks: every chunk reuses ONE compiled extend; the
         # tail chunk pads with zeros whose K/V land beyond the true
@@ -227,26 +235,48 @@ class ServingEngine:
                 jnp.arange(c, dtype=jnp.int32) + start + i * c
             )[None, :]
             logits, mini = extend_step(
-                self.model, self.params, mini, chunk_toks, pos)
+                self.model, self.params, mini, chunk_toks, pos, aid)
             off = n - 1 - i * c
             if 0 <= off < c:
                 last = logits[0, off]
         return _set_len(mini, jnp.int32(0), jnp.int32(start + n)), last
 
-    def register_prefix(self, tokens) -> int:
+    def _adapter_vec(self, adapter: int):
+        """[1]-shaped adapter-id operand, or None for non-LoRA models
+        (keeps their compiled extends identical to before)."""
+        if self.model.n_adapters == 0:
+            return None
+        return jnp.asarray([adapter], jnp.int32)
+
+    def _check_adapter(self, adapter) -> int:
+        if adapter is None:
+            return -1
+        if self.model.n_adapters == 0:
+            raise ValueError(
+                "model was built without LoRA adapters (n_adapters=0)")
+        if not 0 <= adapter < self.model.n_adapters:
+            raise ValueError(
+                f"adapter {adapter} outside [0, "
+                f"{self.model.n_adapters})")
+        return adapter
+
+    def register_prefix(self, tokens, adapter: Optional[int] = None) -> int:
         """Prefill a shared prompt prefix (e.g. a system prompt) ONCE
         and reuse it across admits: ``admit(prompt, prefix=handle)``
         skips recomputing the first ``len(tokens)`` positions.  Returns
-        an opaque handle."""
+        an opaque handle.  A prefix is bound to its ``adapter`` (the
+        adapter shapes the prefix K/V!); admits must request the same
+        one."""
         toks = jnp.asarray(tokens, jnp.int32).reshape(1, -1)
         if int(toks.shape[1]) < 1:
             raise ValueError("empty prefix")
+        aid = self._check_adapter(adapter)
         mini = self._place_cache(init_cache(self.model, 1))
-        mini, last = self._extend_prompt(mini, toks, start=0)
+        mini, last = self._extend_prompt(mini, toks, start=0, adapter=aid)
         handle = self._next_prefix
         self._next_prefix += 1
         self._prefixes[handle] = (
-            np.asarray(toks[0], np.int32), mini, last)
+            np.asarray(toks[0], np.int32), mini, last, aid)
         return handle
 
     def release_prefix(self, handle: int) -> None:
@@ -258,7 +288,8 @@ class ServingEngine:
 
     def admit(self, prompt, prefix: Optional[int] = None,
               temperature: float = 0.0,
-              top_k: Optional[int] = None) -> int:
+              top_k: Optional[int] = None,
+              adapter: Optional[int] = None) -> int:
         """Prefill *prompt* into a free slot; returns the slot id.
         Raises RuntimeError when the engine is full (callers queue).
         With ``prefix`` (a :meth:`register_prefix` handle), the prompt
@@ -273,6 +304,7 @@ class ServingEngine:
         if temperature < 0:
             raise ValueError("temperature must be >= 0")
         validate_top_k(self.model, top_k)
+        aid = self._check_adapter(adapter)
         budget = self.max_new_tokens or 1
         if t_p + budget > self.model.max_len:
             raise ValueError(
@@ -288,12 +320,17 @@ class ServingEngine:
         if prefix is not None:
             if prefix not in self._prefixes:
                 raise ValueError(f"unknown prefix handle {prefix}")
-            ptoks, pcache, plast = self._prefixes[prefix]
+            ptoks, pcache, plast, paid = self._prefixes[prefix]
             L = len(ptoks)
             if t_p < L or not np.array_equal(
                     np.asarray(prompt[0, :L]), ptoks):
                 raise ValueError(
                     "prompt does not start with the registered prefix")
+            if paid != aid:
+                raise ValueError(
+                    f"prefix was registered with adapter {paid}, "
+                    f"request uses {aid} — the adapter shapes the "
+                    "prefix K/V, register one per adapter")
             start, n = L, t_p - L
         else:
             start, n = 0, t_p
@@ -314,7 +351,7 @@ class ServingEngine:
                 # and the registry entry must survive for the next admit
                 mini = jax.tree_util.tree_map(jnp.copy, pcache)
                 mini, last = self._extend_prompt(
-                    mini, prompt[:, L:], start=L)
+                    mini, prompt[:, L:], start=L, adapter=aid)
             else:
                 # exact-prefix prompt: no extend runs, and _splice_slot
                 # does not donate its mini argument, so the registry
@@ -322,18 +359,21 @@ class ServingEngine:
                 mini, last = pcache, plast
         else:
             mini = self._place_cache(init_cache(self.model, 1))
-            mini, last = self._extend_prompt(mini, prompt, start=0)
+            mini, last = self._extend_prompt(mini, prompt, start=0,
+                                             adapter=aid)
 
         self.cache = _splice_slot(self.cache, mini, jnp.int32(slot))
         self.lens[slot] = t_p
         self.active[slot] = True
         self.temps[slot] = temperature
         self.topks[slot] = top_k or 0
+        self.adapters[slot] = aid
         first = int(self._sample(last[None, :],
                                  np.asarray([temperature], np.float32),
                                  np.asarray([top_k or 0], np.int32))[0])
         self.last_token[slot] = first
         self.outputs[slot] = [first]
+        self._tokens += 1
         self._maybe_finish(slot, first)
         return slot
 
@@ -365,8 +405,12 @@ class ServingEngine:
             return {}
         tokens = jnp.asarray(self.last_token)[:, None]
         positions = jnp.asarray(self.lens, jnp.int32)[:, None]
+        aids = (jnp.asarray(self.adapters)
+                if self.model.n_adapters > 0 else None)
         logits, self.cache = extend_step(
-            self.model, self.params, self.cache, tokens, positions)
+            self.model, self.params, self.cache, tokens, positions,
+            aids)
+        self._steps += 1
         nxt = self._sample(logits[:, -1, :], self.temps, self.topks)
         out = {}
         for s in range(self.n_slots):
@@ -376,6 +420,7 @@ class ServingEngine:
             tok = int(nxt[s])
             self.last_token[s] = tok
             self.outputs[s].append(tok)
+            self._tokens += 1
             out[s] = tok
             self._maybe_finish(s, tok)
         return out
@@ -399,6 +444,8 @@ class ServingEngine:
     def _finish(self, slot: int) -> None:
         self._finished[slot] = self.outputs[slot]
         self.active[slot] = False
+        self._completed += 1
+        self._reset_slot_params(slot)
 
     def finished(self, slot: int) -> bool:
         return slot in self._finished
@@ -407,8 +454,30 @@ class ServingEngine:
         """Generated tokens for *slot* (finished or in flight)."""
         return list(self.outputs[slot])
 
+    def stats(self) -> Dict[str, int]:
+        """Engine counters for the debug/observability endpoint:
+        slot occupancy, total emitted tokens, decode steps taken."""
+        return {
+            "n_slots": self.n_slots,
+            "active_slots": sum(self.active),
+            "free_slots": self.n_slots - sum(self.active),
+            "finished_requests": self._completed,
+            "registered_prefixes": len(self._prefixes),
+            "tokens_emitted": self._tokens,
+            "decode_steps": self._steps,
+        }
+
     def release(self, slot: int) -> None:
         """Free a slot (abandons any in-flight generation)."""
         self.active[slot] = False
         self._finished.pop(slot, None)
         self.lens[slot] = 0
+        self._reset_slot_params(slot)
+
+    def _reset_slot_params(self, slot: int) -> None:
+        """Clear a freed slot's sampling/adapter knobs: the all-greedy
+        argmax fast path gates on the WHOLE temps/topks vectors, so a
+        finished sampled request must not keep disabling it."""
+        self.temps[slot] = 0.0
+        self.topks[slot] = 0
+        self.adapters[slot] = -1
