@@ -422,3 +422,76 @@ def test_greedy_fast_path_restored_after_sampled_request(setup):
     sg = eng.admit([3, 14, 15])
     eng.run(5)
     assert eng.output(sg) == _solo(model, params, [3, 14, 15], 3)
+
+
+def test_top_p_tiny_equals_greedy(setup):
+    # p below the argmax's own probability keeps only the argmax
+    model, params = setup
+    prompt = [2, 71, 82]
+    eng = ServingEngine(model, params, n_slots=1)
+    s = eng.admit(prompt, temperature=2.0, top_p=1e-6)
+    eng.run(6)
+    assert eng.output(s)[:7] == _solo(model, params, prompt, 7)
+
+
+def test_top_p_tokens_stay_in_nucleus(setup):
+    model, params = setup
+    prompt = [5, 9, 3]
+    P_NUC = 0.6
+    eng = ServingEngine(model, params, n_slots=1,
+                        rng=jax.random.PRNGKey(11))
+    s = eng.admit(prompt, temperature=1.0, top_p=P_NUC)
+    eng.run(6)
+    toks = eng.output(s)
+    from tpu_k8s_device_plugin.workloads.inference import init_cache as _ic
+    cur = jnp.asarray(prompt, jnp.int32)[None, :]
+    for tok in toks:
+        T = cur.shape[1]
+        pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (1, T))
+        logits, _ = model.apply(
+            {"params": params, "cache": _ic(model, 1)},
+            cur, pos, decode=False, mutable=["cache"])
+        pr = np.asarray(jax.nn.softmax(logits[0, -1]))
+        order = np.argsort(-pr)
+        csum = np.cumsum(pr[order])
+        nucleus = set(order[:int(np.searchsorted(csum, P_NUC) + 1)]
+                      .tolist())
+        assert tok in nucleus
+        cur = jnp.concatenate(
+            [cur, jnp.asarray([[tok]], jnp.int32)], axis=1)
+
+
+def test_top_p_validation(setup):
+    model, params = setup
+    eng = ServingEngine(model, params, n_slots=1)
+    with pytest.raises(ValueError, match="top_p"):
+        eng.admit([1, 2], top_p=0.0)
+    with pytest.raises(ValueError, match="top_p"):
+        eng.admit([1, 2], top_p=1.5)
+
+
+def test_top_p_applies_within_top_k(setup):
+    # sequential semantics: with top_k=2 and top_p just above the
+    # renormalized top-1 mass, only the argmax survives — even though
+    # the FULL-vocab nucleus at that p would span many tokens
+    model, params = setup
+    prompt = [5, 9, 3]
+    from tpu_k8s_device_plugin.workloads.inference import init_cache as _ic
+    cur = jnp.asarray(prompt, jnp.int32)[None, :]
+    pos = jnp.broadcast_to(jnp.arange(3, dtype=jnp.int32), (1, 3))
+    logits, _ = model.apply(
+        {"params": params, "cache": _ic(model, 1)},
+        cur, pos, decode=False, mutable=["cache"])
+    TEMP = 5.0
+    top2 = np.asarray(
+        jax.lax.top_k(logits[0, -1], 2)[0], np.float64) / TEMP
+    p1 = float(np.exp(top2[0]) / np.exp(top2).sum())  # renorm. top-1 mass
+    eng = ServingEngine(model, params, n_slots=1,
+                        rng=jax.random.PRNGKey(13))
+    # keep rule is before < p: the 2nd token's 'before' equals the
+    # top-1 renormalized mass, so p just BELOW it keeps only the argmax
+    s = eng.admit(prompt, temperature=TEMP, top_k=2,
+                  top_p=max(1e-6, p1 * 0.9999))
+    # ONLY checking the first token (later steps have other logits);
+    # with the nucleus inside top-k it must be the argmax
+    assert eng.output(s)[0] == _solo(model, params, prompt, 1)[0]

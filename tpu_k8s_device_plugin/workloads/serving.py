@@ -82,24 +82,43 @@ def _set_len(cache, slot, value):
 
 
 @jax.jit
-def _pick_tokens(logits, temps, topks, key):
+def _pick_tokens(logits, temps, topks, topps, key):
     """Per-slot sampling in one vectorized pass: [S, V] logits with
-    per-slot temperature (0 = greedy) and top-k (0 = unrestricted).
-    The per-slot knobs are DATA, not shapes, so mixed greedy/sampled
-    batches share the engine's one compiled step.  Gumbel-max sampling:
-    argmax(logits/T + G) is a categorical draw from softmax(logits/T),
-    and zeroing the noise where T == 0 recovers exact greedy."""
+    per-slot temperature (0 = greedy), top-k (0 = unrestricted), and
+    top-p / nucleus (1.0 = unrestricted).  The per-slot knobs are DATA,
+    not shapes, so mixed greedy/sampled batches share the engine's one
+    compiled step.  Gumbel-max sampling: argmax(logits/T + G) is a
+    categorical draw from softmax(logits/T), and zeroing the noise
+    where T == 0 recovers exact greedy.  One descending sort serves
+    both filters: top-k thresholds at the k-th largest logit; top-p
+    keeps the smallest prefix of the TEMPERATURE-SCALED distribution
+    whose mass reaches p (a token survives when the mass strictly
+    before it is < p — the argmax always survives, so greedy rows are
+    untouched by any p)."""
     S, V = logits.shape
     logits = logits.astype(jnp.float32)
     safe_t = jnp.where(temps > 0, temps, 1.0)
     scaled = logits / safe_t[:, None]
-    # top-k by thresholding at each row's k-th largest logit (one
-    # descending sort serves every row's k; ties at the threshold all
-    # stay in — the usual top-k-with-ties behavior)
+    rows = jnp.arange(S)
+    # top-k threshold on the raw logits (temperature-invariant order)
     k_eff = jnp.where(topks > 0, topks, V)
     sorted_desc = -jnp.sort(-logits, axis=-1)
-    kth = sorted_desc[jnp.arange(S), k_eff - 1]
+    kth = sorted_desc[rows, k_eff - 1]
     masked = jnp.where(logits >= kth[:, None], scaled, -jnp.inf)
+    # nucleus AFTER top-k (the sequential vLLM/HF semantics): the
+    # candidate distribution is the top-k prefix RENORMALIZED, and the
+    # kept set is its smallest prefix whose mass reaches p.  Division
+    # by the (positive) temperature preserves order, so the scaled
+    # sorted logits derive from the one sort above.
+    sorted_scaled = sorted_desc / safe_t[:, None]
+    in_topk = jnp.arange(V)[None, :] < k_eff[:, None]
+    sorted_masked = jnp.where(in_topk, sorted_scaled, -jnp.inf)
+    probs_sorted = jax.nn.softmax(sorted_masked, axis=-1)
+    before = jnp.cumsum(probs_sorted, axis=-1) - probs_sorted
+    keep = before < topps[:, None]          # [S, V], a top-k subset
+    n_keep = jnp.maximum(jnp.sum(keep, axis=-1), 1)
+    pth = sorted_scaled[rows, n_keep - 1]
+    masked = jnp.where(scaled >= pth[:, None], masked, -jnp.inf)
     gumbel = jax.random.gumbel(key, (S, V), jnp.float32)
     noised = masked + jnp.where(temps[:, None] > 0, gumbel, 0.0)
     return jnp.argmax(noised, axis=-1).astype(jnp.int32)
@@ -178,6 +197,7 @@ class ServingEngine:
         self._completed = 0
         self.temps = np.zeros(n_slots, np.float32)
         self.topks = np.zeros(n_slots, np.int32)
+        self.topps = np.ones(n_slots, np.float32)
         # per-slot LoRA adapter ids (-1 = base model); only consulted
         # when the model was built with n_adapters > 0
         self.adapters = np.full(n_slots, -1, np.int32)
@@ -289,6 +309,7 @@ class ServingEngine:
     def admit(self, prompt, prefix: Optional[int] = None,
               temperature: float = 0.0,
               top_k: Optional[int] = None,
+              top_p: float = 1.0,
               adapter: Optional[int] = None) -> int:
         """Prefill *prompt* into a free slot; returns the slot id.
         Raises RuntimeError when the engine is full (callers queue).
@@ -304,6 +325,8 @@ class ServingEngine:
         if temperature < 0:
             raise ValueError("temperature must be >= 0")
         validate_top_k(self.model, top_k)
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p {top_p} outside (0, 1]")
         aid = self._check_adapter(adapter)
         budget = self.max_new_tokens or 1
         if t_p + budget > self.model.max_len:
@@ -367,18 +390,20 @@ class ServingEngine:
         self.active[slot] = True
         self.temps[slot] = temperature
         self.topks[slot] = top_k or 0
+        self.topps[slot] = top_p
         self.adapters[slot] = aid
-        first = int(self._sample(last[None, :],
-                                 np.asarray([temperature], np.float32),
-                                 np.asarray([top_k or 0], np.int32))[0])
+        first = int(self._sample(
+            last[None, :], np.asarray([temperature], np.float32),
+            np.asarray([top_k or 0], np.int32),
+            np.asarray([top_p], np.float32))[0])
         self.last_token[slot] = first
         self.outputs[slot] = [first]
         self._tokens += 1
         self._maybe_finish(slot, first)
         return slot
 
-    def _sample(self, logits, temps, topks):
-        if not temps.any() and not topks.any():
+    def _sample(self, logits, temps, topks, topps):
+        if not temps.any() and not topks.any() and (topps >= 1.0).all():
             # all-greedy batch (the default): plain argmax — no vocab
             # sort, no Gumbel draw, and the key stream stays untouched
             # so adding a sampled request never shifts greedy outputs
@@ -388,7 +413,7 @@ class ServingEngine:
         self._draws += 1
         return np.asarray(
             _pick_tokens(logits, jnp.asarray(temps), jnp.asarray(topks),
-                         key), dtype=np.int32)
+                         jnp.asarray(topps), key), dtype=np.int32)
 
     # -- decoding ----------------------------------------------------------
 
@@ -411,7 +436,8 @@ class ServingEngine:
             self.model, self.params, self.cache, tokens, positions,
             aids)
         self._steps += 1
-        nxt = self._sample(logits[:, -1, :], self.temps, self.topks)
+        nxt = self._sample(logits[:, -1, :], self.temps, self.topks,
+                           self.topps)
         out = {}
         for s in range(self.n_slots):
             self.lens[s] += 1  # every slot appended (masking, not branching)
@@ -480,4 +506,5 @@ class ServingEngine:
         finished sampled request must not keep disabling it."""
         self.temps[slot] = 0.0
         self.topks[slot] = 0
+        self.topps[slot] = 1.0
         self.adapters[slot] = -1
