@@ -10,6 +10,7 @@ path is additionally exercised on real hardware by bench.py).
 import jax
 import jax.numpy as jnp
 import flax.linen as nn
+import numpy as np
 import pytest
 
 from tpu_k8s_device_plugin.workloads.pool import max_pool
@@ -105,3 +106,37 @@ def test_batch_not_multiple_of_128_padded_correctly():
     x = jax.random.normal(jax.random.PRNGKey(5), (5, 12, 12, 8))
     assert jnp.array_equal(
         max_pool(x, 3, 2, interpret=True), _ref(x, 3, 2))
+
+
+def test_alexnet_pallas_pool_matches_xla_pool():
+    # the model-level knob: same params, both pool impls, identical
+    # logits and gradients (interpret mode on CPU)
+    import functools
+
+    from tpu_k8s_device_plugin.workloads.alexnet import (
+        AlexNet,
+        loss_fn,
+        space_to_depth,
+    )
+
+    rng = jax.random.PRNGKey(0)
+    x = space_to_depth(
+        jax.random.normal(rng, (2, 224, 224, 3), jnp.float32))
+    labels = jnp.asarray([3, 7])
+    a_xla = AlexNet(num_classes=10, dtype=jnp.float32, s2d=True,
+                    pool="xla")
+    a_pl = AlexNet(num_classes=10, dtype=jnp.float32, s2d=True,
+                   pool="pallas")
+    params = a_xla.init(rng, x, train=False)["params"]
+    lx = a_xla.apply({"params": params}, x, train=False)
+    lp = a_pl.apply({"params": params}, x, train=False)
+    assert jnp.array_equal(lx, lp)
+    gx = jax.grad(functools.partial(loss_fn, a_xla))(params, x, labels)
+    gp = jax.grad(functools.partial(loss_fn, a_pl))(params, x, labels)
+    # the pool op itself is bit-exact (tests above); through the whole
+    # model, XLA fuses differently around the custom-call boundary so
+    # OTHER ops' accumulation order shifts at float epsilon
+    for a, b in zip(jax.tree_util.tree_leaves(gx),
+                    jax.tree_util.tree_leaves(gp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)

@@ -59,6 +59,22 @@ class AlexNet(nn.Module):
     num_classes: int = NUM_CLASSES
     dtype: Any = COMPUTE_DTYPE
     s2d: bool = False
+    # "xla" = reduce_window/select_and_scatter; "pallas" = the fused
+    # argmax-index kernel (workloads/pool.py) whose backward avoids
+    # select_and_scatter entirely — bit-exact either way (fwd AND grad,
+    # tie-break included; tests/test_pool.py), so this is purely a
+    # performance knob to be set from measurement on the target chip
+    pool: str = "xla"
+
+    def _max_pool(self, x: jax.Array) -> jax.Array:
+        if self.pool == "pallas":
+            from .pool import max_pool as pallas_max_pool
+
+            return pallas_max_pool(x, 3, 2)
+        if self.pool != "xla":
+            raise ValueError(
+                f"unknown pool {self.pool!r}: expected 'xla' or 'pallas'")
+        return nn.max_pool(x, window_shape=(3, 3), strides=(2, 2))
 
     @nn.compact
     def __call__(self, x: jax.Array, *, train: bool = True) -> jax.Array:
@@ -76,17 +92,17 @@ class AlexNet(nn.Module):
             x = conv(features=64, kernel_size=(3, 3))(x)
         else:
             x = conv(features=64, kernel_size=(11, 11), strides=(4, 4))(x)
-        x = nn.max_pool(x, window_shape=(3, 3), strides=(2, 2))
+        x = self._max_pool(x)
         x = nn.relu(x)
         x = conv(features=192, kernel_size=(5, 5))(x)
-        x = nn.max_pool(x, window_shape=(3, 3), strides=(2, 2))
+        x = self._max_pool(x)
         x = nn.relu(x)
         x = conv(features=384, kernel_size=(3, 3))(x)
         x = nn.relu(x)
         x = conv(features=256, kernel_size=(3, 3))(x)
         x = nn.relu(x)
         x = conv(features=256, kernel_size=(3, 3))(x)
-        x = nn.max_pool(x, window_shape=(3, 3), strides=(2, 2))
+        x = self._max_pool(x)
         x = nn.relu(x)
         x = x.reshape((x.shape[0], -1))
         x = nn.Dense(4096, dtype=self.dtype)(x)
@@ -104,9 +120,10 @@ def create_train_state(
     num_classes: int = NUM_CLASSES,
     learning_rate: float = 0.01,
     s2d: bool = False,
+    pool: str = "xla",
 ) -> Tuple[AlexNet, Dict[str, Any]]:
     """Model + initial (params, opt_state) pytree."""
-    model = AlexNet(num_classes=num_classes, s2d=s2d)
+    model = AlexNet(num_classes=num_classes, s2d=s2d, pool=pool)
     if s2d:
         shape = (batch_size, image_size // S2D_BLOCK, image_size // S2D_BLOCK,
                  S2D_BLOCK * S2D_BLOCK * 3)

@@ -46,9 +46,19 @@ def _timed_loop(
     return best
 
 
+def _resolve_pool(pool):
+    """Pool impl knob for A/B runs on the target chip without editing
+    code: explicit argument, else ALEXNET_POOL env, else "xla".
+    "pallas" routes the max-pools through the Pallas argmax-index
+    kernel (bit-exact either way; see workloads/pool.py)."""
+    import os
+
+    return pool or os.environ.get("ALEXNET_POOL", "xla")
+
+
 def run_single(
     batch: int, steps: int, warmup: int, s2d: bool = True,
-    want_flops: bool = False, rounds: int = 1,
+    want_flops: bool = False, rounds: int = 1, pool=None,
 ):
     """Returns images/sec (and, with ``want_flops``, XLA's per-step FLOP
     count for MFU accounting).  ``s2d`` is on by default: the
@@ -56,7 +66,8 @@ def run_single(
     from .alexnet import create_train_state, synthetic_batch, train_step
 
     rng = jax.random.PRNGKey(0)
-    model, state = create_train_state(rng, batch_size=batch, s2d=s2d)
+    model, state = create_train_state(
+        rng, batch_size=batch, s2d=s2d, pool=_resolve_pool(pool))
     params, opt_state, tx = state["params"], state["opt_state"], state["tx"]
     images, labels = synthetic_batch(rng, batch, s2d=s2d)
     step = jax.jit(
@@ -96,7 +107,8 @@ def _step_flops(step, *args):
         return None, None
 
 
-def run_sharded(batch: int, steps: int, warmup: int, s2d: bool = True) -> float:
+def run_sharded(batch: int, steps: int, warmup: int, s2d: bool = True,
+                pool=None) -> float:
     from .alexnet import create_train_state, synthetic_batch
     from .parallel import make_mesh, make_sharded_train_step
 
@@ -104,7 +116,8 @@ def run_sharded(batch: int, steps: int, warmup: int, s2d: bool = True) -> float:
     # keep per-device batch constant so chips stay MXU-bound as we scale
     batch *= mesh.shape["data"]
     rng = jax.random.PRNGKey(0)
-    model, state = create_train_state(rng, batch_size=batch, s2d=s2d)
+    model, state = create_train_state(
+        rng, batch_size=batch, s2d=s2d, pool=_resolve_pool(pool))
     step, params, opt_state, (img_sh, lbl_sh) = make_sharded_train_step(
         model, state["tx"], mesh, state["params"], state["opt_state"]
     )
@@ -158,6 +171,8 @@ def main(argv=None) -> int:
     p.add_argument("--warmup", type=int, default=5)
     p.add_argument("--sharded", action="store_true",
                    help="train over a mesh of all visible devices")
+    p.add_argument("--pool", choices=("xla", "pallas"), default=None,
+                   help="max-pool impl (default: $ALEXNET_POOL or xla)")
     args = p.parse_args(argv)
     if args.steps < 1:
         p.error("--steps must be >= 1")
@@ -171,9 +186,11 @@ def main(argv=None) -> int:
     devs = jax.devices()
     print(f"devices: {len(devs)} x {devs[0].platform}", flush=True)
     if args.sharded:
-        ips = run_sharded(args.batch, args.steps, args.warmup)
+        ips = run_sharded(args.batch, args.steps, args.warmup,
+                          pool=args.pool)
     else:
-        ips = run_single(args.batch, args.steps, args.warmup)
+        ips = run_single(args.batch, args.steps, args.warmup,
+                         pool=args.pool)
     n = len(devs) if args.sharded else 1
     print(f"total images/sec: {ips:.1f}")
     print(f"images/sec/chip:  {ips / n:.1f}")
