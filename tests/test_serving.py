@@ -495,3 +495,61 @@ def test_top_p_applies_within_top_k(setup):
     # ONLY checking the first token (later steps have other logits);
     # with the nucleus inside top-k it must be the argmax
     assert eng.output(s)[0] == _solo(model, params, prompt, 1)[0]
+
+
+def test_run_scan_matches_stepwise_greedy(setup):
+    model, params = setup
+    prompts = {"a": [3, 14, 15, 92], "b": [9, 8]}
+    a = ServingEngine(model, params, n_slots=3)
+    b = ServingEngine(model, params, n_slots=3)
+    sa = {k: a.admit(p) for k, p in prompts.items()}
+    sb = {k: b.admit(p) for k, p in prompts.items()}
+    for _ in range(6):
+        a.step()
+    b.run_scan(6)
+    for k in prompts:
+        assert a.output(sa[k]) == b.output(sb[k]), k
+    assert a.stats()["decode_steps"] == b.stats()["decode_steps"]
+
+
+def test_run_scan_matches_stepwise_sampled(setup):
+    model, params = setup
+    a = ServingEngine(model, params, n_slots=2,
+                      rng=jax.random.PRNGKey(21))
+    b = ServingEngine(model, params, n_slots=2,
+                      rng=jax.random.PRNGKey(21))
+    sa = a.admit([5, 17, 3], temperature=1.0, top_k=16, top_p=0.9)
+    sb = b.admit([5, 17, 3], temperature=1.0, top_k=16, top_p=0.9)
+    for _ in range(5):
+        a.step()
+    b.run_scan(5)
+    assert a.output(sa) == b.output(sb)
+
+
+def test_run_scan_retires_on_eos_and_budget(setup):
+    model, params = setup
+    prompt = [3, 14, 15, 92, 65]
+    solo = _solo(model, params, prompt, 6)
+    eos = solo[2]
+    eng = ServingEngine(model, params, n_slots=2, eos_id=eos)
+    s = eng.admit(prompt)
+    out = eng.run_scan(6)
+    assert eng.finished(s)
+    assert eng.output(s) == solo[:3]
+    assert out[s] == solo[1:3]  # scan returns post-admit tokens
+    # budget retirement through run_scan: discarded post-retirement
+    # tokens must not count toward outputs or the budget
+    bng = ServingEngine(model, params, n_slots=1, max_new_tokens=4)
+    sb = bng.admit(prompt)
+    bng.run_scan(6)
+    assert bng.finished(sb)
+    assert bng.output(sb) == solo[:4]
+    assert bng.stats()["tokens_emitted"] == 4
+
+
+def test_run_scan_headroom_guard(setup):
+    model, params = setup  # max_len = 64
+    eng = ServingEngine(model, params, n_slots=1)
+    eng.admit(list(range(60)))
+    with pytest.raises(ValueError, match="cache rows"):
+        eng.run_scan(10)

@@ -124,6 +124,39 @@ def _pick_tokens(logits, temps, topks, topps, key):
     return jnp.argmax(noised, axis=-1).astype(jnp.int32)
 
 
+@functools.partial(
+    jax.jit, static_argnums=(0, 1, 2), donate_argnums=(4,)
+)
+def _scan_decode(model, n_steps, sampled, params, cache, last, lens,
+                 temps, topks, topps, adapter_ids, rng, draws0):
+    """n_steps decode steps in one lax.scan.  The per-step sampling key
+    is fold_in(rng, draws0 + i) — the same chain ``step`` consumes one
+    link of per call, so scan and step-by-step emit identical streams.
+    Greedy mode (sampled=False) skips the pick entirely."""
+
+    def step_fn(carry, i):
+        cache, tok, pos = carry
+        logits, mut = model.apply(
+            {"params": params, "cache": cache},
+            tok[:, None], pos[:, None], decode=True,
+            adapter_ids=adapter_ids, mutable=["cache"],
+        )
+        lg = logits[:, -1, :]
+        if sampled:
+            nxt = _pick_tokens(
+                lg, temps, topks, topps,
+                jax.random.fold_in(rng, draws0 + i),
+            )
+        else:
+            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        return (mut["cache"], nxt, pos + 1), nxt
+
+    (cache, _, _), toks = lax.scan(
+        step_fn, (cache, last, lens), jnp.arange(n_steps)
+    )
+    return toks, cache
+
+
 class ServingEngine:
     """Continuous-batching scheduler over one compiled decode step.
 
@@ -456,6 +489,60 @@ class ServingEngine:
             if not any(self.active):
                 return
             self.step()
+
+    def run_scan(self, n_steps: int) -> Dict[int, List[int]]:
+        """*n_steps* decode steps as ONE compiled ``lax.scan`` — no
+        per-token host round-trip (the difference is decisive over
+        remote/tunneled transports, same reason greedy_generate scans).
+        Token-for-token identical to ``n_steps`` × :meth:`step` when no
+        admissions interleave; EOS/budget retirement applies AFTER the
+        scan (retired slots' extra tokens are computed and discarded —
+        masking, not branching — exactly like inactive slots in
+        ``step``).  Every active slot must have *n_steps* of cache
+        headroom.  Returns {slot: [tokens]} for slots active at entry.
+        """
+        if n_steps < 1:
+            raise ValueError("n_steps must be >= 1")
+        if not any(self.active):
+            return {}
+        for s in range(self.n_slots):
+            if self.active[s] and \
+                    self.lens[s] + n_steps > self.model.max_len:
+                raise ValueError(
+                    f"slot {s} has {self.model.max_len - self.lens[s]} "
+                    f"cache rows left, need {n_steps}")
+        sampled = bool(self.temps.any() or self.topks.any()
+                       or (self.topps < 1.0).any())
+        aids = (jnp.asarray(self.adapters)
+                if self.model.n_adapters > 0 else None)
+        toks, self.cache = _scan_decode(
+            self.model, n_steps, sampled, self.params, self.cache,
+            jnp.asarray(self.last_token), jnp.asarray(self.lens, jnp.int32),
+            jnp.asarray(self.temps), jnp.asarray(self.topks),
+            jnp.asarray(self.topps), aids, self._rng,
+            jnp.int32(self._draws),
+        )
+        toks = np.asarray(toks, dtype=np.int32)  # [n_steps, S]
+        if sampled:
+            self._draws += n_steps
+        self._steps += n_steps
+        out: Dict[int, List[int]] = {
+            s: [] for s in range(self.n_slots) if self.active[s]
+        }
+        for i in range(n_steps):
+            for s in range(self.n_slots):
+                self.lens[s] += 1
+                if not self.active[s]:
+                    continue
+                tok = int(toks[i, s])
+                self.last_token[s] = tok
+                self.outputs[s].append(tok)
+                self._tokens += 1
+                out[s].append(tok)
+                self._maybe_finish(s, tok)
+        # lens advanced n_steps per slot in-device; the loop above
+        # advanced the host mirror the same amount
+        return out
 
     # -- completion --------------------------------------------------------
 
