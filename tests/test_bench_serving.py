@@ -1,0 +1,23 @@
+"""The serving benchmark CLI paths stay runnable (the pods call these)."""
+
+import jax
+
+from tpu_k8s_device_plugin.workloads.bench_serving import CONFIGS, run
+
+
+def test_uniform_path_runs():
+    stats = run("tiny", quantized=True, batch=2, steps=4,
+                prompt_len=8, max_len=64)
+    assert stats["tokens_per_sec"] > 0
+    assert stats["batch"] == 2.0
+
+
+def test_engine_path_runs():
+    stats = run("tiny", quantized=False, batch=2, steps=4,
+                prompt_len=8, max_len=128, engine=True)
+    assert stats["tokens_per_sec"] > 0
+    assert stats["engine"] is True
+
+
+def test_configs_cover_llama_presets():
+    assert {"llama3-8b", "llama2-7b", "tiny"} <= set(CONFIGS)
