@@ -1,7 +1,5 @@
 """The serving benchmark CLI paths stay runnable (the pods call these)."""
 
-import jax
-
 from tpu_k8s_device_plugin.workloads.bench_serving import CONFIGS, run
 
 
