@@ -19,3 +19,10 @@ def test_engine_path_runs():
 
 def test_configs_cover_llama_presets():
     assert {"llama3-8b", "llama2-7b", "tiny"} <= set(CONFIGS)
+
+
+def test_int4_path_runs():
+    stats = run("tiny", quantized="int4", batch=1, steps=4,
+                prompt_len=8, max_len=64)
+    assert stats["tokens_per_sec"] > 0
+    assert stats["quantized"] == "int4"

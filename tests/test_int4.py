@@ -1,0 +1,117 @@
+"""Weight-only int4: packing exactness, serving closeness, engine
+composition.  4-bit is the coarse rung of the quantization ladder, so
+the oracle is closeness (per-channel scales bound the error), not
+bit-equality — but the PACKING itself must be lossless over the whole
+[-8, 7] grid."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_k8s_device_plugin.workloads import llama
+from tpu_k8s_device_plugin.workloads.inference import (
+    greedy_generate,
+    init_cache,
+    make_decoder,
+    pack_int4,
+    quantize_lm_params_int4,
+    unpack_int4,
+)
+from tpu_k8s_device_plugin.workloads.serving import ServingEngine
+
+CFG = dict(vocab=96, d_model=64, n_heads=4, n_layers=2, d_ff=128)
+DT = jnp.float32
+
+
+@pytest.fixture(scope="module")
+def trained():
+    model = make_decoder(**CFG, max_len=64, dtype=DT)
+    rng = jax.random.PRNGKey(0)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+    return model, model.init(rng, tokens, pos)["params"]
+
+
+def test_pack_unpack_exact_over_full_grid():
+    vals = jnp.asarray(
+        np.stack([np.arange(-8, 8, dtype=np.int8)] * 4), jnp.int8)
+    assert jnp.array_equal(unpack_int4(pack_int4(vals)), vals)
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.integers(-8, 8, (32, 48), np.int8))
+    assert jnp.array_equal(unpack_int4(pack_int4(w)), w)
+
+
+def test_int4_tree_layout_and_size(trained):
+    _, params = trained
+    q = quantize_lm_params_int4(params)
+    blk = q["block_0"]
+    assert blk["qkv"]["kernel_int4"].dtype == jnp.int8
+    assert blk["qkv"]["kernel_int4"].shape == (
+        CFG["d_model"], params["block_0"]["qkv"]["kernel"].shape[1] // 2)
+    # group-wise scales: [D/group, F]
+    from tpu_k8s_device_plugin.workloads.inference import _int4_group
+    d = CFG["d_model"]
+    f = params["block_0"]["qkv"]["kernel"].shape[1]
+    assert blk["qkv"]["scale"].shape == (d // _int4_group(d), f)
+
+
+def test_int4_prefill_close_to_full_precision(trained):
+    model, params = trained
+    q = quantize_lm_params_int4(params)
+    m4 = make_decoder(**CFG, max_len=64, dtype=DT, quantized="int4")
+    prompt = jnp.asarray([[5, 17, 3, 70, 2]], jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(5, dtype=jnp.int32), (1, 5))
+    ref, _ = model.apply(
+        {"params": params, "cache": init_cache(model, 1)},
+        prompt, pos, decode=False, mutable=["cache"])
+    got, _ = m4.apply(
+        {"params": q, "cache": init_cache(m4, 1)},
+        prompt, pos, decode=False, mutable=["cache"])
+    err = float(jnp.max(jnp.abs(ref - got)))
+    scale = float(jnp.max(jnp.abs(ref))) + 1e-6
+    assert err / scale < 0.25, err / scale  # 4-bit is coarse
+
+
+def test_int4_decodes_through_engine_and_loop(trained):
+    _, params = trained
+    q = quantize_lm_params_int4(params)
+    m4 = make_decoder(**CFG, max_len=64, dtype=DT, quantized="int4")
+    prompt = [5, 17, 3]
+    out, _ = greedy_generate(m4, q, jnp.asarray([prompt]), 5)
+    assert out.shape == (1, 5)
+    eng = ServingEngine(m4, q, n_slots=2, max_new_tokens=5)
+    s = eng.admit(prompt)
+    eng.run_scan(4)
+    assert eng.finished(s)
+    assert eng.output(s) == np.asarray(out)[0].tolist()
+
+
+def test_int4_llama_gqa_swiglu(trained):
+    cfg = llama.TINY_LLAMA
+    base = llama.train_model(cfg, dtype=DT)
+    rng = jax.random.PRNGKey(2)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+    params = base.init(rng, tokens, pos)["params"]
+    q = quantize_lm_params_int4(params)
+    assert "kernel_int4" in q["block_0"]["mlp_gate"]
+    m4 = make_decoder(
+        vocab=cfg.vocab, d_model=cfg.d_model, n_heads=cfg.n_heads,
+        n_layers=cfg.n_layers, d_ff=cfg.d_ff, max_len=64, dtype=DT,
+        quantized="int4", n_kv_heads=cfg.n_kv_heads, ffn="swiglu",
+        rope_theta=cfg.rope_theta)
+    out, _ = greedy_generate(m4, q, jnp.asarray([[3, 200, 100]]), 4)
+    assert out.shape == (1, 4)
+
+
+def test_int4_moe_rejected(trained):
+    moe = make_decoder(**CFG, max_len=64, dtype=DT, quantized="int4",
+                       n_experts=4)
+    with pytest.raises(NotImplementedError, match="int4"):
+        moe.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32),
+                 jnp.broadcast_to(jnp.arange(4, dtype=jnp.int32), (1, 4)))
+    _, params = trained
+    bad = {"block_0": {"moe": {"experts_up": jnp.zeros((2, 4, 8))}}}
+    with pytest.raises(NotImplementedError, match="int8"):
+        quantize_lm_params_int4(bad)

@@ -160,3 +160,23 @@ def test_lora_composes_with_int8(setup):
     qbase = make_decoder(**CFG, max_len=64, dtype=DT, quantized=True)
     want = _solo(qbase, quantize_lm_params(base_params), prompt, 4)
     assert got == want
+
+
+def test_lora_composes_with_int4(setup):
+    base, _, base_params = setup
+    from tpu_k8s_device_plugin.workloads.inference import (
+        quantize_lm_params_int4)
+
+    q4lora = make_decoder(**CFG, max_len=64, dtype=DT, quantized="int4",
+                          n_adapters=N_ADAPT, lora_rank=RANK)
+    qp = attach_lora(quantize_lm_params_int4(base_params), q4lora,
+                     jax.random.PRNGKey(1))
+    # lora_B must carry the FULL output dim, not the packed width
+    f = base_params["block_0"]["mlp_up"]["kernel"].shape[1]
+    assert qp["block_0"]["mlp_up_lora_B"].shape == (N_ADAPT, RANK, f)
+    got = _solo(q4lora, qp, [5, 17, 3], 4, adapter=1)
+    # zero-B adapters over the int4 base == plain int4 decode
+    q4base = make_decoder(**CFG, max_len=64, dtype=DT, quantized="int4")
+    want = _solo(q4base, quantize_lm_params_int4(base_params),
+                 [5, 17, 3], 4)
+    assert got == want

@@ -17,6 +17,7 @@ from .inference import (
     greedy_generate,
     make_decoder,
     quantize_lm_params,
+    quantize_lm_params_int4,
     sample_generate,
 )
 try:  # checkpointing needs orbax; the rest of the workloads don't
@@ -51,6 +52,7 @@ __all__ = [
     "greedy_generate",
     "make_decoder",
     "quantize_lm_params",
+    "quantize_lm_params_int4",
     "sample_generate",
     "ServingEngine",
     "attach_lora",

@@ -31,11 +31,13 @@ CONFIGS = {
 }
 
 
-def run(config: str, quantized: bool, batch: int, steps: int,
+def run(config: str, quantized, batch: int, steps: int,
         prompt_len: int, max_len: int, engine: bool = False):
     cfg = CONFIGS[config]
     model = llama.decoder(cfg, max_len=max_len, quantized=quantized)
-    if quantized:
+    if quantized == "int4":
+        params = llama.random_quantized_params(cfg, bits=4)
+    elif quantized:
         params = llama.random_quantized_params(cfg)
     else:
         # small configs only: materializes the bf16 tree
@@ -98,7 +100,10 @@ def _engine_throughput(model, params, prompt, steps,
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tpu-serving-bench")
     p.add_argument("--config", choices=sorted(CONFIGS), default="tiny")
-    p.add_argument("--quantized", action="store_true")
+    p.add_argument("--quantized", action="store_true",
+                   help="weight-only int8")
+    p.add_argument("--int4", action="store_true",
+                   help="weight-only int4 (packed; dense configs only)")
     p.add_argument("--batch", type=int, default=1)
     p.add_argument("--steps", type=int, default=64)
     p.add_argument("--prompt-len", type=int, default=128)
@@ -113,7 +118,10 @@ def main(argv=None) -> int:
 
     devs = jax.devices()
     print(f"devices: {len(devs)} x {devs[0].platform}", flush=True)
-    stats = run(args.config, args.quantized, args.batch, args.steps,
+    if args.int4 and args.quantized:
+        p.error("--quantized and --int4 are mutually exclusive")
+    quantized = "int4" if args.int4 else args.quantized
+    stats = run(args.config, quantized, args.batch, args.steps,
                 args.prompt_len, args.max_len, engine=args.engine)
     for k, v in stats.items():
         print(f"{k}: {v}")

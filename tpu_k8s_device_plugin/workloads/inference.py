@@ -97,6 +97,146 @@ class QuantDense(nn.Dense):
         return (out * scale).astype(self.dtype)
 
 
+# int4 scale-group size along the INPUT dim: 4-bit needs finer scale
+# granularity than a whole column (the max over 4096 weights is ~1.5x
+# the max over 64, and the quantization error scales with it) — the
+# standard GPTQ/AWQ-style recipe
+_INT4_GROUP = 64
+
+
+class Quant4Dense(nn.Dense):
+    """Weight-only int4 Dense: two 4-bit values per stored int8 byte
+    (adjacent output channels share a byte — low nibble = even channel,
+    high nibble = odd), GROUP-WISE f32 scales (one per
+    ``_INT4_GROUP``-sized input-dim group per output channel, symmetric
+    range [-7, 7]).  Halves the weight bytes per token AGAIN vs int8 —
+    decode is weight-bandwidth-bound, so this is the next rung of the
+    same ladder (and what fits Llama-3-8B kernels in ~4 GB).  Because
+    the scales vary along the contraction dim they cannot move to the
+    dot output; the matmul runs as a per-group batched einsum with the
+    group scales applied to the per-group partial sums — weights still
+    stream as int8 bytes."""
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        if self.use_bias:
+            raise NotImplementedError(
+                "Quant4Dense is weight-only (no bias)")
+        if self.features % 2:
+            raise ValueError(
+                f"int4 packing needs an even output dim, got "
+                f"{self.features}")
+        din = x.shape[-1]
+        g = _int4_group(din)
+        packed = self.param(
+            "kernel_int4",
+            lambda rng, shape: jnp.zeros(shape, jnp.int8),
+            (din, self.features // 2),
+        )
+        scale = self.param(
+            "scale",
+            lambda rng, shape: jnp.ones(shape, jnp.float32),
+            (din // g, self.features),
+        )
+        w4 = unpack_int4(packed).astype(self.dtype)  # [D, F]
+        n_g = din // g
+        lead = x.shape[:-1]
+        xg = x.astype(self.dtype).reshape(lead + (n_g, g))
+        wg = w4.reshape(n_g, g, self.features)
+        partial = jnp.einsum("...gd,gdf->...gf", xg, wg)
+        out = jnp.einsum(
+            "...gf,gf->...f", partial.astype(jnp.float32), scale
+        )
+        return out.astype(self.dtype)
+
+
+def _dense_cls(quantized):
+    """False -> nn.Dense, truthy -> int8, "int4" -> packed 4-bit."""
+    if quantized == "int4":
+        return Quant4Dense
+    return QuantDense if quantized else nn.Dense
+
+
+def _int4_group(din: int) -> int:
+    """Largest divisor of the input dim at or below _INT4_GROUP."""
+    g = min(_INT4_GROUP, din)
+    while din % g:
+        g -= 1
+    return g
+
+
+def pack_int4(w4: jax.Array) -> jax.Array:
+    """[D, F] int8 values in [-8, 7] → [D, F//2] bytes: low nibble =
+    even column, high nibble = odd column."""
+    lo = w4[:, 0::2] & 0x0F
+    hi = w4[:, 1::2] & 0x0F
+    return (lo | (hi << 4)).astype(jnp.int8)
+
+
+def unpack_int4(packed: jax.Array) -> jax.Array:
+    """[D, P] bytes → [D, 2P] sign-extended int8 values (inverse of
+    :func:`pack_int4`; arithmetic shifts do the sign extension)."""
+    lo = ((packed << 4).astype(jnp.int8) >> 4).astype(jnp.int8)
+    hi = (packed >> 4).astype(jnp.int8)
+    d, p_cols = packed.shape
+    return jnp.stack([lo, hi], axis=-1).reshape(d, 2 * p_cols)
+
+
+# the projection names every quantizer targets
+_QUANT_NAMES = (
+    "qkv", "out_proj", "mlp_up", "mlp_gate", "mlp_down", "lm_head"
+)
+
+
+def _quantize_tree(params, kernel_fn, experts_fn):
+    """Shared tree walk for the weight-only quantizers: each projection
+    ``kernel`` under a _QUANT_NAMES scope is replaced by
+    ``kernel_fn(w) -> {new leaves}``; MoE expert stacks go through
+    ``experts_fn(name, w) -> {new leaves}``."""
+
+    def convert(tree, under_quant):
+        out = {}
+        for name, sub in tree.items():
+            if isinstance(sub, dict):
+                out[name] = convert(sub, name in _QUANT_NAMES)
+            elif under_quant and name == "kernel":
+                out.update(kernel_fn(sub))
+            elif name in ("experts_up", "experts_down"):
+                out.update(experts_fn(name, sub))
+            else:
+                out[name] = sub
+        return out
+
+    return convert(params, False)
+
+
+def quantize_lm_params_int4(params):
+    """Weight-only int4 conversion of a trained LM tree (projections
+    only — MoE expert stacks stay unsupported here; use int8 for MoE).
+    Each projection ``kernel`` becomes ``{kernel_int4, scale}`` with
+    symmetric GROUP-WISE scales ([D/group, F], group along the input
+    dim) over the [-7, 7] grid."""
+
+    def quant(w):
+        w = jnp.asarray(w, jnp.float32)
+        din, dout = w.shape
+        g = _int4_group(din)
+        wg = w.reshape(din // g, g, dout)
+        scale = jnp.max(jnp.abs(wg), axis=1) / 7.0  # [D/g, F]
+        scale = jnp.where(scale == 0.0, 1.0, scale)
+        wq = jnp.clip(
+            jnp.round(wg / scale[:, None, :]), -7, 7
+        ).astype(jnp.int8).reshape(din, dout)
+        return {"kernel_int4": pack_int4(wq), "scale": scale}
+
+    def experts(name, w):
+        raise NotImplementedError(
+            "int4 MoE expert stacks not supported; quantize "
+            "MoE configs with quantize_lm_params (int8)")
+
+    return _quantize_tree(params, quant, experts)
+
+
 def quantize_lm_params(params, dtype=jnp.int8):
     """Convert a trained LM param tree to the weight-only integer layout
     the quantized decode model consumes: every projection ``kernel``
@@ -107,9 +247,6 @@ def quantize_lm_params(params, dtype=jnp.int8):
     ``jnp.iinfo(dtype)``; expert scales are per (expert, out-channel)).
     Embeddings, norms, and the router stay as-is (lookups and tiny
     vectors — not where the bandwidth goes)."""
-    quant_names = (
-        "qkv", "out_proj", "mlp_up", "mlp_gate", "mlp_down", "lm_head"
-    )
     qmax = float(jnp.iinfo(dtype).max)
 
     def quant(w, reduce_axis):
@@ -121,26 +258,17 @@ def quantize_lm_params(params, dtype=jnp.int8):
         ).astype(dtype)
         return wq, scale
 
-    def convert(tree, under_quant):
-        out = {}
-        for name, sub in tree.items():
-            if isinstance(sub, dict):
-                out[name] = convert(sub, name in quant_names)
-            elif under_quant and name == "kernel":
-                wq, scale = quant(sub, 0)
-                out["kernel_int8"] = wq
-                out["scale"] = scale
-            elif name in ("experts_up", "experts_down"):
-                # [E, D, F] / [E, F, D]: contraction axis is 1, so the
-                # per-(expert, out-channel) scale reduces over it
-                wq, scale = quant(sub, 1)
-                out[f"{name}_int8"] = wq
-                out[f"{name}_scale"] = scale
-            else:
-                out[name] = sub
-        return out
+    def kernel_fn(w):
+        wq, scale = quant(w, 0)
+        return {"kernel_int8": wq, "scale": scale}
 
-    return convert(params, False)
+    def experts_fn(name, w):
+        # [E, D, F] / [E, F, D]: contraction axis is 1, so the
+        # per-(expert, out-channel) scale reduces over it
+        wq, scale = quant(w, 1)
+        return {f"{name}_int8": wq, f"{name}_scale": scale}
+
+    return _quantize_tree(params, kernel_fn, experts_fn)
 
 
 class CachedBlock(nn.Module):
@@ -172,7 +300,9 @@ class CachedBlock(nn.Module):
     d_ff: int
     max_len: int
     dtype: Any = COMPUTE_DTYPE
-    quantized: bool = False  # weight-only int8 projections (QuantDense)
+    # False = full precision; True = weight-only int8 (QuantDense);
+    # "int4" = packed 4-bit weights (Quant4Dense, dense configs only)
+    quantized: Any = False
     n_experts: int = 0      # >0: MoE FFN (same MoEFFN as training)
     moe_k: int = 2
     moe_capacity_factor: float = 1.25
@@ -189,7 +319,11 @@ class CachedBlock(nn.Module):
         adapter_ids: Optional[jax.Array] = None,  # [B] int32, -1 = base
     ) -> jax.Array:
         B, T, _ = x.shape
-        dense = QuantDense if self.quantized else nn.Dense
+        if self.quantized == "int4" and self.n_experts > 0:
+            raise NotImplementedError(
+                "int4 + MoE not supported (expert stacks stay "
+                "int8); use quantized=True for MoE configs")
+        dense = _dense_cls(self.quantized)
         head_dim = self.d_model // self.n_heads
         n_kv = self.n_kv_heads or self.n_heads
         _validate_attn_ffn(self.n_heads, n_kv, self.ffn)
@@ -381,7 +515,7 @@ class DecodeTransformerLM(nn.Module):
     d_ff: int = 1024
     max_len: int = 512
     dtype: Any = COMPUTE_DTYPE
-    quantized: bool = False  # weight-only int8 projections (QuantDense)
+    quantized: Any = False  # False | True (int8) | "int4"
     n_experts: int = 0
     moe_k: int = 2
     moe_capacity_factor: float = 1.25
@@ -414,7 +548,7 @@ class DecodeTransformerLM(nn.Module):
                 name=f"block_{i}",
             )(x, positions, decode=decode, adapter_ids=adapter_ids)
         x = nn.RMSNorm(dtype=self.dtype, name="final_norm")(x)
-        dense = QuantDense if self.quantized else nn.Dense
+        dense = _dense_cls(self.quantized)
         logits = dense(self.vocab, use_bias=False, dtype=self.dtype,
                        name="lm_head")(x)
         return logits.astype(jnp.float32)
@@ -428,7 +562,7 @@ def make_decoder(
     d_ff: int = 1024,
     max_len: int = 512,
     dtype: Any = COMPUTE_DTYPE,
-    quantized: bool = False,
+    quantized: Any = False,
     n_experts: int = 0,
     moe_k: int = 2,
     moe_capacity_factor: float = 1.25,
@@ -609,8 +743,19 @@ def attach_lora(params, model: "DecodeTransformerLM", rng,
             if name not in block:
                 continue
             kern = block[name].get(
-                "kernel", block[name].get("kernel_int8"))
-            din, dout = kern.shape
+                "kernel",
+                block[name].get("kernel_int8",
+                                block[name].get("kernel_int4")))
+            din = kern.shape[0]
+            # output dim from the scale, not the kernel: the int4
+            # kernel is PACKED (F/2 wide) and int4 scales are
+            # group-wise [D/g, F] — the last scale axis is F in every
+            # quantized layout, and full-precision kernels carry F
+            # directly
+            if "scale" in block[name]:
+                dout = block[name]["scale"].shape[-1]
+            else:
+                dout = kern.shape[1]
             rng, k1 = jax.random.split(rng)
             block[f"{name}_lora_A"] = (
                 jax.random.normal(

@@ -106,7 +106,7 @@ def train_model(
 def decoder(
     cfg: LlamaConfig,
     max_len: Optional[int] = None,
-    quantized: bool = False,
+    quantized: Any = False,  # False | True (int8) | "int4"
     dtype: Any = COMPUTE_DTYPE,
 ) -> DecodeTransformerLM:
     """Serving-side twin (KV-cached; same param tree as train_model)."""
@@ -120,7 +120,8 @@ def decoder(
 
 
 def random_quantized_params(
-    cfg: LlamaConfig, seed: int = 0, dtype: Any = COMPUTE_DTYPE
+    cfg: LlamaConfig, seed: int = 0, dtype: Any = COMPUTE_DTYPE,
+    bits: int = 8,
 ):
     """Random weight-only-int8 parameter tree for *cfg*, built DIRECTLY
     in the quantized layout.
@@ -140,12 +141,26 @@ def random_quantized_params(
     import numpy as np
 
     del dtype  # leaf dtypes are fixed by the real quantized layout
+    if bits not in (4, 8):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
     rng = np.random.default_rng(seed)
     d, f, v = cfg.d_model, cfg.d_ff, cfg.vocab
     hd = cfg.head_dim
     qkv_out = (cfg.n_heads + 2 * cfg.n_kv_heads) * hd
 
     def kern(din, dout):
+        if bits == 4:
+            # packed two-per-byte + group-wise scales, same layout
+            # quantize_lm_params_int4 emits (Llama-3-8B kernels: ~4 GB)
+            from .inference import _int4_group
+
+            g = _int4_group(din)
+            return {
+                "kernel_int4": jnp.asarray(
+                    rng.integers(-128, 128, (din, dout // 2),
+                                 dtype=np.int8)),
+                "scale": jnp.full((din // g, dout), 0.01, jnp.float32),
+            }
         return {
             "kernel_int8": jnp.asarray(
                 rng.integers(-127, 128, (din, dout), dtype=np.int8)),
