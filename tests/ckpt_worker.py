@@ -1,0 +1,96 @@
+"""Subprocess worker for the cross-process checkpoint tests.
+
+Run as ``python tests/ckpt_worker.py <mode> <base_dir> <out_json>``:
+
+  train-crash   run 2 train steps, save step_2, print "saved", then
+                SIGKILL itself — a hard crash with no atexit/orbax
+                cleanup, the way a preempted pod actually dies
+  resume        restore the latest checkpoint into a FRESH process,
+                run 3 more steps, write the losses to <out_json>
+
+The training setup is bit-identical to test_checkpoint._setup (same
+seeds, same config, same backend), so the parent test can compare the
+resumed trajectory against an uninterrupted in-process run exactly.
+"""
+
+import functools
+import json
+import os
+import sys
+
+# same backend forcing as conftest.py: the host image's sitecustomize
+# pins JAX_PLATFORMS to the TPU tunnel, and jax.config beats env —
+# set both BEFORE any backend initialization
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except (AttributeError, KeyError):  # pragma: no cover
+    pass
+
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+from tpu_k8s_device_plugin.workloads import llama  # noqa: E402
+from tpu_k8s_device_plugin.workloads.checkpoint import (  # noqa: E402
+    latest_step,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from tpu_k8s_device_plugin.workloads.transformer import (  # noqa: E402
+    lm_train_step,
+    synthetic_lm_batch,
+)
+
+CFG = llama.TINY_LLAMA
+
+
+def build():
+    model = llama.train_model(CFG, dtype=jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    tokens, labels, positions = synthetic_lm_batch(rng, 4, 16, CFG.vocab)
+    params = model.init(rng, tokens, positions)["params"]
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+    step = jax.jit(functools.partial(lm_train_step, model, tx))
+    return step, params, opt_state, (tokens, labels, positions)
+
+
+def main() -> None:
+    mode, base, out = sys.argv[1], sys.argv[2], sys.argv[3]
+    step, params, opt_state, batch = build()
+    if mode == "train-crash":
+        p, o = params, opt_state
+        for _ in range(2):
+            p, o, _ = step(p, o, *batch)
+        save_checkpoint(base, 2, {"params": p, "opt_state": o})
+        print("saved", flush=True)
+        os.kill(os.getpid(), 9)  # no clean shutdown of any kind
+    elif mode == "resume":
+        template = {"params": params, "opt_state": opt_state}
+        start = latest_step(base)
+        restored = restore_checkpoint(base, template=template)
+        p, o = restored["params"], restored["opt_state"]
+        losses = []
+        for _ in range(3):
+            p, o, loss = step(p, o, *batch)
+            losses.append(float(loss))
+        with open(out, "w") as f:
+            json.dump({"start_step": start, "losses": losses}, f)
+    else:  # pragma: no cover
+        raise SystemExit(f"unknown mode {mode!r}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,5 +1,7 @@
 """The serving benchmark CLI paths stay runnable (the pods call these)."""
 
+import pytest
+
 from tpu_k8s_device_plugin.workloads.bench_serving import CONFIGS, run
 
 
@@ -19,6 +21,14 @@ def test_engine_path_runs():
 
 def test_configs_cover_llama_presets():
     assert {"llama3-8b", "llama2-7b", "tiny"} <= set(CONFIGS)
+
+
+def test_engine_headroom_validated_up_front():
+    # library callers get the same fail-fast guard as the CLI: engine
+    # mode burns (warmup + rounds) scan windows of cache headroom
+    with pytest.raises(ValueError, match="max_len"):
+        run("tiny", quantized=False, batch=1, steps=16,
+            prompt_len=8, max_len=64, engine=True)
 
 
 def test_int4_path_runs():

@@ -553,3 +553,148 @@ def test_run_scan_headroom_guard(setup):
     eng.admit(list(range(60)))
     with pytest.raises(ValueError, match="cache rows"):
         eng.run_scan(10)
+
+
+def test_default_chunk_is_compile_safe(setup, monkeypatch):
+    # the default engine must admit arbitrary prompt lengths through a
+    # bounded set of compiled extend shapes: one chunk-wide prefill
+    # shape plus the S-wide decode step
+    import tpu_k8s_device_plugin.workloads.serving as serving_mod
+
+    model, params = setup
+    shapes = set()
+    real = serving_mod.extend_step
+
+    def counting(model_, params_, cache, tokens, positions,
+                 adapter_ids=None):
+        shapes.add(tuple(tokens.shape))
+        return real(model_, params_, cache, tokens, positions,
+                    adapter_ids)
+
+    monkeypatch.setattr(serving_mod, "extend_step", counting)
+    eng = ServingEngine(model, params, n_slots=8)
+    assert eng.chunk == 32  # largest divisor of 64 <= min(128, 32)
+    for ln in range(1, 9):  # 8 distinct prompt lengths
+        eng.admit(list(range(1, ln + 1)))
+    eng.step()
+    assert shapes == {(1, 32), (8, 1)}
+
+
+def test_default_chunk_matches_unchunked_tokens(setup):
+    model, params = setup
+    prompt = [5, 9, 3, 3, 7, 1, 0, 44, 91, 12]
+    auto = ServingEngine(model, params, n_slots=2)          # chunk=32
+    plain = ServingEngine(model, params, n_slots=2, chunk=None)
+    sa = auto.admit(prompt)
+    sp = plain.admit(prompt)
+    auto.run(6)
+    plain.run(6)
+    assert auto.output(sa) == plain.output(sp)
+    assert auto.output(sa)[:6] == _solo(model, params, prompt, 6)
+
+
+def test_chunk_rejects_bad_string(setup):
+    model, params = setup
+    with pytest.raises(ValueError, match="chunk"):
+        ServingEngine(model, params, n_slots=1, chunk="big")
+
+
+def test_auto_prefix_reuses_resident_slot_prompt(setup):
+    # two prompts sharing a 3-chunk prefix: the second admission must
+    # prefill only the tail, and its tokens must be bit-identical to
+    # cold (APC-off) admission
+    model, params = setup
+    shared = [7, 3, 9, 12, 5, 8, 1, 2, 44, 6, 91, 30]  # 12 = 3 chunks
+    pa = shared + [5, 9, 3]
+    pb = shared + [44, 1]
+    cold = ServingEngine(model, params, n_slots=2, chunk=4,
+                         auto_prefix=False)
+    warm = ServingEngine(model, params, n_slots=2, chunk=4)
+    ca, cb = cold.admit(pa), cold.admit(pb)
+    wa = warm.admit(pa)
+    before = warm.stats()["prefill_tokens"]
+    wb = warm.admit(pb)
+    st = warm.stats()
+    # only the 2-token tail prefilled (the last shared chunk is partial
+    # against t_p - 1 = 13 -> matched 12 rows reused)
+    assert st["prefill_tokens"] - before == len(pb) - 12
+    assert st["prefix_cache_hits"] == 1
+    assert st["prefix_reused_tokens"] == 12
+    cold.run(6)
+    warm.run(6)
+    assert warm.output(wa) == cold.output(ca)
+    assert warm.output(wb) == cold.output(cb)
+
+
+def test_auto_prefix_matches_registry_partially(setup):
+    # a registered system prompt is reusable WITHOUT the handle, and a
+    # partial (chunk-floored) match reuses only the shared chunks
+    model, params = setup
+    system = [7, 7, 7, 12, 90, 3, 1, 2]          # 2 chunks of 4
+    prompt = system[:6] + [9, 9, 44]             # shares 6 -> 1 chunk
+    ref = ServingEngine(model, params, n_slots=2, chunk=4,
+                        auto_prefix=False)
+    eng = ServingEngine(model, params, n_slots=2, chunk=4,
+                        auto_prefix_min=4)
+    eng.register_prefix(system)
+    before = eng.stats()["prefill_tokens"]
+    s = eng.admit(prompt)
+    assert eng.stats()["prefill_tokens"] - before == len(prompt) - 4
+    r = ref.admit(prompt)
+    eng.run(5)
+    ref.run(5)
+    assert eng.output(s) == ref.output(r)
+
+
+def test_auto_prefix_respects_adapter_binding(setup):
+    # donors under a different LoRA adapter must not match (the
+    # adapter shapes the K/V)
+    model = make_decoder(**CFG, max_len=64, dtype=DT, n_adapters=2,
+                         lora_rank=4)
+    rng = jax.random.PRNGKey(3)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+    params = model.init(rng, tokens, pos)["params"]
+    shared = list(range(1, 13))
+    eng = ServingEngine(model, params, n_slots=2, chunk=4,
+                        auto_prefix_min=4)
+    eng.admit(shared + [5], adapter=0)
+    before = eng.stats()["prefix_cache_hits"]
+    eng.admit(shared + [9], adapter=1)  # different adapter: no reuse
+    assert eng.stats()["prefix_cache_hits"] == before
+
+
+def test_unchunked_engine_disables_auto_prefix(setup):
+    model, params = setup
+    eng = ServingEngine(model, params, n_slots=2, chunk=None)
+    assert not eng.auto_prefix
+    shared = list(range(1, 13))
+    eng.admit(shared + [5])
+    eng.admit(shared + [9])
+    assert eng.stats()["prefix_cache_hits"] == 0
+
+
+def test_draw_stream_mode_independent_after_retirement(setup):
+    # a sampled slot retiring mid-window must leave the engine's key
+    # stream where step-by-step scheduling would have left it, so later
+    # sampled admissions emit identical tokens under either API
+    model, params = setup
+
+    def mk():
+        return ServingEngine(model, params, n_slots=2,
+                             max_new_tokens=3,
+                             rng=jax.random.PRNGKey(5))
+
+    a, b = mk(), mk()
+    for e in (a, b):
+        e.admit([3, 14, 15])                              # greedy
+        e.admit([9, 9, 8], temperature=1.0, top_k=8)      # sampled
+    for _ in range(6):
+        a.step()
+    b.run_scan(6)  # both requests retire after step 2 of the window
+    sa = a.admit([5, 17, 3], temperature=1.0, top_k=8)
+    sb = b.admit([5, 17, 3], temperature=1.0, top_k=8)
+    for _ in range(2):
+        a.step()
+    b.run_scan(2)
+    assert a.output(sa) == b.output(sb)

@@ -31,8 +31,12 @@ CONFIGS = {
 }
 
 
-def run(config: str, quantized, batch: int, steps: int,
-        prompt_len: int, max_len: int, engine: bool = False):
+def build_model_and_params(config: str, max_len: int, quantized):
+    """Decode model + benchmark-posture params (random weights built
+    DIRECTLY in the serving layout) for a named config.  The ONE
+    construction recipe shared by this benchmark and the HTTP server
+    (workloads/server.py) — a real deployment swaps the random params
+    for a checkpoint restored via workloads.checkpoint."""
     cfg = CONFIGS[config]
     model = llama.decoder(cfg, max_len=max_len, quantized=quantized)
     if quantized == "int4":
@@ -45,6 +49,22 @@ def run(config: str, quantized, batch: int, steps: int,
         tokens = jnp.zeros((1, 8), jnp.int32)
         pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
         params = train.init(jax.random.PRNGKey(0), tokens, pos)["params"]
+    return cfg, model, params
+
+
+def run(config: str, quantized, batch: int, steps: int,
+        prompt_len: int, max_len: int, engine: bool = False):
+    # fail fast for library callers too, not just the CLI: engine mode
+    # consumes (warmup + rounds) run_scan windows of cache headroom,
+    # and a mid-benchmark ValueError from run_scan is a worse place to
+    # learn that than here
+    scans = (_ENGINE_WARMUP + _ENGINE_ROUNDS) if engine else 1
+    if prompt_len + steps * scans > max_len:
+        raise ValueError(
+            f"prompt_len {prompt_len} + {scans} decode windows of "
+            f"{steps} steps exceed max_len {max_len}")
+    cfg, model, params = build_model_and_params(
+        config, max_len, quantized)
     prompt = jax.random.randint(
         jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab)
     if engine:
@@ -57,7 +77,7 @@ def run(config: str, quantized, batch: int, steps: int,
 
 
 # scans the engine benchmark actually runs: 1 warmup + the timed rounds
-# (main()'s headroom guard derives from these — keep them in sync)
+# (run()'s and main()'s headroom guards derive from these — in sync)
 _ENGINE_WARMUP = 1
 _ENGINE_ROUNDS = 3
 

@@ -19,10 +19,12 @@ JAX checkpoints on TPU pods:
   garbage collection, so a rescheduled pod resumes from wherever its
   predecessor died.
 
-Resume-equivalence is oracle-tested: train k steps, checkpoint,
-restore into a fresh process-alike state, continue — the loss
-trajectory must match the uninterrupted run exactly
-(tests/test_checkpoint.py).
+Resume-equivalence is oracle-tested ACROSS processes: one interpreter
+trains, checkpoints, and is SIGKILLed (no cleanup — a preempted pod);
+a fresh interpreter restores and continues, and the loss trajectory
+must match the uninterrupted run exactly.  Restore onto a different
+mesh shape than the save ran on is exercised too
+(tests/test_checkpoint.py, tests/ckpt_worker.py).
 """
 
 from __future__ import annotations

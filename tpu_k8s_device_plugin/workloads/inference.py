@@ -115,7 +115,12 @@ class Quant4Dense(nn.Dense):
     the scales vary along the contraction dim they cannot move to the
     dot output; the matmul runs as a per-group batched einsum with the
     group scales applied to the per-group partial sums — weights still
-    stream as int8 bytes."""
+    stream as int8 bytes PROVIDED XLA fuses the nibble unpack into the
+    einsum's operand load instead of materializing the [D, F] bf16
+    kernel (numerics are oracle-tested either way; the bandwidth win
+    is a fusion property that must be confirmed from the measured
+    bytes/token on real TPU — see BASELINE.md's int4 measurement
+    backlog)."""
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:

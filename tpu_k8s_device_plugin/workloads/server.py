@@ -1,0 +1,403 @@
+"""HTTP front door for the native continuous-batching engine.
+
+The reference's serving example exists to be CALLED — a vLLM Deployment
+plus Service with a documented curl smoke test
+(/root/reference/example/vllm-serve/service.yaml:1,
+/root/reference/README.md:144-156).  This module is the native
+counterpart's admission surface: a stdlib HTTP server in front of
+``serving.ServingEngine`` that streams tokens per request while the
+engine keeps all slots decoding.
+
+Design: ONE scheduler thread owns the engine (admission, decode,
+harvest — the engine is not thread-safe and never needs to be); HTTP
+handler threads only enqueue requests and drain per-request event
+queues.  Decode runs as ``run_scan`` windows (one compiled scan per
+window, no per-token host round-trip), with admission interleaved
+between windows so a request arriving mid-generation lands in a free
+slot without disturbing running streams — continuous batching over the
+wire, not just in a benchmark loop.
+
+API (JSON over HTTP/1.1):
+
+  POST /generate   {"tokens": [int...], "max_new_tokens": N?,
+                    "temperature": f?, "top_k": k?, "top_p": p?,
+                    "adapter": a?, "stream": true?}
+                   stream=true (default): chunked body, one JSON line
+                   per event — {"token": t} ... then
+                   {"done": true, "tokens": [...], "finish_reason": r}
+                   stream=false: single JSON body (the final event).
+  GET  /healthz    liveness ("ok").
+  GET  /stats      engine + server counters (JSON).
+
+Token ids in, token ids out: tokenization is the caller's business
+(the k8s example mounts a tokenizer next to the client), and the
+engine's contract stays exact and model-agnostic.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import queue
+import threading
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from .serving import ServingEngine
+
+log = logging.getLogger(__name__)
+
+# scheduler knobs: a window is one compiled run_scan; shorter windows
+# lower time-to-first-token for requests waiting in the admission
+# queue, longer ones amortize host round-trips harder
+DEFAULT_WINDOW = 8
+_IDLE_POLL_S = 0.05
+
+
+@dataclass
+class _Request:
+    tokens: List[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: float = 1.0
+    adapter: Optional[int] = None
+    events: "queue.Queue" = field(default_factory=queue.Queue)
+    cancelled: bool = False
+    emitted: int = 0
+
+
+class EngineServer:
+    """Scheduler + HTTP surface around one ServingEngine.
+
+    >>> srv = EngineServer(engine, max_new_tokens=64).start(port=0)
+    >>> # curl -N -d '{"tokens":[1,2,3]}' http://host:port/generate
+    >>> srv.stop()
+    """
+
+    def __init__(self, engine: ServingEngine,
+                 max_new_tokens: int = 64,
+                 window: int = DEFAULT_WINDOW):
+        if engine.max_new_tokens is not None:
+            raise ValueError(
+                "pass per-request budgets to EngineServer, not the "
+                "engine: an engine-wide max_new_tokens would retire "
+                "slots behind the scheduler's back at the wrong budget")
+        if window < 1:
+            raise ValueError("window must be >= 1")
+        self.engine = engine
+        self.default_max_new = max_new_tokens
+        self.window = window
+        self._pending: "queue.Queue[_Request]" = queue.Queue()
+        self._work = threading.Event()    # set on every enqueue
+        self._running: dict = {}          # slot -> _Request
+        self._stop = threading.Event()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._scheduler: Optional[threading.Thread] = None
+        self._requests_served = 0
+        self._requests_rejected = 0
+
+    # -- scheduler (sole owner of the engine) -------------------------------
+
+    def _admit_pending(self) -> None:
+        eng = self.engine
+        while eng.free_slots():
+            try:
+                req = self._pending.get_nowait()
+            except queue.Empty:
+                return
+            if req.cancelled:
+                continue
+            budget = req.max_new_tokens
+            try:
+                # cap the admission budget so prompt + generation fits
+                # the cache; the per-request budget still applies
+                if len(req.tokens) + budget > eng.model.max_len:
+                    budget = eng.model.max_len - len(req.tokens)
+                    if budget < 1:
+                        raise ValueError(
+                            f"prompt ({len(req.tokens)} tokens) leaves "
+                            f"no room to generate within max_len "
+                            f"{eng.model.max_len}")
+                slot = eng.admit(
+                    req.tokens, temperature=req.temperature,
+                    top_k=req.top_k, top_p=req.top_p,
+                    adapter=req.adapter)
+            except (ValueError, RuntimeError) as e:
+                self._requests_rejected += 1
+                req.events.put({"error": str(e), "code": 400})
+                continue
+            req.max_new_tokens = budget
+            self._running[slot] = req
+            # the admit's first sampled token streams immediately
+            self._emit(slot, req, eng.output(slot))
+
+    def _emit(self, slot: int, req: _Request, tokens: List[int]) -> None:
+        """Push tokens the request hasn't seen yet, honoring its budget
+        and retiring the slot when done."""
+        eng = self.engine
+        new = tokens[req.emitted:req.max_new_tokens]
+        for t in new:
+            req.events.put({"token": int(t)})
+        req.emitted += len(new)
+        finished = eng.finished(slot)
+        if req.cancelled:
+            eng.release(slot)
+            del self._running[slot]
+            return
+        if req.emitted >= req.max_new_tokens or finished:
+            if finished:
+                out = eng.output(slot)[:req.max_new_tokens]
+                reason = ("eos" if eng.eos_id is not None
+                          and out and out[-1] == eng.eos_id else "length")
+            else:
+                out = eng.output(slot)[:req.max_new_tokens]
+                reason = "length"
+                eng.release(slot)
+            req.events.put({
+                "done": True,
+                "tokens": [int(t) for t in out],
+                "finish_reason": reason,
+            })
+            del self._running[slot]
+            self._requests_served += 1
+
+    def _scheduler_loop(self) -> None:
+        eng = self.engine
+        while not self._stop.is_set():
+            self._admit_pending()
+            if not self._running:
+                # idle: wait for work without spinning (FIFO order is
+                # preserved — requests stay in the queue)
+                self._work.wait(timeout=_IDLE_POLL_S)
+                self._work.clear()
+                continue
+            # drop requests whose client went away
+            for slot, req in list(self._running.items()):
+                if req.cancelled:
+                    eng.release(slot)
+                    del self._running[slot]
+            if not self._running:
+                continue
+            headroom = min(
+                eng.model.max_len - eng.lens[s] for s in self._running
+            )
+            window = min(self.window, headroom)
+            if window < 1:
+                # a slot ran out of cache: one step() retires it
+                eng.step()
+            else:
+                eng.run_scan(window)
+            for slot, req in list(self._running.items()):
+                self._emit(slot, req, eng.output(slot))
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self, host: str = "0.0.0.0", port: int = 8000
+              ) -> "EngineServer":
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path == "/healthz":
+                    self._send(200, "text/plain", "ok\n")
+                elif self.path == "/stats":
+                    body = json.dumps(server.stats(), indent=2)
+                    self._send(200, "application/json", body + "\n")
+                else:
+                    self._send(404, "text/plain", "not found\n")
+
+            def do_POST(self):  # noqa: N802
+                if self.path != "/generate":
+                    self._send(404, "text/plain", "not found\n")
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(length))
+                    req = server._parse_request(body)
+                except (ValueError, TypeError, KeyError) as e:
+                    self._send(400, "application/json",
+                               json.dumps({"error": str(e)}) + "\n")
+                    return
+                stream = bool(body.get("stream", True))
+                server._pending.put(req)
+                server._work.set()
+                try:
+                    if stream:
+                        self._stream(req)
+                    else:
+                        self._collect(req)
+                except (BrokenPipeError, ConnectionResetError):
+                    req.cancelled = True
+
+            def _stream(self, req: _Request):
+                # wait for the FIRST event before sending headers: an
+                # admission-time rejection must surface as a real 4xx,
+                # not an in-band error line on a 200 (status-checking
+                # clients — curl -f, k8s probes — would see success)
+                first = req.events.get()
+                if "error" in first:
+                    self._send(first.get("code", 400),
+                               "application/json",
+                               json.dumps(first) + "\n")
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/jsonlines")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                ev = first
+                while True:
+                    self._chunk(json.dumps(ev) + "\n")
+                    if "done" in ev or "error" in ev:
+                        break
+                    ev = req.events.get()
+                self._chunk("")  # terminating 0-length chunk
+
+            def _collect(self, req: _Request):
+                while True:
+                    ev = req.events.get()
+                    if "error" in ev:
+                        self._send(ev.get("code", 400),
+                                   "application/json",
+                                   json.dumps(ev) + "\n")
+                        return
+                    if "done" in ev:
+                        self._send(200, "application/json",
+                                   json.dumps(ev) + "\n")
+                        return
+
+            def _chunk(self, text: str):
+                data = text.encode()
+                self.wfile.write(f"{len(data):x}\r\n".encode()
+                                 + data + b"\r\n")
+                self.wfile.flush()
+
+            def _send(self, code, ctype, body: str):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, fmt, *args):
+                log.debug("serve-http: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        threading.Thread(target=self._httpd.serve_forever,
+                         name="serve-http", daemon=True).start()
+        self._scheduler = threading.Thread(
+            target=self._scheduler_loop, name="engine-scheduler",
+            daemon=True)
+        self._scheduler.start()
+        log.info("serving engine on http://%s:%d", host, self.port)
+        return self
+
+    @property
+    def port(self) -> int:
+        """Actual bound port (differs from the requested one for 0)."""
+        return self._httpd.server_address[1] if self._httpd else 0
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._scheduler is not None:
+            self._scheduler.join(timeout=5)
+            self._scheduler = None
+        # unblock every connected client: handler threads sit in
+        # req.events.get(), and ThreadingHTTPServer.shutdown() only
+        # stops the ACCEPT loop — without a terminal event they would
+        # hang until their socket timeout
+        bye = {"error": "server shutting down", "code": 503}
+        for req in self._running.values():
+            req.events.put(dict(bye))
+        self._running.clear()
+        while True:
+            try:
+                self._pending.get_nowait().events.put(dict(bye))
+            except queue.Empty:
+                break
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    # -- request plumbing ---------------------------------------------------
+
+    def _parse_request(self, body: dict) -> _Request:
+        tokens = body.get("tokens")
+        if (not isinstance(tokens, list) or not tokens
+                or not all(isinstance(t, int) for t in tokens)):
+            raise ValueError("'tokens' must be a non-empty int list")
+        max_new = int(body.get("max_new_tokens", self.default_max_new))
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        top_k = body.get("top_k")
+        adapter = body.get("adapter")
+        return _Request(
+            tokens=tokens,
+            max_new_tokens=max_new,
+            temperature=float(body.get("temperature", 0.0)),
+            top_k=None if top_k is None else int(top_k),
+            top_p=float(body.get("top_p", 1.0)),
+            adapter=None if adapter is None else int(adapter),
+        )
+
+    def stats(self) -> dict:
+        st = dict(self.engine.stats())
+        st.update({
+            "pending_requests": self._pending.qsize(),
+            "running_requests": len(self._running),
+            "requests_served": self._requests_served,
+            "requests_rejected": self._requests_rejected,
+            "window": self.window,
+        })
+        return st
+
+
+def main(argv=None) -> int:
+    """CLI: build a Llama-family engine and serve it.  The k8s example
+    (example/native-serve/deployment.yaml) runs exactly this."""
+    from .bench_serving import CONFIGS, build_model_and_params
+
+    p = argparse.ArgumentParser(prog="tpu-serve")
+    p.add_argument("--config", choices=sorted(CONFIGS), default="tiny")
+    p.add_argument("--quantized", action="store_true",
+                   help="weight-only int8")
+    p.add_argument("--int4", action="store_true",
+                   help="weight-only int4")
+    p.add_argument("--n-slots", type=int, default=8)
+    p.add_argument("--max-len", type=int, default=2048)
+    p.add_argument("--max-new-tokens", type=int, default=256,
+                   help="default per-request budget")
+    p.add_argument("--window", type=int, default=DEFAULT_WINDOW)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    args = p.parse_args(argv)
+    if args.int4 and args.quantized:
+        p.error("--quantized and --int4 are mutually exclusive")
+
+    quantized = "int4" if args.int4 else args.quantized
+    cfg, model, params = build_model_and_params(
+        args.config, args.max_len, quantized)
+    engine = ServingEngine(model, params, n_slots=args.n_slots,
+                           eos_id=getattr(cfg, "eos_id", None))
+    srv = EngineServer(engine, max_new_tokens=args.max_new_tokens,
+                       window=args.window)
+    srv.start(host=args.host, port=args.port)
+    print(f"serving {args.config} (quantized={quantized}) on "
+          f"http://{args.host}:{srv.port}  "
+          f"[POST /generate, GET /healthz, GET /stats]", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -38,7 +38,7 @@ inference.py; this module is the scheduler around it.
 from __future__ import annotations
 
 import functools
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -51,6 +51,27 @@ from .inference import (
     init_cache,
     validate_top_k,
 )
+
+# Upper bound for the auto-selected prefill chunk.  128 rides the MXU
+# tile (128 lanes) and keeps peak prefill attention memory at
+# O(128 · T_max) regardless of prompt length; the resolved chunk is
+# always a divisor of max_len so padded admission can never overflow
+# the cache (see _resolve_chunk).
+DEFAULT_CHUNK = 128
+
+
+def _resolve_chunk(max_len: int) -> Optional[int]:
+    """Pick the admission chunk for ``chunk="auto"``: the largest
+    divisor of *max_len* that is <= min(128, max_len // 2).  A divisor
+    guarantees ceil(t_p / c) * c <= max_len, so a prompt that passes
+    the budget check is never rejected by chunk padding; the
+    max_len // 2 cap leaves room for suffix extends after an unaligned
+    explicit prefix.  Falls back to None (per-length compiles) for
+    pathological max_len with no divisor >= 8."""
+    c = min(DEFAULT_CHUNK, max(1, max_len // 2))
+    while c > 1 and max_len % c:
+        c -= 1
+    return c if c >= 8 else None
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -79,6 +100,45 @@ def _set_len(cache, slot, value):
         out[layer] = dict(buf)
         out[layer]["cache_lens"] = buf["cache_lens"].at[slot].set(value)
     return out
+
+
+@jax.jit
+def _slot_to_mini(cache, slot):
+    """Copy row *slot* of the engine cache out as a B=1 mini cache
+    (the inverse of _splice_slot's write).  NOT donated — the engine
+    cache must survive; this is the data movement that makes a
+    resident slot's prompt K/V reusable as an automatic prefix."""
+    out = {}
+    for layer, buf in cache.items():
+        _, T, H, D = buf["cached_k"].shape
+        out[layer] = {
+            "cached_k": lax.dynamic_slice(
+                buf["cached_k"], (slot, 0, 0, 0), (1, T, H, D)),
+            "cached_v": lax.dynamic_slice(
+                buf["cached_v"], (slot, 0, 0, 0), (1, T, H, D)),
+            "cache_lens": lax.dynamic_slice(
+                buf["cache_lens"], (slot,), (1,)),
+        }
+    return out
+
+
+def _lcp(a: np.ndarray, b: np.ndarray) -> int:
+    """Longest common prefix of two int token arrays."""
+    L = min(len(a), len(b))
+    if L == 0:
+        return 0
+    neq = a[:L] != b[:L]
+    idx = int(np.argmax(neq))
+    return L if not neq[idx] else idx
+
+
+def _knobs_live(temps, topks, topps) -> bool:
+    """True when any slot's sampling knobs are armed.  THE predicate
+    the engine's key-stream accounting hangs on: _sample's greedy fast
+    path, run_scan's sampled flag, and its per-step draw count must
+    all agree, or step() and run_scan() leave different draw counters
+    behind (the streams would diverge after a retirement)."""
+    return bool(temps.any() or topks.any() or (np.asarray(topps) < 1.0).any())
 
 
 @jax.jit
@@ -172,13 +232,24 @@ class ServingEngine:
         params,
         n_slots: int,
         eos_id: Optional[int] = None,
-        chunk: Optional[int] = None,
+        chunk: Union[int, None, str] = "auto",
         max_new_tokens: Optional[int] = None,
         mesh=None,
         rng: Optional[jax.Array] = None,
+        auto_prefix: bool = True,
+        auto_prefix_min: int = 8,
     ):
         if n_slots < 1:
             raise ValueError("n_slots must be >= 1")
+        if chunk == "auto":
+            # compile-safe default: every admission reuses ONE compiled
+            # extend shape no matter how many distinct prompt lengths
+            # arrive (real traffic has hundreds; per-length compiles
+            # are a trap outside benchmarks)
+            chunk = _resolve_chunk(model.max_len)
+        elif isinstance(chunk, str):
+            raise ValueError(f"chunk must be an int, None, or 'auto', "
+                             f"got {chunk!r}")
         if chunk is not None and chunk < 1:
             raise ValueError("chunk must be >= 1 when set")
         self.model = model
@@ -221,6 +292,22 @@ class ServingEngine:
         self._finished: Dict[int, List[int]] = {}
         self._prefixes: Dict[int, tuple] = {}
         self._next_prefix = 0
+        # automatic prefix caching (vLLM's APC, the feature the
+        # reference's serving image ships by default): match new
+        # prompts against resident slot prompts and the registry at
+        # CHUNK granularity — reused rows sit on the same chunk grid
+        # the cold path would prefill, so outputs stay bit-identical.
+        # Unchunked engines disable it (no grid to stay exact on).
+        self.auto_prefix = bool(auto_prefix) and chunk is not None
+        self.auto_prefix_min = auto_prefix_min
+        # per-slot resident prompt: (tokens, adapter, canon) where
+        # canon is the prefix length up to which the slot's cache rows
+        # lie on the chunk grid (decode appends never touch them)
+        self._slot_prompts: List[Optional[Tuple[np.ndarray, int, int]]] \
+            = [None] * n_slots
+        self._prefill_tokens = 0
+        self._prefix_hits = 0
+        self._prefix_reused_tokens = 0
         # sampling: per-slot temperature (0 = greedy) and top-k (0 =
         # unrestricted), set at admit; one key stream for the engine
         self._rng = jax.random.PRNGKey(0) if rng is None else rng
@@ -263,6 +350,7 @@ class ServingEngine:
         n = int(toks.shape[1])
         aid = self._adapter_vec(adapter)
         if self.chunk is None:
+            self._prefill_tokens += n
             # one compiled extend per distinct prompt length — fine for
             # benchmarks/tests; set ``chunk`` to pin admission to a
             # single compiled shape
@@ -281,7 +369,8 @@ class ServingEngine:
                 f"{self.model.max_len} (shrink chunk or prompt)")
         toks = jnp.concatenate(
             [toks, jnp.zeros((1, padded - n), jnp.int32)], axis=1)
-        last = None
+        self._prefill_tokens += n  # after the overflow check: rejected
+        last = None                # extends never prefilled anything
         for i in range(padded // c):
             chunk_toks = toks[:, i * c:(i + 1) * c]
             pos = (
@@ -312,6 +401,40 @@ class ServingEngine:
                 f"adapter {adapter} outside [0, "
                 f"{self.model.n_adapters})")
         return adapter
+
+    def _auto_match(self, pnp: np.ndarray, t_p: int, aid: int):
+        """Find the best automatic prefix donor for *prompt*: the
+        registry entry or resident slot prompt sharing the longest
+        common prefix, measured in whole chunks (reuse stays on the
+        chunk grid, so reused K/V is bit-identical to what cold
+        chunked admission would compute).  The match is capped at
+        t_p - 1 — the last prompt token always recomputes so admission
+        has its logits row (same rule as vLLM's APC).  Returns
+        (kind, ref, m) or None; donors are adapter-bound (the adapter
+        shapes the K/V)."""
+        if not self.auto_prefix:
+            return None
+        c = self.chunk
+        best = None
+        best_m = 0
+        for h, (ptoks, _pc, _pl, paid) in self._prefixes.items():
+            if paid != aid:
+                continue
+            m = (min(_lcp(pnp, ptoks), t_p - 1) // c) * c
+            if m > best_m:
+                best_m, best = m, ("reg", h, m)
+        for s, rec in enumerate(self._slot_prompts):
+            if rec is None:
+                continue
+            stoks, said, canon = rec
+            if said != aid:
+                continue
+            m = (min(_lcp(pnp, stoks), canon, t_p - 1) // c) * c
+            if m > best_m:
+                best_m, best = m, ("slot", s, m)
+        if best_m < max(1, self.auto_prefix_min):
+            return None
+        return best
 
     def register_prefix(self, tokens, adapter: Optional[int] = None) -> int:
         """Prefill a shared prompt prefix (e.g. a system prompt) ONCE
@@ -349,9 +472,18 @@ class ServingEngine:
         With ``prefix`` (a :meth:`register_prefix` handle), the prompt
         must start with the registered tokens and only the suffix is
         prefilled — the prefix K/V is copied from the registry.
-        ``temperature``/``top_k`` select this request's sampling
-        (0 / None = greedy) — per-slot data, never a recompile."""
-        prompt = jnp.asarray(prompt, jnp.int32).reshape(1, -1)
+        Without a handle, automatic prefix caching (on by default for
+        chunked engines) matches the prompt against resident slot
+        prompts and the registry at chunk granularity and prefills
+        only the unmatched tail — reused rows lie on the same chunk
+        grid cold admission would compute, so tokens stay
+        bit-identical.  ``temperature``/``top_k`` select this
+        request's sampling (0 / None = greedy) — per-slot data, never
+        a recompile."""
+        # ONE host-side copy serves validation, auto-matching, and the
+        # resident-prompt record; the device transfer happens once here
+        prompt_np = np.asarray(prompt, np.int32).reshape(1, -1)
+        prompt = jnp.asarray(prompt_np)
         t_p = int(prompt.shape[1])
         if t_p < 1:
             raise ValueError("empty prompt")
@@ -378,8 +510,7 @@ class ServingEngine:
                 raise ValueError(f"unknown prefix handle {prefix}")
             ptoks, pcache, plast, paid = self._prefixes[prefix]
             L = len(ptoks)
-            if t_p < L or not np.array_equal(
-                    np.asarray(prompt[0, :L]), ptoks):
+            if t_p < L or not np.array_equal(prompt_np[0, :L], ptoks):
                 raise ValueError(
                     "prompt does not start with the registered prefix")
             if paid != aid:
@@ -389,7 +520,9 @@ class ServingEngine:
                     "prefix K/V, register one per adapter")
             start, n = L, t_p - L
         else:
-            start, n = 0, t_p
+            auto_src = self._auto_match(prompt_np[0], t_p, aid)
+            start = auto_src[2] if auto_src is not None else 0
+            n = t_p - start
         if self.chunk is not None and n > 0:
             padded = ((n + self.chunk - 1) // self.chunk) * self.chunk
             if start + padded > self.model.max_len:
@@ -413,12 +546,37 @@ class ServingEngine:
                 # does not donate its mini argument, so the registry
                 # cache splices directly — no copy
                 mini, last = pcache, plast
+        elif auto_src is not None:
+            kind, ref, m = auto_src
+            if kind == "reg":
+                # registry entries must survive — copy before donating
+                src = jax.tree_util.tree_map(
+                    jnp.copy, self._prefixes[ref][1])
+            else:
+                src = self._place_cache(
+                    _slot_to_mini(self.cache, jnp.int32(ref)))
+            # rows beyond m are stale donor data masked out by the
+            # cache_lens reset; the suffix extend overwrites [m, ...)
+            mini = _set_len(src, jnp.int32(0), jnp.int32(m))
+            mini, last = self._extend_prompt(
+                mini, prompt[:, m:], start=m, adapter=aid)
+            self._prefix_hits += 1
+            self._prefix_reused_tokens += m
         else:
             mini = self._place_cache(init_cache(self.model, 1))
             mini, last = self._extend_prompt(mini, prompt, start=0,
                                              adapter=aid)
 
         self.cache = _splice_slot(self.cache, mini, jnp.int32(slot))
+        # explicit-prefix admits with an unaligned prefix leave the
+        # suffix rows off the chunk grid — only the prefix part is
+        # reusable by future automatic matches
+        if (self.chunk is not None and prefix is not None
+                and L % self.chunk):
+            canon = L
+        else:
+            canon = t_p
+        self._slot_prompts[slot] = (prompt_np[0], aid, canon)
         self.lens[slot] = t_p
         self.active[slot] = True
         self.temps[slot] = temperature
@@ -436,7 +594,7 @@ class ServingEngine:
         return slot
 
     def _sample(self, logits, temps, topks, topps):
-        if not temps.any() and not topks.any() and (topps >= 1.0).all():
+        if not _knobs_live(temps, topks, topps):
             # all-greedy batch (the default): plain argmax — no vocab
             # sort, no Gumbel draw, and the key stream stays untouched
             # so adding a sampled request never shifts greedy outputs
@@ -511,8 +669,7 @@ class ServingEngine:
                 raise ValueError(
                     f"slot {s} has {self.model.max_len - self.lens[s]} "
                     f"cache rows left, need {n_steps}")
-        sampled = bool(self.temps.any() or self.topks.any()
-                       or (self.topps < 1.0).any())
+        sampled = _knobs_live(self.temps, self.topks, self.topps)
         aids = (jnp.asarray(self.adapters)
                 if self.model.n_adapters > 0 else None)
         toks, self.cache = _scan_decode(
@@ -523,13 +680,21 @@ class ServingEngine:
             jnp.int32(self._draws),
         )
         toks = np.asarray(toks, dtype=np.int32)  # [n_steps, S]
-        if sampled:
-            self._draws += n_steps
         self._steps += n_steps
         out: Dict[int, List[int]] = {
             s: [] for s in range(self.n_slots) if self.active[s]
         }
+        draws_used = 0
         for i in range(n_steps):
+            # mirror step()'s draw accounting: a draw is consumed only
+            # while some sampled slot is still live (retirement resets
+            # its knobs, re-arming the greedy fast path), so the key
+            # stream a later admission sees is identical whichever
+            # scheduling API ran this window — the scan's keys for
+            # post-retirement steps produced only discarded tokens
+            if sampled and _knobs_live(self.temps, self.topks,
+                                       self.topps):
+                draws_used += 1
             for s in range(self.n_slots):
                 self.lens[s] += 1
                 if not self.active[s]:
@@ -540,6 +705,7 @@ class ServingEngine:
                 self._tokens += 1
                 out[s].append(tok)
                 self._maybe_finish(s, tok)
+        self._draws += draws_used
         # lens advanced n_steps per slot in-device; the loop above
         # advanced the host mirror the same amount
         return out
@@ -578,6 +744,9 @@ class ServingEngine:
             "registered_prefixes": len(self._prefixes),
             "tokens_emitted": self._tokens,
             "decode_steps": self._steps,
+            "prefill_tokens": self._prefill_tokens,
+            "prefix_cache_hits": self._prefix_hits,
+            "prefix_reused_tokens": self._prefix_reused_tokens,
         }
 
     def release(self, slot: int) -> None:

@@ -162,7 +162,8 @@ def speculative_generate(
         # commit: both caches advance past out[-1]'s predecessors —
         # the target cache holds length + g + 1 appended rows, of which
         # (1 + n_acc) are committed (first + accepted proposals); the
-        # draft holds length + g, same commit point
+        # draft also holds length + g + 1 (the scan's g appends plus
+        # the final logit-discarded extend), same commit point
         length += 1 + n_acc
         t_cache = _rollback(t_cache, length)
         d_cache = _rollback(d_cache, length)
