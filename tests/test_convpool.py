@@ -1,0 +1,136 @@
+"""Fused conv+pool ("flash-conv"): forward and gradients must match
+the unfused ``conv → max_pool`` pipeline, tie-breaks included, and the
+AlexNet pool="fused" wiring must reproduce the pool="xla" model."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+from tpu_k8s_device_plugin.workloads.alexnet import (
+    AlexNet,
+    loss_fn,
+    space_to_depth,
+)
+from tpu_k8s_device_plugin.workloads.convpool import conv_pool
+
+
+def _ref(x, k):
+    y = lax.conv_general_dilated(
+        x, k, (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return nn.max_pool(y, (3, 3), (2, 2))
+
+
+@pytest.mark.parametrize("window,shape,feat", [
+    (3, (4, 8, 8, 6), 8),    # even spatial, oh=3 -> pool_rows 3
+    (5, (2, 9, 9, 4), 8),    # odd spatial + the 5x5 window
+    (3, (2, 7, 7, 4), 6),    # oh=3 with odd input
+])
+def test_matches_unfused_fwd_and_grad(window, shape, feat):
+    x = jax.random.normal(jax.random.PRNGKey(0), shape, jnp.float32)
+    k = jax.random.normal(
+        jax.random.PRNGKey(1), (window, window, shape[-1], feat),
+        jnp.float32) * 0.2
+    np.testing.assert_allclose(
+        np.asarray(_ref(x, k)), np.asarray(conv_pool(x, k)),
+        rtol=1e-5, atol=1e-5)
+    gw = jax.grad(lambda x_, k_: (_ref(x_, k_) ** 2).sum(),
+                  argnums=(0, 1))(x, k)
+    gg = jax.grad(lambda x_, k_: (conv_pool(x_, k_) ** 2).sum(),
+                  argnums=(0, 1))(x, k)
+    for a, b in zip(gw, gg):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_tie_break_matches_select_and_scatter():
+    # constant input patches force exact ties in every pool window; the
+    # gradient then depends entirely on the argmax tie-break, which
+    # must match XLA's first-offset-in-row-major rule
+    x = jnp.ones((2, 8, 8, 4), jnp.float32)
+    k = jnp.ones((3, 3, 4, 6), jnp.float32) * 0.1
+    gw = jax.grad(lambda x_: _ref(x_, k).sum())(x)
+    gg = jax.grad(lambda x_: conv_pool(x_, k).sum())(x)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(gg),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_bf16_path():
+    x = jax.random.normal(
+        jax.random.PRNGKey(2), (2, 8, 8, 4)).astype(jnp.bfloat16)
+    k = (jax.random.normal(jax.random.PRNGKey(3), (3, 3, 4, 8)) * 0.2
+         ).astype(jnp.bfloat16)
+    want = _ref(x, k).astype(jnp.float32)
+    got = conv_pool(x, k).astype(jnp.float32)
+    # bf16 conv accumulation order differs between XLA's conv and the
+    # tap-packed matmul; both accumulate in f32 so the pooled outputs
+    # agree to bf16 resolution
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_bad_kernel_shapes_rejected():
+    x = jnp.zeros((2, 8, 8, 4), jnp.float32)
+    with pytest.raises(ValueError, match="odd-square"):
+        conv_pool(x, jnp.zeros((2, 2, 4, 8), jnp.float32))
+    with pytest.raises(ValueError, match="odd-square"):
+        conv_pool(x, jnp.zeros((3, 3, 5, 8), jnp.float32))
+
+
+def _remap_params(xla_params):
+    """pool='fused' swaps stages 1/2/5 to FusedConvPool modules: map
+    the xla-model tree onto the fused-model tree (same tensors)."""
+    p = xla_params
+    return {
+        "FusedConvPool_0": p["Conv_0"],
+        "FusedConvPool_1": p["Conv_1"],
+        "Conv_0": p["Conv_2"],
+        "Conv_1": p["Conv_3"],
+        "FusedConvPool_2": p["Conv_4"],
+        "Dense_0": p["Dense_0"],
+        "Dense_1": p["Dense_1"],
+        "Dense_2": p["Dense_2"],
+    }
+
+
+def test_alexnet_fused_matches_xla():
+    # full-model equivalence at a reduced image size (64 -> s2d 16x16:
+    # stage spatial chain 16 -> 7 -> 3 -> 1, all three pools fused)
+    rng = jax.random.PRNGKey(0)
+    img = jax.random.normal(rng, (2, 64, 64, 3), jnp.float32)
+    x = space_to_depth(img)
+    labels = jnp.asarray([3, 7])
+    ref_model = AlexNet(num_classes=10, s2d=True, pool="xla",
+                        dtype=jnp.float32)
+    params = ref_model.init(rng, x, train=False)["params"]
+    fused_model = AlexNet(num_classes=10, s2d=True, pool="fused",
+                          dtype=jnp.float32)
+    fparams = _remap_params(params)
+    want = ref_model.apply({"params": params}, x, train=False)
+    got = fused_model.apply({"params": fparams}, x, train=False)
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                               rtol=1e-4, atol=1e-4)
+    gw = jax.grad(lambda p: loss_fn(ref_model, p, x, labels))(params)
+    gg = jax.grad(lambda p: loss_fn(fused_model, p, x, labels))(fparams)
+    for ref_name, fused_name in (
+            ("Conv_0", "FusedConvPool_0"),
+            ("Conv_1", "FusedConvPool_1"),
+            ("Conv_4", "FusedConvPool_2"),
+            ("Dense_0", "Dense_0")):
+        for leaf in ("kernel", "bias"):
+            np.testing.assert_allclose(
+                np.asarray(gw[ref_name][leaf]),
+                np.asarray(gg[fused_name][leaf]),
+                rtol=2e-3, atol=2e-3,
+                err_msg=f"{ref_name}->{fused_name}.{leaf}")
+
+
+def test_alexnet_fused_requires_s2d():
+    model = AlexNet(num_classes=10, s2d=False, pool="fused",
+                    dtype=jnp.float32)
+    with pytest.raises(ValueError, match="s2d"):
+        model.init(jax.random.PRNGKey(0),
+                   jnp.zeros((1, 64, 64, 3), jnp.float32), train=False)

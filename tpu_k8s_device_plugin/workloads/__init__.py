@@ -9,6 +9,7 @@ sharded variant that scales over a ``jax.sharding.Mesh``.
 """
 
 from .alexnet import AlexNet, create_train_state, train_step
+from .convpool import conv_pool
 from .flash_attention import flash_attention, flash_causal_attention
 from .inference import (
     DecodeTransformerLM,
@@ -27,6 +28,7 @@ except ImportError:  # pragma: no cover - orbax always in the CI image
 from . import llama
 from .moe import MoEFFN, top_k_routing
 from .pool import max_pool as pallas_max_pool
+from .server import EngineServer
 from .serving import ServingEngine
 from .speculative import speculative_generate
 from .parallel import make_mesh, make_sharded_train_step
@@ -44,8 +46,10 @@ __all__ = [
     "DecodeTransformerLM",
     "MoEFFN",
     "TransformerLM",
+    "conv_pool",
     "create_train_state",
     "decode_throughput",
+    "EngineServer",
     "flash_attention",
     "flash_causal_attention",
     "full_attention",

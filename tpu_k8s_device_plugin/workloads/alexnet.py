@@ -49,6 +49,31 @@ def space_to_depth(x: jax.Array, block: int = S2D_BLOCK) -> jax.Array:
     )
 
 
+class FusedConvPool(nn.Module):
+    """Stride-1 SAME conv + 3x3/s2 max-pool through the fused Pallas
+    kernel (workloads/convpool.py) — the pre-pool activation never
+    reaches HBM.  Param names/initializers match ``nn.Conv`` (f32
+    params, compute-dtype cast at use); the bias is added AFTER the
+    pool, which is exact: a per-channel constant commutes with max,
+    and the scatter backward preserves the gradient sum."""
+
+    features: int
+    window: int
+    dtype: Any = COMPUTE_DTYPE
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        from .convpool import conv_pool
+
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (self.window, self.window, x.shape[-1], self.features))
+        bias = self.param(
+            "bias", nn.initializers.zeros_init(), (self.features,))
+        y = conv_pool(x.astype(self.dtype), kernel.astype(self.dtype))
+        return y + bias.astype(self.dtype)
+
+
 class AlexNet(nn.Module):
     """Canonical 5-conv / 3-dense AlexNet (single-tower).
 
@@ -59,11 +84,16 @@ class AlexNet(nn.Module):
     num_classes: int = NUM_CLASSES
     dtype: Any = COMPUTE_DTYPE
     s2d: bool = False
-    # "xla" = reduce_window/select_and_scatter; "pallas" = the fused
-    # argmax-index kernel (workloads/pool.py) whose backward avoids
-    # select_and_scatter entirely — bit-exact either way (fwd AND grad,
-    # tie-break included; tests/test_pool.py), so this is purely a
-    # performance knob to be set from measurement on the target chip
+    # "xla" = reduce_window/select_and_scatter; "pallas" = the
+    # argmax-index pool kernel (workloads/pool.py) whose backward
+    # avoids select_and_scatter; "fused" = conv+pool in ONE Pallas
+    # kernel (workloads/convpool.py) so the pre-pool activation never
+    # hits HBM (requires s2d — the raw 11×11/s4 first conv is not
+    # stride-1).  All three are numerically equivalent (fwd AND grad,
+    # tie-break included; tests/test_pool.py, tests/test_convpool.py),
+    # so this is a performance knob to be set from measurement on the
+    # target chip.  NOTE: "fused" swaps conv+pool stages to
+    # FusedConvPool modules, which renames those param-tree nodes.
     pool: str = "xla"
 
     def _max_pool(self, x: jax.Array) -> jax.Array:
@@ -73,11 +103,27 @@ class AlexNet(nn.Module):
             return pallas_max_pool(x, 3, 2)
         if self.pool != "xla":
             raise ValueError(
-                f"unknown pool {self.pool!r}: expected 'xla' or 'pallas'")
+                f"unknown pool {self.pool!r}: expected 'xla', "
+                "'pallas', or 'fused'")
         return nn.max_pool(x, window_shape=(3, 3), strides=(2, 2))
+
+    def _conv_pool(self, x: jax.Array, features: int,
+                   window: int) -> jax.Array:
+        """One conv→pool stage, fused or as separate ops."""
+        if self.pool == "fused":
+            return FusedConvPool(features=features, window=window,
+                                 dtype=self.dtype)(x)
+        conv = functools.partial(nn.Conv, dtype=self.dtype,
+                                 padding="SAME")
+        x = conv(features=features, kernel_size=(window, window))(x)
+        return self._max_pool(x)
 
     @nn.compact
     def __call__(self, x: jax.Array, *, train: bool = True) -> jax.Array:
+        if self.pool == "fused" and not self.s2d:
+            raise ValueError(
+                "pool='fused' requires s2d=True (the raw 11x11/s4 "
+                "first conv is not stride-1)")
         conv = functools.partial(nn.Conv, dtype=self.dtype, padding="SAME")
         x = x.astype(self.dtype)
         # Wherever a max-pool follows a relu, pool FIRST: max and relu
@@ -89,20 +135,18 @@ class AlexNet(nn.Module):
         # measured -4.2 ms (seg1) and -2.7 ms (seg2) fwd+bwd at batch
         # 4096 on v5e-1.
         if self.s2d:
-            x = conv(features=64, kernel_size=(3, 3))(x)
+            x = self._conv_pool(x, features=64, window=3)
         else:
             x = conv(features=64, kernel_size=(11, 11), strides=(4, 4))(x)
-        x = self._max_pool(x)
+            x = self._max_pool(x)
         x = nn.relu(x)
-        x = conv(features=192, kernel_size=(5, 5))(x)
-        x = self._max_pool(x)
+        x = self._conv_pool(x, features=192, window=5)
         x = nn.relu(x)
         x = conv(features=384, kernel_size=(3, 3))(x)
         x = nn.relu(x)
         x = conv(features=256, kernel_size=(3, 3))(x)
         x = nn.relu(x)
-        x = conv(features=256, kernel_size=(3, 3))(x)
-        x = self._max_pool(x)
+        x = self._conv_pool(x, features=256, window=3)
         x = nn.relu(x)
         x = x.reshape((x.shape[0], -1))
         x = nn.Dense(4096, dtype=self.dtype)(x)

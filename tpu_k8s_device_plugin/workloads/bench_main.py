@@ -50,7 +50,9 @@ def _resolve_pool(pool):
     """Pool impl knob for A/B runs on the target chip without editing
     code: explicit argument, else ALEXNET_POOL env, else "xla".
     "pallas" routes the max-pools through the Pallas argmax-index
-    kernel (bit-exact either way; see workloads/pool.py)."""
+    kernel (workloads/pool.py); "fused" computes conv+pool in one
+    kernel so the pre-pool activation never hits HBM
+    (workloads/convpool.py).  Numerically equivalent either way."""
     import os
 
     return pool or os.environ.get("ALEXNET_POOL", "xla")
@@ -171,7 +173,8 @@ def main(argv=None) -> int:
     p.add_argument("--warmup", type=int, default=5)
     p.add_argument("--sharded", action="store_true",
                    help="train over a mesh of all visible devices")
-    p.add_argument("--pool", choices=("xla", "pallas"), default=None,
+    p.add_argument("--pool", choices=("xla", "pallas", "fused"),
+                   default=None,
                    help="max-pool impl (default: $ALEXNET_POOL or xla)")
     args = p.parse_args(argv)
     if args.steps < 1:

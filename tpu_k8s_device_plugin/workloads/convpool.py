@@ -1,0 +1,254 @@
+"""Fused conv/s1(SAME) + maxpool3x3/s2(VALID): the "flash-conv".
+
+Handles any odd conv window (AlexNet's pooled stages use 3x3 and 5x5).
+
+Why: the AlexNet conv head is HBM-activation-bound (BASELINE.md's
+segment ablation), and the single largest remaining traffic item after
+pool-before-relu and the Pallas pool is the conv OUTPUT tensor itself —
+written by the conv, read right back by the pool (2 full passes of a
+[B, 56, 56, 64] bf16 tensor per forward).  This kernel computes the
+conv and pools it IN VMEM, writing only the 4x-smaller pooled output
+(plus the int8 argmax index the scatter backward needs).  The pre-pool
+activation never exists in HBM.
+
+Forward mapping (per grid step = one batch lane-block x one block of
+pool rows): the stride-1 conv over C_in channels is one MXU matmul per
+output pixel:
+
+    conv[h, w]  =  K_flat[[F, W^2*C]]  @  patch[[W^2*C, B]]
+
+where ``patch`` stacks the window's input tiles (C, B) along the
+sublane dim — tap-packing turns the 48-deep contraction into a
+432-deep one (3x3) or 1600-deep (5x5), which is what makes the matmul
+MXU-worthy.  Tiles are
+(C sublane, B lane): the native orientation of the batch-minor
+(H, W, C, B) conv activation layout (see pool.py's layout note).  Each
+needed conv row is computed ONCE per block (rolling rows, cast to the
+activation dtype so pooling sees exactly what the unfused pipeline
+pools), then 3x3/s2 windows are maxed in VMEM with pool.py's
+first-match argmax-index rule.
+
+Backward (custom VJP, no second hand kernel): scatter the pooled
+gradient through the index with pool.py's scatter kernel to get the
+conv-output gradient, then let ``jax.vjp`` of XLA's own conv produce
+dx/dK.  The backward still materializes dconv once — fusing the
+backward too is the recorded next step — but the forward saves both
+passes of the pre-pool tensor and select_and_scatter is gone.
+
+Like pool.py: strides/windows static, interpret mode off-TPU, and the
+kernel sticks to constructs proven on Mosaic in this repo (static
+slices, sublane concats, 2D dot_general with f32 accumulation; no
+gathers, no value dynamic_update_slice, no i1 vector algebra).  The
+one new construct is a clamped dynamic ROW index into the x block
+(major, untiled dim) for the SAME-padding halo.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from .pool import (
+    _block_spec,
+    _bpad,
+    _LANES,
+    _out_dim,
+    _pool_bwd_impl,
+    _to_bhwc,
+    _to_hwcb,
+)
+
+try:  # TPU memory spaces; absent on some non-TPU installs
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+POOL_WINDOW = 3          # pool window (VALID)
+POOL_STRIDE = 2
+
+
+def _compiler_params(interpret):
+    if pltpu is None or interpret:
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "arbitrary"),
+        vmem_limit_bytes=100 * 1024 * 1024,
+    )
+
+
+def _fused_kernel(h: int, w: int, window: int, pool_rows: int,
+                  x_ref, k_ref, y_ref, idx_ref):
+    """One grid step: pool rows [pi * pool_rows, ...) for one 128-wide
+    batch block.
+
+    x block:   (h, w, c, B) — full spatial extent (same-block for every
+               pi, so the pipeline keeps it resident per batch block).
+    k block:   (F, 9c) tap-packed flat kernel, resident.
+    y/idx:     (pool_rows, ow, F, B).
+    """
+    pi = pl.program_id(1)
+    kf = k_ref[...]                      # [F, window^2 * C]
+    ow = _out_dim(w, POOL_WINDOW, POOL_STRIDE)
+    pad = window // 2                    # SAME padding offset
+    f32 = jnp.float32
+    dtype = y_ref.dtype
+    zero_tile = jnp.zeros_like(x_ref[0, 0])
+
+    def x_tile(r, cc):
+        """Input tile (C, B) at conv-SAME position (row r, col cc).
+        Columns are static; the row is traced (pi) and clamped, with
+        out-of-range rows zeroed — SAME padding."""
+        if not 0 <= cc < w:
+            return zero_tile
+        rc = jnp.clip(r, 0, h - 1)
+        valid = ((r >= 0) & (r <= h - 1)).astype(x_ref.dtype)
+        return x_ref[rc, cc] * valid
+
+    def conv_row(hh):
+        """Conv output row hh: w tiles of [F, B] in the activation
+        dtype (pooling must see what the unfused conv would emit)."""
+        tiles = []
+        for ww in range(w):
+            parts = []
+            for di in range(window):
+                for dj in range(window):
+                    parts.append(x_tile(hh + di - pad, ww + dj - pad))
+            patch = jnp.concatenate(parts, axis=0)  # [window^2*C, B]
+            acc = lax.dot_general(
+                kf, patch, (((1,), (0,)), ((), ())),
+                preferred_element_type=f32,
+            )
+            tiles.append(acc.astype(dtype))
+        return tiles
+
+    # rolling rows: the block's pool rows need conv rows
+    # [2*p0, 2*p0 + 2*pool_rows], each computed ONCE (adjacent pool
+    # windows share rows; recompute would cost 1.5x the conv FLOPs)
+    p0 = pi * pool_rows
+    rows = [conv_row(2 * p0 + k) for k in range(2 * pool_rows + 1)]
+    one = jnp.ones((), f32)
+    for pr in range(pool_rows):
+        for pw in range(ow):
+            cand = [rows[2 * pr + di][2 * pw + dj]
+                    for di in range(POOL_WINDOW)
+                    for dj in range(POOL_WINDOW)]
+            cf = [t.astype(f32) for t in cand]
+            m = cf[0]
+            for t in cf[1:]:
+                m = jnp.maximum(m, t)
+            # first-match argmax via mask arithmetic (pool.py's rule:
+            # compares in f32 — exact for bf16 inputs — no i1 algebra)
+            idx = jnp.zeros_like(m)
+            found = jnp.zeros_like(m)
+            for k, t in enumerate(cf):
+                hit = (t == m).astype(f32) * (one - found)
+                idx = idx + jnp.full((), k, f32) * hit
+                found = found + hit
+            y_ref[pr, pw] = m.astype(dtype)
+            idx_ref[pr, pw] = idx.astype(jnp.int8)
+
+
+def _pick_pool_rows(oh: int) -> int:
+    """Pool-row block: a small divisor of oh bounds the rolling-row
+    VMEM working set ((2*rows+1) x w x [F, B] tiles) and the unrolled
+    kernel size; 1 always divides."""
+    for cand in (3, 2, 1):
+        if oh % cand == 0:
+            return cand
+    return 1
+
+
+def _fused_fwd_impl(x, kernel, interpret):
+    """x (B, H, W, C) NHWC, kernel (3, 3, C, F) HWIO ->
+    (pooled (B, OH, OW, F) NHWC, idx (OH, OW, F, Bt) kernel-layout)."""
+    b, h, w, c = x.shape
+    window = kernel.shape[0]
+    if kernel.shape[:3] != (window, window, c) or window % 2 != 1:
+        raise ValueError(
+            f"kernel {kernel.shape} must be odd-square x C={c}")
+    feat = kernel.shape[-1]
+    oh = _out_dim(h, POOL_WINDOW, POOL_STRIDE)
+    ow = _out_dim(w, POOL_WINDOW, POOL_STRIDE)
+    bpad = _bpad(b)
+    bt = b + bpad
+    xt = _to_hwcb(x, bpad)  # (H, W, C, Bt)
+    # tap-packed kernel [F, window^2 * C]: tap-major (di, dj),
+    # channel-minor — the same order the kernel concatenates patches
+    kf = kernel.astype(x.dtype).transpose(3, 0, 1, 2).reshape(feat, -1)
+    pool_rows = _pick_pool_rows(oh)
+    grid = (bt // _LANES, oh // pool_rows)
+    y, idx = pl.pallas_call(
+        functools.partial(_fused_kernel, h, w, window, pool_rows),
+        grid=grid,
+        in_specs=[
+            _block_spec((h, w, c, _LANES), lambda bi, pi: (0, 0, 0, bi)),
+            _block_spec((feat, window * window * c),
+                        lambda bi, pi: (0, 0)),
+        ],
+        out_specs=[
+            _block_spec((pool_rows, ow, feat, _LANES),
+                        lambda bi, pi: (pi, 0, 0, bi)),
+            _block_spec((pool_rows, ow, feat, _LANES),
+                        lambda bi, pi: (pi, 0, 0, bi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((oh, ow, feat, bt), x.dtype),
+            jax.ShapeDtypeStruct((oh, ow, feat, bt), jnp.int8),
+        ],
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )(xt, kf)
+    return _to_bhwc(y, b), idx
+
+
+def _resolve(interpret):
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return interpret
+
+
+def _conv_ref(x, kernel):
+    """The unfused conv this kernel replaces (used for its VJP)."""
+    return lax.conv_general_dilated(
+        x, kernel.astype(x.dtype), window_strides=(1, 1),
+        padding="SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def conv_pool(x, kernel, interpret: Optional[bool] = None):
+    """Fused stride-1 SAME conv (odd window) + 3x3/s2 VALID max-pool
+    over NHWC.  Equivalent to
+    ``nn.max_pool(conv(x, kernel), (3, 3), (2, 2))`` with the pre-pool
+    activation never materialized in HBM.  Gradient tie-break matches
+    XLA's select_and_scatter (first window offset in row-major
+    order)."""
+    y, _ = _fused_fwd_impl(x, kernel, _resolve(interpret))
+    return y
+
+
+def _vjp_fwd(x, kernel, interpret):
+    y, idx = _fused_fwd_impl(x, kernel, _resolve(interpret))
+    return y, (x, kernel, idx)
+
+
+def _vjp_bwd(interpret, res, dp):
+    x, kernel, idx = res
+    b, h, w, _ = x.shape
+    feat = kernel.shape[-1]
+    # pooled grad -> conv-output grad via the index scatter (pool.py's
+    # backward kernel), then XLA's own conv VJP for dx/dK — the
+    # forward's win was never the conv FLOPs, it was the traffic
+    dconv = _pool_bwd_impl(
+        idx, dp, (b, h, w, feat), POOL_WINDOW, POOL_STRIDE,
+        _resolve(interpret))
+    _, conv_vjp = jax.vjp(_conv_ref, x, kernel)
+    dx, dk = conv_vjp(dconv)
+    return dx, dk.astype(kernel.dtype)
+
+
+conv_pool.defvjp(_vjp_fwd, _vjp_bwd)
