@@ -165,6 +165,21 @@ def test_stop_drains_inflight_requests(setup):
     assert "error" in events[-1]  # ...and was terminated explicitly
 
 
+def test_stop_tokens_over_http(server, setup):
+    model, params = setup
+    prompt = [3, 14, 15, 92, 65]
+    solo = _solo(model, params, prompt, 8)
+    status, events = _post(
+        server.port,
+        {"tokens": prompt, "max_new_tokens": 8, "stop": [solo[2]]})
+    assert status == 200
+    done = events[-1]
+    assert done["finish_reason"] == "stop"
+    assert done["tokens"] == solo[:3]
+    status, _ = _post(server.port, {"tokens": [1, 2], "stop": "x"})
+    assert status == 400
+
+
 def test_healthz_and_stats(server):
     conn = http.client.HTTPConnection("127.0.0.1", server.port,
                                       timeout=30)

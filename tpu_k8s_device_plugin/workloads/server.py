@@ -21,7 +21,7 @@ API (JSON over HTTP/1.1):
 
   POST /generate   {"tokens": [int...], "max_new_tokens": N?,
                     "temperature": f?, "top_k": k?, "top_p": p?,
-                    "adapter": a?, "stream": true?}
+                    "adapter": a?, "stop": [int...]?, "stream": true?}
                    stream=true (default): chunked body, one JSON line
                    per event — {"token": t} ... then
                    {"done": true, "tokens": [...], "finish_reason": r}
@@ -64,6 +64,7 @@ class _Request:
     top_k: Optional[int] = None
     top_p: float = 1.0
     adapter: Optional[int] = None
+    stop: Optional[List[int]] = None
     events: "queue.Queue" = field(default_factory=queue.Queue)
     cancelled: bool = False
     emitted: int = 0
@@ -124,7 +125,7 @@ class EngineServer:
                 slot = eng.admit(
                     req.tokens, temperature=req.temperature,
                     top_k=req.top_k, top_p=req.top_p,
-                    adapter=req.adapter)
+                    adapter=req.adapter, stop=req.stop)
             except (ValueError, RuntimeError) as e:
                 self._requests_rejected += 1
                 req.events.put({"error": str(e), "code": 400})
@@ -148,14 +149,17 @@ class EngineServer:
             del self._running[slot]
             return
         if req.emitted >= req.max_new_tokens or finished:
-            if finished:
-                out = eng.output(slot)[:req.max_new_tokens]
-                reason = ("eos" if eng.eos_id is not None
-                          and out and out[-1] == eng.eos_id else "length")
+            full = eng.output(slot)
+            out = full[:req.max_new_tokens]
+            if finished and len(full) <= req.max_new_tokens:
+                # the engine's own verdict (eos / stop / length)
+                reason = eng.finish_reason(slot) or "length"
             else:
-                out = eng.output(slot)[:req.max_new_tokens]
+                # budget cut the stream before (or at) the engine's
+                # retirement point
                 reason = "length"
-                eng.release(slot)
+                if not finished:
+                    eng.release(slot)
             req.events.put({
                 "done": True,
                 "tokens": [int(t) for t in out],
@@ -338,6 +342,14 @@ class EngineServer:
             raise ValueError("max_new_tokens must be >= 1")
         top_k = body.get("top_k")
         adapter = body.get("adapter")
+        stop = body.get("stop")
+        if stop is not None and (
+                not isinstance(stop, list)
+                or not all(isinstance(t, int)
+                           and not isinstance(t, bool) for t in stop)):
+            # bool is an int subclass: JSON `true` would silently
+            # become token id 1 instead of a 400
+            raise ValueError("'stop' must be a list of token ids")
         return _Request(
             tokens=tokens,
             max_new_tokens=max_new,
@@ -345,6 +357,7 @@ class EngineServer:
             top_k=None if top_k is None else int(top_k),
             top_p=float(body.get("top_p", 1.0)),
             adapter=None if adapter is None else int(adapter),
+            stop=stop,
         )
 
     def stats(self) -> dict:

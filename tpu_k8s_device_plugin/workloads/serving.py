@@ -290,6 +290,10 @@ class ServingEngine:
         self.last_token = np.zeros(n_slots, np.int32)
         self.outputs: List[List[int]] = [[] for _ in range(n_slots)]
         self._finished: Dict[int, List[int]] = {}
+        self._finish_reason: Dict[int, str] = {}
+        # per-request stop-token sets (vLLM's `stop_token_ids`):
+        # host-side data consulted at harvest, never a recompile
+        self._stops: List[frozenset] = [frozenset()] * n_slots
         self._prefixes: Dict[int, tuple] = {}
         self._next_prefix = 0
         # automatic prefix caching (vLLM's APC, the feature the
@@ -466,7 +470,8 @@ class ServingEngine:
               temperature: float = 0.0,
               top_k: Optional[int] = None,
               top_p: float = 1.0,
-              adapter: Optional[int] = None) -> int:
+              adapter: Optional[int] = None,
+              stop: Optional[List[int]] = None) -> int:
         """Prefill *prompt* into a free slot; returns the slot id.
         Raises RuntimeError when the engine is full (callers queue).
         With ``prefix`` (a :meth:`register_prefix` handle), the prompt
@@ -478,8 +483,9 @@ class ServingEngine:
         only the unmatched tail — reused rows lie on the same chunk
         grid cold admission would compute, so tokens stay
         bit-identical.  ``temperature``/``top_k`` select this
-        request's sampling (0 / None = greedy) — per-slot data, never
-        a recompile."""
+        request's sampling (0 / None = greedy) and ``stop`` lists
+        per-request stop-token ids — per-slot data, never a
+        recompile."""
         # ONE host-side copy serves validation, auto-matching, and the
         # resident-prompt record; the device transfer happens once here
         prompt_np = np.asarray(prompt, np.int32).reshape(1, -1)
@@ -493,6 +499,12 @@ class ServingEngine:
         if not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p {top_p} outside (0, 1]")
         aid = self._check_adapter(adapter)
+        stops = frozenset(int(t) for t in (stop or ()))
+        for t in stops:
+            if not 0 <= t < self.model.vocab:
+                raise ValueError(
+                    f"stop token {t} outside [0, vocab="
+                    f"{self.model.vocab})")
         budget = self.max_new_tokens or 1
         if t_p + budget > self.model.max_len:
             raise ValueError(
@@ -533,6 +545,7 @@ class ServingEngine:
         # record, or finished(slot) would report True for the new
         # in-flight request
         self._finished.pop(slot, None)
+        self._finish_reason.pop(slot, None)
 
         if prefix is not None:
             if n > 0:
@@ -583,6 +596,7 @@ class ServingEngine:
         self.topks[slot] = top_k or 0
         self.topps[slot] = top_p
         self.adapters[slot] = aid
+        self._stops[slot] = stops
         first = int(self._sample(
             last[None, :], np.asarray([temperature], np.float32),
             np.asarray([top_k or 0], np.int32),
@@ -713,21 +727,29 @@ class ServingEngine:
     # -- completion --------------------------------------------------------
 
     def _maybe_finish(self, slot: int, token: int) -> None:
-        budget_hit = (
-            self.max_new_tokens is not None
-            and len(self.outputs[slot]) >= self.max_new_tokens
-        )
-        if (self.eos_id is not None and token == self.eos_id) or budget_hit:
-            self._finish(slot)
+        if self.eos_id is not None and token == self.eos_id:
+            self._finish(slot, "eos")
+        elif token in self._stops[slot]:
+            self._finish(slot, "stop")
+        elif (self.max_new_tokens is not None
+              and len(self.outputs[slot]) >= self.max_new_tokens):
+            self._finish(slot, "length")
 
-    def _finish(self, slot: int) -> None:
+    def _finish(self, slot: int, reason: str = "length") -> None:
         self._finished[slot] = self.outputs[slot]
+        self._finish_reason[slot] = reason
         self.active[slot] = False
         self._completed += 1
         self._reset_slot_params(slot)
 
     def finished(self, slot: int) -> bool:
         return slot in self._finished
+
+    def finish_reason(self, slot: int) -> Optional[str]:
+        """Why the slot finished: "eos", "stop" (a per-request stop
+        token), or "length" (budget/cache exhaustion); None while the
+        request is still in flight (vLLM's finish_reason taxonomy)."""
+        return self._finish_reason.get(slot)
 
     def output(self, slot: int) -> List[int]:
         """Generated tokens for *slot* (finished or in flight)."""
@@ -753,6 +775,7 @@ class ServingEngine:
         """Free a slot (abandons any in-flight generation)."""
         self.active[slot] = False
         self._finished.pop(slot, None)
+        self._finish_reason.pop(slot, None)
         self.lens[slot] = 0
         self._reset_slot_params(slot)
 
@@ -764,3 +787,4 @@ class ServingEngine:
         self.topks[slot] = 0
         self.topps[slot] = 1.0
         self.adapters[slot] = -1
+        self._stops[slot] = frozenset()
