@@ -20,13 +20,17 @@ def qkv(shape, dtype=jnp.float32, seed=0):
 
 
 class TestForward:
+    # shapes are the smallest that preserve the structural cases
+    # (multiple blocks per axis, uneven bq != bk both ways, clamping):
+    # interpret-mode cost scales with B*T^2*H*D and this file is on the
+    # suite's critical path (1-core box, VERDICT r2 #8)
     @pytest.mark.parametrize("causal", [False, True])
     @pytest.mark.parametrize(
         "shape,blocks",
         [
-            ((2, 128, 2, 16), (64, 64)),
-            ((1, 256, 4, 8), (128, 64)),   # uneven bq != bk
-            ((2, 64, 1, 32), (128, 128)),  # blocks clamp to T
+            ((2, 64, 2, 16), (32, 32)),
+            ((1, 128, 2, 8), (64, 32)),    # uneven bq != bk
+            ((2, 32, 1, 32), (64, 64)),    # blocks clamp to T
         ],
     )
     def test_matches_oracle(self, causal, shape, blocks):
@@ -40,7 +44,7 @@ class TestForward:
         )
 
     def test_bf16_inputs(self):
-        q, k, v = qkv((2, 128, 2, 16), jnp.bfloat16)
+        q, k, v = qkv((2, 64, 2, 16), jnp.bfloat16)
         got = flash_attention(q, k, v, causal=True)
         want = full_attention(q, k, v, causal=True)
         np.testing.assert_allclose(
@@ -64,9 +68,9 @@ class TestBackward:
     @pytest.mark.parametrize(
         "shape,blocks",
         [
-            ((1, 128, 2, 16), (64, 64)),
-            ((2, 256, 1, 8), (128, 64)),   # bq != bk: dkv diagonal lower
-            ((1, 256, 2, 16), (64, 128)),  # bound exercised both ways
+            ((1, 64, 2, 16), (32, 32)),
+            ((2, 128, 1, 8), (64, 32)),    # bq != bk: dkv diagonal lower
+            ((1, 128, 2, 8), (32, 64)),    # bound exercised both ways
         ],
     )
     def test_gradients_match_oracle(self, causal, shape, blocks):
@@ -93,7 +97,7 @@ class TestBackward:
     def test_bf16_gradients(self):
         """bf16 end-to-end: the kernel casts P/dS to bf16 for the MXU
         (same rounding as the forward's P·V), so compare loosely."""
-        q, k, v = qkv((1, 128, 2, 16), jnp.bfloat16, seed=5)
+        q, k, v = qkv((1, 64, 2, 16), jnp.bfloat16, seed=5)
 
         def flash_loss(q, k, v):
             return jnp.sum(
@@ -126,7 +130,7 @@ class TestLMIntegration:
 
         tiny = dict(vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64)
         rng = jax.random.PRNGKey(1)
-        tokens, _, positions = synthetic_lm_batch(rng, 2, 64, tiny["vocab"])
+        tokens, _, positions = synthetic_lm_batch(rng, 2, 32, tiny["vocab"])
         ref_model = TransformerLM(attn_fn=local_causal_attention, **tiny)
         params = ref_model.init(rng, tokens, positions)["params"]
         want = ref_model.apply({"params": params}, tokens, positions)

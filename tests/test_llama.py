@@ -83,17 +83,16 @@ def test_cached_decode_matches_recompute_oracle():
     train, serve = _models()
     params, tokens, _ = _init(train, batch=2, seq=8)
     out, _ = greedy_generate(serve, params, tokens, n_steps=6)
-    # oracle: recompute the full forward for every generated token
-    cur = tokens
-    for _ in range(6):
-        T = cur.shape[1]
-        pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32),
-                               (cur.shape[0], T))
-        logits = train.apply({"params": params}, cur, pos)
-        nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(cur.dtype)
-        cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
-    np.testing.assert_array_equal(
-        np.asarray(out), np.asarray(cur[:, tokens.shape[1]:]))
+    # recompute oracle in ONE causal full-length forward (per-step
+    # regrowing would compile 6 shapes for the same assertion)
+    T_p = tokens.shape[1]
+    full = jnp.concatenate([tokens, out.astype(tokens.dtype)], axis=1)
+    T = full.shape[1]
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32),
+                           (full.shape[0], T))
+    logits = train.apply({"params": params}, full, pos)
+    want = jnp.argmax(logits[:, T_p - 1:-1, :], axis=-1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
 
 
 def test_gqa_cache_is_compact():
@@ -216,6 +215,15 @@ def test_gqa_ring_attention_matches_local_oracle():
     )
 
     mesh = make_lm_mesh(seq=4, model=2, expert=1)
+    # ONE local oracle serves both ring layouts (hoisted: rebuilding it
+    # per layout recompiled an identical train step)
+    step2, state2, _ = make_lm_train_step(
+        mesh, vocab=64, d_model=64, n_heads=8, n_layers=1, d_ff=128,
+        seq_axis=None, batch=2, seq_len=32,
+        n_kv_heads=2, ffn="swiglu", rope_theta=500000.0,
+    )
+    oracle_step = jax.jit(functools.partial(
+        lm_train_step, state2["model"], state2["tx"]))
     for layout in ("contiguous", "zigzag"):
         step, state, place = make_lm_train_step(
             mesh, vocab=64, d_model=64, n_heads=8, n_layers=1, d_ff=128,
@@ -226,14 +234,6 @@ def test_gqa_ring_attention_matches_local_oracle():
         params, opt_state, loss_ring = step(
             state["params"], state["opt_state"], *place(
                 tokens, labels, positions))
-        # local oracle on a fresh copy of the same initial params
-        step2, state2, _ = make_lm_train_step(
-            mesh, vocab=64, d_model=64, n_heads=8, n_layers=1, d_ff=128,
-            seq_axis=None, batch=2, seq_len=32,
-            n_kv_heads=2, ffn="swiglu", rope_theta=500000.0,
-        )
-        oracle_step = jax.jit(functools.partial(
-            lm_train_step, state2["model"], state2["tx"]))
         _, _, loss_local = oracle_step(
             state2["params"], state2["opt_state"], tokens, labels,
             positions)

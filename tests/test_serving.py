@@ -358,21 +358,21 @@ def test_sampled_tokens_stay_in_top_k(setup):
     s = eng.admit(prompt, temperature=3.0, top_k=2)
     eng.run(8)
     toks = eng.output(s)
-    # recompute the logits for every step and check membership in top-2
-    cur = jnp.asarray(prompt, jnp.int32)[None, :]
+    # ONE full-length causal forward recomputes every step's logits
+    # (position t-1's row is what the engine sampled token t from) —
+    # a regrowing per-token loop would compile len(toks) shapes
     from tpu_k8s_device_plugin.workloads.inference import (
         init_cache as _ic)
-    for tok in toks:
-        T = cur.shape[1]
-        pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (1, T))
-        logits, _ = model.apply(
-            {"params": params, "cache": _ic(model, 1)},
-            cur, pos, decode=False, mutable=["cache"])
-        top2 = set(np.asarray(
-            jax.lax.top_k(logits[0, -1], 2)[1]).tolist())
-        assert tok in top2
-        cur = jnp.concatenate(
-            [cur, jnp.asarray([[tok]], jnp.int32)], axis=1)
+    full = jnp.asarray(list(prompt) + toks, jnp.int32)[None, :]
+    T = full.shape[1]
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (1, T))
+    logits, _ = model.apply(
+        {"params": params, "cache": _ic(model, 1)},
+        full, pos, decode=False, mutable=["cache"])
+    for i, tok in enumerate(toks):
+        row = logits[0, len(prompt) - 1 + i]
+        top2 = set(np.asarray(jax.lax.top_k(row, 2)[1]).tolist())
+        assert tok in top2, f"step {i}"
 
 
 def test_sampling_params_validated(setup):
@@ -444,21 +444,21 @@ def test_top_p_tokens_stay_in_nucleus(setup):
     eng.run(6)
     toks = eng.output(s)
     from tpu_k8s_device_plugin.workloads.inference import init_cache as _ic
-    cur = jnp.asarray(prompt, jnp.int32)[None, :]
-    for tok in toks:
-        T = cur.shape[1]
-        pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (1, T))
-        logits, _ = model.apply(
-            {"params": params, "cache": _ic(model, 1)},
-            cur, pos, decode=False, mutable=["cache"])
-        pr = np.asarray(jax.nn.softmax(logits[0, -1]))
+    # one full-length causal forward gives every step's logits (see
+    # test_sampled_tokens_stay_in_top_k)
+    full = jnp.asarray(prompt + toks, jnp.int32)[None, :]
+    T = full.shape[1]
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (1, T))
+    logits, _ = model.apply(
+        {"params": params, "cache": _ic(model, 1)},
+        full, pos, decode=False, mutable=["cache"])
+    for i, tok in enumerate(toks):
+        pr = np.asarray(jax.nn.softmax(logits[0, len(prompt) - 1 + i]))
         order = np.argsort(-pr)
         csum = np.cumsum(pr[order])
         nucleus = set(order[:int(np.searchsorted(csum, P_NUC) + 1)]
                       .tolist())
-        assert tok in nucleus
-        cur = jnp.concatenate(
-            [cur, jnp.asarray([[tok]], jnp.int32)], axis=1)
+        assert tok in nucleus, f"step {i}"
 
 
 def test_top_p_validation(setup):
@@ -672,6 +672,60 @@ def test_unchunked_engine_disables_auto_prefix(setup):
     eng.admit(shared + [5])
     eng.admit(shared + [9])
     assert eng.stats()["prefix_cache_hits"] == 0
+
+
+def test_stop_tokens_finish_request(setup):
+    # per-request stop tokens (vLLM stop_token_ids): the slot retires
+    # at the first stop token, reason "stop"; other slots unaffected
+    model, params = setup
+    prompt = [3, 14, 15, 92, 65]
+    solo = _solo(model, params, prompt, 6)
+    stop_tok = solo[2]  # emitted at step 3
+    eng = ServingEngine(model, params, n_slots=2)
+    s = eng.admit(prompt, stop=[stop_tok, 999999 % 128])
+    other = eng.admit([9, 9, 8])
+    eng.run(10)
+    assert eng.finished(s)
+    assert eng.finish_reason(s) == "stop"
+    assert eng.output(s) == solo[:3]  # stop token included, like eos
+    assert not eng.finished(other)
+    assert eng.finish_reason(other) is None
+    # through run_scan too
+    bng = ServingEngine(model, params, n_slots=1)
+    sb = bng.admit(prompt, stop=[stop_tok])
+    bng.run_scan(6)
+    assert bng.finished(sb) and bng.finish_reason(sb) == "stop"
+    assert bng.output(sb) == solo[:3]
+
+
+def test_finish_reasons_eos_and_length(setup):
+    model, params = setup
+    prompt = [3, 14, 15, 92, 65]
+    solo = _solo(model, params, prompt, 6)
+    eng = ServingEngine(model, params, n_slots=1, eos_id=solo[2])
+    s = eng.admit(prompt)
+    eng.run(10)
+    assert eng.finish_reason(s) == "eos"
+    bng = ServingEngine(model, params, n_slots=1, max_new_tokens=2)
+    sb = bng.admit(prompt)
+    bng.run(10)
+    assert bng.finish_reason(sb) == "length"
+    # recycled slot drops the stale reason and stop set
+    sc = bng.admit([7, 7])
+    assert bng.finish_reason(sc) is None
+    bng.run(10)
+    assert bng.finish_reason(sc) == "length"
+
+
+def test_stop_token_validation(setup):
+    model, params = setup
+    eng = ServingEngine(model, params, n_slots=1, max_new_tokens=2)
+    sa = eng.admit([1, 2])
+    eng.run(5)
+    assert eng.finished(sa)
+    with pytest.raises(ValueError, match="stop token"):
+        eng.admit([1, 2], stop=[9999])
+    assert eng.finished(sa)  # rejected admit left state untouched
 
 
 def test_draw_stream_mode_independent_after_retirement(setup):

@@ -54,12 +54,11 @@ from jax import lax
 from jax.experimental import pallas as pl
 
 from .pool import (
+    _batch_tiling,
     _block_spec,
-    _bpad,
-    _LANES,
+    _first_match_idx,
     _out_dim,
     _pool_bwd_impl,
-    _to_bhwc,
     _to_hwcb,
 )
 
@@ -86,71 +85,85 @@ def _fused_kernel(h: int, w: int, window: int, pool_rows: int,
     """One grid step: pool rows [pi * pool_rows, ...) for one 128-wide
     batch block.
 
-    x block:   (h, w, c, B) — full spatial extent (same-block for every
+    x block:   (h, c, w, B) — channel-before-width layout so the W^2
+               shifted row slices concatenate along the CONTRACTION
+               dim with no in-kernel transpose (same-block for every
                pi, so the pipeline keeps it resident per batch block).
-    k block:   (F, 9c) tap-packed flat kernel, resident.
-    y/idx:     (pool_rows, ow, F, B).
-    """
+    k block:   (F, window^2 * c) tap-packed flat kernel, resident.
+    y/idx:     (pool_rows, F, ow, B) — F-major; the host transposes
+               the small pooled outputs back to spatial-major.
+
+    The whole conv row is ONE MXU matmul, [F, W^2*C] @ [W^2*C, w*B]:
+    per-pixel dots would trace O(w * W^2) ops and feed the MXU
+    N=128-wide; row-batching traces O(W^2) and feeds it N=w*128.
+    Pooling then runs on the row values with pool.py's parity-plane
+    trick (reshape + static slices — no strided value slices, which
+    Mosaic lowers to unsupported gathers)."""
     pi = pl.program_id(1)
     kf = k_ref[...]                      # [F, window^2 * C]
     ow = _out_dim(w, POOL_WINDOW, POOL_STRIDE)
     pad = window // 2                    # SAME padding offset
     f32 = jnp.float32
     dtype = y_ref.dtype
-    zero_tile = jnp.zeros_like(x_ref[0, 0])
+    feat = kf.shape[0]
+    bsz = x_ref.shape[3]
+    wq = -(-w // POOL_STRIDE)            # parity-plane cols
 
-    def x_tile(r, cc):
-        """Input tile (C, B) at conv-SAME position (row r, col cc).
-        Columns are static; the row is traced (pi) and clamped, with
-        out-of-range rows zeroed — SAME padding."""
-        if not 0 <= cc < w:
-            return zero_tile
+    def x_row(r):
+        """Input row r as [c, w, B]; out-of-range rows read as zeros
+        (conv SAME padding).  r is traced (derives from program_id)."""
         rc = jnp.clip(r, 0, h - 1)
         valid = ((r >= 0) & (r <= h - 1)).astype(x_ref.dtype)
-        return x_ref[rc, cc] * valid
+        return x_ref[rc] * valid
 
     def conv_row(hh):
-        """Conv output row hh: w tiles of [F, B] in the activation
-        dtype (pooling must see what the unfused conv would emit)."""
-        tiles = []
-        for ww in range(w):
-            parts = []
-            for di in range(window):
-                for dj in range(window):
-                    parts.append(x_tile(hh + di - pad, ww + dj - pad))
-            patch = jnp.concatenate(parts, axis=0)  # [window^2*C, B]
-            acc = lax.dot_general(
-                kf, patch, (((1,), (0,)), ((), ())),
-                preferred_element_type=f32,
-            )
-            tiles.append(acc.astype(dtype))
-        return tiles
+        """Conv output row hh as [F, w, B] in the activation dtype
+        (pooling must see exactly what the unfused conv would emit)."""
+        parts = []
+        for di in range(window):
+            row = x_row(hh + di - pad)
+            for dj in range(window):
+                s = dj - pad             # column shift
+                lead = max(0, -s)        # zeros before the valid span
+                lo = max(0, s)
+                span = w - abs(s)
+                sl = row[:, lo:lo + span]
+                parts.append(jnp.pad(
+                    sl, ((0, 0), (lead, w - lead - span), (0, 0))))
+        patch = jnp.concatenate(parts, axis=0)   # [W^2*C, w, B]
+        patch = patch.reshape(patch.shape[0], w * bsz)
+        acc = lax.dot_general(
+            kf, patch, (((1,), (0,)), ((), ())),
+            preferred_element_type=f32,
+        )
+        return acc.reshape(feat, w, bsz).astype(dtype)
 
     # rolling rows: the block's pool rows need conv rows
     # [2*p0, 2*p0 + 2*pool_rows], each computed ONCE (adjacent pool
     # windows share rows; recompute would cost 1.5x the conv FLOPs)
     p0 = pi * pool_rows
     rows = [conv_row(2 * p0 + k) for k in range(2 * pool_rows + 1)]
-    one = jnp.ones((), f32)
+
+    def plane(v, dj):
+        """Columns 2*pw + dj of row value v, for all pw: [F, ow, B].
+        Parity reshape keeps every slice unit-stride."""
+        vp = jnp.pad(
+            v, ((0, 0), (0, wq * POOL_STRIDE - w), (0, 0)))
+        vr = vp.reshape(feat, wq, POOL_STRIDE, bsz)
+        off = dj // POOL_STRIDE
+        return vr[:, off:off + ow, dj % POOL_STRIDE]
+
     for pr in range(pool_rows):
-        for pw in range(ow):
-            cand = [rows[2 * pr + di][2 * pw + dj]
-                    for di in range(POOL_WINDOW)
-                    for dj in range(POOL_WINDOW)]
-            cf = [t.astype(f32) for t in cand]
-            m = cf[0]
-            for t in cf[1:]:
-                m = jnp.maximum(m, t)
-            # first-match argmax via mask arithmetic (pool.py's rule:
-            # compares in f32 — exact for bf16 inputs — no i1 algebra)
-            idx = jnp.zeros_like(m)
-            found = jnp.zeros_like(m)
-            for k, t in enumerate(cf):
-                hit = (t == m).astype(f32) * (one - found)
-                idx = idx + jnp.full((), k, f32) * hit
-                found = found + hit
-            y_ref[pr, pw] = m.astype(dtype)
-            idx_ref[pr, pw] = idx.astype(jnp.int8)
+        cand = [plane(rows[2 * pr + di], dj)
+                for di in range(POOL_WINDOW)
+                for dj in range(POOL_WINDOW)]
+        cf = [t.astype(f32) for t in cand]
+        m = cf[0]
+        for t in cf[1:]:
+            m = jnp.maximum(m, t)
+        idx = _first_match_idx(cf, m)   # pool.py's shared tie-break
+        y_ref[pr] = m.astype(dtype)
+        idx_ref[pr] = idx.astype(jnp.int8)
 
 
 def _pick_pool_rows(oh: int) -> int:
@@ -165,7 +178,7 @@ def _pick_pool_rows(oh: int) -> int:
 
 def _fused_fwd_impl(x, kernel, interpret):
     """x (B, H, W, C) NHWC, kernel (3, 3, C, F) HWIO ->
-    (pooled (B, OH, OW, F) NHWC, idx (OH, OW, F, Bt) kernel-layout)."""
+    (pooled (B, OH, OW, F) NHWC, idx (OH, OW, F, Bt) pool-layout)."""
     b, h, w, c = x.shape
     window = kernel.shape[0]
     if kernel.shape[:3] != (window, window, c) or window % 2 != 1:
@@ -174,36 +187,43 @@ def _fused_fwd_impl(x, kernel, interpret):
     feat = kernel.shape[-1]
     oh = _out_dim(h, POOL_WINDOW, POOL_STRIDE)
     ow = _out_dim(w, POOL_WINDOW, POOL_STRIDE)
-    bpad = _bpad(b)
+    bpad, lanes = _batch_tiling(b, interpret)
     bt = b + bpad
-    xt = _to_hwcb(x, bpad)  # (H, W, C, Bt)
+    # (H, C, W, Bt): channel-before-width so the kernel's shifted row
+    # slices stack along the contraction dim without a relayout (the
+    # producer-side transpose is XLA's to fuse into its layout choice)
+    xt = _to_hwcb(x, bpad).transpose(0, 2, 1, 3)
     # tap-packed kernel [F, window^2 * C]: tap-major (di, dj),
     # channel-minor — the same order the kernel concatenates patches
     kf = kernel.astype(x.dtype).transpose(3, 0, 1, 2).reshape(feat, -1)
     pool_rows = _pick_pool_rows(oh)
-    grid = (bt // _LANES, oh // pool_rows)
+    grid = (bt // lanes, oh // pool_rows)
     y, idx = pl.pallas_call(
         functools.partial(_fused_kernel, h, w, window, pool_rows),
         grid=grid,
         in_specs=[
-            _block_spec((h, w, c, _LANES), lambda bi, pi: (0, 0, 0, bi)),
+            _block_spec((h, c, w, lanes), lambda bi, pi: (0, 0, 0, bi)),
             _block_spec((feat, window * window * c),
                         lambda bi, pi: (0, 0)),
         ],
         out_specs=[
-            _block_spec((pool_rows, ow, feat, _LANES),
+            _block_spec((pool_rows, feat, ow, lanes),
                         lambda bi, pi: (pi, 0, 0, bi)),
-            _block_spec((pool_rows, ow, feat, _LANES),
+            _block_spec((pool_rows, feat, ow, lanes),
                         lambda bi, pi: (pi, 0, 0, bi)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((oh, ow, feat, bt), x.dtype),
-            jax.ShapeDtypeStruct((oh, ow, feat, bt), jnp.int8),
+            jax.ShapeDtypeStruct((oh, feat, ow, bt), x.dtype),
+            jax.ShapeDtypeStruct((oh, feat, ow, bt), jnp.int8),
         ],
         compiler_params=_compiler_params(interpret),
         interpret=interpret,
     )(xt, kf)
-    return _to_bhwc(y, b), idx
+    # back to spatial-major: y to NHWC for the caller, idx to the
+    # (OH, OW, F, Bt) layout pool.py's scatter backward expects —
+    # both are 4x-pooled tensors, cheap XLA transposes
+    y = y.transpose(3, 0, 2, 1)[:b]          # (B, OH, OW, F)
+    return y, idx.transpose(0, 2, 1, 3)
 
 
 def _resolve(interpret):

@@ -129,6 +129,24 @@ def _window_slice(planes, di, dj, oh, ow, stride):
     return p[r0:r0 + oh, c0:c0 + ow]
 
 
+def _first_match_idx(cands_f32, max_f32):
+    """First-match argmax over *cands_f32* (a list of same-shaped f32
+    tensors) against their elementwise max, as an f32 index tensor —
+    the ge-select tie-break select_and_scatter uses.  Mask ARITHMETIC,
+    not boolean algebra, and f32 compares (exact for bf16 inputs):
+    i1 vectors from different-width compares carry incompatible Mosaic
+    layouts and the VPU has no bf16 cmpf — the load-bearing rules for
+    every kernel that shares this (pool fwd, fused conv+pool)."""
+    one = jnp.ones((), jnp.float32)
+    idx = jnp.zeros_like(max_f32)
+    found = jnp.zeros_like(max_f32)
+    for k, t in enumerate(cands_f32):
+        hit = (t == max_f32).astype(jnp.float32) * (one - found)
+        idx = idx + jnp.full((), k, jnp.float32) * hit
+        found = found + hit
+    return idx
+
+
 def _fwd_kernel(window, stride, oh, ow, x_ref, y_ref, idx_ref):
     # block shapes: x (H, W, cb, bb), y/idx (oh, ow, cb, bb)
     planes = _parity_planes(x_ref[...], window, stride)
@@ -136,26 +154,14 @@ def _fwd_kernel(window, stride, oh, ow, x_ref, y_ref, idx_ref):
     for di, dj in _offsets(window):
         s = _window_slice(planes, di, dj, oh, ow, stride)
         y = s if y is None else jnp.maximum(y, s)
-    # The argmax index is computed with same-dtype mask ARITHMETIC, not
-    # boolean algebra: i1 vectors from compares of different-width
-    # dtypes carry different Mosaic layouts, and i1(+)i1 relayouts hit
-    # "Non-singleton logical dimension is replicated" compile bugs.
-    # Compare -> convert to x.dtype -> multiply/add keeps every vector
-    # in one layout family.  hit_k = (s==y)*(1-found) reproduces the
-    # first-match tie-break; idx = sum k*hit_k; 0..window^2-1 is exact
-    # in bf16 for window<=11.
-    # compares run in f32 — the VPU has no bf16 cmpf ("Target does not
-    # support this comparison"), and bf16->f32 is exact
-    f32 = jnp.float32
-    yf = y.astype(f32)
-    one = jnp.ones((), f32)
-    idx = jnp.zeros(y.shape, f32)
-    found = jnp.zeros(y.shape, f32)
-    for k, (di, dj) in enumerate(_offsets(window)):
-        s = _window_slice(planes, di, dj, oh, ow, stride)
-        hit = (s.astype(f32) == yf).astype(f32) * (one - found)
-        idx = idx + jnp.full((), k, f32) * hit
-        found = found + hit
+    # idx via the shared first-match rule (_first_match_idx documents
+    # the Mosaic layout/compare constraints); 0..window^2-1 is exact
+    # in f32 for any sane window
+    cands = [
+        _window_slice(planes, di, dj, oh, ow, stride).astype(jnp.float32)
+        for di, dj in _offsets(window)
+    ]
+    idx = _first_match_idx(cands, y.astype(jnp.float32))
     y_ref[...] = y
     idx_ref[...] = idx.astype(jnp.int8)
 
@@ -220,25 +226,35 @@ def _bpad(b: int) -> int:
     return (-b) % _LANES
 
 
+def _batch_tiling(b: int, interpret: bool):
+    """(bpad, lane block) for the batch-minor dim.  On TPU the lane
+    dim tiles at 128; in interpret mode (CPU tests/fallback) there is
+    no lane hardware and padding a tiny test batch to 128 would be up
+    to 32x wasted arithmetic — use the true batch as the one block."""
+    if interpret:
+        return 0, b
+    return _bpad(b), _LANES
+
+
 def _pool_fwd_impl(x, window, stride, interpret):
     b, h, w, c = x.shape
     oh = _out_dim(h, window, stride)
     ow = _out_dim(w, window, stride)
-    bpad = _bpad(b)
+    bpad, lanes = _batch_tiling(b, interpret)
     bt = b + bpad
     cb = _pick_cb(c, x.dtype.itemsize)
     xt = _to_hwcb(x, bpad)
-    grid = (c // cb, bt // _LANES)
+    grid = (c // cb, bt // lanes)
     y, idx = pl.pallas_call(
         functools.partial(_fwd_kernel, window, stride, oh, ow),
         grid=grid,
         in_specs=[
-            _block_spec((h, w, cb, _LANES), lambda ci, bi: (0, 0, ci, bi)),
+            _block_spec((h, w, cb, lanes), lambda ci, bi: (0, 0, ci, bi)),
         ],
         out_specs=[
-            _block_spec((oh, ow, cb, _LANES),
+            _block_spec((oh, ow, cb, lanes),
                         lambda ci, bi: (0, 0, ci, bi)),
-            _block_spec((oh, ow, cb, _LANES),
+            _block_spec((oh, ow, cb, lanes),
                         lambda ci, bi: (0, 0, ci, bi)),
         ],
         out_shape=[
@@ -255,22 +271,22 @@ def _pool_bwd_impl(idx, dp, xshape, window, stride, interpret):
     b, h, w, c = xshape
     oh = _out_dim(h, window, stride)
     ow = _out_dim(w, window, stride)
-    bpad = _bpad(b)
+    bpad, lanes = _batch_tiling(b, interpret)
     bt = b + bpad
     cb = _pick_cb(c, dp.dtype.itemsize)
     dpt = _to_hwcb(dp, bpad)
-    grid = (c // cb, bt // _LANES)
+    grid = (c // cb, bt // lanes)
     dy = pl.pallas_call(
         functools.partial(_bwd_kernel, window, stride, h, w),
         grid=grid,
         in_specs=[
-            _block_spec((oh, ow, cb, _LANES),
+            _block_spec((oh, ow, cb, lanes),
                         lambda ci, bi: (0, 0, ci, bi)),
-            _block_spec((oh, ow, cb, _LANES),
+            _block_spec((oh, ow, cb, lanes),
                         lambda ci, bi: (0, 0, ci, bi)),
         ],
         out_specs=_block_spec(
-            (h, w, cb, _LANES), lambda ci, bi: (0, 0, ci, bi)),
+            (h, w, cb, lanes), lambda ci, bi: (0, 0, ci, bi)),
         out_shape=jax.ShapeDtypeStruct((h, w, c, bt), dp.dtype),
         compiler_params=_compiler_params(interpret),
         interpret=interpret,
