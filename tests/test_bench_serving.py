@@ -2,7 +2,11 @@
 
 import pytest
 
-from tpu_k8s_device_plugin.workloads.bench_serving import CONFIGS, run
+from tpu_k8s_device_plugin.workloads.bench_serving import (
+    CONFIGS,
+    build_model_and_params,
+    run,
+)
 
 
 def test_uniform_path_runs():
@@ -36,3 +40,19 @@ def test_int4_path_runs():
                 prompt_len=8, max_len=64)
     assert stats["tokens_per_sec"] > 0
     assert stats["quantized"] == "int4"
+
+
+@pytest.mark.parametrize("quantized", [False, True])
+def test_build_with_mesh_materializes_sharded(quantized):
+    # the --tp path: leaves must come out ALREADY on their TP
+    # placement (build-then-reshard would peak the full tree on one
+    # device — the thing tensor parallelism exists to avoid)
+    from tpu_k8s_device_plugin.workloads.transformer import make_lm_mesh
+
+    mesh = make_lm_mesh(seq=1, model=2, expert=1)
+    _, _, params = build_model_and_params(
+        "tiny", 64, quantized, mesh=mesh)
+    leaf_name = "kernel_int8" if quantized else "kernel"
+    leaf = params["block_0"]["mlp_gate"][leaf_name]
+    assert leaf.sharding.mesh.shape["model"] == 2
+    assert tuple(leaf.sharding.spec) == (None, "model")

@@ -217,6 +217,37 @@ def test_eos_finish_reason(setup):
         srv.stop()
 
 
+def test_tensor_parallel_server_matches_meshless(setup):
+    # the --tp path: an EngineServer over a model=2-sharded engine must
+    # stream the same tokens the meshless engine produces (CPU-mesh
+    # calibrated; see __graft_entry__ on f32 psum near-ties)
+    from tpu_k8s_device_plugin.workloads import llama
+    from tpu_k8s_device_plugin.workloads.transformer import make_lm_mesh
+
+    cfg = llama.TINY_LLAMA  # 2 KV heads: shardable over model=2
+    model = llama.decoder(cfg, dtype=jnp.float32, max_len=64)
+    rng = jax.random.PRNGKey(2)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+    params = model.init(rng, tokens, pos)["params"]
+    mesh = make_lm_mesh(seq=1, model=2, expert=1)
+    plain = ServingEngine(model, params, n_slots=2)
+    sp = plain.admit([5, 17, 3, 70])
+    plain.run(5)
+    srv = EngineServer(
+        ServingEngine(model, params, n_slots=2, mesh=mesh),
+        max_new_tokens=6, window=3)
+    srv.start(host="127.0.0.1", port=0)
+    try:
+        status, events = _post(
+            srv.port, {"tokens": [5, 17, 3, 70], "max_new_tokens": 6,
+                       "stream": False})
+        assert status == 200
+        assert events[0]["tokens"] == plain.output(sp)
+    finally:
+        srv.stop()
+
+
 def test_parse_request_defaults():
     eng_default = 64
 

@@ -31,24 +31,38 @@ CONFIGS = {
 }
 
 
-def build_model_and_params(config: str, max_len: int, quantized):
+def build_model_and_params(config: str, max_len: int, quantized,
+                           mesh=None):
     """Decode model + benchmark-posture params (random weights built
     DIRECTLY in the serving layout) for a named config.  The ONE
     construction recipe shared by this benchmark and the HTTP server
     (workloads/server.py) — a real deployment swaps the random params
-    for a checkpoint restored via workloads.checkpoint."""
+    for a checkpoint restored via workloads.checkpoint.
+
+    With *mesh*, every leaf is materialized ALREADY SHARDED onto its
+    tensor-parallel placement (jit with out_shardings from an abstract
+    tree) — build-then-reshard would peak at the full tree on one
+    device, which is exactly what --tp exists to avoid at 8B scale."""
     cfg = CONFIGS[config]
     model = llama.decoder(cfg, max_len=max_len, quantized=quantized)
-    if quantized == "int4":
-        params = llama.random_quantized_params(cfg, bits=4)
-    elif quantized:
-        params = llama.random_quantized_params(cfg)
-    else:
+
+    def build():
+        if quantized == "int4":
+            return llama.random_quantized_params(cfg, bits=4)
+        if quantized:
+            return llama.random_quantized_params(cfg)
         # small configs only: materializes the bf16 tree
         train = llama.train_model(cfg)
         tokens = jnp.zeros((1, 8), jnp.int32)
         pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
-        params = train.init(jax.random.PRNGKey(0), tokens, pos)["params"]
+        return train.init(jax.random.PRNGKey(0), tokens, pos)["params"]
+
+    if mesh is None:
+        return cfg, model, build()
+    from .transformer import lm_tree_shardings
+
+    shardings = lm_tree_shardings(mesh, jax.eval_shape(build))
+    params = jax.jit(build, out_shardings=shardings)()
     return cfg, model, params
 
 

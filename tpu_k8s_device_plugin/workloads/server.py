@@ -384,6 +384,11 @@ def main(argv=None) -> int:
     p.add_argument("--int4", action="store_true",
                    help="weight-only int4")
     p.add_argument("--n-slots", type=int, default=8)
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel ways: shard params/KV over a "
+                        "model-axis mesh of the first N visible chips "
+                        "(the native analog of vLLM's "
+                        "--tensor-parallel-size)")
     p.add_argument("--max-len", type=int, default=2048)
     p.add_argument("--max-new-tokens", type=int, default=256,
                    help="default per-request budget")
@@ -395,10 +400,32 @@ def main(argv=None) -> int:
         p.error("--quantized and --int4 are mutually exclusive")
 
     quantized = "int4" if args.int4 else args.quantized
+    mesh = None
+    if args.tp > 1:
+        # validate BEFORE the (potentially many-GB) param build: a bad
+        # --tp must fail in milliseconds with an argparse error, not
+        # after minutes of weight materialization
+        import jax
+
+        from .bench_serving import CONFIGS as _cfgs
+        from .transformer import make_lm_mesh
+
+        devs = jax.devices()
+        if len(devs) < args.tp:
+            p.error(f"--tp {args.tp} needs {args.tp} devices, "
+                    f"found {len(devs)}")
+        cfg0 = _cfgs[args.config]
+        n_kv = getattr(cfg0, "n_kv_heads", None) or cfg0.n_heads
+        if n_kv % args.tp:
+            p.error(f"--tp {args.tp} must divide the config's "
+                    f"{n_kv} KV heads (the cache shards on them)")
+        mesh = make_lm_mesh(devs[:args.tp], seq=1, model=args.tp,
+                            expert=1)
     cfg, model, params = build_model_and_params(
-        args.config, args.max_len, quantized)
+        args.config, args.max_len, quantized, mesh=mesh)
     engine = ServingEngine(model, params, n_slots=args.n_slots,
-                           eos_id=getattr(cfg, "eos_id", None))
+                           eos_id=getattr(cfg, "eos_id", None),
+                           mesh=mesh)
     srv = EngineServer(engine, max_new_tokens=args.max_new_tokens,
                        window=args.window)
     srv.start(host=args.host, port=args.port)
