@@ -165,6 +165,32 @@ def test_stop_drains_inflight_requests(setup):
     assert "error" in events[-1]  # ...and was terminated explicitly
 
 
+def test_logprobs_over_http(setup):
+    model, params = setup
+    eng = ServingEngine(model, params, n_slots=1, logprobs_k=4)
+    srv = EngineServer(eng, max_new_tokens=4, window=2)
+    srv.start(host="127.0.0.1", port=0)
+    try:
+        status, events = _post(
+            srv.port,
+            {"tokens": [5, 9, 3], "max_new_tokens": 4, "logprobs": 2})
+        assert status == 200
+        tok_evs = [e for e in events if "token" in e]
+        for e in tok_evs:
+            assert "logprob" in e and len(e["top_logprobs"]) == 2
+            # greedy: the chosen token leads its own top list
+            assert e["top_logprobs"][0][0] == e["token"]
+        done = events[-1]
+        assert len(done["logprobs"]) == len(done["tokens"])
+        # over-cap ask is a clean 400
+        status, events = _post(
+            srv.port, {"tokens": [1, 2], "logprobs": 9,
+                       "stream": False})
+        assert status == 400
+    finally:
+        srv.stop()
+
+
 def test_stop_tokens_over_http(server, setup):
     model, params = setup
     prompt = [3, 14, 15, 92, 65]

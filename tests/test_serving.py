@@ -728,6 +728,75 @@ def test_stop_token_validation(setup):
     assert eng.finished(sa)  # rejected admit left state untouched
 
 
+def test_logprobs_match_full_recompute(setup):
+    # per-token logprobs (vLLM's `logprobs` API): chosen + top-n must
+    # equal log-softmax of a full causal recompute at every position
+    model, params = setup
+    prompt = [3, 14, 15, 92]
+    eng = ServingEngine(model, params, n_slots=2, logprobs_k=4)
+    s = eng.admit(prompt, logprobs=3)
+    eng.run(4)
+    toks = eng.output(s)
+    recs = eng.token_logprobs(s)
+    assert len(recs) == len(toks)
+    from tpu_k8s_device_plugin.workloads.inference import init_cache
+    full = jnp.asarray(prompt + toks, jnp.int32)[None, :]
+    T = full.shape[1]
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (1, T))
+    logits, _ = model.apply(
+        {"params": params, "cache": init_cache(model, 1)},
+        full, pos, decode=False, mutable=["cache"])
+    lp = np.asarray(jax.nn.log_softmax(
+        np.asarray(logits, np.float32), axis=-1))[0]
+    for i, (tok, (clp, top)) in enumerate(zip(toks, recs)):
+        row = lp[len(prompt) - 1 + i]
+        assert len(top) == 3
+        np.testing.assert_allclose(clp, row[tok], rtol=1e-4, atol=1e-4)
+        want_ids = np.argsort(-row)[:3]
+        got_ids = [t for t, _ in top]
+        assert got_ids == want_ids.tolist(), f"step {i}"
+        for tid, tlp in top:
+            np.testing.assert_allclose(tlp, row[tid],
+                                       rtol=1e-4, atol=1e-4)
+        # greedy: chosen token IS the top-1
+        assert tok == got_ids[0]
+
+
+def test_logprobs_scan_matches_stepwise(setup):
+    model, params = setup
+    prompt = [5, 17, 3]
+
+    def mk():
+        return ServingEngine(model, params, n_slots=2, logprobs_k=2)
+
+    a, b = mk(), mk()
+    sa = a.admit(prompt, logprobs=2)
+    sb = b.admit(prompt, logprobs=2)
+    for _ in range(4):
+        a.step()
+    b.run_scan(4)
+    ra, rb = a.token_logprobs(sa), b.token_logprobs(sb)
+    assert len(ra) == len(rb) == 5
+    for (ca, ta), (cb, tb) in zip(ra, rb):
+        np.testing.assert_allclose(ca, cb, rtol=1e-5, atol=1e-6)
+        assert [t for t, _ in ta] == [t for t, _ in tb]
+
+
+def test_logprobs_validation_and_isolation(setup):
+    model, params = setup
+    eng = ServingEngine(model, params, n_slots=2, logprobs_k=2)
+    with pytest.raises(ValueError, match="logprobs_k"):
+        eng.admit([1, 2], logprobs=3)
+    s = eng.admit([1, 2])           # no logprobs requested
+    t = eng.admit([3, 4], logprobs=1)
+    eng.run(3)
+    assert eng.token_logprobs(s) == []
+    assert len(eng.token_logprobs(t)) == 4
+    off = ServingEngine(model, params, n_slots=1)  # default k=0
+    with pytest.raises(ValueError, match="logprobs_k"):
+        off.admit([1, 2], logprobs=1)
+
+
 def test_draw_stream_mode_independent_after_retirement(setup):
     # a sampled slot retiring mid-window must leave the engine's key
     # stream where step-by-step scheduling would have left it, so later

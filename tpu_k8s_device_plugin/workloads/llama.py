@@ -36,6 +36,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Optional
 
+import jax
 import jax.numpy as jnp
 
 from .inference import DecodeTransformerLM, make_decoder
@@ -138,12 +139,22 @@ def random_quantized_params(
     2.1 GB f32 embed).  Tree layout matches
     ``quantize_lm_params(train_model(cfg) params)`` exactly (asserted
     in tests/test_llama.py)."""
-    import numpy as np
-
     del dtype  # leaf dtypes are fixed by the real quantized layout
     if bits not in (4, 8):
         raise ValueError(f"bits must be 4 or 8, got {bits}")
-    rng = np.random.default_rng(seed)
+    # jax-native leaf construction (jax.random, not host numpy): the
+    # builder must stay TRACEABLE so tensor-parallel serving can jit it
+    # with out_shardings and materialize each leaf directly on its TP
+    # shard (bench_serving.build_model_and_params) — numpy leaves would
+    # bake full-size device-0 constants into the trace, peaking the
+    # whole tree on one chip, the exact failure the sharded build
+    # exists to avoid.  Eager calls behave as before.
+    root = jax.random.PRNGKey(seed)
+    leaf_counter = iter(range(1 << 20))
+
+    def nk():
+        return jax.random.fold_in(root, next(leaf_counter))
+
     d, f, v = cfg.d_model, cfg.d_ff, cfg.vocab
     hd = cfg.head_dim
     qkv_out = (cfg.n_heads + 2 * cfg.n_kv_heads) * hd
@@ -156,14 +167,13 @@ def random_quantized_params(
 
             g = _int4_group(din)
             return {
-                "kernel_int4": jnp.asarray(
-                    rng.integers(-128, 128, (din, dout // 2),
-                                 dtype=np.int8)),
+                "kernel_int4": jax.random.randint(
+                    nk(), (din, dout // 2), -128, 128, jnp.int8),
                 "scale": jnp.full((din // g, dout), 0.01, jnp.float32),
             }
         return {
-            "kernel_int8": jnp.asarray(
-                rng.integers(-127, 128, (din, dout), dtype=np.int8)),
+            "kernel_int8": jax.random.randint(
+                nk(), (din, dout), -127, 128, jnp.int8),
             "scale": jnp.full((dout,), 0.01, jnp.float32),
         }
 
@@ -172,9 +182,8 @@ def random_quantized_params(
 
     params = {
         "embed": {
-            "embedding": jnp.asarray(
-                rng.standard_normal((v, d), np.float32) * 0.02,
-                jnp.float32)
+            "embedding": jax.random.normal(
+                nk(), (v, d), jnp.float32) * 0.02
         },
         "final_norm": norm(),
         "lm_head": kern(d, v),

@@ -21,7 +21,8 @@ API (JSON over HTTP/1.1):
 
   POST /generate   {"tokens": [int...], "max_new_tokens": N?,
                     "temperature": f?, "top_k": k?, "top_p": p?,
-                    "adapter": a?, "stop": [int...]?, "stream": true?}
+                    "adapter": a?, "stop": [int...]?, "logprobs": n?,
+                    "stream": true?}
                    stream=true (default): chunked body, one JSON line
                    per event — {"token": t} ... then
                    {"done": true, "tokens": [...], "finish_reason": r}
@@ -65,6 +66,7 @@ class _Request:
     top_p: float = 1.0
     adapter: Optional[int] = None
     stop: Optional[List[int]] = None
+    logprobs: Optional[int] = None
     events: "queue.Queue" = field(default_factory=queue.Queue)
     cancelled: bool = False
     emitted: int = 0
@@ -125,7 +127,8 @@ class EngineServer:
                 slot = eng.admit(
                     req.tokens, temperature=req.temperature,
                     top_k=req.top_k, top_p=req.top_p,
-                    adapter=req.adapter, stop=req.stop)
+                    adapter=req.adapter, stop=req.stop,
+                    logprobs=req.logprobs)
             except (ValueError, RuntimeError) as e:
                 self._requests_rejected += 1
                 req.events.put({"error": str(e), "code": 400})
@@ -140,8 +143,14 @@ class EngineServer:
         and retiring the slot when done."""
         eng = self.engine
         new = tokens[req.emitted:req.max_new_tokens]
-        for t in new:
-            req.events.put({"token": int(t)})
+        lps = (eng.token_logprobs(slot) if req.logprobs else None)
+        for j, t in enumerate(new):
+            ev = {"token": int(t)}
+            if lps is not None:
+                clp, top = lps[req.emitted + j]
+                ev["logprob"] = clp
+                ev["top_logprobs"] = [[i, p] for i, p in top]
+            req.events.put(ev)
         req.emitted += len(new)
         finished = eng.finished(slot)
         if req.cancelled:
@@ -160,11 +169,19 @@ class EngineServer:
                 reason = "length"
                 if not finished:
                     eng.release(slot)
-            req.events.put({
+            done = {
                 "done": True,
                 "tokens": [int(t) for t in out],
                 "finish_reason": reason,
-            })
+            }
+            if req.logprobs:
+                done["logprobs"] = [
+                    {"logprob": clp,
+                     "top_logprobs": [[i, p] for i, p in top]}
+                    for clp, top in
+                    eng.token_logprobs(slot)[:len(out)]
+                ]
+            req.events.put(done)
             del self._running[slot]
             self._requests_served += 1
 
@@ -342,6 +359,7 @@ class EngineServer:
             raise ValueError("max_new_tokens must be >= 1")
         top_k = body.get("top_k")
         adapter = body.get("adapter")
+        logprobs = body.get("logprobs")
         stop = body.get("stop")
         if stop is not None and (
                 not isinstance(stop, list)
@@ -358,6 +376,7 @@ class EngineServer:
             top_p=float(body.get("top_p", 1.0)),
             adapter=None if adapter is None else int(adapter),
             stop=stop,
+            logprobs=None if logprobs is None else int(logprobs),
         )
 
     def stats(self) -> dict:
@@ -393,6 +412,9 @@ def main(argv=None) -> int:
     p.add_argument("--max-new-tokens", type=int, default=256,
                    help="default per-request budget")
     p.add_argument("--window", type=int, default=DEFAULT_WINDOW)
+    p.add_argument("--logprobs-k", type=int, default=5,
+                   help="engine-wide top-k logprobs cap (requests ask "
+                        "for n <= k; 0 disables the stats entirely)")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8000)
     args = p.parse_args(argv)
@@ -425,7 +447,7 @@ def main(argv=None) -> int:
         args.config, args.max_len, quantized, mesh=mesh)
     engine = ServingEngine(model, params, n_slots=args.n_slots,
                            eos_id=getattr(cfg, "eos_id", None),
-                           mesh=mesh)
+                           mesh=mesh, logprobs_k=args.logprobs_k)
     srv = EngineServer(engine, max_new_tokens=args.max_new_tokens,
                        window=args.window)
     srv.start(host=args.host, port=args.port)

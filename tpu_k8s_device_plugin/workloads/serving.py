@@ -184,15 +184,28 @@ def _pick_tokens(logits, temps, topks, topps, key):
     return jnp.argmax(noised, axis=-1).astype(jnp.int32)
 
 
+@functools.partial(jax.jit, static_argnums=(2,))
+def _top_logprobs(logits, chosen, k):
+    """log-softmax stats for emitted tokens: ([S] chosen logprob,
+    [S, k] top-k logprobs, [S, k] top-k token ids).  Raw-logit
+    log-softmax (temperature-independent — what evaluators score)."""
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    top_lp, top_id = jax.lax.top_k(lp, k)
+    chosen_lp = jnp.take_along_axis(lp, chosen[:, None], axis=-1)[:, 0]
+    return chosen_lp, top_lp, top_id
+
+
 @functools.partial(
-    jax.jit, static_argnums=(0, 1, 2), donate_argnums=(4,)
+    jax.jit, static_argnums=(0, 1, 2, 3), donate_argnums=(5,)
 )
-def _scan_decode(model, n_steps, sampled, params, cache, last, lens,
-                 temps, topks, topps, adapter_ids, rng, draws0):
+def _scan_decode(model, n_steps, sampled, lp_k, params, cache, last,
+                 lens, temps, topks, topps, adapter_ids, rng, draws0):
     """n_steps decode steps in one lax.scan.  The per-step sampling key
     is fold_in(rng, draws0 + i) — the same chain ``step`` consumes one
     link of per call, so scan and step-by-step emit identical streams.
-    Greedy mode (sampled=False) skips the pick entirely."""
+    Greedy mode (sampled=False) skips the pick entirely.  With lp_k,
+    per-step logprob stats ride the scan outputs (one compiled variant
+    per engine-wide k — never per request)."""
 
     def step_fn(carry, i):
         cache, tok, pos = carry
@@ -209,12 +222,16 @@ def _scan_decode(model, n_steps, sampled, params, cache, last, lens,
             )
         else:
             nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
-        return (mut["cache"], nxt, pos + 1), nxt
+        if lp_k:
+            out = (nxt,) + _top_logprobs(lg, nxt, lp_k)
+        else:
+            out = (nxt,)
+        return (mut["cache"], nxt, pos + 1), out
 
-    (cache, _, _), toks = lax.scan(
+    (cache, _, _), ys = lax.scan(
         step_fn, (cache, last, lens), jnp.arange(n_steps)
     )
-    return toks, cache
+    return ys, cache
 
 
 class ServingEngine:
@@ -238,9 +255,12 @@ class ServingEngine:
         rng: Optional[jax.Array] = None,
         auto_prefix: bool = True,
         auto_prefix_min: int = 8,
+        logprobs_k: int = 0,
     ):
         if n_slots < 1:
             raise ValueError("n_slots must be >= 1")
+        if logprobs_k < 0:
+            raise ValueError("logprobs_k must be >= 0")
         if chunk == "auto":
             # compile-safe default: every admission reuses ONE compiled
             # extend shape no matter how many distinct prompt lengths
@@ -294,6 +314,13 @@ class ServingEngine:
         # per-request stop-token sets (vLLM's `stop_token_ids`):
         # host-side data consulted at harvest, never a recompile
         self._stops: List[frozenset] = [frozenset()] * n_slots
+        # logprobs: the engine computes top-`logprobs_k` stats for ALL
+        # slots when enabled (one compiled variant, engine-wide k —
+        # masking, not branching); requests ask for n <= k and the
+        # host trims.  vLLM's `logprobs` API, compile-stable.
+        self.logprobs_k = logprobs_k
+        self._lp_want = [0] * n_slots
+        self._lp_records: List[list] = [[] for _ in range(n_slots)]
         self._prefixes: Dict[int, tuple] = {}
         self._next_prefix = 0
         # automatic prefix caching (vLLM's APC, the feature the
@@ -471,7 +498,8 @@ class ServingEngine:
               top_k: Optional[int] = None,
               top_p: float = 1.0,
               adapter: Optional[int] = None,
-              stop: Optional[List[int]] = None) -> int:
+              stop: Optional[List[int]] = None,
+              logprobs: Optional[int] = None) -> int:
         """Prefill *prompt* into a free slot; returns the slot id.
         Raises RuntimeError when the engine is full (callers queue).
         With ``prefix`` (a :meth:`register_prefix` handle), the prompt
@@ -505,6 +533,14 @@ class ServingEngine:
                 raise ValueError(
                     f"stop token {t} outside [0, vocab="
                     f"{self.model.vocab})")
+        lp_n = int(logprobs or 0)
+        if lp_n < 0:
+            raise ValueError("logprobs must be >= 0")
+        if lp_n > self.logprobs_k:
+            raise ValueError(
+                f"logprobs={lp_n} exceeds the engine's logprobs_k="
+                f"{self.logprobs_k} (set at construction — the "
+                "engine-wide k keeps the decode step compile-stable)")
         budget = self.max_new_tokens or 1
         if t_p + budget > self.model.max_len:
             raise ValueError(
@@ -597,15 +633,48 @@ class ServingEngine:
         self.topps[slot] = top_p
         self.adapters[slot] = aid
         self._stops[slot] = stops
+        self._lp_want[slot] = lp_n
+        self._lp_records[slot] = []
         first = int(self._sample(
             last[None, :], np.asarray([temperature], np.float32),
             np.asarray([top_k or 0], np.int32),
             np.asarray([top_p], np.float32))[0])
+        if lp_n:
+            clp, tlp, tid = _top_logprobs(
+                last[None, :], jnp.asarray([first], jnp.int32),
+                self.logprobs_k)
+            self._record_logprobs(slot, float(np.asarray(clp)[0]),
+                                  np.asarray(tlp)[0], np.asarray(tid)[0])
         self.last_token[slot] = first
         self.outputs[slot] = [first]
         self._tokens += 1
         self._maybe_finish(slot, first)
         return slot
+
+    def _record_logprobs(self, slot: int, chosen_lp: float,
+                         top_lp, top_id) -> None:
+        """Append one emitted token's stats, trimmed to the request's
+        n: (chosen logprob, [(token id, logprob) x n])."""
+        n = self._lp_want[slot]
+        self._lp_records[slot].append((
+            chosen_lp,
+            [(int(top_id[j]), float(top_lp[j])) for j in range(n)],
+        ))
+
+    def _harvest_logprobs(self, clp, tlp, tid) -> None:
+        """Record one decode step's [S]-wide logprob stats for every
+        active slot that asked (host arrays)."""
+        for s in range(self.n_slots):
+            if self.active[s] and self._lp_want[s]:
+                self._record_logprobs(s, float(clp[s]), tlp[s], tid[s])
+
+    def token_logprobs(self, slot: int):
+        """Per-token logprob records for *slot* (finished or in
+        flight), parallel to :meth:`output`: a list of
+        ``(chosen_logprob, [(token_id, logprob), ...])`` with the
+        request's ``logprobs`` n entries each.  Empty when the request
+        didn't ask."""
+        return list(self._lp_records[slot])
 
     def _sample(self, logits, temps, topks, topps):
         if not _knobs_live(temps, topks, topps):
@@ -643,6 +712,13 @@ class ServingEngine:
         self._steps += 1
         nxt = self._sample(logits[:, -1, :], self.temps, self.topks,
                            self.topps)
+        if self.logprobs_k and any(
+                self._lp_want[s] for s in range(self.n_slots)
+                if self.active[s]):
+            clp, tlp, tid = _top_logprobs(
+                logits[:, -1, :], jnp.asarray(nxt), self.logprobs_k)
+            self._harvest_logprobs(
+                np.asarray(clp), np.asarray(tlp), np.asarray(tid))
         out = {}
         for s in range(self.n_slots):
             self.lens[s] += 1  # every slot appended (masking, not branching)
@@ -684,16 +760,25 @@ class ServingEngine:
                     f"slot {s} has {self.model.max_len - self.lens[s]} "
                     f"cache rows left, need {n_steps}")
         sampled = _knobs_live(self.temps, self.topks, self.topps)
+        # logprob stats ride the scan only when someone is listening:
+        # at most two compiled variants (k and 0), never per request
+        lp_k = self.logprobs_k if any(
+            self._lp_want[s] for s in range(self.n_slots)
+            if self.active[s]) else 0
         aids = (jnp.asarray(self.adapters)
                 if self.model.n_adapters > 0 else None)
-        toks, self.cache = _scan_decode(
-            self.model, n_steps, sampled, self.params, self.cache,
+        ys, self.cache = _scan_decode(
+            self.model, n_steps, sampled, lp_k, self.params, self.cache,
             jnp.asarray(self.last_token), jnp.asarray(self.lens, jnp.int32),
             jnp.asarray(self.temps), jnp.asarray(self.topks),
             jnp.asarray(self.topps), aids, self._rng,
             jnp.int32(self._draws),
         )
-        toks = np.asarray(toks, dtype=np.int32)  # [n_steps, S]
+        toks = np.asarray(ys[0], dtype=np.int32)  # [n_steps, S]
+        if lp_k:
+            clps = np.asarray(ys[1])   # [n_steps, S]
+            tlps = np.asarray(ys[2])   # [n_steps, S, k]
+            tids = np.asarray(ys[3])   # [n_steps, S, k]
         self._steps += n_steps
         out: Dict[int, List[int]] = {
             s: [] for s in range(self.n_slots) if self.active[s]
@@ -709,6 +794,8 @@ class ServingEngine:
             if sampled and _knobs_live(self.temps, self.topks,
                                        self.topps):
                 draws_used += 1
+            if lp_k:
+                self._harvest_logprobs(clps[i], tlps[i], tids[i])
             for s in range(self.n_slots):
                 self.lens[s] += 1
                 if not self.active[s]:
@@ -788,3 +875,4 @@ class ServingEngine:
         self.topps[slot] = 1.0
         self.adapters[slot] = -1
         self._stops[slot] = frozenset()
+        self._lp_want[slot] = 0  # records stay readable post-finish
