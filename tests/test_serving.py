@@ -728,6 +728,69 @@ def test_stop_token_validation(setup):
     assert eng.finished(sa)  # rejected admit left state untouched
 
 
+def test_min_p_one_equals_greedy(setup):
+    # min_p = 1.0 keeps only tokens at least as probable as the argmax
+    # -> exactly the argmax, at any temperature
+    model, params = setup
+    prompt = [2, 71, 82, 9]
+    eng = ServingEngine(model, params, n_slots=1)
+    s = eng.admit(prompt, temperature=3.0, min_p=1.0)
+    eng.run(6)
+    assert eng.output(s)[:7] == _solo(model, params, prompt, 7)
+
+
+def test_min_p_tokens_stay_in_support(setup):
+    # every sampled token's candidate probability must be >= min_p
+    # times the argmax's (full recompute oracle, one causal forward)
+    model, params = setup
+    prompt = [5, 9, 3]
+    MIN_P = 0.5
+    eng = ServingEngine(model, params, n_slots=1,
+                        rng=jax.random.PRNGKey(19))
+    s = eng.admit(prompt, temperature=1.2, min_p=MIN_P)
+    eng.run(6)
+    toks = eng.output(s)
+    from tpu_k8s_device_plugin.workloads.inference import init_cache as _ic
+    full = jnp.asarray(prompt + toks, jnp.int32)[None, :]
+    T = full.shape[1]
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (1, T))
+    logits, _ = model.apply(
+        {"params": params, "cache": _ic(model, 1)},
+        full, pos, decode=False, mutable=["cache"])
+    for i, tok in enumerate(toks):
+        # candidate distribution at temperature 1.2 (min_p thresholds
+        # the TEMPERATURE-SCALED probabilities)
+        pr = np.asarray(jax.nn.softmax(
+            np.asarray(logits[0, len(prompt) - 1 + i], np.float64)
+            / 1.2))
+        assert pr[tok] >= MIN_P * pr.max() * (1 - 1e-6), f"step {i}"
+
+
+def test_min_p_scan_matches_stepwise(setup):
+    model, params = setup
+
+    def mk():
+        return ServingEngine(model, params, n_slots=1,
+                             rng=jax.random.PRNGKey(23))
+
+    a, b = mk(), mk()
+    sa = a.admit([5, 17, 3], temperature=1.0, min_p=0.3)
+    sb = b.admit([5, 17, 3], temperature=1.0, min_p=0.3)
+    for _ in range(5):
+        a.step()
+    b.run_scan(5)
+    assert a.output(sa) == b.output(sb)
+
+
+def test_min_p_validation(setup):
+    model, params = setup
+    eng = ServingEngine(model, params, n_slots=1)
+    with pytest.raises(ValueError, match="min_p"):
+        eng.admit([1, 2], min_p=1.5)
+    with pytest.raises(ValueError, match="min_p"):
+        eng.admit([1, 2], min_p=-0.1)
+
+
 def test_logprobs_match_full_recompute(setup):
     # per-token logprobs (vLLM's `logprobs` API): chosen + top-n must
     # equal log-softmax of a full causal recompute at every position

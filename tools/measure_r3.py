@@ -1,0 +1,304 @@
+"""Round-3 on-chip measurement backlog, runnable as ONE command.
+
+The TPU tunnel has been down for most of rounds 2-3; every queued
+measurement (VERDICT r2 #1/#2/#7 + BASELINE.md backlog) is encoded here
+so a brief tunnel window captures all of it:
+
+    python tools/measure_r3.py            # everything, ~15-25 min
+    python tools/measure_r3.py --phase pool_ab   # one phase
+
+Each phase runs in a SUBPROCESS (weights for the 8B configs must be
+freed between phases — jax holds device buffers for the life of the
+process) with its own timeout; failures are recorded per phase and the
+rest continue.  Results land in MEASURE_r03.json, ready to be copied
+into BASELINE.md and to drive the default flips (AlexNet pool impl —
+VERDICT r2 asks for xla vs pallas vs fused with the winner as default).
+
+Sync discipline: all timing helpers here sync by VALUE TRANSFER
+(float of one element), never block_until_ready — the axon tunnel can
+report buffers ready early and inflate numbers ~70x (verify skill
+gotchas).
+
+NOT here: zigzag-vs-contiguous ring on ICI (VERDICT r2 #7) — rings
+need >= 2 devices and the tunnel exposes ONE chip; recorded as
+hardware-blocked in BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "MEASURE_r03.json")
+
+# (name, timeout seconds); order: cheap headline stuff first so a short
+# window still produces the most important numbers
+PHASES = [
+    ("probe", 180),
+    ("alexnet_pool_xla", 900),
+    ("alexnet_pool_pallas", 900),
+    ("alexnet_pool_fused", 900),
+    ("flash_attention", 900),
+    ("pool_kernel", 600),
+    ("serving_int8_b1", 1200),
+    ("serving_int8_b8", 1200),
+    ("serving_int8_b8_engine", 1200),
+    ("serving_int4_b1", 1200),
+    ("serving_int8_b32", 1200),
+    ("int4_bytes", 900),
+]
+
+
+def _sync(x) -> float:
+    """Value-transfer sync (see module docstring)."""
+    import numpy as np
+
+    return float(np.asarray(x).ravel()[0])
+
+
+# -- phases (run inside the subprocess) ---------------------------------------
+
+def phase_probe():
+    import jax
+
+    devs = jax.devices()
+    return {
+        "platform": devs[0].platform,
+        "device_kind": getattr(devs[0], "device_kind", "?"),
+        "n_devices": len(devs),
+    }
+
+
+def _alexnet(pool: str):
+    import jax
+
+    from tpu_k8s_device_plugin.workloads.bench_main import run_single
+
+    if jax.devices()[0].platform == "cpu":
+        raise RuntimeError("no accelerator")
+    ips, flops = run_single(4096, 10, 3, want_flops=True, rounds=3,
+                            pool=pool)
+    mfu = None
+    from tpu_k8s_device_plugin.tpu.topology import spec_for_device_kind
+
+    spec = spec_for_device_kind(
+        getattr(jax.devices()[0], "device_kind", "") or "")
+    if flops and spec:
+        mfu = (flops / 4096) * ips / float(spec.peak_bf16_flops)
+    return {"images_per_sec": round(ips, 1), "pool": pool,
+            "mfu": round(mfu, 4) if mfu else None}
+
+
+def phase_alexnet_pool_xla():
+    return _alexnet("xla")
+
+
+def phase_alexnet_pool_pallas():
+    return _alexnet("pallas")
+
+
+def phase_alexnet_pool_fused():
+    return _alexnet("fused")
+
+
+def phase_flash_attention():
+    """flash vs einsum attention, fwd and fwd+bwd, bf16 (the r2 claims
+    were 2.8x fwd / 1.98x fwd+bwd pre-outage)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_k8s_device_plugin.workloads.flash_attention import (
+        flash_causal_attention,
+    )
+    from tpu_k8s_device_plugin.workloads.ring_attention import (
+        full_attention,
+    )
+
+    B, T, H, D = 2, 2048, 8, 128
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, T, H, D), jnp.bfloat16)
+               for kk in ks)
+
+    def timed(fn, *args, reps=20):
+        f = jax.jit(fn)
+        _sync(f(*args))  # compile
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = f(*args)
+        _sync(out)
+        return (time.perf_counter() - t0) / reps * 1e3
+
+    res = {}
+    res["fwd_flash_ms"] = timed(
+        lambda q, k, v: flash_causal_attention(q, k, v), q, k, v)
+    res["fwd_einsum_ms"] = timed(
+        lambda q, k, v: full_attention(q, k, v, causal=True), q, k, v)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_causal_attention(q, k, v)
+                       .astype(jnp.float32) ** 2)
+
+    def loss_einsum(q, k, v):
+        return jnp.sum(full_attention(q, k, v, causal=True)
+                       .astype(jnp.float32) ** 2)
+
+    res["fwdbwd_flash_ms"] = timed(
+        jax.grad(loss_flash, argnums=(0, 1, 2)), q, k, v, reps=10)
+    res["fwdbwd_einsum_ms"] = timed(
+        jax.grad(loss_einsum, argnums=(0, 1, 2)), q, k, v, reps=10)
+    res["fwd_speedup"] = round(
+        res["fwd_einsum_ms"] / res["fwd_flash_ms"], 2)
+    res["fwdbwd_speedup"] = round(
+        res["fwdbwd_einsum_ms"] / res["fwdbwd_flash_ms"], 2)
+    res["shape"] = [B, T, H, D]
+    return res
+
+
+def phase_pool_kernel():
+    """Pallas argmax-index pool vs XLA reduce_window/select_and_scatter
+    fwd+bwd at the AlexNet seg1 shape (the BASELINE.md backlog item)."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_k8s_device_plugin.workloads.pool import max_pool
+
+    x = jax.random.normal(
+        jax.random.PRNGKey(0), (4096, 56, 56, 64), jnp.bfloat16)
+
+    def timed_grad(fn, reps=10):
+        g = jax.jit(jax.grad(
+            lambda a: jnp.sum(fn(a).astype(jnp.float32) ** 2)))
+        _sync(g(x))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = g(x)
+        _sync(out)
+        return (time.perf_counter() - t0) / reps * 1e3
+
+    return {
+        "xla_fwdbwd_ms": timed_grad(
+            lambda a: nn.max_pool(a, (3, 3), (2, 2))),
+        "pallas_fwdbwd_ms": timed_grad(lambda a: max_pool(a, 3, 2)),
+        "shape": [4096, 56, 56, 64],
+    }
+
+
+def _serving(quantized, batch, steps, max_len, engine=False):
+    from tpu_k8s_device_plugin.workloads.bench_serving import run
+
+    return run("llama3-8b", quantized, batch, steps,
+               prompt_len=128, max_len=max_len, engine=engine)
+
+
+def phase_serving_int8_b1():
+    return _serving(True, 1, 128, 512)
+
+
+def phase_serving_int8_b8():
+    return _serving(True, 8, 128, 512)
+
+
+def phase_serving_int8_b8_engine():
+    return _serving(True, 8, 64, 512, engine=True)
+
+
+def phase_serving_int8_b32():
+    # 10.4 GB weights + ~4.3 GB cache at max_len 256: tight on a 16 GB
+    # v5e — an OOM here is a finding, not a harness bug
+    return _serving(True, 32, 64, 256)
+
+
+def phase_serving_int4_b1():
+    return _serving("int4", 1, 128, 512)
+
+
+def phase_int4_bytes():
+    """Is the int4 nibble-unpack fused into the matmul, or does XLA
+    materialize the bf16 kernel?  (ADVICE r2: the int4 bandwidth win is
+    a fusion property.)  Compare XLA-reported bytes accessed for one
+    decode-shaped matmul, int8 vs int4."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_k8s_device_plugin.workloads.inference import (
+        Quant4Dense,
+        QuantDense,
+    )
+
+    D, F, B = 4096, 14336, 8
+    x = jnp.zeros((B, 1, D), jnp.bfloat16)
+    out = {}
+    for name, mod in (("int8", QuantDense(features=F, use_bias=False,
+                                          dtype=jnp.bfloat16)),
+                      ("int4", Quant4Dense(features=F, use_bias=False,
+                                           dtype=jnp.bfloat16))):
+        params = mod.init(jax.random.PRNGKey(0), x)
+
+        def f(p, x):
+            return mod.apply(p, x)
+
+        compiled = jax.jit(f).lower(params, x).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0] if ca else {}
+        out[f"{name}_bytes_accessed"] = ca.get("bytes accessed")
+    if out.get("int8_bytes_accessed") and out.get("int4_bytes_accessed"):
+        out["int4_over_int8"] = round(
+            out["int4_bytes_accessed"] / out["int8_bytes_accessed"], 3)
+    return out
+
+
+# -- orchestration ------------------------------------------------------------
+
+def run_phase_subprocess(name: str, timeout: int) -> dict:
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--phase", name],
+            capture_output=True, text=True, timeout=timeout, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout after {timeout}s"}
+    dt = round(time.time() - t0, 1)
+    if proc.returncode != 0:
+        return {"error": proc.stderr.strip()[-2000:], "seconds": dt}
+    for line in reversed(proc.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            d = json.loads(line)
+            d["seconds"] = dt
+            return d
+    return {"error": f"no JSON in output: {proc.stdout[-500:]}",
+            "seconds": dt}
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--phase", default=None,
+                   help="run one phase in-process and print its JSON")
+    args = p.parse_args()
+    if args.phase:
+        result = globals()[f"phase_{args.phase}"]()
+        print(json.dumps(result))
+        return 0
+
+    results = {}
+    for name, timeout in PHASES:
+        print(f"== {name} (limit {timeout}s)", flush=True)
+        results[name] = run_phase_subprocess(name, timeout)
+        print(json.dumps({name: results[name]}), flush=True)
+        with open(OUT, "w") as f:
+            json.dump(results, f, indent=1)
+        if name == "probe" and "error" in results[name]:
+            print("no chip reachable — aborting", flush=True)
+            return 1
+    print(f"wrote {OUT}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

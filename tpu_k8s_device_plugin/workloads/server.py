@@ -21,8 +21,8 @@ API (JSON over HTTP/1.1):
 
   POST /generate   {"tokens": [int...], "max_new_tokens": N?,
                     "temperature": f?, "top_k": k?, "top_p": p?,
-                    "adapter": a?, "stop": [int...]?, "logprobs": n?,
-                    "stream": true?}
+                    "min_p": m?, "adapter": a?, "stop": [int...]?,
+                    "logprobs": n?, "stream": true?}
                    stream=true (default): chunked body, one JSON line
                    per event — {"token": t} ... then
                    {"done": true, "tokens": [...], "finish_reason": r}
@@ -64,6 +64,7 @@ class _Request:
     temperature: float = 0.0
     top_k: Optional[int] = None
     top_p: float = 1.0
+    min_p: float = 0.0
     adapter: Optional[int] = None
     stop: Optional[List[int]] = None
     logprobs: Optional[int] = None
@@ -127,6 +128,7 @@ class EngineServer:
                 slot = eng.admit(
                     req.tokens, temperature=req.temperature,
                     top_k=req.top_k, top_p=req.top_p,
+                    min_p=req.min_p,
                     adapter=req.adapter, stop=req.stop,
                     logprobs=req.logprobs)
             except (ValueError, RuntimeError) as e:
@@ -374,6 +376,7 @@ class EngineServer:
             temperature=float(body.get("temperature", 0.0)),
             top_k=None if top_k is None else int(top_k),
             top_p=float(body.get("top_p", 1.0)),
+            min_p=float(body.get("min_p", 0.0)),
             adapter=None if adapter is None else int(adapter),
             stop=stop,
             logprobs=None if logprobs is None else int(logprobs),

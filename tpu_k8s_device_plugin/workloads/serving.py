@@ -132,20 +132,22 @@ def _lcp(a: np.ndarray, b: np.ndarray) -> int:
     return L if not neq[idx] else idx
 
 
-def _knobs_live(temps, topks, topps) -> bool:
+def _knobs_live(temps, topks, topps, minps) -> bool:
     """True when any slot's sampling knobs are armed.  THE predicate
     the engine's key-stream accounting hangs on: _sample's greedy fast
     path, run_scan's sampled flag, and its per-step draw count must
     all agree, or step() and run_scan() leave different draw counters
     behind (the streams would diverge after a retirement)."""
-    return bool(temps.any() or topks.any() or (np.asarray(topps) < 1.0).any())
+    return bool(temps.any() or topks.any()
+                or (np.asarray(topps) < 1.0).any() or minps.any())
 
 
 @jax.jit
-def _pick_tokens(logits, temps, topks, topps, key):
+def _pick_tokens(logits, temps, topks, topps, minps, key):
     """Per-slot sampling in one vectorized pass: [S, V] logits with
-    per-slot temperature (0 = greedy), top-k (0 = unrestricted), and
-    top-p / nucleus (1.0 = unrestricted).  The per-slot knobs are DATA,
+    per-slot temperature (0 = greedy), top-k (0 = unrestricted),
+    top-p / nucleus (1.0 = unrestricted), and min-p (0 =
+    unrestricted).  The per-slot knobs are DATA,
     not shapes, so mixed greedy/sampled batches share the engine's one
     compiled step.  Gumbel-max sampling: argmax(logits/T + G) is a
     categorical draw from softmax(logits/T), and zeroing the noise
@@ -154,7 +156,10 @@ def _pick_tokens(logits, temps, topks, topps, key):
     keeps the smallest prefix of the TEMPERATURE-SCALED distribution
     whose mass reaches p (a token survives when the mass strictly
     before it is < p — the argmax always survives, so greedy rows are
-    untouched by any p)."""
+    untouched by any p).  min-p keeps tokens whose candidate
+    probability is >= min_p times the argmax's (applied after
+    top-k/top-p, vLLM's sequential semantics) — in logit space, within
+    log(min_p) of the surviving max, so the argmax always survives."""
     S, V = logits.shape
     logits = logits.astype(jnp.float32)
     safe_t = jnp.where(temps > 0, temps, 1.0)
@@ -179,6 +184,14 @@ def _pick_tokens(logits, temps, topks, topps, key):
     n_keep = jnp.maximum(jnp.sum(keep, axis=-1), 1)
     pth = sorted_scaled[rows, n_keep - 1]
     masked = jnp.where(scaled >= pth[:, None], masked, -jnp.inf)
+    # min-p on the surviving candidates: threshold at
+    # max + log(min_p) in (scaled) logit space; rows with min_p == 0
+    # are left untouched (log of the epsilon-clamped 0 would otherwise
+    # cut tokens ~88 nats below the max)
+    mmax = jnp.max(masked, axis=-1, keepdims=True)
+    thresh = mmax + jnp.log(jnp.maximum(minps, 1e-30))[:, None]
+    masked = jnp.where(
+        (minps[:, None] > 0) & (scaled < thresh), -jnp.inf, masked)
     gumbel = jax.random.gumbel(key, (S, V), jnp.float32)
     noised = masked + jnp.where(temps[:, None] > 0, gumbel, 0.0)
     return jnp.argmax(noised, axis=-1).astype(jnp.int32)
@@ -199,7 +212,8 @@ def _top_logprobs(logits, chosen, k):
     jax.jit, static_argnums=(0, 1, 2, 3), donate_argnums=(5,)
 )
 def _scan_decode(model, n_steps, sampled, lp_k, params, cache, last,
-                 lens, temps, topks, topps, adapter_ids, rng, draws0):
+                 lens, temps, topks, topps, minps, adapter_ids, rng,
+                 draws0):
     """n_steps decode steps in one lax.scan.  The per-step sampling key
     is fold_in(rng, draws0 + i) — the same chain ``step`` consumes one
     link of per call, so scan and step-by-step emit identical streams.
@@ -217,7 +231,7 @@ def _scan_decode(model, n_steps, sampled, lp_k, params, cache, last,
         lg = logits[:, -1, :]
         if sampled:
             nxt = _pick_tokens(
-                lg, temps, topks, topps,
+                lg, temps, topks, topps, minps,
                 jax.random.fold_in(rng, draws0 + i),
             )
         else:
@@ -349,6 +363,7 @@ class ServingEngine:
         self.temps = np.zeros(n_slots, np.float32)
         self.topks = np.zeros(n_slots, np.int32)
         self.topps = np.ones(n_slots, np.float32)
+        self.minps = np.zeros(n_slots, np.float32)
         # per-slot LoRA adapter ids (-1 = base model); only consulted
         # when the model was built with n_adapters > 0
         self.adapters = np.full(n_slots, -1, np.int32)
@@ -497,6 +512,7 @@ class ServingEngine:
               temperature: float = 0.0,
               top_k: Optional[int] = None,
               top_p: float = 1.0,
+              min_p: float = 0.0,
               adapter: Optional[int] = None,
               stop: Optional[List[int]] = None,
               logprobs: Optional[int] = None) -> int:
@@ -526,6 +542,8 @@ class ServingEngine:
         validate_top_k(self.model, top_k)
         if not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p {top_p} outside (0, 1]")
+        if not 0.0 <= min_p <= 1.0:
+            raise ValueError(f"min_p {min_p} outside [0, 1]")
         aid = self._check_adapter(adapter)
         stops = frozenset(int(t) for t in (stop or ()))
         for t in stops:
@@ -631,6 +649,7 @@ class ServingEngine:
         self.temps[slot] = temperature
         self.topks[slot] = top_k or 0
         self.topps[slot] = top_p
+        self.minps[slot] = min_p
         self.adapters[slot] = aid
         self._stops[slot] = stops
         self._lp_want[slot] = lp_n
@@ -638,7 +657,8 @@ class ServingEngine:
         first = int(self._sample(
             last[None, :], np.asarray([temperature], np.float32),
             np.asarray([top_k or 0], np.int32),
-            np.asarray([top_p], np.float32))[0])
+            np.asarray([top_p], np.float32),
+            np.asarray([min_p], np.float32))[0])
         if lp_n:
             clp, tlp, tid = _top_logprobs(
                 last[None, :], jnp.asarray([first], jnp.int32),
@@ -676,8 +696,8 @@ class ServingEngine:
         didn't ask."""
         return list(self._lp_records[slot])
 
-    def _sample(self, logits, temps, topks, topps):
-        if not _knobs_live(temps, topks, topps):
+    def _sample(self, logits, temps, topks, topps, minps):
+        if not _knobs_live(temps, topks, topps, minps):
             # all-greedy batch (the default): plain argmax — no vocab
             # sort, no Gumbel draw, and the key stream stays untouched
             # so adding a sampled request never shifts greedy outputs
@@ -687,7 +707,8 @@ class ServingEngine:
         self._draws += 1
         return np.asarray(
             _pick_tokens(logits, jnp.asarray(temps), jnp.asarray(topks),
-                         jnp.asarray(topps), key), dtype=np.int32)
+                         jnp.asarray(topps), jnp.asarray(minps), key),
+            dtype=np.int32)
 
     # -- decoding ----------------------------------------------------------
 
@@ -711,7 +732,7 @@ class ServingEngine:
             aids)
         self._steps += 1
         nxt = self._sample(logits[:, -1, :], self.temps, self.topks,
-                           self.topps)
+                           self.topps, self.minps)
         if self.logprobs_k and any(
                 self._lp_want[s] for s in range(self.n_slots)
                 if self.active[s]):
@@ -759,7 +780,8 @@ class ServingEngine:
                 raise ValueError(
                     f"slot {s} has {self.model.max_len - self.lens[s]} "
                     f"cache rows left, need {n_steps}")
-        sampled = _knobs_live(self.temps, self.topks, self.topps)
+        sampled = _knobs_live(self.temps, self.topks, self.topps,
+                              self.minps)
         # logprob stats ride the scan only when someone is listening:
         # at most two compiled variants (k and 0), never per request
         lp_k = self.logprobs_k if any(
@@ -771,8 +793,8 @@ class ServingEngine:
             self.model, n_steps, sampled, lp_k, self.params, self.cache,
             jnp.asarray(self.last_token), jnp.asarray(self.lens, jnp.int32),
             jnp.asarray(self.temps), jnp.asarray(self.topks),
-            jnp.asarray(self.topps), aids, self._rng,
-            jnp.int32(self._draws),
+            jnp.asarray(self.topps), jnp.asarray(self.minps), aids,
+            self._rng, jnp.int32(self._draws),
         )
         toks = np.asarray(ys[0], dtype=np.int32)  # [n_steps, S]
         if lp_k:
@@ -792,7 +814,7 @@ class ServingEngine:
             # scheduling API ran this window — the scan's keys for
             # post-retirement steps produced only discarded tokens
             if sampled and _knobs_live(self.temps, self.topks,
-                                       self.topps):
+                                       self.topps, self.minps):
                 draws_used += 1
             if lp_k:
                 self._harvest_logprobs(clps[i], tlps[i], tids[i])
@@ -873,6 +895,7 @@ class ServingEngine:
         self.temps[slot] = 0.0
         self.topks[slot] = 0
         self.topps[slot] = 1.0
+        self.minps[slot] = 0.0
         self.adapters[slot] = -1
         self._stops[slot] = frozenset()
         self._lp_want[slot] = 0  # records stay readable post-finish
