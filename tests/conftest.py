@@ -12,9 +12,15 @@ import sys
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_backend_optimization_level" not in _flags:
+    # tests are COMPILE-bound on this box (dozens of distinct mesh
+    # compiles, one CPU core); backend opt level 0 halves compile time
+    # and the tests only check correctness, with both sides of every
+    # oracle comparison compiled the same way.  bench.py and the
+    # driver's dryrun run outside conftest and keep full optimization.
+    _flags = _flags + " --xla_backend_optimization_level=0"
+os.environ["XLA_FLAGS"] = _flags
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

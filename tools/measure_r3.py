@@ -55,10 +55,13 @@ PHASES = [
 
 
 def _sync(x) -> float:
-    """Value-transfer sync (see module docstring)."""
-    import numpy as np
+    """Value-transfer sync (see module docstring): slice ONE element
+    on device and transfer only that — np.asarray of a whole gradient
+    tree would ship GBs through the tunnel inside the timed region."""
+    import jax
 
-    return float(np.asarray(x).ravel()[0])
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    return float(leaf.ravel()[0])
 
 
 # -- phases (run inside the subprocess) ---------------------------------------

@@ -21,8 +21,9 @@ API (JSON over HTTP/1.1):
 
   POST /generate   {"tokens": [int...], "max_new_tokens": N?,
                     "temperature": f?, "top_k": k?, "top_p": p?,
-                    "min_p": m?, "adapter": a?, "stop": [int...]?,
-                    "logprobs": n?, "stream": true?}
+                    "min_p": m?, "presence_penalty": f?,
+                    "frequency_penalty": f?, "adapter": a?,
+                    "stop": [int...]?, "logprobs": n?, "stream": true?}
                    stream=true (default): chunked body, one JSON line
                    per event — {"token": t} ... then
                    {"done": true, "tokens": [...], "finish_reason": r}
@@ -65,6 +66,8 @@ class _Request:
     top_k: Optional[int] = None
     top_p: float = 1.0
     min_p: float = 0.0
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
     adapter: Optional[int] = None
     stop: Optional[List[int]] = None
     logprobs: Optional[int] = None
@@ -129,6 +132,8 @@ class EngineServer:
                     req.tokens, temperature=req.temperature,
                     top_k=req.top_k, top_p=req.top_p,
                     min_p=req.min_p,
+                    presence_penalty=req.presence_penalty,
+                    frequency_penalty=req.frequency_penalty,
                     adapter=req.adapter, stop=req.stop,
                     logprobs=req.logprobs)
             except (ValueError, RuntimeError) as e:
@@ -377,6 +382,8 @@ class EngineServer:
             top_k=None if top_k is None else int(top_k),
             top_p=float(body.get("top_p", 1.0)),
             min_p=float(body.get("min_p", 0.0)),
+            presence_penalty=float(body.get("presence_penalty", 0.0)),
+            frequency_penalty=float(body.get("frequency_penalty", 0.0)),
             adapter=None if adapter is None else int(adapter),
             stop=stop,
             logprobs=None if logprobs is None else int(logprobs),

@@ -132,22 +132,56 @@ def _lcp(a: np.ndarray, b: np.ndarray) -> int:
     return L if not neq[idx] else idx
 
 
-def _knobs_live(temps, topks, topps, minps) -> bool:
+def _knobs_live(temps, topks, topps, minps, pres, freqs) -> bool:
     """True when any slot's sampling knobs are armed.  THE predicate
     the engine's key-stream accounting hangs on: _sample's greedy fast
     path, run_scan's sampled flag, and its per-step draw count must
     all agree, or step() and run_scan() leave different draw counters
-    behind (the streams would diverge after a retirement)."""
+    behind (the streams would diverge after a retirement).  Penalties
+    arm it too: a penalized temp-0 request still needs the full pick
+    (penalized argmax != plain argmax)."""
     return bool(temps.any() or topks.any()
-                or (np.asarray(topps) < 1.0).any() or minps.any())
+                or (np.asarray(topps) < 1.0).any() or minps.any()
+                or pres.any() or freqs.any())
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _zero_count_row(counts, slot):
+    """Reset one slot's output-token histogram (at admit)."""
+    return counts.at[slot].set(0.0)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _bump_counts(counts, tokens):
+    """counts[s, tokens[s]] += 1 for every slot (garbage rows of
+    inactive/unpenalized slots are harmless — their penalty knobs are
+    zero — and are reset at the slot's next PENALIZED admit)."""
+    return counts.at[jnp.arange(counts.shape[0]), tokens].add(1.0)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _bump_one(counts, slot, token):
+    """counts[slot, token] += 1 (the admit-time first token)."""
+    return counts.at[slot, token].add(1.0)
+
+
+def _apply_penalties(logits, pres, freqs, counts):
+    """vLLM's presence/frequency penalties on the RAW logits (before
+    temperature): presence subtracts a flat penalty from every token
+    the request already emitted, frequency subtracts per occurrence.
+    Zero penalties leave logits bit-identical (0 * anything)."""
+    seen = (counts > 0).astype(jnp.float32)
+    return logits - pres[:, None] * seen - freqs[:, None] * counts
 
 
 @jax.jit
-def _pick_tokens(logits, temps, topks, topps, minps, key):
+def _pick_tokens(logits, temps, topks, topps, minps, pres, freqs,
+                 counts, key):
     """Per-slot sampling in one vectorized pass: [S, V] logits with
     per-slot temperature (0 = greedy), top-k (0 = unrestricted),
-    top-p / nucleus (1.0 = unrestricted), and min-p (0 =
-    unrestricted).  The per-slot knobs are DATA,
+    top-p / nucleus (1.0 = unrestricted), min-p (0 = unrestricted),
+    and presence/frequency penalties over the per-slot output-token
+    histogram *counts* (0 = none).  The per-slot knobs are DATA,
     not shapes, so mixed greedy/sampled batches share the engine's one
     compiled step.  Gumbel-max sampling: argmax(logits/T + G) is a
     categorical draw from softmax(logits/T), and zeroing the noise
@@ -161,7 +195,8 @@ def _pick_tokens(logits, temps, topks, topps, minps, key):
     top-k/top-p, vLLM's sequential semantics) — in logit space, within
     log(min_p) of the surviving max, so the argmax always survives."""
     S, V = logits.shape
-    logits = logits.astype(jnp.float32)
+    logits = _apply_penalties(
+        logits.astype(jnp.float32), pres, freqs, counts)
     safe_t = jnp.where(temps > 0, temps, 1.0)
     scaled = logits / safe_t[:, None]
     rows = jnp.arange(S)
@@ -209,20 +244,21 @@ def _top_logprobs(logits, chosen, k):
 
 
 @functools.partial(
-    jax.jit, static_argnums=(0, 1, 2, 3), donate_argnums=(5,)
+    jax.jit, static_argnums=(0, 1, 2, 3, 4), donate_argnums=(6,)
 )
-def _scan_decode(model, n_steps, sampled, lp_k, params, cache, last,
-                 lens, temps, topks, topps, minps, adapter_ids, rng,
-                 draws0):
+def _scan_decode(model, n_steps, sampled, lp_k, pen, params, cache,
+                 last, lens, temps, topks, topps, minps, pres, freqs,
+                 counts, adapter_ids, rng, draws0):
     """n_steps decode steps in one lax.scan.  The per-step sampling key
     is fold_in(rng, draws0 + i) — the same chain ``step`` consumes one
     link of per call, so scan and step-by-step emit identical streams.
     Greedy mode (sampled=False) skips the pick entirely.  With lp_k,
-    per-step logprob stats ride the scan outputs (one compiled variant
-    per engine-wide k — never per request)."""
+    per-step logprob stats ride the scan outputs; with pen, the
+    penalty histogram rides the carry (compiled variants scale with
+    the STATIC flags — a handful engine-wide, never per request)."""
 
     def step_fn(carry, i):
-        cache, tok, pos = carry
+        cache, tok, pos, cnt = carry
         logits, mut = model.apply(
             {"params": params, "cache": cache},
             tok[:, None], pos[:, None], decode=True,
@@ -231,7 +267,7 @@ def _scan_decode(model, n_steps, sampled, lp_k, params, cache, last,
         lg = logits[:, -1, :]
         if sampled:
             nxt = _pick_tokens(
-                lg, temps, topks, topps, minps,
+                lg, temps, topks, topps, minps, pres, freqs, cnt,
                 jax.random.fold_in(rng, draws0 + i),
             )
         else:
@@ -240,12 +276,16 @@ def _scan_decode(model, n_steps, sampled, lp_k, params, cache, last,
             out = (nxt,) + _top_logprobs(lg, nxt, lp_k)
         else:
             out = (nxt,)
-        return (mut["cache"], nxt, pos + 1), out
+        if pen:
+            # penalties read cnt BEFORE this step's token lands in it
+            # (same order as step(): sample, then bump)
+            cnt = cnt.at[jnp.arange(cnt.shape[0]), nxt].add(1.0)
+        return (mut["cache"], nxt, pos + 1, cnt), out
 
-    (cache, _, _), ys = lax.scan(
-        step_fn, (cache, last, lens), jnp.arange(n_steps)
+    (cache, _, _, counts), ys = lax.scan(
+        step_fn, (cache, last, lens, counts), jnp.arange(n_steps)
     )
-    return ys, cache
+    return ys, cache, counts
 
 
 class ServingEngine:
@@ -364,6 +404,14 @@ class ServingEngine:
         self.topks = np.zeros(n_slots, np.int32)
         self.topps = np.ones(n_slots, np.float32)
         self.minps = np.zeros(n_slots, np.float32)
+        self.pres = np.zeros(n_slots, np.float32)
+        self.freqs = np.zeros(n_slots, np.float32)
+        # output-token histogram for the penalties: [S, V] on device,
+        # bumped per decode step only while some penalized request is
+        # live, reset per slot at each PENALIZED admit (unpenalized
+        # slots may hold stale rows — their zero knobs mask them)
+        self._counts = jnp.zeros((n_slots, model.vocab), jnp.float32)
+        self._zero_vocab_row = jnp.zeros((1, model.vocab), jnp.float32)
         # per-slot LoRA adapter ids (-1 = base model); only consulted
         # when the model was built with n_adapters > 0
         self.adapters = np.full(n_slots, -1, np.int32)
@@ -513,6 +561,8 @@ class ServingEngine:
               top_k: Optional[int] = None,
               top_p: float = 1.0,
               min_p: float = 0.0,
+              presence_penalty: float = 0.0,
+              frequency_penalty: float = 0.0,
               adapter: Optional[int] = None,
               stop: Optional[List[int]] = None,
               logprobs: Optional[int] = None) -> int:
@@ -544,6 +594,11 @@ class ServingEngine:
             raise ValueError(f"top_p {top_p} outside (0, 1]")
         if not 0.0 <= min_p <= 1.0:
             raise ValueError(f"min_p {min_p} outside [0, 1]")
+        for pname, pval in (("presence_penalty", presence_penalty),
+                            ("frequency_penalty", frequency_penalty)):
+            if not -2.0 <= pval <= 2.0:
+                raise ValueError(
+                    f"{pname} {pval} outside [-2, 2]")
         aid = self._check_adapter(adapter)
         stops = frozenset(int(t) for t in (stop or ()))
         for t in stops:
@@ -650,15 +705,25 @@ class ServingEngine:
         self.topks[slot] = top_k or 0
         self.topps[slot] = top_p
         self.minps[slot] = min_p
+        self.pres[slot] = presence_penalty
+        self.freqs[slot] = frequency_penalty
         self.adapters[slot] = aid
         self._stops[slot] = stops
         self._lp_want[slot] = lp_n
         self._lp_records[slot] = []
+        # first token: the output histogram is empty by definition, so
+        # penalties are a no-op — pass a zero row
         first = int(self._sample(
             last[None, :], np.asarray([temperature], np.float32),
             np.asarray([top_k or 0], np.int32),
             np.asarray([top_p], np.float32),
-            np.asarray([min_p], np.float32))[0])
+            np.asarray([min_p], np.float32),
+            np.asarray([presence_penalty], np.float32),
+            np.asarray([frequency_penalty], np.float32),
+            self._zero_vocab_row)[0])
+        if presence_penalty or frequency_penalty:
+            self._counts = _zero_count_row(self._counts, slot)
+            self._counts = _bump_one(self._counts, slot, first)
         if lp_n:
             clp, tlp, tid = _top_logprobs(
                 last[None, :], jnp.asarray([first], jnp.int32),
@@ -670,6 +735,12 @@ class ServingEngine:
         self._tokens += 1
         self._maybe_finish(slot, first)
         return slot
+
+    def _pen_live(self) -> bool:
+        """Any penalized request live?  Gates the per-step histogram
+        bumps so the common (unpenalized) engine does zero extra
+        device work (penalty knobs reset at finish, like temps)."""
+        return bool(self.pres.any() or self.freqs.any())
 
     def _record_logprobs(self, slot: int, chosen_lp: float,
                          top_lp, top_id) -> None:
@@ -696,8 +767,9 @@ class ServingEngine:
         didn't ask."""
         return list(self._lp_records[slot])
 
-    def _sample(self, logits, temps, topks, topps, minps):
-        if not _knobs_live(temps, topks, topps, minps):
+    def _sample(self, logits, temps, topks, topps, minps, pres, freqs,
+                counts):
+        if not _knobs_live(temps, topks, topps, minps, pres, freqs):
             # all-greedy batch (the default): plain argmax — no vocab
             # sort, no Gumbel draw, and the key stream stays untouched
             # so adding a sampled request never shifts greedy outputs
@@ -707,7 +779,9 @@ class ServingEngine:
         self._draws += 1
         return np.asarray(
             _pick_tokens(logits, jnp.asarray(temps), jnp.asarray(topks),
-                         jnp.asarray(topps), jnp.asarray(minps), key),
+                         jnp.asarray(topps), jnp.asarray(minps),
+                         jnp.asarray(pres), jnp.asarray(freqs),
+                         counts, key),
             dtype=np.int32)
 
     # -- decoding ----------------------------------------------------------
@@ -732,7 +806,10 @@ class ServingEngine:
             aids)
         self._steps += 1
         nxt = self._sample(logits[:, -1, :], self.temps, self.topks,
-                           self.topps, self.minps)
+                           self.topps, self.minps, self.pres,
+                           self.freqs, self._counts)
+        if self._pen_live():
+            self._counts = _bump_counts(self._counts, jnp.asarray(nxt))
         if self.logprobs_k and any(
                 self._lp_want[s] for s in range(self.n_slots)
                 if self.active[s]):
@@ -781,7 +858,8 @@ class ServingEngine:
                     f"slot {s} has {self.model.max_len - self.lens[s]} "
                     f"cache rows left, need {n_steps}")
         sampled = _knobs_live(self.temps, self.topks, self.topps,
-                              self.minps)
+                              self.minps, self.pres, self.freqs)
+        pen = self._pen_live()
         # logprob stats ride the scan only when someone is listening:
         # at most two compiled variants (k and 0), never per request
         lp_k = self.logprobs_k if any(
@@ -789,12 +867,14 @@ class ServingEngine:
             if self.active[s]) else 0
         aids = (jnp.asarray(self.adapters)
                 if self.model.n_adapters > 0 else None)
-        ys, self.cache = _scan_decode(
-            self.model, n_steps, sampled, lp_k, self.params, self.cache,
+        ys, self.cache, self._counts = _scan_decode(
+            self.model, n_steps, sampled, lp_k, pen, self.params,
+            self.cache,
             jnp.asarray(self.last_token), jnp.asarray(self.lens, jnp.int32),
             jnp.asarray(self.temps), jnp.asarray(self.topks),
-            jnp.asarray(self.topps), jnp.asarray(self.minps), aids,
-            self._rng, jnp.int32(self._draws),
+            jnp.asarray(self.topps), jnp.asarray(self.minps),
+            jnp.asarray(self.pres), jnp.asarray(self.freqs),
+            self._counts, aids, self._rng, jnp.int32(self._draws),
         )
         toks = np.asarray(ys[0], dtype=np.int32)  # [n_steps, S]
         if lp_k:
@@ -814,7 +894,8 @@ class ServingEngine:
             # scheduling API ran this window — the scan's keys for
             # post-retirement steps produced only discarded tokens
             if sampled and _knobs_live(self.temps, self.topks,
-                                       self.topps, self.minps):
+                                       self.topps, self.minps,
+                                       self.pres, self.freqs):
                 draws_used += 1
             if lp_k:
                 self._harvest_logprobs(clps[i], tlps[i], tids[i])
@@ -896,6 +977,8 @@ class ServingEngine:
         self.topks[slot] = 0
         self.topps[slot] = 1.0
         self.minps[slot] = 0.0
+        self.pres[slot] = 0.0
+        self.freqs[slot] = 0.0
         self.adapters[slot] = -1
         self._stops[slot] = frozenset()
         self._lp_want[slot] = 0  # records stay readable post-finish
