@@ -382,6 +382,14 @@ def test_sampling_params_validated(setup):
         eng.admit([1, 2], temperature=-1.0)
     with pytest.raises(ValueError, match="top_k"):
         eng.admit([1, 2], top_k=0)
+    # out-of-range prompt ids reject BEFORE any state mutation (a bad
+    # id used to flow into clamped gathers; with the repetition
+    # histogram it must be a clean error)
+    with pytest.raises(ValueError, match="prompt token"):
+        eng.admit([1, 999999])
+    with pytest.raises(ValueError, match="prompt token"):
+        eng.admit([-1, 2])
+    assert eng.free_slots() == [0]  # nothing half-admitted
 
 
 def test_stats_counters(setup):
@@ -851,6 +859,59 @@ def test_penalty_validation(setup):
         eng.admit([1, 2], presence_penalty=3.0)
     with pytest.raises(ValueError, match="frequency_penalty"):
         eng.admit([1, 2], frequency_penalty=-2.5)
+    with pytest.raises(ValueError, match="repetition_penalty"):
+        eng.admit([1, 2], repetition_penalty=0.0)
+
+
+def test_repetition_penalty_matches_recompute_oracle(setup):
+    # greedy + repetition penalty: every step's token equals the argmax
+    # of logits with seen (PROMPT + output) tokens scaled by vLLM's
+    # divide-positive / multiply-negative rule — including the FIRST
+    # token, whose seen set is the prompt alone
+    model, params = setup
+    prompt = [3, 14, 15, 92, 65, 14, 3]   # repeated prompt tokens
+    REP = 1.8
+    eng = ServingEngine(model, params, n_slots=2)
+    s = eng.admit(prompt, repetition_penalty=REP)
+    eng.run(6)
+    toks = eng.output(s)
+    from tpu_k8s_device_plugin.workloads.inference import init_cache
+    full = jnp.asarray(prompt + toks, jnp.int32)[None, :]
+    T = full.shape[1]
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (1, T))
+    logits, _ = model.apply(
+        {"params": params, "cache": init_cache(model, 1)},
+        full, pos, decode=False, mutable=["cache"])
+    logits = np.asarray(logits, np.float64)[0]
+    seen = np.zeros(model.vocab, bool)
+    seen[prompt] = True
+    for i, tok in enumerate(toks):
+        row = logits[len(prompt) - 1 + i].copy()
+        row[seen] = np.where(row[seen] > 0, row[seen] / REP,
+                             row[seen] * REP)
+        assert tok == int(np.argmax(row)), f"step {i}"
+        seen[tok] = True
+    assert toks != _solo(model, params, prompt, 7)  # it bites
+
+
+def test_repetition_penalty_scan_matches_stepwise(setup):
+    model, params = setup
+
+    def mk():
+        return ServingEngine(model, params, n_slots=2)
+
+    a, b = mk(), mk()
+    sa = a.admit([5, 17, 3, 17], repetition_penalty=1.5)
+    sb = b.admit([5, 17, 3, 17], repetition_penalty=1.5)
+    for _ in range(5):
+        a.step()
+    b.run_scan(5)
+    assert a.output(sa) == b.output(sb)
+    # recycled slot must not inherit the seen histogram or the knob
+    a.release(sa)
+    sc = a.admit([3, 14, 15])
+    a.run(4)
+    assert a.output(sc) == _solo(model, params, [3, 14, 15], 5)[:5]
 
 
 def test_logprobs_match_full_recompute(setup):

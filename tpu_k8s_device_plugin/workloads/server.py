@@ -22,8 +22,9 @@ API (JSON over HTTP/1.1):
   POST /generate   {"tokens": [int...], "max_new_tokens": N?,
                     "temperature": f?, "top_k": k?, "top_p": p?,
                     "min_p": m?, "presence_penalty": f?,
-                    "frequency_penalty": f?, "adapter": a?,
-                    "stop": [int...]?, "logprobs": n?, "stream": true?}
+                    "frequency_penalty": f?, "repetition_penalty": r?,
+                    "adapter": a?, "stop": [int...]?, "logprobs": n?,
+                    "stream": true?}
                    stream=true (default): chunked body, one JSON line
                    per event — {"token": t} ... then
                    {"done": true, "tokens": [...], "finish_reason": r}
@@ -68,6 +69,7 @@ class _Request:
     min_p: float = 0.0
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
+    repetition_penalty: float = 1.0
     adapter: Optional[int] = None
     stop: Optional[List[int]] = None
     logprobs: Optional[int] = None
@@ -134,6 +136,7 @@ class EngineServer:
                     min_p=req.min_p,
                     presence_penalty=req.presence_penalty,
                     frequency_penalty=req.frequency_penalty,
+                    repetition_penalty=req.repetition_penalty,
                     adapter=req.adapter, stop=req.stop,
                     logprobs=req.logprobs)
             except (ValueError, RuntimeError) as e:
@@ -384,6 +387,8 @@ class EngineServer:
             min_p=float(body.get("min_p", 0.0)),
             presence_penalty=float(body.get("presence_penalty", 0.0)),
             frequency_penalty=float(body.get("frequency_penalty", 0.0)),
+            repetition_penalty=float(
+                body.get("repetition_penalty", 1.0)),
             adapter=None if adapter is None else int(adapter),
             stop=stop,
             logprobs=None if logprobs is None else int(logprobs),

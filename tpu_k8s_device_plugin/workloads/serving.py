@@ -132,7 +132,7 @@ def _lcp(a: np.ndarray, b: np.ndarray) -> int:
     return L if not neq[idx] else idx
 
 
-def _knobs_live(temps, topks, topps, minps, pres, freqs) -> bool:
+def _knobs_live(temps, topks, topps, minps, pres, freqs, reps) -> bool:
     """True when any slot's sampling knobs are armed.  THE predicate
     the engine's key-stream accounting hangs on: _sample's greedy fast
     path, run_scan's sampled flag, and its per-step draw count must
@@ -142,7 +142,8 @@ def _knobs_live(temps, topks, topps, minps, pres, freqs) -> bool:
     (penalized argmax != plain argmax)."""
     return bool(temps.any() or topks.any()
                 or (np.asarray(topps) < 1.0).any() or minps.any()
-                or pres.any() or freqs.any())
+                or pres.any() or freqs.any()
+                or (np.asarray(reps) != 1.0).any())
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -165,23 +166,39 @@ def _bump_one(counts, slot, token):
     return counts.at[slot, token].add(1.0)
 
 
-def _apply_penalties(logits, pres, freqs, counts):
-    """vLLM's presence/frequency penalties on the RAW logits (before
-    temperature): presence subtracts a flat penalty from every token
-    the request already emitted, frequency subtracts per occurrence.
-    Zero penalties leave logits bit-identical (0 * anything)."""
-    seen = (counts > 0).astype(jnp.float32)
-    return logits - pres[:, None] * seen - freqs[:, None] * counts
+def _apply_penalties(logits, pres, freqs, reps, counts, seen):
+    """vLLM's penalty family on the RAW logits (before temperature).
+    Repetition first (multiplicative, over tokens seen in the PROMPT
+    or output: positive logits divide by r, negative multiply — r = 1
+    is bit-exact off), then presence/frequency (additive, over the
+    OUTPUT histogram only — 0 is bit-exact off)."""
+    r = reps[:, None]
+    seen_any = seen > 0
+    logits = jnp.where(
+        seen_any, jnp.where(logits > 0, logits / r, logits * r),
+        logits)
+    out_seen = (counts > 0).astype(jnp.float32)
+    return logits - pres[:, None] * out_seen - freqs[:, None] * counts
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _set_count_row(counts, slot, row):
+    """Install a precomputed histogram row (the prompt histogram at a
+    repetition-penalized admit — host bincount keeps admission free of
+    per-prompt-length compiled scatters)."""
+    return counts.at[slot].set(row)
 
 
 @jax.jit
 def _pick_tokens(logits, temps, topks, topps, minps, pres, freqs,
-                 counts, key):
+                 reps, counts, seen, key):
     """Per-slot sampling in one vectorized pass: [S, V] logits with
     per-slot temperature (0 = greedy), top-k (0 = unrestricted),
     top-p / nucleus (1.0 = unrestricted), min-p (0 = unrestricted),
-    and presence/frequency penalties over the per-slot output-token
-    histogram *counts* (0 = none).  The per-slot knobs are DATA,
+    presence/frequency penalties over the per-slot output-token
+    histogram *counts* (0 = none), and repetition penalty over the
+    prompt+output histogram *seen* (1 = none).  The per-slot knobs are
+    DATA,
     not shapes, so mixed greedy/sampled batches share the engine's one
     compiled step.  Gumbel-max sampling: argmax(logits/T + G) is a
     categorical draw from softmax(logits/T), and zeroing the noise
@@ -196,7 +213,7 @@ def _pick_tokens(logits, temps, topks, topps, minps, pres, freqs,
     log(min_p) of the surviving max, so the argmax always survives."""
     S, V = logits.shape
     logits = _apply_penalties(
-        logits.astype(jnp.float32), pres, freqs, counts)
+        logits.astype(jnp.float32), pres, freqs, reps, counts, seen)
     safe_t = jnp.where(temps > 0, temps, 1.0)
     scaled = logits / safe_t[:, None]
     rows = jnp.arange(S)
@@ -244,11 +261,11 @@ def _top_logprobs(logits, chosen, k):
 
 
 @functools.partial(
-    jax.jit, static_argnums=(0, 1, 2, 3, 4), donate_argnums=(6,)
+    jax.jit, static_argnums=(0, 1, 2, 3, 4, 5), donate_argnums=(7,)
 )
-def _scan_decode(model, n_steps, sampled, lp_k, pen, params, cache,
-                 last, lens, temps, topks, topps, minps, pres, freqs,
-                 counts, adapter_ids, rng, draws0):
+def _scan_decode(model, n_steps, sampled, lp_k, pen, rep, params,
+                 cache, last, lens, temps, topks, topps, minps, pres,
+                 freqs, reps, counts, seen, adapter_ids, rng, draws0):
     """n_steps decode steps in one lax.scan.  The per-step sampling key
     is fold_in(rng, draws0 + i) — the same chain ``step`` consumes one
     link of per call, so scan and step-by-step emit identical streams.
@@ -258,7 +275,7 @@ def _scan_decode(model, n_steps, sampled, lp_k, pen, params, cache,
     the STATIC flags — a handful engine-wide, never per request)."""
 
     def step_fn(carry, i):
-        cache, tok, pos, cnt = carry
+        cache, tok, pos, cnt, sn = carry
         logits, mut = model.apply(
             {"params": params, "cache": cache},
             tok[:, None], pos[:, None], decode=True,
@@ -267,8 +284,8 @@ def _scan_decode(model, n_steps, sampled, lp_k, pen, params, cache,
         lg = logits[:, -1, :]
         if sampled:
             nxt = _pick_tokens(
-                lg, temps, topks, topps, minps, pres, freqs, cnt,
-                jax.random.fold_in(rng, draws0 + i),
+                lg, temps, topks, topps, minps, pres, freqs, reps,
+                cnt, sn, jax.random.fold_in(rng, draws0 + i),
             )
         else:
             nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
@@ -276,16 +293,18 @@ def _scan_decode(model, n_steps, sampled, lp_k, pen, params, cache,
             out = (nxt,) + _top_logprobs(lg, nxt, lp_k)
         else:
             out = (nxt,)
+        # histograms read BEFORE this step's token lands in them
+        # (same order as step(): sample, then bump)
         if pen:
-            # penalties read cnt BEFORE this step's token lands in it
-            # (same order as step(): sample, then bump)
             cnt = cnt.at[jnp.arange(cnt.shape[0]), nxt].add(1.0)
-        return (mut["cache"], nxt, pos + 1, cnt), out
+        if rep:
+            sn = sn.at[jnp.arange(sn.shape[0]), nxt].add(1.0)
+        return (mut["cache"], nxt, pos + 1, cnt, sn), out
 
-    (cache, _, _, counts), ys = lax.scan(
-        step_fn, (cache, last, lens, counts), jnp.arange(n_steps)
+    (cache, _, _, counts, seen), ys = lax.scan(
+        step_fn, (cache, last, lens, counts, seen), jnp.arange(n_steps)
     )
-    return ys, cache, counts
+    return ys, cache, counts, seen
 
 
 class ServingEngine:
@@ -406,11 +425,15 @@ class ServingEngine:
         self.minps = np.zeros(n_slots, np.float32)
         self.pres = np.zeros(n_slots, np.float32)
         self.freqs = np.zeros(n_slots, np.float32)
+        self.reps = np.ones(n_slots, np.float32)
         # output-token histogram for the penalties: [S, V] on device,
         # bumped per decode step only while some penalized request is
         # live, reset per slot at each PENALIZED admit (unpenalized
         # slots may hold stale rows — their zero knobs mask them)
         self._counts = jnp.zeros((n_slots, model.vocab), jnp.float32)
+        # prompt+output histogram for the repetition penalty (vLLM
+        # scopes it wider than presence/frequency), same lifecycle
+        self._seen = jnp.zeros((n_slots, model.vocab), jnp.float32)
         self._zero_vocab_row = jnp.zeros((1, model.vocab), jnp.float32)
         # per-slot LoRA adapter ids (-1 = base model); only consulted
         # when the model was built with n_adapters > 0
@@ -563,6 +586,7 @@ class ServingEngine:
               min_p: float = 0.0,
               presence_penalty: float = 0.0,
               frequency_penalty: float = 0.0,
+              repetition_penalty: float = 1.0,
               adapter: Optional[int] = None,
               stop: Optional[List[int]] = None,
               logprobs: Optional[int] = None) -> int:
@@ -587,6 +611,14 @@ class ServingEngine:
         t_p = int(prompt.shape[1])
         if t_p < 1:
             raise ValueError("empty prompt")
+        if int(prompt_np.min()) < 0 or int(prompt_np.max()) >= \
+                self.model.vocab:
+            # validate BEFORE any state mutation: a bad id must reject
+            # cleanly, not corrupt a half-admitted slot (and the
+            # repetition-penalty histogram would otherwise bincount to
+            # the wrong width)
+            raise ValueError(
+                f"prompt token outside [0, vocab={self.model.vocab})")
         if temperature < 0:
             raise ValueError("temperature must be >= 0")
         validate_top_k(self.model, top_k)
@@ -599,6 +631,9 @@ class ServingEngine:
             if not -2.0 <= pval <= 2.0:
                 raise ValueError(
                     f"{pname} {pval} outside [-2, 2]")
+        if not repetition_penalty > 0:
+            raise ValueError(
+                f"repetition_penalty {repetition_penalty} must be > 0")
         aid = self._check_adapter(adapter)
         stops = frozenset(int(t) for t in (stop or ()))
         for t in stops:
@@ -707,12 +742,21 @@ class ServingEngine:
         self.minps[slot] = min_p
         self.pres[slot] = presence_penalty
         self.freqs[slot] = frequency_penalty
+        self.reps[slot] = repetition_penalty
         self.adapters[slot] = aid
         self._stops[slot] = stops
         self._lp_want[slot] = lp_n
         self._lp_records[slot] = []
-        # first token: the output histogram is empty by definition, so
-        # penalties are a no-op — pass a zero row
+        # first token: the OUTPUT histogram is empty by definition
+        # (presence/frequency no-op), but the repetition penalty scopes
+        # over the prompt — host bincount, no per-length compiles
+        rep_on = repetition_penalty != 1.0
+        if rep_on:
+            seen_row = jnp.asarray(np.bincount(
+                prompt_np[0], minlength=self.model.vocab
+            ).astype(np.float32))[None, :]
+        else:
+            seen_row = self._zero_vocab_row
         first = int(self._sample(
             last[None, :], np.asarray([temperature], np.float32),
             np.asarray([top_k or 0], np.int32),
@@ -720,10 +764,15 @@ class ServingEngine:
             np.asarray([min_p], np.float32),
             np.asarray([presence_penalty], np.float32),
             np.asarray([frequency_penalty], np.float32),
-            self._zero_vocab_row)[0])
+            np.asarray([repetition_penalty], np.float32),
+            self._zero_vocab_row, seen_row)[0])
         if presence_penalty or frequency_penalty:
             self._counts = _zero_count_row(self._counts, slot)
             self._counts = _bump_one(self._counts, slot, first)
+        if rep_on:
+            self._seen = _set_count_row(
+                self._seen, jnp.int32(slot), seen_row[0])
+            self._seen = _bump_one(self._seen, slot, first)
         if lp_n:
             clp, tlp, tid = _top_logprobs(
                 last[None, :], jnp.asarray([first], jnp.int32),
@@ -737,10 +786,13 @@ class ServingEngine:
         return slot
 
     def _pen_live(self) -> bool:
-        """Any penalized request live?  Gates the per-step histogram
-        bumps so the common (unpenalized) engine does zero extra
-        device work (penalty knobs reset at finish, like temps)."""
+        """Any presence/frequency-penalized request live?  Gates the
+        per-step histogram bumps so the common (unpenalized) engine
+        does zero extra device work (knobs reset at finish)."""
         return bool(self.pres.any() or self.freqs.any())
+
+    def _rep_live(self) -> bool:
+        return bool((self.reps != 1.0).any())
 
     def _record_logprobs(self, slot: int, chosen_lp: float,
                          top_lp, top_id) -> None:
@@ -768,8 +820,9 @@ class ServingEngine:
         return list(self._lp_records[slot])
 
     def _sample(self, logits, temps, topks, topps, minps, pres, freqs,
-                counts):
-        if not _knobs_live(temps, topks, topps, minps, pres, freqs):
+                reps, counts, seen):
+        if not _knobs_live(temps, topks, topps, minps, pres, freqs,
+                           reps):
             # all-greedy batch (the default): plain argmax — no vocab
             # sort, no Gumbel draw, and the key stream stays untouched
             # so adding a sampled request never shifts greedy outputs
@@ -781,7 +834,7 @@ class ServingEngine:
             _pick_tokens(logits, jnp.asarray(temps), jnp.asarray(topks),
                          jnp.asarray(topps), jnp.asarray(minps),
                          jnp.asarray(pres), jnp.asarray(freqs),
-                         counts, key),
+                         jnp.asarray(reps), counts, seen, key),
             dtype=np.int32)
 
     # -- decoding ----------------------------------------------------------
@@ -807,9 +860,12 @@ class ServingEngine:
         self._steps += 1
         nxt = self._sample(logits[:, -1, :], self.temps, self.topks,
                            self.topps, self.minps, self.pres,
-                           self.freqs, self._counts)
+                           self.freqs, self.reps, self._counts,
+                           self._seen)
         if self._pen_live():
             self._counts = _bump_counts(self._counts, jnp.asarray(nxt))
+        if self._rep_live():
+            self._seen = _bump_counts(self._seen, jnp.asarray(nxt))
         if self.logprobs_k and any(
                 self._lp_want[s] for s in range(self.n_slots)
                 if self.active[s]):
@@ -858,8 +914,10 @@ class ServingEngine:
                     f"slot {s} has {self.model.max_len - self.lens[s]} "
                     f"cache rows left, need {n_steps}")
         sampled = _knobs_live(self.temps, self.topks, self.topps,
-                              self.minps, self.pres, self.freqs)
+                              self.minps, self.pres, self.freqs,
+                              self.reps)
         pen = self._pen_live()
+        rep = self._rep_live()
         # logprob stats ride the scan only when someone is listening:
         # at most two compiled variants (k and 0), never per request
         lp_k = self.logprobs_k if any(
@@ -867,14 +925,15 @@ class ServingEngine:
             if self.active[s]) else 0
         aids = (jnp.asarray(self.adapters)
                 if self.model.n_adapters > 0 else None)
-        ys, self.cache, self._counts = _scan_decode(
-            self.model, n_steps, sampled, lp_k, pen, self.params,
+        ys, self.cache, self._counts, self._seen = _scan_decode(
+            self.model, n_steps, sampled, lp_k, pen, rep, self.params,
             self.cache,
             jnp.asarray(self.last_token), jnp.asarray(self.lens, jnp.int32),
             jnp.asarray(self.temps), jnp.asarray(self.topks),
             jnp.asarray(self.topps), jnp.asarray(self.minps),
             jnp.asarray(self.pres), jnp.asarray(self.freqs),
-            self._counts, aids, self._rng, jnp.int32(self._draws),
+            jnp.asarray(self.reps), self._counts, self._seen, aids,
+            self._rng, jnp.int32(self._draws),
         )
         toks = np.asarray(ys[0], dtype=np.int32)  # [n_steps, S]
         if lp_k:
@@ -895,7 +954,8 @@ class ServingEngine:
             # post-retirement steps produced only discarded tokens
             if sampled and _knobs_live(self.temps, self.topks,
                                        self.topps, self.minps,
-                                       self.pres, self.freqs):
+                                       self.pres, self.freqs,
+                                       self.reps):
                 draws_used += 1
             if lp_k:
                 self._harvest_logprobs(clps[i], tlps[i], tids[i])
@@ -979,6 +1039,7 @@ class ServingEngine:
         self.minps[slot] = 0.0
         self.pres[slot] = 0.0
         self.freqs[slot] = 0.0
+        self.reps[slot] = 1.0
         self.adapters[slot] = -1
         self._stops[slot] = frozenset()
         self._lp_want[slot] = 0  # records stay readable post-finish
