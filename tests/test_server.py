@@ -165,6 +165,70 @@ def test_stop_drains_inflight_requests(setup):
     assert "error" in events[-1]  # ...and was terminated explicitly
 
 
+def test_n_completions_over_http(setup):
+    # n=3 on a 2-slot engine: copies admit INCREMENTALLY as slots
+    # free; the final event carries all three choices and per-token
+    # events are index-tagged
+    model, params = setup
+    eng = ServingEngine(model, params, n_slots=2)
+    srv = EngineServer(eng, max_new_tokens=4, window=2)
+    srv.start(host="127.0.0.1", port=0)
+    try:
+        status, events = _post(
+            srv.port,
+            {"tokens": [5, 9, 3], "max_new_tokens": 4, "n": 3,
+             "temperature": 1.0, "top_k": 16})
+        assert status == 200
+        done = events[-1]
+        assert done.get("done") is True
+        choices = done["choices"]
+        assert [c["index"] for c in choices] == [0, 1, 2]
+        for c in choices:
+            assert len(c["tokens"]) == 4
+            assert c["finish_reason"] == "length"
+        for e in events[:-1]:
+            assert "index" in e and 0 <= e["index"] < 3
+        # streamed events reassemble into exactly the choices
+        streams = {i: [] for i in range(3)}
+        for e in events[:-1]:
+            streams[e["index"]].append(e["token"])
+        for c in choices:
+            assert streams[c["index"]] == c["tokens"]
+        # sampled siblings must actually diverge (distinct noise per
+        # slot row — the failure mode n>1 exists to avoid is n
+        # identical copies); statistically safe at temp 1.0/top-k 16
+        assert len({tuple(c["tokens"]) for c in choices}) > 1
+        assert srv.stats()["requests_served"] == 1
+    finally:
+        srv.stop()
+
+
+def test_n_greedy_copies_identical_and_prefix_cached(setup):
+    # greedy copies are deterministic duplicates, and siblings reuse
+    # the shared prompt through the automatic prefix cache (prompt
+    # longer than the engine chunk so the match clears the grid)
+    model, params = setup
+    eng = ServingEngine(model, params, n_slots=2)  # chunk=32
+    srv = EngineServer(eng, max_new_tokens=3, window=2)
+    srv.start(host="127.0.0.1", port=0)
+    try:
+        prompt = list(range(1, 40))  # 39 tokens > chunk
+        status, events = _post(
+            srv.port,
+            {"tokens": prompt, "max_new_tokens": 3, "n": 2,
+             "stream": False})
+        assert status == 200
+        a, b = events[0]["choices"]
+        assert a["tokens"] == b["tokens"]
+        assert srv.stats()["prefix_cache_hits"] >= 1
+        # invalid n is a clean 400
+        status, _ = _post(srv.port, {"tokens": [1, 2], "n": 0,
+                                     "stream": False})
+        assert status == 400
+    finally:
+        srv.stop()
+
+
 def test_logprobs_over_http(setup):
     model, params = setup
     eng = ServingEngine(model, params, n_slots=1, logprobs_k=4)

@@ -23,8 +23,12 @@ API (JSON over HTTP/1.1):
                     "temperature": f?, "top_k": k?, "top_p": p?,
                     "min_p": m?, "presence_penalty": f?,
                     "frequency_penalty": f?, "repetition_penalty": r?,
-                    "adapter": a?, "stop": [int...]?, "logprobs": n?,
-                    "stream": true?}
+                    "adapter": a?, "stop": [int...]?, "logprobs": k?,
+                    "n": c?, "stream": true?}
+                   n > 1 returns c completions: token events carry
+                   "index", the final event has "choices" (copies
+                   admit incrementally and share the prompt via the
+                   automatic prefix cache).
                    stream=true (default): chunked body, one JSON line
                    per event — {"token": t} ... then
                    {"done": true, "tokens": [...], "finish_reason": r}
@@ -73,9 +77,13 @@ class _Request:
     adapter: Optional[int] = None
     stop: Optional[List[int]] = None
     logprobs: Optional[int] = None
+    n: int = 1
     events: "queue.Queue" = field(default_factory=queue.Queue)
     cancelled: bool = False
-    emitted: int = 0
+    admitted: int = 0                 # copies admitted so far (of n)
+    emitted: dict = field(default_factory=dict)   # copy index -> count
+    choices: list = field(default_factory=list)   # finished copies
+    budget_capped: bool = False
 
 
 class EngineServer:
@@ -101,7 +109,8 @@ class EngineServer:
         self.window = window
         self._pending: "queue.Queue[_Request]" = queue.Queue()
         self._work = threading.Event()    # set on every enqueue
-        self._running: dict = {}          # slot -> _Request
+        self._running: dict = {}          # slot -> (_Request, copy idx)
+        self._head: Optional[_Request] = None  # partially admitted n>1
         self._stop = threading.Event()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._scheduler: Optional[threading.Thread] = None
@@ -111,25 +120,36 @@ class EngineServer:
     # -- scheduler (sole owner of the engine) -------------------------------
 
     def _admit_pending(self) -> None:
+        """Admit copies of queued requests into free slots.  A request
+        with n > 1 admits one slot per copy, INCREMENTALLY as slots
+        free (continuous batching, not gang scheduling) — sibling
+        copies share the prompt, so the automatic prefix cache turns
+        every copy after the first into a tail-only prefill."""
         eng = self.engine
         while eng.free_slots():
-            try:
-                req = self._pending.get_nowait()
-            except queue.Empty:
-                return
+            req = self._head
+            self._head = None
+            if req is None:
+                try:
+                    req = self._pending.get_nowait()
+                except queue.Empty:
+                    return
             if req.cancelled:
                 continue
-            budget = req.max_new_tokens
             try:
-                # cap the admission budget so prompt + generation fits
-                # the cache; the per-request budget still applies
-                if len(req.tokens) + budget > eng.model.max_len:
-                    budget = eng.model.max_len - len(req.tokens)
-                    if budget < 1:
-                        raise ValueError(
-                            f"prompt ({len(req.tokens)} tokens) leaves "
-                            f"no room to generate within max_len "
-                            f"{eng.model.max_len}")
+                if not req.budget_capped:
+                    # cap the admission budget so prompt + generation
+                    # fits the cache; the per-request budget applies
+                    if (len(req.tokens) + req.max_new_tokens
+                            > eng.model.max_len):
+                        budget = eng.model.max_len - len(req.tokens)
+                        if budget < 1:
+                            raise ValueError(
+                                f"prompt ({len(req.tokens)} tokens) "
+                                f"leaves no room to generate within "
+                                f"max_len {eng.model.max_len}")
+                        req.max_new_tokens = budget
+                    req.budget_capped = True
                 slot = eng.admit(
                     req.tokens, temperature=req.temperature,
                     top_k=req.top_k, top_p=req.top_p,
@@ -140,34 +160,46 @@ class EngineServer:
                     adapter=req.adapter, stop=req.stop,
                     logprobs=req.logprobs)
             except (ValueError, RuntimeError) as e:
+                # identical args per copy, so only the FIRST admit can
+                # fail on validation (the free-slot guard rules out
+                # engine-full) — no partially-errored requests
                 self._requests_rejected += 1
                 req.events.put({"error": str(e), "code": 400})
                 continue
-            req.max_new_tokens = budget
-            self._running[slot] = req
+            idx = req.admitted
+            req.admitted += 1
+            req.emitted[idx] = 0
+            self._running[slot] = (req, idx)
+            if req.admitted < req.n:
+                self._head = req  # next free slot continues this req
             # the admit's first sampled token streams immediately
-            self._emit(slot, req, eng.output(slot))
+            self._emit(slot, req, idx, eng.output(slot))
 
-    def _emit(self, slot: int, req: _Request, tokens: List[int]) -> None:
-        """Push tokens the request hasn't seen yet, honoring its budget
-        and retiring the slot when done."""
+    def _emit(self, slot: int, req: _Request, idx: int,
+              tokens: List[int]) -> None:
+        """Push copy *idx*'s unseen tokens, honoring the budget and
+        retiring the slot when the copy is done; the request completes
+        when ALL n copies have."""
         eng = self.engine
-        new = tokens[req.emitted:req.max_new_tokens]
+        seen = req.emitted[idx]
+        new = tokens[seen:req.max_new_tokens]
         lps = (eng.token_logprobs(slot) if req.logprobs else None)
         for j, t in enumerate(new):
             ev = {"token": int(t)}
+            if req.n > 1:
+                ev["index"] = idx
             if lps is not None:
-                clp, top = lps[req.emitted + j]
+                clp, top = lps[seen + j]
                 ev["logprob"] = clp
                 ev["top_logprobs"] = [[i, p] for i, p in top]
             req.events.put(ev)
-        req.emitted += len(new)
+        req.emitted[idx] = seen + len(new)
         finished = eng.finished(slot)
         if req.cancelled:
             eng.release(slot)
             del self._running[slot]
             return
-        if req.emitted >= req.max_new_tokens or finished:
+        if req.emitted[idx] >= req.max_new_tokens or finished:
             full = eng.output(slot)
             out = full[:req.max_new_tokens]
             if finished and len(full) <= req.max_new_tokens:
@@ -179,21 +211,31 @@ class EngineServer:
                 reason = "length"
                 if not finished:
                     eng.release(slot)
-            done = {
-                "done": True,
+            choice = {
+                "index": idx,
                 "tokens": [int(t) for t in out],
                 "finish_reason": reason,
             }
             if req.logprobs:
-                done["logprobs"] = [
+                choice["logprobs"] = [
                     {"logprob": clp,
                      "top_logprobs": [[i, p] for i, p in top]}
                     for clp, top in
                     eng.token_logprobs(slot)[:len(out)]
                 ]
-            req.events.put(done)
             del self._running[slot]
-            self._requests_served += 1
+            req.choices.append(choice)
+            if len(req.choices) == req.n:
+                if req.n == 1:
+                    done = {"done": True, **req.choices[0]}
+                    del done["index"]  # single-completion wire shape
+                else:
+                    done = {"done": True, "choices": sorted(
+                        req.choices, key=lambda c: c["index"])}
+                # count BEFORE the event lands: a client reacting to
+                # the final chunk must not read a stale /stats counter
+                self._requests_served += 1
+                req.events.put(done)
 
     def _scheduler_loop(self) -> None:
         eng = self.engine
@@ -206,7 +248,7 @@ class EngineServer:
                 self._work.clear()
                 continue
             # drop requests whose client went away
-            for slot, req in list(self._running.items()):
+            for slot, (req, _idx) in list(self._running.items()):
                 if req.cancelled:
                     eng.release(slot)
                     del self._running[slot]
@@ -221,8 +263,8 @@ class EngineServer:
                 eng.step()
             else:
                 eng.run_scan(window)
-            for slot, req in list(self._running.items()):
-                self._emit(slot, req, eng.output(slot))
+            for slot, (req, idx) in list(self._running.items()):
+                self._emit(slot, req, idx, eng.output(slot))
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -344,9 +386,16 @@ class EngineServer:
         # stops the ACCEPT loop — without a terminal event they would
         # hang until their socket timeout
         bye = {"error": "server shutting down", "code": 503}
-        for req in self._running.values():
-            req.events.put(dict(bye))
+        notified = set()
+        for req, _idx in self._running.values():
+            if id(req) not in notified:
+                notified.add(id(req))
+                req.events.put(dict(bye))
         self._running.clear()
+        if self._head is not None:
+            if id(self._head) not in notified:
+                self._head.events.put(dict(bye))
+            self._head = None
         while True:
             try:
                 self._pending.get_nowait().events.put(dict(bye))
@@ -370,6 +419,11 @@ class EngineServer:
         top_k = body.get("top_k")
         adapter = body.get("adapter")
         logprobs = body.get("logprobs")
+        # copies admit incrementally, so n may exceed the slot count;
+        # the cap is only a sanity bound against runaway requests
+        n = int(body.get("n", 1))
+        if not 1 <= n <= 128:
+            raise ValueError(f"n={n} outside [1, 128]")
         stop = body.get("stop")
         if stop is not None and (
                 not isinstance(stop, list)
@@ -392,13 +446,17 @@ class EngineServer:
             adapter=None if adapter is None else int(adapter),
             stop=stop,
             logprobs=None if logprobs is None else int(logprobs),
+            n=n,
         )
 
     def stats(self) -> dict:
         st = dict(self.engine.stats())
         st.update({
             "pending_requests": self._pending.qsize(),
-            "running_requests": len(self._running),
+            # distinct REQUESTS (an n>1 request occupies n slots)
+            "running_requests": len(
+                {id(r) for r, _ in self._running.values()}),
+            "running_copies": len(self._running),
             "requests_served": self._requests_served,
             "requests_rejected": self._requests_rejected,
             "window": self.window,
