@@ -255,6 +255,38 @@ def test_logprobs_over_http(setup):
         srv.stop()
 
 
+def test_prompt_logprobs_over_http(setup):
+    model, params = setup
+    eng = ServingEngine(model, params, n_slots=1, logprobs_k=3)
+    srv = EngineServer(eng, max_new_tokens=3, window=2)
+    srv.start(host="127.0.0.1", port=0)
+    try:
+        status, events = _post(
+            srv.port,
+            {"tokens": [5, 9, 3, 7], "max_new_tokens": 3,
+             "prompt_logprobs": 2, "stream": False})
+        assert status == 200
+        plps = events[0]["prompt_logprobs"]
+        assert len(plps) == 4 and plps[0] is None
+        for rec in plps[1:]:
+            assert "logprob" in rec and len(rec["top_logprobs"]) == 2
+        # n>1: only copy 0 computes the (identical) records — the
+        # siblings keep APC tail-only prefill and the done event
+        # carries prompt_logprobs ONCE, not per choice
+        prompt = list(range(1, 40))  # > chunk so APC can match
+        status, events = _post(
+            srv.port,
+            {"tokens": prompt, "max_new_tokens": 2,
+             "prompt_logprobs": 1, "n": 2, "stream": False})
+        assert status == 200
+        done = events[0]
+        assert len(done["prompt_logprobs"]) == len(prompt)
+        assert all("prompt_logprobs" not in c for c in done["choices"])
+        assert srv.stats()["prefix_cache_hits"] >= 1
+    finally:
+        srv.stop()
+
+
 def test_stop_tokens_over_http(server, setup):
     model, params = setup
     prompt = [3, 14, 15, 92, 65]

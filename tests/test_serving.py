@@ -983,6 +983,75 @@ def test_logprobs_validation_and_isolation(setup):
         off.admit([1, 2], logprobs=1)
 
 
+def test_prompt_logprobs_match_full_recompute(setup):
+    # vLLM's prompt_logprobs: entry j scores prompt[j] given
+    # prompt[:j] (entry 0 is None) — compare chunked-prefill records
+    # against log-softmax of one full causal forward, and the chunked
+    # records against an unchunked engine's
+    model, params = setup
+    prompt = [3, 14, 15, 92, 65, 7, 9, 1, 44, 2]  # 10 tokens, chunk 4
+    eng = ServingEngine(model, params, n_slots=2, chunk=4,
+                        logprobs_k=3)
+    s = eng.admit(prompt, prompt_logprobs=2)
+    recs = eng.prompt_logprobs(s)
+    assert len(recs) == len(prompt) and recs[0] is None
+    from tpu_k8s_device_plugin.workloads.inference import init_cache
+    full = jnp.asarray(prompt, jnp.int32)[None, :]
+    T = full.shape[1]
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (1, T))
+    logits, _ = model.apply(
+        {"params": params, "cache": init_cache(model, 1)},
+        full, pos, decode=False, mutable=["cache"])
+    lp = np.asarray(jax.nn.log_softmax(
+        np.asarray(logits, np.float32), axis=-1))[0]
+    for j in range(1, len(prompt)):
+        clp, top = recs[j]
+        row = lp[j - 1]
+        np.testing.assert_allclose(clp, row[prompt[j]],
+                                   rtol=1e-4, atol=1e-4)
+        assert len(top) == 2
+        assert [t for t, _ in top] == np.argsort(-row)[:2].tolist()
+    # unchunked engine produces the same records (to tolerance)
+    ung = ServingEngine(model, params, n_slots=1, chunk=None,
+                        logprobs_k=3)
+    recs2 = ung.prompt_logprobs(ung.admit(prompt, prompt_logprobs=2))
+    for a, b in zip(recs[1:], recs2[1:]):
+        np.testing.assert_allclose(a[0], b[0], rtol=1e-4, atol=1e-4)
+
+
+def test_prompt_logprobs_bypass_prefix_cache(setup):
+    # every position needs ITS OWN logits, so APC must not skip any
+    # prefill for a prompt_logprobs request
+    model, params = setup
+    shared = list(range(1, 13))
+    eng = ServingEngine(model, params, n_slots=2, chunk=4,
+                        auto_prefix_min=4, logprobs_k=2)
+    eng.admit(shared + [5])
+    before = eng.stats()
+    s = eng.admit(shared + [9], prompt_logprobs=1)
+    st = eng.stats()
+    assert st["prefix_cache_hits"] == before["prefix_cache_hits"]
+    assert (st["prefill_tokens"] - before["prefill_tokens"]
+            == len(shared) + 1)
+    assert len(eng.prompt_logprobs(s)) == len(shared) + 1
+
+
+def test_prompt_logprobs_validation_and_reset(setup):
+    model, params = setup
+    eng = ServingEngine(model, params, n_slots=1, chunk=4,
+                        logprobs_k=2, max_new_tokens=2)
+    with pytest.raises(ValueError, match="prompt_logprobs"):
+        eng.admit([1, 2], prompt_logprobs=3)
+    h = eng.register_prefix([1, 2, 3])
+    with pytest.raises(ValueError, match="prefix"):
+        eng.admit([1, 2, 3, 4], prefix=h, prompt_logprobs=1)
+    s = eng.admit([1, 2, 3], prompt_logprobs=1)
+    assert len(eng.prompt_logprobs(s)) == 3
+    eng.run(5)
+    s2 = eng.admit([4, 5, 6])  # recycled without the ask
+    assert eng.prompt_logprobs(s2) == []
+
+
 def test_draw_stream_mode_independent_after_retirement(setup):
     # a sampled slot retiring mid-window must leave the engine's key
     # stream where step-by-step scheduling would have left it, so later

@@ -24,7 +24,7 @@ API (JSON over HTTP/1.1):
                     "min_p": m?, "presence_penalty": f?,
                     "frequency_penalty": f?, "repetition_penalty": r?,
                     "adapter": a?, "stop": [int...]?, "logprobs": k?,
-                    "n": c?, "stream": true?}
+                    "prompt_logprobs": k?, "n": c?, "stream": true?}
                    n > 1 returns c completions: token events carry
                    "index", the final event has "choices" (copies
                    admit incrementally and share the prompt via the
@@ -77,6 +77,7 @@ class _Request:
     adapter: Optional[int] = None
     stop: Optional[List[int]] = None
     logprobs: Optional[int] = None
+    prompt_logprobs: Optional[int] = None
     n: int = 1
     events: "queue.Queue" = field(default_factory=queue.Queue)
     cancelled: bool = False
@@ -158,7 +159,12 @@ class EngineServer:
                     frequency_penalty=req.frequency_penalty,
                     repetition_penalty=req.repetition_penalty,
                     adapter=req.adapter, stop=req.stop,
-                    logprobs=req.logprobs)
+                    logprobs=req.logprobs,
+                    # the records are deterministic and identical per
+                    # copy: only copy 0 pays the full-prefill cost
+                    # (copies 1..n-1 keep their APC tail-only prefill)
+                    prompt_logprobs=(req.prompt_logprobs
+                                     if req.admitted == 0 else None))
             except (ValueError, RuntimeError) as e:
                 # identical args per copy, so only the FIRST admit can
                 # fail on validation (the free-slot guard rules out
@@ -223,6 +229,13 @@ class EngineServer:
                     for clp, top in
                     eng.token_logprobs(slot)[:len(out)]
                 ]
+            if req.prompt_logprobs and idx == 0:
+                choice["prompt_logprobs"] = [
+                    None if rec is None else
+                    {"logprob": rec[0],
+                     "top_logprobs": [[i, p] for i, p in rec[1]]}
+                    for rec in eng.prompt_logprobs(slot)
+                ]
             del self._running[slot]
             req.choices.append(choice)
             if len(req.choices) == req.n:
@@ -232,6 +245,13 @@ class EngineServer:
                 else:
                     done = {"done": True, "choices": sorted(
                         req.choices, key=lambda c: c["index"])}
+                    if req.prompt_logprobs:
+                        # identical across copies — attached ONCE,
+                        # from the one copy that computed them
+                        for ch in done["choices"]:
+                            if "prompt_logprobs" in ch:
+                                done["prompt_logprobs"] = ch.pop(
+                                    "prompt_logprobs")
                 # count BEFORE the event lands: a client reacting to
                 # the final chunk must not read a stale /stats counter
                 self._requests_served += 1
@@ -419,6 +439,7 @@ class EngineServer:
         top_k = body.get("top_k")
         adapter = body.get("adapter")
         logprobs = body.get("logprobs")
+        prompt_logprobs = body.get("prompt_logprobs")
         # copies admit incrementally, so n may exceed the slot count;
         # the cap is only a sanity bound against runaway requests
         n = int(body.get("n", 1))
@@ -446,6 +467,8 @@ class EngineServer:
             adapter=None if adapter is None else int(adapter),
             stop=stop,
             logprobs=None if logprobs is None else int(logprobs),
+            prompt_logprobs=(None if prompt_logprobs is None
+                             else int(prompt_logprobs)),
             n=n,
         )
 

@@ -394,6 +394,9 @@ class ServingEngine:
         self.logprobs_k = logprobs_k
         self._lp_want = [0] * n_slots
         self._lp_records: List[list] = [[] for _ in range(n_slots)]
+        # prompt_logprobs records (vLLM's prompt-scoring API): filled
+        # at admit from the prefill chunks' own logits
+        self._prompt_lp: List[list] = [[] for _ in range(n_slots)]
         self._prefixes: Dict[int, tuple] = {}
         self._next_prefix = 0
         # automatic prefix caching (vLLM's APC, the feature the
@@ -461,9 +464,13 @@ class ServingEngine:
         return [s for s in range(self.n_slots) if not self.active[s]]
 
     def _extend_prompt(self, mini, toks, start: int,
-                       adapter: int = -1):
+                       adapter: int = -1, plp_k: int = 0,
+                       plp_out: Optional[list] = None):
         """Push *toks* [1, n] into the B=1 *mini* cache starting at
-        depth *start*; returns (mini, last real token's logits row)."""
+        depth *start*; returns (mini, last real token's logits row).
+        With *plp_k*, per-chunk prompt-logprob stats (row j scores the
+        NEXT prompt token) are appended to *plp_out* as device arrays
+        — same compiled shapes as the extends themselves."""
         n = int(toks.shape[1])
         aid = self._adapter_vec(adapter)
         if self.chunk is None:
@@ -474,6 +481,10 @@ class ServingEngine:
             pos = (jnp.arange(n, dtype=jnp.int32) + start)[None, :]
             logits, mini = extend_step(
                 self.model, self.params, mini, toks, pos, aid)
+            if plp_k:
+                tgt = jnp.concatenate(
+                    [toks[0, 1:], jnp.zeros((1,), jnp.int32)])
+                plp_out.append(_top_logprobs(logits[0], tgt, plp_k))
             return mini, logits[0, n - 1]
         # fixed-size chunks: every chunk reuses ONE compiled extend; the
         # tail chunk pads with zeros whose K/V land beyond the true
@@ -488,6 +499,12 @@ class ServingEngine:
             [toks, jnp.zeros((1, padded - n), jnp.int32)], axis=1)
         self._prefill_tokens += n  # after the overflow check: rejected
         last = None                # extends never prefilled anything
+        if plp_k:
+            # row j of chunk i scores padded token i*c + j + 1: one
+            # extra zero column so the final row's slice exists (its
+            # stats are discarded host-side anyway)
+            toks_ext = jnp.concatenate(
+                [toks, jnp.zeros((1, 1), jnp.int32)], axis=1)
         for i in range(padded // c):
             chunk_toks = toks[:, i * c:(i + 1) * c]
             pos = (
@@ -495,6 +512,9 @@ class ServingEngine:
             )[None, :]
             logits, mini = extend_step(
                 self.model, self.params, mini, chunk_toks, pos, aid)
+            if plp_k:
+                tgt = toks_ext[0, i * c + 1:i * c + c + 1]
+                plp_out.append(_top_logprobs(logits[0], tgt, plp_k))
             off = n - 1 - i * c
             if 0 <= off < c:
                 last = logits[0, off]
@@ -589,7 +609,8 @@ class ServingEngine:
               repetition_penalty: float = 1.0,
               adapter: Optional[int] = None,
               stop: Optional[List[int]] = None,
-              logprobs: Optional[int] = None) -> int:
+              logprobs: Optional[int] = None,
+              prompt_logprobs: Optional[int] = None) -> int:
         """Prefill *prompt* into a free slot; returns the slot id.
         Raises RuntimeError when the engine is full (callers queue).
         With ``prefix`` (a :meth:`register_prefix` handle), the prompt
@@ -642,13 +663,20 @@ class ServingEngine:
                     f"stop token {t} outside [0, vocab="
                     f"{self.model.vocab})")
         lp_n = int(logprobs or 0)
-        if lp_n < 0:
-            raise ValueError("logprobs must be >= 0")
-        if lp_n > self.logprobs_k:
+        plp_n = int(prompt_logprobs or 0)
+        for nm, v in (("logprobs", lp_n), ("prompt_logprobs", plp_n)):
+            if v < 0:
+                raise ValueError(f"{nm} must be >= 0")
+            if v > self.logprobs_k:
+                raise ValueError(
+                    f"{nm}={v} exceeds the engine's logprobs_k="
+                    f"{self.logprobs_k} (set at construction — the "
+                    "engine-wide k keeps the decode step "
+                    "compile-stable)")
+        if plp_n and prefix is not None:
             raise ValueError(
-                f"logprobs={lp_n} exceeds the engine's logprobs_k="
-                f"{self.logprobs_k} (set at construction — the "
-                "engine-wide k keeps the decode step compile-stable)")
+                "prompt_logprobs needs the full prompt prefilled — "
+                "incompatible with a prefix handle")
         budget = self.max_new_tokens or 1
         if t_p + budget > self.model.max_len:
             raise ValueError(
@@ -676,7 +704,10 @@ class ServingEngine:
                     "prefix K/V, register one per adapter")
             start, n = L, t_p - L
         else:
-            auto_src = self._auto_match(prompt_np[0], t_p, aid)
+            # prompt_logprobs needs every position's logits, so it
+            # forces a full (cold) prefill — no automatic prefix reuse
+            auto_src = (None if plp_n
+                        else self._auto_match(prompt_np[0], t_p, aid))
             start = auto_src[2] if auto_src is not None else 0
             n = t_p - start
         if self.chunk is not None and n > 0:
@@ -690,6 +721,7 @@ class ServingEngine:
         # in-flight request
         self._finished.pop(slot, None)
         self._finish_reason.pop(slot, None)
+        self._prompt_lp[slot] = []
 
         if prefix is not None:
             if n > 0:
@@ -721,8 +753,31 @@ class ServingEngine:
             self._prefix_reused_tokens += m
         else:
             mini = self._place_cache(init_cache(self.model, 1))
-            mini, last = self._extend_prompt(mini, prompt, start=0,
-                                             adapter=aid)
+            plp_dev: list = []
+            mini, last = self._extend_prompt(
+                mini, prompt, start=0, adapter=aid,
+                plp_k=self.logprobs_k if plp_n else 0,
+                plp_out=plp_dev)
+            if plp_n:
+                # host assembly: position 0 has no conditional (vLLM
+                # emits null there); position j scores prompt[j] from
+                # chunk (j-1)//c's row (j-1)%c
+                c = self.chunk or t_p
+                # ONE batched transfer for all chunks' stats: per-array
+                # np.asarray would serialize a device round-trip per
+                # chunk — painful for exactly the long prompts this
+                # feature scores
+                hosts = jax.device_get(plp_dev)
+                recs: list = [None]
+                for j in range(1, t_p):
+                    clp, tlp, tid = hosts[(j - 1) // c]
+                    r = (j - 1) % c
+                    recs.append((
+                        float(clp[r]),
+                        [(int(tid[r][q]), float(tlp[r][q]))
+                         for q in range(plp_n)],
+                    ))
+                self._prompt_lp[slot] = recs
 
         self.cache = _splice_slot(self.cache, mini, jnp.int32(slot))
         # explicit-prefix admits with an unaligned prefix leave the
@@ -810,6 +865,14 @@ class ServingEngine:
         for s in range(self.n_slots):
             if self.active[s] and self._lp_want[s]:
                 self._record_logprobs(s, float(clp[s]), tlp[s], tid[s])
+
+    def prompt_logprobs(self, slot: int):
+        """Prompt-scoring records from admission (vLLM's
+        ``prompt_logprobs``): entry 0 is None (no conditional), entry
+        j is ``(logprob of prompt[j] given prompt[:j],
+        [(token id, logprob) x n])``.  Empty unless the request asked.
+        """
+        return list(self._prompt_lp[slot])
 
     def token_logprobs(self, slot: int):
         """Per-token logprob records for *slot* (finished or in
