@@ -19,9 +19,54 @@ from __future__ import annotations
 import json
 import os
 import statistics
+import threading
 import time
 
-import jax
+
+def _init_watchdog(timeout_s: int | None = None) -> threading.Timer:
+    """Emit a structured outage record and exit if backend init hangs:
+    when the remote TPU tunnel is down, ``jax.devices()`` blocks
+    indefinitely in NATIVE code (observed for hours in rounds 2-3) and
+    the round's benchmark artifact would be an empty hang.  A timer
+    thread still runs while the main thread is stuck, prints the
+    record, and hard-exits.  Fast failures (ImportError, backend
+    errors) are NOT masked — they traceback normally in the main
+    thread; healthy init just cancels the timer (zero extra cost).
+    The threshold is a judgment call between outage and slow-but-alive
+    init (healthy axon init is well under a minute; outages last
+    hours): BENCH_INIT_TIMEOUT overrides the 180s default when the
+    transport is known to be slower."""
+    if timeout_s is None:
+        timeout_s = int(os.environ.get("BENCH_INIT_TIMEOUT", "180"))
+
+    def fire():
+        print(json.dumps({
+            "metric": "alexnet_jax_images_per_sec_per_chip",
+            "value": None,
+            "unit": "images/sec",
+            "vs_baseline": None,
+            "extra": {
+                "error": "accelerator backend init exceeded "
+                         f"{timeout_s}s (TPU tunnel down, or raise "
+                         "BENCH_INIT_TIMEOUT for a slow transport); "
+                         "queued measurements: tools/measure_r3.py",
+            },
+        }), flush=True)
+        os._exit(1)
+
+    t = threading.Timer(timeout_s, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+_watchdog = _init_watchdog() if __name__ == "__main__" else None
+
+import jax  # noqa: E402  (under the watchdog by design)
+
+if _watchdog is not None:
+    jax.devices()  # the call that hangs when the tunnel is down
+    _watchdog.cancel()
 
 
 def bench_alexnet(platform: str):
