@@ -706,6 +706,29 @@ def test_stop_tokens_finish_request(setup):
     assert bng.output(sb) == solo[:3]
 
 
+def test_ignore_eos_decodes_to_budget(setup):
+    # vLLM's ignore_eos: the slot decodes past the eos token to the
+    # budget (fixed-length benchmarking through the real engine path);
+    # per-request stop tokens still apply
+    model, params = setup
+    prompt = [3, 14, 15, 92, 65]
+    solo = _solo(model, params, prompt, 6)
+    eos = solo[2]
+    eng = ServingEngine(model, params, n_slots=2, eos_id=eos,
+                        max_new_tokens=6)
+    s = eng.admit(prompt, ignore_eos=True)
+    other = eng.admit(prompt)  # respects eos
+    eng.run(10)
+    assert eng.output(s) == solo  # all 6, eos included mid-stream
+    assert eng.finish_reason(s) == "length"
+    assert eng.output(other) == solo[:3]
+    assert eng.finish_reason(other) == "eos"
+    # recycled slot must not inherit the flag
+    s2 = eng.admit(prompt)
+    eng.run(10)
+    assert eng.finish_reason(s2) == "eos"
+
+
 def test_finish_reasons_eos_and_length(setup):
     model, params = setup
     prompt = [3, 14, 15, 92, 65]

@@ -23,7 +23,8 @@ API (JSON over HTTP/1.1):
                     "temperature": f?, "top_k": k?, "top_p": p?,
                     "min_p": m?, "presence_penalty": f?,
                     "frequency_penalty": f?, "repetition_penalty": r?,
-                    "adapter": a?, "stop": [int...]?, "logprobs": k?,
+                    "adapter": a?, "stop": [int...]?,
+                    "ignore_eos": bool?, "logprobs": k?,
                     "prompt_logprobs": k?, "n": c?, "stream": true?}
                    n > 1 returns c completions: token events carry
                    "index", the final event has "choices" (copies
@@ -76,6 +77,7 @@ class _Request:
     repetition_penalty: float = 1.0
     adapter: Optional[int] = None
     stop: Optional[List[int]] = None
+    ignore_eos: bool = False
     logprobs: Optional[int] = None
     prompt_logprobs: Optional[int] = None
     n: int = 1
@@ -159,6 +161,7 @@ class EngineServer:
                     frequency_penalty=req.frequency_penalty,
                     repetition_penalty=req.repetition_penalty,
                     adapter=req.adapter, stop=req.stop,
+                    ignore_eos=req.ignore_eos,
                     logprobs=req.logprobs,
                     # the records are deterministic and identical per
                     # copy: only copy 0 pays the full-prefill cost
@@ -466,6 +469,7 @@ class EngineServer:
                 body.get("repetition_penalty", 1.0)),
             adapter=None if adapter is None else int(adapter),
             stop=stop,
+            ignore_eos=bool(body.get("ignore_eos", False)),
             logprobs=None if logprobs is None else int(logprobs),
             prompt_logprobs=(None if prompt_logprobs is None
                              else int(prompt_logprobs)),

@@ -387,6 +387,9 @@ class ServingEngine:
         # per-request stop-token sets (vLLM's `stop_token_ids`):
         # host-side data consulted at harvest, never a recompile
         self._stops: List[frozenset] = [frozenset()] * n_slots
+        # vLLM's ignore_eos (fixed-length benchmarking through the
+        # real engine path: decode to the budget regardless of eos)
+        self._ignore_eos = [False] * n_slots
         # logprobs: the engine computes top-`logprobs_k` stats for ALL
         # slots when enabled (one compiled variant, engine-wide k —
         # masking, not branching); requests ask for n <= k and the
@@ -609,6 +612,7 @@ class ServingEngine:
               repetition_penalty: float = 1.0,
               adapter: Optional[int] = None,
               stop: Optional[List[int]] = None,
+              ignore_eos: bool = False,
               logprobs: Optional[int] = None,
               prompt_logprobs: Optional[int] = None) -> int:
         """Prefill *prompt* into a free slot; returns the slot id.
@@ -800,6 +804,7 @@ class ServingEngine:
         self.reps[slot] = repetition_penalty
         self.adapters[slot] = aid
         self._stops[slot] = stops
+        self._ignore_eos[slot] = bool(ignore_eos)
         self._lp_want[slot] = lp_n
         self._lp_records[slot] = []
         # first token: the OUTPUT histogram is empty by definition
@@ -1040,7 +1045,8 @@ class ServingEngine:
     # -- completion --------------------------------------------------------
 
     def _maybe_finish(self, slot: int, token: int) -> None:
-        if self.eos_id is not None and token == self.eos_id:
+        if (self.eos_id is not None and token == self.eos_id
+                and not self._ignore_eos[slot]):
             self._finish(slot, "eos")
         elif token in self._stops[slot]:
             self._finish(slot, "stop")
@@ -1105,4 +1111,5 @@ class ServingEngine:
         self.reps[slot] = 1.0
         self.adapters[slot] = -1
         self._stops[slot] = frozenset()
+        self._ignore_eos[slot] = False
         self._lp_want[slot] = 0  # records stay readable post-finish
