@@ -302,6 +302,25 @@ def test_stop_tokens_over_http(server, setup):
     assert status == 400
 
 
+def test_seed_over_http(server):
+    # per-request seed: same request, same tokens — even after an
+    # unseeded sampled request shifts the engine's global stream — and
+    # n>1 sibling copies diverge (distinct second-level streams)
+    body = {"tokens": [5, 17, 3], "max_new_tokens": 5,
+            "temperature": 1.0, "top_k": 16, "seed": 42,
+            "stream": False}
+    _, events = _post(server.port, dict(body))
+    first = events[0]["tokens"]
+    _post(server.port, {"tokens": [9, 9], "max_new_tokens": 3,
+                        "temperature": 1.3, "stream": False})
+    _, events = _post(server.port, dict(body))
+    assert events[0]["tokens"] == first
+    _, events = _post(server.port, {**body, "max_new_tokens": 4,
+                                    "n": 2})
+    a, b = events[0]["choices"]
+    assert a["tokens"] != b["tokens"]
+
+
 def test_healthz_and_stats(server):
     conn = http.client.HTTPConnection("127.0.0.1", server.port,
                                       timeout=30)

@@ -24,7 +24,7 @@ API (JSON over HTTP/1.1):
                     "min_p": m?, "presence_penalty": f?,
                     "frequency_penalty": f?, "repetition_penalty": r?,
                     "adapter": a?, "stop": [int...]?,
-                    "ignore_eos": bool?, "logprobs": k?,
+                    "ignore_eos": bool?, "seed": s?, "logprobs": k?,
                     "prompt_logprobs": k?, "n": c?, "stream": true?}
                    n > 1 returns c completions: token events carry
                    "index", the final event has "choices" (copies
@@ -78,6 +78,7 @@ class _Request:
     adapter: Optional[int] = None
     stop: Optional[List[int]] = None
     ignore_eos: bool = False
+    seed: Optional[int] = None
     logprobs: Optional[int] = None
     prompt_logprobs: Optional[int] = None
     n: int = 1
@@ -162,6 +163,13 @@ class EngineServer:
                     repetition_penalty=req.repetition_penalty,
                     adapter=req.adapter, stop=req.stop,
                     ignore_eos=req.ignore_eos,
+                    # each sampled copy diverges via the engine's
+                    # SECOND fold level (seed_stream = copy index), so
+                    # "seed s copy 1" never aliases "seed s+1 copy 0";
+                    # copy-varying args are the one exception to the
+                    # identical-args-per-copy rule the except clause
+                    # below leans on (the engine validates neither)
+                    seed=req.seed, seed_stream=req.admitted,
                     logprobs=req.logprobs,
                     # the records are deterministic and identical per
                     # copy: only copy 0 pays the full-prefill cost
@@ -470,6 +478,8 @@ class EngineServer:
             adapter=None if adapter is None else int(adapter),
             stop=stop,
             ignore_eos=bool(body.get("ignore_eos", False)),
+            seed=(None if body.get("seed") is None
+                  else int(body["seed"])),
             logprobs=None if logprobs is None else int(logprobs),
             prompt_logprobs=(None if prompt_logprobs is None
                              else int(prompt_logprobs)),

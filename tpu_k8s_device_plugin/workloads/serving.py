@@ -189,9 +189,11 @@ def _set_count_row(counts, slot, row):
     return counts.at[slot].set(row)
 
 
-@jax.jit
+@functools.partial(jax.jit, static_argnums=(11,))
 def _pick_tokens(logits, temps, topks, topps, minps, pres, freqs,
-                 reps, counts, seen, key):
+                 reps, counts, seen, key, seeded=False,
+                 seeds=None, seed_streams=None, seed_on=None,
+                 seed_idx=None):
     """Per-slot sampling in one vectorized pass: [S, V] logits with
     per-slot temperature (0 = greedy), top-k (0 = unrestricted),
     top-p / nucleus (1.0 = unrestricted), min-p (0 = unrestricted),
@@ -245,6 +247,20 @@ def _pick_tokens(logits, temps, topks, topps, minps, pres, freqs,
     masked = jnp.where(
         (minps[:, None] > 0) & (scaled < thresh), -jnp.inf, masked)
     gumbel = jax.random.gumbel(key, (S, V), jnp.float32)
+    if seeded:
+        # per-request seeds (vLLM's `seed`): a seeded slot draws from
+        # its OWN chain — PRNGKey(seed) folded by stream (the n>1 copy
+        # index: a SECOND fold level, so "seed s copy 1" never aliases
+        # "seed s+1 copy 0") then by the slot's draw index — making
+        # its tokens reproducible regardless of neighbors or admission
+        # order.  Unseeded rows keep the engine stream.
+        def row_noise(seed, stream, idx):
+            k = jax.random.fold_in(jax.random.PRNGKey(seed), stream)
+            return jax.random.gumbel(
+                jax.random.fold_in(k, idx), (V,), jnp.float32)
+
+        own = jax.vmap(row_noise)(seeds, seed_streams, seed_idx)
+        gumbel = jnp.where(seed_on[:, None] > 0, own, gumbel)
     noised = masked + jnp.where(temps[:, None] > 0, gumbel, 0.0)
     return jnp.argmax(noised, axis=-1).astype(jnp.int32)
 
@@ -261,11 +277,12 @@ def _top_logprobs(logits, chosen, k):
 
 
 @functools.partial(
-    jax.jit, static_argnums=(0, 1, 2, 3, 4, 5), donate_argnums=(7,)
+    jax.jit, static_argnums=(0, 1, 2, 3, 4, 5, 6), donate_argnums=(8,)
 )
-def _scan_decode(model, n_steps, sampled, lp_k, pen, rep, params,
-                 cache, last, lens, temps, topks, topps, minps, pres,
-                 freqs, reps, counts, seen, adapter_ids, rng, draws0):
+def _scan_decode(model, n_steps, sampled, lp_k, pen, rep, seeded,
+                 params, cache, last, lens, temps, topks, topps, minps,
+                 pres, freqs, reps, counts, seen, seeds, seed_streams,
+                 seed_on, seed_base, adapter_ids, rng, draws0):
     """n_steps decode steps in one lax.scan.  The per-step sampling key
     is fold_in(rng, draws0 + i) — the same chain ``step`` consumes one
     link of per call, so scan and step-by-step emit identical streams.
@@ -286,6 +303,7 @@ def _scan_decode(model, n_steps, sampled, lp_k, pen, rep, params,
             nxt = _pick_tokens(
                 lg, temps, topks, topps, minps, pres, freqs, reps,
                 cnt, sn, jax.random.fold_in(rng, draws0 + i),
+                seeded, seeds, seed_streams, seed_on, seed_base + i,
             )
         else:
             nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
@@ -390,6 +408,14 @@ class ServingEngine:
         # vLLM's ignore_eos (fixed-length benchmarking through the
         # real engine path: decode to the budget regardless of eos)
         self._ignore_eos = [False] * n_slots
+        # per-request seeds (vLLM's `seed`): seeded slots draw from
+        # their own fold_in chain, indexed by a PER-SLOT draw counter
+        # — never the global one, which neighbors' admissions advance
+        # (the whole point of a seed is a stream that ignores them)
+        self.seeds = np.zeros(n_slots, np.uint32)
+        self._seed_streams = np.zeros(n_slots, np.int32)
+        self._seed_on = np.zeros(n_slots, np.int32)
+        self._slot_draws = [0] * n_slots
         # logprobs: the engine computes top-`logprobs_k` stats for ALL
         # slots when enabled (one compiled variant, engine-wide k —
         # masking, not branching); requests ask for n <= k and the
@@ -610,6 +636,8 @@ class ServingEngine:
               presence_penalty: float = 0.0,
               frequency_penalty: float = 0.0,
               repetition_penalty: float = 1.0,
+              seed: Optional[int] = None,
+              seed_stream: int = 0,
               adapter: Optional[int] = None,
               stop: Optional[List[int]] = None,
               ignore_eos: bool = False,
@@ -805,11 +833,16 @@ class ServingEngine:
         self.adapters[slot] = aid
         self._stops[slot] = stops
         self._ignore_eos[slot] = bool(ignore_eos)
+        self.seeds[slot] = np.uint32((seed or 0) & 0xFFFFFFFF)
+        self._seed_streams[slot] = int(seed_stream)
+        self._seed_on[slot] = 0 if seed is None else 1
+        self._slot_draws[slot] = 0
         self._lp_want[slot] = lp_n
         self._lp_records[slot] = []
         # first token: the OUTPUT histogram is empty by definition
         # (presence/frequency no-op), but the repetition penalty scopes
         # over the prompt — host bincount, no per-length compiles
+        draws_before = self._draws
         rep_on = repetition_penalty != 1.0
         if rep_on:
             seen_row = jnp.asarray(np.bincount(
@@ -825,7 +858,14 @@ class ServingEngine:
             np.asarray([presence_penalty], np.float32),
             np.asarray([frequency_penalty], np.float32),
             np.asarray([repetition_penalty], np.float32),
-            self._zero_vocab_row, seen_row)[0])
+            self._zero_vocab_row, seen_row,
+            self.seeds[slot:slot + 1],
+            self._seed_streams[slot:slot + 1],
+            self._seed_on[slot:slot + 1],
+            np.asarray([0], np.int32))[0])
+        if self._draws != draws_before:
+            # the admit consumed a draw: this slot's own chain moved
+            self._slot_draws[slot] = 1
         if presence_penalty or frequency_penalty:
             self._counts = _zero_count_row(self._counts, slot)
             self._counts = _bump_one(self._counts, slot, first)
@@ -888,7 +928,8 @@ class ServingEngine:
         return list(self._lp_records[slot])
 
     def _sample(self, logits, temps, topks, topps, minps, pres, freqs,
-                reps, counts, seen):
+                reps, counts, seen, seeds, seed_streams, seed_on,
+                seed_idx):
         if not _knobs_live(temps, topks, topps, minps, pres, freqs,
                            reps):
             # all-greedy batch (the default): plain argmax — no vocab
@@ -898,11 +939,16 @@ class ServingEngine:
                 jnp.argmax(logits, axis=-1), dtype=np.int32)
         key = jax.random.fold_in(self._rng, self._draws)
         self._draws += 1
+        seeded = bool(np.asarray(seed_on).any())
         return np.asarray(
             _pick_tokens(logits, jnp.asarray(temps), jnp.asarray(topks),
                          jnp.asarray(topps), jnp.asarray(minps),
                          jnp.asarray(pres), jnp.asarray(freqs),
-                         jnp.asarray(reps), counts, seen, key),
+                         jnp.asarray(reps), counts, seen, key,
+                         seeded, jnp.asarray(seeds),
+                         jnp.asarray(seed_streams),
+                         jnp.asarray(seed_on),
+                         jnp.asarray(seed_idx)),
             dtype=np.int32)
 
     # -- decoding ----------------------------------------------------------
@@ -926,10 +972,17 @@ class ServingEngine:
             self.model, self.params, self.cache, tokens, positions,
             aids)
         self._steps += 1
+        sidx = np.asarray(self._slot_draws, np.int32)
+        draws_before = self._draws
         nxt = self._sample(logits[:, -1, :], self.temps, self.topks,
                            self.topps, self.minps, self.pres,
                            self.freqs, self.reps, self._counts,
-                           self._seen)
+                           self._seen, self.seeds, self._seed_streams,
+                           self._seed_on, sidx)
+        if self._draws != draws_before:
+            # a sampled step advances every slot's own chain in
+            # lockstep (garbage rows are reset at their next admit)
+            self._slot_draws = [d + 1 for d in self._slot_draws]
         if self._pen_live():
             self._counts = _bump_counts(self._counts, jnp.asarray(nxt))
         if self._rep_live():
@@ -986,6 +1039,7 @@ class ServingEngine:
                               self.reps)
         pen = self._pen_live()
         rep = self._rep_live()
+        seeded = bool(self._seed_on.any())
         # logprob stats ride the scan only when someone is listening:
         # at most two compiled variants (k and 0), never per request
         lp_k = self.logprobs_k if any(
@@ -994,13 +1048,16 @@ class ServingEngine:
         aids = (jnp.asarray(self.adapters)
                 if self.model.n_adapters > 0 else None)
         ys, self.cache, self._counts, self._seen = _scan_decode(
-            self.model, n_steps, sampled, lp_k, pen, rep, self.params,
-            self.cache,
+            self.model, n_steps, sampled, lp_k, pen, rep, seeded,
+            self.params, self.cache,
             jnp.asarray(self.last_token), jnp.asarray(self.lens, jnp.int32),
             jnp.asarray(self.temps), jnp.asarray(self.topks),
             jnp.asarray(self.topps), jnp.asarray(self.minps),
             jnp.asarray(self.pres), jnp.asarray(self.freqs),
-            jnp.asarray(self.reps), self._counts, self._seen, aids,
+            jnp.asarray(self.reps), self._counts, self._seen,
+            jnp.asarray(self.seeds), jnp.asarray(self._seed_streams),
+            jnp.asarray(self._seed_on),
+            jnp.asarray(self._slot_draws, jnp.int32), aids,
             self._rng, jnp.int32(self._draws),
         )
         toks = np.asarray(ys[0], dtype=np.int32)  # [n_steps, S]
@@ -1038,6 +1095,9 @@ class ServingEngine:
                 out[s].append(tok)
                 self._maybe_finish(s, tok)
         self._draws += draws_used
+        # per-slot chains advance in lockstep with the global counter
+        # (step() does the same once per sampled call)
+        self._slot_draws = [d + draws_used for d in self._slot_draws]
         # lens advanced n_steps per slot in-device; the loop above
         # advanced the host mirror the same amount
         return out
@@ -1112,4 +1172,5 @@ class ServingEngine:
         self.adapters[slot] = -1
         self._stops[slot] = frozenset()
         self._ignore_eos[slot] = False
+        self._seed_on[slot] = 0
         self._lp_want[slot] = 0  # records stay readable post-finish
