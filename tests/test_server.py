@@ -302,6 +302,55 @@ def test_stop_tokens_over_http(server, setup):
     assert status == 400
 
 
+def test_priority_scheduling_order(setup):
+    # higher priority admits first when slots are scarce; FIFO within
+    # a level (deterministic: scheduler thread not started, the heap
+    # is exercised directly)
+    model, params = setup
+    eng = ServingEngine(model, params, n_slots=1)
+    srv = EngineServer(eng, max_new_tokens=4)
+    lo = srv._parse_request({"tokens": [1, 2], "priority": 0})
+    hi = srv._parse_request({"tokens": [3, 4], "priority": 7})
+    srv._enqueue(lo)
+    srv._enqueue(hi)
+    srv._admit_pending()
+    assert hi.admitted == 1 and lo.admitted == 0
+    assert srv.stats()["pending_requests"] == 1
+    eng2 = ServingEngine(model, params, n_slots=1)
+    srv2 = EngineServer(eng2, max_new_tokens=4)
+    a = srv2._parse_request({"tokens": [1, 2]})
+    b = srv2._parse_request({"tokens": [3, 4]})
+    srv2._enqueue(a)
+    srv2._enqueue(b)
+    srv2._admit_pending()
+    assert a.admitted == 1 and b.admitted == 0
+
+
+def test_priority_preempts_multi_completion_head(setup):
+    # a partially-admitted n>1 request must NOT monopolize freed slots
+    # against a strictly higher-priority arrival: its remaining copies
+    # go back into the heap and the high-priority request admits first
+    model, params = setup
+    eng = ServingEngine(model, params, n_slots=1)
+    srv = EngineServer(eng, max_new_tokens=2, window=1)
+    low = srv._parse_request(
+        {"tokens": [1, 2], "max_new_tokens": 2, "n": 3})
+    srv._enqueue(low)
+    srv._admit_pending()          # copy 0 occupies the one slot
+    assert low.admitted == 1 and srv._head is low
+    hi = srv._parse_request({"tokens": [3, 4], "priority": 5})
+    srv._enqueue(hi)
+    # finish the running copy and harvest it (what the scheduler loop
+    # does between windows)
+    eng.run(5)
+    for slot, (req, idx) in list(srv._running.items()):
+        srv._emit(slot, req, idx, eng.output(slot))
+    srv._admit_pending()
+    assert hi.admitted == 1      # preempted the head's copy 1
+    assert low.admitted == 1
+    assert srv._head is None and len(srv._pending) == 1
+
+
 def test_seed_over_http(server):
     # per-request seed: same request, same tokens — even after an
     # unseeded sampled request shifts the engine's global stream — and

@@ -25,7 +25,8 @@ API (JSON over HTTP/1.1):
                     "frequency_penalty": f?, "repetition_penalty": r?,
                     "adapter": a?, "stop": [int...]?,
                     "ignore_eos": bool?, "seed": s?, "logprobs": k?,
-                    "prompt_logprobs": k?, "n": c?, "stream": true?}
+                    "prompt_logprobs": k?, "n": c?, "priority": p?,
+                    "stream": true?}
                    n > 1 returns c completions: token events carry
                    "index", the final event has "choices" (copies
                    admit incrementally and share the prompt via the
@@ -45,6 +46,7 @@ engine's contract stays exact and model-agnostic.
 from __future__ import annotations
 
 import argparse
+import heapq
 import json
 import logging
 import queue
@@ -79,6 +81,8 @@ class _Request:
     stop: Optional[List[int]] = None
     ignore_eos: bool = False
     seed: Optional[int] = None
+    priority: int = 0                 # higher admits first
+    _seq: int = 0                     # enqueue order (FIFO in a level)
     logprobs: Optional[int] = None
     prompt_logprobs: Optional[int] = None
     n: int = 1
@@ -111,7 +115,13 @@ class EngineServer:
         self.engine = engine
         self.default_max_new = max_new_tokens
         self.window = window
-        self._pending: "queue.Queue[_Request]" = queue.Queue()
+        # priority heap (vLLM's priority scheduling): higher-priority
+        # requests admit first, FIFO within a priority level (the
+        # monotonic sequence number breaks ties).  Guarded by _lock —
+        # handler threads push, only the scheduler pops.
+        self._pending: list = []
+        self._pending_seq = 0
+        self._lock = threading.Lock()
         self._work = threading.Event()    # set on every enqueue
         self._running: dict = {}          # slot -> (_Request, copy idx)
         self._head: Optional[_Request] = None  # partially admitted n>1
@@ -131,12 +141,25 @@ class EngineServer:
         every copy after the first into a tail-only prefill."""
         eng = self.engine
         while eng.free_slots():
-            req = self._head
-            self._head = None
-            if req is None:
-                try:
-                    req = self._pending.get_nowait()
-                except queue.Empty:
+            with self._lock:
+                head = self._head
+                top = self._pending[0] if self._pending else None
+                if (head is not None and top is not None
+                        and -top[0] > head.priority):
+                    # a strictly higher-priority arrival preempts the
+                    # remaining copies of a partially-admitted n>1
+                    # request — the head goes back into the heap at
+                    # its ORIGINAL position within its level
+                    req = heapq.heappop(self._pending)[2]
+                    heapq.heappush(
+                        self._pending,
+                        (-head.priority, head._seq, head))
+                    self._head = None
+                elif head is not None:
+                    req, self._head = head, None
+                elif top is not None:
+                    req = heapq.heappop(self._pending)[2]
+                else:
                     return
             if req.cancelled:
                 continue
@@ -273,8 +296,8 @@ class EngineServer:
         while not self._stop.is_set():
             self._admit_pending()
             if not self._running:
-                # idle: wait for work without spinning (FIFO order is
-                # preserved — requests stay in the queue)
+                # idle: wait for work without spinning (admission is
+                # priority-then-FIFO; requests stay in the heap)
                 self._work.wait(timeout=_IDLE_POLL_S)
                 self._work.clear()
                 continue
@@ -328,8 +351,7 @@ class EngineServer:
                                json.dumps({"error": str(e)}) + "\n")
                     return
                 stream = bool(body.get("stream", True))
-                server._pending.put(req)
-                server._work.set()
+                server._enqueue(req)
                 try:
                     if stream:
                         self._stream(req)
@@ -427,15 +449,22 @@ class EngineServer:
             if id(self._head) not in notified:
                 self._head.events.put(dict(bye))
             self._head = None
-        while True:
-            try:
-                self._pending.get_nowait().events.put(dict(bye))
-            except queue.Empty:
-                break
+        with self._lock:
+            drained, self._pending = self._pending, []
+        for _, _, req in drained:
+            req.events.put(dict(bye))
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+
+    def _enqueue(self, req: _Request) -> None:
+        with self._lock:
+            self._pending_seq += 1
+            req._seq = self._pending_seq
+            heapq.heappush(self._pending,
+                           (-req.priority, req._seq, req))
+        self._work.set()
 
     # -- request plumbing ---------------------------------------------------
 
@@ -480,6 +509,7 @@ class EngineServer:
             ignore_eos=bool(body.get("ignore_eos", False)),
             seed=(None if body.get("seed") is None
                   else int(body["seed"])),
+            priority=int(body.get("priority", 0)),
             logprobs=None if logprobs is None else int(logprobs),
             prompt_logprobs=(None if prompt_logprobs is None
                              else int(prompt_logprobs)),
@@ -489,7 +519,7 @@ class EngineServer:
     def stats(self) -> dict:
         st = dict(self.engine.stats())
         st.update({
-            "pending_requests": self._pending.qsize(),
+            "pending_requests": len(self._pending),
             # distinct REQUESTS (an n>1 request occupies n slots)
             "running_requests": len(
                 {id(r) for r, _ in self._running.values()}),
