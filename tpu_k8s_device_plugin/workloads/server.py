@@ -319,6 +319,24 @@ class EngineServer:
                 eng.run_scan(window)
             for slot, (req, idx) in list(self._running.items()):
                 self._emit(slot, req, idx, eng.output(slot))
+        # the scheduler owns _running/_head: it performs the shutdown
+        # drain itself so stop() never mutates them while a device step
+        # is still in flight (a stuck 5s join used to race here)
+        self._drain_on_stop()
+
+    def _drain_on_stop(self) -> None:
+        """Send every connected client a terminal 503. Idempotent."""
+        bye = {"error": "server shutting down", "code": 503}
+        notified = set()
+        for req, _idx in self._running.values():
+            if id(req) not in notified:
+                notified.add(id(req))
+                req.events.put(dict(bye))
+        self._running.clear()
+        if self._head is not None:
+            if id(self._head) not in notified:
+                self._head.events.put(dict(bye))
+            self._head = None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -431,24 +449,28 @@ class EngineServer:
 
     def stop(self) -> None:
         self._stop.set()
-        if self._scheduler is not None:
-            self._scheduler.join(timeout=5)
-            self._scheduler = None
-        # unblock every connected client: handler threads sit in
-        # req.events.get(), and ThreadingHTTPServer.shutdown() only
-        # stops the ACCEPT loop — without a terminal event they would
-        # hang until their socket timeout
+        self._work.set()  # wake an idle scheduler so it can exit
+        sched = self._scheduler
+        if sched is not None:
+            sched.join(timeout=5)
+            if sched.is_alive():
+                # stuck in a long device step (e.g. a first-window
+                # run_scan compile): the scheduler drains _running and
+                # _head itself on exit — mutating them here would race
+                # with the still-running thread (KeyError in _emit,
+                # re-admitted requests)
+                log.warning(
+                    "scheduler busy after 5s join; clients will be "
+                    "drained when the in-flight device step returns")
+            else:
+                self._scheduler = None
+                self._drain_on_stop()  # no-op if scheduler drained
+        else:
+            # never started: unblock any connected client directly —
+            # handler threads sit in req.events.get(), and
+            # ThreadingHTTPServer.shutdown() only stops the ACCEPT loop
+            self._drain_on_stop()
         bye = {"error": "server shutting down", "code": 503}
-        notified = set()
-        for req, _idx in self._running.values():
-            if id(req) not in notified:
-                notified.add(id(req))
-                req.events.put(dict(bye))
-        self._running.clear()
-        if self._head is not None:
-            if id(self._head) not in notified:
-                self._head.events.put(dict(bye))
-            self._head = None
         with self._lock:
             drained, self._pending = self._pending, []
         for _, _, req in drained:
@@ -471,7 +493,10 @@ class EngineServer:
     def _parse_request(self, body: dict) -> _Request:
         tokens = body.get("tokens")
         if (not isinstance(tokens, list) or not tokens
-                or not all(isinstance(t, int) for t in tokens)):
+                or not all(isinstance(t, int)
+                           and not isinstance(t, bool) for t in tokens)):
+            # bool is an int subclass: JSON `true` would silently
+            # become token id 1 instead of a 400 (same guard as 'stop')
             raise ValueError("'tokens' must be a non-empty int list")
         max_new = int(body.get("max_new_tokens", self.default_max_new))
         if max_new < 1:

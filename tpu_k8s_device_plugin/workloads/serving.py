@@ -714,6 +714,11 @@ class ServingEngine:
             raise ValueError(
                 f"prompt {t_p} + budget {budget} exceeds "
                 f"max_len {self.model.max_len}")
+        # t_p <= max_len - 1 is also the prefix-cache donor invariant
+        # (see release()): a parked slot's masked decode writes clamp to
+        # row max_len - 1, which this bound keeps out of the prompt
+        # rows, so released-slot donor records stay valid K/V
+        assert t_p <= self.model.max_len - 1
         free = self.free_slots()
         if not free:
             raise RuntimeError("no free slots")
@@ -1156,6 +1161,15 @@ class ServingEngine:
         self._finished.pop(slot, None)
         self._finish_reason.pop(slot, None)
         self.lens[slot] = 0
+        # _slot_prompts[slot] deliberately SURVIVES release: the prompt
+        # K/V rows [0, canon) stay valid donors for automatic-prefix
+        # matches until the slot is re-admitted (the common server
+        # pattern: retire request A, admit request B sharing A's system
+        # prompt into the same slot).  Validity rests on the clamped-
+        # write invariant asserted in admit(): inactive slots' masked
+        # decode writes land at device cache_lens rows clamped to
+        # max_len - 1, and every prompt row is < max_len - 1, so a
+        # parked slot's prompt K/V is never overwritten.
         self._reset_slot_params(slot)
 
     def _reset_slot_params(self, slot: int) -> None:
