@@ -118,11 +118,15 @@ class TestContainerImpl:
         assert car.envs[constants.ENV_TPU_WORKER_ID] == "1"
         assert car.envs[constants.ENV_TPU_TOPOLOGY] == "4x4"
 
-    def test_allocate_noncontiguous_bounds_degrade_linear(self, testdata):
+    def test_allocate_noncontiguous_bounds_degrade_linear(self, testdata, caplog):
         """Fragmented kubelet-default sets must not claim a bounding box
-        whose volume exceeds the chip count."""
+        whose volume exceeds the chip count — and the lossy degrade must
+        be operator-visible (warning + counter), not silent (VERDICT r3
+        #8: a pod with linear bounds has slow ICI collectives and the
+        operator needs to see why)."""
         impl = make_impl(testdata, "v5e-8")
         ctx = ctx_for(impl)
+        assert impl.counters()["degraded_bounds_allocations"] == 0
         req = pluginapi.AllocateRequest(
             container_requests=[
                 # coords (0,0) and (1,1): box volume 4 != 2 chips
@@ -131,8 +135,25 @@ class TestContainerImpl:
                 )
             ]
         )
-        car = impl.allocate(ctx, req).container_responses[0]
+        with caplog.at_level("WARNING",
+                             logger="tpu_k8s_device_plugin.tpu.device_impl"):
+            car = impl.allocate(ctx, req).container_responses[0]
         assert car.envs[constants.ENV_TPU_CHIPS_PER_HOST_BOUNDS] == "2,1,1"
+        assert impl.counters()["degraded_bounds_allocations"] == 1
+        assert any("non-contiguous" in r.message for r in caplog.records)
+
+    def test_allocate_contiguous_does_not_count_degraded(self, testdata):
+        impl = make_impl(testdata, "v5e-8")
+        ctx = ctx_for(impl)
+        req = pluginapi.AllocateRequest(
+            container_requests=[
+                pluginapi.ContainerAllocateRequest(
+                    devices_ids=[addr(0), addr(1)]
+                )
+            ]
+        )
+        impl.allocate(ctx, req)
+        assert impl.counters()["degraded_bounds_allocations"] == 0
 
     def test_allocate_unknown_device(self, testdata):
         impl = make_impl(testdata, "v5e-8")
