@@ -13,7 +13,7 @@ import signal
 import sys
 
 from tpu_k8s_device_plugin import __version__
-from tpu_k8s_device_plugin.health import TpuHealthServer
+from tpu_k8s_device_plugin.health import MetricsHTTPServer, TpuHealthServer
 from tpu_k8s_device_plugin.types import constants
 
 
@@ -23,6 +23,10 @@ def main(argv=None) -> int:
         "--socket", default=constants.METRICS_EXPORTER_SOCKET,
         help="unix socket to serve the TpuHealthService on",
     )
+    p.add_argument(
+        "--metrics-port", type=int, default=constants.METRICS_HTTP_PORT,
+        help="TCP port for the Prometheus /metrics endpoint (0 disables)",
+    )
     p.add_argument("--sysfs-root", default="/sys", help=argparse.SUPPRESS)
     p.add_argument("--dev-root", default="/dev", help=argparse.SUPPRESS)
     p.add_argument("--version", action="version", version=__version__)
@@ -30,18 +34,36 @@ def main(argv=None) -> int:
 
     logging.basicConfig(level=logging.INFO)
     # pod shutdown sends SIGTERM; exit through the finally so the socket is
-    # removed rather than left stale for the next incarnation
-    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+    # removed rather than left stale for the next incarnation (skipped when
+    # main() is driven from a worker thread, where signal.signal raises)
+    import threading
+    if threading.current_thread() is threading.main_thread():
+        signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
     server = TpuHealthServer(
         socket_path=args.socket,
         sysfs_root=args.sysfs_root,
         dev_root=args.dev_root,
     ).start()
+    metrics = None
     try:
+        # inside the try: a bind failure (port taken by a restart race)
+        # must tear the gRPC server down and exit non-zero so the pod
+        # restarts, not leave a live process with no /metrics listener
+        if args.metrics_port:
+            metrics = MetricsHTTPServer(
+                port=args.metrics_port,
+                sysfs_root=args.sysfs_root,
+                dev_root=args.dev_root,
+            ).start()
         server.wait()
     except KeyboardInterrupt:
         pass
+    except OSError as e:
+        logging.error("metrics listener failed: %s", e)
+        return 1
     finally:
+        if metrics is not None:
+            metrics.stop()
         server.stop()
     return 0
 

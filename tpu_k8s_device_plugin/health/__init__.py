@@ -1,6 +1,12 @@
 """Health subsystem: exporter client + probe server (≈ internal/pkg/exporter)."""
 
 from .client import get_tpu_health
+from .metrics import MetricsHTTPServer, render_metrics
 from .server import TpuHealthServer
 
-__all__ = ["get_tpu_health", "TpuHealthServer"]
+__all__ = [
+    "get_tpu_health",
+    "MetricsHTTPServer",
+    "render_metrics",
+    "TpuHealthServer",
+]

@@ -1,0 +1,173 @@
+"""Prometheus surface of the tpu-metrics-exporter daemon.
+
+The AMD daemon this subsystem mirrors is a *metrics* exporter first —
+the reference's health client dials
+``amdgpu_device_metrics_exporter_grpc.socket``
+(/root/reference/internal/pkg/exporter/health.go:35-37) and the health
+RPC is one service on it.  Round 3 shipped the gRPC health half only;
+this module adds the Prometheus half: a ``/metrics`` HTTP endpoint with
+per-chip health gauges and error counters, hand-rendered in the text
+exposition format (no client-library registry state to leak between
+tests).
+
+Exported series:
+
+- ``tpu_device_health{chip,device} 0|1`` — per-chip gauge, same probe
+  as the gRPC health RPC (sysfs chip_state / UE count / node stat)
+- ``tpu_device_uncorrectable_errors{chip}`` — driver-reported fatal
+  error count (present only when the sysfs attr exists)
+- ``tpu_exporter_chips`` / ``tpu_exporter_unhealthy_chips`` — node
+  rollups so one scrape answers "is this node degraded"
+- ``tpu_exporter_scrapes_total`` — exporter liveness
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from tpu_k8s_device_plugin.tpu import discovery, sysfs
+from tpu_k8s_device_plugin.types import constants
+
+from .server import probe_chip_states
+
+log = logging.getLogger(__name__)
+
+
+def _escape(v: str) -> str:
+    """Prometheus label-value escaping (backslash, quote, newline)."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def read_ue_count(sysfs_root: str, pci_address: str) -> Optional[int]:
+    """Driver-reported uncorrectable-error count for a chip, or None when
+    the attribute is absent (older drivers) or unparseable."""
+    raw = sysfs.read_file(os.path.join(
+        sysfs_root, "bus", "pci", "devices", pci_address,
+        constants.SYSFS_UE_COUNT))
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
+
+
+def render_metrics(sysfs_root: str = "/sys", dev_root: str = "/dev",
+                   scrapes: int = 0) -> str:
+    """One scrape: probe every chip and render the exposition text."""
+    chips, _ = discovery.get_tpu_chips(sysfs_root, dev_root, "/nonexistent")
+    states = probe_chip_states(sysfs_root, dev_root, chips=chips)
+    lines = [
+        "# HELP tpu_device_health Per-chip health (1 healthy, 0 unhealthy).",
+        "# TYPE tpu_device_health gauge",
+    ]
+    unhealthy = 0
+    for cid in sorted(states):
+        st = states[cid]
+        up = 1 if st.health == "Healthy" else 0
+        unhealthy += 1 - up
+        lines.append(
+            f'tpu_device_health{{chip="{_escape(cid)}",'
+            f'device="{_escape(st.device)}"}} {up}')
+    ue_lines = []
+    for cid in sorted(states):
+        chip = chips.get(cid)
+        if chip is None:
+            continue
+        ue = read_ue_count(sysfs_root, chip.pci_address)
+        if ue is not None:
+            ue_lines.append(
+                f'tpu_device_uncorrectable_errors{{chip="{_escape(cid)}"}}'
+                f" {ue}")
+    if ue_lines:
+        lines += [
+            "# HELP tpu_device_uncorrectable_errors Driver-reported fatal "
+            "error count.",
+            "# TYPE tpu_device_uncorrectable_errors counter",
+            *ue_lines,
+        ]
+    lines += [
+        "# HELP tpu_exporter_chips Chips the exporter probes.",
+        "# TYPE tpu_exporter_chips gauge",
+        f"tpu_exporter_chips {len(states)}",
+        "# HELP tpu_exporter_unhealthy_chips Chips currently unhealthy.",
+        "# TYPE tpu_exporter_unhealthy_chips gauge",
+        f"tpu_exporter_unhealthy_chips {unhealthy}",
+        "# HELP tpu_exporter_scrapes_total Scrapes served.",
+        "# TYPE tpu_exporter_scrapes_total counter",
+        f"tpu_exporter_scrapes_total {scrapes}",
+    ]
+    return "\n".join(lines) + "\n"
+
+
+class MetricsHTTPServer:
+    """``/metrics`` (Prometheus) + ``/healthz`` on a TCP port, probing the
+    same fixture-injectable sysfs/dev roots as the gRPC service."""
+
+    def __init__(self, port: int = constants.METRICS_HTTP_PORT,
+                 sysfs_root: str = "/sys", dev_root: str = "/dev",
+                 host: str = "0.0.0.0"):
+        self._port = port
+        self._host = host
+        self._sysfs_root = sysfs_root
+        self._dev_root = dev_root
+        self._scrapes = 0
+        self._lock = threading.Lock()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else self._port
+
+    def start(self) -> "MetricsHTTPServer":
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path == "/healthz":
+                    self._send(200, "text/plain", "ok\n")
+                    return
+                if self.path != "/metrics":
+                    self._send(404, "text/plain", "not found\n")
+                    return
+                with outer._lock:
+                    outer._scrapes += 1
+                    n = outer._scrapes
+                try:
+                    body = render_metrics(
+                        outer._sysfs_root, outer._dev_root, scrapes=n)
+                except Exception as e:  # scrape must not kill the daemon
+                    log.exception("metrics scrape failed")
+                    self._send(500, "text/plain", f"scrape failed: {e}\n")
+                    return
+                self._send(200,
+                           "text/plain; version=0.0.4; charset=utf-8",
+                           body)
+
+            def _send(self, code, ctype, body: str):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, fmt, *args):
+                log.debug("metrics-http: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        threading.Thread(target=self._httpd.serve_forever,
+                         name="metrics-http", daemon=True).start()
+        log.info("prometheus metrics on http://%s:%d/metrics",
+                 self._host, self.port)
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

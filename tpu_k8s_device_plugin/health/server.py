@@ -86,12 +86,17 @@ def _sysfs_chip_fault(sysfs_root: str, pci_address: str) -> Optional[str]:
 
 
 def probe_chip_states(
-    sysfs_root: str = "/sys", dev_root: str = "/dev"
+    sysfs_root: str = "/sys", dev_root: str = "/dev", chips=None
 ) -> Dict[str, hpb.TpuState]:
     """Probe every chip: driver-reported sysfs state first (sees wedged
-    chips), then device-node accessibility (sees missing/broken nodes)."""
+    chips), then device-node accessibility (sees missing/broken nodes).
+    *chips* skips the discovery walk when the caller already ran one
+    (the Prometheus scrape renders health + error counters from a single
+    enumeration)."""
     states: Dict[str, hpb.TpuState] = {}
-    chips, _ = discovery.get_tpu_chips(sysfs_root, dev_root, "/nonexistent")
+    if chips is None:
+        chips, _ = discovery.get_tpu_chips(
+            sysfs_root, dev_root, "/nonexistent")
     for chip in chips.values():
         if chip.accel_index < 0:
             # raw-PCI fallback chips (vfio passthrough) have no accel node to

@@ -8,6 +8,9 @@ introspection a Go binary would get for free —
   GET /debug/status   JSON: served resources, per-device health, RPC
                       counters, topology summary
   GET /debug/threads  all-thread stack dump (the goroutine-dump analog)
+  GET /metrics        the same counters in Prometheus exposition format
+                      (per-resource RPC counters, device health rollups,
+                      degraded-allocation count)
 
 Disabled unless --debug-port is set; binds loopback only (it exposes
 internal state and has no auth — same posture as Go's default pprof
@@ -52,6 +55,11 @@ def manager_status(manager: "PluginManager") -> dict:
         "kubelet_dir": manager.kubelet_dir,
         "resources": manager.status_snapshot(),
     }
+    # impl-level counters are node-wide, not per-resource (e.g. how many
+    # Allocates degraded to linear bounds under fragmentation)
+    impl_counters = getattr(manager.impl, "counters", None)
+    if callable(impl_counters):
+        status["impl_counters"] = impl_counters()
     topo = getattr(manager.impl, "topology", None)
     if topo is not None:
         status["topology"] = {
@@ -61,6 +69,49 @@ def manager_status(manager: "PluginManager") -> dict:
             "num_workers": topo.num_workers,
         }
     return status
+
+
+def render_plugin_metrics(manager: "PluginManager") -> str:
+    """The manager's debug snapshot as Prometheus text: kubelet RPC
+    counters (Allocate / ListAndWatch / preferred-allocation), device
+    health rollups, and the impl's degraded-allocation counter."""
+    from tpu_k8s_device_plugin.health.metrics import _escape as esc
+
+    status = manager_status(manager)
+    lines = [
+        "# HELP tpu_plugin_rpc_total Kubelet device-plugin RPCs served.",
+        "# TYPE tpu_plugin_rpc_total counter",
+    ]
+    gauges = []
+    for resource, st in sorted(status["resources"].items()):
+        if "error" in st:
+            continue
+        for rpc, n in sorted(st.get("rpc_counts", {}).items()):
+            lines.append(
+                f'tpu_plugin_rpc_total{{resource="{esc(resource)}",'
+                f'rpc="{esc(rpc)}"}} {n}')
+        gauges += [
+            f'tpu_plugin_devices_healthy{{resource="{esc(resource)}"}} '
+            f'{st.get("healthy", 0)}',
+            f'tpu_plugin_devices_unhealthy{{resource="{esc(resource)}"}} '
+            f'{st.get("unhealthy", 0)}',
+        ]
+    if gauges:
+        lines += [
+            "# HELP tpu_plugin_devices_healthy Devices advertised Healthy.",
+            "# TYPE tpu_plugin_devices_healthy gauge",
+            *[g for g in gauges if "devices_healthy" in g],
+            "# HELP tpu_plugin_devices_unhealthy Devices advertised "
+            "Unhealthy.",
+            "# TYPE tpu_plugin_devices_unhealthy gauge",
+            *[g for g in gauges if "devices_unhealthy" in g],
+        ]
+    for name, value in status.get("impl_counters", {}).items():
+        lines += [
+            f"# TYPE tpu_plugin_{name} counter",
+            f"tpu_plugin_{name} {value}",
+        ]
+    return "\n".join(lines) + "\n"
 
 
 class DebugServer:
@@ -93,6 +144,15 @@ class DebugServer:
                         self._send(500, "text/plain", f"{e}\n")
                 elif self.path == "/debug/threads":
                     self._send(200, "text/plain", thread_dump())
+                elif self.path == "/metrics":
+                    try:
+                        self._send(
+                            200,
+                            "text/plain; version=0.0.4; charset=utf-8",
+                            render_plugin_metrics(manager),
+                        )
+                    except Exception as e:
+                        self._send(500, "text/plain", f"{e}\n")
                 else:
                     self._send(404, "text/plain", "not found\n")
 

@@ -120,6 +120,11 @@ METRICS_EXPORTER_SOCKET = (
     "/var/lib/tpu-metrics-exporter/tpu_device_metrics_exporter_grpc.socket"
 )
 
+# TCP port of the exporter's Prometheus /metrics endpoint (the AMD
+# analog is a metrics exporter first; the health gRPC is one service on
+# it).  0 disables the HTTP listener.
+METRICS_HTTP_PORT = 9400
+
 # ---------------------------------------------------------------------------
 # Kubelet device-plugin API surface (vendored constants in the reference:
 # k8s.io/kubelet/pkg/apis/deviceplugin/v1beta1/constants.go).
