@@ -1,0 +1,29 @@
+# UBI-based node-labeller image (≈ ubi-labeller.Dockerfile in the
+# reference): standalone Red Hat build for OpenShift environments that
+# pull the labeller independently of the device plugin.  The labeller
+# needs sysfs + the tpu-env file only, so the final stage carries no
+# extra privileges or device libraries.
+FROM registry.access.redhat.com/ubi9/python-311 AS builder
+ARG GIT_DESCRIBE=unknown
+USER 0
+RUN dnf install -y gcc-c++ make && dnf clean all
+WORKDIR /src
+COPY pyproject.toml README.md LICENSE ./
+COPY tpu_k8s_device_plugin/ tpu_k8s_device_plugin/
+COPY native/ native/
+RUN make -C native/tpuprobe \
+    && pip install --no-cache-dir --prefix=/install . \
+    && cp tpu_k8s_device_plugin/hostinfo/libtpuprobe.so \
+         /install/lib/python3.11/site-packages/tpu_k8s_device_plugin/hostinfo/ \
+    && echo "${GIT_DESCRIBE}" > /install/git-describe
+
+FROM registry.access.redhat.com/ubi9/python-311
+LABEL \
+    org.opencontainers.image.title="k8s-tpu-node-labeller" \
+    org.opencontainers.image.description="Kubernetes node labeller for Google Cloud TPUs" \
+    org.opencontainers.image.licenses="Apache-2.0"
+RUN mkdir -p /licenses
+COPY LICENSE /licenses/LICENSE
+COPY --from=builder /install /usr/local
+ENV PYTHONPATH=/usr/local/lib/python3.11/site-packages
+ENTRYPOINT ["/usr/local/bin/k8s-tpu-node-labeller"]
