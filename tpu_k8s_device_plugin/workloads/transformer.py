@@ -344,12 +344,25 @@ def _lm_pspec(path, leaf, axes=("data", "expert", "seq", "model")) -> P:
 
 def lm_tree_shardings(mesh: Mesh, tree):
     axes = tuple(mesh.axis_names)
-    return jax.tree_util.tree_map_with_path(
-        lambda path, leaf: NamedSharding(
-            mesh, _lm_pspec(path, leaf, axes)
-        ),
-        tree,
-    )
+
+    def shard(path, leaf):
+        spec = _lm_pspec(path, leaf, axes)
+        # degrade any split the actual dim can't honor to replication
+        # (always numerically valid — XLA re-broadcasts): e.g. an int4
+        # group scale [D/group, F] whose group count is smaller than
+        # the model axis in tiny test configs
+        fixed = []
+        for d, ax in enumerate(spec):
+            if ax is not None:
+                n = 1
+                for a in (ax if isinstance(ax, tuple) else (ax,)):
+                    n *= mesh.shape[a]
+                if leaf.shape[d] % n:
+                    ax = None
+            fixed.append(ax)
+        return NamedSharding(mesh, P(*fixed))
+
+    return jax.tree_util.tree_map_with_path(shard, tree)
 
 
 def make_lm_train_step(
