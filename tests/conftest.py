@@ -24,6 +24,12 @@ os.environ["XLA_FLAGS"] = _flags
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# The AOT-cache loader logs a scary-but-benign machine-feature banner per
+# cache hit (the compile target records XLA tuning pseudo-features like
+# prefer-no-scatter that the host-feature probe doesn't report); silence
+# C++ log spam below FATAL for test runs
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
 # The host image's sitecustomize pins JAX_PLATFORMS to the real-TPU tunnel
 # AFTER our env assignment above; jax.config beats env, so pin it here too,
 # before any test module initializes a backend.
@@ -35,6 +41,28 @@ try:
         jax.config.update("jax_num_cpu_devices", 8)
     except (AttributeError, KeyError):  # pragma: no cover
         pass  # older jax: XLA_FLAGS above still sizes the device pool
+    # persistent compilation cache (VERDICT r3 #7): the suite's floor is
+    # ~30 serial mesh compiles on this box's ONE core, so cache compiled
+    # executables across runs — first run pays full price, repeat runs
+    # (the common case: the driver re-running the suite per round) load
+    # AOT results instead of recompiling.  Identical coverage, no test
+    # shrinkage.  TPU_DP_NO_COMPILE_CACHE=1 opts out (e.g. to measure a
+    # cold run).
+    if not os.environ.get("TPU_DP_NO_COMPILE_CACHE"):
+        _cache_dir = os.environ.get(
+            "TPU_DP_COMPILE_CACHE_DIR",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache"),
+        )
+        try:
+            jax.config.update("jax_compilation_cache_dir", _cache_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.5)
+            # CPU executables only persist with the XLA-caches knob on
+            jax.config.update(
+                "jax_persistent_cache_enable_xla_caches", "all")
+        except (AttributeError, KeyError, ValueError):  # pragma: no cover
+            pass  # older jax: cache unsupported, run cold
 except ImportError:  # pragma: no cover
     pass
 
