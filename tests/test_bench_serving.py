@@ -35,6 +35,16 @@ def test_engine_headroom_validated_up_front():
             prompt_len=8, max_len=64, engine=True)
 
 
+def test_spec_path_runs():
+    stats = run("tiny", quantized=False, batch=2, steps=4,
+                prompt_len=8, max_len=128, spec=2)
+    assert stats["spec_round_ms"] > 0
+    assert stats["plain_step_ms"] > 0
+    assert 0.0 <= stats["breakeven_accept"] <= 1.0
+    assert stats["draft"] == "tiny-draft"
+    assert stats["tokens_per_sec_at_accept_1.0"] > 0
+
+
 def test_int4_path_runs():
     stats = run("tiny", quantized="int4", batch=1, steps=4,
                 prompt_len=8, max_len=64)

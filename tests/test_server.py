@@ -449,3 +449,38 @@ def test_parse_request_defaults():
     assert isinstance(req, _Request)
     assert req.max_new_tokens == eng_default
     assert req.temperature == 0.0 and req.top_p == 1.0
+
+
+def test_speculative_server_matches_plain(setup):
+    """A draft-loaded engine behind the front door serves greedy
+    requests through spec rounds — streams identical to the plain
+    server's, and the engine must actually have speculated."""
+    model, params = setup
+    draft = make_decoder(vocab=128, d_model=32, n_heads=2, n_layers=1,
+                         d_ff=64, max_len=64, dtype=jnp.float32)
+    rng = jax.random.PRNGKey(7)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+    dparams = draft.init(rng, tokens, pos)["params"]
+
+    eng = ServingEngine(model, params, n_slots=2,
+                        draft=(draft, dparams), gamma=3)
+    srv = EngineServer(eng, max_new_tokens=8, window=4)
+    srv.start(host="127.0.0.1", port=0)
+    try:
+        prompt = [5, 17, 3, 70]
+        status, events = _post(
+            srv.port, {"tokens": prompt, "stream": False})
+        assert status == 200
+        assert events[0]["tokens"] == _solo(model, params, prompt, 8)
+        assert eng.stats()["spec_rounds"] >= 1
+
+        # a SAMPLED request flips the scheduler to run_scan (spec is
+        # greedy-only) and still matches its seeded oracle shape
+        status, events = _post(
+            srv.port, {"tokens": prompt, "temperature": 0.9,
+                       "seed": 11, "stream": False})
+        assert status == 200
+        assert len(events[0]["tokens"]) == 8
+    finally:
+        srv.stop()

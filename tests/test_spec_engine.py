@@ -1,0 +1,207 @@
+"""Engine-level speculative decoding: batched propose/verify rounds
+inside the continuous-batching engine (vLLM's speculative_model).
+
+Oracle: greedy spec-decode is bit-exact vs plain greedy decoding, so
+every slot's output must equal the standalone greedy_generate run of
+its own prompt — regardless of draft quality, scheduling, admissions
+interleaving, budgets, stops, or cache exhaustion."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_k8s_device_plugin.workloads.inference import (
+    greedy_generate,
+    make_decoder,
+)
+from tpu_k8s_device_plugin.workloads.serving import ServingEngine
+
+TARGET_CFG = dict(vocab=96, d_model=64, n_heads=4, n_layers=2, d_ff=128)
+DRAFT_CFG = dict(vocab=96, d_model=32, n_heads=2, n_layers=1, d_ff=64)
+DT = jnp.float32
+MAX_LEN = 64
+
+
+def _init(model, seed):
+    rng = jax.random.PRNGKey(seed)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+    return model.init(rng, tokens, pos)["params"]
+
+
+@pytest.fixture(scope="module")
+def models():
+    target = make_decoder(**TARGET_CFG, max_len=MAX_LEN, dtype=DT)
+    draft = make_decoder(**DRAFT_CFG, max_len=MAX_LEN, dtype=DT)
+    return (target, _init(target, 0)), (draft, _init(draft, 1))
+
+
+def _oracle(target, params, prompt, n):
+    out, _ = greedy_generate(
+        target, params, jnp.asarray(prompt, jnp.int32)[None, :], n)
+    return np.asarray(out)[0].tolist()
+
+
+def test_spec_rounds_match_plain_greedy(models):
+    (target, tp), (draft, dp) = models
+    eng = ServingEngine(target, tp, n_slots=2, max_new_tokens=9,
+                        draft=(draft, dp), gamma=3)
+    pa, pb = [5, 17, 3, 70], [11, 2, 9]
+    sa, sb = eng.admit(pa), eng.admit(pb)
+    eng.run_spec(12)
+    assert eng.output(sa) == _oracle(target, tp, pa, 9)
+    assert eng.output(sb) == _oracle(target, tp, pb, 9)
+    assert eng.finished(sa) and eng.finished(sb)
+    st = eng.stats()
+    assert st["spec_proposed"] >= st["spec_accepted"] >= 0
+    # fewer device rounds than tokens is the whole point
+    assert 1 <= st["spec_rounds"] < 9
+
+
+def test_draft_equals_target_accepts_everything(models):
+    (target, tp), _ = models
+    eng = ServingEngine(target, tp, n_slots=1, max_new_tokens=8,
+                        draft=(target, tp), gamma=3)
+    s = eng.admit([5, 17, 3, 70])
+    eng.run_spec(8)
+    assert eng.output(s) == _oracle(target, tp, [5, 17, 3, 70], 8)
+    assert eng.accept_rate == 1.0
+    # admit emits 1, each full round commits gamma+1 = 4: 2 rounds
+    assert eng.stats()["spec_rounds"] == 2
+
+
+def test_garbage_draft_still_exact(models):
+    (target, tp), (draft, _) = models
+    garbage = _init(draft, 999)
+    eng = ServingEngine(target, tp, n_slots=1, max_new_tokens=7,
+                        draft=(draft, garbage), gamma=4)
+    s = eng.admit([3, 14, 15, 92])
+    eng.run_spec(10)
+    assert eng.output(s) == _oracle(target, tp, [3, 14, 15, 92], 7)
+
+
+def test_stop_token_mid_round(models):
+    """A stop token landing inside a round's committed block must
+    retire the slot there and discard the rest of the block."""
+    (target, tp), (draft, dp) = models
+    want = _oracle(target, tp, [5, 17, 3, 70], 8)
+    stop = want[4]
+    plain = ServingEngine(target, tp, n_slots=1, max_new_tokens=8)
+    sp = plain.admit([5, 17, 3, 70], stop=[stop])
+    plain.run(10)
+    eng = ServingEngine(target, tp, n_slots=1, max_new_tokens=8,
+                        draft=(draft, dp), gamma=4)
+    s = eng.admit([5, 17, 3, 70], stop=[stop])
+    eng.run_spec(10)
+    assert eng.output(s) == plain.output(sp)
+    assert eng.finish_reason(s) == plain.finish_reason(sp) == "stop"
+
+
+def test_cache_exhaustion_matches_plain(models):
+    (target, tp), (draft, dp) = models
+    prompt = [5, 17, 3, 70]
+    small_t = make_decoder(**TARGET_CFG, max_len=16, dtype=DT)
+    small_d = make_decoder(**DRAFT_CFG, max_len=16, dtype=DT)
+    plain = ServingEngine(small_t, tp, n_slots=1)
+    sp = plain.admit(prompt)
+    plain.run(20)
+    eng = ServingEngine(small_t, tp, n_slots=1,
+                        draft=(small_d, dp), gamma=3)
+    s = eng.admit(prompt)
+    eng.run_spec(20)
+    assert eng.output(s) == plain.output(sp)
+    assert eng.finish_reason(s) == plain.finish_reason(sp) == "length"
+
+
+def test_admission_between_rounds(models):
+    """Continuous batching: a prompt admitted mid-stream joins the
+    next round; both slots stay exact."""
+    (target, tp), (draft, dp) = models
+    pa, pb = [5, 17, 3, 70], [11, 2, 9, 44, 8]
+    eng = ServingEngine(target, tp, n_slots=2, max_new_tokens=7,
+                        draft=(draft, dp), gamma=3)
+    sa = eng.admit(pa)
+    eng.spec_round()
+    sb = eng.admit(pb)
+    eng.run_spec(10)
+    assert eng.output(sa) == _oracle(target, tp, pa, 7)
+    assert eng.output(sb) == _oracle(target, tp, pb, 7)
+
+
+def test_spec_with_auto_prefix(models):
+    """APC reuses the TARGET's prompt K/V; the draft prefills cold —
+    outputs still exact for both the donor and the borrower."""
+    (target, tp), (draft, dp) = models
+    shared = [7, 3, 9, 12, 5, 8, 1, 2]
+    pa, pb = shared + [5, 9], shared + [44]
+    eng = ServingEngine(target, tp, n_slots=2, max_new_tokens=6,
+                        chunk=4, auto_prefix_min=4,
+                        draft=(draft, dp), gamma=3)
+    sa = eng.admit(pa)
+    sb = eng.admit(pb)
+    assert eng.stats()["prefix_cache_hits"] == 1
+    eng.run_spec(10)
+    assert eng.output(sa) == _oracle(target, tp, pa, 6)
+    assert eng.output(sb) == _oracle(target, tp, pb, 6)
+
+
+def test_released_donor_survives_spec_rounds(models):
+    """Spec rounds on OTHER slots must not touch a released slot's
+    prompt K/V: the rollback may only set lens for dispatched slots
+    (a released slot's host mirror is 0 — pushing it to the device
+    would park the clamped verify writes ON TOP of the donor rows)."""
+    (target, tp), (draft, dp) = models
+    shared = [7, 3, 9, 12, 5, 8, 1, 2]
+    pa = shared + [5, 9]
+    eng = ServingEngine(target, tp, n_slots=2,
+                        chunk=4, auto_prefix_min=4,
+                        draft=(draft, dp), gamma=3)
+    # request A retires on a stop token and releases; its donor stays.
+    # B admits FIRST into the other slot so A's parked slot (and donor
+    # record) survive until C arrives
+    stop_a = _oracle(target, tp, pa, 8)[2]
+    eng.admit([44, 61, 20])
+    sa = eng.admit(pa, stop=[stop_a])
+    eng.run_spec(8)
+    assert eng.finished(sa) and eng.finish_reason(sa) == "stop"
+    eng.release(sa)
+    # long-running request B keeps spec rounds (and their clamped
+    # writes) going while A's slot is parked
+    for _ in range(3):
+        eng.spec_round()
+    # request C shares A's prefix: APC must reuse A's rows and still
+    # be bit-exact vs the cold oracle
+    before = eng.stats()["prefix_cache_hits"]
+    sc = eng.admit(shared + [44])
+    assert eng.stats()["prefix_cache_hits"] == before + 1
+    for _ in range(3):
+        eng.spec_round()
+    got = eng.output(sc)
+    assert len(got) >= 4
+    assert got == _oracle(target, tp, shared + [44], len(got))
+
+
+def test_greedy_only_guard(models):
+    (target, tp), (draft, dp) = models
+    eng = ServingEngine(target, tp, n_slots=1, draft=(draft, dp))
+    eng.admit([5, 17, 3], temperature=0.8)
+    with pytest.raises(ValueError, match="greedy-only"):
+        eng.spec_round()
+
+
+def test_requires_draft(models):
+    (target, tp), _ = models
+    eng = ServingEngine(target, tp, n_slots=1)
+    eng.admit([5, 17, 3])
+    with pytest.raises(RuntimeError, match="draft"):
+        eng.spec_round()
+
+
+def test_draft_validation(models):
+    (target, tp), (draft, dp) = models
+    short = make_decoder(**DRAFT_CFG, max_len=MAX_LEN // 2, dtype=DT)
+    with pytest.raises(ValueError, match="max_len"):
+        ServingEngine(target, tp, n_slots=1, draft=(short, dp))
+    with pytest.raises(ValueError, match="gamma"):
+        ServingEngine(target, tp, n_slots=1, draft=(draft, dp), gamma=0)

@@ -51,6 +51,12 @@ PHASES = [
     ("serving_int4_b1", 1200),
     ("serving_int8_b32", 1200),
     ("int4_bytes", 900),
+    # round-4 additions: speculative-round economics on the 8B int8
+    # target with the Llama-3.2-1B-shaped draft (random weights, so
+    # the OUTPUT is round latency + the implied tok/s curve over
+    # accept rate + break-even accept — see bench_serving._spec_throughput)
+    ("serving_spec_g4_b1", 1500),
+    ("serving_spec_g8_b1", 1500),
 ]
 
 
@@ -219,6 +225,21 @@ def phase_serving_int8_b32():
 
 def phase_serving_int4_b1():
     return _serving("int4", 1, 128, 512)
+
+
+def phase_serving_spec_g4_b1():
+    from tpu_k8s_device_plugin.workloads.bench_serving import run
+
+    # budget: 2*64 + 4*(4+1) = 148 decode rows + 128 prompt <= 512
+    return run("llama3-8b", True, 1, 64,
+               prompt_len=128, max_len=512, spec=4)
+
+
+def phase_serving_spec_g8_b1():
+    from tpu_k8s_device_plugin.workloads.bench_serving import run
+
+    return run("llama3-8b", True, 1, 64,
+               prompt_len=128, max_len=512, spec=8)
 
 
 def phase_int4_bytes():

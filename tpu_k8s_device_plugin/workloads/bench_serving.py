@@ -26,8 +26,16 @@ from .inference import decode_throughput, quantize_lm_params
 
 CONFIGS = {
     "llama3-8b": llama.LLAMA3_8B,
+    "llama3-1b": llama.LLAMA32_1B,
     "llama2-7b": llama.LLAMA2_7B,
     "tiny": llama.TINY_LLAMA,
+    "tiny-draft": llama.TINY_DRAFT,
+}
+
+# the standard draft pairing for --spec (same vocab/tokenizer family)
+DRAFT_FOR = {
+    "llama3-8b": "llama3-1b",
+    "tiny": "tiny-draft",
 }
 
 
@@ -67,21 +75,39 @@ def build_model_and_params(config: str, max_len: int, quantized,
 
 
 def run(config: str, quantized, batch: int, steps: int,
-        prompt_len: int, max_len: int, engine: bool = False):
+        prompt_len: int, max_len: int, engine: bool = False,
+        spec: int = 0):
     # fail fast for library callers too, not just the CLI: engine mode
     # consumes (warmup + rounds) run_scan windows of cache headroom,
     # and a mid-benchmark ValueError from run_scan is a worse place to
     # learn that than here
-    scans = (_ENGINE_WARMUP + _ENGINE_ROUNDS) if engine else 1
-    if prompt_len + steps * scans > max_len:
+    if spec:
+        # 2 run_scan windows (plain-step reference) + warm + timed
+        # spec rounds, each committing at most gamma+1; an exhausted
+        # slot would turn timed rounds into no-ops
+        budget = 2 * steps + (1 + _ENGINE_ROUNDS) * (spec + 1)
+    else:
+        scans = (_ENGINE_WARMUP + _ENGINE_ROUNDS) if engine else 1
+        budget = steps * scans
+    if prompt_len + budget > max_len:
         raise ValueError(
-            f"prompt_len {prompt_len} + {scans} decode windows of "
-            f"{steps} steps exceed max_len {max_len}")
+            f"prompt_len {prompt_len} + decode budget {budget} "
+            f"exceed max_len {max_len}")
     cfg, model, params = build_model_and_params(
         config, max_len, quantized)
     prompt = jax.random.randint(
         jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab)
-    if engine:
+    if spec:
+        draft_name = DRAFT_FOR.get(config)
+        if draft_name is None:
+            raise ValueError(
+                f"no draft pairing for {config} (DRAFT_FOR)")
+        _, dmodel, dparams = build_model_and_params(
+            draft_name, max_len, quantized)
+        stats = _spec_throughput(
+            model, params, dmodel, dparams, prompt, spec, steps)
+        stats["draft"] = draft_name
+    elif engine:
         stats = _engine_throughput(model, params, prompt, steps)
     else:
         stats = decode_throughput(model, params, prompt, steps)
@@ -131,6 +157,72 @@ def _engine_throughput(model, params, prompt, steps,
     }
 
 
+def _spec_throughput(model, params, draft_model, draft_params, prompt,
+                     gamma, steps, rounds: int = _ENGINE_ROUNDS):
+    """Speculative-round economics through the engine.  Random weights
+    make the MEASURED accept rate meaningless (~1/vocab), but round
+    latency is shape-static — so this reports the measured per-round
+    and per-step costs plus the exact implied throughput curve over
+    accept rate, and the break-even accept probability:
+
+        E[commit | p] = 1 + sum_{k=1..gamma} p^k
+        tokens/sec(p) = batch * E[commit | p] / t_round
+        break-even:     E[commit | p*] = t_round / t_step
+    """
+    import time
+
+    import numpy as np
+
+    from .serving import ServingEngine
+
+    batch, _ = prompt.shape
+    eng = ServingEngine(model, params, n_slots=batch,
+                        draft=(draft_model, draft_params), gamma=gamma)
+    prompt_host = np.asarray(prompt)
+    for b in range(batch):
+        eng.admit(prompt_host[b].tolist())
+
+    eng.run_scan(steps)  # warm the plain path
+    t0 = time.perf_counter()
+    eng.run_scan(steps)
+    t_step = (time.perf_counter() - t0) / steps
+
+    eng.spec_round()  # warm propose/verify
+    best = None
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        eng.spec_round()
+        dt = time.perf_counter() - t0
+        best = dt if best is None or dt < best else best
+
+    def commit(p):
+        return 1.0 + sum(p ** k for k in range(1, gamma + 1))
+
+    # break-even accept prob: bisect E[commit | p] = t_round / t_step
+    ratio = best / t_step
+    if ratio <= 1.0:
+        breakeven = 0.0
+    elif ratio >= commit(1.0):
+        breakeven = 1.0
+    else:
+        lo, hi = 0.0, 1.0
+        for _ in range(40):
+            mid = (lo + hi) / 2
+            lo, hi = (mid, hi) if commit(mid) < ratio else (lo, mid)
+        breakeven = (lo + hi) / 2
+    out = {
+        "spec_round_ms": best * 1e3,
+        "plain_step_ms": t_step * 1e3,
+        "gamma": float(gamma),
+        "batch": float(batch),
+        "breakeven_accept": breakeven,
+        "measured_accept": eng.accept_rate,  # ~0 on random weights
+    }
+    for p in (0.5, 0.8, 1.0):
+        out[f"tokens_per_sec_at_accept_{p}"] = batch * commit(p) / best
+    return out
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tpu-serving-bench")
     p.add_argument("--config", choices=sorted(CONFIGS), default="tiny")
@@ -145,18 +237,23 @@ def main(argv=None) -> int:
     p.add_argument("--engine", action="store_true",
                    help="measure through the continuous-batching "
                         "engine (run_scan) instead of the uniform loop")
+    p.add_argument("--spec", type=int, default=0, metavar="GAMMA",
+                   help="speculative-round economics at this gamma "
+                        "(paired draft per DRAFT_FOR; reports round "
+                        "latency + implied tok/s over accept rate)")
     args = p.parse_args(argv)
-    scans = (_ENGINE_WARMUP + _ENGINE_ROUNDS) if args.engine else 1
-    if args.prompt_len + args.steps * scans > args.max_len:
-        p.error("--prompt-len + decode budget must fit in --max-len")
 
     devs = jax.devices()
     print(f"devices: {len(devs)} x {devs[0].platform}", flush=True)
     if args.int4 and args.quantized:
         p.error("--quantized and --int4 are mutually exclusive")
     quantized = "int4" if args.int4 else args.quantized
-    stats = run(args.config, quantized, args.batch, args.steps,
-                args.prompt_len, args.max_len, engine=args.engine)
+    try:
+        stats = run(args.config, quantized, args.batch, args.steps,
+                    args.prompt_len, args.max_len, engine=args.engine,
+                    spec=args.spec)
+    except ValueError as e:
+        p.error(str(e))
     for k, v in stats.items():
         print(f"{k}: {v}")
     return 0

@@ -84,11 +84,24 @@ LLAMA2_7B = LlamaConfig(
     n_layers=32, d_ff=11008, rope_theta=10000.0, max_len=4096,
 )
 
+# Llama-3.2-1B-shaped: the standard speculative DRAFT for the 8B
+# target (same 128k vocab + tokenizer family, ~8x fewer FLOPs/token)
+LLAMA32_1B = LlamaConfig(
+    vocab=128256, d_model=2048, n_heads=32, n_kv_heads=8,
+    n_layers=16, d_ff=8192,
+)
+
 # scaled-down config with the full Llama shape grammar (GQA 4:1, SwiGLU,
 # big theta) for tests and CPU meshes
 TINY_LLAMA = LlamaConfig(
     vocab=256, d_model=128, n_heads=8, n_kv_heads=2,
     n_layers=2, d_ff=352, max_len=128,
+)
+
+# 1-layer draft for TINY_LLAMA (CPU-mesh spec-decode benchmarks)
+TINY_DRAFT = LlamaConfig(
+    vocab=256, d_model=64, n_heads=4, n_kv_heads=2,
+    n_layers=1, d_ff=128, max_len=128,
 )
 
 

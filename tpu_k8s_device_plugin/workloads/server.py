@@ -308,15 +308,24 @@ class EngineServer:
                     del self._running[slot]
             if not self._running:
                 continue
-            headroom = min(
-                eng.model.max_len - eng.lens[s] for s in self._running
-            )
-            window = min(self.window, headroom)
-            if window < 1:
-                # a slot ran out of cache: one step() retires it
-                eng.step()
+            if eng.spec_ready():
+                # greedy-only traffic on a draft-loaded engine: one
+                # speculative round commits up to gamma+1 tokens per
+                # slot for one host round-trip (spec_round handles the
+                # cache endgame itself); a sampled/logprobs admission
+                # flips the loop back to run_scan until it drains
+                eng.spec_round()
             else:
-                eng.run_scan(window)
+                headroom = min(
+                    eng.model.max_len - eng.lens[s]
+                    for s in self._running
+                )
+                window = min(self.window, headroom)
+                if window < 1:
+                    # a slot ran out of cache: one step() retires it
+                    eng.step()
+                else:
+                    eng.run_scan(window)
             for slot, (req, idx) in list(self._running.items()):
                 self._emit(slot, req, idx, eng.output(slot))
         # the scheduler owns _running/_head: it performs the shutdown
@@ -580,6 +589,12 @@ def main(argv=None) -> int:
     p.add_argument("--logprobs-k", type=int, default=5,
                    help="engine-wide top-k logprobs cap (requests ask "
                         "for n <= k; 0 disables the stats entirely)")
+    p.add_argument("--draft-config", choices=sorted(CONFIGS), default=None,
+                   help="speculative draft model (e.g. llama3-1b for "
+                        "llama3-8b); greedy requests decode in "
+                        "propose/verify rounds")
+    p.add_argument("--gamma", type=int, default=4,
+                   help="draft proposals per speculative round")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8000)
     args = p.parse_args(argv)
@@ -610,9 +625,18 @@ def main(argv=None) -> int:
                             expert=1)
     cfg, model, params = build_model_and_params(
         args.config, args.max_len, quantized, mesh=mesh)
+    draft = None
+    if args.draft_config:
+        # speculative serving (vLLM's --speculative-model): the draft
+        # shares the target's vocab family; greedy requests decode in
+        # spec rounds, sampled ones flip the scheduler to run_scan
+        _, dmodel, dparams = build_model_and_params(
+            args.draft_config, args.max_len, quantized, mesh=mesh)
+        draft = (dmodel, dparams)
     engine = ServingEngine(model, params, n_slots=args.n_slots,
                            eos_id=getattr(cfg, "eos_id", None),
-                           mesh=mesh, logprobs_k=args.logprobs_k)
+                           mesh=mesh, logprobs_k=args.logprobs_k,
+                           draft=draft, gamma=args.gamma)
     srv = EngineServer(engine, max_new_tokens=args.max_new_tokens,
                        window=args.window)
     srv.start(host=args.host, port=args.port)

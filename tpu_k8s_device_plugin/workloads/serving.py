@@ -94,6 +94,26 @@ def _splice_slot(cache, mini, slot):
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
+def _rollback_active(cache, lens, active):
+    """Set cache_lens to the [S] vector *lens* where *active*, keeping
+    the device value elsewhere — the batched rollback a speculative
+    round ends with (rejected proposal rows become dead rows the next
+    append overwrites).  Inactive slots MUST keep their own device
+    lens: a released slot's host mirror is 0 while its device lens
+    stays high, and lowering it would park subsequent clamped writes
+    on top of the slot's prompt K/V — the APC donor rows release()
+    promises stay valid."""
+    lens = jnp.asarray(lens, jnp.int32)
+    active = jnp.asarray(active)
+    out = {}
+    for layer, buf in cache.items():
+        out[layer] = dict(buf)
+        out[layer]["cache_lens"] = jnp.where(
+            active, lens, buf["cache_lens"])
+    return out
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
 def _set_len(cache, slot, value):
     out = {}
     for layer, buf in cache.items():
@@ -347,6 +367,8 @@ class ServingEngine:
         auto_prefix: bool = True,
         auto_prefix_min: int = 8,
         logprobs_k: int = 0,
+        draft: Optional[tuple] = None,
+        gamma: int = 4,
     ):
         if n_slots < 1:
             raise ValueError("n_slots must be >= 1")
@@ -470,6 +492,45 @@ class ServingEngine:
         # per-slot LoRA adapter ids (-1 = base model); only consulted
         # when the model was built with n_adapters > 0
         self.adapters = np.full(n_slots, -1, np.int32)
+        # engine-level speculative decoding (vLLM's speculative_model):
+        # a small greedy draft proposes gamma tokens per round for EVERY
+        # active slot (one batched lax.scan), the target verifies all of
+        # them in ONE [S, gamma+1] extend — k in [1, gamma+1] tokens
+        # commit per slot per round, with ONE host round-trip where
+        # step() pays one per token.  Greedy-only (see spec_round).
+        self._draft_model = self._draft_params = None
+        self._draft_cache = None
+        self.gamma = gamma
+        self._spec_rounds = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        if draft is not None:
+            draft_model, draft_params = draft
+            if gamma < 1:
+                raise ValueError("gamma must be >= 1")
+            if draft_model.vocab != model.vocab:
+                raise ValueError(
+                    f"draft vocab {draft_model.vocab} != target vocab "
+                    f"{model.vocab}")
+            if draft_model.max_len < model.max_len:
+                raise ValueError(
+                    f"draft max_len {draft_model.max_len} < target "
+                    f"max_len {model.max_len} (the draft cache must "
+                    "cover every committable position)")
+            if mesh is not None:
+                from .transformer import lm_tree_shardings as _lts
+
+                n_kv_d = draft_model.n_kv_heads or draft_model.n_heads
+                if n_kv_d % mesh.shape.get("model", 1):
+                    raise ValueError(
+                        f"draft n_kv_heads={n_kv_d} must divide the "
+                        f"mesh's model axis")
+                draft_params = jax.device_put(
+                    draft_params, _lts(mesh, draft_params))
+            self._draft_model = draft_model
+            self._draft_params = draft_params
+            self._draft_cache = self._place_cache(
+                init_cache(draft_model, n_slots))
 
     def _place_cache(self, cache):
         """Apply the TP shardings to a cache pytree (no-op meshless)."""
@@ -548,6 +609,29 @@ class ServingEngine:
             if 0 <= off < c:
                 last = logits[0, off]
         return _set_len(mini, jnp.int32(0), jnp.int32(start + n)), last
+
+    def _draft_prefill(self, prompt):
+        """Cold-prefill the draft with the FULL prompt on the engine's
+        chunk grid (no prefix reuse — the target's K/V cannot seed a
+        different model's cache).  Returns a B=1 draft mini holding
+        t_p rows."""
+        n = int(prompt.shape[1])
+        mini = self._place_cache(init_cache(self._draft_model, 1))
+        c = self.chunk
+        if c is None:
+            pos = jnp.arange(n, dtype=jnp.int32)[None, :]
+            _, mini = extend_step(
+                self._draft_model, self._draft_params, mini, prompt, pos)
+            return mini
+        padded = ((n + c - 1) // c) * c
+        toks = jnp.concatenate(
+            [prompt, jnp.zeros((1, padded - n), jnp.int32)], axis=1)
+        for i in range(padded // c):
+            pos = (jnp.arange(c, dtype=jnp.int32) + i * c)[None, :]
+            _, mini = extend_step(
+                self._draft_model, self._draft_params, mini,
+                toks[:, i * c:(i + 1) * c], pos)
+        return _set_len(mini, jnp.int32(0), jnp.int32(n))
 
     def _adapter_vec(self, adapter: int):
         """[1]-shaped adapter-id operand, or None for non-LoRA models
@@ -817,6 +901,10 @@ class ServingEngine:
                 self._prompt_lp[slot] = recs
 
         self.cache = _splice_slot(self.cache, mini, jnp.int32(slot))
+        if self._draft_model is not None:
+            self._draft_cache = _splice_slot(
+                self._draft_cache, self._draft_prefill(prompt),
+                jnp.int32(slot))
         # explicit-prefix admits with an unaligned prefix leave the
         # suffix rows off the chunk grid — only the prefix part is
         # reusable by future automatic matches
@@ -1018,6 +1106,162 @@ class ServingEngine:
                 return
             self.step()
 
+    # -- speculative decoding ----------------------------------------------
+
+    def spec_round(self) -> Dict[int, List[int]]:
+        """One speculative round for every active slot: the draft
+        proposes ``gamma`` tokens (one batched ``lax.scan``), the target
+        verifies them in ONE ``[S, gamma+1]`` extend, and each slot
+        commits its accepted prefix plus the target's own next token —
+         1..gamma+1 tokens per slot for one host round-trip, tokens
+        bit-identical to :meth:`step` greedy decoding.
+
+        Greedy-only, like the first-mismatch acceptance rule it uses:
+        raises if any active slot armed sampling knobs or logprobs
+        (vLLM's speculative path has the same posture — rejection
+        sampling is a different verifier).  Returns {slot: [tokens]}.
+        """
+        if self._draft_model is None:
+            raise RuntimeError(
+                "engine was built without a draft model "
+                "(ServingEngine(..., draft=(model, params)))")
+        if _knobs_live(self.temps, self.topks, self.topps, self.minps,
+                       self.pres, self.freqs, self.reps):
+            raise ValueError(
+                "speculative decoding is greedy-only: a slot armed "
+                "sampling/penalty knobs")
+        if self.logprobs_k and any(
+                self._lp_want[s] for s in range(self.n_slots)
+                if self.active[s]):
+            raise ValueError(
+                "speculative decoding does not produce per-token "
+                "logprobs (the accepted tokens skip their own decode "
+                "step)")
+        if not any(self.active):
+            return {}
+        for s in range(self.n_slots):
+            if self.active[s] and self.lens[s] >= self.model.max_len:
+                self._finish(s)
+        if not any(self.active):
+            return {}
+        from .speculative import _draft_propose
+
+        g = self.gamma
+        headroom = min(self.model.max_len - self.lens[s]
+                       for s in range(self.n_slots) if self.active[s])
+        if headroom < g + 1:
+            # a slot is too close to the cache end for the full verify
+            # band: position max_len lands a CLAMPED write on row
+            # max_len-1, overwriting that slot's valid tail K/V
+            # mid-extend — decode the endgame with plain steps instead
+            # (bit-identical to what the plain engine does there).
+            # Draft caches go stale for tokens emitted this way; that
+            # only costs accept rate on later rounds (the target verify
+            # is ground truth), never token correctness.
+            return {s: [t] for s, t in self.step().items()}
+        first = jnp.asarray(self.last_token)          # [S]
+        pos0 = jnp.asarray(self.lens, jnp.int32)      # [S]
+        props, self._draft_cache = _draft_propose(
+            self._draft_model, self._draft_params, g,
+            self._draft_cache, first, pos0)           # props [S, g]
+        verify = jnp.concatenate([first[:, None], props], axis=1)
+        positions = pos0[:, None] + jnp.arange(
+            g + 1, dtype=jnp.int32)[None, :]
+        aids = (jnp.asarray(self.adapters)
+                if self.model.n_adapters > 0 else None)
+        logits, self.cache = extend_step(
+            self.model, self.params, self.cache, verify, positions,
+            aids)
+        tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, g+1]
+        # ONE batched transfer (per-array np.asarray would serialize
+        # two blocking round-trips on the hot path this feature exists
+        # to shorten)
+        props_h, tgt_h = jax.device_get((props, tgt))
+        self._steps += 1
+        self._spec_rounds += 1
+
+        out: Dict[int, List[int]] = {}
+        new_lens = np.zeros(self.n_slots, np.int32)
+        dispatched = np.asarray(self.active, bool)  # active at verify
+        for s in range(self.n_slots):
+            if not dispatched[s]:
+                # host mirror only (step() does the same +1): the
+                # DEVICE lens of a parked slot is deliberately left
+                # alone by the rollback below — it sits high so writes
+                # clamp past the slot's prompt K/V (the APC donor rows)
+                self.lens[s] += g + 1
+                continue
+            acc = 0
+            while acc < g and props_h[s, acc] == tgt_h[s, acc]:
+                acc += 1
+            self._spec_proposed += g
+            self._spec_accepted += acc
+            # committed = accepted proposals + the target's own token
+            # (correction at the first mismatch / bonus on full
+            # acceptance) == tgt_h[s, :acc+1]; cap at the cache end —
+            # token j was computed at position lens+j, valid only
+            # below max_len
+            k = min(acc + 1, self.model.max_len - self.lens[s])
+            toks = []
+            for j in range(k):
+                tok = int(tgt_h[s, j])
+                self.last_token[s] = tok
+                self.outputs[s].append(tok)
+                self._tokens += 1
+                toks.append(tok)
+                self._maybe_finish(s, tok)
+                if not self.active[s]:
+                    # eos / stop / budget: later verify tokens are
+                    # discarded, the cache rolls back to the real end
+                    k = j + 1
+                    break
+            self.lens[s] += k
+            new_lens[s] = self.lens[s]
+            if self.active[s] and self.lens[s] >= self.model.max_len:
+                self._finish(s)
+            out[s] = toks
+        # both caches roll to the SAME committed length: the target
+        # keeps its accepted verify rows, the draft holds
+        # [first, props[:-1]] plus the extra append (_draft_propose's
+        # final extend), so rows < lens are valid in both.  Slots that
+        # finished DURING the commit loop still get their exact lens
+        # (dispatched mask, not self.active)
+        self.cache = _rollback_active(self.cache, new_lens, dispatched)
+        self._draft_cache = _rollback_active(
+            self._draft_cache, new_lens, dispatched)
+        return out
+
+    def run_spec(self, max_rounds: int) -> None:
+        """Speculative rounds until every slot retires (the spec-decode
+        analog of :meth:`run`)."""
+        for _ in range(max_rounds):
+            if not any(self.active):
+                return
+            self.spec_round()
+
+    @property
+    def accept_rate(self) -> float:
+        """Fraction of draft proposals the target kept (draft-quality
+        metric, not a correctness knob)."""
+        return (self._spec_accepted / self._spec_proposed
+                if self._spec_proposed else 0.0)
+
+    def spec_ready(self) -> bool:
+        """Would :meth:`spec_round` run right now?  True iff a draft is
+        loaded and no active slot armed sampling knobs or logprobs —
+        the schedulers' predicate for adaptively switching between
+        spec rounds (greedy traffic) and run_scan (mixed traffic)."""
+        if self._draft_model is None:
+            return False
+        if _knobs_live(self.temps, self.topks, self.topps, self.minps,
+                       self.pres, self.freqs, self.reps):
+            return False
+        if self.logprobs_k and any(
+                self._lp_want[s] for s in range(self.n_slots)
+                if self.active[s]):
+            return False
+        return True
+
     def run_scan(self, n_steps: int) -> Dict[int, List[int]]:
         """*n_steps* decode steps as ONE compiled ``lax.scan`` — no
         per-token host round-trip (the difference is decisive over
@@ -1153,6 +1397,9 @@ class ServingEngine:
             "prefill_tokens": self._prefill_tokens,
             "prefix_cache_hits": self._prefix_hits,
             "prefix_reused_tokens": self._prefix_reused_tokens,
+            "spec_rounds": self._spec_rounds,
+            "spec_proposed": self._spec_proposed,
+            "spec_accepted": self._spec_accepted,
         }
 
     def release(self, slot: int) -> None:
