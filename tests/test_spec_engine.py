@@ -205,3 +205,73 @@ def test_draft_validation(models):
         ServingEngine(target, tp, n_slots=1, draft=(short, dp))
     with pytest.raises(ValueError, match="gamma"):
         ServingEngine(target, tp, n_slots=1, draft=(draft, dp), gamma=0)
+
+# -- n-gram (prompt-lookup) mode ---------------------------------------------
+
+def test_ngram_propose_unit():
+    from tpu_k8s_device_plugin.workloads.serving import _ngram_propose
+    import numpy as np
+    # ...a b c X ... a b c -> proposes X and what followed
+    seq = np.asarray([9, 1, 2, 3, 7, 8, 4, 1, 2, 3], np.int32)
+    got = _ngram_propose(seq, 3, 3).tolist()
+    assert got == [7, 8, 4]
+    # LATEST earlier occurrence wins
+    seq = np.asarray([1, 2, 5, 0, 1, 2, 6, 0, 1, 2], np.int32)
+    assert _ngram_propose(seq, 2, 1).tolist() == [6]
+    # continuation shorter than gamma pads with the last token
+    seq = np.asarray([1, 2, 7, 1, 2], np.int32)
+    assert _ngram_propose(seq, 2, 3).tolist() == [7, 1, 2]
+    # no match: repeat last token
+    seq = np.asarray([1, 2, 3, 4], np.int32)
+    assert _ngram_propose(seq, 2, 2).tolist() == [4, 4]
+    # degenerate history
+    seq = np.asarray([5], np.int32)
+    assert _ngram_propose(seq, 3, 2).tolist() == [5, 5]
+
+
+def test_ngram_spec_matches_plain_greedy(models):
+    """Draft-free prompt-lookup speculation: same verify machinery,
+    proposals from the request's own history — exact regardless of
+    hit rate."""
+    (target, tp), _ = models
+    eng = ServingEngine(target, tp, n_slots=2, max_new_tokens=9,
+                        draft="ngram", gamma=3, ngram_n=2)
+    pa = [5, 17, 3, 5, 17, 3, 5, 17]  # repetitive: lookups will hit
+    pb = [11, 2, 9]
+    sa, sb = eng.admit(pa), eng.admit(pb)
+    eng.run_spec(12)
+    assert eng.output(sa) == _oracle(target, tp, pa, 9)
+    assert eng.output(sb) == _oracle(target, tp, pb, 9)
+    assert eng.stats()["spec_rounds"] >= 1
+
+
+def test_ngram_spec_server(models):
+    from tpu_k8s_device_plugin.workloads.server import EngineServer
+    import http.client, json as _json
+    (target, tp), _ = models
+    eng = ServingEngine(target, tp, n_slots=2, draft="ngram", gamma=3)
+    srv = EngineServer(eng, max_new_tokens=6, window=4)
+    srv.start(host="127.0.0.1", port=0)
+    try:
+        c = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=120)
+        c.request("POST", "/generate", _json.dumps(
+            {"tokens": [5, 17, 3, 70], "stream": False}),
+            {"Content-Type": "application/json"})
+        r = c.getresponse()
+        ev = _json.loads(r.read().decode().strip().splitlines()[0])
+        assert ev["tokens"] == _oracle(target, tp, [5, 17, 3, 70], 6)
+        assert eng.stats()["spec_rounds"] >= 1
+        # /metrics renders the same counters for a scrape
+        c2 = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=30)
+        c2.request("GET", "/metrics")
+        body = c2.getresponse().read().decode()
+        assert "tpu_serving_spec_rounds" in body
+        assert "tpu_serving_tokens_emitted" in body
+    finally:
+        srv.stop()
+
+
+def test_ngram_validation(models):
+    (target, tp), _ = models
+    with pytest.raises(ValueError, match="ngram_n"):
+        ServingEngine(target, tp, n_slots=1, draft="ngram", ngram_n=0)

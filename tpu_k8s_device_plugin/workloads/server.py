@@ -352,6 +352,13 @@ class EngineServer:
     def start(self, host: str = "0.0.0.0", port: int = 8000
               ) -> "EngineServer":
         server = self
+        # stats keys that describe CURRENT state (everything else in
+        # stats() is monotonic and scrapes as a counter)
+        _GAUGE_STATS = frozenset({
+            "n_slots", "active_slots", "free_slots",
+            "registered_prefixes", "pending_requests",
+            "running_requests", "running_copies", "window",
+        })
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -362,6 +369,23 @@ class EngineServer:
                 elif self.path == "/stats":
                     body = json.dumps(server.stats(), indent=2)
                     self._send(200, "application/json", body + "\n")
+                elif self.path == "/metrics":
+                    # Prometheus exposition of the same counters
+                    # (vLLM's server exposes /metrics; scrape configs
+                    # expect it from a serving pod)
+                    lines = []
+                    for k, v in sorted(server.stats().items()):
+                        if (not isinstance(v, (int, float))
+                                or isinstance(v, bool)):
+                            continue
+                        kind = ("gauge" if k in _GAUGE_STATS
+                                else "counter")
+                        lines.append(f"# TYPE tpu_serving_{k} {kind}")
+                        lines.append(f"tpu_serving_{k} {v}")
+                    self._send(
+                        200,
+                        "text/plain; version=0.0.4; charset=utf-8",
+                        "\n".join(lines) + "\n")
                 else:
                     self._send(404, "text/plain", "not found\n")
 
@@ -595,11 +619,20 @@ def main(argv=None) -> int:
                         "propose/verify rounds")
     p.add_argument("--gamma", type=int, default=4,
                    help="draft proposals per speculative round")
+    p.add_argument("--spec-ngram", type=int, default=0, metavar="N",
+                   help="draft-free prompt-lookup speculation with "
+                        "N-gram matching (vLLM's [ngram] mode); "
+                        "mutually exclusive with --draft-config")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8000)
     args = p.parse_args(argv)
     if args.int4 and args.quantized:
         p.error("--quantized and --int4 are mutually exclusive")
+    if args.draft_config and args.spec_ngram:
+        # before the (potentially many-GB) target build, like the
+        # quantization check above
+        p.error("--draft-config and --spec-ngram are mutually "
+                "exclusive")
 
     quantized = "int4" if args.int4 else args.quantized
     mesh = None
@@ -633,10 +666,13 @@ def main(argv=None) -> int:
         _, dmodel, dparams = build_model_and_params(
             args.draft_config, args.max_len, quantized, mesh=mesh)
         draft = (dmodel, dparams)
+    elif args.spec_ngram:
+        draft = "ngram"
     engine = ServingEngine(model, params, n_slots=args.n_slots,
                            eos_id=getattr(cfg, "eos_id", None),
                            mesh=mesh, logprobs_k=args.logprobs_k,
-                           draft=draft, gamma=args.gamma)
+                           draft=draft, gamma=args.gamma,
+                           ngram_n=args.spec_ngram or 3)
     srv = EngineServer(engine, max_new_tokens=args.max_new_tokens,
                        window=args.window)
     srv.start(host=args.host, port=args.port)

@@ -152,6 +152,31 @@ def _lcp(a: np.ndarray, b: np.ndarray) -> int:
     return L if not neq[idx] else idx
 
 
+def _ngram_propose(seq: np.ndarray, n: int, g: int) -> np.ndarray:
+    """Prompt-lookup proposals (vLLM's [ngram] speculative mode): find
+    the LATEST earlier occurrence of the sequence's final *n*-gram and
+    propose the *g* tokens that followed it.  Repetitive continuations
+    (summarization, code edits, retrieval echoes) hit constantly; a
+    miss proposes the last token repeated — proposals are free guesses,
+    the target verify is ground truth either way."""
+    L = len(seq)
+    n = min(n, L - 1)
+    out = np.full(g, seq[-1] if L else 0, np.int32)
+    if n < 1:
+        return out
+    key = seq[L - n:]
+    # vectorized scan (histories approach max_len on the hot path —
+    # a per-position Python loop would put interpreted work in the
+    # round): all windows vs the key in one comparison, latest match
+    windows = np.lib.stride_tricks.sliding_window_view(seq[:L - 1], n)
+    hits = np.flatnonzero((windows == key).all(axis=1))
+    if len(hits):
+        i = int(hits[-1])
+        cont = seq[i + n:i + n + g]
+        out[:len(cont)] = cont
+    return out
+
+
 def _knobs_live(temps, topks, topps, minps, pres, freqs, reps) -> bool:
     """True when any slot's sampling knobs are armed.  THE predicate
     the engine's key-stream accounting hangs on: _sample's greedy fast
@@ -367,8 +392,9 @@ class ServingEngine:
         auto_prefix: bool = True,
         auto_prefix_min: int = 8,
         logprobs_k: int = 0,
-        draft: Optional[tuple] = None,
+        draft=None,
         gamma: int = 4,
+        ngram_n: int = 3,
     ):
         if n_slots < 1:
             raise ValueError("n_slots must be >= 1")
@@ -500,11 +526,23 @@ class ServingEngine:
         # step() pays one per token.  Greedy-only (see spec_round).
         self._draft_model = self._draft_params = None
         self._draft_cache = None
+        self._ngram = False
+        self.ngram_n = ngram_n
         self.gamma = gamma
         self._spec_rounds = 0
         self._spec_proposed = 0
         self._spec_accepted = 0
-        if draft is not None:
+        if draft == "ngram":
+            # draft-FREE speculation (vLLM's [ngram] / prompt-lookup
+            # mode): proposals come from the request's own token
+            # history on the host — no second model, no draft cache,
+            # same batched verify
+            if gamma < 1:
+                raise ValueError("gamma must be >= 1")
+            if ngram_n < 1:
+                raise ValueError("ngram_n must be >= 1")
+            self._ngram = True
+        elif draft is not None:
             draft_model, draft_params = draft
             if gamma < 1:
                 raise ValueError("gamma must be >= 1")
@@ -1121,10 +1159,11 @@ class ServingEngine:
         (vLLM's speculative path has the same posture — rejection
         sampling is a different verifier).  Returns {slot: [tokens]}.
         """
-        if self._draft_model is None:
+        if self._draft_model is None and not self._ngram:
             raise RuntimeError(
-                "engine was built without a draft model "
-                "(ServingEngine(..., draft=(model, params)))")
+                "engine was built without a speculative proposer "
+                "(ServingEngine(..., draft=(model, params)) or "
+                "draft=\"ngram\")")
         if _knobs_live(self.temps, self.topks, self.topps, self.minps,
                        self.pres, self.freqs, self.reps):
             raise ValueError(
@@ -1161,9 +1200,25 @@ class ServingEngine:
             return {s: [t] for s, t in self.step().items()}
         first = jnp.asarray(self.last_token)          # [S]
         pos0 = jnp.asarray(self.lens, jnp.int32)      # [S]
-        props, self._draft_cache = _draft_propose(
-            self._draft_model, self._draft_params, g,
-            self._draft_cache, first, pos0)           # props [S, g]
+        if self._ngram:
+            # host-side prompt-lookup proposals — histories are short
+            # and resident (no device work until the verify)
+            pnp = np.zeros((self.n_slots, g), np.int32)
+            for s in range(self.n_slots):
+                if not self.active[s]:
+                    continue
+                rec = self._slot_prompts[s]
+                hist = np.concatenate([
+                    rec[0] if rec is not None else
+                    np.zeros(0, np.int32),
+                    np.asarray(self.outputs[s], np.int32),
+                ])
+                pnp[s] = _ngram_propose(hist, self.ngram_n, g)
+            props = jnp.asarray(pnp)
+        else:
+            props, self._draft_cache = _draft_propose(
+                self._draft_model, self._draft_params, g,
+                self._draft_cache, first, pos0)       # props [S, g]
         verify = jnp.concatenate([first[:, None], props], axis=1)
         positions = pos0[:, None] + jnp.arange(
             g + 1, dtype=jnp.int32)[None, :]
@@ -1227,8 +1282,9 @@ class ServingEngine:
         # finished DURING the commit loop still get their exact lens
         # (dispatched mask, not self.active)
         self.cache = _rollback_active(self.cache, new_lens, dispatched)
-        self._draft_cache = _rollback_active(
-            self._draft_cache, new_lens, dispatched)
+        if self._draft_cache is not None:
+            self._draft_cache = _rollback_active(
+                self._draft_cache, new_lens, dispatched)
         return out
 
     def run_spec(self, max_rounds: int) -> None:
@@ -1251,7 +1307,7 @@ class ServingEngine:
         loaded and no active slot armed sampling knobs or logprobs —
         the schedulers' predicate for adaptively switching between
         spec rounds (greedy traffic) and run_scan (mixed traffic)."""
-        if self._draft_model is None:
+        if self._draft_model is None and not self._ngram:
             return False
         if _knobs_live(self.temps, self.topks, self.topps, self.minps,
                        self.pres, self.freqs, self.reps):
