@@ -484,3 +484,112 @@ def test_speculative_server_matches_plain(setup):
         assert len(events[0]["tokens"]) == 8
     finally:
         srv.stop()
+
+
+# -- tokenizer surface: prompt strings, stop strings, text streaming ---------
+
+class _ByteTok:
+    """1 byte == 1 token (ids < 128 fit the test vocab): the simplest
+    lossless tokenizer, so text oracles derive from token oracles."""
+
+    def encode(self, s):
+        return list(s.encode("latin-1"))
+
+    def decode(self, ids):
+        return bytes(int(t) % 256 for t in ids).decode("latin-1")
+
+
+@pytest.fixture()
+def text_server(setup):
+    model, params = setup
+    eng = ServingEngine(model, params, n_slots=2)
+    srv = EngineServer(eng, max_new_tokens=8, window=3,
+                       tokenizer=_ByteTok())
+    srv.start(host="127.0.0.1", port=0)
+    yield srv, model, params
+    srv.stop()
+
+
+def test_prompt_string_roundtrip(text_server):
+    srv, model, params = text_server
+    tok = _ByteTok()
+    prompt = "ab"
+    want = _solo(model, params, tok.encode(prompt), 8)
+    status, events = _post(
+        srv.port, {"prompt": prompt, "stream": False})
+    assert status == 200
+    assert events[0]["tokens"] == want
+    assert events[0]["text"] == tok.decode(want)
+
+
+def test_stop_string_truncates(text_server):
+    srv, model, params = text_server
+    tok = _ByteTok()
+    prompt_ids = tok.encode("ab")
+    full = _solo(model, params, prompt_ids, 8)
+    text = tok.decode(full)
+    stop = text[3:5]          # 2 chars spanning emit windows
+    pos = text.find(stop)     # first occurrence rules the truncation
+    status, events = _post(
+        srv.port, {"prompt": "ab", "stop": [stop], "stream": False})
+    assert status == 200
+    assert events[0]["finish_reason"] == "stop"
+    assert events[0]["text"] == text[:pos]
+
+
+def test_stop_string_streaming_holdback(text_server):
+    srv, model, params = text_server
+    tok = _ByteTok()
+    full = _solo(model, params, tok.encode("ab"), 8)
+    text = tok.decode(full)
+    stop = text[3:5]
+    status, events = _post(
+        srv.port, {"prompt": "ab", "stop": [stop]})
+    assert status == 200
+    deltas = "".join(e["text"] for e in events if "text" in e
+                     and "done" not in e)
+    done = [e for e in events if e.get("done")][0]
+    # streamed deltas reassemble exactly to the final truncated text,
+    # and no intermediate chunk ever leaked past the stop
+    assert deltas == done["text"] == text[:text.find(stop)]
+    assert done["finish_reason"] == "stop"
+
+
+def test_mixed_stop_ids_and_strings(text_server):
+    srv, model, params = text_server
+    tok = _ByteTok()
+    full = _solo(model, params, tok.encode("ab"), 8)
+    # both forms in one list; the EARLIEST token boundary wins —
+    # computed from the oracle for whichever rule fires first
+    # (repetitive random-model output can make either one fire early)
+    stop_id = full[2]
+    stop_str = tok.decode(full)[5:7]
+    keep_id = full.index(stop_id) + 1  # id token is included
+    keep_str = next(t for t in range(1, len(full) + 1)
+                    if stop_str in tok.decode(full[:t]))
+    expect = full[:min(keep_id, keep_str)]
+    status, events = _post(
+        srv.port, {"prompt": "ab", "stream": False,
+                   "stop": [stop_id, stop_str]})
+    assert status == 200
+    assert events[0]["finish_reason"] == "stop"
+    assert events[0]["tokens"] == expect
+
+
+def test_text_features_require_tokenizer(server):
+    status, body = _post_raw(server.port, {"prompt": "hi"})
+    assert status == 400 and "tokenizer" in body
+    status, body = _post_raw(
+        server.port, {"tokens": [1, 2], "stop": ["x"]})
+    assert status == 400 and "tokenizer" in body
+
+
+def _post_raw(port, payload):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    try:
+        conn.request("POST", "/generate", json.dumps(payload),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, resp.read().decode()
+    finally:
+        conn.close()

@@ -66,6 +66,42 @@ DEFAULT_WINDOW = 8
 _IDLE_POLL_S = 0.05
 
 
+def _earliest_stop(text: str, stop_strs) -> int:
+    """Index of the earliest stop-string match in *text*, or -1."""
+    hits = [text.find(s) for s in stop_strs]
+    hits = [h for h in hits if h >= 0]
+    return min(hits) if hits else -1
+
+
+def _holdback(text: str, stop_strs) -> int:
+    """How many trailing chars of *text* could still become a stop
+    string (the longest proper stop-prefix *text* ends with) — the
+    stream withholds them so a stop spanning two chunks never leaks."""
+    h = 0
+    for s in stop_strs:
+        for k in range(min(len(s) - 1, len(text)), 0, -1):
+            if text.endswith(s[:k]):
+                h = max(h, k)
+                break
+    return h
+
+
+def _truncate_at_stop(tok, ids, stop_strs, start: int = 1):
+    """Scan prefix decodes for the first stop-string hit, beginning at
+    prefix length *start* (the caller's resume point — tokens below it
+    were proven match-free in earlier windows, so each token is scanned
+    once per request, not once per window): returns (kept token count,
+    truncated text) or (None, None).  The kept tokens include the token
+    that completed the match; the TEXT stops at the match start
+    (vLLM's default, stop string excluded)."""
+    for t in range(max(1, start), len(ids) + 1):
+        txt = tok.decode(ids[:t])
+        pos = _earliest_stop(txt, stop_strs)
+        if pos >= 0:
+            return t, txt[:pos]
+    return None, None
+
+
 @dataclass
 class _Request:
     tokens: List[int]
@@ -92,6 +128,11 @@ class _Request:
     emitted: dict = field(default_factory=dict)   # copy index -> count
     choices: list = field(default_factory=list)   # finished copies
     budget_capped: bool = False
+    # tokenizer-level surface (server-side; the engine stays ids-only):
+    stop_strs: Optional[List[str]] = None
+    detokenize: bool = False          # emit "text" deltas + final text
+    text_sent: dict = field(default_factory=dict)  # idx -> emitted str
+    stop_scanned: dict = field(default_factory=dict)  # idx -> resume t
 
 
 class EngineServer:
@@ -104,7 +145,15 @@ class EngineServer:
 
     def __init__(self, engine: ServingEngine,
                  max_new_tokens: int = 64,
-                 window: int = DEFAULT_WINDOW):
+                 window: int = DEFAULT_WINDOW,
+                 tokenizer=None):
+        """*tokenizer* (anything with ``encode(str) -> List[int]`` and
+        ``decode(List[int]) -> str``, e.g. a transformers tokenizer)
+        unlocks the text-level surface: ``"prompt"`` strings, STRING
+        entries in ``"stop"`` (vLLM's stop strings — matched against
+        the detokenized stream, held back across chunk boundaries),
+        and ``"text"`` deltas in the response.  Without it the server
+        speaks token ids only, as before."""
         if engine.max_new_tokens is not None:
             raise ValueError(
                 "pass per-request budgets to EngineServer, not the "
@@ -115,6 +164,7 @@ class EngineServer:
         self.engine = engine
         self.default_max_new = max_new_tokens
         self.window = window
+        self.tokenizer = tokenizer
         # priority heap (vLLM's priority scheduling): higher-priority
         # requests admit first, FIFO within a priority level (the
         # monotonic sequence number breaks ties).  Guarded by _lock —
@@ -219,10 +269,23 @@ class EngineServer:
               tokens: List[int]) -> None:
         """Push copy *idx*'s unseen tokens, honoring the budget and
         retiring the slot when the copy is done; the request completes
-        when ALL n copies have."""
+        when ALL n copies have.  With a tokenizer, stop STRINGS are
+        matched against the detokenized stream (a match truncates the
+        copy there) and "text" deltas ride alongside the token events,
+        holding back any tail that could still become a stop string."""
         eng = self.engine
         seen = req.emitted[idx]
         new = tokens[seen:req.max_new_tokens]
+        stop_text = None  # truncated text when a stop string matched
+        if req.stop_strs and new:
+            keep, text = _truncate_at_stop(
+                self.tokenizer, tokens[:seen + len(new)],
+                req.stop_strs, start=req.stop_scanned.get(idx, 1))
+            if keep is not None:
+                new = tokens[seen:keep] if keep > seen else []
+                stop_text = text
+            else:
+                req.stop_scanned[idx] = seen + len(new) + 1
         lps = (eng.token_logprobs(slot) if req.logprobs else None)
         for j, t in enumerate(new):
             ev = {"token": int(t)}
@@ -235,27 +298,62 @@ class EngineServer:
             req.events.put(ev)
         req.emitted[idx] = seen + len(new)
         finished = eng.finished(slot)
+        done = (stop_text is not None
+                or req.emitted[idx] >= req.max_new_tokens or finished)
+        if req.detokenize:
+            cur = (stop_text if stop_text is not None
+                   else self.tokenizer.decode(
+                       [int(t) for t in tokens[:req.emitted[idx]]]))
+            hold = (0 if done or not req.stop_strs
+                    else _holdback(cur, req.stop_strs))
+            safe = len(cur) - hold
+            # BPE/byte-fallback decodes are not prefix-stable: a char
+            # split across tokens decodes as U+FFFD until its last
+            # byte arrives, and would never be corrected once
+            # streamed — withhold unstable tails, and if an earlier
+            # emission turns out to mismatch (merge rewrote history),
+            # stop emitting deltas; the final event carries the
+            # authoritative full text either way
+            while not done and safe > 0 and cur[safe - 1] == "�":
+                safe -= 1
+            sent = req.text_sent.get(idx, "")
+            if cur[:len(sent)] == sent and safe > len(sent):
+                ev = {"text": cur[len(sent):safe]}
+                if req.n > 1:
+                    ev["index"] = idx
+                req.events.put(ev)
+                req.text_sent[idx] = cur[:safe]
         if req.cancelled:
             eng.release(slot)
             del self._running[slot]
             return
-        if req.emitted[idx] >= req.max_new_tokens or finished:
-            full = eng.output(slot)
-            out = full[:req.max_new_tokens]
-            if finished and len(full) <= req.max_new_tokens:
-                # the engine's own verdict (eos / stop / length)
-                reason = eng.finish_reason(slot) or "length"
-            else:
-                # budget cut the stream before (or at) the engine's
-                # retirement point
-                reason = "length"
+        if done:
+            if stop_text is not None:
+                out = tokens[:req.emitted[idx]]
+                reason = "stop"
                 if not finished:
                     eng.release(slot)
+            else:
+                full = eng.output(slot)
+                out = full[:req.max_new_tokens]
+                if finished and len(full) <= req.max_new_tokens:
+                    # the engine's own verdict (eos / stop / length)
+                    reason = eng.finish_reason(slot) or "length"
+                else:
+                    # budget cut the stream before (or at) the
+                    # engine's retirement point
+                    reason = "length"
+                    if not finished:
+                        eng.release(slot)
             choice = {
                 "index": idx,
                 "tokens": [int(t) for t in out],
                 "finish_reason": reason,
             }
+            if req.detokenize:
+                choice["text"] = (
+                    stop_text if stop_text is not None
+                    else self.tokenizer.decode([int(t) for t in out]))
             if req.logprobs:
                 choice["logprobs"] = [
                     {"logprob": clp,
@@ -525,6 +623,20 @@ class EngineServer:
 
     def _parse_request(self, body: dict) -> _Request:
         tokens = body.get("tokens")
+        prompt = body.get("prompt")
+        detokenize = bool(body.get("detokenize", prompt is not None))
+        if prompt is not None:
+            if tokens is not None:
+                raise ValueError("pass 'prompt' OR 'tokens', not both")
+            if not isinstance(prompt, str) or not prompt:
+                raise ValueError("'prompt' must be a non-empty string")
+            if self.tokenizer is None:
+                raise ValueError(
+                    "'prompt' strings need a tokenizer (start the "
+                    "server with --tokenizer); pass 'tokens' instead")
+            tokens = [int(t) for t in self.tokenizer.encode(prompt)]
+        if detokenize and self.tokenizer is None:
+            raise ValueError("'detokenize' needs a tokenizer")
         if (not isinstance(tokens, list) or not tokens
                 or not all(isinstance(t, int)
                            and not isinstance(t, bool) for t in tokens)):
@@ -544,13 +656,24 @@ class EngineServer:
         if not 1 <= n <= 128:
             raise ValueError(f"n={n} outside [1, 128]")
         stop = body.get("stop")
-        if stop is not None and (
-                not isinstance(stop, list)
-                or not all(isinstance(t, int)
-                           and not isinstance(t, bool) for t in stop)):
-            # bool is an int subclass: JSON `true` would silently
-            # become token id 1 instead of a 400
-            raise ValueError("'stop' must be a list of token ids")
+        stop_strs: Optional[List[str]] = None
+        if stop is not None:
+            if not isinstance(stop, list) or not all(
+                    (isinstance(t, int) and not isinstance(t, bool))
+                    or isinstance(t, str)
+                    for t in stop):
+                # bool is an int subclass: JSON `true` would silently
+                # become token id 1 instead of a 400
+                raise ValueError(
+                    "'stop' must be a list of token ids and/or strings")
+            stop_strs = [s for s in stop if isinstance(s, str) and s]
+            stop = [t for t in stop if isinstance(t, int)]
+            if stop_strs and self.tokenizer is None:
+                raise ValueError(
+                    "stop STRINGS need a tokenizer (start the server "
+                    "with --tokenizer); pass stop token ids instead")
+            stop = stop or None
+            stop_strs = stop_strs or None
         return _Request(
             tokens=tokens,
             max_new_tokens=max_new,
@@ -564,6 +687,8 @@ class EngineServer:
                 body.get("repetition_penalty", 1.0)),
             adapter=None if adapter is None else int(adapter),
             stop=stop,
+            stop_strs=stop_strs,
+            detokenize=detokenize,
             ignore_eos=bool(body.get("ignore_eos", False)),
             seed=(None if body.get("seed") is None
                   else int(body["seed"])),
@@ -623,11 +748,17 @@ def main(argv=None) -> int:
                    help="draft-free prompt-lookup speculation with "
                         "N-gram matching (vLLM's [ngram] mode); "
                         "mutually exclusive with --draft-config")
+    p.add_argument("--tokenizer", default=None, metavar="NAME_OR_PATH",
+                   help="transformers tokenizer enabling the text "
+                        "surface: 'prompt' strings, stop STRINGS, "
+                        "'text' in responses (ids-only without it)")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8000)
     args = p.parse_args(argv)
     if args.int4 and args.quantized:
         p.error("--quantized and --int4 are mutually exclusive")
+    if args.spec_ngram < 0:
+        p.error("--spec-ngram must be >= 1 (0 disables)")
     if args.draft_config and args.spec_ngram:
         # before the (potentially many-GB) target build, like the
         # quantization check above
@@ -673,8 +804,16 @@ def main(argv=None) -> int:
                            mesh=mesh, logprobs_k=args.logprobs_k,
                            draft=draft, gamma=args.gamma,
                            ngram_n=args.spec_ngram or 3)
+    tokenizer = None
+    if args.tokenizer:
+        try:
+            from transformers import AutoTokenizer
+
+            tokenizer = AutoTokenizer.from_pretrained(args.tokenizer)
+        except Exception as e:
+            p.error(f"could not load tokenizer {args.tokenizer!r}: {e}")
     srv = EngineServer(engine, max_new_tokens=args.max_new_tokens,
-                       window=args.window)
+                       window=args.window, tokenizer=tokenizer)
     srv.start(host=args.host, port=args.port)
     print(f"serving {args.config} (quantized={quantized}) on "
           f"http://{args.host}:{srv.port}  "
