@@ -502,7 +502,7 @@ class _ByteTok:
 @pytest.fixture()
 def text_server(setup):
     model, params = setup
-    eng = ServingEngine(model, params, n_slots=2)
+    eng = ServingEngine(model, params, n_slots=2, logprobs_k=2)
     srv = EngineServer(eng, max_new_tokens=8, window=3,
                        tokenizer=_ByteTok())
     srv.start(host="127.0.0.1", port=0)
@@ -593,3 +593,108 @@ def _post_raw(port, payload):
         return resp.status, resp.read().decode()
     finally:
         conn.close()
+
+
+# -- OpenAI-compatible /v1/completions ---------------------------------------
+
+def _post_openai(port, payload):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    try:
+        conn.request("POST", "/v1/completions", json.dumps(payload),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, resp.read().decode()
+    finally:
+        conn.close()
+
+
+def test_openai_completions_basic(text_server):
+    srv, model, params = text_server
+    tok = _ByteTok()
+    want = _solo(model, params, tok.encode("ab"), 8)
+    status, body = _post_openai(srv.port, {
+        "model": "tiny", "prompt": "ab", "temperature": 0,
+        "max_tokens": 8})
+    assert status == 200
+    out = json.loads(body)
+    assert out["object"] == "text_completion"
+    assert out["model"] == "tiny"
+    ch = out["choices"][0]
+    assert ch["text"] == tok.decode(want)
+    assert ch["finish_reason"] == "length"
+    assert out["usage"] == {"prompt_tokens": 2,
+                            "completion_tokens": 8,
+                            "total_tokens": 10}
+
+
+def test_openai_completions_token_array_and_stop(text_server):
+    srv, model, params = text_server
+    tok = _ByteTok()
+    ids = tok.encode("ab")
+    full = _solo(model, params, ids, 8)
+    text = tok.decode(full)
+    stop = text[3:5]
+    status, body = _post_openai(srv.port, {
+        "prompt": ids, "temperature": 0, "max_tokens": 8,
+        "stop": stop})
+    assert status == 200
+    ch = json.loads(body)["choices"][0]
+    assert ch["finish_reason"] == "stop"
+    assert ch["text"] == text[:text.find(stop)]
+
+
+def test_openai_completions_sse_stream(text_server):
+    srv, model, params = text_server
+    tok = _ByteTok()
+    want = _solo(model, params, tok.encode("ab"), 8)
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                      timeout=120)
+    conn.request("POST", "/v1/completions", json.dumps({
+        "prompt": "ab", "temperature": 0, "max_tokens": 8,
+        "stream": True}), {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.getheader("Content-Type") == "text/event-stream"
+    raw = resp.read().decode()
+    conn.close()
+    datas = [line[len("data: "):] for line in raw.splitlines()
+             if line.startswith("data: ")]
+    assert datas[-1] == "[DONE]"
+    chunks = [json.loads(d) for d in datas[:-1]]
+    text = "".join(c["choices"][0]["text"] for c in chunks)
+    assert text == tok.decode(want)
+    finals = [c["choices"][0]["finish_reason"] for c in chunks
+              if c["choices"][0]["finish_reason"]]
+    assert finals == ["length"]
+
+
+def test_openai_completions_needs_tokenizer(server):
+    status, body = _post_openai(server.port, {"prompt": "hi"})
+    assert status == 400
+    err = json.loads(body)["error"]
+    assert err["type"] == "invalid_request_error"
+    assert "tokenizer" in err["message"]
+
+
+def test_openai_logprobs_counts(text_server):
+    srv, model, params = text_server
+    # logprobs=0: chosen token's logprob, NO alternatives (valid in
+    # the OpenAI API; engine-side 0 means off, so the server maps it)
+    status, body = _post_openai(srv.port, {
+        "prompt": "ab", "temperature": 0, "max_tokens": 4,
+        "logprobs": 0})
+    assert status == 200
+    lp = json.loads(body)["choices"][0]["logprobs"]
+    assert len(lp["token_logprobs"]) == 4
+    assert all(t == {} for t in lp["top_logprobs"])
+    # logprobs=2: two alternatives per position
+    status, body = _post_openai(srv.port, {
+        "prompt": "ab", "temperature": 0, "max_tokens": 4,
+        "logprobs": 2})
+    lp = json.loads(body)["choices"][0]["logprobs"]
+    assert all(len(t) == 2 for t in lp["top_logprobs"])
+    # streamed logprobs are an explicit 400, not silent data loss
+    status, body = _post_openai(srv.port, {
+        "prompt": "ab", "logprobs": 2, "stream": True})
+    assert status == 400
+    assert "stream" in json.loads(body)["error"]["message"]

@@ -35,12 +35,20 @@ API (JSON over HTTP/1.1):
                    per event — {"token": t} ... then
                    {"done": true, "tokens": [...], "finish_reason": r}
                    stream=false: single JSON body (the final event).
+  POST /v1/completions   OpenAI-compatible text completions (needs
+                   --tokenizer): string or token-array "prompt",
+                   max_tokens/temperature/top_p/n/seed/penalties/
+                   logprobs/stop, "stream": true = SSE data: chunks
+                   ending in [DONE]; usage token accounting.
   GET  /healthz    liveness ("ok").
   GET  /stats      engine + server counters (JSON).
+  GET  /metrics    the same counters in Prometheus exposition format.
 
-Token ids in, token ids out: tokenization is the caller's business
-(the k8s example mounts a tokenizer next to the client), and the
-engine's contract stays exact and model-agnostic.
+Token ids in, token ids out by default: tokenization is the caller's
+business and the engine's contract stays exact and model-agnostic.
+``--tokenizer`` opts into the text surface server-side ("prompt"
+strings, stop STRINGS with streaming holdback, "text" deltas) without
+touching the compiled decode path.
 """
 
 from __future__ import annotations
@@ -102,6 +110,85 @@ def _truncate_at_stop(tok, ids, stop_strs, start: int = 1):
     return None, None
 
 
+def _openai_chunk(rid: str, model_name: str, ev: dict, sent: dict):
+    """One SSE chunk for a native event, or None for events the OpenAI
+    stream does not carry (raw token ids).  *sent* accumulates the text
+    streamed per choice index so the final chunk can flush whatever the
+    deltas withheld — the native done event's "text" is authoritative
+    (BPE holdback / rewritten-history cases deliberately under-stream;
+    see _emit)."""
+    if "text" in ev and "done" not in ev:
+        idx = ev.get("index", 0)
+        sent[idx] = sent.get(idx, "") + ev["text"]
+        return {
+            "id": rid, "object": "text_completion",
+            "model": model_name,
+            "choices": [{"index": idx,
+                         "text": ev["text"], "finish_reason": None}],
+        }
+    if "done" in ev:
+        chs = (ev["choices"] if "choices" in ev
+               else [{**ev, "index": 0}])
+        choices = []
+        for c in chs:
+            final = c.get("text", "")
+            prev = sent.get(c["index"], "")
+            if final.startswith(prev):
+                tail = final[len(prev):]
+            else:
+                # a decode merge rewrote streamed history (rare, BPE):
+                # resend the full authoritative text — duplicated
+                # beats silently wrong
+                tail = final
+            choices.append({"index": c["index"], "text": tail,
+                            "finish_reason": c["finish_reason"]})
+        return {
+            "id": rid, "object": "text_completion",
+            "model": model_name,
+            "choices": choices,
+        }
+    return None
+
+
+def _openai_response(rid: str, model_name: str, req: "_Request",
+                     done: dict) -> dict:
+    chs = done["choices"] if "choices" in done else [{**done, "index": 0}]
+    choices = []
+    completion_tokens = 0
+    for c in sorted(chs, key=lambda c: c["index"]):
+        completion_tokens += len(c["tokens"])
+        lp = None
+        if c.get("logprobs"):
+            # trim the engine's top list to the OpenAI-requested count
+            # (0 = chosen only; the engine always computes >= 1)
+            n = req.openai_logprobs or 0
+            lp = {
+                "token_logprobs": [r["logprob"] for r in c["logprobs"]],
+                "top_logprobs": [
+                    {str(i): p for i, p in r["top_logprobs"][:n]}
+                    for r in c["logprobs"]],
+                "tokens": [str(t) for t in c["tokens"]],
+                "text_offset": None,
+            }
+        choices.append({
+            "index": c["index"],
+            "text": c.get("text", ""),
+            "finish_reason": c["finish_reason"],
+            "logprobs": lp,
+        })
+    return {
+        "id": rid,
+        "object": "text_completion",
+        "model": model_name,
+        "choices": choices,
+        "usage": {
+            "prompt_tokens": len(req.tokens),
+            "completion_tokens": completion_tokens,
+            "total_tokens": len(req.tokens) + completion_tokens,
+        },
+    }
+
+
 @dataclass
 class _Request:
     tokens: List[int]
@@ -133,6 +220,7 @@ class _Request:
     detokenize: bool = False          # emit "text" deltas + final text
     text_sent: dict = field(default_factory=dict)  # idx -> emitted str
     stop_scanned: dict = field(default_factory=dict)  # idx -> resume t
+    openai_logprobs: Optional[int] = None  # client-requested count
 
 
 class EngineServer:
@@ -488,6 +576,9 @@ class EngineServer:
                     self._send(404, "text/plain", "not found\n")
 
             def do_POST(self):  # noqa: N802
+                if self.path == "/v1/completions":
+                    self._openai_completions()
+                    return
                 if self.path != "/generate":
                     self._send(404, "text/plain", "not found\n")
                     return
@@ -508,6 +599,102 @@ class EngineServer:
                         self._collect(req)
                 except (BrokenPipeError, ConnectionResetError):
                     req.cancelled = True
+
+            def _openai_completions(self):
+                """OpenAI-compatible text completions (the interface
+                vLLM serves first): translate the body onto the native
+                request, answer in the OpenAI wire shape — streamed as
+                SSE `data:` chunks or one JSON object."""
+                stream = False
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(length))
+                    stream = bool(body.get("stream", False))
+                    native, model_name = server._openai_to_native(body)
+                    if stream and native.get("logprobs") is not None:
+                        # explicit 400 beats silently dropping the
+                        # data: the SSE chunks carry text deltas that
+                        # do not align 1:1 with tokens
+                        raise ValueError(
+                            "logprobs with stream=true is not "
+                            "supported; request them unstreamed")
+                    req = server._parse_request(native)
+                    if body.get("logprobs") is not None:
+                        # the OpenAI-requested count (may be 0): the
+                        # response trims the engine's top list to it
+                        req.openai_logprobs = int(body["logprobs"])
+                except (ValueError, TypeError, KeyError) as e:
+                    self._openai_error(400, str(e))
+                    return
+                server._enqueue(req)
+                try:
+                    if stream:
+                        self._openai_stream(req, model_name)
+                    else:
+                        self._openai_collect(req, model_name)
+                except (BrokenPipeError, ConnectionResetError):
+                    req.cancelled = True
+
+            def _openai_error(self, code: int, message: str):
+                """OpenAI error wire shape; 5xx are server faults so
+                retry middleware retries them, 4xx are caller errors."""
+                kind = ("server_error" if code >= 500
+                        else "invalid_request_error")
+                self._send(code, "application/json",
+                           json.dumps({"error": {
+                               "message": message,
+                               "type": kind}}) + "\n")
+
+            def _openai_stream(self, req: _Request, model_name):
+                first = req.events.get()
+                if "error" in first:
+                    self._openai_error(first.get("code", 400),
+                                       first["error"])
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                rid = f"cmpl-{id(req):x}"
+                sent: dict = {}  # index -> streamed text so far
+                ev = first
+                while True:
+                    if "error" in ev:
+                        # mid-stream failure (e.g. shutdown drain):
+                        # surface it as an error chunk, never as a
+                        # clean-looking [DONE]
+                        kind = ("server_error"
+                                if ev.get("code", 400) >= 500
+                                else "invalid_request_error")
+                        self._chunk("data: " + json.dumps({
+                            "error": {"message": ev["error"],
+                                      "type": kind}}) + "\n\n")
+                        break
+                    chunk = _openai_chunk(rid, model_name, ev, sent)
+                    if chunk is not None:
+                        self._chunk("data: " + json.dumps(chunk)
+                                    + "\n\n")
+                    if "done" in ev:
+                        break
+                    ev = req.events.get()
+                self._chunk("data: [DONE]\n\n")
+                self._chunk("")
+
+            def _openai_collect(self, req: _Request, model_name):
+                while True:
+                    ev = req.events.get()
+                    if "error" in ev:
+                        self._openai_error(ev.get("code", 400),
+                                           ev["error"])
+                        return
+                    if "done" in ev:
+                        self._send(
+                            200, "application/json",
+                            json.dumps(_openai_response(
+                                f"cmpl-{id(req):x}", model_name,
+                                req, ev)) + "\n")
+                        return
 
             def _stream(self, req: _Request):
                 # wait for the FIRST event before sending headers: an
@@ -620,6 +807,50 @@ class EngineServer:
         self._work.set()
 
     # -- request plumbing ---------------------------------------------------
+
+    def _openai_to_native(self, body: dict):
+        """Translate an OpenAI /v1/completions body onto the native
+        request shape.  Returns (native_body, model_name)."""
+        if self.tokenizer is None:
+            raise ValueError(
+                "/v1/completions needs a tokenizer (start the server "
+                "with --tokenizer); the native /generate endpoint "
+                "speaks raw token ids")
+        prompt = body.get("prompt")
+        native: dict = {"detokenize": True}
+        if isinstance(prompt, list) and prompt and all(
+                isinstance(t, int) and not isinstance(t, bool)
+                for t in prompt):
+            native["tokens"] = prompt  # OpenAI's token-array form
+        elif isinstance(prompt, str):
+            native["prompt"] = prompt
+        else:
+            raise ValueError(
+                "'prompt' must be a string or a token-id array")
+        native["max_new_tokens"] = int(body.get("max_tokens", 16))
+        # OpenAI defaults temperature to 1.0 (sampled); clients wanting
+        # greedy pass 0 explicitly, exactly as with OpenAI/vLLM
+        native["temperature"] = float(body.get("temperature", 1.0))
+        if "top_p" in body:
+            native["top_p"] = float(body["top_p"])
+        if "n" in body:
+            native["n"] = int(body["n"])
+        if "seed" in body and body["seed"] is not None:
+            native["seed"] = int(body["seed"])
+        if "presence_penalty" in body:
+            native["presence_penalty"] = float(body["presence_penalty"])
+        if "frequency_penalty" in body:
+            native["frequency_penalty"] = float(
+                body["frequency_penalty"])
+        if body.get("logprobs") is not None:
+            # OpenAI logprobs=0 means "chosen token's logprob, no
+            # alternatives" — the engine's 0 means OFF, so request
+            # top-1 and trim the alternatives in the response
+            native["logprobs"] = max(1, int(body["logprobs"]))
+        stop = body.get("stop")
+        if stop is not None:
+            native["stop"] = [stop] if isinstance(stop, str) else stop
+        return native, str(body.get("model", "default"))
 
     def _parse_request(self, body: dict) -> _Request:
         tokens = body.get("tokens")
@@ -817,7 +1048,8 @@ def main(argv=None) -> int:
     srv.start(host=args.host, port=args.port)
     print(f"serving {args.config} (quantized={quantized}) on "
           f"http://{args.host}:{srv.port}  "
-          f"[POST /generate, GET /healthz, GET /stats]", flush=True)
+          f"[POST /generate, POST /v1/completions, GET /healthz, "
+          f"GET /stats, GET /metrics]", flush=True)
     try:
         threading.Event().wait()
     except KeyboardInterrupt:
