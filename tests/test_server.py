@@ -698,3 +698,112 @@ def test_openai_logprobs_counts(text_server):
         "prompt": "ab", "logprobs": 2, "stream": True})
     assert status == 400
     assert "stream" in json.loads(body)["error"]["message"]
+
+
+class _ChatTok(_ByteTok):
+    """ByteTok plus a minimal chat template (the transformers API
+    surface the chat endpoint needs)."""
+
+    def apply_chat_template(self, messages, tokenize=False,
+                            add_generation_prompt=True):
+        text = "".join(f"<{m['role']}>{m['content']}" for m in messages)
+        if add_generation_prompt:
+            text += "<assistant>"
+        return text
+
+
+def test_openai_chat_completions(setup):
+    model, params = setup
+    tok = _ChatTok()
+    eng = ServingEngine(model, params, n_slots=2)
+    srv = EngineServer(eng, max_new_tokens=6, window=3, tokenizer=tok)
+    srv.start(host="127.0.0.1", port=0)
+    try:
+        msgs = [{"role": "user", "content": "hi"}]
+        prompt_ids = tok.encode(tok.apply_chat_template(msgs))
+        want = _solo(model, params, prompt_ids, 6)
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=120)
+        conn.request("POST", "/v1/chat/completions", json.dumps({
+            "model": "tiny", "messages": msgs, "temperature": 0,
+            "max_tokens": 6}), {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        out = json.loads(resp.read().decode())
+        conn.close()
+        assert resp.status == 200
+        assert out["object"] == "chat.completion"
+        msg = out["choices"][0]["message"]
+        assert msg["role"] == "assistant"
+        assert msg["content"] == tok.decode(want)
+        assert out["usage"]["prompt_tokens"] == len(prompt_ids)
+
+        # streamed: chat.completion.chunk deltas reassemble
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=120)
+        conn.request("POST", "/v1/chat/completions", json.dumps({
+            "messages": msgs, "temperature": 0, "max_tokens": 6,
+            "stream": True}), {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        raw = resp.read().decode()
+        conn.close()
+        datas = [l[len("data: "):] for l in raw.splitlines()
+                 if l.startswith("data: ")]
+        assert datas[-1] == "[DONE]"
+        chunks = [json.loads(d) for d in datas[:-1]]
+        assert all(c["object"] == "chat.completion.chunk"
+                   for c in chunks)
+        text = "".join(c["choices"][0]["delta"].get("content", "")
+                       for c in chunks)
+        assert text == tok.decode(want)
+    finally:
+        srv.stop()
+
+
+def test_openai_chat_needs_template(text_server):
+    srv, _, _ = text_server  # _ByteTok has no chat template
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=60)
+    conn.request("POST", "/v1/chat/completions", json.dumps({
+        "messages": [{"role": "user", "content": "x"}]}),
+        {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    body = resp.read().decode()
+    conn.close()
+    assert resp.status == 400 and "chat template" in body
+
+
+def test_openai_chat_logprobs_boolean(setup):
+    model, params = setup
+    tok = _ChatTok()
+    eng = ServingEngine(model, params, n_slots=1, logprobs_k=2)
+    srv = EngineServer(eng, max_new_tokens=4, window=2, tokenizer=tok)
+    srv.start(host="127.0.0.1", port=0)
+    try:
+        msgs = [{"role": "user", "content": "hi"}]
+
+        def chat(body):
+            c = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                           timeout=120)
+            c.request("POST", "/v1/chat/completions", json.dumps(body),
+                      {"Content-Type": "application/json"})
+            r = c.getresponse()
+            out = r.status, json.loads(r.read().decode())
+            c.close()
+            return out
+
+        # logprobs: false must NOT enable logprobs (bool, not count)
+        status, out = chat({"messages": msgs, "temperature": 0,
+                            "max_tokens": 4, "logprobs": False})
+        assert status == 200
+        assert out["choices"][0]["logprobs"] is None
+        assert out["created"] > 0
+        # logprobs: true + top_logprobs: 2 -> chat content shape
+        status, out = chat({"messages": msgs, "temperature": 0,
+                            "max_tokens": 4, "logprobs": True,
+                            "top_logprobs": 2})
+        assert status == 200
+        recs = out["choices"][0]["logprobs"]["content"]
+        assert len(recs) == 4
+        assert all(len(r["top_logprobs"]) == 2 for r in recs)
+        assert all("logprob" in r and "token" in r for r in recs)
+    finally:
+        srv.stop()

@@ -40,6 +40,9 @@ API (JSON over HTTP/1.1):
                    max_tokens/temperature/top_p/n/seed/penalties/
                    logprobs/stop, "stream": true = SSE data: chunks
                    ending in [DONE]; usage token accounting.
+  POST /v1/chat/completions   chat variant: "messages" rendered by
+                   the tokenizer's chat template; responses carry
+                   message/delta objects in the chat wire shape.
   GET  /healthz    liveness ("ok").
   GET  /stats      engine + server counters (JSON).
   GET  /metrics    the same counters in Prometheus exposition format.
@@ -59,6 +62,7 @@ import json
 import logging
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
@@ -110,21 +114,31 @@ def _truncate_at_stop(tok, ids, stop_strs, start: int = 1):
     return None, None
 
 
-def _openai_chunk(rid: str, model_name: str, ev: dict, sent: dict):
+def _openai_chunk(rid: str, model_name: str, ev: dict, sent: dict,
+                  chat: bool = False):
     """One SSE chunk for a native event, or None for events the OpenAI
     stream does not carry (raw token ids).  *sent* accumulates the text
     streamed per choice index so the final chunk can flush whatever the
     deltas withheld — the native done event's "text" is authoritative
     (BPE holdback / rewritten-history cases deliberately under-stream;
-    see _emit)."""
+    see _emit).  *chat* switches to the chat.completion.chunk shape
+    (delta objects instead of text fields)."""
+    obj = "chat.completion.chunk" if chat else "text_completion"
+
+    def choice(idx, text, reason):
+        if chat:
+            delta = {"content": text} if text else {}
+            return {"index": idx, "delta": delta,
+                    "finish_reason": reason}
+        return {"index": idx, "text": text, "finish_reason": reason}
+
     if "text" in ev and "done" not in ev:
         idx = ev.get("index", 0)
         sent[idx] = sent.get(idx, "") + ev["text"]
         return {
-            "id": rid, "object": "text_completion",
-            "model": model_name,
-            "choices": [{"index": idx,
-                         "text": ev["text"], "finish_reason": None}],
+            "id": rid, "object": obj, "model": model_name,
+            "created": int(time.time()),
+            "choices": [choice(idx, ev["text"], None)],
         }
     if "done" in ev:
         chs = (ev["choices"] if "choices" in ev
@@ -140,18 +154,18 @@ def _openai_chunk(rid: str, model_name: str, ev: dict, sent: dict):
                 # resend the full authoritative text — duplicated
                 # beats silently wrong
                 tail = final
-            choices.append({"index": c["index"], "text": tail,
-                            "finish_reason": c["finish_reason"]})
+            choices.append(
+                choice(c["index"], tail, c["finish_reason"]))
         return {
-            "id": rid, "object": "text_completion",
-            "model": model_name,
+            "id": rid, "object": obj, "model": model_name,
+            "created": int(time.time()),
             "choices": choices,
         }
     return None
 
 
 def _openai_response(rid: str, model_name: str, req: "_Request",
-                     done: dict) -> dict:
+                     done: dict, chat: bool = False) -> dict:
     chs = done["choices"] if "choices" in done else [{**done, "index": 0}]
     choices = []
     completion_tokens = 0
@@ -162,24 +176,45 @@ def _openai_response(rid: str, model_name: str, req: "_Request",
             # trim the engine's top list to the OpenAI-requested count
             # (0 = chosen only; the engine always computes >= 1)
             n = req.openai_logprobs or 0
-            lp = {
-                "token_logprobs": [r["logprob"] for r in c["logprobs"]],
-                "top_logprobs": [
-                    {str(i): p for i, p in r["top_logprobs"][:n]}
-                    for r in c["logprobs"]],
-                "tokens": [str(t) for t in c["tokens"]],
-                "text_offset": None,
-            }
-        choices.append({
-            "index": c["index"],
-            "text": c.get("text", ""),
-            "finish_reason": c["finish_reason"],
-            "logprobs": lp,
-        })
+            if chat:
+                # the chat wire shape: content list of per-token
+                # records with nested top_logprobs objects
+                lp = {"content": [
+                    {"token": str(t), "logprob": r["logprob"],
+                     "top_logprobs": [
+                         {"token": str(i), "logprob": p}
+                         for i, p in r["top_logprobs"][:n]]}
+                    for t, r in zip(c["tokens"], c["logprobs"])]}
+            else:
+                lp = {
+                    "token_logprobs": [
+                        r["logprob"] for r in c["logprobs"]],
+                    "top_logprobs": [
+                        {str(i): p for i, p in r["top_logprobs"][:n]}
+                        for r in c["logprobs"]],
+                    "tokens": [str(t) for t in c["tokens"]],
+                    "text_offset": None,
+                }
+        if chat:
+            choices.append({
+                "index": c["index"],
+                "message": {"role": "assistant",
+                            "content": c.get("text", "")},
+                "finish_reason": c["finish_reason"],
+                "logprobs": lp,
+            })
+        else:
+            choices.append({
+                "index": c["index"],
+                "text": c.get("text", ""),
+                "finish_reason": c["finish_reason"],
+                "logprobs": lp,
+            })
     return {
         "id": rid,
-        "object": "text_completion",
+        "object": "chat.completion" if chat else "text_completion",
         "model": model_name,
+        "created": int(time.time()),
         "choices": choices,
         "usage": {
             "prompt_tokens": len(req.tokens),
@@ -577,7 +612,10 @@ class EngineServer:
 
             def do_POST(self):  # noqa: N802
                 if self.path == "/v1/completions":
-                    self._openai_completions()
+                    self._openai_completions(chat=False)
+                    return
+                if self.path == "/v1/chat/completions":
+                    self._openai_completions(chat=True)
                     return
                 if self.path != "/generate":
                     self._send(404, "text/plain", "not found\n")
@@ -600,7 +638,7 @@ class EngineServer:
                 except (BrokenPipeError, ConnectionResetError):
                     req.cancelled = True
 
-            def _openai_completions(self):
+            def _openai_completions(self, chat: bool = False):
                 """OpenAI-compatible text completions (the interface
                 vLLM serves first): translate the body onto the native
                 request, answer in the OpenAI wire shape — streamed as
@@ -610,7 +648,9 @@ class EngineServer:
                     length = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(length))
                     stream = bool(body.get("stream", False))
-                    native, model_name = server._openai_to_native(body)
+                    native, model_name = (
+                        server._openai_chat_to_native(body) if chat
+                        else server._openai_to_native(body))
                     if stream and native.get("logprobs") is not None:
                         # explicit 400 beats silently dropping the
                         # data: the SSE chunks carry text deltas that
@@ -619,19 +659,19 @@ class EngineServer:
                             "logprobs with stream=true is not "
                             "supported; request them unstreamed")
                     req = server._parse_request(native)
-                    if body.get("logprobs") is not None:
-                        # the OpenAI-requested count (may be 0): the
+                    if native.get("_lp_count") is not None:
+                        # the client-requested count (may be 0): the
                         # response trims the engine's top list to it
-                        req.openai_logprobs = int(body["logprobs"])
+                        req.openai_logprobs = native["_lp_count"]
                 except (ValueError, TypeError, KeyError) as e:
                     self._openai_error(400, str(e))
                     return
                 server._enqueue(req)
                 try:
                     if stream:
-                        self._openai_stream(req, model_name)
+                        self._openai_stream(req, model_name, chat)
                     else:
-                        self._openai_collect(req, model_name)
+                        self._openai_collect(req, model_name, chat)
                 except (BrokenPipeError, ConnectionResetError):
                     req.cancelled = True
 
@@ -645,7 +685,8 @@ class EngineServer:
                                "message": message,
                                "type": kind}}) + "\n")
 
-            def _openai_stream(self, req: _Request, model_name):
+            def _openai_stream(self, req: _Request, model_name,
+                   chat: bool = False):
                 first = req.events.get()
                 if "error" in first:
                     self._openai_error(first.get("code", 400),
@@ -657,6 +698,19 @@ class EngineServer:
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
                 rid = f"cmpl-{id(req):x}"
+                if chat:
+                    # the chat stream contract: role arrives in the
+                    # first chunk's delta, content in later deltas
+                    self._chunk("data: " + json.dumps({
+                        "id": rid, "object": "chat.completion.chunk",
+                        "model": model_name,
+                        "created": int(time.time()),
+                        "choices": [
+                            {"index": i,
+                             "delta": {"role": "assistant"},
+                             "finish_reason": None}
+                            for i in range(req.n)],
+                    }) + "\n\n")
                 sent: dict = {}  # index -> streamed text so far
                 ev = first
                 while True:
@@ -671,7 +725,8 @@ class EngineServer:
                             "error": {"message": ev["error"],
                                       "type": kind}}) + "\n\n")
                         break
-                    chunk = _openai_chunk(rid, model_name, ev, sent)
+                    chunk = _openai_chunk(rid, model_name, ev, sent,
+                                          chat=chat)
                     if chunk is not None:
                         self._chunk("data: " + json.dumps(chunk)
                                     + "\n\n")
@@ -681,7 +736,8 @@ class EngineServer:
                 self._chunk("data: [DONE]\n\n")
                 self._chunk("")
 
-            def _openai_collect(self, req: _Request, model_name):
+            def _openai_collect(self, req: _Request, model_name,
+                    chat: bool = False):
                 while True:
                     ev = req.events.get()
                     if "error" in ev:
@@ -693,7 +749,7 @@ class EngineServer:
                             200, "application/json",
                             json.dumps(_openai_response(
                                 f"cmpl-{id(req):x}", model_name,
-                                req, ev)) + "\n")
+                                req, ev, chat=chat)) + "\n")
                         return
 
             def _stream(self, req: _Request):
@@ -827,30 +883,83 @@ class EngineServer:
         else:
             raise ValueError(
                 "'prompt' must be a string or a token-id array")
-        native["max_new_tokens"] = int(body.get("max_tokens", 16))
+        def opt(key, default=None):
+            # an explicit JSON null means "use the default" in the
+            # OpenAI API (clients serialize unset optionals as null)
+            v = body.get(key)
+            return default if v is None else v
+
+        native["max_new_tokens"] = int(
+            opt("max_tokens", opt("max_completion_tokens", 16)))
         # OpenAI defaults temperature to 1.0 (sampled); clients wanting
         # greedy pass 0 explicitly, exactly as with OpenAI/vLLM
-        native["temperature"] = float(body.get("temperature", 1.0))
-        if "top_p" in body:
-            native["top_p"] = float(body["top_p"])
-        if "n" in body:
-            native["n"] = int(body["n"])
-        if "seed" in body and body["seed"] is not None:
-            native["seed"] = int(body["seed"])
-        if "presence_penalty" in body:
-            native["presence_penalty"] = float(body["presence_penalty"])
-        if "frequency_penalty" in body:
+        native["temperature"] = float(opt("temperature", 1.0))
+        if opt("top_p") is not None:
+            native["top_p"] = float(opt("top_p"))
+        if opt("n") is not None:
+            native["n"] = int(opt("n"))
+        if opt("seed") is not None:
+            native["seed"] = int(opt("seed"))
+        if opt("presence_penalty") is not None:
+            native["presence_penalty"] = float(opt("presence_penalty"))
+        if opt("frequency_penalty") is not None:
             native["frequency_penalty"] = float(
-                body["frequency_penalty"])
-        if body.get("logprobs") is not None:
+                opt("frequency_penalty"))
+        if opt("logprobs") is not None:
             # OpenAI logprobs=0 means "chosen token's logprob, no
             # alternatives" — the engine's 0 means OFF, so request
             # top-1 and trim the alternatives in the response
-            native["logprobs"] = max(1, int(body["logprobs"]))
-        stop = body.get("stop")
+            # (_lp_count carries the client-requested count through to
+            # the response builder; _parse_request ignores it)
+            native["_lp_count"] = int(opt("logprobs"))
+            native["logprobs"] = max(1, native["_lp_count"])
+        stop = opt("stop")
         if stop is not None:
             native["stop"] = [stop] if isinstance(stop, str) else stop
-        return native, str(body.get("model", "default"))
+        return native, str(opt("model", "default"))
+
+    def _openai_chat_to_native(self, body: dict):
+        """Translate an OpenAI /v1/chat/completions body: the
+        tokenizer's chat template renders the messages into the
+        prompt, everything else rides the completions translation."""
+        if self.tokenizer is None:
+            raise ValueError(
+                "/v1/chat/completions needs a tokenizer (start the "
+                "server with --tokenizer)")
+        template = getattr(self.tokenizer, "apply_chat_template", None)
+        if template is None:
+            raise ValueError(
+                "the loaded tokenizer has no chat template; use "
+                "/v1/completions")
+        messages = body.get("messages")
+        if (not isinstance(messages, list) or not messages or not all(
+                isinstance(m, dict)
+                and isinstance(m.get("role"), str)
+                and isinstance(m.get("content"), str)
+                for m in messages)):
+            raise ValueError(
+                "'messages' must be a non-empty list of "
+                "{role, content} objects")
+        prompt = template(messages, tokenize=False,
+                          add_generation_prompt=True)
+        flat = dict(body)
+        flat.pop("messages")
+        # chat templates already emit BOS/special markers: re-encoding
+        # with default special-token addition would double the BOS, so
+        # pre-encode here (token-array prompts skip encode entirely)
+        try:
+            ids = self.tokenizer.encode(prompt,
+                                        add_special_tokens=False)
+        except TypeError:  # tokenizer without the kwarg (test fakes)
+            ids = self.tokenizer.encode(prompt)
+        flat["prompt"] = [int(t) for t in ids]
+        # chat logprobs semantics: a BOOLEAN plus top_logprobs (int),
+        # not the completions integer — translate before delegating
+        lpb = flat.pop("logprobs", None)
+        top_n = flat.pop("top_logprobs", None)
+        if lpb:
+            flat["logprobs"] = int(top_n or 0)
+        return self._openai_to_native(flat)
 
     def _parse_request(self, body: dict) -> _Request:
         tokens = body.get("tokens")
