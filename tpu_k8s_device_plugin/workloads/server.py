@@ -256,6 +256,7 @@ class _Request:
     text_sent: dict = field(default_factory=dict)  # idx -> emitted str
     stop_scanned: dict = field(default_factory=dict)  # idx -> resume t
     openai_logprobs: Optional[int] = None  # client-requested count
+    logit_bias: Optional[dict] = None      # {token id: bias}
 
 
 class EngineServer:
@@ -371,7 +372,8 @@ class EngineServer:
                     # copy: only copy 0 pays the full-prefill cost
                     # (copies 1..n-1 keep their APC tail-only prefill)
                     prompt_logprobs=(req.prompt_logprobs
-                                     if req.admitted == 0 else None))
+                                     if req.admitted == 0 else None),
+                    logit_bias=req.logit_bias)
             except (ValueError, RuntimeError) as e:
                 # identical args per copy, so only the FIRST admit can
                 # fail on validation (the free-slot guard rules out
@@ -916,6 +918,8 @@ class EngineServer:
         stop = opt("stop")
         if stop is not None:
             native["stop"] = [stop] if isinstance(stop, str) else stop
+        if opt("logit_bias") is not None:
+            native["logit_bias"] = opt("logit_bias")
         return native, str(opt("model", "default"))
 
     def _openai_chat_to_native(self, body: dict):
@@ -995,6 +999,21 @@ class EngineServer:
         n = int(body.get("n", 1))
         if not 1 <= n <= 128:
             raise ValueError(f"n={n} outside [1, 128]")
+        logit_bias = body.get("logit_bias")
+        if logit_bias == {}:
+            logit_bias = None  # OpenAI treats an empty object as unset
+        if logit_bias is not None:
+            if not isinstance(logit_bias, dict):
+                raise ValueError(
+                    "'logit_bias' must be a {token id: bias} object")
+            try:
+                # JSON object keys are strings (OpenAI sends them so)
+                logit_bias = {int(k): float(v)
+                              for k, v in logit_bias.items()}
+            except (TypeError, ValueError):
+                raise ValueError(
+                    "'logit_bias' keys must be token ids and values "
+                    "numbers")
         stop = body.get("stop")
         stop_strs: Optional[List[str]] = None
         if stop is not None:
@@ -1029,6 +1048,7 @@ class EngineServer:
             stop=stop,
             stop_strs=stop_strs,
             detokenize=detokenize,
+            logit_bias=logit_bias,
             ignore_eos=bool(body.get("ignore_eos", False)),
             seed=(None if body.get("seed") is None
                   else int(body["seed"])),

@@ -322,12 +322,14 @@ def _top_logprobs(logits, chosen, k):
 
 
 @functools.partial(
-    jax.jit, static_argnums=(0, 1, 2, 3, 4, 5, 6), donate_argnums=(8,)
+    jax.jit, static_argnums=(0, 1, 2, 3, 4, 5, 6, 7),
+    donate_argnums=(9,)
 )
 def _scan_decode(model, n_steps, sampled, lp_k, pen, rep, seeded,
-                 params, cache, last, lens, temps, topks, topps, minps,
-                 pres, freqs, reps, counts, seen, seeds, seed_streams,
-                 seed_on, seed_base, adapter_ids, rng, draws0):
+                 biased, params, cache, last, lens, temps, topks,
+                 topps, minps, pres, freqs, reps, counts, seen, bias,
+                 seeds, seed_streams, seed_on, seed_base, adapter_ids,
+                 rng, draws0):
     """n_steps decode steps in one lax.scan.  The per-step sampling key
     is fold_in(rng, draws0 + i) — the same chain ``step`` consumes one
     link of per call, so scan and step-by-step emit identical streams.
@@ -344,6 +346,11 @@ def _scan_decode(model, n_steps, sampled, lp_k, pen, rep, seeded,
             adapter_ids=adapter_ids, mutable=["cache"],
         )
         lg = logits[:, -1, :]
+        if biased:
+            # per-request logit_bias (OpenAI semantics): a plain add
+            # before the pick; unbiased rows carry zeros, so their
+            # tokens are untouched whatever the neighbors request
+            lg = lg + bias
         if sampled:
             nxt = _pick_tokens(
                 lg, temps, topks, topps, minps, pres, freqs, reps,
@@ -353,6 +360,10 @@ def _scan_decode(model, n_steps, sampled, lp_k, pen, rep, seeded,
         else:
             nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
         if lp_k:
+            # logprob stats reflect logit_bias (OpenAI semantics: the
+            # reported distribution is the one the pick used) but stay
+            # independent of temperature/penalties, which evaluators
+            # score around; unbiased rows are value-identical either way
             out = (nxt,) + _top_logprobs(lg, nxt, lp_k)
         else:
             out = (nxt,)
@@ -515,6 +526,14 @@ class ServingEngine:
         # scopes it wider than presence/frequency), same lifecycle
         self._seen = jnp.zeros((n_slots, model.vocab), jnp.float32)
         self._zero_vocab_row = jnp.zeros((1, model.vocab), jnp.float32)
+        # per-request logit_bias rows (OpenAI's logit_bias): applied as
+        # a plain add before every pick; rows are zero unless the
+        # slot's admit supplied a bias, and a stale row is re-zeroed at
+        # the slot's next unbiased admit (host flag tracks staleness —
+        # unlike the penalty histograms there is no knob masking a
+        # stale row, the add is unconditional while any bias is live)
+        self._bias = jnp.zeros((n_slots, model.vocab), jnp.float32)
+        self._bias_on = [False] * n_slots
         # per-slot LoRA adapter ids (-1 = base model); only consulted
         # when the model was built with n_adapters > 0
         self.adapters = np.full(n_slots, -1, np.int32)
@@ -764,7 +783,8 @@ class ServingEngine:
               stop: Optional[List[int]] = None,
               ignore_eos: bool = False,
               logprobs: Optional[int] = None,
-              prompt_logprobs: Optional[int] = None) -> int:
+              prompt_logprobs: Optional[int] = None,
+              logit_bias: Optional[Dict[int, float]] = None) -> int:
         """Prefill *prompt* into a free slot; returns the slot id.
         Raises RuntimeError when the engine is full (callers queue).
         With ``prefix`` (a :meth:`register_prefix` handle), the prompt
@@ -841,6 +861,22 @@ class ServingEngine:
         # row max_len - 1, which this bound keeps out of the prompt
         # rows, so released-slot donor records stay valid K/V
         assert t_p <= self.model.max_len - 1
+        if logit_bias is not None:
+            if not isinstance(logit_bias, dict) or not logit_bias:
+                raise ValueError(
+                    "logit_bias must be a non-empty {token: bias} dict")
+            for bk, bv in logit_bias.items():
+                if isinstance(bk, bool) or not isinstance(
+                        bk, (int, np.integer)):
+                    raise ValueError(
+                        "logit_bias keys must be token ids")
+                if not 0 <= int(bk) < self.model.vocab:
+                    raise ValueError(
+                        f"logit_bias token {bk} outside "
+                        f"[0, vocab={self.model.vocab})")
+                if not np.isfinite(float(bv)):
+                    raise ValueError(
+                        "logit_bias values must be finite")
         free = self.free_slots()
         if not free:
             raise RuntimeError("no free slots")
@@ -964,6 +1000,22 @@ class ServingEngine:
         self.adapters[slot] = aid
         self._stops[slot] = stops
         self._ignore_eos[slot] = bool(ignore_eos)
+        if logit_bias:
+            bias_np = np.zeros(self.model.vocab, np.float32)
+            for bk, bv in logit_bias.items():
+                bias_np[int(bk)] = float(bv)
+            row_dev = jnp.asarray(bias_np)  # ONE host-to-device copy
+            self._bias = _set_count_row(
+                self._bias, jnp.int32(slot), row_dev)
+            self._bias_on[slot] = True
+            bias_row = row_dev[None, :]
+        else:
+            if self._bias_on[slot]:
+                # stale row from a previous biased occupant: there is
+                # no knob masking the add, so it must be zeroed
+                self._bias = _zero_count_row(self._bias, slot)
+                self._bias_on[slot] = False
+            bias_row = None
         self.seeds[slot] = np.uint32((seed or 0) & 0xFFFFFFFF)
         self._seed_streams[slot] = int(seed_stream)
         self._seed_on[slot] = 0 if seed is None else 1
@@ -982,7 +1034,9 @@ class ServingEngine:
         else:
             seen_row = self._zero_vocab_row
         first = int(self._sample(
-            last[None, :], np.asarray([temperature], np.float32),
+            (last[None, :] if bias_row is None
+             else last[None, :] + bias_row),
+            np.asarray([temperature], np.float32),
             np.asarray([top_k or 0], np.int32),
             np.asarray([top_p], np.float32),
             np.asarray([min_p], np.float32),
@@ -1006,7 +1060,9 @@ class ServingEngine:
             self._seen = _bump_one(self._seen, slot, first)
         if lp_n:
             clp, tlp, tid = _top_logprobs(
-                last[None, :], jnp.asarray([first], jnp.int32),
+                (last[None, :] if bias_row is None
+                 else last[None, :] + bias_row),
+                jnp.asarray([first], jnp.int32),
                 self.logprobs_k)
             self._record_logprobs(slot, float(np.asarray(clp)[0]),
                                   np.asarray(tlp)[0], np.asarray(tid)[0])
@@ -1021,6 +1077,13 @@ class ServingEngine:
         per-step histogram bumps so the common (unpenalized) engine
         does zero extra device work (knobs reset at finish)."""
         return bool(self.pres.any() or self.freqs.any())
+
+    def _bias_live(self) -> bool:
+        """Any ACTIVE slot with a logit_bias row — the gate for the
+        pre-pick add (retired slots' rows are zero or their outputs
+        discarded either way)."""
+        return any(self._bias_on[s] for s in range(self.n_slots)
+                   if self.active[s])
 
     def _rep_live(self) -> bool:
         return bool((self.reps != 1.0).any())
@@ -1105,7 +1168,10 @@ class ServingEngine:
         self._steps += 1
         sidx = np.asarray(self._slot_draws, np.int32)
         draws_before = self._draws
-        nxt = self._sample(logits[:, -1, :], self.temps, self.topks,
+        lg = logits[:, -1, :]
+        if self._bias_live():
+            lg = lg + self._bias
+        nxt = self._sample(lg, self.temps, self.topks,
                            self.topps, self.minps, self.pres,
                            self.freqs, self.reps, self._counts,
                            self._seen, self.seeds, self._seed_streams,
@@ -1121,8 +1187,10 @@ class ServingEngine:
         if self.logprobs_k and any(
                 self._lp_want[s] for s in range(self.n_slots)
                 if self.active[s]):
+            # lg carries the bias when live (OpenAI semantics: the
+            # reported distribution is the one the pick used)
             clp, tlp, tid = _top_logprobs(
-                logits[:, -1, :], jnp.asarray(nxt), self.logprobs_k)
+                lg, jnp.asarray(nxt), self.logprobs_k)
             self._harvest_logprobs(
                 np.asarray(clp), np.asarray(tlp), np.asarray(tid))
         out = {}
@@ -1227,6 +1295,12 @@ class ServingEngine:
         logits, self.cache = extend_step(
             self.model, self.params, self.cache, verify, positions,
             aids)
+        if self._bias_live():
+            # logit_bias composes with greedy spec: the verify rule is
+            # the SAME biased argmax plain decoding uses, so tokens
+            # stay bit-identical (the draft proposes unbiased, which
+            # only costs accept rate)
+            logits = logits + self._bias[:, None, :]
         tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, g+1]
         # ONE batched transfer (per-array np.asarray would serialize
         # two blocking round-trips on the hot path this feature exists
@@ -1352,14 +1426,16 @@ class ServingEngine:
             if self.active[s]) else 0
         aids = (jnp.asarray(self.adapters)
                 if self.model.n_adapters > 0 else None)
+        biased = self._bias_live()
         ys, self.cache, self._counts, self._seen = _scan_decode(
             self.model, n_steps, sampled, lp_k, pen, rep, seeded,
-            self.params, self.cache,
+            biased, self.params, self.cache,
             jnp.asarray(self.last_token), jnp.asarray(self.lens, jnp.int32),
             jnp.asarray(self.temps), jnp.asarray(self.topks),
             jnp.asarray(self.topps), jnp.asarray(self.minps),
             jnp.asarray(self.pres), jnp.asarray(self.freqs),
             jnp.asarray(self.reps), self._counts, self._seen,
+            self._bias,
             jnp.asarray(self.seeds), jnp.asarray(self._seed_streams),
             jnp.asarray(self._seed_on),
             jnp.asarray(self._slot_draws, jnp.int32), aids,
