@@ -152,3 +152,108 @@ def test_logit_bias_over_http(setup):
         assert ev["tokens"] == [42] * 4
     finally:
         srv.stop()
+
+
+# -- min_tokens (vLLM): eos/stop floor -----------------------------------
+
+def test_min_tokens_defers_forced_eos(setup):
+    """+1000 bias makes eos win every pick; min_tokens must suppress
+    it for exactly the floor, then let it fire with reason 'eos'."""
+    model, params = setup
+    eos = 33
+    eng = ServingEngine(model, params, n_slots=1, eos_id=eos)
+    s = eng.admit([5, 17, 3], logit_bias={eos: 1000.0}, min_tokens=3)
+    eng.run(8)
+    out = eng.output(s)
+    assert len(out) == 4
+    assert eos not in out[:3] and out[3] == eos
+    assert eng.finish_reason(s) == "eos"
+
+
+def test_min_tokens_defers_stop_ids_too(setup):
+    model, params = setup
+    t = 44
+    eng = ServingEngine(model, params, n_slots=1)
+    s = eng.admit([5, 17, 3], logit_bias={t: 1000.0}, stop=[t],
+                  min_tokens=2)
+    eng.run(6)
+    out = eng.output(s)
+    assert t not in out[:2] and out[2] == t
+    assert eng.finish_reason(s) == "stop"
+
+
+def test_min_tokens_scan_step_spec_agree(setup):
+    model, params = setup
+    draft = make_decoder(**DRAFT_CFG, max_len=64, dtype=jnp.float32)
+    dparams = _init(draft, 1)
+    eos = 33
+
+    def mk(**kw):
+        e = ServingEngine(model, params, n_slots=1, eos_id=eos,
+                          max_new_tokens=8, **kw)
+        return e, e.admit([5, 17, 3], logit_bias={eos: 1000.0},
+                          min_tokens=5)
+
+    a, sa = mk()
+    for _ in range(10):
+        a.step()
+    b, sb = mk()
+    b.run_scan(3)   # crossing happens MID-window on the next scan
+    b.run_scan(5)
+    c, sc = mk(draft=(draft, dparams), gamma=3)
+    c.run_spec(10)
+    assert a.output(sa) == b.output(sb) == c.output(sc)
+    assert a.output(sa)[5] == eos
+
+
+def test_min_tokens_zero_is_noop(setup):
+    model, params = setup
+    a = ServingEngine(model, params, n_slots=1, max_new_tokens=5)
+    sa = a.admit([3, 14, 15])
+    a.run(7)
+    b = ServingEngine(model, params, n_slots=1, max_new_tokens=5)
+    sb = b.admit([3, 14, 15], min_tokens=0)
+    b.run(7)
+    assert a.output(sa) == b.output(sb)
+
+
+def test_min_tokens_validation(setup):
+    model, params = setup
+    eng = ServingEngine(model, params, n_slots=1, max_new_tokens=4)
+    with pytest.raises(ValueError, match="min_tokens"):
+        eng.admit([1, 2], min_tokens=-1)
+    with pytest.raises(ValueError, match="exceeds"):
+        eng.admit([1, 2], min_tokens=9)
+
+
+def test_min_tokens_over_http(setup):
+    from tpu_k8s_device_plugin.workloads.server import EngineServer
+    import http.client
+    import json
+
+    model, params = setup
+    eng = ServingEngine(model, params, n_slots=1, eos_id=33)
+    srv = EngineServer(eng, max_new_tokens=6, window=2)
+    srv.start(host="127.0.0.1", port=0)
+    try:
+        c = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                       timeout=120)
+        c.request("POST", "/generate", json.dumps(
+            {"tokens": [5, 17, 3], "stream": False,
+             "logit_bias": {"33": 1000.0}, "min_tokens": 3}),
+            {"Content-Type": "application/json"})
+        r = c.getresponse()
+        ev = json.loads(r.read().decode().strip().splitlines()[0])
+        c.close()
+        assert len(ev["tokens"]) == 4 and ev["tokens"][3] == 33
+        assert ev["finish_reason"] == "eos"
+        # min > max is a 400, as in vLLM
+        c = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                       timeout=60)
+        c.request("POST", "/generate", json.dumps(
+            {"tokens": [1, 2], "max_new_tokens": 2, "min_tokens": 5}),
+            {"Content-Type": "application/json"})
+        assert c.getresponse().status == 400
+        c.close()
+    finally:
+        srv.stop()

@@ -807,3 +807,23 @@ def test_openai_chat_logprobs_boolean(setup):
         assert all("logprob" in r and "token" in r for r in recs)
     finally:
         srv.stop()
+
+
+def test_min_tokens_floors_stop_strings(text_server):
+    """vLLM semantics: no stop check below the min_tokens floor —
+    a stop string completing early must not end the request there."""
+    srv, model, params = text_server
+    tok = _ByteTok()
+    full = _solo(model, params, tok.encode("ab"), 8)
+    text = tok.decode(full)
+    stop = text[1:3]  # completes at token 3 (< the floor)
+    status, events = _post(
+        srv.port, {"prompt": "ab", "stop": [stop], "stream": False,
+                   "min_tokens": 6})
+    assert status == 200
+    ev = events[0]
+    assert len(ev["tokens"]) >= 6
+    # without the floor the same request stops early
+    status, events = _post(
+        srv.port, {"prompt": "ab", "stop": [stop], "stream": False})
+    assert len(events[0]["tokens"]) < 6

@@ -257,6 +257,7 @@ class _Request:
     stop_scanned: dict = field(default_factory=dict)  # idx -> resume t
     openai_logprobs: Optional[int] = None  # client-requested count
     logit_bias: Optional[dict] = None      # {token id: bias}
+    min_tokens: int = 0                    # eos/stop floor (vLLM)
 
 
 class EngineServer:
@@ -373,7 +374,8 @@ class EngineServer:
                     # (copies 1..n-1 keep their APC tail-only prefill)
                     prompt_logprobs=(req.prompt_logprobs
                                      if req.admitted == 0 else None),
-                    logit_bias=req.logit_bias)
+                    logit_bias=req.logit_bias,
+                    min_tokens=req.min_tokens)
             except (ValueError, RuntimeError) as e:
                 # identical args per copy, so only the FIRST admit can
                 # fail on validation (the free-slot guard rules out
@@ -403,9 +405,14 @@ class EngineServer:
         new = tokens[seen:req.max_new_tokens]
         stop_text = None  # truncated text when a stop string matched
         if req.stop_strs and new:
+            # min_tokens floors stop strings too (vLLM: no stop check
+            # below the floor): starting the scan past the floor means
+            # a match can only complete at token min_tokens+1 or later
             keep, text = _truncate_at_stop(
                 self.tokenizer, tokens[:seen + len(new)],
-                req.stop_strs, start=req.stop_scanned.get(idx, 1))
+                req.stop_strs,
+                start=max(req.stop_scanned.get(idx, 1),
+                          req.min_tokens + 1))
             if keep is not None:
                 new = tokens[seen:keep] if keep > seen else []
                 stop_text = text
@@ -920,6 +927,8 @@ class EngineServer:
             native["stop"] = [stop] if isinstance(stop, str) else stop
         if opt("logit_bias") is not None:
             native["logit_bias"] = opt("logit_bias")
+        if opt("min_tokens") is not None:  # vLLM's OpenAI extension
+            native["min_tokens"] = int(opt("min_tokens"))
         return native, str(opt("model", "default"))
 
     def _openai_chat_to_native(self, body: dict):
@@ -990,6 +999,13 @@ class EngineServer:
         max_new = int(body.get("max_new_tokens", self.default_max_new))
         if max_new < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        min_new = int(body.get("min_tokens", 0))
+        if min_new < 0:
+            raise ValueError("min_tokens must be >= 0")
+        if min_new > max_new:
+            raise ValueError(
+                f"min_tokens {min_new} exceeds max_new_tokens "
+                f"{max_new}")
         top_k = body.get("top_k")
         adapter = body.get("adapter")
         logprobs = body.get("logprobs")
@@ -1049,6 +1065,7 @@ class EngineServer:
             stop_strs=stop_strs,
             detokenize=detokenize,
             logit_bias=logit_bias,
+            min_tokens=min_new,
             ignore_eos=bool(body.get("ignore_eos", False)),
             seed=(None if body.get("seed") is None
                   else int(body["seed"])),

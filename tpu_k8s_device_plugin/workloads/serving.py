@@ -322,12 +322,13 @@ def _top_logprobs(logits, chosen, k):
 
 
 @functools.partial(
-    jax.jit, static_argnums=(0, 1, 2, 3, 4, 5, 6, 7),
-    donate_argnums=(9,)
+    jax.jit, static_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8),
+    donate_argnums=(10,)
 )
 def _scan_decode(model, n_steps, sampled, lp_k, pen, rep, seeded,
-                 biased, params, cache, last, lens, temps, topks,
-                 topps, minps, pres, freqs, reps, counts, seen, bias,
+                 biased, minned, params, cache, last, lens, temps,
+                 topks, topps, minps, pres, freqs, reps, counts, seen,
+                 bias, min_mask, min_toks, emitted0,
                  seeds, seed_streams, seed_on, seed_base, adapter_ids,
                  rng, draws0):
     """n_steps decode steps in one lax.scan.  The per-step sampling key
@@ -351,6 +352,14 @@ def _scan_decode(model, n_steps, sampled, lp_k, pen, rep, seeded,
             # before the pick; unbiased rows carry zeros, so their
             # tokens are untouched whatever the neighbors request
             lg = lg + bias
+        if minned:
+            # min_tokens floor: eos/stop ids masked while the slot's
+            # emitted count (pre-window + step index) is below it —
+            # the gate is per-step data, so a mid-window crossing
+            # lifts the mask exactly where step-by-step decoding would
+            gate = ((emitted0 + i) < min_toks).astype(
+                lg.dtype)[:, None]
+            lg = lg + min_mask * gate
         if sampled:
             nxt = _pick_tokens(
                 lg, temps, topks, topps, minps, pres, freqs, reps,
@@ -534,6 +543,14 @@ class ServingEngine:
         # stale row, the add is unconditional while any bias is live)
         self._bias = jnp.zeros((n_slots, model.vocab), jnp.float32)
         self._bias_on = [False] * n_slots
+        # min_tokens (vLLM): a -1e9 mask over eos + the request's stop
+        # ids, applied while the slot has emitted fewer than min_toks
+        # tokens — the gate is computed from per-slot counters inside
+        # every pick, so step, run_scan (mid-window crossings included),
+        # and spec rounds stay token-identical.  A stale row is
+        # harmless: min_toks resets to 0 at every admit, gating it off.
+        self._min_mask = jnp.zeros((n_slots, model.vocab), jnp.float32)
+        self.min_toks = np.zeros(n_slots, np.int32)
         # per-slot LoRA adapter ids (-1 = base model); only consulted
         # when the model was built with n_adapters > 0
         self.adapters = np.full(n_slots, -1, np.int32)
@@ -784,7 +801,8 @@ class ServingEngine:
               ignore_eos: bool = False,
               logprobs: Optional[int] = None,
               prompt_logprobs: Optional[int] = None,
-              logit_bias: Optional[Dict[int, float]] = None) -> int:
+              logit_bias: Optional[Dict[int, float]] = None,
+              min_tokens: int = 0) -> int:
         """Prefill *prompt* into a free slot; returns the slot id.
         Raises RuntimeError when the engine is full (callers queue).
         With ``prefix`` (a :meth:`register_prefix` handle), the prompt
@@ -861,6 +879,13 @@ class ServingEngine:
         # row max_len - 1, which this bound keeps out of the prompt
         # rows, so released-slot donor records stay valid K/V
         assert t_p <= self.model.max_len - 1
+        if min_tokens < 0:
+            raise ValueError("min_tokens must be >= 0")
+        if (min_tokens and self.max_new_tokens is not None
+                and min_tokens > self.max_new_tokens):
+            raise ValueError(
+                f"min_tokens {min_tokens} exceeds the engine budget "
+                f"{self.max_new_tokens}")
         if logit_bias is not None:
             if not isinstance(logit_bias, dict) or not logit_bias:
                 raise ValueError(
@@ -1016,6 +1041,18 @@ class ServingEngine:
                 self._bias = _zero_count_row(self._bias, slot)
                 self._bias_on[slot] = False
             bias_row = None
+        self.min_toks[slot] = min_tokens
+        min_row = None
+        if min_tokens:
+            mask_np = np.zeros(self.model.vocab, np.float32)
+            if self.eos_id is not None:
+                mask_np[self.eos_id] = -1e9
+            for t in stops:
+                mask_np[t] = -1e9
+            row_dev = jnp.asarray(mask_np)
+            self._min_mask = _set_count_row(
+                self._min_mask, jnp.int32(slot), row_dev)
+            min_row = row_dev[None, :]  # first pick has 0 emitted
         self.seeds[slot] = np.uint32((seed or 0) & 0xFFFFFFFF)
         self._seed_streams[slot] = int(seed_stream)
         self._seed_on[slot] = 0 if seed is None else 1
@@ -1033,9 +1070,13 @@ class ServingEngine:
             ).astype(np.float32))[None, :]
         else:
             seen_row = self._zero_vocab_row
+        first_lg = last[None, :]
+        if bias_row is not None:
+            first_lg = first_lg + bias_row
+        if min_row is not None:
+            first_lg = first_lg + min_row
         first = int(self._sample(
-            (last[None, :] if bias_row is None
-             else last[None, :] + bias_row),
+            first_lg,
             np.asarray([temperature], np.float32),
             np.asarray([top_k or 0], np.int32),
             np.asarray([top_p], np.float32),
@@ -1060,9 +1101,7 @@ class ServingEngine:
             self._seen = _bump_one(self._seen, slot, first)
         if lp_n:
             clp, tlp, tid = _top_logprobs(
-                (last[None, :] if bias_row is None
-                 else last[None, :] + bias_row),
-                jnp.asarray([first], jnp.int32),
+                first_lg, jnp.asarray([first], jnp.int32),
                 self.logprobs_k)
             self._record_logprobs(slot, float(np.asarray(clp)[0]),
                                   np.asarray(tlp)[0], np.asarray(tid)[0])
@@ -1084,6 +1123,19 @@ class ServingEngine:
         discarded either way)."""
         return any(self._bias_on[s] for s in range(self.n_slots)
                    if self.active[s])
+
+    def _min_live(self) -> bool:
+        """Any ACTIVE slot still below its min_tokens floor."""
+        return any(
+            self.active[s]
+            and len(self.outputs[s]) < int(self.min_toks[s])
+            for s in range(self.n_slots))
+
+    def _min_need(self) -> np.ndarray:
+        """[S] float gate: 1 while the slot is below its floor."""
+        return np.asarray(
+            [float(len(self.outputs[s]) < int(self.min_toks[s]))
+             for s in range(self.n_slots)], np.float32)
 
     def _rep_live(self) -> bool:
         return bool((self.reps != 1.0).any())
@@ -1171,6 +1223,9 @@ class ServingEngine:
         lg = logits[:, -1, :]
         if self._bias_live():
             lg = lg + self._bias
+        if self._min_live():
+            lg = lg + self._min_mask * jnp.asarray(
+                self._min_need())[:, None]
         nxt = self._sample(lg, self.temps, self.topks,
                            self.topps, self.minps, self.pres,
                            self.freqs, self.reps, self._counts,
@@ -1301,6 +1356,18 @@ class ServingEngine:
             # stay bit-identical (the draft proposes unbiased, which
             # only costs accept rate)
             logits = logits + self._bias[:, None, :]
+        if self._min_live():
+            # min_tokens: verify position j emits output token
+            # (emitted + j), so the eos/stop mask lifts per position
+            # exactly where plain decoding would
+            emitted = jnp.asarray(
+                [len(self.outputs[s]) for s in range(self.n_slots)],
+                jnp.int32)
+            gate = ((emitted[:, None]
+                     + jnp.arange(g + 1, dtype=jnp.int32)[None, :])
+                    < jnp.asarray(self.min_toks)[:, None]
+                    ).astype(logits.dtype)
+            logits = logits + self._min_mask[:, None, :] * gate[:, :, None]
         tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, g+1]
         # ONE batched transfer (per-array np.asarray would serialize
         # two blocking round-trips on the hot path this feature exists
@@ -1427,15 +1494,18 @@ class ServingEngine:
         aids = (jnp.asarray(self.adapters)
                 if self.model.n_adapters > 0 else None)
         biased = self._bias_live()
+        minned = self._min_live()
         ys, self.cache, self._counts, self._seen = _scan_decode(
             self.model, n_steps, sampled, lp_k, pen, rep, seeded,
-            biased, self.params, self.cache,
+            biased, minned, self.params, self.cache,
             jnp.asarray(self.last_token), jnp.asarray(self.lens, jnp.int32),
             jnp.asarray(self.temps), jnp.asarray(self.topks),
             jnp.asarray(self.topps), jnp.asarray(self.minps),
             jnp.asarray(self.pres), jnp.asarray(self.freqs),
             jnp.asarray(self.reps), self._counts, self._seen,
-            self._bias,
+            self._bias, self._min_mask, jnp.asarray(self.min_toks),
+            jnp.asarray([len(self.outputs[s])
+                         for s in range(self.n_slots)], jnp.int32),
             jnp.asarray(self.seeds), jnp.asarray(self._seed_streams),
             jnp.asarray(self._seed_on),
             jnp.asarray(self._slot_draws, jnp.int32), aids,
