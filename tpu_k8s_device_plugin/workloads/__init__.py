@@ -29,6 +29,7 @@ from . import llama
 from .moe import MoEFFN, top_k_routing
 from .pool import max_pool as pallas_max_pool
 from .server import EngineServer
+from .grammar import TokenDfa, regex_to_dfa, token_dfa
 from .serving import ServingEngine
 from .speculative import speculative_generate
 from .parallel import make_mesh, make_sharded_train_step
@@ -59,6 +60,9 @@ __all__ = [
     "quantize_lm_params_int4",
     "sample_generate",
     "ServingEngine",
+    "TokenDfa",
+    "regex_to_dfa",
+    "token_dfa",
     "attach_lora",
     "checkpoint",
     "llama",

@@ -1,0 +1,301 @@
+"""Grammar-constrained decoding support (vLLM's guided decoding).
+
+The TPU-shaped design: a grammar is compiled AHEAD of decoding into a
+token-level DFA — ``table[state, token] -> next state`` (-1 rejects)
+and a ``mask[state, token]`` additive logit mask (0 / -1e9) — and the
+DFA state rides the decode scan's carry.  Constrained generation then
+costs one gather and one add per step inside the SAME compiled
+``lax.scan`` as unconstrained decoding: no per-token host round-trip,
+no Python in the loop (the xgrammar/outlines token-bitmask idea,
+expressed as jit-friendly arrays).
+
+Pipeline:
+
+1. ``regex_to_dfa(pattern)`` — a small regex subset (literals, ``|``,
+   ``*`` ``+`` ``?``, ``(...)``, ``[a-z]`` classes, ``.``) compiled
+   via Thompson NFA + subset construction over the byte alphabet.
+2. ``token_dfa(dfa, token_bytes, eos_id)`` — the char DFA is closed
+   over the tokenizer's vocabulary: walking each token's bytes from
+   each state yields the token-level table; ``eos`` is allowed exactly
+   in ACCEPTING states (structural completion gates the stop).
+
+Engines take the result as ``ServingEngine(grammar=...)`` and requests
+opt in with ``admit(grammar=True)``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, List, Tuple
+
+import numpy as np
+
+_REJECT = -1
+
+
+# -- char-level regex -> DFA -------------------------------------------------
+
+@dataclass(frozen=True)
+class CharDfa:
+    """Byte-alphabet DFA: table [n_states, 256] int32 (-1 = reject),
+    state 0 initial, ``accepting`` a bool per state."""
+
+    table: np.ndarray
+    accepting: np.ndarray
+
+
+class _Nfa:
+    """Thompson construction: states are ints, transitions are
+    (state, byte) -> set[state], plus epsilon edges."""
+
+    def __init__(self):
+        self.eps: Dict[int, set] = {}
+        self.edges: Dict[Tuple[int, int], set] = {}
+        self.n = 0
+
+    def new(self) -> int:
+        s = self.n
+        self.n += 1
+        return s
+
+    def add_eps(self, a: int, b: int) -> None:
+        self.eps.setdefault(a, set()).add(b)
+
+    def add(self, a: int, byte: int, b: int) -> None:
+        self.edges.setdefault((a, byte), set()).add(b)
+
+
+def _parse(pattern: str):
+    """Recursive-descent parse into an AST of
+    ('lit', bytes) | ('class', frozenset) | ('cat', [..]) |
+    ('alt', [..]) | ('star'|'plus'|'opt', node)."""
+    pos = 0
+
+    def error(msg):
+        raise ValueError(f"regex error at {pos}: {msg} in {pattern!r}")
+
+    def parse_alt():
+        nonlocal pos
+        branches = [parse_cat()]
+        while pos < len(pattern) and pattern[pos] == "|":
+            pos += 1
+            branches.append(parse_cat())
+        return branches[0] if len(branches) == 1 else ("alt", branches)
+
+    def parse_cat():
+        nonlocal pos
+        items = []
+        while pos < len(pattern) and pattern[pos] not in "|)":
+            items.append(parse_repeat())
+        return ("cat", items)
+
+    def parse_repeat():
+        nonlocal pos
+        atom = parse_atom()
+        while pos < len(pattern) and pattern[pos] in "*+?":
+            op = {"*": "star", "+": "plus", "?": "opt"}[pattern[pos]]
+            pos += 1
+            atom = (op, atom)
+        return atom
+
+    def parse_atom():
+        nonlocal pos
+        c = pattern[pos]
+        if c == "(":
+            pos += 1
+            inner = parse_alt()
+            if pos >= len(pattern) or pattern[pos] != ")":
+                error("unclosed group")
+            pos += 1
+            return inner
+        if c == "[":
+            pos += 1
+            negate = pos < len(pattern) and pattern[pos] == "^"
+            if negate:
+                pos += 1
+            chars = set()
+            while pos < len(pattern) and pattern[pos] != "]":
+                ch = pattern[pos]
+                if ch == "\\":
+                    pos += 1
+                    ch = pattern[pos]
+                if (pos + 2 < len(pattern) and pattern[pos + 1] == "-"
+                        and pattern[pos + 2] != "]"):
+                    lo, hi = ord(ch), ord(pattern[pos + 2])
+                    chars.update(range(lo, hi + 1))
+                    pos += 3
+                else:
+                    chars.add(ord(ch))
+                    pos += 1
+            if pos >= len(pattern):
+                error("unclosed class")
+            pos += 1
+            if negate:
+                chars = set(range(256)) - chars
+            return ("class", frozenset(chars))
+        if c == ".":
+            pos += 1
+            return ("class", frozenset(range(256)))
+        if c == "\\":
+            pos += 1
+            if pos >= len(pattern):
+                error("trailing backslash")
+            ch = pattern[pos]
+            pos += 1
+            table = {"n": 10, "t": 9, "r": 13, "d": None, "s": None}
+            if ch == "d":
+                return ("class", frozenset(range(48, 58)))
+            if ch == "s":
+                return ("class", frozenset({9, 10, 13, 32}))
+            return ("lit", bytes([table.get(ch) or ord(ch)]))
+        if c in "*+?|)":
+            error(f"unexpected {c!r}")
+        pos += 1
+        return ("lit", c.encode("utf-8"))
+
+    ast = parse_alt()
+    if pos != len(pattern):
+        error("trailing input")
+    return ast
+
+
+def _build_nfa(node, nfa: _Nfa) -> Tuple[int, int]:
+    """Returns (entry, exit) state pair for *node*."""
+    kind = node[0]
+    if kind == "lit":
+        prev = nfa.new()
+        entry = prev
+        for b in node[1]:
+            nxt = nfa.new()
+            nfa.add(prev, b, nxt)
+            prev = nxt
+        return entry, prev
+    if kind == "class":
+        a, b = nfa.new(), nfa.new()
+        for byte in node[1]:
+            nfa.add(a, byte, b)
+        return a, b
+    if kind == "cat":
+        if not node[1]:
+            s = nfa.new()
+            return s, s
+        entry, cur = _build_nfa(node[1][0], nfa)
+        for item in node[1][1:]:
+            a, b = _build_nfa(item, nfa)
+            nfa.add_eps(cur, a)
+            cur = b
+        return entry, cur
+    if kind == "alt":
+        entry, exit_ = nfa.new(), nfa.new()
+        for br in node[1]:
+            a, b = _build_nfa(br, nfa)
+            nfa.add_eps(entry, a)
+            nfa.add_eps(b, exit_)
+        return entry, exit_
+    if kind in ("star", "plus", "opt"):
+        a, b = _build_nfa(node[1], nfa)
+        entry, exit_ = nfa.new(), nfa.new()
+        nfa.add_eps(entry, a)
+        nfa.add_eps(b, exit_)
+        if kind in ("star", "opt"):
+            nfa.add_eps(entry, exit_)
+        if kind in ("star", "plus"):
+            nfa.add_eps(b, a)
+        return entry, exit_
+    raise AssertionError(kind)
+
+
+def regex_to_dfa(pattern: str) -> CharDfa:
+    """Compile the regex subset into a byte-alphabet DFA (full-match
+    semantics: accepting states mean the WHOLE input so far matches)."""
+    nfa = _Nfa()
+    entry, exit_ = _build_nfa(_parse(pattern), nfa)
+
+    def closure(states: FrozenSet[int]) -> FrozenSet[int]:
+        out = set(states)
+        work = list(states)
+        while work:
+            s = work.pop()
+            for t in nfa.eps.get(s, ()):
+                if t not in out:
+                    out.add(t)
+                    work.append(t)
+        return frozenset(out)
+
+    start = closure(frozenset({entry}))
+    ids: Dict[FrozenSet[int], int] = {start: 0}
+    rows: List[np.ndarray] = []
+    accepting: List[bool] = []
+    work = [start]
+    while work:
+        cur = work.pop()
+        i = ids[cur]
+        while len(rows) <= i:
+            rows.append(np.full(256, _REJECT, np.int32))
+            accepting.append(False)
+        accepting[i] = exit_ in cur
+        row = rows[i]
+        for byte in range(256):
+            tgt = set()
+            for s in cur:
+                tgt.update(nfa.edges.get((s, byte), ()))
+            if not tgt:
+                continue
+            nxt = closure(frozenset(tgt))
+            if nxt not in ids:
+                ids[nxt] = len(ids)
+                work.append(nxt)
+            row[byte] = ids[nxt]
+    table = np.stack([rows[i] for i in range(len(ids))])
+    acc = np.asarray([accepting[i] for i in range(len(ids))], bool)
+    return CharDfa(table=table, accepting=acc)
+
+
+# -- char DFA -> token DFA ---------------------------------------------------
+
+@dataclass(frozen=True)
+class TokenDfa:
+    """Token-level automaton for an engine: ``table [N, V]`` int32
+    next-state (-1 = token rejected in that state), ``mask [N, V]``
+    float32 additive logit mask (0 allowed / -1e9 rejected), start
+    state 0.  ``eos`` is allowed exactly in accepting states."""
+
+    table: np.ndarray
+    mask: np.ndarray
+    start: int = 0
+
+
+def token_dfa(dfa: CharDfa, token_bytes: List[bytes],
+              eos_id: int) -> TokenDfa:
+    """Close the char DFA over the vocabulary: token t from state s
+    lands where walking t's bytes lands (or rejects).  Tokens mapping
+    to b"" (special ids) are rejected everywhere except ``eos``, which
+    is allowed exactly in accepting states."""
+    n_states = len(dfa.table)
+    V = len(token_bytes)
+    table = np.full((n_states, V), _REJECT, np.int32)
+    for t, bs in enumerate(token_bytes):
+        if t == eos_id or not bs:
+            continue
+        for s in range(n_states):
+            cur = s
+            for b in bs:
+                cur = int(dfa.table[cur, b])
+                if cur == _REJECT:
+                    break
+            if cur != _REJECT:
+                table[s, t] = cur
+    mask = np.where(table >= 0, 0.0, -1e9).astype(np.float32)
+    if 0 <= eos_id < V:
+        for s in np.flatnonzero(dfa.accepting):
+            mask[s, eos_id] = 0.0
+            table[s, eos_id] = s  # self-loop; generation retires at eos
+    # dead-end guard: a reachable state where nothing (incl. eos) is
+    # allowed would force garbage tokens through the mask
+    dead = (mask <= -1e9 / 2).all(axis=1)
+    if dead.any():
+        raise ValueError(
+            f"grammar has dead-end states {np.flatnonzero(dead).tolist()}"
+            " (no token or eos allowed); widen the pattern or the "
+            "vocabulary")
+    return TokenDfa(table=table, mask=mask, start=0)

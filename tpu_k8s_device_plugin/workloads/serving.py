@@ -322,13 +322,14 @@ def _top_logprobs(logits, chosen, k):
 
 
 @functools.partial(
-    jax.jit, static_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8),
-    donate_argnums=(10,)
+    jax.jit, static_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8, 9),
+    donate_argnums=(11,)
 )
 def _scan_decode(model, n_steps, sampled, lp_k, pen, rep, seeded,
-                 biased, minned, params, cache, last, lens, temps,
-                 topks, topps, minps, pres, freqs, reps, counts, seen,
-                 bias, min_mask, min_toks, emitted0,
+                 biased, minned, grammared, params, cache, last, lens,
+                 temps, topks, topps, minps, pres, freqs, reps, counts,
+                 seen, bias, min_mask, min_toks, emitted0,
+                 gmask, gtable, gstate0,
                  seeds, seed_streams, seed_on, seed_base, adapter_ids,
                  rng, draws0):
     """n_steps decode steps in one lax.scan.  The per-step sampling key
@@ -340,7 +341,7 @@ def _scan_decode(model, n_steps, sampled, lp_k, pen, rep, seeded,
     the STATIC flags — a handful engine-wide, never per request)."""
 
     def step_fn(carry, i):
-        cache, tok, pos, cnt, sn = carry
+        cache, tok, pos, cnt, sn, gs = carry
         logits, mut = model.apply(
             {"params": params, "cache": cache},
             tok[:, None], pos[:, None], decode=True,
@@ -360,6 +361,12 @@ def _scan_decode(model, n_steps, sampled, lp_k, pen, rep, seeded,
             gate = ((emitted0 + i) < min_toks).astype(
                 lg.dtype)[:, None]
             lg = lg + min_mask * gate
+        if grammared:
+            # grammar state rides the carry: one gather for this
+            # step's allowed-token mask, one gather to advance after
+            # the pick — constrained decoding without leaving the scan
+            gon = (gs >= 0).astype(lg.dtype)[:, None]
+            lg = lg + gmask[jnp.maximum(gs, 0)] * gon
         if sampled:
             nxt = _pick_tokens(
                 lg, temps, topks, topps, minps, pres, freqs, reps,
@@ -382,10 +389,14 @@ def _scan_decode(model, n_steps, sampled, lp_k, pen, rep, seeded,
             cnt = cnt.at[jnp.arange(cnt.shape[0]), nxt].add(1.0)
         if rep:
             sn = sn.at[jnp.arange(sn.shape[0]), nxt].add(1.0)
-        return (mut["cache"], nxt, pos + 1, cnt, sn), out
+        if grammared:
+            gs = jnp.where(
+                gs >= 0, gtable[jnp.maximum(gs, 0), nxt], gs)
+        return (mut["cache"], nxt, pos + 1, cnt, sn, gs), out
 
-    (cache, _, _, counts, seen), ys = lax.scan(
-        step_fn, (cache, last, lens, counts, seen), jnp.arange(n_steps)
+    (cache, _, _, counts, seen, _), ys = lax.scan(
+        step_fn, (cache, last, lens, counts, seen, gstate0),
+        jnp.arange(n_steps)
     )
     return ys, cache, counts, seen
 
@@ -415,6 +426,7 @@ class ServingEngine:
         draft=None,
         gamma: int = 4,
         ngram_n: int = 3,
+        grammar=None,
     ):
         if n_slots < 1:
             raise ValueError("n_slots must be >= 1")
@@ -551,6 +563,24 @@ class ServingEngine:
         # harmless: min_toks resets to 0 at every admit, gating it off.
         self._min_mask = jnp.zeros((n_slots, model.vocab), jnp.float32)
         self.min_toks = np.zeros(n_slots, np.int32)
+        # grammar-constrained decoding (vLLM's guided decoding, the
+        # TPU way): ONE engine-wide token-level DFA (grammar.TokenDfa —
+        # mask [N, V] and table [N, V]) whose per-slot state rides the
+        # decode scan's carry; requests opt in with admit(grammar=True)
+        # and pay one gather + one add per step, inside the same
+        # compiled step as everyone else.  gstate -1 = unconstrained.
+        self._grammar = None
+        self.gstate = np.full(n_slots, -1, np.int32)
+        if grammar is not None:
+            if grammar.table.shape[1] != model.vocab:
+                raise ValueError(
+                    f"grammar vocab {grammar.table.shape[1]} != model "
+                    f"vocab {model.vocab}")
+            self._grammar = grammar
+            self._gtable_np = np.asarray(grammar.table, np.int32)
+            self._gmask = jnp.asarray(grammar.mask, jnp.float32)
+            self._gtable = jnp.asarray(self._gtable_np)
+            self._gstart = int(grammar.start)
         # per-slot LoRA adapter ids (-1 = base model); only consulted
         # when the model was built with n_adapters > 0
         self.adapters = np.full(n_slots, -1, np.int32)
@@ -802,7 +832,8 @@ class ServingEngine:
               logprobs: Optional[int] = None,
               prompt_logprobs: Optional[int] = None,
               logit_bias: Optional[Dict[int, float]] = None,
-              min_tokens: int = 0) -> int:
+              min_tokens: int = 0,
+              grammar: bool = False) -> int:
         """Prefill *prompt* into a free slot; returns the slot id.
         Raises RuntimeError when the engine is full (callers queue).
         With ``prefix`` (a :meth:`register_prefix` handle), the prompt
@@ -879,6 +910,10 @@ class ServingEngine:
         # row max_len - 1, which this bound keeps out of the prompt
         # rows, so released-slot donor records stay valid K/V
         assert t_p <= self.model.max_len - 1
+        if grammar and self._grammar is None:
+            raise ValueError(
+                "engine was built without a grammar "
+                "(ServingEngine(..., grammar=TokenDfa))")
         if min_tokens < 0:
             raise ValueError("min_tokens must be >= 0")
         if (min_tokens and self.max_new_tokens is not None
@@ -1041,6 +1076,7 @@ class ServingEngine:
                 self._bias = _zero_count_row(self._bias, slot)
                 self._bias_on[slot] = False
             bias_row = None
+        self.gstate[slot] = self._gstart if grammar else -1
         self.min_toks[slot] = min_tokens
         min_row = None
         if min_tokens:
@@ -1075,6 +1111,8 @@ class ServingEngine:
             first_lg = first_lg + bias_row
         if min_row is not None:
             first_lg = first_lg + min_row
+        if grammar:
+            first_lg = first_lg + self._gmask[self._gstart][None, :]
         first = int(self._sample(
             first_lg,
             np.asarray([temperature], np.float32),
@@ -1105,6 +1143,8 @@ class ServingEngine:
                 self.logprobs_k)
             self._record_logprobs(slot, float(np.asarray(clp)[0]),
                                   np.asarray(tlp)[0], np.asarray(tid)[0])
+        if grammar:
+            self.gstate[slot] = int(self._gtable_np[self._gstart, first])
         self.last_token[slot] = first
         self.outputs[slot] = [first]
         self._tokens += 1
@@ -1123,6 +1163,12 @@ class ServingEngine:
         discarded either way)."""
         return any(self._bias_on[s] for s in range(self.n_slots)
                    if self.active[s])
+
+    def _grammar_live(self) -> bool:
+        """Any ACTIVE slot under grammar constraint."""
+        return self._grammar is not None and any(
+            self.active[s] and self.gstate[s] >= 0
+            for s in range(self.n_slots))
 
     def _min_live(self) -> bool:
         """Any ACTIVE slot still below its min_tokens floor."""
@@ -1226,6 +1272,12 @@ class ServingEngine:
         if self._min_live():
             lg = lg + self._min_mask * jnp.asarray(
                 self._min_need())[:, None]
+        grammared = self._grammar_live()
+        if grammared:
+            gs = jnp.asarray(np.maximum(self.gstate, 0))
+            gon = jnp.asarray(
+                (self.gstate >= 0).astype(np.float32))[:, None]
+            lg = lg + self._gmask[gs] * gon
         nxt = self._sample(lg, self.temps, self.topks,
                            self.topps, self.minps, self.pres,
                            self.freqs, self.reps, self._counts,
@@ -1254,6 +1306,8 @@ class ServingEngine:
             if not self.active[s]:
                 continue
             tok = int(nxt[s])
+            if grammared and self.gstate[s] >= 0:
+                self.gstate[s] = int(self._gtable_np[self.gstate[s], tok])
             self.last_token[s] = tok
             self.outputs[s].append(tok)
             self._tokens += 1
@@ -1299,6 +1353,12 @@ class ServingEngine:
                 "speculative decoding does not produce per-token "
                 "logprobs (the accepted tokens skip their own decode "
                 "step)")
+        if self._grammar_live():
+            raise ValueError(
+                "speculative decoding does not compose with grammar "
+                "constraints (verify positions depend on sequential "
+                "DFA states); decode grammar requests with "
+                "step/run_scan")
         if not any(self.active):
             return {}
         for s in range(self.n_slots):
@@ -1457,6 +1517,8 @@ class ServingEngine:
                 self._lp_want[s] for s in range(self.n_slots)
                 if self.active[s]):
             return False
+        if self._grammar_live():
+            return False
         return True
 
     def run_scan(self, n_steps: int) -> Dict[int, List[int]]:
@@ -1495,9 +1557,17 @@ class ServingEngine:
                 if self.model.n_adapters > 0 else None)
         biased = self._bias_live()
         minned = self._min_live()
+        grammared = self._grammar_live()
+        if grammared:
+            gmask, gtable = self._gmask, self._gtable
+        else:
+            # unused placeholders (the static flag gates their use);
+            # tiny fixed shapes keep the jit cache key stable
+            gmask = jnp.zeros((1, 1), jnp.float32)
+            gtable = jnp.zeros((1, 1), jnp.int32)
         ys, self.cache, self._counts, self._seen = _scan_decode(
             self.model, n_steps, sampled, lp_k, pen, rep, seeded,
-            biased, minned, self.params, self.cache,
+            biased, minned, grammared, self.params, self.cache,
             jnp.asarray(self.last_token), jnp.asarray(self.lens, jnp.int32),
             jnp.asarray(self.temps), jnp.asarray(self.topks),
             jnp.asarray(self.topps), jnp.asarray(self.minps),
@@ -1506,6 +1576,7 @@ class ServingEngine:
             self._bias, self._min_mask, jnp.asarray(self.min_toks),
             jnp.asarray([len(self.outputs[s])
                          for s in range(self.n_slots)], jnp.int32),
+            gmask, gtable, jnp.asarray(self.gstate),
             jnp.asarray(self.seeds), jnp.asarray(self._seed_streams),
             jnp.asarray(self._seed_on),
             jnp.asarray(self._slot_draws, jnp.int32), aids,
@@ -1540,6 +1611,11 @@ class ServingEngine:
                 if not self.active[s]:
                     continue
                 tok = int(toks[i, s])
+                if grammared and self.gstate[s] >= 0:
+                    # host mirror of the carry's transitions, walked
+                    # over the SAME emitted tokens
+                    self.gstate[s] = int(
+                        self._gtable_np[self.gstate[s], tok])
                 self.last_token[s] = tok
                 self.outputs[s].append(tok)
                 self._tokens += 1
