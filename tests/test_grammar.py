@@ -137,7 +137,17 @@ def test_grammar_excludes_spec(setup):
 
 def test_vocab_mismatch_rejected(setup):
     model, params, _ = setup
+    # byte "0" (0x30) IS inside the 64-byte vocab, so the DFA builds
+    # fine and the engine's vocab-size check is what must reject it
     tb = [bytes([i]) if i else b"" for i in range(64)]
-    small = token_dfa(regex_to_dfa("a+"), tb, eos_id=0)
+    small = token_dfa(regex_to_dfa("0+"), tb, eos_id=0)
     with pytest.raises(ValueError, match="vocab"):
         ServingEngine(model, params, n_slots=1, grammar=small)
+
+
+def test_dead_end_grammar_rejected():
+    # byte "a" (0x61) is OUTSIDE a 64-byte vocab: every state rejects
+    # every token, which the dead-end guard must catch at build time
+    tb = [bytes([i]) if i else b"" for i in range(64)]
+    with pytest.raises(ValueError, match="dead-end"):
+        token_dfa(regex_to_dfa("a+"), tb, eos_id=0)
