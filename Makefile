@@ -1,0 +1,37 @@
+# Tiered test entry points (VERDICT r4 #6): plugin-side work should
+# not pay the workload tier's JAX compile tax on every local run.
+#
+#   make test-plugin     fast tier — discovery/allocator/plugin/manager/
+#                        labeller/health/proto/observability/C++ probe
+#   make test-workloads  compile-heavy tier — models, kernels, serving
+#   make test            everything (what CI runs, there with -n auto)
+
+PYTEST ?= python -m pytest
+PYTEST_ARGS ?= -q
+
+PLUGIN_TESTS := \
+    tests/test_allocator.py \
+    tests/test_cmd_device_plugin.py \
+    tests/test_device_impl.py \
+    tests/test_discovery.py \
+    tests/test_hardware.py \
+    tests/test_health.py \
+    tests/test_labeller.py \
+    tests/test_metrics.py \
+    tests/test_observability.py \
+    tests/test_plugin_manager.py \
+    tests/test_proto.py \
+    tests/test_tpuprobe.py
+
+WORKLOAD_TESTS := $(filter-out $(PLUGIN_TESTS), $(wildcard tests/test_*.py))
+
+.PHONY: test test-plugin test-workloads
+
+test:
+	$(PYTEST) tests/ -x $(PYTEST_ARGS)
+
+test-plugin:
+	$(PYTEST) $(PLUGIN_TESTS) -x $(PYTEST_ARGS)
+
+test-workloads:
+	$(PYTEST) $(WORKLOAD_TESTS) -x $(PYTEST_ARGS)

@@ -135,6 +135,56 @@ def test_grammar_excludes_spec(setup):
         eng.spec_round()
 
 
+def test_per_request_grammars(setup):
+    """The registry: two grammars on one engine, each request decoding
+    under its OWN DFA (admit(grammar=gid)), bit-independent of the
+    neighbor's constraint."""
+    model, params, dfa = setup
+    tb = [bytes([i]) if i else b"" for i in range(CFG["vocab"])]
+    digits = token_dfa(regex_to_dfa(r"\d+"), tb, eos_id=EOS)
+    eng = ServingEngine(model, params, n_slots=2, eos_id=EOS,
+                        max_new_tokens=12, grammar=dfa)
+    gid2 = eng.register_grammar(digits)
+    assert gid2 == 1 and eng.n_grammars == 2
+    s0 = eng.admit([70, 71, 72], grammar=True)     # (ab|cd)+e
+    s1 = eng.admit([70, 71, 72], grammar=gid2)     # \d+
+    eng.run(14)
+    t0, t1 = _decode(eng.output(s0)), _decode(eng.output(s1))
+    for text, pat in ((t0, PATTERN), (t1, r"\d+")):
+        d = regex_to_dfa(pat)
+        cur = 0
+        for b in text.encode():
+            cur = int(d.table[cur, b])
+            assert cur >= 0, (text, pat)
+    assert t1 and all(c.isdigit() for c in t1)
+
+
+def test_register_after_construction(setup):
+    """An engine built without a ctor grammar can still register one
+    later (the server's lazy per-request compile path)."""
+    model, params, dfa = setup
+    eng = ServingEngine(model, params, n_slots=1, eos_id=EOS,
+                        max_new_tokens=8)
+    with pytest.raises(ValueError, match="grammar"):
+        eng.admit([70], grammar=True)
+    gid = eng.register_grammar(dfa)
+    s = eng.admit([70, 71, 72], grammar=gid)
+    eng.run(10)
+    d = regex_to_dfa(PATTERN)
+    cur = 0
+    for b in _decode(eng.output(s)).encode():
+        cur = int(d.table[cur, b])
+        assert cur >= 0
+
+
+def test_unknown_grammar_id_rejected(setup):
+    model, params, dfa = setup
+    eng = ServingEngine(model, params, n_slots=1, eos_id=EOS,
+                        grammar=dfa)
+    with pytest.raises(ValueError, match="unknown grammar id"):
+        eng.admit([70], grammar=3)
+
+
 def test_vocab_mismatch_rejected(setup):
     model, params, _ = setup
     # byte "0" (0x30) IS inside the 64-byte vocab, so the DFA builds
@@ -151,3 +201,238 @@ def test_dead_end_grammar_rejected():
     tb = [bytes([i]) if i else b"" for i in range(64)]
     with pytest.raises(ValueError, match="dead-end"):
         token_dfa(regex_to_dfa("a+"), tb, eos_id=0)
+
+
+def test_trap_transitions_trimmed():
+    """A token step into a state from which acceptance is unreachable
+    must be rejected up front (co-accessible trim): pattern 'ab' with
+    a vocab holding 'a' but NOT 'b' — entering after 'a' would trap
+    generation, so 'a' itself must be masked out and the grammar is a
+    dead end at the start state."""
+    tb = [b"", b"a", b"c"]
+    with pytest.raises(ValueError, match="dead-end"):
+        token_dfa(regex_to_dfa("ab"), tb, eos_id=0)
+    # but with an alternative the trap branch is trimmed, not fatal
+    td = token_dfa(regex_to_dfa("ab|c"), tb, eos_id=0)
+    assert td.mask[0, 1] <= -1e8      # 'a' leads only to the trap
+    assert td.mask[0, 2] > -1e8       # 'c' accepts
+
+
+def test_json_lowering_is_rfc_strict():
+    """The guided-JSON regexes must only admit parseable JSON: raw
+    control chars in strings, leading-zero integers, and invalid
+    escapes are rejected; enum/property strings with quotes lower to
+    their escaped encodings."""
+    import json as _json
+
+    from tpu_k8s_device_plugin.workloads.grammar import (
+        json_value_regex,
+        schema_to_regex,
+    )
+
+    d = regex_to_dfa(json_value_regex(1))
+
+    def m(s):
+        cur = 0
+        for b in s.encode():
+            cur = int(d.table[cur, b])
+            if cur < 0:
+                return False
+        return bool(d.accepting[cur])
+
+    assert m('"a\\nb"') and m('"q\\"uo"') and m('"u\\u00e9x"')
+    assert not m('"a\nb"')      # raw newline inside a string
+    assert not m('"a\\qb"')     # \q is not a JSON escape
+    assert not m("007")         # leading zeros
+    assert m("0") and m("0.5") and m("-10e3")
+    # enum values with quotes/backslashes force ESCAPED output
+    e = regex_to_dfa(schema_to_regex({"enum": ['say "hi"']}))
+
+    def me(s):
+        cur = 0
+        for b in s.encode():
+            cur = int(e.table[cur, b])
+            if cur < 0:
+                return False
+        return bool(e.accepting[cur])
+
+    assert me(_json.dumps('say "hi"'))
+    assert not me('"say "hi""')
+    # property names JSON-encode too
+    sr = schema_to_regex({"type": "object",
+                          "properties": {'a"b': {"type": "null"}}})
+    p = regex_to_dfa(sr)
+    cur = 0
+    for b in '{"a\\"b": null}'.encode():
+        cur = int(p.table[cur, b])
+        assert cur >= 0
+    assert bool(p.accepting[cur])
+
+
+# -- the served surface: guided decoding over HTTP ---------------------------
+
+def _post(port, payload, path="/generate"):
+    import http.client
+    import json as _json
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    try:
+        conn.request("POST", path, _json.dumps(payload),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        events = [_json.loads(line) for line in resp if line.strip()]
+        return resp.status, events
+    finally:
+        conn.close()
+
+
+def _valid_prefix(text, pattern):
+    d = regex_to_dfa(pattern)
+    cur = 0
+    for b in text.encode():
+        cur = int(d.table[cur, b])
+        if cur < 0:
+            return False
+    return True
+
+
+@pytest.fixture()
+def grammar_server(setup):
+    from tpu_k8s_device_plugin.workloads.server import EngineServer
+
+    model, params, _ = setup
+    eng = ServingEngine(model, params, n_slots=2, eos_id=EOS)
+    tb = [bytes([i]) if i else b"" for i in range(CFG["vocab"])]
+    srv = EngineServer(eng, max_new_tokens=16, window=4,
+                       token_bytes=tb)
+    srv.start(host="127.0.0.1", port=0)
+    yield srv, eng
+    srv.stop()
+
+
+def test_guided_regex_over_http(grammar_server):
+    srv, eng = grammar_server
+    status, events = _post(srv.port, {
+        "tokens": [70, 71, 72], "guided_regex": PATTERN,
+        "stream": False})
+    assert status == 200
+    text = _decode(events[0]["tokens"])
+    if events[0]["finish_reason"] == "eos":
+        assert re.fullmatch(PATTERN, text), text
+    else:
+        assert _valid_prefix(text, PATTERN), text
+    # same pattern again: cache hit, no second registration
+    status, _ = _post(srv.port, {
+        "tokens": [9, 4], "guided_regex": PATTERN, "stream": False})
+    assert status == 200
+    assert srv.stats()["grammar_patterns"] == 1
+    assert eng.n_grammars == 1
+
+
+def test_guided_json_schema_over_http(grammar_server):
+    from tpu_k8s_device_plugin.workloads.grammar import schema_to_regex
+
+    srv, _ = grammar_server
+    schema = {"type": "object",
+              "properties": {"a": {"type": "integer"}}}
+    status, events = _post(srv.port, {
+        "tokens": [70, 71], "guided_json": schema, "stream": False})
+    assert status == 200
+    text = _decode(events[0]["tokens"])
+    assert _valid_prefix(text, schema_to_regex(schema)), text
+    assert text.startswith("{")
+
+
+def test_guided_errors_are_400s(grammar_server, setup):
+    from tpu_k8s_device_plugin.workloads.server import EngineServer
+
+    srv, _ = grammar_server
+    status, events = _post(srv.port, {
+        "tokens": [1], "guided_regex": "(oops"})
+    assert status == 400 and "error" in events[0]
+    status, events = _post(srv.port, {
+        "tokens": [1], "guided_regex": "a+",
+        "guided_json": True})
+    assert status == 400
+    # a server with no token-byte table rejects cleanly
+    model, params, _ = setup
+    eng = ServingEngine(model, params, n_slots=1, eos_id=EOS)
+    bare = EngineServer(eng, max_new_tokens=4)
+    bare.start(host="127.0.0.1", port=0)
+    try:
+        status, events = _post(bare.port, {
+            "tokens": [1], "guided_regex": "a+"})
+        assert status == 400
+        assert "token" in events[0]["error"]
+    finally:
+        bare.stop()
+
+
+def test_guided_composes_with_ngram_spec(setup):
+    """Constrained requests decode via run_scan (spec_ready gates on
+    grammar-live slots); once they drain, greedy traffic resumes spec
+    rounds — the adaptive composition the scheduler promises."""
+    from tpu_k8s_device_plugin.workloads.server import EngineServer
+
+    model, params, _ = setup
+    eng = ServingEngine(model, params, n_slots=1, eos_id=EOS,
+                        draft="ngram", gamma=3)
+    tb = [bytes([i]) if i else b"" for i in range(CFG["vocab"])]
+    srv = EngineServer(eng, max_new_tokens=8, window=4,
+                       token_bytes=tb)
+    srv.start(host="127.0.0.1", port=0)
+    try:
+        status, events = _post(srv.port, {
+            "tokens": [70, 71, 72], "guided_regex": PATTERN,
+            "stream": False})
+        assert status == 200
+        assert _valid_prefix(_decode(events[0]["tokens"]), PATTERN)
+        rounds_after_grammar = eng.stats()["spec_rounds"]
+        status, _ = _post(srv.port, {"tokens": [5, 9, 3],
+                                     "stream": False})
+        assert status == 200
+        assert eng.stats()["spec_rounds"] > rounds_after_grammar
+    finally:
+        srv.stop()
+
+
+def test_response_format_openai(setup):
+    """OpenAI response_format={"type": "json_object"} constrains
+    /v1/completions output to a JSON OBJECT (token bytes derived from
+    the tokenizer); a json_schema without a schema object is a 400,
+    never a silent fallback."""
+    from tpu_k8s_device_plugin.workloads.grammar import (
+        json_object_regex,
+    )
+    from tpu_k8s_device_plugin.workloads.server import EngineServer
+
+    class _ByteTok:
+        def encode(self, s):
+            return list(s.encode("latin-1"))
+
+        def decode(self, ids):
+            return bytes(int(t) % 256 for t in ids).decode("latin-1")
+
+    model, params, _ = setup
+    eng = ServingEngine(model, params, n_slots=1, eos_id=EOS)
+    srv = EngineServer(eng, max_new_tokens=12, window=4,
+                       tokenizer=_ByteTok())
+    srv.start(host="127.0.0.1", port=0)
+    try:
+        status, events = _post(srv.port, {
+            "prompt": "Fe", "temperature": 0.0,
+            "max_tokens": 12,
+            "response_format": {"type": "json_object"}},
+            path="/v1/completions")
+        assert status == 200
+        text = events[0]["choices"][0]["text"]
+        assert _valid_prefix(text, json_object_regex()), text
+        assert text.startswith("{")
+        # malformed json_schema (schema key missing) -> 400
+        status, events = _post(srv.port, {
+            "prompt": "Fe", "max_tokens": 4,
+            "response_format": {"type": "json_schema",
+                                "json_schema": {"name": "x"}}},
+            path="/v1/completions")
+        assert status == 400
+    finally:
+        srv.stop()

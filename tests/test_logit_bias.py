@@ -1,8 +1,10 @@
 """Per-request logit_bias (OpenAI semantics): a plain add before every
 pick, per-slot data on the one compiled step.
 
-Oracles: +1000 on one token forces it deterministically (even
-sampled); banning the greedy winner yields the runner-up; run_scan,
+Oracles: +100 (the OpenAI range cap) on one token forces it
+deterministically against O(1)-scale random-init logits (even
+sampled); banning the greedy winner with -100 yields the runner-up;
+run_scan,
 step-wise decode, and spec rounds agree token-for-token on a biased
 engine; an unbiased neighbor's tokens are untouched by a biased slot."""
 
@@ -44,7 +46,7 @@ def test_force_token_even_when_sampled(setup):
     model, params = setup
     eng = ServingEngine(model, params, n_slots=1)
     s = eng.admit([5, 17, 3], temperature=1.0, top_k=32,
-                  logit_bias={42: 1000.0})
+                  logit_bias={42: 100.0})
     eng.run(5)
     assert eng.output(s)[:5] == [42] * 5
 
@@ -54,7 +56,7 @@ def test_ban_greedy_winner_yields_runner_up(setup):
     plain = _oracle(model, params, [5, 17, 3], 1)
     banned = plain[0]
     eng = ServingEngine(model, params, n_slots=1)
-    s = eng.admit([5, 17, 3], logit_bias={banned: -1e9})
+    s = eng.admit([5, 17, 3], logit_bias={banned: -100.0})
     tok = eng.output(s)[0]
     assert tok != banned
     # the runner-up of the true first-step distribution
@@ -73,7 +75,7 @@ def test_scan_step_and_spec_agree_biased(setup):
     model, params = setup
     draft = make_decoder(**DRAFT_CFG, max_len=64, dtype=jnp.float32)
     dparams = _init(draft, 1)
-    bias = {7: 5.0, 11: -1e9}
+    bias = {7: 5.0, 11: -100.0}
 
     def mk(**kw):
         e = ServingEngine(model, params, n_slots=1,
@@ -96,7 +98,7 @@ def test_unbiased_neighbor_untouched(setup):
     solo = _oracle(model, params, [3, 14, 15], 6)
     eng = ServingEngine(model, params, n_slots=2, max_new_tokens=6)
     su = eng.admit([3, 14, 15])
-    eng.admit([5, 17, 3], logit_bias={42: 1000.0})
+    eng.admit([5, 17, 3], logit_bias={42: 100.0})
     eng.run(8)
     assert eng.output(su) == solo
 
@@ -105,7 +107,7 @@ def test_stale_bias_cleared_on_reuse(setup):
     model, params = setup
     solo = _oracle(model, params, [3, 14, 15], 5)
     eng = ServingEngine(model, params, n_slots=1, max_new_tokens=5)
-    s = eng.admit([5, 17, 3], logit_bias={42: 1000.0})
+    s = eng.admit([5, 17, 3], logit_bias={42: 100.0})
     eng.run(7)
     assert eng.output(s) == [42] * 5
     eng.release(s)
@@ -121,6 +123,13 @@ def test_validation(setup):
         eng.admit([1, 2], logit_bias={CFG["vocab"]: 1.0})
     with pytest.raises(ValueError, match="finite"):
         eng.admit([1, 2], logit_bias={3: float("nan")})
+    # OpenAI clamps the range to [-100, 100]; out-of-range values are
+    # rejected so a bias can never overpower the -1e9 min_tokens /
+    # grammar constraint masks (ADVICE r4)
+    with pytest.raises(ValueError, match=r"\[-100, 100\]"):
+        eng.admit([1, 2], logit_bias={3: 101.0})
+    with pytest.raises(ValueError, match=r"\[-100, 100\]"):
+        eng.admit([1, 2], logit_bias={3: -1e12})
     with pytest.raises(ValueError, match="non-empty"):
         eng.admit([1, 2], logit_bias={})
     # a rejected admit leaves the engine reusable
@@ -144,7 +153,7 @@ def test_logit_bias_over_http(setup):
         # JSON object keys are strings, as OpenAI clients send them
         c.request("POST", "/generate", json.dumps(
             {"tokens": [5, 17, 3], "stream": False,
-             "logit_bias": {"42": 1000.0}}),
+             "logit_bias": {"42": 100.0}}),
             {"Content-Type": "application/json"})
         r = c.getresponse()
         ev = json.loads(r.read().decode().strip().splitlines()[0])
@@ -157,12 +166,12 @@ def test_logit_bias_over_http(setup):
 # -- min_tokens (vLLM): eos/stop floor -----------------------------------
 
 def test_min_tokens_defers_forced_eos(setup):
-    """+1000 bias makes eos win every pick; min_tokens must suppress
+    """+100 bias makes eos win every pick; min_tokens must suppress
     it for exactly the floor, then let it fire with reason 'eos'."""
     model, params = setup
     eos = 33
     eng = ServingEngine(model, params, n_slots=1, eos_id=eos)
-    s = eng.admit([5, 17, 3], logit_bias={eos: 1000.0}, min_tokens=3)
+    s = eng.admit([5, 17, 3], logit_bias={eos: 100.0}, min_tokens=3)
     eng.run(8)
     out = eng.output(s)
     assert len(out) == 4
@@ -174,7 +183,7 @@ def test_min_tokens_defers_stop_ids_too(setup):
     model, params = setup
     t = 44
     eng = ServingEngine(model, params, n_slots=1)
-    s = eng.admit([5, 17, 3], logit_bias={t: 1000.0}, stop=[t],
+    s = eng.admit([5, 17, 3], logit_bias={t: 100.0}, stop=[t],
                   min_tokens=2)
     eng.run(6)
     out = eng.output(s)
@@ -191,7 +200,7 @@ def test_min_tokens_scan_step_spec_agree(setup):
     def mk(**kw):
         e = ServingEngine(model, params, n_slots=1, eos_id=eos,
                           max_new_tokens=8, **kw)
-        return e, e.admit([5, 17, 3], logit_bias={eos: 1000.0},
+        return e, e.admit([5, 17, 3], logit_bias={eos: 100.0},
                           min_tokens=5)
 
     a, sa = mk()
@@ -240,7 +249,7 @@ def test_min_tokens_over_http(setup):
                                        timeout=120)
         c.request("POST", "/generate", json.dumps(
             {"tokens": [5, 17, 3], "stream": False,
-             "logit_bias": {"33": 1000.0}, "min_tokens": 3}),
+             "logit_bias": {"33": 100.0}, "min_tokens": 3}),
             {"Content-Type": "application/json"})
         r = c.getresponse()
         ev = json.loads(r.read().decode().strip().splitlines()[0])

@@ -397,3 +397,78 @@ def test_registration_survives_kubelet_downtime(impl, tmp_path):
     finally:
         mgr_mod._REGISTER_RETRY_DELAY_S = old
         m.stop()
+
+
+def test_multihost_slice_env_coherent_over_wire(testdata, tmp_path):
+    """The JobSet example (example/multihost/jobset.yaml) depends on
+    BOTH hosts of a multi-host slice handing their full-host pods a
+    COHERENT slice identity: identical accelerator type / topology /
+    per-host bounds / process bounds, and distinct worker ids covering
+    [0, num_workers).  Drive the two v5e-16 fixture hosts through two
+    fake kubelets simultaneously — the full registration + preferred
+    allocation + Allocate path over real gRPC sockets — and assert the
+    pair of responses libtpu would see (VERDICT r4 #7)."""
+    cars = {}
+    stack = []
+    try:
+        for host in ("v5e-16-host0", "v5e-16-host1"):
+            root = os.path.join(testdata, host)
+            impl = TpuContainerImpl(
+                sysfs_root=os.path.join(root, "sys"),
+                dev_root=os.path.join(root, "dev"),
+                tpu_env_path=os.path.join(root, "run", "tpu", "tpu-env"),
+            )
+            k = FakeKubelet(str(tmp_path / host)).start()
+            stack.append(k.stop)
+            m = PluginManager(
+                impl, pulse_seconds=0, kubelet_dir=k.dir,
+                kubelet_watch_interval_s=0.1,
+            )
+            m.run(block=False)
+            stack.append(m.stop)
+            assert k.wait_for_registration()
+            stub = k.plugin_stub("google.com_tpu")
+            # a full-host pod asks for every advertised chip; the
+            # preferred allocator must grant the whole host
+            pref = stub.GetPreferredAllocation(
+                pluginapi.PreferredAllocationRequest(
+                    container_requests=[
+                        pluginapi.ContainerPreferredAllocationRequest(
+                            available_deviceIDs=[
+                                addr(i) for i in range(8)],
+                            allocation_size=8,
+                        )
+                    ]
+                )
+            )
+            chosen = list(pref.container_responses[0].deviceIDs)
+            assert sorted(chosen) == [addr(i) for i in range(8)]
+            alloc = stub.Allocate(
+                pluginapi.AllocateRequest(
+                    container_requests=[
+                        pluginapi.ContainerAllocateRequest(
+                            devices_ids=chosen)
+                    ]
+                )
+            )
+            cars[host] = alloc.container_responses[0]
+    finally:
+        for fn in reversed(stack):
+            fn()
+    e0, e1 = (cars[h].envs for h in ("v5e-16-host0", "v5e-16-host1"))
+    # slice-global identity: identical on every host
+    for key in (constants.ENV_TPU_ACCELERATOR_TYPE,
+                constants.ENV_TPU_TOPOLOGY,
+                constants.ENV_TPU_CHIPS_PER_HOST_BOUNDS,
+                constants.ENV_TPU_PROCESS_BOUNDS):
+        assert e0[key] == e1[key], key
+    assert e0[constants.ENV_TPU_ACCELERATOR_TYPE] == "v5litepod-16"
+    assert e0[constants.ENV_TPU_PROCESS_BOUNDS] == "2,1,1"
+    # per-host identity: worker ids are distinct and cover the slice
+    ids = {e[constants.ENV_TPU_WORKER_ID] for e in (e0, e1)}
+    assert ids == {"0", "1"}
+    # every host mounts its full 8 local chips
+    for host in cars:
+        assert len(cars[host].devices) == 8
+        assert cars[host].envs[constants.ENV_TPU_VISIBLE_CHIPS] == \
+            ",".join(str(i) for i in range(8))

@@ -510,6 +510,67 @@ def text_server(setup):
     srv.stop()
 
 
+def test_incremental_detok_matches_full_decode():
+    """_DetokState commits text token-by-token with BOUNDED decode
+    windows; the committed text must equal the full decode once every
+    byte of a split UTF-8 char has arrived (the U+FFFD stall case)."""
+    from tpu_k8s_device_plugin.workloads.server import _DetokState
+
+    class _Utf8ByteTok:
+        # 1 token == 1 raw UTF-8 byte: multi-byte chars span tokens
+        def decode(self, ids):
+            return bytes(ids).decode("utf-8", errors="replace")
+
+    text = "héllo ✓ wörld"
+    ids = list(text.encode("utf-8"))
+    tok = _Utf8ByteTok()
+    st = _DetokState()
+    for n in range(1, len(ids) + 1):
+        st.feed(tok, ids, n)
+        # committed text is always a prefix of the final text — the
+        # unstable tail is withheld, never streamed as U+FFFD
+        assert text.startswith(st.text), (n, st.text)
+        assert len(st.cum) == n + 1
+    assert st.text == text
+
+
+def test_find_stop_spanning_scan_windows():
+    from tpu_k8s_device_plugin.workloads.server import (
+        _DetokState, _find_stop,
+    )
+
+    st = _DetokState()
+    st.text = "abcXYdef"
+    st.cum = [0, 1, 2, 3, 4, 5, 6, 7, 8]  # 1 char per token
+    # scanned through "abcX" (4 chars): the match completes at "Y" —
+    # the overlap window must still see the X that was already scanned
+    keep, text = _find_stop(st, ["XY"], 4)
+    assert keep == 5 and text == "abc"
+    # fully-scanned matches are not re-reported
+    keep, _ = _find_stop(st, ["XY"], 8)
+    assert keep is None
+
+
+def test_find_stop_stale_match_does_not_shadow_new():
+    """A stop occurrence already inside the scanned region must not
+    shadow a LATER genuine occurrence of the same stop string (the
+    first-occurrence-only bug): with scanned_from past the first 'AB',
+    the second 'AB' is the match."""
+    from tpu_k8s_device_plugin.workloads.server import (
+        _DetokState, _find_stop,
+    )
+
+    st = _DetokState()
+    st.text = "xABxyABz"
+    st.cum = list(range(len(st.text) + 1))
+    # with chars [0, 4) marked scanned, the new AB completing at 7 must
+    # still be FOUND (the first-occurrence-only bug returned None
+    # because the stale AB at pos 1 shadowed it); the cut lands at the
+    # new match — the stale one sits before the overlap window
+    keep, text = _find_stop(st, ["AB"], 4)
+    assert keep == 7 and text == "xABxy"
+
+
 def test_prompt_string_roundtrip(text_server):
     srv, model, params = text_server
     tok = _ByteTok()

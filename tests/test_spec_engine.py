@@ -182,6 +182,27 @@ def test_released_donor_survives_spec_rounds(models):
     assert got == _oracle(target, tp, shared + [44], len(got))
 
 
+def test_spec_donor_bound_rejects_long_prompts(models):
+    """With a proposer, EVERY verify extend writes gamma+1 rows, and a
+    parked slot's clamped write band is [max_len-gamma-1, max_len-1] —
+    admit must reject prompts whose K/V would sit inside it (ADVICE
+    r4: the plain t_p <= max_len-1 invariant only covers T=1 writes)."""
+    (target, tp), (draft, dp) = models
+    small_t = make_decoder(**TARGET_CFG, max_len=16, dtype=DT)
+    small_d = make_decoder(**DRAFT_CFG, max_len=16, dtype=DT)
+    eng = ServingEngine(small_t, tp, n_slots=1,
+                        draft=(small_d, dp), gamma=3)
+    # bound is 16 - 3 - 1 = 12: 12 admits, 13 rejects
+    s = eng.admit(list(range(1, 13)))
+    eng.release(s)
+    with pytest.raises(ValueError, match="donor bound"):
+        eng.admit(list(range(1, 14)))
+    # n-gram proposers share the same verify band
+    eng2 = ServingEngine(small_t, tp, n_slots=1, draft="ngram", gamma=3)
+    with pytest.raises(ValueError, match="donor bound"):
+        eng2.admit(list(range(1, 14)))
+
+
 def test_greedy_only_guard(models):
     (target, tp), (draft, dp) = models
     eng = ServingEngine(target, tp, n_slots=1, draft=(draft, dp))

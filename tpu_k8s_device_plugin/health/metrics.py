@@ -32,7 +32,7 @@ from typing import Optional
 from tpu_k8s_device_plugin.tpu import discovery, sysfs
 from tpu_k8s_device_plugin.types import constants
 
-from .server import probe_chip_states
+from .server import granular_health_available, probe_chip_states
 
 log = logging.getLogger(__name__)
 
@@ -91,6 +91,11 @@ def render_metrics(sysfs_root: str = "/sys", dev_root: str = "/dev",
             *ue_lines,
         ]
     lines += [
+        "# HELP tpu_exporter_granular_health Driver exposes chip_state/"
+        "UE attrs (0 = wedged-chip detection degraded to node stats).",
+        "# TYPE tpu_exporter_granular_health gauge",
+        "tpu_exporter_granular_health "
+        f"{1 if chips and granular_health_available(sysfs_root, chips) else 0}",
         "# HELP tpu_exporter_chips Chips the exporter probes.",
         "# TYPE tpu_exporter_chips gauge",
         f"tpu_exporter_chips {len(states)}",

@@ -65,6 +65,29 @@ def _node_openable(path: str) -> bool:
     return os.path.exists(path) and os.access(path, os.R_OK | os.W_OK)
 
 
+def granular_health_available(sysfs_root: str, chips) -> bool:
+    """Does the driver expose EITHER granular health attribute
+    (chip_state / uncorrectable_errors) for any chip?  The attrs are
+    modelled from the fixture ABI, not a cited driver source
+    (testdata/README.md records the provenance per attribute) — so on
+    a real host where the driver spells them differently, the granular
+    path would silently never fire.  This predicate makes that state
+    operator-visible: probe_chip_states warns once per tree and the
+    exporter publishes ``tpu_exporter_granular_health``."""
+    for chip in chips.values():
+        pci_dir = os.path.join(
+            sysfs_root, "bus", "pci", "devices", chip.pci_address)
+        if (os.path.exists(os.path.join(
+                pci_dir, constants.SYSFS_CHIP_STATE))
+                or os.path.exists(os.path.join(
+                    pci_dir, constants.SYSFS_UE_COUNT))):
+            return True
+    return False
+
+
+_warned_no_granular: set = set()
+
+
 def _sysfs_chip_fault(sysfs_root: str, pci_address: str) -> Optional[str]:
     """Granular driver-reported chip state from sysfs — the signal an
     open(2) probe cannot see (a wedged chip whose chardev still opens).
@@ -97,6 +120,19 @@ def probe_chip_states(
     if chips is None:
         chips, _ = discovery.get_tpu_chips(
             sysfs_root, dev_root, "/nonexistent")
+    if (chips and not granular_health_available(sysfs_root, chips)
+            and sysfs_root not in _warned_no_granular):
+        # absence-is-healthy is the right per-chip verdict (older
+        # drivers legitimately omit the attrs), but a WHOLE tree
+        # without them means wedged-chip detection is off — say so
+        # once, instead of silently degrading to node-stat checks
+        _warned_no_granular.add(sysfs_root)
+        log.warning(
+            "granular health unavailable: no chip under %s exposes "
+            "%s or %s — wedged-chip detection degrades to device-node "
+            "stat checks (see testdata/README.md for the attr "
+            "provenance)", sysfs_root, constants.SYSFS_CHIP_STATE,
+            constants.SYSFS_UE_COUNT)
     for chip in chips.values():
         if chip.accel_index < 0:
             # raw-PCI fallback chips (vfio passthrough) have no accel node to

@@ -76,7 +76,8 @@ def build_model_and_params(config: str, max_len: int, quantized,
 
 def run(config: str, quantized, batch: int, steps: int,
         prompt_len: int, max_len: int, engine: bool = False,
-        spec: int = 0):
+        spec: int = 0, http_clients: int = 0, http_requests: int = 0,
+        cancel_every: int = 0):
     # fail fast for library callers too, not just the CLI: engine mode
     # consumes (warmup + rounds) run_scan windows of cache headroom,
     # and a mid-benchmark ValueError from run_scan is a worse place to
@@ -86,6 +87,9 @@ def run(config: str, quantized, batch: int, steps: int,
         # spec rounds, each committing at most gamma+1; an exhausted
         # slot would turn timed rounds into no-ops
         budget = 2 * steps + (1 + _ENGINE_ROUNDS) * (spec + 1)
+    elif http_clients:
+        # the post-load direct-engine comparison is the deep consumer
+        budget = steps * (_ENGINE_WARMUP + _ENGINE_ROUNDS)
     else:
         scans = (_ENGINE_WARMUP + _ENGINE_ROUNDS) if engine else 1
         budget = steps * scans
@@ -107,6 +111,11 @@ def run(config: str, quantized, batch: int, steps: int,
         stats = _spec_throughput(
             model, params, dmodel, dparams, prompt, spec, steps)
         stats["draft"] = draft_name
+    elif http_clients:
+        stats = _http_throughput(
+            model, params, prompt, steps, http_clients,
+            http_requests or 4 * http_clients, slots=batch,
+            cancel_every=cancel_every)
     elif engine:
         stats = _engine_throughput(model, params, prompt, steps)
     else:
@@ -223,6 +232,148 @@ def _spec_throughput(model, params, draft_model, draft_params, prompt,
     return out
 
 
+def _percentile(xs, q):
+    xs = sorted(xs)
+    if not xs:
+        return float("nan")
+    i = min(len(xs) - 1, int(round(q * (len(xs) - 1))))
+    return xs[i]
+
+
+def _http_throughput(model, params, prompt, steps, clients,
+                     n_requests, slots, cancel_every: int = 0):
+    """Front-door load test (VERDICT r4 #5): *clients* concurrent
+    streaming HTTP clients drive *n_requests* total requests (mixed
+    priorities; every *cancel_every*-th request disconnects after its
+    first token, exercising the release path under load) against a
+    live EngineServer.  Reports req/s and p50/p99 TTFT/TPOT as the
+    wire sees them — queueing, scheduler windows, and HTTP framing
+    included — next to the direct-engine tokens/sec for the same
+    model, so the front-door overhead is a number, not a guess."""
+    import http.client
+    import json as _json
+    import threading
+    import time
+
+    import numpy as np
+
+    from .server import EngineServer
+    from .serving import ServingEngine
+
+    prompt_host = np.asarray(prompt)
+    eng = ServingEngine(model, params, n_slots=slots)
+    srv = EngineServer(eng, max_new_tokens=steps, window=8)
+    srv.start(host="127.0.0.1", port=0)
+    lock = threading.Lock()
+    ttfts, tpots, done_tokens, errors = [], [], [], []
+    cancelled = [0]
+    seq = iter(range(n_requests))
+
+    def client_loop(cid):
+        while True:
+            with lock:
+                i = next(seq, None)
+            if i is None:
+                return
+            body = _json.dumps({
+                "tokens": prompt_host[i % len(prompt_host)].tolist(),
+                "max_new_tokens": steps,
+                # mixed priorities: odd requests jump the queue
+                "priority": i % 2,
+            })
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", srv.port, timeout=600)
+            t0 = time.perf_counter()
+            try:
+                conn.request("POST", "/generate", body,
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                first = last = None
+                n_toks = 0
+                for line in resp:
+                    if not line.strip():
+                        continue
+                    now = time.perf_counter()
+                    ev = _json.loads(line)
+                    if "token" in ev:
+                        n_toks += 1
+                        last = now
+                        if first is None:
+                            first = now
+                            if cancel_every and i % cancel_every == \
+                                    cancel_every - 1:
+                                with lock:
+                                    cancelled[0] += 1
+                                break  # disconnect mid-stream
+                    elif "error" in ev:
+                        # errored requests must not vanish from the
+                        # stats (clean-looking numbers over a broken
+                        # run would be worse than no numbers)
+                        with lock:
+                            errors.append(ev["error"])
+                        break
+                    elif "done" in ev and first is not None:
+                        with lock:
+                            ttfts.append(first - t0)
+                            if n_toks > 1:
+                                tpots.append(
+                                    (last - first) / (n_toks - 1))
+                            done_tokens.append(len(ev["tokens"]))
+            finally:
+                conn.close()
+
+    try:
+        # warm the compiled paths outside the timed region (first
+        # window compile would otherwise dominate every percentile)
+        warm = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=600)
+        warm.request("POST", "/generate", _json.dumps(
+            {"tokens": prompt_host[0].tolist(),
+             "max_new_tokens": steps, "stream": False}),
+            {"Content-Type": "application/json"})
+        warm.getresponse().read()
+        warm.close()
+
+        t_start = time.perf_counter()
+        threads = [threading.Thread(target=client_loop, args=(c,))
+                   for c in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t_start
+    finally:
+        # a failure mid-bench must not leak the live server/engine
+        # into the rest of the process
+        srv.stop()
+    if errors and not done_tokens:
+        raise RuntimeError(
+            f"every request errored; first: {errors[0]}")
+
+    # the direct-engine ceiling for the same shapes: batch = slot count
+    eng_stats = _engine_throughput(
+        model, params,
+        jnp.broadcast_to(prompt[:1], (slots, prompt.shape[1])), steps)
+    http_tps = sum(done_tokens) / wall
+    return {
+        "http": True,
+        "clients": float(clients),
+        "slots": float(slots),
+        "requests_completed": float(len(done_tokens)),
+        "requests_cancelled": float(cancelled[0]),
+        "requests_errored": float(len(errors)),
+        "req_per_sec": len(done_tokens) / wall,
+        "ttft_ms_p50": _percentile(ttfts, 0.5) * 1e3,
+        "ttft_ms_p99": _percentile(ttfts, 0.99) * 1e3,
+        "tpot_ms_p50": _percentile(tpots, 0.5) * 1e3,
+        "tpot_ms_p99": _percentile(tpots, 0.99) * 1e3,
+        "tokens_per_sec_http": http_tps,
+        "tokens_per_sec_engine": eng_stats["tokens_per_sec"],
+        "front_door_overhead_pct":
+            100.0 * (1.0 - http_tps / eng_stats["tokens_per_sec"]),
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tpu-serving-bench")
     p.add_argument("--config", choices=sorted(CONFIGS), default="tiny")
@@ -241,17 +392,39 @@ def main(argv=None) -> int:
                    help="speculative-round economics at this gamma "
                         "(paired draft per DRAFT_FOR; reports round "
                         "latency + implied tok/s over accept rate)")
+    p.add_argument("--http", type=int, default=0, metavar="CLIENTS",
+                   help="front-door load test: N concurrent streaming "
+                        "HTTP clients (mixed priorities) against a "
+                        "live EngineServer; --batch sets the slot "
+                        "count; reports req/s + p50/p99 TTFT/TPOT vs "
+                        "the direct-engine tokens/sec")
+    p.add_argument("--requests", type=int, default=0,
+                   help="total requests for --http (default 4x clients)")
+    p.add_argument("--cancel-every", type=int, default=0, metavar="K",
+                   help="with --http: every K-th request disconnects "
+                        "after its first token (release-path stress)")
     args = p.parse_args(argv)
 
     devs = jax.devices()
     print(f"devices: {len(devs)} x {devs[0].platform}", flush=True)
     if args.int4 and args.quantized:
         p.error("--quantized and --int4 are mutually exclusive")
+    modes = [f for f, on in (("--engine", args.engine),
+                             ("--spec", args.spec),
+                             ("--http", args.http)) if on]
+    if len(modes) > 1:
+        # silently running a different experiment than the one asked
+        # for is worse than an error
+        p.error(f"{' and '.join(modes)} are mutually exclusive")
+    if (args.requests or args.cancel_every) and not args.http:
+        p.error("--requests/--cancel-every only apply with --http")
     quantized = "int4" if args.int4 else args.quantized
     try:
         stats = run(args.config, quantized, args.batch, args.steps,
                     args.prompt_len, args.max_len, engine=args.engine,
-                    spec=args.spec)
+                    spec=args.spec, http_clients=args.http,
+                    http_requests=args.requests,
+                    cancel_every=args.cancel_every)
     except ValueError as e:
         p.error(str(e))
     for k, v in stats.items():

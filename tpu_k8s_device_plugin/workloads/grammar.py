@@ -25,8 +25,9 @@ opt in with ``admit(grammar=True)``.
 
 from __future__ import annotations
 
+import re as _re
 from dataclasses import dataclass
-from typing import Dict, FrozenSet, List, Tuple
+from typing import Dict, FrozenSet, List, Optional, Tuple
 
 import numpy as np
 
@@ -273,29 +274,266 @@ def token_dfa(dfa: CharDfa, token_bytes: List[bytes],
     is allowed exactly in accepting states."""
     n_states = len(dfa.table)
     V = len(token_bytes)
-    table = np.full((n_states, V), _REJECT, np.int32)
+    # vectorized closure: walk EVERY (state, token) pair one byte
+    # position at a time with [N, V] gathers — max-token-length numpy
+    # passes instead of an O(N * V * len) Python loop (decisive for
+    # real 100k+ vocabs against a few-thousand-state JSON grammar)
+    max_b = max((len(bs) for bs in token_bytes), default=0)
+    bytes_mat = np.full((V, max(max_b, 1)), -1, np.int64)
     for t, bs in enumerate(token_bytes):
         if t == eos_id or not bs:
-            continue
-        for s in range(n_states):
-            cur = s
-            for b in bs:
-                cur = int(dfa.table[cur, b])
-                if cur == _REJECT:
-                    break
-            if cur != _REJECT:
-                table[s, t] = cur
+            continue  # specials/eos reject everywhere (masked below)
+        bytes_mat[t, :len(bs)] = list(bs)
+    cur = np.tile(np.arange(n_states, dtype=np.int32)[:, None], (1, V))
+    for p in range(max_b):
+        bp = bytes_mat[:, p]
+        has = (bp >= 0)[None, :]
+        step = dfa.table[np.maximum(cur, 0),
+                         np.maximum(bp, 0)[None, :]]
+        cur = np.where(has, np.where(cur >= 0, step, _REJECT), cur)
+    cur[:, bytes_mat[:, 0] < 0] = _REJECT
+    table = np.ascontiguousarray(cur.astype(np.int32))
     mask = np.where(table >= 0, 0.0, -1e9).astype(np.float32)
     if 0 <= eos_id < V:
         for s in np.flatnonzero(dfa.accepting):
             mask[s, eos_id] = 0.0
             table[s, eos_id] = s  # self-loop; generation retires at eos
-    # dead-end guard: a reachable state where nothing (incl. eos) is
-    # allowed would force garbage tokens through the mask
-    dead = (mask <= -1e9 / 2).all(axis=1)
+    # trim to co-accessible states: a token step into a state from
+    # which NO accepting state is token-reachable would trap the
+    # generation (decoding forever with eos masked, or hitting a
+    # dead end later) — reject those transitions up front, exactly
+    # like outlines' FSM reduction
+    # reverse-adjacency BFS (one O(N*V) edge collection + O(edges)
+    # walk) instead of a forward fixed point, whose iteration count is
+    # the DFA diameter — quadratic for chain grammars like long
+    # literal enums
+    rev: List[List[int]] = [[] for _ in range(n_states)]
+    for s in range(n_states):
+        row = table[s]
+        for t in np.unique(row[row >= 0]):
+            rev[int(t)].append(s)
+    live = dfa.accepting.copy()
+    work = [int(s) for s in np.flatnonzero(live)]
+    while work:
+        t = work.pop()
+        for s in rev[t]:
+            if not live[s]:
+                live[s] = True
+                work.append(s)
+    trap = (table >= 0) & ~live[np.maximum(table, 0)]
+    table[trap] = _REJECT
+    mask[trap] = -1e9
+    # dead-end guard over states actually REACHABLE from the start
+    # (unreachable char-DFA states legitimately have no token cover):
+    # a reachable state where nothing (incl. eos) is allowed would
+    # force garbage tokens through the mask
+    reach = np.zeros(n_states, bool)
+    reach[0] = True
+    work = [0]
+    while work:
+        s = work.pop()
+        row = table[s]
+        for t in np.unique(row[row >= 0]):
+            if not reach[t]:
+                reach[t] = True
+                work.append(int(t))
+    dead = (mask <= -1e9 / 2).all(axis=1) & reach
     if dead.any():
         raise ValueError(
             f"grammar has dead-end states {np.flatnonzero(dead).tolist()}"
             " (no token or eos allowed); widen the pattern or the "
             "vocabulary")
     return TokenDfa(table=table, mask=mask, start=0)
+
+
+# -- served-grammar helpers --------------------------------------------------
+#
+# The front door (server.py) compiles per-request constraints through
+# these: a `guided_regex` pattern is used verbatim; `guided_json` /
+# OpenAI `response_format` lowers to a bounded-depth JSON regex (a
+# regular-language approximation of JSON — the standard trick for
+# DFA-based guided decoding, since true JSON nesting is not regular).
+
+_JSON_WS = r"\s*"
+# RFC 8259-strict lowering (under-constraining would let "guided JSON"
+# emit unparseable output): string chars exclude raw control bytes,
+# escapes are the legal set only, integers forbid leading zeros
+_JSON_CTRL = "".join(chr(c) for c in range(0x20))
+_JSON_HEX = "[0-9a-fA-F]"
+_JSON_STRING = ('"([^"\\\\' + _JSON_CTRL + ']|\\\\(["\\\\/bfnrt]'
+                f"|u{_JSON_HEX}{_JSON_HEX}{_JSON_HEX}{_JSON_HEX}))*\"")
+_JSON_NUMBER = r"-?(0|[1-9]\d*)(\.\d+)?([eE][+-]?\d+)?"
+_JSON_SCALAR = (f"({_JSON_STRING}|{_JSON_NUMBER}"
+                "|true|false|null)")
+
+
+def json_value_regex(depth: int = 3) -> str:
+    """Regex for a JSON value with nesting bounded at *depth* (0 =
+    scalars only).  OpenAI ``response_format={"type": "json_object"}``
+    maps here: the model may emit any JSON object up to the depth
+    bound."""
+    if depth < 0:
+        raise ValueError("depth must be >= 0")
+    val = _JSON_SCALAR
+    for _ in range(depth):
+        pair = f"{_JSON_STRING}{_JSON_WS}:{_JSON_WS}{val}"
+        obj = (f"\\{{{_JSON_WS}({pair}({_JSON_WS},{_JSON_WS}{pair})*)?"
+               f"{_JSON_WS}\\}}")
+        arr = (f"\\[{_JSON_WS}({val}({_JSON_WS},{_JSON_WS}{val})*)?"
+               f"{_JSON_WS}\\]")
+        val = f"({_JSON_SCALAR}|{obj}|{arr})"
+    return val
+
+
+def json_object_regex(depth: int = 3) -> str:
+    """Regex for a JSON OBJECT (not a bare scalar/array) with member
+    values nested up to ``depth - 1`` — the OpenAI
+    ``response_format={"type": "json_object"}`` contract, which
+    promises an object, not any JSON value."""
+    if depth < 1:
+        raise ValueError("depth must be >= 1")
+    val = json_value_regex(depth - 1)
+    pair = f"{_JSON_STRING}{_JSON_WS}:{_JSON_WS}{val}"
+    return (f"\\{{{_JSON_WS}({pair}({_JSON_WS},{_JSON_WS}{pair})*)?"
+            f"{_JSON_WS}\\}}")
+
+
+def _regex_escape(text: str) -> str:
+    """Escape *text* for the module's regex subset (literal match)."""
+    return "".join(
+        "\\" + c if c in "\\()[]{}*+?|." else c for c in text)
+
+
+def schema_to_regex(schema: dict, depth: int = 3) -> str:
+    """Lower a JSON-schema SUBSET to a regex: ``type`` of string /
+    integer / number / boolean / null, ``enum`` of scalars, ``array``
+    with ``items``, and ``object`` with ``properties`` (all properties
+    required, emitted in declaration order — the shape constrained
+    decoding guarantees, mirroring vLLM's guided_json ordering).
+    Unsupported keywords raise ValueError so callers 400 instead of
+    silently under-constraining."""
+    if not isinstance(schema, dict):
+        raise ValueError("schema must be a JSON object")
+    # reject keywords whose absence from the lowering could make the
+    # OUTPUT violate the schema (minimum, pattern, maxLength, ...):
+    # silent under-constraining is exactly what the 400 path exists to
+    # prevent.  Keys that only ever OVER-constrain relative to our
+    # all-properties/declaration-order contract (required,
+    # additionalProperties) or are annotations are safe to ignore.
+    unsafe = set(schema) - {
+        "type", "enum", "items", "properties", "required",
+        "additionalProperties", "title", "description", "default",
+        "$schema", "examples",
+    }
+    if unsafe:
+        raise ValueError(
+            f"unsupported schema keywords {sorted(unsafe)}: the "
+            "served subset cannot enforce them, and ignoring them "
+            "would silently under-constrain the output")
+    if "enum" in schema:
+        import json as _json
+
+        opts = []
+        for v in schema["enum"]:
+            if v is None or isinstance(v, (bool, str, int, float)):
+                # JSON-encode FIRST (quotes/backslashes in strings
+                # must come out as \" / \\ escape sequences, or the
+                # DFA would force unparseable output), then escape
+                # the encoding for the regex subset
+                opts.append(_regex_escape(_json.dumps(v)))
+            else:
+                raise ValueError(f"unsupported enum value {v!r}")
+        return "(" + "|".join(opts) + ")"
+    t = schema.get("type")
+    if t == "string":
+        return _JSON_STRING
+    if t == "integer":
+        return r"-?(0|[1-9]\d*)"  # RFC 8259: no leading zeros
+    if t == "number":
+        return _JSON_NUMBER
+    if t == "boolean":
+        return "(true|false)"
+    if t == "null":
+        return "null"
+    if t == "array":
+        item = (schema_to_regex(schema["items"], depth)
+                if "items" in schema else json_value_regex(depth))
+        return (f"\\[{_JSON_WS}({item}({_JSON_WS},{_JSON_WS}{item})*)?"
+                f"{_JSON_WS}\\]")
+    if t == "object":
+        props = schema.get("properties")
+        if not props:
+            # a schemaless object is still an OBJECT, never a scalar
+            return json_object_regex(max(depth, 1))
+        import json as _json
+
+        pairs = []
+        for name, sub in props.items():
+            key = _regex_escape(_json.dumps(name))
+            pairs.append(
+                f"{key}{_JSON_WS}:{_JSON_WS}"
+                + schema_to_regex(sub, depth))
+        body = f"{_JSON_WS},{_JSON_WS}".join(pairs)
+        return f"\\{{{_JSON_WS}{body}{_JSON_WS}\\}}"
+    raise ValueError(
+        f"unsupported schema {schema!r}: the served subset covers "
+        "type string/integer/number/boolean/null/array/object and "
+        "scalar enum")
+
+
+def _gpt2_byte_decoder() -> Dict[str, int]:
+    """The GPT-2 byte-level BPE printable-unicode <-> byte table
+    (public algorithm from the GPT-2 tokenizer; every byte-level
+    tokenizer since reuses it)."""
+    bs = (list(range(33, 127)) + list(range(161, 173))
+          + list(range(174, 256)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return {chr(c): b for b, c in zip(bs, cs)}
+
+
+def token_bytes_of(tokenizer, vocab_size: Optional[int] = None
+                   ) -> List[bytes]:
+    """Best-effort per-token byte strings for *tokenizer* (the input
+    ``token_dfa`` needs): handles sentencepiece ``▁``-space and
+    ``<0xHH>`` byte-fallback tokens, GPT-2-style byte-level BPE
+    surface forms, and plain vocab entries; special tokens (and ids
+    past the tokenizer's size, for padded model vocabs) map to ``b""``
+    so the DFA rejects them everywhere.  This is the same
+    token-to-bytes dance outlines/xgrammar do for vLLM's guided
+    decoding."""
+    try:
+        size = len(tokenizer)
+    except TypeError:
+        size = None  # minimal tokenizers (test fakes) are unsized
+    V = vocab_size if vocab_size is not None else size
+    if V is None:
+        raise ValueError(
+            "tokenizer has no __len__; pass vocab_size explicitly")
+    specials = set(getattr(tokenizer, "all_special_ids", None) or ())
+    convert = getattr(tokenizer, "convert_ids_to_tokens", None)
+    byte_dec = _gpt2_byte_decoder()
+    out: List[bytes] = []
+    for i in range(V):
+        if i in specials or (size is not None and i >= size):
+            out.append(b"")
+            continue
+        s = convert(i) if convert is not None else None
+        if not isinstance(s, str):
+            out.append(tokenizer.decode([i]).encode("utf-8"))
+            continue
+        m = _re.fullmatch(r"<0x([0-9A-Fa-f]{2})>", s)
+        if m:
+            out.append(bytes([int(m.group(1), 16)]))
+        elif "▁" in s:  # sentencepiece's ▁ word-boundary space
+            out.append(s.replace("▁", " ").encode("utf-8"))
+        elif all(c in byte_dec for c in s):
+            out.append(bytes(byte_dec[c] for c in s))
+        else:
+            out.append(s.encode("utf-8"))
+    return out

@@ -26,7 +26,14 @@ API (JSON over HTTP/1.1):
                     "adapter": a?, "stop": [int...]?,
                     "ignore_eos": bool?, "seed": s?, "logprobs": k?,
                     "prompt_logprobs": k?, "n": c?, "priority": p?,
+                    "guided_regex": pattern?, "guided_json": true|schema?,
                     "stream": true?}
+                   guided_regex / guided_json constrain the output to
+                   a regex / JSON (vLLM's guided decoding): the server
+                   lowers the constraint to a token-level DFA riding
+                   the compiled decode scan.  Constrained requests
+                   decode via run_scan; a draft-loaded engine's spec
+                   rounds resume once no constrained slot is active.
                    n > 1 returns c completions: token events carry
                    "index", the final event has "choices" (copies
                    admit incrementally and share the prompt via the
@@ -38,8 +45,10 @@ API (JSON over HTTP/1.1):
   POST /v1/completions   OpenAI-compatible text completions (needs
                    --tokenizer): string or token-array "prompt",
                    max_tokens/temperature/top_p/n/seed/penalties/
-                   logprobs/stop, "stream": true = SSE data: chunks
-                   ending in [DONE]; usage token accounting.
+                   logprobs/stop, "response_format" {"type":
+                   "json_object" | "json_schema"} and "guided_regex"
+                   for guided decoding, "stream": true = SSE data:
+                   chunks ending in [DONE]; usage token accounting.
   POST /v1/chat/completions   chat variant: "messages" rendered by
                    the tokenizer's chat template; responses carry
                    message/delta objects in the chat wire shape.
@@ -57,6 +66,7 @@ touching the compiled decode path.
 from __future__ import annotations
 
 import argparse
+import bisect
 import heapq
 import json
 import logging
@@ -67,6 +77,13 @@ from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
+from .grammar import (
+    json_value_regex,
+    regex_to_dfa,
+    schema_to_regex,
+    token_bytes_of,
+    token_dfa,
+)
 from .serving import ServingEngine
 
 log = logging.getLogger(__name__)
@@ -76,13 +93,6 @@ log = logging.getLogger(__name__)
 # queue, longer ones amortize host round-trips harder
 DEFAULT_WINDOW = 8
 _IDLE_POLL_S = 0.05
-
-
-def _earliest_stop(text: str, stop_strs) -> int:
-    """Index of the earliest stop-string match in *text*, or -1."""
-    hits = [text.find(s) for s in stop_strs]
-    hits = [h for h in hits if h >= 0]
-    return min(hits) if hits else -1
 
 
 def _holdback(text: str, stop_strs) -> int:
@@ -98,20 +108,77 @@ def _holdback(text: str, stop_strs) -> int:
     return h
 
 
-def _truncate_at_stop(tok, ids, stop_strs, start: int = 1):
-    """Scan prefix decodes for the first stop-string hit, beginning at
-    prefix length *start* (the caller's resume point — tokens below it
-    were proven match-free in earlier windows, so each token is scanned
-    once per request, not once per window): returns (kept token count,
-    truncated text) or (None, None).  The kept tokens include the token
-    that completed the match; the TEXT stops at the match start
-    (vLLM's default, stop string excluded)."""
-    for t in range(max(1, start), len(ids) + 1):
-        txt = tok.decode(ids[:t])
-        pos = _earliest_stop(txt, stop_strs)
-        if pos >= 0:
-            return t, txt[:pos]
-    return None, None
+class _DetokState:
+    """Incremental detokenization for one stream copy (vLLM's
+    prefix/read-offset scheme): each committed token decodes a BOUNDED
+    trailing window — decode(ids[prefix:t]) minus the already-read
+    decode(ids[prefix:read]) — so total tokenizer work is O(T · window)
+    instead of the O(T^2) full-prefix re-decodes that used to run on
+    the scheduler thread (ADVICE r4).  Offsets advance only when the
+    tail is UTF-8 stable (no trailing U+FFFD), so a char split across
+    tokens (BPE byte fallback) commits once its last byte arrives.
+
+    ``text`` is the committed text; ``cum[t]`` is its length after
+    token t committed — the token<->char map stop scanning needs."""
+
+    __slots__ = ("prefix_off", "read_off", "text", "cum")
+
+    def __init__(self):
+        self.prefix_off = 0
+        self.read_off = 0
+        self.text = ""
+        self.cum = [0]
+
+    def feed(self, tok, ids, n: int) -> None:
+        """Commit tokens up to count *n* (monotonic)."""
+        while len(self.cum) - 1 < n:
+            t = len(self.cum)
+            full = tok.decode([int(i) for i in ids[self.prefix_off:t]])
+            prefix = (tok.decode(
+                [int(i) for i in ids[self.prefix_off:self.read_off]])
+                if self.read_off > self.prefix_off else "")
+            delta = full[len(prefix):]
+            if delta and not delta.endswith("�"):
+                self.text += delta
+                self.prefix_off = self.read_off
+                self.read_off = t
+            self.cum.append(len(self.text))
+
+
+def _find_stop(st: _DetokState, stop_strs, scanned_from: int):
+    """Earliest-completing NEW stop match in the committed text past
+    char offset *scanned_from* (earlier chars were proven match-free;
+    the window re-covers max(len)-1 overlap chars so a stop spanning
+    the boundary is still seen).  Returns (kept token count, truncated
+    text) or (None, None): the kept tokens include the token that
+    completed the match, the TEXT stops at the earliest start of any
+    match visible by then (vLLM's default, stop string excluded)."""
+    lo = max(0, scanned_from - (max(len(s) for s in stop_strs) - 1))
+    best = None  # (end, pos) of the first COMPLETED new match
+    for s in stop_strs:
+        p = st.text.find(s, lo)
+        while p >= 0:
+            if p + len(s) > scanned_from:
+                # first NEW completion of this stop; earlier (stale)
+                # occurrences in the overlap window must not shadow it
+                e = (p + len(s), p)
+                if best is None or e < best:
+                    best = e
+                break
+            p = st.text.find(s, p + 1)
+    if best is None:
+        return None, None
+    end, pos = best
+    # the text cut is the earliest START among matches completed by
+    # *end* (a longer stop beginning earlier but ending later is not
+    # yet complete and does not count — same rule as prefix scanning)
+    for s in stop_strs:
+        p = st.text.find(s, lo)
+        while p >= 0 and p + len(s) <= end:
+            pos = min(pos, p)
+            p = st.text.find(s, p + 1)
+    keep = bisect.bisect_left(st.cum, end)
+    return keep, st.text[:pos]
 
 
 def _openai_chunk(rid: str, model_name: str, ev: dict, sent: dict,
@@ -254,10 +321,16 @@ class _Request:
     stop_strs: Optional[List[str]] = None
     detokenize: bool = False          # emit "text" deltas + final text
     text_sent: dict = field(default_factory=dict)  # idx -> emitted str
-    stop_scanned: dict = field(default_factory=dict)  # idx -> resume t
+    detok: dict = field(default_factory=dict)  # idx -> _DetokState
+    stop_scanned: dict = field(default_factory=dict)  # idx -> char off
     openai_logprobs: Optional[int] = None  # client-requested count
     logit_bias: Optional[dict] = None      # {token id: bias}
     min_tokens: int = 0                    # eos/stop floor (vLLM)
+    # guided decoding (vLLM's guided_regex / OpenAI response_format):
+    # the handler thread compiles the pattern to a TokenDfa (cached by
+    # pattern); the scheduler registers it with the engine at admit
+    grammar_key: Optional[str] = None      # cache key (the pattern)
+    grammar_tdfa: object = None            # compiled, pre-registration
 
 
 class EngineServer:
@@ -271,7 +344,9 @@ class EngineServer:
     def __init__(self, engine: ServingEngine,
                  max_new_tokens: int = 64,
                  window: int = DEFAULT_WINDOW,
-                 tokenizer=None):
+                 tokenizer=None,
+                 token_bytes: Optional[List[bytes]] = None,
+                 max_grammars: int = 64):
         """*tokenizer* (anything with ``encode(str) -> List[int]`` and
         ``decode(List[int]) -> str``, e.g. a transformers tokenizer)
         unlocks the text-level surface: ``"prompt"`` strings, STRING
@@ -290,6 +365,18 @@ class EngineServer:
         self.default_max_new = max_new_tokens
         self.window = window
         self.tokenizer = tokenizer
+        # guided decoding: per-token byte strings let the server lower
+        # per-request regex/JSON constraints to the engine's TokenDfa.
+        # Explicit *token_bytes* wins; otherwise derived lazily from
+        # the tokenizer on the first grammar request.  The pattern ->
+        # TokenDfa cache is bounded (max_grammars) because each
+        # distinct pattern also occupies rows in the engine's combined
+        # grammar table for the engine's lifetime.
+        self._token_bytes = token_bytes
+        self.max_grammars = max_grammars
+        self._grammar_tdfas: dict = {}    # pattern -> TokenDfa
+        self._grammar_gids: dict = {}     # pattern -> engine gid
+        self._glock = threading.Lock()
         # priority heap (vLLM's priority scheduling): higher-priority
         # requests admit first, FIFO within a priority level (the
         # monotonic sequence number breaks ties).  Guarded by _lock —
@@ -352,6 +439,17 @@ class EngineServer:
                                 f"max_len {eng.model.max_len}")
                         req.max_new_tokens = budget
                     req.budget_capped = True
+                gid: object = False
+                if req.grammar_key is not None:
+                    # engine-side registration happens HERE because the
+                    # scheduler is the engine's sole owner; the pattern
+                    # cache makes it once-per-pattern, so the steady
+                    # state is a dict lookup
+                    gid = self._grammar_gids.get(req.grammar_key)
+                    if gid is None:
+                        gid = eng.register_grammar(req.grammar_tdfa)
+                        self._grammar_gids[req.grammar_key] = gid
+                    req.grammar_tdfa = None  # registered; drop the ref
                 slot = eng.admit(
                     req.tokens, temperature=req.temperature,
                     top_k=req.top_k, top_p=req.top_p,
@@ -375,7 +473,8 @@ class EngineServer:
                     prompt_logprobs=(req.prompt_logprobs
                                      if req.admitted == 0 else None),
                     logit_bias=req.logit_bias,
-                    min_tokens=req.min_tokens)
+                    min_tokens=req.min_tokens,
+                    grammar=gid)
             except (ValueError, RuntimeError) as e:
                 # identical args per copy, so only the FIRST admit can
                 # fail on validation (the free-slot guard rules out
@@ -403,21 +502,34 @@ class EngineServer:
         eng = self.engine
         seen = req.emitted[idx]
         new = tokens[seen:req.max_new_tokens]
+        st = None
+        if (req.stop_strs or req.detokenize) and self.tokenizer:
+            st = req.detok.setdefault(idx, _DetokState())
+            st.feed(self.tokenizer, tokens, min(len(tokens),
+                                                req.max_new_tokens))
         stop_text = None  # truncated text when a stop string matched
         if req.stop_strs and new:
             # min_tokens floors stop strings too (vLLM: no stop check
-            # below the floor): starting the scan past the floor means
+            # below the floor): scanning starts only past the floor, so
             # a match can only complete at token min_tokens+1 or later
-            keep, text = _truncate_at_stop(
-                self.tokenizer, tokens[:seen + len(new)],
-                req.stop_strs,
-                start=max(req.stop_scanned.get(idx, 1),
-                          req.min_tokens + 1))
+            keep = scanned = None
+            if seen + len(new) > req.min_tokens:
+                keep, text = _find_stop(
+                    st, req.stop_strs, req.stop_scanned.get(idx, 0))
+                scanned = True
             if keep is not None:
+                # kept tokens include the completing token, and at
+                # least the floor (the match itself may sit below it)
+                keep = max(keep, min(req.min_tokens + 1,
+                                     seen + len(new)))
                 new = tokens[seen:keep] if keep > seen else []
                 stop_text = text
-            else:
-                req.stop_scanned[idx] = seen + len(new) + 1
+            elif scanned:
+                # resume point advances ONLY past text a scan actually
+                # covered — below the floor nothing was scanned, and a
+                # match there must still surface at the first
+                # post-floor scan
+                req.stop_scanned[idx] = len(st.text)
         lps = (eng.token_logprobs(slot) if req.logprobs else None)
         for j, t in enumerate(new):
             ev = {"token": int(t)}
@@ -433,21 +545,26 @@ class EngineServer:
         done = (stop_text is not None
                 or req.emitted[idx] >= req.max_new_tokens or finished)
         if req.detokenize:
+            # the committed incremental text (never ends mid-char:
+            # _DetokState withholds UTF-8-unstable tails, so the old
+            # U+FFFD backscan is structurally unnecessary), capped at
+            # the emitted token count; a stop match overrides with its
+            # truncation.  An eos finish excludes the eos token from
+            # the TEXT (OpenAI/vLLM semantics: special tokens never
+            # reach text; the ids surface keeps it)
+            n_text = req.emitted[idx]
+            if (stop_text is None and finished and n_text
+                    and eng.finish_reason(slot) == "eos"
+                    and int(tokens[n_text - 1]) == eng.eos_id):
+                n_text -= 1
             cur = (stop_text if stop_text is not None
-                   else self.tokenizer.decode(
-                       [int(t) for t in tokens[:req.emitted[idx]]]))
+                   else st.text[:st.cum[n_text]])
             hold = (0 if done or not req.stop_strs
                     else _holdback(cur, req.stop_strs))
             safe = len(cur) - hold
-            # BPE/byte-fallback decodes are not prefix-stable: a char
-            # split across tokens decodes as U+FFFD until its last
-            # byte arrives, and would never be corrected once
-            # streamed — withhold unstable tails, and if an earlier
-            # emission turns out to mismatch (merge rewrote history),
-            # stop emitting deltas; the final event carries the
-            # authoritative full text either way
-            while not done and safe > 0 and cur[safe - 1] == "�":
-                safe -= 1
+            # if an earlier emission turns out to mismatch (a stop
+            # truncation rewrote history), stop emitting deltas; the
+            # final event carries the authoritative full text
             sent = req.text_sent.get(idx, "")
             if cur[:len(sent)] == sent and safe > len(sent):
                 ev = {"text": cur[len(sent):safe]}
@@ -483,9 +600,14 @@ class EngineServer:
                 "finish_reason": reason,
             }
             if req.detokenize:
+                text_ids = [int(t) for t in out]
+                if (stop_text is None and reason == "eos" and text_ids
+                        and text_ids[-1] == eng.eos_id):
+                    # eos is data on the ids surface, never text
+                    text_ids = text_ids[:-1]
                 choice["text"] = (
                     stop_text if stop_text is not None
-                    else self.tokenizer.decode([int(t) for t in out]))
+                    else self.tokenizer.decode(text_ids))
             if req.logprobs:
                 choice["logprobs"] = [
                     {"logprob": clp,
@@ -873,6 +995,76 @@ class EngineServer:
 
     # -- request plumbing ---------------------------------------------------
 
+    def _token_byte_table(self) -> List[bytes]:
+        """Per-token byte strings for grammar compilation: the
+        explicit constructor table, or derived once from the tokenizer
+        (the outlines/xgrammar token-to-bytes mapping)."""
+        if self._token_bytes is None:
+            if self.tokenizer is None:
+                raise ValueError(
+                    "guided decoding needs a token-to-bytes table: "
+                    "start the server with --tokenizer (or "
+                    "EngineServer(token_bytes=...))")
+            self._token_bytes = token_bytes_of(
+                self.tokenizer, self.engine.model.vocab)
+        return self._token_bytes
+
+    def _compile_grammar(self, pattern: str):
+        """Pattern -> TokenDfa, cached: compilation runs on the
+        HANDLER thread (it is pure — the engine is untouched), so slow
+        first-compiles of big grammars never stall the scheduler loop;
+        concurrent first requests may compile twice, last write wins
+        harmlessly.  The engine-side register happens later, on the
+        scheduler thread (see _admit_pending)."""
+        with self._glock:
+            tdfa = self._grammar_tdfas.get(pattern)
+            if tdfa is None and len(self._grammar_tdfas) >= \
+                    self.max_grammars:
+                raise ValueError(
+                    f"grammar cache full ({self.max_grammars} distinct "
+                    "patterns); raise --max-grammars or reuse patterns")
+        if tdfa is None:
+            tdfa = token_dfa(regex_to_dfa(pattern),
+                             self._token_byte_table(),
+                             eos_id=self.engine.eos_id)
+            with self._glock:
+                # re-check under the lock: concurrent first requests
+                # with DISTINCT new patterns each passed the earlier
+                # size check and must not overshoot the bound (cache
+                # entries pin engine grammar-table rows for life)
+                if pattern not in self._grammar_tdfas and \
+                        len(self._grammar_tdfas) >= self.max_grammars:
+                    raise ValueError(
+                        f"grammar cache full ({self.max_grammars} "
+                        "distinct patterns); raise --max-grammars or "
+                        "reuse patterns")
+                tdfa = self._grammar_tdfas.setdefault(pattern, tdfa)
+        return tdfa
+
+    def _grammar_request(self, body: dict) -> Optional[str]:
+        """Extract the guided-decoding constraint from a native body:
+        ``guided_regex`` (a pattern in the served regex subset) or
+        ``guided_json`` (true = any JSON, or a schema-subset object).
+        Returns the lowered regex pattern, or None."""
+        regex = body.get("guided_regex")
+        gjson = body.get("guided_json")
+        if regex is not None and gjson is not None:
+            raise ValueError(
+                "pass 'guided_regex' OR 'guided_json', not both")
+        if regex is not None:
+            if not isinstance(regex, str) or not regex:
+                raise ValueError(
+                    "'guided_regex' must be a non-empty pattern string")
+            return regex
+        if gjson is None:
+            return None
+        if gjson is True:
+            return json_value_regex()
+        if isinstance(gjson, dict):
+            return schema_to_regex(gjson)
+        raise ValueError(
+            "'guided_json' must be true or a JSON-schema object")
+
     def _openai_to_native(self, body: dict):
         """Translate an OpenAI /v1/completions body onto the native
         request shape.  Returns (native_body, model_name)."""
@@ -929,6 +1121,34 @@ class EngineServer:
             native["logit_bias"] = opt("logit_bias")
         if opt("min_tokens") is not None:  # vLLM's OpenAI extension
             native["min_tokens"] = int(opt("min_tokens"))
+        rf = opt("response_format")
+        if rf is not None:
+            # OpenAI guided decoding: json_object constrains to any
+            # JSON value, json_schema to the declared schema subset
+            if not isinstance(rf, dict) or "type" not in rf:
+                raise ValueError(
+                    "'response_format' must be an object with 'type'")
+            kind = rf["type"]
+            if kind == "json_object":
+                # the OpenAI contract is an OBJECT, not any JSON value
+                native["guided_json"] = {"type": "object"}
+            elif kind == "json_schema":
+                js = rf.get("json_schema")
+                schema = js.get("schema") if isinstance(js, dict) \
+                    else None
+                if not isinstance(schema, dict):
+                    # a 400 beats silently under-constraining: the
+                    # client believes its schema is enforced
+                    raise ValueError(
+                        "'response_format.json_schema.schema' must be "
+                        "a schema object")
+                native["guided_json"] = schema
+            elif kind != "text":
+                raise ValueError(
+                    f"unsupported response_format type {kind!r} "
+                    "(text, json_object, json_schema)")
+        if opt("guided_regex") is not None:  # vLLM's OpenAI extension
+            native["guided_regex"] = opt("guided_regex")
         return native, str(opt("model", "default"))
 
     def _openai_chat_to_native(self, body: dict):
@@ -1049,6 +1269,18 @@ class EngineServer:
                     "with --tokenizer); pass stop token ids instead")
             stop = stop or None
             stop_strs = stop_strs or None
+        grammar_key = grammar_tdfa = None
+        pattern = self._grammar_request(body)
+        if pattern is not None:
+            if self.engine.eos_id is None:
+                raise ValueError(
+                    "guided decoding needs an engine eos id (the "
+                    "grammar gates completion on it)")
+            # compiles (or cache-hits) here on the handler thread;
+            # regex syntax errors and vocabulary dead-ends surface as
+            # this request's 400, never a scheduler stall
+            grammar_tdfa = self._compile_grammar(pattern)
+            grammar_key = pattern
         return _Request(
             tokens=tokens,
             max_new_tokens=max_new,
@@ -1074,6 +1306,8 @@ class EngineServer:
             prompt_logprobs=(None if prompt_logprobs is None
                              else int(prompt_logprobs)),
             n=n,
+            grammar_key=grammar_key,
+            grammar_tdfa=grammar_tdfa,
         )
 
     def stats(self) -> dict:
@@ -1086,6 +1320,7 @@ class EngineServer:
             "running_copies": len(self._running),
             "requests_served": self._requests_served,
             "requests_rejected": self._requests_rejected,
+            "grammar_patterns": len(self._grammar_tdfas),
             "window": self.window,
         })
         return st
@@ -1125,6 +1360,10 @@ def main(argv=None) -> int:
                    help="draft-free prompt-lookup speculation with "
                         "N-gram matching (vLLM's [ngram] mode); "
                         "mutually exclusive with --draft-config")
+    p.add_argument("--max-grammars", type=int, default=64,
+                   help="distinct guided-decoding patterns cached per "
+                        "server lifetime (each occupies engine grammar "
+                        "table rows)")
     p.add_argument("--tokenizer", default=None, metavar="NAME_OR_PATH",
                    help="transformers tokenizer enabling the text "
                         "surface: 'prompt' strings, stop STRINGS, "
@@ -1190,7 +1429,8 @@ def main(argv=None) -> int:
         except Exception as e:
             p.error(f"could not load tokenizer {args.tokenizer!r}: {e}")
     srv = EngineServer(engine, max_new_tokens=args.max_new_tokens,
-                       window=args.window, tokenizer=tokenizer)
+                       window=args.window, tokenizer=tokenizer,
+                       max_grammars=args.max_grammars)
     srv.start(host=args.host, port=args.port)
     print(f"serving {args.config} (quantized={quantized}) on "
           f"http://{args.host}:{srv.port}  "

@@ -564,23 +564,23 @@ class ServingEngine:
         self._min_mask = jnp.zeros((n_slots, model.vocab), jnp.float32)
         self.min_toks = np.zeros(n_slots, np.int32)
         # grammar-constrained decoding (vLLM's guided decoding, the
-        # TPU way): ONE engine-wide token-level DFA (grammar.TokenDfa —
-        # mask [N, V] and table [N, V]) whose per-slot state rides the
-        # decode scan's carry; requests opt in with admit(grammar=True)
-        # and pay one gather + one add per step, inside the same
-        # compiled step as everyone else.  gstate -1 = unconstrained.
-        self._grammar = None
+        # TPU way): a REGISTRY of token-level DFAs (grammar.TokenDfa —
+        # mask [N, V] and table [N, V] each) concatenated into ONE
+        # combined table/mask pair with per-grammar state offsets; the
+        # per-slot state rides the decode scan's carry.  Requests opt
+        # in with admit(grammar=<gid>) (True = grammar 0) and pay one
+        # gather + one add per step, inside the same compiled step as
+        # everyone else.  gstate -1 = unconstrained.  The combined
+        # table's CAPACITY doubles when a registration outgrows it —
+        # one scan recompile per doubling, never per request (the
+        # compile key is the table shape; see register_grammar).
+        self._goffsets: List[int] = []
+        self._gstates_used = 0
+        self._gtable_np = self._gmask_np = None
+        self._gtable = self._gmask = None
         self.gstate = np.full(n_slots, -1, np.int32)
         if grammar is not None:
-            if grammar.table.shape[1] != model.vocab:
-                raise ValueError(
-                    f"grammar vocab {grammar.table.shape[1]} != model "
-                    f"vocab {model.vocab}")
-            self._grammar = grammar
-            self._gtable_np = np.asarray(grammar.table, np.int32)
-            self._gmask = jnp.asarray(grammar.mask, jnp.float32)
-            self._gtable = jnp.asarray(self._gtable_np)
-            self._gstart = int(grammar.start)
+            self.register_grammar(grammar)
         # per-slot LoRA adapter ids (-1 = base model); only consulted
         # when the model was built with n_adapters > 0
         self.adapters = np.full(n_slots, -1, np.int32)
@@ -635,6 +635,55 @@ class ServingEngine:
             self._draft_params = draft_params
             self._draft_cache = self._place_cache(
                 init_cache(draft_model, n_slots))
+
+    def register_grammar(self, grammar) -> int:
+        """Register a token-level DFA (``grammar.TokenDfa``); returns a
+        grammar id for ``admit(grammar=gid)``.  All registered grammars
+        share ONE combined ``[N, V]`` table/mask (each grammar's states
+        offset into it), so the compiled decode step keys on the
+        table's SHAPE, not the grammar count: capacity doubles when a
+        registration outgrows it (one recompile per doubling — the
+        vLLM-guided-decoding analog of compiling a new FSM once and
+        caching it), and registrations within capacity are pure data.
+        """
+        if grammar.table.shape[1] != self.model.vocab:
+            raise ValueError(
+                f"grammar vocab {grammar.table.shape[1]} != model "
+                f"vocab {self.model.vocab}")
+        n_new = int(grammar.table.shape[0])
+        off = self._gstates_used
+        need = off + n_new
+        cap = 0 if self._gtable_np is None else self._gtable_np.shape[0]
+        if need > cap:
+            new_cap = max(64, 1 << (need - 1).bit_length())
+            table = np.full((new_cap, self.model.vocab), -1, np.int32)
+            # padding rows are unreachable (every start state and
+            # transition stays inside a registered grammar's rows);
+            # zero masks keep them inert even if that ever changed
+            mask = np.zeros((new_cap, self.model.vocab), np.float32)
+            if self._gtable_np is not None:
+                table[:off] = self._gtable_np[:off]
+                mask[:off] = self._gmask_np[:off]
+            self._gtable_np, self._gmask_np = table, mask
+        # local state ids shift by this grammar's offset; rejects stay -1
+        self._gtable_np[off:need] = np.where(
+            np.asarray(grammar.table, np.int32) >= 0,
+            np.asarray(grammar.table, np.int32) + np.int32(off),
+            np.int32(-1))
+        self._gmask_np[off:need] = np.asarray(grammar.mask, np.float32)
+        self._gstates_used = need
+        self._goffsets.append(off + int(grammar.start))
+        # device mirrors rebuild on every registration (cheap [N, V]
+        # host-to-device copies; same shape unless capacity grew)
+        self._gtable = jnp.asarray(self._gtable_np)
+        self._gmask = jnp.asarray(self._gmask_np)
+        return len(self._goffsets) - 1
+
+    @property
+    def n_grammars(self) -> int:
+        """How many grammars are registered (admit gids are
+        ``range(n_grammars)``)."""
+        return len(self._goffsets)
 
     def _place_cache(self, cache):
         """Apply the TP shardings to a cache pytree (no-op meshless)."""
@@ -833,7 +882,7 @@ class ServingEngine:
               prompt_logprobs: Optional[int] = None,
               logit_bias: Optional[Dict[int, float]] = None,
               min_tokens: int = 0,
-              grammar: bool = False) -> int:
+              grammar: Union[bool, int] = False) -> int:
         """Prefill *prompt* into a free slot; returns the slot id.
         Raises RuntimeError when the engine is full (callers queue).
         With ``prefix`` (a :meth:`register_prefix` handle), the prompt
@@ -910,10 +959,37 @@ class ServingEngine:
         # row max_len - 1, which this bound keeps out of the prompt
         # rows, so released-slot donor records stay valid K/V
         assert t_p <= self.model.max_len - 1
-        if grammar and self._grammar is None:
-            raise ValueError(
-                "engine was built without a grammar "
-                "(ServingEngine(..., grammar=TokenDfa))")
+        if self._draft_model is not None or self._ngram:
+            # with a speculative proposer the donor invariant is
+            # STRONGER: spec_round's verify extend writes T = gamma+1
+            # rows for EVERY slot, and a parked slot's clamped write
+            # band is [max_len-gamma-1, max_len-1] — prompt K/V must
+            # sit strictly below it or later rounds silently corrupt
+            # the slot's APC donor rows
+            spec_limit = self.model.max_len - self.gamma - 1
+            if t_p > spec_limit:
+                raise ValueError(
+                    f"prompt {t_p} exceeds the speculative donor bound "
+                    f"{spec_limit} (max_len - gamma - 1): parked-slot "
+                    "prompt K/V must stay below the clamped verify "
+                    "band; shorten the prompt, raise max_len, or "
+                    "lower gamma")
+        # grammar opt-in: True = grammar 0 (the ctor grammar), an int
+        # selects a register_grammar() id; gstart -1 = unconstrained
+        if grammar is False or grammar is None:
+            gstart = -1
+        else:
+            if not self._goffsets:
+                raise ValueError(
+                    "engine has no grammar registered "
+                    "(ServingEngine(..., grammar=TokenDfa) or "
+                    "register_grammar())")
+            gid = 0 if grammar is True else int(grammar)
+            if not 0 <= gid < len(self._goffsets):
+                raise ValueError(
+                    f"unknown grammar id {gid} (registered: "
+                    f"{len(self._goffsets)})")
+            gstart = self._goffsets[gid]
         if min_tokens < 0:
             raise ValueError("min_tokens must be >= 0")
         if (min_tokens and self.max_new_tokens is not None
@@ -937,6 +1013,14 @@ class ServingEngine:
                 if not np.isfinite(float(bv)):
                     raise ValueError(
                         "logit_bias values must be finite")
+                if not -100.0 <= float(bv) <= 100.0:
+                    # OpenAI clamps to [-100, 100]; beyond that a bias
+                    # could overpower the -1e9 additive masks that
+                    # implement min_tokens floors and grammar
+                    # constraints
+                    raise ValueError(
+                        f"logit_bias value {float(bv)} outside "
+                        "[-100, 100]")
         free = self.free_slots()
         if not free:
             raise RuntimeError("no free slots")
@@ -1076,7 +1160,7 @@ class ServingEngine:
                 self._bias = _zero_count_row(self._bias, slot)
                 self._bias_on[slot] = False
             bias_row = None
-        self.gstate[slot] = self._gstart if grammar else -1
+        self.gstate[slot] = gstart
         self.min_toks[slot] = min_tokens
         min_row = None
         if min_tokens:
@@ -1111,8 +1195,8 @@ class ServingEngine:
             first_lg = first_lg + bias_row
         if min_row is not None:
             first_lg = first_lg + min_row
-        if grammar:
-            first_lg = first_lg + self._gmask[self._gstart][None, :]
+        if gstart >= 0:
+            first_lg = first_lg + self._gmask[gstart][None, :]
         first = int(self._sample(
             first_lg,
             np.asarray([temperature], np.float32),
@@ -1143,8 +1227,8 @@ class ServingEngine:
                 self.logprobs_k)
             self._record_logprobs(slot, float(np.asarray(clp)[0]),
                                   np.asarray(tlp)[0], np.asarray(tid)[0])
-        if grammar:
-            self.gstate[slot] = int(self._gtable_np[self._gstart, first])
+        if gstart >= 0:
+            self.gstate[slot] = int(self._gtable_np[gstart, first])
         self.last_token[slot] = first
         self.outputs[slot] = [first]
         self._tokens += 1
@@ -1166,7 +1250,7 @@ class ServingEngine:
 
     def _grammar_live(self) -> bool:
         """Any ACTIVE slot under grammar constraint."""
-        return self._grammar is not None and any(
+        return bool(self._goffsets) and any(
             self.active[s] and self.gstate[s] >= 0
             for s in range(self.n_slots))
 
@@ -1693,8 +1777,11 @@ class ServingEngine:
         # prompt into the same slot).  Validity rests on the clamped-
         # write invariant asserted in admit(): inactive slots' masked
         # decode writes land at device cache_lens rows clamped to
-        # max_len - 1, and every prompt row is < max_len - 1, so a
-        # parked slot's prompt K/V is never overwritten.
+        # max_len - 1 — or max_len - gamma - 1 under a speculative
+        # proposer, whose verify extend writes gamma+1 rows per round;
+        # admit enforces the matching stronger prompt bound — and every
+        # prompt row sits below the clamp band, so a parked slot's
+        # prompt K/V is never overwritten.
         self._reset_slot_params(slot)
 
     def _reset_slot_params(self, slot: int) -> None:
