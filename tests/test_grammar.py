@@ -263,10 +263,126 @@ def test_json_lowering_is_rfc_strict():
                           "properties": {'a"b': {"type": "null"}}})
     p = regex_to_dfa(sr)
     cur = 0
-    for b in '{"a\\"b": null}'.encode():
+    for b in '{"a\\"b":null}'.encode():  # compact: schema default
         cur = int(p.table[cur, b])
         assert cur >= 0
     assert bool(p.accepting[cur])
+
+
+# -- structural jump-ahead (grammar-forced chains) ---------------------------
+
+def _walk_valid(text, pattern):
+    d = regex_to_dfa(pattern)
+    cur = 0
+    for b in text.encode():
+        cur = int(d.table[cur, b])
+        if cur < 0:
+            return False
+    return True
+
+
+def test_jump_round_matches_step_decoding(setup):
+    """jump_round commits DFA-forced chains in one extend; tokens must
+    be bit-identical to plain step() decoding on an equivalent engine
+    (a forced token IS the greedy pick under the mask hierarchy)."""
+    from tpu_k8s_device_plugin.workloads.grammar import schema_to_regex
+
+    model, params, _ = setup
+    # a schema with literal keys: long forced runs between values
+    schema = {"type": "object",
+              "properties": {"id": {"type": "integer"},
+                             "ok": {"type": "boolean"}}}
+    tb = [bytes([i]) if i else b"" for i in range(CFG["vocab"])]
+    dfa = token_dfa(regex_to_dfa(schema_to_regex(schema)), tb,
+                    eos_id=EOS)
+
+    def mk():
+        e = ServingEngine(model, params, n_slots=2, eos_id=EOS,
+                          max_new_tokens=24, grammar=dfa, jump_len=6)
+        return e, e.admit([70, 71, 72], grammar=True), e.admit([5, 9])
+
+    a, sa, ua = mk()
+    for _ in range(30):
+        if not any(a.active):
+            break
+        a.step()
+    b, sb, ub = mk()
+    rounds = 0
+    best_chain = 0
+    while any(b.active) and rounds < 30:
+        if b.forced_pending():
+            got = b.jump_round()
+            assert got is not None
+            best_chain = max(best_chain,
+                             max(len(v) for v in got.values()))
+        else:
+            b.step()
+        rounds += 1
+    assert a.output(sa) == b.output(sb)
+    assert a.output(ua) == b.output(ub)
+    # at least one jump committed a multi-token forced chain (the
+    # schema's literal keys) — the compression the feature exists for
+    assert best_chain >= 2, best_chain
+    text = _decode(b.output(sb))
+    assert _walk_valid(text, schema_to_regex(schema)), text
+
+
+def test_jump_round_guards(setup):
+    model, params, dfa = setup
+    eng = ServingEngine(model, params, n_slots=1, eos_id=EOS,
+                        grammar=dfa)
+    eng.admit([70], grammar=True, temperature=0.7)
+    assert not eng.jump_ready() and not eng.forced_pending()
+    with pytest.raises(ValueError, match="jump_ready"):
+        eng.jump_round()
+
+
+def test_jump_round_endgame_returns_none(setup):
+    """Too little headroom for the fixed band: jump_round must refuse
+    (None) and leave the engine fully usable by step()."""
+    model, params, _ = setup
+    small = make_decoder(**CFG, max_len=16, dtype=jnp.float32)
+    tb = [bytes([i]) if i else b"" for i in range(CFG["vocab"])]
+    dfa = token_dfa(regex_to_dfa("(AB|CD)+E"), tb, eos_id=EOS)
+    eng = ServingEngine(small, params, n_slots=1, eos_id=EOS,
+                        grammar=dfa, jump_len=8)
+    s = eng.admit([70, 71, 72, 73, 74, 75, 76, 77], grammar=True)
+    assert eng.jump_round() is None  # 16 - 8 rows < jump_len + 1 = 9
+    eng.step()
+    assert len(eng.output(s)) >= 2
+
+
+def test_jump_used_by_server(setup):
+    """The scheduler takes the jump path for forced chains: a schema
+    request over HTTP must finish with fewer decode rounds than
+    tokens."""
+    from tpu_k8s_device_plugin.workloads.server import EngineServer
+
+    model, params, _ = setup
+    eng = ServingEngine(model, params, n_slots=2, eos_id=EOS,
+                        jump_len=6)
+    tb = [bytes([i]) if i else b"" for i in range(CFG["vocab"])]
+    srv = EngineServer(eng, max_new_tokens=24, window=4,
+                       token_bytes=tb)
+    srv.start(host="127.0.0.1", port=0)
+    try:
+        schema = {"type": "object",
+                  "properties": {"id": {"type": "integer"}}}
+        status, events = _post(srv.port, {
+            "tokens": [70, 71], "guided_json": schema,
+            "stream": False})
+        assert status == 200
+        toks = events[0]["tokens"]
+        from tpu_k8s_device_plugin.workloads.grammar import (
+            schema_to_regex,
+        )
+
+        assert _walk_valid(_decode(toks), schema_to_regex(schema))
+        # forced keys commit in jumps: rounds < emitted tokens
+        st = eng.stats()
+        assert st["decode_steps"] < st["tokens_emitted"]
+    finally:
+        srv.stop()
 
 
 # -- the served surface: guided decoding over HTTP ---------------------------
@@ -326,6 +442,11 @@ def test_guided_regex_over_http(grammar_server):
     assert status == 200
     assert srv.stats()["grammar_patterns"] == 1
     assert eng.n_grammars == 1
+    # post-registration, the standalone TokenDfa host copy is dropped
+    # (the engine's combined table holds the rows; keeping both would
+    # pin a redundant [N, V] per pattern for the server's lifetime)
+    assert PATTERN in srv._grammar_gids
+    assert PATTERN not in srv._grammar_tdfas
 
 
 def test_guided_json_schema_over_http(grammar_server):
@@ -340,6 +461,45 @@ def test_guided_json_schema_over_http(grammar_server):
     text = _decode(events[0]["tokens"])
     assert _valid_prefix(text, schema_to_regex(schema)), text
     assert text.startswith("{")
+
+
+def test_guided_choice_over_http(grammar_server):
+    """vLLM's guided_choice: the output is exactly one of the listed
+    literals (or a prefix at the budget)."""
+    srv, _ = grammar_server
+    choices = ["AB", "CDE"]
+    status, events = _post(srv.port, {
+        "tokens": [70, 71], "guided_choice": choices,
+        "stream": False})
+    assert status == 200
+    text = _decode(events[0]["tokens"])
+    if events[0]["finish_reason"] == "eos":
+        assert text in choices, text
+    else:
+        assert any(c.startswith(text) for c in choices), text
+    status, _ = _post(srv.port, {
+        "tokens": [1], "guided_choice": []})
+    assert status == 400
+    status, _ = _post(srv.port, {
+        "tokens": [1], "guided_choice": ["A"], "guided_regex": "B"})
+    assert status == 400
+
+
+def test_grammar_beats_min_tokens_floor(grammar_server):
+    """Mask hierarchy: when the DFA reaches an accepting state whose
+    ONLY continuation is eos while a min_tokens floor still masks eos,
+    the grammar (-1e9) must beat the floor (-1e6) — the request
+    retires IN-GRAMMAR below its floor instead of degenerating to
+    unmasked argmax and silently leaving the grammar."""
+    srv, _ = grammar_server
+    status, events = _post(srv.port, {
+        "tokens": [70, 71], "guided_choice": ["AB"],
+        "min_tokens": 6, "stream": False})
+    assert status == 200
+    ev = events[0]
+    text = _decode(ev["tokens"])
+    assert text == "AB", (text, ev)
+    assert ev["finish_reason"] == "eos"
 
 
 def test_guided_errors_are_400s(grammar_server, setup):

@@ -201,6 +201,14 @@ def test_spec_donor_bound_rejects_long_prompts(models):
     eng2 = ServingEngine(small_t, tp, n_slots=1, draft="ngram", gamma=3)
     with pytest.raises(ValueError, match="donor bound"):
         eng2.admit(list(range(1, 14)))
+    # the bound guards APC donor reads: with auto_prefix off, parked
+    # rows are never read back and long prompts must still admit
+    # (spec_round's own headroom fallback protects live decoding)
+    eng3 = ServingEngine(small_t, tp, n_slots=1, draft="ngram",
+                         gamma=3, auto_prefix=False)
+    s3 = eng3.admit(list(range(1, 14)))
+    eng3.run_spec(6)
+    assert len(eng3.output(s3)) >= 1
 
 
 def test_greedy_only_guard(models):

@@ -257,13 +257,22 @@ def regex_to_dfa(pattern: str) -> CharDfa:
 @dataclass(frozen=True)
 class TokenDfa:
     """Token-level automaton for an engine: ``table [N, V]`` int32
-    next-state (-1 = token rejected in that state), ``mask [N, V]``
-    float32 additive logit mask (0 allowed / -1e9 rejected), start
-    state 0.  ``eos`` is allowed exactly in accepting states."""
+    next-state (-1 = token rejected in that state), start state 0.
+    ``eos`` is allowed exactly in accepting states (a self-loop, so
+    its entry is >= 0).  The table is the ONLY stored array — the
+    additive logit mask is fully derived from reject entries, and
+    storing it would double the footprint (~1.4 GB for a JSON grammar
+    at a 128k vocab) per cached pattern."""
 
     table: np.ndarray
-    mask: np.ndarray
     start: int = 0
+
+    @property
+    def mask(self) -> np.ndarray:
+        """[N, V] float32 additive logit mask (0 allowed / -1e9
+        rejected), derived on demand — diagnostics and tests only;
+        the engine derives the same mask in-step from the table."""
+        return np.where(self.table >= 0, 0.0, -1e9).astype(np.float32)
 
 
 def token_dfa(dfa: CharDfa, token_bytes: List[bytes],
@@ -293,10 +302,8 @@ def token_dfa(dfa: CharDfa, token_bytes: List[bytes],
         cur = np.where(has, np.where(cur >= 0, step, _REJECT), cur)
     cur[:, bytes_mat[:, 0] < 0] = _REJECT
     table = np.ascontiguousarray(cur.astype(np.int32))
-    mask = np.where(table >= 0, 0.0, -1e9).astype(np.float32)
     if 0 <= eos_id < V:
         for s in np.flatnonzero(dfa.accepting):
-            mask[s, eos_id] = 0.0
             table[s, eos_id] = s  # self-loop; generation retires at eos
     # trim to co-accessible states: a token step into a state from
     # which NO accepting state is token-reachable would trap the
@@ -322,7 +329,6 @@ def token_dfa(dfa: CharDfa, token_bytes: List[bytes],
                 work.append(s)
     trap = (table >= 0) & ~live[np.maximum(table, 0)]
     table[trap] = _REJECT
-    mask[trap] = -1e9
     # dead-end guard over states actually REACHABLE from the start
     # (unreachable char-DFA states legitimately have no token cover):
     # a reachable state where nothing (incl. eos) is allowed would
@@ -337,13 +343,13 @@ def token_dfa(dfa: CharDfa, token_bytes: List[bytes],
             if not reach[t]:
                 reach[t] = True
                 work.append(int(t))
-    dead = (mask <= -1e9 / 2).all(axis=1) & reach
+    dead = (table < 0).all(axis=1) & reach
     if dead.any():
         raise ValueError(
             f"grammar has dead-end states {np.flatnonzero(dead).tolist()}"
             " (no token or eos allowed); widen the pattern or the "
             "vocabulary")
-    return TokenDfa(table=table, mask=mask, start=0)
+    return TokenDfa(table=table, start=0)
 
 
 # -- served-grammar helpers --------------------------------------------------
@@ -404,14 +410,22 @@ def _regex_escape(text: str) -> str:
         "\\" + c if c in "\\()[]{}*+?|." else c for c in text)
 
 
-def schema_to_regex(schema: dict, depth: int = 3) -> str:
+def schema_to_regex(schema: dict, depth: int = 3,
+                    ws: str = "") -> str:
     """Lower a JSON-schema SUBSET to a regex: ``type`` of string /
     integer / number / boolean / null, ``enum`` of scalars, ``array``
     with ``items``, and ``object`` with ``properties`` (all properties
     required, emitted in declaration order — the shape constrained
     decoding guarantees, mirroring vLLM's guided_json ordering).
     Unsupported keywords raise ValueError so callers 400 instead of
-    silently under-constraining."""
+    silently under-constraining.
+
+    *ws* is the separator-whitespace regex fragment.  The default is
+    COMPACT output (no whitespace — OpenAI structured-output style):
+    compactness makes the schema's literal skeleton (braces, keys,
+    colons, commas) single-choice at every DFA state, which is exactly
+    what the engine's structural jump-ahead (``jump_round``) commits
+    in one extend; pass ``ws=r"\\s*"`` for lenient spacing."""
     if not isinstance(schema, dict):
         raise ValueError("schema must be a JSON object")
     # reject keywords whose absence from the lowering could make the
@@ -456,10 +470,10 @@ def schema_to_regex(schema: dict, depth: int = 3) -> str:
     if t == "null":
         return "null"
     if t == "array":
-        item = (schema_to_regex(schema["items"], depth)
+        item = (schema_to_regex(schema["items"], depth, ws)
                 if "items" in schema else json_value_regex(depth))
-        return (f"\\[{_JSON_WS}({item}({_JSON_WS},{_JSON_WS}{item})*)?"
-                f"{_JSON_WS}\\]")
+        return (f"\\[{ws}({item}({ws},{ws}{item})*)?"
+                f"{ws}\\]")
     if t == "object":
         props = schema.get("properties")
         if not props:
@@ -471,10 +485,10 @@ def schema_to_regex(schema: dict, depth: int = 3) -> str:
         for name, sub in props.items():
             key = _regex_escape(_json.dumps(name))
             pairs.append(
-                f"{key}{_JSON_WS}:{_JSON_WS}"
-                + schema_to_regex(sub, depth))
-        body = f"{_JSON_WS},{_JSON_WS}".join(pairs)
-        return f"\\{{{_JSON_WS}{body}{_JSON_WS}\\}}"
+                f"{key}{ws}:{ws}"
+                + schema_to_regex(sub, depth, ws))
+        body = f"{ws},{ws}".join(pairs)
+        return f"\\{{{ws}{body}{ws}\\}}"
     raise ValueError(
         f"unsupported schema {schema!r}: the served subset covers "
         "type string/integer/number/boolean/null/array/object and "

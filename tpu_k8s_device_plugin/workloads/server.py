@@ -27,7 +27,7 @@ API (JSON over HTTP/1.1):
                     "ignore_eos": bool?, "seed": s?, "logprobs": k?,
                     "prompt_logprobs": k?, "n": c?, "priority": p?,
                     "guided_regex": pattern?, "guided_json": true|schema?,
-                    "stream": true?}
+                    "guided_choice": [str...]?, "stream": true?}
                    guided_regex / guided_json constrain the output to
                    a regex / JSON (vLLM's guided decoding): the server
                    lowers the constraint to a token-level DFA riding
@@ -449,6 +449,13 @@ class EngineServer:
                     if gid is None:
                         gid = eng.register_grammar(req.grammar_tdfa)
                         self._grammar_gids[req.grammar_key] = gid
+                        with self._glock:
+                            # the engine's combined table now holds the
+                            # rows; keeping the standalone TokenDfa
+                            # would pin a second full [N, V] host copy
+                            # per pattern for the server's lifetime
+                            self._grammar_tdfas.pop(req.grammar_key,
+                                                    None)
                     req.grammar_tdfa = None  # registered; drop the ref
                 slot = eng.admit(
                     req.tokens, temperature=req.temperature,
@@ -667,6 +674,15 @@ class EngineServer:
                 # cache endgame itself); a sampled/logprobs admission
                 # flips the loop back to run_scan until it drains
                 eng.spec_round()
+            elif eng.forced_pending() and eng.jump_round() is not None:
+                # structural jump-ahead: a grammar slot's next tokens
+                # are DFA-forced (JSON keys/punctuation), so one
+                # fixed-width extend commits the whole chain.  A None
+                # return means the jump could not run safely (endgame
+                # headroom / parked-donor band) and did no device
+                # work — the elif is then false and the scan path
+                # below handles this iteration
+                pass
             else:
                 headroom = min(
                     eng.model.max_len - eng.lens[s]
@@ -1018,7 +1034,7 @@ class EngineServer:
         scheduler thread (see _admit_pending)."""
         with self._glock:
             tdfa = self._grammar_tdfas.get(pattern)
-            if tdfa is None and len(self._grammar_tdfas) >= \
+            if tdfa is None and self._grammar_count() >= \
                     self.max_grammars:
                 raise ValueError(
                     f"grammar cache full ({self.max_grammars} distinct "
@@ -1033,7 +1049,8 @@ class EngineServer:
                 # size check and must not overshoot the bound (cache
                 # entries pin engine grammar-table rows for life)
                 if pattern not in self._grammar_tdfas and \
-                        len(self._grammar_tdfas) >= self.max_grammars:
+                        pattern not in self._grammar_gids and \
+                        self._grammar_count() >= self.max_grammars:
                     raise ValueError(
                         f"grammar cache full ({self.max_grammars} "
                         "distinct patterns); raise --max-grammars or "
@@ -1041,21 +1058,41 @@ class EngineServer:
                 tdfa = self._grammar_tdfas.setdefault(pattern, tdfa)
         return tdfa
 
+    def _grammar_count(self) -> int:
+        """Distinct patterns this server has seen: registered (rows
+        live in the engine's combined table) plus compiled-but-pending
+        (a union — a pattern briefly sits in both mid-registration)."""
+        return len(set(self._grammar_gids) | set(self._grammar_tdfas))
+
     def _grammar_request(self, body: dict) -> Optional[str]:
         """Extract the guided-decoding constraint from a native body:
-        ``guided_regex`` (a pattern in the served regex subset) or
-        ``guided_json`` (true = any JSON, or a schema-subset object).
-        Returns the lowered regex pattern, or None."""
+        ``guided_regex`` (a pattern in the served regex subset),
+        ``guided_json`` (true = any JSON, or a schema-subset object),
+        or ``guided_choice`` (a list of literal strings — vLLM's
+        choice mode, lowered as a literal alternation).  Returns the
+        lowered regex pattern, or None."""
         regex = body.get("guided_regex")
         gjson = body.get("guided_json")
-        if regex is not None and gjson is not None:
+        choice = body.get("guided_choice")
+        if sum(x is not None for x in (regex, gjson, choice)) > 1:
             raise ValueError(
-                "pass 'guided_regex' OR 'guided_json', not both")
+                "pass exactly one of 'guided_regex', 'guided_json', "
+                "'guided_choice'")
         if regex is not None:
             if not isinstance(regex, str) or not regex:
                 raise ValueError(
                     "'guided_regex' must be a non-empty pattern string")
             return regex
+        if choice is not None:
+            if (not isinstance(choice, list) or not choice or not all(
+                    isinstance(c, str) and c for c in choice)):
+                raise ValueError(
+                    "'guided_choice' must be a non-empty list of "
+                    "non-empty strings")
+            from .grammar import _regex_escape
+
+            return "(" + "|".join(
+                _regex_escape(c) for c in choice) + ")"
         if gjson is None:
             return None
         if gjson is True:
@@ -1149,6 +1186,8 @@ class EngineServer:
                     "(text, json_object, json_schema)")
         if opt("guided_regex") is not None:  # vLLM's OpenAI extension
             native["guided_regex"] = opt("guided_regex")
+        if opt("guided_choice") is not None:  # vLLM's OpenAI extension
+            native["guided_choice"] = opt("guided_choice")
         return native, str(opt("model", "default"))
 
     def _openai_chat_to_native(self, body: dict):
@@ -1276,11 +1315,14 @@ class EngineServer:
                 raise ValueError(
                     "guided decoding needs an engine eos id (the "
                     "grammar gates completion on it)")
-            # compiles (or cache-hits) here on the handler thread;
-            # regex syntax errors and vocabulary dead-ends surface as
-            # this request's 400, never a scheduler stall
-            grammar_tdfa = self._compile_grammar(pattern)
             grammar_key = pattern
+            if pattern not in self._grammar_gids:
+                # compiles (or cache-hits) here on the handler thread;
+                # regex syntax errors and vocabulary dead-ends surface
+                # as this request's 400, never a scheduler stall.
+                # Registered patterns skip the compile entirely — the
+                # engine's combined table already holds their rows
+                grammar_tdfa = self._compile_grammar(pattern)
         return _Request(
             tokens=tokens,
             max_new_tokens=max_new,
@@ -1320,7 +1362,7 @@ class EngineServer:
             "running_copies": len(self._running),
             "requests_served": self._requests_served,
             "requests_rejected": self._requests_rejected,
-            "grammar_patterns": len(self._grammar_tdfas),
+            "grammar_patterns": self._grammar_count(),
             "window": self.window,
         })
         return st
