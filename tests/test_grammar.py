@@ -378,9 +378,14 @@ def test_jump_used_by_server(setup):
         )
 
         assert _walk_valid(_decode(toks), schema_to_regex(schema))
-        # forced keys commit in jumps: rounds < emitted tokens
+        # forced keys commit in jumps: rounds < emitted tokens, and
+        # the observability counters say so
         st = eng.stats()
         assert st["decode_steps"] < st["tokens_emitted"]
+        assert st["jump_rounds"] >= 1
+        assert st["jump_forced_tokens"] >= 2
+        # the combined table packs to int16 while states fit
+        assert eng._gtable_np.dtype == np.int16
     finally:
         srv.stop()
 

@@ -1406,6 +1406,10 @@ def main(argv=None) -> int:
                    help="distinct guided-decoding patterns cached per "
                         "server lifetime (each occupies engine grammar "
                         "table rows)")
+    p.add_argument("--jump-len", type=int, default=8,
+                   help="structural jump-ahead width: up to this many "
+                        "DFA-forced tokens (a schema's keys and "
+                        "punctuation) commit per multi-token extend")
     p.add_argument("--tokenizer", default=None, metavar="NAME_OR_PATH",
                    help="transformers tokenizer enabling the text "
                         "surface: 'prompt' strings, stop STRINGS, "
@@ -1422,6 +1426,8 @@ def main(argv=None) -> int:
         # quantization check above
         p.error("--draft-config and --spec-ngram are mutually "
                 "exclusive")
+    if args.jump_len < 1:
+        p.error("--jump-len must be >= 1")
 
     quantized = "int4" if args.int4 else args.quantized
     mesh = None
@@ -1461,7 +1467,8 @@ def main(argv=None) -> int:
                            eos_id=getattr(cfg, "eos_id", None),
                            mesh=mesh, logprobs_k=args.logprobs_k,
                            draft=draft, gamma=args.gamma,
-                           ngram_n=args.spec_ngram or 3)
+                           ngram_n=args.spec_ngram or 3,
+                           jump_len=args.jump_len)
     tokenizer = None
     if args.tokenizer:
         try:

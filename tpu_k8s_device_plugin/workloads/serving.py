@@ -617,6 +617,8 @@ class ServingEngine:
         self._spec_rounds = 0
         self._spec_proposed = 0
         self._spec_accepted = 0
+        self._jump_rounds = 0
+        self._jump_forced = 0
         if draft == "ngram":
             # draft-FREE speculation (vLLM's [ngram] / prompt-lookup
             # mode): proposals come from the request's own token
@@ -678,10 +680,14 @@ class ServingEngine:
             # the table is the ONLY grammar array (the logit mask is
             # derived in-step from reject entries — a stored f32 mask
             # would double the HBM footprint, ~1.4 GB for a JSON
-            # grammar at a 128k vocab); padding rows are unreachable
-            # (every start state and transition stays inside a
-            # registered grammar's rows)
-            table = np.full((new_cap, self.model.vocab), -1, np.int32)
+            # grammar at a 128k vocab), and it packs to int16 while
+            # every state id fits (one more halving; growth past
+            # 32767 states re-widens to int32 — a recompile, like any
+            # capacity change).  Padding rows are unreachable (every
+            # start state and transition stays inside a registered
+            # grammar's rows).
+            dt = np.int16 if new_cap <= 32767 else np.int32
+            table = np.full((new_cap, self.model.vocab), -1, dt)
             if self._gtable_np is not None:
                 table[:off] = self._gtable_np[:off]
             self._gtable_np = table
@@ -689,7 +695,7 @@ class ServingEngine:
         self._gtable_np[off:need] = np.where(
             np.asarray(grammar.table, np.int32) >= 0,
             np.asarray(grammar.table, np.int32) + np.int32(off),
-            np.int32(-1))
+            np.int32(-1)).astype(self._gtable_np.dtype)
         self._gstates_used = need
         self._goffsets.append(off + int(grammar.start))
         # device mirror rebuilds on every registration (one [N, V]
@@ -1775,6 +1781,7 @@ class ServingEngine:
         lg = lg + jnp.where(grow < 0, -1e9, 0.0) * gon
         bonus = np.asarray(jnp.argmax(lg, axis=-1), np.int32)
         self._steps += 1
+        self._jump_rounds += 1
 
         out: Dict[int, List[int]] = {}
         new_lens = np.zeros(self.n_slots, np.int32)
@@ -1799,6 +1806,10 @@ class ServingEngine:
                     n_c = j + 1  # later tokens discarded
                     break
             self.lens[s] += n_c
+            # forced-token accounting from the COMMITTED prefix (a
+            # stop/budget finish mid-chain discards the rest; counting
+            # dispatch would overstate jump savings)
+            self._jump_forced += min(n_c, len(chains[s]))
             new_lens[s] = self.lens[s]
             if self.active[s] and self.lens[s] >= self.model.max_len:
                 self._finish(s)
@@ -1965,6 +1976,8 @@ class ServingEngine:
             "spec_rounds": self._spec_rounds,
             "spec_proposed": self._spec_proposed,
             "spec_accepted": self._spec_accepted,
+            "jump_rounds": self._jump_rounds,
+            "jump_forced_tokens": self._jump_forced,
         }
 
     def release(self, slot: int) -> None:
