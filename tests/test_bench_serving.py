@@ -84,3 +84,46 @@ def test_http_load_path_runs():
     for k in ("ttft_ms_p50", "ttft_ms_p99", "tpot_ms_p50",
               "tpot_ms_p99"):
         assert stats[k] == stats[k] and stats[k] >= 0  # not NaN
+
+
+def test_load_checkpoint_params_serves_real_weights(tmp_path):
+    """The serving CLI's --checkpoint path: restore a train-layout
+    orbax checkpoint, (optionally) quantize on load, and decode — the
+    bf16 restore must reproduce the SOURCE weights' tokens exactly,
+    and the quantized rungs must build the quantized layouts."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_k8s_device_plugin.workloads import llama
+    from tpu_k8s_device_plugin.workloads.bench_serving import (
+        load_checkpoint_params,
+    )
+    from tpu_k8s_device_plugin.workloads.checkpoint import (
+        save_checkpoint,
+    )
+    from tpu_k8s_device_plugin.workloads.inference import (
+        greedy_generate,
+    )
+
+    cfg = llama.TINY_LLAMA
+    train = llama.train_model(cfg)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+    params = train.init(jax.random.PRNGKey(7), tokens, pos)["params"]
+    save_checkpoint(str(tmp_path), 3, {"params": params})
+
+    _, model, loaded = load_checkpoint_params(
+        "tiny", 64, False, str(tmp_path))
+    want, _ = greedy_generate(
+        model, params, jnp.asarray([[5, 17, 3]], jnp.int32), 6)
+    got, _ = greedy_generate(
+        model, loaded, jnp.asarray([[5, 17, 3]], jnp.int32), 6)
+    assert np.asarray(got).tolist() == np.asarray(want).tolist()
+
+    for q in (True, "int4"):
+        _, qmodel, qparams = load_checkpoint_params(
+            "tiny", 64, q, str(tmp_path), step=3)
+        out, _ = greedy_generate(
+            qmodel, qparams, jnp.asarray([[5, 17, 3]], jnp.int32), 4)
+        assert np.asarray(out).shape == (1, 4)

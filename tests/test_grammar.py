@@ -60,6 +60,56 @@ def test_regex_compiler_grid():
     assert not m("") and not m(".5") and not m("3.") and not m("a")
 
 
+def test_regex_compiler_fuzz_vs_re():
+    """Differential fuzz: random patterns from the served subset,
+    random byte strings — the DFA's full-match verdict must agree
+    with Python's re on every sample (the compiler backs a public,
+    per-request API; a mis-compile silently mis-constrains)."""
+    import random
+
+    rnd = random.Random(1234)
+    alphabet = "abc01"
+
+    def gen(depth):
+        kind = rnd.choice(
+            ["lit", "lit", "class", "alt", "cat", "star", "plus",
+             "opt"] if depth > 0 else ["lit", "class"])
+        if kind == "lit":
+            return rnd.choice(alphabet)
+        if kind == "class":
+            chars = "".join(sorted(set(
+                rnd.choice(alphabet)
+                for _ in range(rnd.randint(1, 3)))))
+            neg = "^" if rnd.random() < 0.2 else ""
+            return f"[{neg}{chars}]"
+        if kind == "alt":
+            return ("(" + gen(depth - 1) + "|" + gen(depth - 1)
+                    + ")")
+        if kind == "cat":
+            return gen(depth - 1) + gen(depth - 1)
+        return "(" + gen(depth - 1) + ")" + {
+            "star": "*", "plus": "+", "opt": "?"}[kind]
+
+    for _ in range(60):
+        pat = gen(3)
+        try:
+            d = regex_to_dfa(pat)
+        except ValueError:
+            continue  # e.g. an empty alternation arm; re may differ
+        gold = re.compile(f"(?s:{pat})")
+        for _ in range(40):
+            s = "".join(rnd.choice(alphabet)
+                        for _ in range(rnd.randint(0, 6)))
+            cur = 0
+            for b in s.encode():
+                cur = int(d.table[cur, b])
+                if cur < 0:
+                    break
+            got = cur >= 0 and bool(d.accepting[cur])
+            want = gold.fullmatch(s) is not None
+            assert got == want, (pat, s)
+
+
 def test_constrained_output_matches_grammar(setup):
     model, params, dfa = setup
     eng = ServingEngine(model, params, n_slots=1, eos_id=EOS,
@@ -267,6 +317,56 @@ def test_json_lowering_is_rfc_strict():
         cur = int(p.table[cur, b])
         assert cur >= 0
     assert bool(p.accepting[cur])
+
+
+def test_grammar_composes_with_apc(setup):
+    """A constrained admit sharing a cached prefix must reuse it (APC
+    hit) and still decode in-grammar — prefix reuse only skips
+    prefill, never the DFA."""
+    model, params, dfa = setup
+    eng = ServingEngine(model, params, n_slots=2, eos_id=EOS,
+                        max_new_tokens=8, chunk=4, auto_prefix_min=4,
+                        grammar=dfa)
+    shared = [7, 3, 9, 12, 5, 8, 1, 2]
+    eng.admit(shared + [5, 9])
+    before = eng.stats()["prefix_cache_hits"]
+    sg = eng.admit(shared + [44], grammar=True)
+    assert eng.stats()["prefix_cache_hits"] == before + 1
+    eng.run(10)
+    d = regex_to_dfa(PATTERN)
+    cur = 0
+    for b in _decode(eng.output(sg)).encode():
+        cur = int(d.table[cur, b])
+        assert cur >= 0
+
+
+def test_grammar_composes_with_lora(setup):
+    """Per-request adapters and per-request grammars are orthogonal
+    slot data: a constrained adapter request and an unconstrained base
+    request decode in the same batch, both correct."""
+    from tpu_k8s_device_plugin.workloads.inference import (
+        attach_lora,
+        greedy_generate,
+    )
+
+    model, params, dfa = setup
+    lora_mdl = make_decoder(**CFG, max_len=64, dtype=jnp.float32,
+                            n_adapters=2, lora_rank=4)
+    lora_params = attach_lora(params, lora_mdl, jax.random.PRNGKey(3))
+    eng = ServingEngine(lora_mdl, lora_params, n_slots=2, eos_id=EOS,
+                        max_new_tokens=8, grammar=dfa)
+    sg = eng.admit([70, 71, 72], grammar=True, adapter=1)
+    su = eng.admit([5, 9, 3])
+    eng.run(10)
+    d = regex_to_dfa(PATTERN)
+    cur = 0
+    for b in _decode(eng.output(sg)).encode():
+        cur = int(d.table[cur, b])
+        assert cur >= 0
+    want, _ = greedy_generate(
+        lora_mdl, lora_params,
+        jnp.asarray([[5, 9, 3]], jnp.int32), 8)
+    assert eng.output(su) == np.asarray(want)[0].tolist()
 
 
 # -- structural jump-ahead (grammar-forced chains) ---------------------------

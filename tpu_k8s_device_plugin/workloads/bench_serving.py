@@ -39,6 +39,20 @@ DRAFT_FOR = {
 }
 
 
+def _train_init(cfg):
+    """The ONE train-layout init recipe (shape template and build
+    share it, so the restore template can never silently diverge from
+    the build path's layout)."""
+    train = llama.train_model(cfg)
+
+    def init():
+        tokens = jnp.zeros((1, 8), jnp.int32)
+        pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+        return train.init(jax.random.PRNGKey(0), tokens, pos)["params"]
+
+    return init
+
+
 def build_model_and_params(config: str, max_len: int, quantized,
                            mesh=None):
     """Decode model + benchmark-posture params (random weights built
@@ -60,10 +74,7 @@ def build_model_and_params(config: str, max_len: int, quantized,
         if quantized:
             return llama.random_quantized_params(cfg)
         # small configs only: materializes the bf16 tree
-        train = llama.train_model(cfg)
-        tokens = jnp.zeros((1, 8), jnp.int32)
-        pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
-        return train.init(jax.random.PRNGKey(0), tokens, pos)["params"]
+        return _train_init(cfg)()
 
     if mesh is None:
         return cfg, model, build()
@@ -71,6 +82,56 @@ def build_model_and_params(config: str, max_len: int, quantized,
 
     shardings = lm_tree_shardings(mesh, jax.eval_shape(build))
     params = jax.jit(build, out_shardings=shardings)()
+    return cfg, model, params
+
+
+def load_checkpoint_params(config: str, max_len: int, quantized,
+                           checkpoint_dir: str, step=None, mesh=None):
+    """Decode model + REAL params restored from an orbax checkpoint
+    (``workloads.checkpoint`` layout, state ``{"params": ...}`` in the
+    bf16 TRAIN layout — what a training run saves).  The serving
+    handoff quantizes after restore for int8/int4 configs (the same
+    recipe tests/test_checkpoint.py::test_quantize_after_restore_serves
+    pins).  The restore template is ABSTRACT (eval_shape), so nothing
+    is materialized twice; with *mesh* each leaf restores directly
+    onto its tensor-parallel placement, and WITHOUT one the bf16 tree
+    restores to host memory and only the (possibly quantized) serving
+    tree ships to the device — the single-chip quantized configs
+    exist precisely because the bf16 tree may not fit HBM."""
+    from .checkpoint import restore_checkpoint
+    from .inference import quantize_lm_params_int4
+
+    cfg = CONFIGS[config]
+    model = llama.decoder(cfg, max_len=max_len, quantized=quantized)
+    abstract = jax.eval_shape(_train_init(cfg))
+    if mesh is not None:
+        # TP: each bf16 leaf restores directly onto its mesh shard
+        # (1/N of the tree per chip); quantize runs sharded and the
+        # engine re-places the result
+        from .transformer import lm_tree_shardings
+
+        shardings = {"params": lm_tree_shardings(mesh, abstract)}
+    else:
+        # single-chip: the bf16 train tree may exceed HBM for exactly
+        # the configs --quantized exists for (8B bf16 ~16 GB on a
+        # 16 GB v5e) — restore to HOST memory, quantize there, and
+        # ship only the quantized tree to the device
+        cpu = jax.sharding.SingleDeviceSharding(
+            jax.local_devices(backend="cpu")[0])
+        shardings = {"params": jax.tree_util.tree_map(
+            lambda _: cpu, abstract)}
+    restored = restore_checkpoint(
+        checkpoint_dir, step=step, template={"params": abstract},
+        shardings=shardings)
+    loaded = restored["params"]
+    if quantized == "int4":
+        params = quantize_lm_params_int4(loaded)
+    elif quantized:
+        params = quantize_lm_params(loaded)
+    else:
+        params = loaded
+    if mesh is None:
+        params = jax.device_put(params, jax.devices()[0])
     return cfg, model, params
 
 

@@ -1410,6 +1410,17 @@ def main(argv=None) -> int:
                    help="structural jump-ahead width: up to this many "
                         "DFA-forced tokens (a schema's keys and "
                         "punctuation) commit per multi-token extend")
+    p.add_argument("--checkpoint", default=None, metavar="DIR",
+                   help="serve REAL weights: an orbax checkpoint dir "
+                        "(workloads.checkpoint layout, state "
+                        "{'params': ...} in the bf16 train layout); "
+                        "--quantized/--int4 quantize after restore. "
+                        "Without it the CLI serves random weights in "
+                        "the benchmark posture. (--draft-config drafts "
+                        "stay random either way — correctness never "
+                        "depends on the draft.)")
+    p.add_argument("--checkpoint-step", type=int, default=None,
+                   help="checkpoint step to restore (default: latest)")
     p.add_argument("--tokenizer", default=None, metavar="NAME_OR_PATH",
                    help="transformers tokenizer enabling the text "
                         "surface: 'prompt' strings, stop STRINGS, "
@@ -1428,6 +1439,9 @@ def main(argv=None) -> int:
                 "exclusive")
     if args.jump_len < 1:
         p.error("--jump-len must be >= 1")
+    if args.checkpoint_step is not None and not args.checkpoint:
+        p.error("--checkpoint-step needs --checkpoint (without it the "
+                "server would silently serve random weights)")
 
     quantized = "int4" if args.int4 else args.quantized
     mesh = None
@@ -1451,8 +1465,18 @@ def main(argv=None) -> int:
                     f"{n_kv} KV heads (the cache shards on them)")
         mesh = make_lm_mesh(devs[:args.tp], seq=1, model=args.tp,
                             expert=1)
-    cfg, model, params = build_model_and_params(
-        args.config, args.max_len, quantized, mesh=mesh)
+    if args.checkpoint:
+        from .bench_serving import load_checkpoint_params
+
+        try:
+            cfg, model, params = load_checkpoint_params(
+                args.config, args.max_len, quantized,
+                args.checkpoint, step=args.checkpoint_step, mesh=mesh)
+        except FileNotFoundError as e:
+            p.error(str(e))
+    else:
+        cfg, model, params = build_model_and_params(
+            args.config, args.max_len, quantized, mesh=mesh)
     draft = None
     if args.draft_config:
         # speculative serving (vLLM's --speculative-model): the draft
