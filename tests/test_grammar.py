@@ -319,6 +319,77 @@ def test_json_lowering_is_rfc_strict():
     assert bool(p.accepting[cur])
 
 
+def test_schema_lowering_fuzz():
+    """Differential fuzz for the schema subset: random schemas,
+    random CONFORMING values (accepted) and random mutations
+    (rejected unless still conforming) — the DFA is the product
+    clients trust for structured output."""
+    import json as _json
+    import random
+
+    from tpu_k8s_device_plugin.workloads.grammar import schema_to_regex
+
+    rnd = random.Random(99)
+
+    def gen_schema(depth):
+        kinds = ["string", "integer", "boolean", "null", "enum"]
+        if depth > 0:
+            kinds += ["object", "array"]
+        k = rnd.choice(kinds)
+        if k == "enum":
+            return {"enum": rnd.sample(
+                ["a", "b c", 'q"t', 0, 17, True, None], 3)}
+        if k == "object":
+            return {"type": "object", "properties": {
+                name: gen_schema(depth - 1)
+                for name in rnd.sample(["x", "y", "z"],
+                                       rnd.randint(1, 3))}}
+        if k == "array":
+            return {"type": "array", "items": gen_schema(depth - 1)}
+        return {"type": k}
+
+    def gen_value(schema):
+        if "enum" in schema:
+            return rnd.choice(schema["enum"])
+        t = schema["type"]
+        if t == "string":
+            return rnd.choice(["", "hi", 'sa"y', "a\\b", "é✓"])
+        if t == "integer":
+            return rnd.choice([0, 7, -13, 100200])
+        if t == "boolean":
+            return rnd.random() < 0.5
+        if t == "null":
+            return None
+        if t == "array":
+            return [gen_value(schema["items"])
+                    for _ in range(rnd.randint(0, 3))]
+        return {n: gen_value(sub)
+                for n, sub in schema["properties"].items()}
+
+    def accepts(d, s):
+        cur = 0
+        for b in s.encode():
+            cur = int(d.table[cur, b])
+            if cur < 0:
+                return False
+        return bool(d.accepting[cur])
+
+    for _ in range(30):
+        schema = gen_schema(2)
+        d = regex_to_dfa(schema_to_regex(schema))
+        for _ in range(8):
+            v = gen_value(schema)
+            compact = _json.dumps(v, separators=(",", ":"),
+                                  ensure_ascii=False)
+            assert accepts(d, compact), (schema, compact)
+            # mutations: truncation and trailing junk never conform
+            # (except dropping a digit from a bare integer, which may
+            # leave another valid integer)
+            if len(compact) > 1 and not compact[-1].isdigit():
+                assert not accepts(d, compact[:-1]), compact
+            assert not accepts(d, compact + "x"), compact
+
+
 def test_grammar_composes_with_apc(setup):
     """A constrained admit sharing a cached prefix must reuse it (APC
     hit) and still decode in-grammar — prefix reuse only skips

@@ -729,6 +729,49 @@ def test_openai_completions_sse_stream(text_server):
     assert finals == ["length"]
 
 
+def test_openai_echo_and_stream_usage(text_server):
+    """echo prefixes the prompt text (non-streaming and as the first
+    SSE chunk); stream_options.include_usage appends one usage-only
+    chunk before [DONE]; stream_options without stream is a 400."""
+    srv, model, params = text_server
+    tok = _ByteTok()
+    want = _solo(model, params, tok.encode("ab"), 8)
+    status, body = _post_openai(srv.port, {
+        "prompt": "ab", "temperature": 0, "max_tokens": 8,
+        "echo": True})
+    assert status == 200
+    assert json.loads(body)["choices"][0]["text"] == \
+        "ab" + tok.decode(want)
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                      timeout=120)
+    conn.request("POST", "/v1/completions", json.dumps({
+        "prompt": "ab", "temperature": 0, "max_tokens": 8,
+        "stream": True, "echo": True,
+        "stream_options": {"include_usage": True}}),
+        {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    raw = resp.read().decode()
+    conn.close()
+    datas = [line[len("data: "):] for line in raw.splitlines()
+             if line.startswith("data: ")]
+    assert datas[-1] == "[DONE]"
+    chunks = [json.loads(d) for d in datas[:-1]]
+    # echo chunk first, usage-only chunk last
+    assert chunks[0]["choices"][0]["text"] == "ab"
+    assert chunks[-1]["choices"] == []
+    assert chunks[-1]["usage"] == {"prompt_tokens": 2,
+                                   "completion_tokens": 8,
+                                   "total_tokens": 10}
+    text = "".join(c["choices"][0]["text"] for c in chunks[1:-1])
+    assert text == tok.decode(want)
+    # stream_options without stream: 400
+    status, body = _post_openai(srv.port, {
+        "prompt": "ab", "max_tokens": 2,
+        "stream_options": {"include_usage": True}})
+    assert status == 400
+    assert "stream" in json.loads(body)["error"]["message"]
+
+
 def test_openai_completions_needs_tokenizer(server):
     status, body = _post_openai(server.port, {"prompt": "hi"})
     assert status == 400

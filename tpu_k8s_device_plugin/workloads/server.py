@@ -45,10 +45,12 @@ API (JSON over HTTP/1.1):
   POST /v1/completions   OpenAI-compatible text completions (needs
                    --tokenizer): string or token-array "prompt",
                    max_tokens/temperature/top_p/n/seed/penalties/
-                   logprobs/stop, "response_format" {"type":
+                   logprobs/stop/echo, "response_format" {"type":
                    "json_object" | "json_schema"} and "guided_regex"
                    for guided decoding, "stream": true = SSE data:
-                   chunks ending in [DONE]; usage token accounting.
+                   chunks ending in [DONE] (stream_options
+                   include_usage appends a usage-only chunk); usage
+                   token accounting.
   POST /v1/chat/completions   chat variant: "messages" rendered by
                    the tokenizer's chat template; responses carry
                    message/delta objects in the chat wire shape.
@@ -181,6 +183,21 @@ def _find_stop(st: _DetokState, stop_strs, scanned_from: int):
     return keep, st.text[:pos]
 
 
+def _sse_envelope(rid: str, model_name: str, chat: bool, choices,
+                  **extra) -> dict:
+    """The one SSE chunk envelope (id/object/model/created) — every
+    chunk shape (role, echo, deltas, final, usage) builds on it so the
+    wire format cannot drift between sites."""
+    return {
+        "id": rid,
+        "object": "chat.completion.chunk" if chat else "text_completion",
+        "model": model_name,
+        "created": int(time.time()),
+        "choices": choices,
+        **extra,
+    }
+
+
 def _openai_chunk(rid: str, model_name: str, ev: dict, sent: dict,
                   chat: bool = False):
     """One SSE chunk for a native event, or None for events the OpenAI
@@ -190,8 +207,6 @@ def _openai_chunk(rid: str, model_name: str, ev: dict, sent: dict,
     (BPE holdback / rewritten-history cases deliberately under-stream;
     see _emit).  *chat* switches to the chat.completion.chunk shape
     (delta objects instead of text fields)."""
-    obj = "chat.completion.chunk" if chat else "text_completion"
-
     def choice(idx, text, reason):
         if chat:
             delta = {"content": text} if text else {}
@@ -202,11 +217,8 @@ def _openai_chunk(rid: str, model_name: str, ev: dict, sent: dict,
     if "text" in ev and "done" not in ev:
         idx = ev.get("index", 0)
         sent[idx] = sent.get(idx, "") + ev["text"]
-        return {
-            "id": rid, "object": obj, "model": model_name,
-            "created": int(time.time()),
-            "choices": [choice(idx, ev["text"], None)],
-        }
+        return _sse_envelope(rid, model_name, chat,
+                             [choice(idx, ev["text"], None)])
     if "done" in ev:
         chs = (ev["choices"] if "choices" in ev
                else [{**ev, "index": 0}])
@@ -223,16 +235,13 @@ def _openai_chunk(rid: str, model_name: str, ev: dict, sent: dict,
                 tail = final
             choices.append(
                 choice(c["index"], tail, c["finish_reason"]))
-        return {
-            "id": rid, "object": obj, "model": model_name,
-            "created": int(time.time()),
-            "choices": choices,
-        }
+        return _sse_envelope(rid, model_name, chat, choices)
     return None
 
 
 def _openai_response(rid: str, model_name: str, req: "_Request",
-                     done: dict, chat: bool = False) -> dict:
+                     done: dict, chat: bool = False,
+                     echo_text: Optional[str] = None) -> dict:
     chs = done["choices"] if "choices" in done else [{**done, "index": 0}]
     choices = []
     completion_tokens = 0
@@ -273,7 +282,9 @@ def _openai_response(rid: str, model_name: str, req: "_Request",
         else:
             choices.append({
                 "index": c["index"],
-                "text": c.get("text", ""),
+                # echo (OpenAI completions): the prompt text leads the
+                # completion in every choice
+                "text": (echo_text or "") + c.get("text", ""),
                 "finish_reason": c["finish_reason"],
                 "logprobs": lp,
             })
@@ -324,6 +335,9 @@ class _Request:
     detok: dict = field(default_factory=dict)  # idx -> _DetokState
     stop_scanned: dict = field(default_factory=dict)  # idx -> char off
     openai_logprobs: Optional[int] = None  # client-requested count
+    echo: bool = False                # OpenAI completions echo
+    echo_text: str = ""               # the ORIGINAL prompt text
+    include_usage: bool = False       # stream_options.include_usage
     logit_bias: Optional[dict] = None      # {token id: bias}
     min_tokens: int = 0                    # eos/stop floor (vLLM)
     # guided decoding (vLLM's guided_regex / OpenAI response_format):
@@ -810,6 +824,25 @@ class EngineServer:
                         # the client-requested count (may be 0): the
                         # response trims the engine's top list to it
                         req.openai_logprobs = native["_lp_count"]
+                    req.echo = bool(native.get("_echo"))
+                    if req.echo:
+                        # the ORIGINAL prompt string when the client
+                        # sent one (decode(req.tokens) would echo the
+                        # tokenizer's BOS/special text); token-array
+                        # prompts decode skipping specials when the
+                        # tokenizer supports it
+                        if isinstance(native.get("prompt"), str):
+                            req.echo_text = native["prompt"]
+                        else:
+                            try:
+                                req.echo_text = server.tokenizer.decode(
+                                    req.tokens,
+                                    skip_special_tokens=True)
+                            except TypeError:  # minimal test fakes
+                                req.echo_text = server.tokenizer.decode(
+                                    req.tokens)
+                    req.include_usage = bool(
+                        native.get("_include_usage"))
                 except (ValueError, TypeError, KeyError) as e:
                     self._openai_error(400, str(e))
                     return
@@ -848,16 +881,21 @@ class EngineServer:
                 if chat:
                     # the chat stream contract: role arrives in the
                     # first chunk's delta, content in later deltas
-                    self._chunk("data: " + json.dumps({
-                        "id": rid, "object": "chat.completion.chunk",
-                        "model": model_name,
-                        "created": int(time.time()),
-                        "choices": [
-                            {"index": i,
-                             "delta": {"role": "assistant"},
-                             "finish_reason": None}
-                            for i in range(req.n)],
-                    }) + "\n\n")
+                    self._chunk("data: " + json.dumps(_sse_envelope(
+                        rid, model_name, True,
+                        [{"index": i,
+                          "delta": {"role": "assistant"},
+                          "finish_reason": None}
+                         for i in range(req.n)])) + "\n\n")
+                if req.echo and not chat:
+                    # OpenAI echo streams the prompt text first, one
+                    # chunk covering every choice (it never counts
+                    # toward the completion's sent-text accounting)
+                    self._chunk("data: " + json.dumps(_sse_envelope(
+                        rid, model_name, False,
+                        [{"index": i, "text": req.echo_text,
+                          "finish_reason": None}
+                         for i in range(req.n)])) + "\n\n")
                 sent: dict = {}  # index -> streamed text so far
                 ev = first
                 while True:
@@ -878,6 +916,26 @@ class EngineServer:
                         self._chunk("data: " + json.dumps(chunk)
                                     + "\n\n")
                     if "done" in ev:
+                        if req.include_usage:
+                            # stream_options.include_usage: one final
+                            # usage-only chunk before [DONE]
+                            chs = (ev["choices"] if "choices" in ev
+                                   else [ev])
+                            completion = sum(
+                                len(c.get("tokens", ()))
+                                for c in chs)
+                            self._chunk("data: " + json.dumps(
+                                _sse_envelope(
+                                    rid, model_name, chat, [],
+                                    usage={
+                                        "prompt_tokens":
+                                            len(req.tokens),
+                                        "completion_tokens":
+                                            completion,
+                                        "total_tokens":
+                                            len(req.tokens)
+                                            + completion,
+                                    })) + "\n\n")
                         break
                     ev = req.events.get()
                 self._chunk("data: [DONE]\n\n")
@@ -892,11 +950,14 @@ class EngineServer:
                                            ev["error"])
                         return
                     if "done" in ev:
+                        echo_text = (req.echo_text if req.echo
+                                     else None)
                         self._send(
                             200, "application/json",
                             json.dumps(_openai_response(
                                 f"cmpl-{id(req):x}", model_name,
-                                req, ev, chat=chat)) + "\n")
+                                req, ev, chat=chat,
+                                echo_text=echo_text)) + "\n")
                         return
 
             def _stream(self, req: _Request):
@@ -1188,6 +1249,18 @@ class EngineServer:
             native["guided_regex"] = opt("guided_regex")
         if opt("guided_choice") is not None:  # vLLM's OpenAI extension
             native["guided_choice"] = opt("guided_choice")
+        if opt("echo"):
+            native["_echo"] = True
+        so = opt("stream_options")
+        if so is not None:
+            if not bool(body.get("stream", False)):
+                raise ValueError(
+                    "'stream_options' is only allowed with "
+                    "'stream': true")
+            if not isinstance(so, dict):
+                raise ValueError("'stream_options' must be an object")
+            if so.get("include_usage"):
+                native["_include_usage"] = True
         return native, str(opt("model", "default"))
 
     def _openai_chat_to_native(self, body: dict):
@@ -1212,6 +1285,9 @@ class EngineServer:
             raise ValueError(
                 "'messages' must be a non-empty list of "
                 "{role, content} objects")
+        if body.get("echo"):
+            raise ValueError(
+                "'echo' is a completions-only parameter")
         prompt = template(messages, tokenize=False,
                           add_generation_prompt=True)
         flat = dict(body)
