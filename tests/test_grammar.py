@@ -731,6 +731,32 @@ def test_guided_composes_with_ngram_spec(setup):
         srv.stop()
 
 
+def test_concurrent_distinct_patterns(grammar_server):
+    """Two clients with two NEW patterns in flight at once: handler
+    threads compile concurrently, the scheduler registers both, and
+    each stream honors its OWN grammar (the _glock/_grammar_gids
+    handoff under real concurrency)."""
+    import threading
+
+    srv, eng = grammar_server
+    pats = {"(AB)+E": None, "(CD)+E": None}
+    results = {}
+
+    def go(pat):
+        results[pat] = _post(srv.port, {
+            "tokens": [70, 71], "guided_regex": pat, "stream": False})
+
+    ts = [threading.Thread(target=go, args=(p,)) for p in pats]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for pat, (status, events) in results.items():
+        assert status == 200, (pat, events)
+        assert _valid_prefix(_decode(events[0]["tokens"]), pat), pat
+    assert eng.n_grammars >= 2
+
+
 def test_response_format_openai(setup):
     """OpenAI response_format={"type": "json_object"} constrains
     /v1/completions output to a JSON OBJECT (token bytes derived from

@@ -762,8 +762,21 @@ def test_openai_echo_and_stream_usage(text_server):
     assert chunks[-1]["usage"] == {"prompt_tokens": 2,
                                    "completion_tokens": 8,
                                    "total_tokens": 10}
+    # the include_usage contract: every preceding chunk says usage null
+    assert all(c["usage"] is None for c in chunks[:-1])
     text = "".join(c["choices"][0]["text"] for c in chunks[1:-1])
     assert text == tok.decode(want)
+    # echo + logprobs: arrays cover prompt + completion, first null
+    status, body = _post_openai(srv.port, {
+        "prompt": "ab", "temperature": 0, "max_tokens": 4,
+        "echo": True, "logprobs": 1})
+    assert status == 200
+    lp = json.loads(body)["choices"][0]["logprobs"]
+    assert len(lp["tokens"]) == 2 + 4
+    assert lp["token_logprobs"][0] is None
+    assert lp["top_logprobs"][0] is None
+    assert all(isinstance(v, float)
+               for v in lp["token_logprobs"][1:])
     # stream_options without stream: 400
     status, body = _post_openai(srv.port, {
         "prompt": "ab", "max_tokens": 2,

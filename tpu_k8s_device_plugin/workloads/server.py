@@ -183,6 +183,16 @@ def _find_stop(st: _DetokState, stop_strs, scanned_from: int):
     return keep, st.text[:pos]
 
 
+def _usage(prompt_tokens: int, completion_tokens: int) -> dict:
+    """The ONE usage object (streamed final chunk and unary response
+    share it, so the two surfaces cannot drift)."""
+    return {
+        "prompt_tokens": prompt_tokens,
+        "completion_tokens": completion_tokens,
+        "total_tokens": prompt_tokens + completion_tokens,
+    }
+
+
 def _sse_envelope(rid: str, model_name: str, chat: bool, choices,
                   **extra) -> dict:
     """The one SSE chunk envelope (id/object/model/created) — every
@@ -199,7 +209,7 @@ def _sse_envelope(rid: str, model_name: str, chat: bool, choices,
 
 
 def _openai_chunk(rid: str, model_name: str, ev: dict, sent: dict,
-                  chat: bool = False):
+                  chat: bool = False, include_usage: bool = False):
     """One SSE chunk for a native event, or None for events the OpenAI
     stream does not carry (raw token ids).  *sent* accumulates the text
     streamed per choice index so the final chunk can flush whatever the
@@ -217,8 +227,12 @@ def _openai_chunk(rid: str, model_name: str, ev: dict, sent: dict,
     if "text" in ev and "done" not in ev:
         idx = ev.get("index", 0)
         sent[idx] = sent.get(idx, "") + ev["text"]
-        return _sse_envelope(rid, model_name, chat,
-                             [choice(idx, ev["text"], None)])
+        return _sse_envelope(
+            rid, model_name, chat,
+            [choice(idx, ev["text"], None)],
+            # OpenAI's include_usage contract: every chunk BEFORE the
+            # final usage-only one carries "usage": null
+            **({"usage": None} if include_usage else {}))
     if "done" in ev:
         chs = (ev["choices"] if "choices" in ev
                else [{**ev, "index": 0}])
@@ -235,7 +249,9 @@ def _openai_chunk(rid: str, model_name: str, ev: dict, sent: dict,
                 tail = final
             choices.append(
                 choice(c["index"], tail, c["finish_reason"]))
-        return _sse_envelope(rid, model_name, chat, choices)
+        return _sse_envelope(
+            rid, model_name, chat, choices,
+            **({"usage": None} if include_usage else {}))
     return None
 
 
@@ -271,6 +287,20 @@ def _openai_response(rid: str, model_name: str, req: "_Request",
                     "tokens": [str(t) for t in c["tokens"]],
                     "text_offset": None,
                 }
+                prec = (c.get("prompt_logprobs")
+                        or done.get("prompt_logprobs"))
+                if echo_text is not None and prec:
+                    # echo+logprobs: prompt entries lead (first null),
+                    # aligning the arrays with the echoed text
+                    lp["tokens"] = [str(t) for t in req.tokens]                         + lp["tokens"]
+                    lp["token_logprobs"] = [
+                        None if r is None else r["logprob"]
+                        for r in prec] + lp["token_logprobs"]
+                    lp["top_logprobs"] = [
+                        None if r is None else
+                        {str(i): pr
+                         for i, pr in r["top_logprobs"][:n]}
+                        for r in prec] + lp["top_logprobs"]
         if chat:
             choices.append({
                 "index": c["index"],
@@ -294,11 +324,7 @@ def _openai_response(rid: str, model_name: str, req: "_Request",
         "model": model_name,
         "created": int(time.time()),
         "choices": choices,
-        "usage": {
-            "prompt_tokens": len(req.tokens),
-            "completion_tokens": completion_tokens,
-            "total_tokens": len(req.tokens) + completion_tokens,
-        },
+        "usage": _usage(len(req.tokens), completion_tokens),
     }
 
 
@@ -886,7 +912,9 @@ class EngineServer:
                         [{"index": i,
                           "delta": {"role": "assistant"},
                           "finish_reason": None}
-                         for i in range(req.n)])) + "\n\n")
+                         for i in range(req.n)],
+                        **({"usage": None} if req.include_usage
+                           else {}))) + "\n\n")
                 if req.echo and not chat:
                     # OpenAI echo streams the prompt text first, one
                     # chunk covering every choice (it never counts
@@ -895,7 +923,9 @@ class EngineServer:
                         rid, model_name, False,
                         [{"index": i, "text": req.echo_text,
                           "finish_reason": None}
-                         for i in range(req.n)])) + "\n\n")
+                         for i in range(req.n)],
+                        **({"usage": None} if req.include_usage
+                           else {}))) + "\n\n")
                 sent: dict = {}  # index -> streamed text so far
                 ev = first
                 while True:
@@ -910,8 +940,9 @@ class EngineServer:
                             "error": {"message": ev["error"],
                                       "type": kind}}) + "\n\n")
                         break
-                    chunk = _openai_chunk(rid, model_name, ev, sent,
-                                          chat=chat)
+                    chunk = _openai_chunk(
+                        rid, model_name, ev, sent, chat=chat,
+                        include_usage=req.include_usage)
                     if chunk is not None:
                         self._chunk("data: " + json.dumps(chunk)
                                     + "\n\n")
@@ -927,15 +958,9 @@ class EngineServer:
                             self._chunk("data: " + json.dumps(
                                 _sse_envelope(
                                     rid, model_name, chat, [],
-                                    usage={
-                                        "prompt_tokens":
-                                            len(req.tokens),
-                                        "completion_tokens":
-                                            completion,
-                                        "total_tokens":
-                                            len(req.tokens)
-                                            + completion,
-                                    })) + "\n\n")
+                                    usage=_usage(len(req.tokens),
+                                                 completion)))
+                                + "\n\n")
                         break
                     ev = req.events.get()
                 self._chunk("data: [DONE]\n\n")
@@ -1251,6 +1276,12 @@ class EngineServer:
             native["guided_choice"] = opt("guided_choice")
         if opt("echo"):
             native["_echo"] = True
+            if native.get("logprobs"):
+                # OpenAI echo+logprobs covers the PROMPT tokens too
+                # (first entry null): ride the engine's prompt_logprobs
+                # (prefill-logit scoring) so the response aligns
+                # tokens/token_logprobs with the echoed text
+                native["prompt_logprobs"] = native["logprobs"]
         so = opt("stream_options")
         if so is not None:
             if not bool(body.get("stream", False)):
