@@ -1,26 +1,37 @@
 """Grammar-constrained decoding support (vLLM's guided decoding).
 
 The TPU-shaped design: a grammar is compiled AHEAD of decoding into a
-token-level DFA — ``table[state, token] -> next state`` (-1 rejects)
-and a ``mask[state, token]`` additive logit mask (0 / -1e9) — and the
-DFA state rides the decode scan's carry.  Constrained generation then
-costs one gather and one add per step inside the SAME compiled
-``lax.scan`` as unconstrained decoding: no per-token host round-trip,
-no Python in the loop (the xgrammar/outlines token-bitmask idea,
-expressed as jit-friendly arrays).
+token-level DFA — ``table[state, token] -> next state`` (-1 rejects;
+the additive logit mask is DERIVED from reject entries, never stored)
+— and the DFA state rides the decode scan's carry.  Constrained
+generation then costs one ``[S, V]`` row gather per step inside the
+SAME compiled ``lax.scan`` as unconstrained decoding: no per-token
+host round-trip, no Python in the loop (the xgrammar/outlines
+token-bitmask idea, expressed as jit-friendly arrays).  Runs the DFA
+*forces* (single legal continuation) commit through the engine's
+structural jump-ahead (``ServingEngine.jump_round``) in one
+multi-token extend.
 
 Pipeline:
 
 1. ``regex_to_dfa(pattern)`` — a small regex subset (literals, ``|``,
    ``*`` ``+`` ``?``, ``(...)``, ``[a-z]`` classes, ``.``) compiled
    via Thompson NFA + subset construction over the byte alphabet.
+   ``json_value_regex`` / ``json_object_regex`` / ``schema_to_regex``
+   lower JSON constraints (RFC 8259-strict; compact output for
+   schemas) into the subset; ``token_bytes_of`` maps a tokenizer's
+   vocabulary to byte strings.
 2. ``token_dfa(dfa, token_bytes, eos_id)`` — the char DFA is closed
-   over the tokenizer's vocabulary: walking each token's bytes from
-   each state yields the token-level table; ``eos`` is allowed exactly
-   in ACCEPTING states (structural completion gates the stop).
+   over the vocabulary (vectorized [N, V] walks), trimmed to
+   co-accessible states, and dead-end-checked; ``eos`` is allowed
+   exactly in ACCEPTING states (structural completion gates the
+   stop).
 
-Engines take the result as ``ServingEngine(grammar=...)`` and requests
-opt in with ``admit(grammar=True)``.
+Engines hold a REGISTRY of these (``ServingEngine(grammar=...)`` or
+``register_grammar()``); requests opt in with ``admit(grammar=gid)``
+(``True`` = grammar 0).  The HTTP front door (server.py) lowers
+per-request ``guided_regex`` / ``guided_json`` / ``guided_choice`` /
+OpenAI ``response_format`` through this module.
 """
 
 from __future__ import annotations
