@@ -11,6 +11,7 @@ into random decode-API interleavings with random releases, then checks
 every retired request token-for-token against a SOLO single-slot
 engine running the same request alone."""
 
+import os
 import random
 
 import jax
@@ -82,7 +83,10 @@ def _rand_request(rnd):
 
 def test_random_interleavings_match_solo_oracles(models):
     model, params, dfa = models
-    rnd = random.Random(2026)
+    # deterministic in the suite; ENGINE_FUZZ_SEED sweeps new
+    # interleavings out-of-band (a standing offline bug hunt)
+    seed = int(os.environ.get("ENGINE_FUZZ_SEED") or 2026)
+    rnd = random.Random(seed)
     checked = 0
     for trial in range(3):
         max_new = rnd.randint(5, 8)
@@ -139,5 +143,6 @@ def test_random_interleavings_match_solo_oracles(models):
             assert solo.output(s) == out, (prompt, kw, trial)
             assert solo.finish_reason(s) == reason, (prompt, kw)
             checked += 1
-    # the fuzz must actually have exercised retirements
-    assert checked >= 10, checked
+    # the fuzz must actually have exercised retirements (calibrated
+    # for the default seed; swept seeds only need SOME coverage)
+    assert checked >= (10 if seed == 2026 else 1), checked
