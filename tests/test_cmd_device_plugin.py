@@ -99,3 +99,90 @@ class TestFlagValidation:
     def test_unknown_strategy_rejected(self):
         with pytest.raises(SystemExit):
             build_parser().parse_args(["--resource_naming_strategy", "both"])
+
+
+class TestSliceFlags:
+    """--slice-rendezvous / --slice-workers: validation, env overrides,
+    and coordinator self-election (docs §"Multi-host slices")."""
+
+    def _impl(self, testdata):
+        impl, _ = select_device_impl(args_for(testdata, "v5e-16-host0"))
+        return impl
+
+    def test_default_off(self, testdata):
+        args = args_for(testdata, "v5e-16-host0")
+        assert args.slice_rendezvous == "" and args.slice_workers == 0
+
+    def test_env_overrides(self, testdata, monkeypatch):
+        from tpu_k8s_device_plugin.types import constants
+        monkeypatch.setenv(constants.ENV_SLICE_RENDEZVOUS, "h0:8475")
+        monkeypatch.setenv(constants.ENV_SLICE_WORKERS, "4")
+        args = args_for(testdata, "v5e-16-host0")
+        assert args.slice_rendezvous == "h0:8475"
+        assert args.slice_workers == 4
+
+    def test_bad_address_rejected(self, testdata):
+        from tpu_k8s_device_plugin.cmd.device_plugin import setup_slice
+        impl = self._impl(testdata)
+        args = args_for(testdata, "v5e-16-host0",
+                        "--slice-rendezvous", "no-port",
+                        "--slice-workers", "2")
+        with pytest.raises(SystemExit, match="HOST:PORT"):
+            setup_slice(args, impl, "container")
+
+    def test_workers_required(self, testdata):
+        from tpu_k8s_device_plugin.cmd.device_plugin import setup_slice
+        impl = self._impl(testdata)
+        args = args_for(testdata, "v5e-16-host0",
+                        "--slice-rendezvous", "h0:8475")
+        with pytest.raises(SystemExit, match="slice-workers"):
+            setup_slice(args, impl, "container")
+
+    def test_passthrough_driver_rejected(self, testdata):
+        from tpu_k8s_device_plugin.cmd.device_plugin import setup_slice
+        impl = self._impl(testdata)
+        args = args_for(testdata, "v5e-16-host0",
+                        "--slice-rendezvous", "h0:8475",
+                        "--slice-workers", "2")
+        with pytest.raises(SystemExit, match="container driver"):
+            setup_slice(args, impl, "pf-passthrough")
+
+    def test_self_election_and_wiring(self, testdata, tmp_path, monkeypatch):
+        """ONLY the plugin whose hostname exactly matches the rendezvous
+        HOST serves the coordinator (identical flags on every member, one
+        self-elects); every plugin gets a client attached to its impl
+        with the host's metadata coordinate."""
+        import socket as socket_mod
+
+        from tpu_k8s_device_plugin.cmd.device_plugin import setup_slice
+
+        with socket_mod.socket() as s:   # free ephemeral port
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        impl = self._impl(testdata)
+        args = args_for(
+            testdata, "v5e-16-host0",
+            "--slice-rendezvous", f"tpu-host-0:{port}",
+            "--slice-workers", "2",
+            "--slice-state-file", str(tmp_path / "membership.json"),
+        )
+        monkeypatch.setattr(socket_mod, "gethostname", lambda: "tpu-host-0")
+        coordinator, client = setup_slice(args, impl, "container")
+        try:
+            assert coordinator is not None      # exact hostname match
+            assert impl._slice is client
+            assert client._coords == (0,)       # fixture WORKER_ID: '0'
+            assert client._chip_count == 8
+        finally:
+            client.stop()
+            coordinator.stop()
+
+        # a DIFFERENT hostname must NOT self-elect a second coordinator
+        monkeypatch.setattr(socket_mod, "gethostname", lambda: "tpu-host-1")
+        impl2 = self._impl(testdata)
+        coordinator2, client2 = setup_slice(args, impl2, "container")
+        try:
+            assert coordinator2 is None
+            assert impl2._slice is client2
+        finally:
+            client2.stop()

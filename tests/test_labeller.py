@@ -78,6 +78,39 @@ class TestGenerators:
         assert labels[f"{base}.worker-id"] == "1"
         assert labels[f"{base}.num-workers"] == "2"
 
+    def test_slice_labels_from_membership_file(self, testdata, tmp_path,
+                                               monkeypatch):
+        """With a formed slice persisted by the plugin's slice client, the
+        labeller emits the slice-id (pod-affinity key) and this host's
+        rendezvous rank; without the file, neither label appears."""
+        from tpu_k8s_device_plugin.slice import Membership, save_membership
+
+        monkeypatch.setattr("socket.gethostname", lambda: "host-b")
+        root = os.path.join(testdata, "v5e-16-host1")
+        kwargs = dict(
+            driver_type=constants.CONTAINER,
+            sysfs_root=os.path.join(root, "sys"),
+            dev_root=os.path.join(root, "dev"),
+            tpu_env_path=os.path.join(root, "run", "tpu", "tpu-env"),
+        )
+        base = constants.LABEL_PREFIX
+
+        no_file = generate_labels(LabelContext.collect(
+            slice_state_path=str(tmp_path / "absent.json"), **kwargs))
+        assert f"{base}.slice-id" not in no_file
+        assert f"{base}.slice-rank" not in no_file
+
+        state = tmp_path / "membership.json"
+        save_membership(str(state), Membership(
+            slice_id="abc123def456", generation=1,
+            hostnames=("host-a", "host-b"),
+            coordinator_address="host-a:8476",
+        ))
+        labels = generate_labels(LabelContext.collect(
+            slice_state_path=str(state), **kwargs))
+        assert labels[f"{base}.slice-id"] == "abc123def456"
+        assert labels[f"{base}.slice-rank"] == "1"
+
     def test_v5p_partitioned_host(self, testdata):
         labels = generate_labels(ctx_for(testdata, "v5p-8-core"))
         base = constants.LABEL_PREFIX

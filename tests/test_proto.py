@@ -122,3 +122,36 @@ def test_method_paths_match_kubelet_abi():
         "PreStartContainer",
     ]
     assert "Registration" in fd.services_by_name
+
+
+def test_slice_roundtrip_and_service_shape():
+    """slice.proto wire sanity.  Its _pb2 is built by the no-protoc
+    fallback (tools/gen_slice_pb2.py), so pin both the roundtrip AND the
+    descriptor shape a real protoc run must reproduce."""
+    from tpu_k8s_device_plugin.proto import (
+        slice_pb2 as spb,
+        slice_pb2_grpc as spb_grpc,
+    )
+
+    jr = spb.JoinResponse(
+        formed=True, rank=1, joined=2, expected=2,
+        membership=spb.Membership(
+            slice_id="abc123", generation=1, num_workers=2,
+            hostnames=["host-a", "host-b"],
+            coordinator_address="host-a:8476",
+        ),
+    )
+    jr2 = spb.JoinResponse.FromString(jr.SerializeToString())
+    assert jr2.rank == 1 and tuple(jr2.membership.hostnames) == (
+        "host-a", "host-b")
+
+    hb = spb.HeartbeatRequest(hostname="host-b", healthy=False,
+                              reason="chip_state=dead", generation=1)
+    assert spb.HeartbeatRequest.FromString(
+        hb.SerializeToString()).reason == "chip_state=dead"
+
+    fd = spb.DESCRIPTOR
+    assert fd.package == "tpuslice"
+    svc = fd.services_by_name["SliceRendezvous"]
+    assert sorted(m.name for m in svc.methods) == ["Heartbeat", "Join"]
+    assert spb_grpc is not None

@@ -1,0 +1,356 @@
+"""Multi-host slice coordination: rendezvous, ranks, health propagation.
+
+Two full plugin managers — one per v5e-16 fixture host, each with its own
+fake kubelet — form a 2-host slice over real gRPC sockets and must hand
+every container a consistent env contract; a chip wedged on host A (the
+sysfs ``chip_state`` watch) must flip host B's devices Unhealthy in its
+next ListAndWatch frame, and recovery must propagate back; a restarted
+coordinator or worker must recover membership from the crash-safe state
+file without re-forming the slice.
+"""
+
+import concurrent.futures
+import os
+import shutil
+import time
+
+import grpc
+import pytest
+
+from tpu_k8s_device_plugin.health.server import probe_chip_states
+from tpu_k8s_device_plugin.manager import PluginManager
+from tpu_k8s_device_plugin.proto import deviceplugin_pb2 as pluginapi
+from tpu_k8s_device_plugin.slice import (
+    SliceClient,
+    SliceCoordinator,
+    SliceState,
+    load_membership,
+)
+from tpu_k8s_device_plugin.tpu.device_impl import TpuContainerImpl
+from tpu_k8s_device_plugin.tpu.topology import derive_worker_identity
+from tpu_k8s_device_plugin.types import constants
+
+from fake_kubelet import FakeKubelet, ListAndWatchConsumer
+
+_JAX_PORT = 8476
+
+
+class SliceHost:
+    """One member: mutable fixture tree, device impl (sysfs-fed granular
+    health), slice client, fake kubelet, and a pulsing plugin manager."""
+
+    def __init__(self, name, fixture, testdata, tmp_path, rendezvous):
+        self.name = name
+        root = tmp_path / name
+        shutil.copytree(os.path.join(testdata, fixture), root, symlinks=True)
+        self.sys_root = str(root / "sys")
+        self.dev_root = str(root / "dev")
+        self.impl = TpuContainerImpl(
+            sysfs_root=self.sys_root,
+            dev_root=self.dev_root,
+            tpu_env_path=str(root / "run" / "tpu" / "tpu-env"),
+            health_fn=self._granular,
+        )
+        self.client = SliceClient(
+            rendezvous_address=rendezvous,
+            hostname=name,
+            coords=(self.impl.topology.worker_id,),
+            chip_count=len(self.impl.chips),
+            state_path=str(tmp_path / f"{name}-membership.json"),
+            local_health_fn=self.impl.local_health,
+        )
+        self.impl.set_slice_client(self.client)
+        self.kubelet = FakeKubelet(str(tmp_path / f"{name}-dp")).start()
+        self.manager = PluginManager(
+            self.impl,
+            pulse_seconds=0,
+            kubelet_dir=self.kubelet.dir,
+            kubelet_watch_interval_s=0.1,
+            slice_client=self.client,
+        )
+
+    def _granular(self):
+        states = probe_chip_states(self.sys_root, self.dev_root)
+        return {cid: st.health for cid, st in states.items()}
+
+    def pulse(self):
+        """One manual pulse round, exactly the manager loop's order:
+        slice heartbeat first, then beat every plugin."""
+        self.client.heartbeat_now()
+        with self.manager._plugins_lock:
+            plugins = list(self.manager._plugins.values())
+        for sp in plugins:
+            sp.plugin.beat()
+
+    def wedge_chip(self, pci_address, state="dead"):
+        attr = os.path.join(
+            self.sys_root, "devices", "pci0000:00", pci_address,
+            constants.SYSFS_CHIP_STATE,
+        )
+        with open(attr, "w") as f:
+            f.write(f"{state}\n")
+
+    def stop(self):
+        self.manager.stop()
+        self.client.stop()
+        self.kubelet.stop()
+
+
+@pytest.fixture
+def coordinator(tmp_path):
+    c = SliceCoordinator(
+        expected_workers=2,
+        bind_address="127.0.0.1:0",
+        jax_port=_JAX_PORT,
+        state_path=str(tmp_path / "coordinator-membership.json"),
+        heartbeat_timeout_s=0.0,  # tests drive heartbeats explicitly
+    ).start()
+    yield c
+    c.stop()
+
+
+@pytest.fixture
+def hosts(coordinator, testdata, tmp_path):
+    rendezvous = f"127.0.0.1:{coordinator.port}"
+    pair = [
+        SliceHost("host-a", "v5e-16-host0", testdata, tmp_path, rendezvous),
+        SliceHost("host-b", "v5e-16-host1", testdata, tmp_path, rendezvous),
+    ]
+    yield pair
+    for h in pair:
+        h.stop()
+
+
+def _form(hosts):
+    """Concurrent joins, as in real deployments (each plugin process polls
+    until the slice forms).  host-b is submitted first: ranks must come
+    from ICI coordinates, not from who knocked first."""
+    with concurrent.futures.ThreadPoolExecutor(len(hosts)) as pool:
+        futures = [
+            pool.submit(h.client.join, timeout_s=15.0)
+            for h in reversed(hosts)
+        ]
+        for f in futures:
+            f.result(timeout=20.0)
+
+
+def _allocate_all(host):
+    """Drive Allocate exactly as the kubelet would, over the wire."""
+    assert host.kubelet.wait_for_registration()
+    stub = host.kubelet.plugin_stub("google.com_tpu")
+    consumer = ListAndWatchConsumer(stub)
+    frame = consumer.next_frame()
+    ids = [d.ID for d in frame.devices]
+    resp = stub.Allocate(
+        pluginapi.AllocateRequest(
+            container_requests=[
+                pluginapi.ContainerAllocateRequest(devices_ids=ids)
+            ]
+        )
+    )
+    [car] = resp.container_responses
+    return consumer, dict(car.envs)
+
+
+def test_two_hosts_form_slice_with_consistent_env(hosts):
+    """Acceptance: two coordinated managers, consistent rank/hostname env
+    in both Allocate responses over real gRPC."""
+    _form(hosts)
+    a, b = hosts
+    # deterministic ranks from ICI coordinates (host-a is worker 0 in the
+    # fixture metadata) even though host-b joined first
+    assert a.client.rank == 0 and b.client.rank == 1
+    m = a.client.membership
+    assert m.hostnames == ("host-a", "host-b")
+    assert m.coordinator_address == f"host-a:{_JAX_PORT}"
+    assert b.client.membership == m
+
+    a.manager.run(block=False)
+    b.manager.run(block=False)
+    _, env_a = _allocate_all(a)
+    _, env_b = _allocate_all(b)
+
+    # the rendezvous contract, identical on both members modulo rank
+    assert env_a[constants.ENV_TPU_WORKER_ID] == "0"
+    assert env_b[constants.ENV_TPU_WORKER_ID] == "1"
+    for env in (env_a, env_b):
+        assert env[constants.ENV_TPU_WORKER_HOSTNAMES] == "host-a,host-b"
+        assert (env[constants.ENV_JAX_COORDINATOR_ADDRESS]
+                == f"host-a:{_JAX_PORT}")
+        assert env[constants.ENV_JAX_NUM_PROCESSES] == "2"
+        # the per-host topology env still rides along
+        assert env[constants.ENV_TPU_PROCESS_BOUNDS] == "2,1,1"
+    assert env_a[constants.ENV_JAX_PROCESS_ID] == "0"
+    assert env_b[constants.ENV_JAX_PROCESS_ID] == "1"
+
+    # the slice is operator-visible on the debug surface
+    from tpu_k8s_device_plugin.observability import manager_status
+    st = manager_status(b.manager)["slice"]
+    assert st["formed"] and st["rank"] == 1
+    assert st["hostnames"] == ["host-a", "host-b"]
+
+
+def test_allocate_before_formation_falls_back_to_metadata(
+    coordinator, testdata, tmp_path
+):
+    """A pod admitted while the slice is still forming gets the per-host
+    metadata view (no rendezvous contract yet) — the plugin serves its
+    kubelet without blocking on peers."""
+    h = SliceHost("host-b", "v5e-16-host1", testdata, tmp_path,
+                  f"127.0.0.1:{coordinator.port}")
+    try:
+        h.manager.run(block=False)
+        _, env = _allocate_all(h)
+        # tpu-env metadata WORKER_ID, not a rendezvous rank
+        assert env[constants.ENV_TPU_WORKER_ID] == "1"
+        assert constants.ENV_TPU_WORKER_HOSTNAMES not in env
+        assert constants.ENV_JAX_COORDINATOR_ADDRESS not in env
+    finally:
+        h.stop()
+
+
+def test_wedged_chip_propagates_slice_wide_and_recovers(hosts):
+    """Acceptance: a single-chip failure on host A reaches host B's
+    kubelet as all-Unhealthy within one heartbeat period, and recovery
+    propagates the same way."""
+    _form(hosts)
+    a, b = hosts
+    a.manager.run(block=False)
+    b.manager.run(block=False)
+    consumer_a, _ = _allocate_all(a)
+    consumer_b, _ = _allocate_all(b)
+
+    # settle: both members report healthy, both streams render it
+    a.pulse()
+    b.pulse()
+    frame = consumer_a.next_frame()
+    assert all(d.health == constants.HEALTHY for d in frame.devices)
+    frame = consumer_b.next_frame()
+    assert all(d.health == constants.HEALTHY for d in frame.devices)
+
+    # wedge one chip on A (driver-reported state, the chardev still opens)
+    a.wedge_chip("0000:00:06.0")
+    a.pulse()   # A probes the fault and ships it in its heartbeat
+    b.pulse()   # B learns the slice verdict, then beats its streams
+    frame = consumer_b.next_frame()
+    assert all(d.health == constants.UNHEALTHY for d in frame.devices), (
+        "host B must demote ALL its devices when host A has a wedged chip"
+    )
+    # A's own frame is demoted too (its chip is the faulty one)
+    frame = consumer_a.next_frame()
+    assert all(d.health == constants.UNHEALTHY for d in frame.devices)
+
+    # recovery: chip back alive -> whole slice healthy again
+    a.wedge_chip("0000:00:06.0", state=constants.CHIP_STATE_ALIVE)
+    a.pulse()
+    b.pulse()
+    frame = consumer_b.next_frame()
+    assert all(d.health == constants.HEALTHY for d in frame.devices)
+
+
+def test_coordinator_restart_recovers_membership(coordinator, hosts, tmp_path):
+    """Acceptance: a restarted coordinator serves the SAME membership
+    (ranks, slice id, generation) from its crash-safe state file, without
+    waiting for the full slice to re-join."""
+    _form(hosts)
+    before = hosts[0].client.membership
+    coordinator.stop()
+
+    revived = SliceCoordinator(
+        expected_workers=2,
+        bind_address=f"127.0.0.1:{coordinator.port}",
+        jax_port=_JAX_PORT,
+        state_path=coordinator.state.state_path,
+        heartbeat_timeout_s=0.0,
+    ).start()
+    try:
+        # ONE member rejoining suffices — no re-formation quorum
+        after = hosts[0].client.join(timeout_s=10.0)
+        assert after == before
+        assert revived.state.membership.generation == before.generation
+    finally:
+        revived.stop()
+
+
+def test_worker_restart_recovers_rank_from_state_file(hosts, tmp_path):
+    """A restarted worker knows its rank before any RPC (local state
+    file), and re-polling the coordinator confirms it without changing
+    the membership."""
+    _form(hosts)
+    b = hosts[1]
+    reborn = SliceClient(
+        rendezvous_address=b.client._address,
+        hostname=b.name,
+        state_path=b.client._state_path,
+    )
+    try:
+        assert reborn.rank == 1          # before any RPC
+        m = reborn.join(timeout_s=10.0)  # coordinator agrees, no re-form
+        assert m == b.client.membership
+    finally:
+        reborn.stop()
+
+
+def test_unknown_host_rejected_after_formation(hosts):
+    _form(hosts)
+    stranger = SliceClient(
+        rendezvous_address=hosts[0].client._address,
+        hostname="host-z",
+        state_path=None,
+    )
+    try:
+        with pytest.raises(RuntimeError, match="not a member"):
+            stranger.join(timeout_s=5.0)
+    finally:
+        stranger.stop()
+
+
+def test_join_times_out_without_coordinator(tmp_path):
+    lonely = SliceClient(
+        rendezvous_address="127.0.0.1:1",  # nothing listens there
+        hostname="host-a",
+        state_path=None,
+    )
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError, match="did not form"):
+            lonely.join(timeout_s=1.0)
+        assert time.monotonic() - t0 < 10.0
+    finally:
+        lonely.stop()
+
+
+def test_stale_member_drags_slice_unhealthy():
+    """Coordinator-side staleness: a member that stops heartbeating past
+    the timeout poisons the slice, exactly like a reported fault."""
+    s = SliceState(expected_workers=2, jax_port=_JAX_PORT,
+                   heartbeat_timeout_s=5.0)
+    s.join("host-a", coords=(0,), now=0.0)
+    s.join("host-b", coords=(1,), now=0.0)
+    v = s.heartbeat("host-a", healthy=True, now=1.0)
+    assert v.slice_healthy
+    # host-b silent for > timeout
+    v = s.heartbeat("host-a", healthy=True, now=7.0)
+    assert not v.slice_healthy and v.unhealthy_hostnames == ["host-b"]
+    # it comes back
+    s.heartbeat("host-b", healthy=True, now=8.0)
+    v = s.heartbeat("host-a", healthy=True, now=8.5)
+    assert v.slice_healthy
+
+
+def test_single_host_identity_unchanged():
+    """Satellite guard: without a slice client, both Allocate paths derive
+    the same worker identity as before (sub-host grants are worker 0 of a
+    standalone slice; full-host grants follow the metadata)."""
+    assert derive_worker_identity(None, full_host=False) == (0, 1)
+    assert derive_worker_identity(None, full_host=True) == (0, 1)
+
+
+def test_membership_file_round_trip(tmp_path, coordinator, hosts):
+    _form(hosts)
+    for h in hosts:
+        m = load_membership(h.client._state_path)
+        assert m == h.client.membership
+    # coordinator's own copy matches too
+    assert load_membership(coordinator.state.state_path) == \
+        hosts[0].client.membership

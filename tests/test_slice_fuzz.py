@@ -1,0 +1,128 @@
+"""Randomized rendezvous fuzz: the slice state machine under random
+join/leave/restart orderings.
+
+The rendezvous promise is order-independence: whatever interleaving of
+worker joins, pre-formation departures, worker restarts (new session,
+same hostname) and coordinator crashes (reload from the crash-safe state
+file) actually happens, the slice that forms is THE slice — ranks are
+the pure sorted-by-(coords, hostname) function of the member set, the
+membership survives coordinator restarts bit-for-bit, and slice health
+is exactly the conjunction of member health.  CI sweeps this with
+several ENGINE_FUZZ_SEED values (see .github/workflows/test.yml).
+"""
+
+import os
+import random
+
+from tpu_k8s_device_plugin.slice import SliceState
+
+SEED = int(os.environ.get("ENGINE_FUZZ_SEED", "0"))
+ROUNDS = int(os.environ.get("SLICE_FUZZ_ROUNDS", "30"))
+_JAX_PORT = 8476
+
+
+def _expected_ranks(specs):
+    """The documented rank function, computed independently of the
+    implementation: coordinate-holders first by coordinate, the rest by
+    hostname."""
+    ordered = sorted(
+        specs.items(),
+        key=lambda kv: (0, kv[1], kv[0]) if kv[1] else (1, (), kv[0]),
+    )
+    return [h for h, _ in ordered]
+
+
+def test_rendezvous_fuzz(tmp_path):
+    rnd = random.Random(SEED)
+    for round_i in range(ROUNDS):
+        n = rnd.randint(2, 6)
+        hosts = [f"host-{i:02d}" for i in range(n)]
+        # a random subset knows its ICI coordinate (tpu-env metadata);
+        # shuffled values so coordinate order != hostname order
+        coord_vals = list(range(n))
+        rnd.shuffle(coord_vals)
+        specs = {
+            h: ((coord_vals[i],) if rnd.random() < 0.7 else ())
+            for i, h in enumerate(hosts)
+        }
+        sessions = {h: f"{h}-s0" for h in hosts}
+        state_path = str(tmp_path / f"round-{round_i}.json")
+        state = SliceState(n, _JAX_PORT, state_path)
+        now = 0.0
+
+        # -- formation phase: random joins/leaves/restarts ------------------
+        ops = 0
+        while state.membership is None:
+            ops += 1
+            assert ops < 2000, "rendezvous failed to converge"
+            # leaves and crashes get rarer as the op budget burns down, so
+            # convergence is guaranteed while early orderings stay chaotic
+            roll = rnd.random() if ops < 500 else 1.0
+            if roll < 0.15:
+                state.leave(rnd.choice(hosts))
+            elif roll < 0.25:
+                # coordinator crash pre-formation: nothing persisted yet,
+                # the fresh incarnation starts from zero members
+                state = SliceState(n, _JAX_PORT, state_path)
+            else:
+                h = rnd.choice(hosts)
+                if rnd.random() < 0.1:  # worker restart: new session
+                    sessions[h] = f"{h}-s{ops}"
+                now += 1.0
+                res = state.join(
+                    h, coords=specs[h], chip_count=8,
+                    session=sessions[h], now=now,
+                )
+                assert res.expected == n
+                assert res.joined <= n
+
+        expected = _expected_ranks(specs)
+        membership = state.membership
+        assert list(membership.hostnames) == expected
+        assert membership.coordinator_address == f"{expected[0]}:{_JAX_PORT}"
+
+        # every member, re-polling in any order, gets its deterministic rank
+        for h in rnd.sample(hosts, n):
+            res = state.join(h, coords=specs[h], chip_count=8,
+                             session=sessions[h], now=now)
+            assert res.formed and res.rank == expected.index(h)
+
+        # a stranger can't slip into a formed slice
+        res = state.join("host-zz", session="zz-s0", now=now)
+        assert res.error and res.membership is membership
+
+        # -- post-formation phase: health + crash recovery ------------------
+        model_unhealthy = set()
+        for _ in range(rnd.randint(10, 40)):
+            now += 1.0
+            roll = rnd.random()
+            if roll < 0.15:
+                # coordinator crash: reload from the state file — same
+                # slice id, same generation, same ranks, health resets to
+                # the optimistic default until members heartbeat again
+                state = SliceState(n, _JAX_PORT, state_path)
+                assert state.membership == membership
+                model_unhealthy.clear()
+            elif roll < 0.25:
+                h = rnd.choice(hosts)
+                state.leave(h)
+                model_unhealthy.add(h)
+            else:
+                h = rnd.choice(hosts)
+                healthy = rnd.random() < 0.7
+                view = state.heartbeat(h, healthy=healthy,
+                                       reason="" if healthy else "fuzzed",
+                                       now=now)
+                model_unhealthy.discard(h)
+                if not healthy:
+                    model_unhealthy.add(h)
+                assert view.membership == membership
+                assert view.unhealthy_hostnames == sorted(model_unhealthy)
+                assert view.slice_healthy == (not model_unhealthy)
+
+        # restarted workers recover their ranks to the very end
+        h = rnd.choice(hosts)
+        res = state.join(h, coords=specs[h], chip_count=8,
+                         session=f"{h}-reborn", now=now)
+        assert res.formed and res.rank == expected.index(h)
+        assert state.membership == membership

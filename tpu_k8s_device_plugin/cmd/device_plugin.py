@@ -10,7 +10,9 @@ from __future__ import annotations
 import argparse
 import functools
 import logging
+import os
 import signal
+import socket
 import sys
 
 from tpu_k8s_device_plugin import __version__
@@ -63,6 +65,29 @@ def build_parser() -> argparse.ArgumentParser:
         help="tpu-metrics-exporter unix socket for granular health",
     )
     p.add_argument(
+        "--slice-rendezvous", "--slice_rendezvous", dest="slice_rendezvous",
+        default=os.environ.get(constants.ENV_SLICE_RENDEZVOUS, ""),
+        metavar="HOST:PORT",
+        help="multi-host slice rendezvous address; every member of the "
+             "slice passes the same value, and the plugin whose hostname "
+             "matches HOST also serves the coordinator.  Empty (the "
+             "default) disables slice coordination entirely — single-host "
+             "behavior is unchanged.  Env override: "
+             f"{constants.ENV_SLICE_RENDEZVOUS}",
+    )
+    p.add_argument(
+        "--slice-workers", "--slice_workers", dest="slice_workers",
+        type=int, metavar="N",
+        default=os.environ.get(constants.ENV_SLICE_WORKERS, "0"),
+        help="hosts in the slice (e.g. 2 for v5e-16); required with "
+             "--slice-rendezvous.  Env override: "
+             f"{constants.ENV_SLICE_WORKERS}",
+    )
+    p.add_argument(
+        "--slice-state-file", default=constants.SLICE_STATE_FILE,
+        help=argparse.SUPPRESS,
+    )
+    p.add_argument(
         "--debug-port", type=int, default=0, metavar="PORT",
         help="serve /healthz, /debug/status, /debug/threads on loopback "
              "at PORT; 0 disables (default)",
@@ -112,6 +137,67 @@ def select_device_impl(args):
     raise SystemExit(f"no usable TPU driver mode found: {last_err}")
 
 
+def _metadata_coords(topo):
+    """This host's ICI coordinate for rendezvous rank sorting, but only
+    when the tpu-env metadata actually stated one — a derived/default
+    worker id must not masquerade as physical wiring."""
+    if topo is None:
+        return ()
+    stated = ("WORKER_ID", constants.ENV_TPU_WORKER_ID, "AGENT_WORKER_NUMBER")
+    if any(k in topo.raw_env for k in stated):
+        return (topo.worker_id,)
+    return ()
+
+
+def setup_slice(args, impl, driver_type):
+    """Wire slice coordination when --slice-rendezvous is set: serve the
+    coordinator if this is the named host, attach a client to the impl,
+    start its background join+heartbeat loop.  Returns
+    (coordinator|None, client|None)."""
+    from tpu_k8s_device_plugin.slice import SliceClient, SliceCoordinator
+
+    address = args.slice_rendezvous
+    host, _, port_s = address.rpartition(":")
+    if not host or not port_s.isdigit():
+        raise SystemExit(
+            f"--slice-rendezvous must be HOST:PORT, got {address!r}"
+        )
+    if args.slice_workers < 2:
+        raise SystemExit(
+            "--slice-workers must be >= 2 with --slice-rendezvous "
+            f"(got {args.slice_workers})"
+        )
+    if driver_type != constants.CONTAINER:
+        raise SystemExit(
+            "slice coordination requires the container driver type "
+            f"(got {driver_type}): passthrough VMs run their own runtime"
+        )
+    hostname = socket.gethostname()
+    coordinator = None
+    # EXACT hostname match only: every member runs identical flags, and
+    # exactly one of them may serve the rendezvous.  A loopback-alias
+    # match would make every host self-elect its own empty coordinator
+    # and the slice would never form.
+    if host == hostname:
+        coordinator = SliceCoordinator(
+            expected_workers=args.slice_workers,
+            bind_address=f"[::]:{port_s}",
+            state_path=args.slice_state_file,
+        ).start()
+        log.info("this host (%s) serves the slice rendezvous", hostname)
+    client = SliceClient(
+        rendezvous_address=address,
+        hostname=hostname,
+        coords=_metadata_coords(impl.topology),
+        chip_count=len(impl.chips),
+        state_path=args.slice_state_file,
+        local_health_fn=impl.local_health,
+    )
+    impl.set_slice_client(client)
+    client.start()
+    return coordinator, client
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     logging.basicConfig(
@@ -129,15 +215,24 @@ def main(argv=None) -> int:
         log.error("invalid pulse %d; must be >= 0", args.pulse)
         return 2
 
+    if args.slice_workers and not args.slice_rendezvous:
+        log.error("--slice-workers without --slice-rendezvous has no effect")
+        return 2
+
     impl, driver_type = select_device_impl(args)
     resources = impl.get_resource_names()
     log.info("driver=%s resources=%s", driver_type,
              [f"{constants.RESOURCE_NAMESPACE}/{r}" for r in resources])
 
+    coordinator = client = None
+    if args.slice_rendezvous:
+        coordinator, client = setup_slice(args, impl, driver_type)
+
     manager = PluginManager(
         impl,
         pulse_seconds=args.pulse,
         kubelet_dir=args.kubelet_dir,
+        slice_client=client,
     )
     debug_server = None
     if args.debug_port:
@@ -151,6 +246,10 @@ def main(argv=None) -> int:
         manager.run(block=True)
     finally:
         manager.stop()
+        if client is not None:
+            client.stop()
+        if coordinator is not None:
+            coordinator.stop()
         if debug_server is not None:
             debug_server.stop()
     return 0

@@ -61,6 +61,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--tpu-env", default=constants.TPU_ENV_FILE, help=argparse.SUPPRESS
     )
+    p.add_argument(
+        "--slice-state-file", default=constants.SLICE_STATE_FILE,
+        help=argparse.SUPPRESS,
+    )
     p.add_argument("--oneshot", action="store_true",
                    help="reconcile once and exit (for jobs/tests)")
     p.add_argument("--version", action="version", version=__version__)
@@ -94,6 +98,7 @@ def main(argv=None) -> int:
             sysfs_root=args.sysfs_root,
             dev_root=args.dev_root,
             tpu_env_path=args.tpu_env,
+            slice_state_path=args.slice_state_file,
         )
         return generate_labels(ctx, enabled)
 

@@ -12,9 +12,11 @@ from __future__ import annotations
 
 import logging
 import re
+import socket
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from tpu_k8s_device_plugin.slice import Membership, load_membership
 from tpu_k8s_device_plugin.tpu import discovery, vfio
 from tpu_k8s_device_plugin.tpu.discovery import TpuDevice
 from tpu_k8s_device_plugin.tpu.topology import IciTopology
@@ -39,6 +41,10 @@ class LabelContext:
     chips: Dict[str, TpuDevice] = field(default_factory=dict)
     topology: Optional[IciTopology] = None
     sysfs_root: str = "/sys"
+    # formed multi-host slice membership, from the crash-safe state file
+    # the plugin's slice client maintains (absent on single-host nodes)
+    slice_membership: Optional[Membership] = None
+    hostname: str = ""
 
     @classmethod
     def collect(
@@ -47,6 +53,7 @@ class LabelContext:
         sysfs_root: str = "/sys",
         dev_root: str = "/dev",
         tpu_env_path: str = constants.TPU_ENV_FILE,
+        slice_state_path: str = constants.SLICE_STATE_FILE,
     ) -> "LabelContext":
         chips, topo = discovery.get_tpu_chips(sysfs_root, dev_root, tpu_env_path)
         return cls(
@@ -54,6 +61,8 @@ class LabelContext:
             chips=chips,
             topology=topo,
             sysfs_root=sysfs_root,
+            slice_membership=load_membership(slice_state_path),
+            hostname=socket.gethostname(),
         )
 
 
@@ -158,6 +167,22 @@ def _core_partition(ctx: LabelContext) -> str:
     return "mixed" if len(modes) > 1 else next(iter(modes))
 
 
+def _slice_id(ctx: LabelContext) -> str:
+    """Rendezvous slice identity — the pod-affinity key that pins a
+    multi-host workload's pods onto hosts of the SAME formed slice
+    (example/multihost/README.md's 'slice-identity labels')."""
+    m = ctx.slice_membership
+    return m.slice_id if m is not None else ""
+
+
+def _slice_rank(ctx: LabelContext) -> str:
+    m = ctx.slice_membership
+    if m is None:
+        return ""
+    rank = m.rank_of(ctx.hostname)
+    return str(rank) if rank is not None else ""
+
+
 # key → generator; keys are the SUPPORTED_LABELS flag names
 # (≈ labelGenerators, main.go:123).
 LABEL_GENERATORS: Dict[str, Callable[[LabelContext], str]] = {
@@ -175,6 +200,8 @@ LABEL_GENERATORS: Dict[str, Callable[[LabelContext], str]] = {
     "hbm": _hbm,
     "partitioning-supported": _partitioning_supported,
     "core-partition": _core_partition,
+    "slice-id": _slice_id,
+    "slice-rank": _slice_rank,
 }
 
 assert set(LABEL_GENERATORS) == set(constants.SUPPORTED_LABELS)

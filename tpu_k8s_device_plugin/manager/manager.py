@@ -103,10 +103,15 @@ class PluginManager:
         kubelet_dir: str = constants.DEVICE_PLUGIN_PATH,
         resource_namespace: str = constants.RESOURCE_NAMESPACE,
         kubelet_watch_interval_s: float = 1.0,
+        slice_client=None,
     ):
         self.impl = device_impl
         self.pulse = pulse_seconds
         self.kubelet_dir = kubelet_dir
+        # optional multi-host slice client: the pulse loop heartbeats it
+        # BEFORE beating the plugins, so each ListAndWatch resend already
+        # reflects this round's local probe and the peers' latest verdict
+        self.slice_client = slice_client
         self.kubelet_socket = os.path.join(kubelet_dir, "kubelet.sock")
         self.namespace = resource_namespace
         self._watch_interval = kubelet_watch_interval_s
@@ -334,6 +339,15 @@ class PluginManager:
         every open ListAndWatch stream."""
         while not self._stop.wait(self.pulse):
             self._maybe_rediscover()
+            if self.slice_client is not None:
+                # heartbeat first: ships the fresh local probe to the
+                # coordinator and pulls the slice verdict this round's
+                # update_health frames will render (one wedged chip
+                # anywhere reaches every member within one pulse+heartbeat)
+                try:
+                    self.slice_client.heartbeat_now()
+                except Exception as e:
+                    log.warning("slice heartbeat failed: %s", e)
             with self._plugins_lock:
                 plugins = list(self._plugins.values())
             for sp in plugins:

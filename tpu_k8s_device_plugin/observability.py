@@ -68,6 +68,20 @@ def manager_status(manager: "PluginManager") -> dict:
             "worker_id": topo.worker_id,
             "num_workers": topo.num_workers,
         }
+    client = getattr(manager, "slice_client", None)
+    if client is not None:
+        m = client.membership
+        overlay = client.health_overlay()
+        status["slice"] = {
+            "formed": m is not None,
+            "slice_id": m.slice_id if m else "",
+            "rank": client.rank,
+            "hostnames": list(m.hostnames) if m else [],
+            "coordinator_address": m.coordinator_address if m else "",
+            # null until the first heartbeat verdict arrives
+            "healthy": None if overlay is None else overlay[0],
+            "unhealthy_hostnames": [] if overlay is None else overlay[1],
+        }
     return status
 
 

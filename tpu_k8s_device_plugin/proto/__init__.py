@@ -7,12 +7,16 @@ grpcio-tools.
 
 from . import deviceplugin_pb2
 from . import deviceplugin_pb2_grpc
+from . import slice_pb2
+from . import slice_pb2_grpc
 from . import tpuhealth_pb2
 from . import tpuhealth_pb2_grpc
 
 __all__ = [
     "deviceplugin_pb2",
     "deviceplugin_pb2_grpc",
+    "slice_pb2",
+    "slice_pb2_grpc",
     "tpuhealth_pb2",
     "tpuhealth_pb2_grpc",
 ]

@@ -1,6 +1,16 @@
 #!/bin/sh
 # Regenerate protobuf message modules.  The *_pb2_grpc.py files are
 # hand-maintained (no grpcio-tools in the build image) — do not overwrite.
+#
+# slice_pb2.py has a no-protoc fallback: tools/gen_slice_pb2.py builds the
+# descriptor with the protobuf python API (byte layout differs from protoc
+# output, wire format does not).  With protoc installed, the protoc output
+# below supersedes it.
 set -e
 cd "$(dirname "$0")"
-protoc --python_out=. deviceplugin.proto tpuhealth.proto
+if command -v protoc >/dev/null 2>&1; then
+    protoc --python_out=. deviceplugin.proto tpuhealth.proto slice.proto
+else
+    echo "protoc not found; regenerating slice_pb2.py via descriptor_pb2" >&2
+    python ../../tools/gen_slice_pb2.py
+fi

@@ -1,0 +1,36 @@
+"""Multi-host slice coordination: rendezvous, rank assignment, health.
+
+The ROCm reference has no analog — a GPU node is self-contained — but a
+TPU slice spans hosts over ICI: every worker must agree on ranks,
+hostnames and a coordinator address before JAX can initialize
+(``slice.proto``), and one wedged chip poisons collectives slice-wide, so
+health must propagate to every member's kubelet, not just the faulty
+host's.
+
+Three layers:
+
+- :mod:`.state` — the pure rendezvous state machine (deterministic ranks,
+  crash-safe membership file), fuzzable without gRPC or a clock;
+- :mod:`.server` — the coordinator, serving ``SliceRendezvous`` for the
+  whole slice from one member;
+- :mod:`.client` — per-host join (retries + exponential backoff),
+  heartbeat, and the env contract Allocate injects into containers.
+"""
+
+from .client import SliceClient
+from .server import SliceCoordinator
+from .state import (
+    Membership,
+    SliceState,
+    load_membership,
+    save_membership,
+)
+
+__all__ = [
+    "Membership",
+    "SliceClient",
+    "SliceCoordinator",
+    "SliceState",
+    "load_membership",
+    "save_membership",
+]

@@ -1,0 +1,298 @@
+"""Per-host slice client: join with backoff, heartbeat, env contract.
+
+Every member of the slice (the coordinator's own host included) runs one
+of these inside its device plugin.  The client owns three things:
+
+- **join**: polls the rendezvous service until the slice forms, with
+  exponential backoff, and persists the learned membership to a local
+  crash-safe state file so a restarted plugin knows its rank immediately
+  (and the node labeller can emit slice labels without talking gRPC);
+- **heartbeat**: reports local chip health each pulse and learns the
+  slice-wide verdict from the response — the channel through which one
+  host's wedged chip flips every member's devices Unhealthy;
+- **env contract**: the consistent ``TPU_WORKER_ID`` /
+  ``TPU_WORKER_HOSTNAMES`` / JAX coordinator triple Allocate injects into
+  every container of the slice, replacing per-host guesses.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+import uuid
+from typing import Callable, Dict, List, Optional, Tuple
+
+import grpc
+
+from tpu_k8s_device_plugin.proto import (
+    slice_pb2 as slicepb,
+    slice_pb2_grpc as slicepb_grpc,
+)
+from tpu_k8s_device_plugin.types import constants
+from .state import Membership, load_membership, save_membership
+
+log = logging.getLogger(__name__)
+
+# (healthy, reason) probe of this host's own chips; injected by the device
+# impl so the client carries fresh local state in every heartbeat.
+LocalHealthFn = Callable[[], Tuple[bool, str]]
+
+_JOIN_BACKOFF_INITIAL_S = 0.5
+_JOIN_BACKOFF_MAX_S = 15.0
+_RPC_TIMEOUT_S = 10.0
+
+
+def _membership_from_msg(m: slicepb.Membership) -> Optional[Membership]:
+    if not m.hostnames:
+        return None
+    return Membership(
+        slice_id=m.slice_id,
+        generation=m.generation,
+        hostnames=tuple(m.hostnames),
+        coordinator_address=m.coordinator_address,
+    )
+
+
+class SliceClient:
+    """One host's view of the slice."""
+
+    def __init__(
+        self,
+        rendezvous_address: str,
+        hostname: Optional[str] = None,
+        coords: Tuple[int, ...] = (),
+        chip_count: int = 0,
+        state_path: Optional[str] = constants.SLICE_STATE_FILE,
+        local_health_fn: Optional[LocalHealthFn] = None,
+    ):
+        self._address = rendezvous_address
+        self.hostname = hostname or socket.gethostname()
+        self._coords = tuple(coords)
+        self._chip_count = chip_count
+        self._state_path = state_path
+        self._local_health_fn = local_health_fn
+        # fresh per process start: lets the coordinator tell a worker
+        # restart apart from a duplicate hostname
+        self._session = uuid.uuid4().hex
+        self._lock = threading.Lock()
+        self._membership: Optional[Membership] = None
+        # None until the first heartbeat answer: "no verdict yet" must not
+        # flip devices Unhealthy while the slice is still forming
+        self._slice_healthy: Optional[bool] = None
+        self._unhealthy_hosts: List[str] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if state_path:
+            prior = load_membership(state_path)
+            if prior is not None and prior.rank_of(self.hostname) is not None:
+                # restarted worker: rank recovered without re-forming
+                self._membership = prior
+                log.info(
+                    "recovered slice %s rank %d from %s",
+                    prior.slice_id, prior.rank_of(self.hostname), state_path,
+                )
+
+    # -- join ---------------------------------------------------------------
+
+    def _channel(self) -> grpc.Channel:
+        return grpc.insecure_channel(self._address)
+
+    def _join_once(self) -> Optional[Membership]:
+        """One Join poll; returns the membership when formed."""
+        with self._channel() as ch:
+            stub = slicepb_grpc.SliceRendezvousStub(ch)
+            resp = stub.Join(
+                slicepb.JoinRequest(
+                    hostname=self.hostname,
+                    coords=list(self._coords),
+                    chip_count=self._chip_count,
+                    session=self._session,
+                ),
+                timeout=_RPC_TIMEOUT_S,
+            )
+        if not resp.formed:
+            log.info(
+                "slice forming: %d/%d workers joined",
+                resp.joined, resp.expected,
+            )
+            return None
+        return _membership_from_msg(resp.membership)
+
+    def join(self, timeout_s: float = 0.0) -> Membership:
+        """Poll Join until the slice forms (exponential backoff, capped).
+        ``timeout_s`` 0 means wait forever; on expiry raises TimeoutError.
+        Safe to call again after a restart: the coordinator hands back the
+        existing rank without re-forming."""
+        deadline = time.monotonic() + timeout_s if timeout_s > 0 else None
+        backoff = _JOIN_BACKOFF_INITIAL_S
+        while not self._stop.is_set():
+            try:
+                membership = self._join_once()
+            except grpc.RpcError as e:
+                code = e.code() if hasattr(e, "code") else None
+                if code == grpc.StatusCode.FAILED_PRECONDITION:
+                    # mis-sized slice or hostname drift: retrying cannot
+                    # fix it, surface the coordinator's explanation
+                    raise RuntimeError(
+                        f"slice join rejected: {e.details()}"
+                    ) from e
+                log.info("rendezvous %s unreachable (%s); retrying in "
+                         "%.1fs", self._address, code, backoff)
+                membership = None
+            if membership is not None:
+                self._adopt(membership)
+                return membership
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"slice did not form within {timeout_s:.0f}s "
+                    f"(rendezvous {self._address})"
+                )
+            if self._stop.wait(backoff):
+                break
+            backoff = min(backoff * 2, _JOIN_BACKOFF_MAX_S)
+        raise RuntimeError("slice client stopped before the slice formed")
+
+    def _adopt(self, membership: Membership) -> None:
+        with self._lock:
+            prior = self._membership
+            self._membership = membership
+        if prior is None or prior.generation != membership.generation:
+            rank = membership.rank_of(self.hostname)
+            log.info(
+                "slice %s gen %d: rank %s of %d, coordinator %s",
+                membership.slice_id, membership.generation, rank,
+                membership.num_workers, membership.coordinator_address,
+            )
+            if self._state_path:
+                try:
+                    save_membership(self._state_path, membership)
+                except OSError as e:
+                    log.error("cannot persist slice membership to %s: %s",
+                              self._state_path, e)
+
+    # -- heartbeat ----------------------------------------------------------
+
+    def heartbeat_now(self) -> None:
+        """One synchronous heartbeat: probe local health, report it, learn
+        the slice verdict.  Joins first if the slice hasn't formed yet (a
+        single non-blocking attempt).  Called from the manager's pulse
+        loop and from the background thread; errors degrade to 'no
+        verdict change', never raise."""
+        try:
+            if self.membership is None:
+                membership = self._join_once()
+                if membership is None:
+                    return
+                self._adopt(membership)
+            healthy, reason = True, ""
+            if self._local_health_fn is not None:
+                try:
+                    healthy, reason = self._local_health_fn()
+                except Exception as e:
+                    # a broken probe is a fault report, not a crash: the
+                    # peers must still learn this host can't vouch for
+                    # its chips
+                    log.warning("local health probe failed: %s", e)
+                    healthy, reason = False, f"local probe error: {e}"
+            with self._channel() as ch:
+                stub = slicepb_grpc.SliceRendezvousStub(ch)
+                resp = stub.Heartbeat(
+                    slicepb.HeartbeatRequest(
+                        hostname=self.hostname,
+                        healthy=healthy,
+                        reason=reason,
+                        generation=self.membership.generation,
+                    ),
+                    timeout=_RPC_TIMEOUT_S,
+                )
+        except grpc.RpcError as e:
+            # An unreachable coordinator is NOT a slice-wide Unhealthy
+            # verdict by itself (that would let one crashed pod demote
+            # every node's devices); keep the last verdict and let the
+            # coordinator's own staleness tracking judge us.
+            log.warning("slice heartbeat to %s failed: %s",
+                        self._address,
+                        e.code() if hasattr(e, "code") else e)
+            return
+        fresh = _membership_from_msg(resp.membership)
+        if fresh is not None:
+            self._adopt(fresh)
+        with self._lock:
+            prior = self._slice_healthy
+            self._slice_healthy = resp.slice_healthy
+            self._unhealthy_hosts = list(resp.unhealthy_hostnames)
+        if prior is not None and prior != resp.slice_healthy:
+            log.warning(
+                "slice %s -> %s%s",
+                self.membership.slice_id if self.membership else "?",
+                "healthy" if resp.slice_healthy else "UNHEALTHY",
+                f" (members: {list(resp.unhealthy_hostnames)})"
+                if not resp.slice_healthy else "",
+            )
+
+    def start(
+        self, period_s: float = constants.SLICE_HEARTBEAT_PERIOD_S
+    ) -> "SliceClient":
+        """Background join-then-heartbeat loop.  The manager's pulse also
+        calls heartbeat_now() directly; both paths are lock-safe."""
+        if self._thread is not None:
+            return self
+
+        def loop():
+            while not self._stop.is_set():
+                self.heartbeat_now()
+                if self._stop.wait(period_s):
+                    return
+
+        self._thread = threading.Thread(
+            target=loop, name="slice-heartbeat", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # -- the contract consumed by Allocate / update_health ------------------
+
+    @property
+    def membership(self) -> Optional[Membership]:
+        with self._lock:
+            return self._membership
+
+    @property
+    def rank(self) -> Optional[int]:
+        m = self.membership
+        return m.rank_of(self.hostname) if m is not None else None
+
+    def slice_env(self) -> Dict[str, str]:
+        """Env every container of a full-host grant receives — identical
+        on all members modulo TPU_WORKER_ID.  Empty before formation (the
+        impl then falls back to the per-host metadata view)."""
+        m = self.membership
+        if m is None:
+            return {}
+        rank = m.rank_of(self.hostname)
+        if rank is None:
+            return {}
+        return {
+            constants.ENV_TPU_WORKER_ID: str(rank),
+            constants.ENV_TPU_WORKER_HOSTNAMES: ",".join(m.hostnames),
+            constants.ENV_JAX_COORDINATOR_ADDRESS: m.coordinator_address,
+            constants.ENV_JAX_NUM_PROCESSES: str(m.num_workers),
+            constants.ENV_JAX_PROCESS_ID: str(rank),
+        }
+
+    def health_overlay(self) -> Optional[Tuple[bool, List[str]]]:
+        """(slice_healthy, unhealthy hostnames), or None while no verdict
+        has arrived yet — ListAndWatch must not flap devices Unhealthy
+        just because the slice is still forming."""
+        with self._lock:
+            if self._slice_healthy is None:
+                return None
+            return self._slice_healthy, list(self._unhealthy_hosts)

@@ -1,0 +1,136 @@
+"""Slice coordinator: serves SliceRendezvous for every member of one slice.
+
+Runs inside the device plugin of the host named by ``--slice-rendezvous``
+(the plugin compares that hostname against its own and serves only when
+they match — every member runs identical flags, one of them self-elects).
+The state machine itself lives in :mod:`.state`; this layer adds the gRPC
+surface, locking, and the wall clock.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import threading
+import time
+from typing import Optional
+
+import grpc
+
+from tpu_k8s_device_plugin.proto import (
+    slice_pb2 as slicepb,
+    slice_pb2_grpc as slicepb_grpc,
+)
+from tpu_k8s_device_plugin.types import constants
+from .state import Membership, SliceState
+
+log = logging.getLogger(__name__)
+
+
+def _membership_msg(m: Optional[Membership]) -> slicepb.Membership:
+    if m is None:
+        return slicepb.Membership()
+    return slicepb.Membership(
+        slice_id=m.slice_id,
+        generation=m.generation,
+        num_workers=m.num_workers,
+        hostnames=list(m.hostnames),
+        coordinator_address=m.coordinator_address,
+    )
+
+
+class _Servicer(slicepb_grpc.SliceRendezvousServicer):
+    def __init__(self, state: SliceState, lock: threading.Lock):
+        self._state = state
+        self._lock = lock
+
+    def Join(self, request, context):
+        with self._lock:
+            res = self._state.join(
+                hostname=request.hostname,
+                coords=tuple(request.coords),
+                chip_count=request.chip_count,
+                session=request.session,
+                now=time.monotonic(),
+            )
+        if res.error and res.membership is None:
+            # a non-member knocking on a full-but-unformed slice, or a
+            # malformed request: refuse loudly so the operator sees a
+            # mis-sized --slice-workers instead of a hung formation
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, res.error)
+        if res.error:
+            # formed slice, unknown host: same refusal, but the membership
+            # in the details log helps diagnose a hostname drift
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"{res.error} (members: {list(res.membership.hostnames)})",
+            )
+        return slicepb.JoinResponse(
+            formed=res.formed,
+            rank=res.rank,
+            joined=res.joined,
+            expected=res.expected,
+            membership=_membership_msg(res.membership),
+        )
+
+    def Heartbeat(self, request, context):
+        with self._lock:
+            view = self._state.heartbeat(
+                hostname=request.hostname,
+                healthy=request.healthy,
+                reason=request.reason,
+                now=time.monotonic(),
+            )
+        return slicepb.HeartbeatResponse(
+            slice_healthy=view.slice_healthy,
+            unhealthy_hostnames=view.unhealthy_hostnames,
+            membership=_membership_msg(view.membership),
+        )
+
+
+class SliceCoordinator:
+    """Owns the rendezvous gRPC server + the slice state machine."""
+
+    def __init__(
+        self,
+        expected_workers: int,
+        bind_address: str = f"[::]:{constants.SLICE_RENDEZVOUS_PORT}",
+        jax_port: int = constants.SLICE_JAX_COORDINATOR_PORT,
+        state_path: Optional[str] = constants.SLICE_STATE_FILE,
+        heartbeat_timeout_s: float = constants.SLICE_HEARTBEAT_TIMEOUT_S,
+    ):
+        self._lock = threading.Lock()
+        self.state = SliceState(
+            expected_workers=expected_workers,
+            jax_port=jax_port,
+            state_path=state_path,
+            heartbeat_timeout_s=heartbeat_timeout_s,
+            epoch=time.monotonic(),
+        )
+        self._bind_address = bind_address
+        self._server: Optional[grpc.Server] = None
+        self.port: int = 0
+
+    def start(self) -> "SliceCoordinator":
+        self._server = grpc.server(
+            concurrent.futures.ThreadPoolExecutor(max_workers=8)
+        )
+        slicepb_grpc.add_SliceRendezvousServicer_to_server(
+            _Servicer(self.state, self._lock), self._server
+        )
+        self.port = self._server.add_insecure_port(self._bind_address)
+        if self.port == 0:
+            raise RuntimeError(
+                f"cannot bind slice rendezvous on {self._bind_address}"
+            )
+        self._server.start()
+        log.info(
+            "slice rendezvous serving on %s (expecting %d workers)",
+            self._bind_address, self.state.expected,
+        )
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=1.0).wait()
+            self._server = None

@@ -1,0 +1,346 @@
+"""Rendezvous state machine + crash-safe membership persistence.
+
+Pure logic layer: no gRPC, no wall clock (callers inject ``now``), so the
+CI fuzz sweep can drive random join/leave/restart orderings directly and
+assert the invariants that matter:
+
+- ranks are a pure function of the member set (sorted by ICI coordinates,
+  then hostname), never of join order;
+- a restarted coordinator or worker recovers the formed membership from
+  the state file without re-forming the slice (same ranks, same
+  generation);
+- slice health is the conjunction of every member's reported health and
+  heartbeat freshness.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import tempfile
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+_STATE_VERSION = 1
+
+
+@dataclass(frozen=True)
+class Membership:
+    """The agreed slice: hostnames indexed by rank + coordinator address."""
+
+    slice_id: str
+    generation: int
+    hostnames: Tuple[str, ...]
+    coordinator_address: str
+
+    @property
+    def num_workers(self) -> int:
+        return len(self.hostnames)
+
+    def rank_of(self, hostname: str) -> Optional[int]:
+        try:
+            return self.hostnames.index(hostname)
+        except ValueError:
+            return None
+
+    def to_dict(self) -> dict:
+        return {
+            "version": _STATE_VERSION,
+            "slice_id": self.slice_id,
+            "generation": self.generation,
+            "hostnames": list(self.hostnames),
+            "coordinator_address": self.coordinator_address,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Membership":
+        return cls(
+            slice_id=str(d["slice_id"]),
+            generation=int(d["generation"]),
+            hostnames=tuple(str(h) for h in d["hostnames"]),
+            coordinator_address=str(d.get("coordinator_address", "")),
+        )
+
+
+def save_membership(path: str, membership: Membership) -> None:
+    """Atomic write (tmp + rename in the target dir): a crash mid-write
+    must leave either the old file or the new one, never a torn JSON —
+    the whole point of the state file is surviving exactly such crashes."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".membership-")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(membership.to_dict(), f, indent=1)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def load_membership(path: str) -> Optional[Membership]:
+    """Load a persisted membership; None when absent or unreadable (a
+    corrupt file means re-forming, not crashing the plugin)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            d = json.load(f)
+    except OSError:
+        return None
+    except ValueError as e:
+        log.warning("corrupt slice state file %s (%s); ignoring", path, e)
+        return None
+    try:
+        if int(d.get("version", 0)) != _STATE_VERSION:
+            log.warning("slice state file %s has unknown version %r",
+                        path, d.get("version"))
+            return None
+        return Membership.from_dict(d)
+    except (KeyError, TypeError, ValueError) as e:
+        log.warning("malformed slice state file %s (%s); ignoring", path, e)
+        return None
+
+
+@dataclass
+class _Member:
+    hostname: str
+    coords: Tuple[int, ...] = ()
+    chip_count: int = 0
+    session: str = ""
+    healthy: bool = True
+    reason: str = ""
+    # None = not heard from since this coordinator incarnation started;
+    # freshness is then measured from the incarnation epoch, so a restart
+    # doesn't instantly declare every member stale.
+    last_seen: Optional[float] = None
+    departed: bool = False
+
+
+def _slice_id(hostnames: List[str]) -> str:
+    h = hashlib.sha256("\n".join(hostnames).encode("utf-8"))
+    return h.hexdigest()[:12]
+
+
+@dataclass
+class JoinResult:
+    formed: bool
+    rank: int = -1
+    joined: int = 0
+    expected: int = 0
+    membership: Optional[Membership] = None
+    error: str = ""
+
+
+@dataclass
+class HealthView:
+    slice_healthy: bool = True
+    unhealthy_hostnames: List[str] = field(default_factory=list)
+    membership: Optional[Membership] = None
+
+
+class SliceState:
+    """Rendezvous + health bookkeeping for one slice.
+
+    Not thread-safe by itself — the gRPC servicer wraps calls in a lock;
+    the fuzz harness drives it single-threaded.
+    """
+
+    def __init__(
+        self,
+        expected_workers: int,
+        jax_port: int,
+        state_path: Optional[str] = None,
+        heartbeat_timeout_s: float = 0.0,
+        epoch: float = 0.0,
+    ):
+        if expected_workers < 1:
+            raise ValueError(f"expected_workers must be >= 1, got "
+                             f"{expected_workers}")
+        self.expected = expected_workers
+        self.jax_port = jax_port
+        self.state_path = state_path
+        # 0 disables staleness demotion (tests drive heartbeats manually)
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self._epoch = epoch
+        self._members: Dict[str, _Member] = {}
+        self._membership: Optional[Membership] = None
+        self._generation = 0
+        if state_path:
+            prior = load_membership(state_path)
+            if prior is not None:
+                # Crash recovery: adopt the persisted slice as-is.  Members
+                # exist from the start (ranks already assigned); they
+                # refresh their sessions as they heartbeat/rejoin.
+                self._membership = prior
+                self._generation = prior.generation
+                for hostname in prior.hostnames:
+                    self._members[hostname] = _Member(hostname=hostname)
+                log.info(
+                    "recovered slice %s gen %d (%d workers) from %s",
+                    prior.slice_id, prior.generation,
+                    prior.num_workers, state_path,
+                )
+
+    # -- rendezvous ---------------------------------------------------------
+
+    def join(
+        self,
+        hostname: str,
+        coords: Tuple[int, ...] = (),
+        chip_count: int = 0,
+        session: str = "",
+        now: float = 0.0,
+    ) -> JoinResult:
+        """Idempotent join/poll.  Workers call this until ``formed``."""
+        if not hostname:
+            return JoinResult(formed=False, error="empty hostname")
+        member = self._members.get(hostname)
+        if member is None:
+            if self._membership is not None:
+                # Formed slice, unknown host: ranks are already handed to
+                # running containers — admitting a stranger would silently
+                # change the contract under them.
+                return JoinResult(
+                    formed=True,
+                    membership=self._membership,
+                    joined=len(self._members),
+                    expected=self.expected,
+                    error=(
+                        f"slice {self._membership.slice_id} is formed and "
+                        f"{hostname!r} is not a member"
+                    ),
+                )
+            if len(self._members) >= self.expected:
+                return JoinResult(
+                    formed=False,
+                    joined=len(self._members),
+                    expected=self.expected,
+                    error=f"slice already has {self.expected} joiners",
+                )
+            member = _Member(hostname=hostname)
+            self._members[hostname] = member
+        elif member.session and session and member.session != session:
+            log.info("worker %s restarted (session %s -> %s)",
+                     hostname, member.session[:8], session[:8])
+        member.coords = tuple(coords)
+        member.chip_count = chip_count
+        member.session = session
+        member.departed = False
+        member.last_seen = now
+        if self._membership is None and len(self._members) == self.expected:
+            self._form()
+        m = self._membership
+        rank = m.rank_of(hostname) if m is not None else -1
+        return JoinResult(
+            formed=m is not None,
+            rank=rank if rank is not None else -1,
+            joined=len(self._members),
+            expected=self.expected,
+            membership=m,
+        )
+
+    def _form(self) -> None:
+        """Assign deterministic ranks: members WITH ICI coordinates sort
+        first by coordinate (rank order then matches the physical mesh,
+        which is what TPU_WORKER_ID means to libtpu), coordinate-less
+        members after them by hostname.  Join order never matters."""
+        ordered = sorted(
+            self._members.values(),
+            key=lambda mb: (0, mb.coords, mb.hostname) if mb.coords
+            else (1, (), mb.hostname),
+        )
+        counts = {mb.chip_count for mb in ordered if mb.chip_count}
+        if len(counts) > 1:
+            log.warning(
+                "heterogeneous chip counts across slice members: %s",
+                {mb.hostname: mb.chip_count for mb in ordered},
+            )
+        hostnames = [mb.hostname for mb in ordered]
+        self._generation += 1
+        self._membership = Membership(
+            slice_id=_slice_id(hostnames),
+            generation=self._generation,
+            hostnames=tuple(hostnames),
+            coordinator_address=f"{hostnames[0]}:{self.jax_port}",
+        )
+        log.info("slice %s formed: ranks %s, coordinator %s",
+                 self._membership.slice_id, hostnames,
+                 self._membership.coordinator_address)
+        if self.state_path:
+            try:
+                save_membership(self.state_path, self._membership)
+            except OSError as e:
+                # Keep serving: persistence failing degrades crash
+                # recovery, not the live slice.
+                log.error("cannot persist slice state to %s: %s",
+                          self.state_path, e)
+
+    def leave(self, hostname: str) -> None:
+        """Explicit departure.  Before formation the seat frees up; after,
+        the member set (and every rank) is immutable — the host is marked
+        departed, which drags slice health down until it rejoins."""
+        member = self._members.get(hostname)
+        if member is None:
+            return
+        if self._membership is None:
+            del self._members[hostname]
+        else:
+            member.departed = True
+            member.session = ""
+
+    # -- health -------------------------------------------------------------
+
+    def heartbeat(
+        self,
+        hostname: str,
+        healthy: bool,
+        reason: str = "",
+        now: float = 0.0,
+    ) -> HealthView:
+        member = self._members.get(hostname)
+        if member is not None:
+            was = (member.healthy, member.departed)
+            member.healthy = healthy
+            member.reason = reason
+            member.last_seen = now
+            member.departed = False
+            if (healthy, False) != was:
+                log.info("slice member %s -> %s%s", hostname,
+                         "healthy" if healthy else "UNHEALTHY",
+                         f" ({reason})" if reason else "")
+        return self.health(now)
+
+    def health(self, now: float = 0.0) -> HealthView:
+        """Slice-wide verdict: every member healthy, present, and (when a
+        timeout is configured) recently heard from."""
+        unhealthy: List[str] = []
+        for mb in self._members.values():
+            if not mb.healthy or mb.departed:
+                unhealthy.append(mb.hostname)
+                continue
+            if self.heartbeat_timeout_s > 0:
+                seen = mb.last_seen if mb.last_seen is not None else self._epoch
+                if now - seen > self.heartbeat_timeout_s:
+                    unhealthy.append(mb.hostname)
+        formed = self._membership is not None
+        return HealthView(
+            slice_healthy=formed and not unhealthy,
+            unhealthy_hostnames=sorted(unhealthy),
+            membership=self._membership,
+        )
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def membership(self) -> Optional[Membership]:
+        return self._membership
+
+    @property
+    def joined(self) -> int:
+        return len(self._members)

@@ -13,7 +13,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, TYPE_CHECKING
 
 from tpu_k8s_device_plugin.allocator import (
     AllocationError,
@@ -24,7 +24,10 @@ from tpu_k8s_device_plugin.proto import deviceplugin_pb2 as pluginapi
 from tpu_k8s_device_plugin.types import DeviceImpl, DevicePluginContext, constants
 from . import discovery
 from .discovery import TpuDevice
-from .topology import IciTopology
+from .topology import IciTopology, derive_worker_identity
+
+if TYPE_CHECKING:  # hints only; slice stays an optional runtime wiring
+    from tpu_k8s_device_plugin.slice import SliceClient
 
 log = logging.getLogger(__name__)
 
@@ -43,12 +46,14 @@ class TpuContainerImpl(DeviceImpl):
         dev_root: str = "/dev",
         tpu_env_path: str = constants.TPU_ENV_FILE,
         health_fn: Optional[HealthFn] = None,
+        slice_client: Optional["SliceClient"] = None,
     ):
         self._strategy = resource_naming_strategy
         self._sysfs_root = sysfs_root
         self._dev_root = dev_root
         self._tpu_env_path = tpu_env_path
         self._health_fn = health_fn
+        self._slice = slice_client
 
         self.chips: Dict[str, TpuDevice] = {}
         self.topology: Optional[IciTopology] = None
@@ -264,8 +269,22 @@ class TpuContainerImpl(DeviceImpl):
             car.envs[constants.ENV_TPU_PROCESS_BOUNDS] = ",".join(
                 str(b) for b in topo.host_bounds
             )
-            car.envs[constants.ENV_TPU_WORKER_ID] = str(topo.worker_id)
+            slice_env = self._slice.slice_env() if self._slice else {}
+            membership = self._slice.membership if self._slice else None
+            wid, _ = derive_worker_identity(
+                topo,
+                full_host=True,
+                slice_rank=self._slice.rank if self._slice else None,
+                slice_workers=membership.num_workers if membership else 0,
+            )
+            car.envs[constants.ENV_TPU_WORKER_ID] = str(wid)
             car.envs[constants.ENV_TPU_TOPOLOGY] = topo.topology_str
+            # Rendezvous-agreed contract: identical on every member of the
+            # slice (modulo rank), so coordinated containers never depend
+            # on per-host metadata guesses.  Includes TPU_WORKER_ID=rank,
+            # consistent with the derivation above.
+            for key, val in slice_env.items():
+                car.envs[key] = val
         else:
             # Sub-host allocation: a standalone single-process slice.  The
             # slice-wide accelerator type would mislead libtpu (it implies a
@@ -284,7 +303,10 @@ class TpuContainerImpl(DeviceImpl):
                 )
             car.envs[constants.ENV_TPU_CHIPS_PER_HOST_BOUNDS] = bounds
             car.envs[constants.ENV_TPU_PROCESS_BOUNDS] = "1,1,1"
-            car.envs[constants.ENV_TPU_WORKER_ID] = "0"
+            # standalone single-process view: worker 0 of 1 by derivation,
+            # not by hardcoded string — same helper as the full-host path
+            wid, _ = derive_worker_identity(topo, full_host=False)
+            car.envs[constants.ENV_TPU_WORKER_ID] = str(wid)
         if core_ids:
             # per-core partitions: tell the runtime which TensorCores of the
             # visible chips belong to this container
@@ -323,6 +345,39 @@ class TpuContainerImpl(DeviceImpl):
     # -- health (≈ UpdateHealth + simpleHealthCheck, amdgpu.go:322-345,
     #    865-910, exporter overlay :954-974) --------------------------------
 
+    def set_slice_client(self, client: Optional["SliceClient"]) -> None:
+        """Late wiring: the client needs this impl's chip inventory and
+        local_health before it can be built, so cmd attaches it after
+        construction."""
+        self._slice = client
+
+    def _granular_health(self) -> Dict[str, str]:
+        """Per-chip health overlay (exporter-fed sysfs chip_state watch);
+        {} when the probe is unwired or failing."""
+        if self._health_fn is None:
+            return {}
+        try:
+            return self._health_fn()
+        except Exception as e:
+            log.warning("granular health probe failed: %s", e)
+            return {}
+
+    def local_health(self) -> "tuple[bool, str]":
+        """This host's contribution to slice-wide health — what the slice
+        client reports in every heartbeat.  A single wedged chip makes the
+        whole HOST unhealthy here, and the coordinator fans that out to
+        the whole SLICE."""
+        if not self.simple_health_check():
+            return False, "node health probe failed"
+        per_chip = self._granular_health()
+        bad = sorted(
+            cid for cid in self.chips
+            if per_chip.get(cid, constants.HEALTHY) != constants.HEALTHY
+        )
+        if bad:
+            return False, "unhealthy chips: " + ",".join(bad)
+        return True, ""
+
     def simple_health_check(self) -> bool:
         """Cheap whole-node probe: the accel class still enumerates every
         chip we advertised and the device nodes exist."""
@@ -338,12 +393,20 @@ class TpuContainerImpl(DeviceImpl):
         node_health = (
             constants.HEALTHY if self.simple_health_check() else constants.UNHEALTHY
         )
-        per_chip: Dict[str, str] = {}
-        if self._health_fn is not None:
-            try:
-                per_chip = self._health_fn()
-            except Exception as e:
-                log.warning("granular health probe failed: %s", e)
+        per_chip: Dict[str, str] = self._granular_health()
+        # Slice-wide verdict: ANY member's wedged chip (or a silent member)
+        # poisons the ICI collectives of every host, so a slice-Unhealthy
+        # verdict demotes every local device — the kubelet then stops
+        # scheduling onto any member until the slice recovers.  The same
+        # channel propagates recovery.
+        slice_down = False
+        overlay = self._slice.health_overlay() if self._slice else None
+        if overlay is not None:
+            slice_ok, bad_hosts = overlay
+            if not slice_ok:
+                slice_down = True
+                log.debug("slice unhealthy (members: %s); demoting all "
+                          "local devices", bad_hosts)
         # fresh messages, not in-place mutation: the cached _dev_list entries
         # are shared with every open ListAndWatch stream, and concurrent
         # health writes would race with their serialization
@@ -356,9 +419,12 @@ class TpuContainerImpl(DeviceImpl):
             chip = self._chips_by_dev_id.get(dev.ID)
             fresh = pluginapi.Device()
             fresh.CopyFrom(dev)
-            fresh.health = (
-                per_chip.get(chip.id, node_health) if chip else node_health
-            )
+            if slice_down:
+                fresh.health = constants.UNHEALTHY
+            else:
+                fresh.health = (
+                    per_chip.get(chip.id, node_health) if chip else node_health
+                )
             out.append(fresh)
         return out
 

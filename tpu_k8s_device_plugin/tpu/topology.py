@@ -295,6 +295,28 @@ def topology_from_env(
     return topo
 
 
+def derive_worker_identity(
+    topo: Optional[IciTopology],
+    full_host: bool,
+    slice_rank: Optional[int] = None,
+    slice_workers: int = 0,
+) -> Tuple[int, int]:
+    """Single source of the (worker_id, num_workers) pair Allocate injects.
+
+    Both Allocate paths route through here instead of hardcoding worker
+    "0" inline: a sub-host grant is a standalone single-process slice
+    (0 of 1) whatever the host metadata says; a full-host grant prefers
+    the rendezvous-assigned rank when slice coordination agreed on one
+    (the per-host tpu-env WORKER_ID is a static guess that desyncs the
+    moment pods reschedule), falling back to the metadata view.
+    """
+    if not full_host or topo is None:
+        return 0, 1
+    if slice_rank is not None and slice_workers > 0:
+        return slice_rank, slice_workers
+    return topo.worker_id, topo.num_workers
+
+
 def _volume(b: Tuple[int, int, int]) -> int:
     return b[0] * b[1] * b[2]
 

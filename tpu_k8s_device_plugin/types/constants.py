@@ -25,6 +25,8 @@ SUPPORTED_LABELS = [
     "hbm",                           # HBM bytes per chip
     "partitioning-supported",        # whether per-core partitioning is available
     "core-partition",                # current partition granularity (chip / core)
+    "slice-id",                      # formed-slice identity hash (pod affinity key)
+    "slice-rank",                    # this host's rendezvous-assigned rank
 ]
 
 # Label prefixes.  The reference emits both amd.com/gpu.* and a legacy
@@ -39,6 +41,8 @@ LABEL_PREFIX_BETA = "beta.google.com/tpu"
 CMDLINE_PULSE = "pulse"
 CMDLINE_DRIVER_TYPE = "driver_type"
 CMDLINE_RES_NAMING_STRATEGY = "resource_naming_strategy"
+CMDLINE_SLICE_RENDEZVOUS = "slice_rendezvous"
+CMDLINE_SLICE_WORKERS = "slice_workers"
 
 # Resource naming strategies (constants.go:36-42).
 RESOURCE_NAMING_STRATEGY_SINGLE = "single"
@@ -146,7 +150,43 @@ ENV_TPU_WORKER_ID = "TPU_WORKER_ID"
 ENV_TPU_SKIP_MDS_QUERY = "TPU_SKIP_MDS_QUERY"
 ENV_TPU_ACCELERATOR_TYPE = "TPU_ACCELERATOR_TYPE"
 ENV_TPU_TOPOLOGY = "TPU_TOPOLOGY"
+# Slice membership env (set on full-host grants when slice coordination is
+# on; the hostnames/worker-id pair mirrors what the Cloud TPU VM runtime
+# publishes, the JAX triple feeds jax.distributed.initialize directly —
+# see workloads/bench_main._maybe_init_distributed).
+ENV_TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+ENV_JAX_COORDINATOR_ADDRESS = "JAX_COORDINATOR_ADDRESS"
+ENV_JAX_NUM_PROCESSES = "JAX_NUM_PROCESSES"
+ENV_JAX_PROCESS_ID = "JAX_PROCESS_ID"
 
 # Host-local metadata file written by the TPU VM runtime / GKE (fixture-able
 # stand-in for the GCE metadata server's tpu-env attribute).
 TPU_ENV_FILE = "/run/tpu/tpu-env"
+
+# ---------------------------------------------------------------------------
+# Multi-host slice coordination (slice/: rendezvous, ranks, slice health).
+# ---------------------------------------------------------------------------
+
+# Rendezvous gRPC port (the coordinator member's device plugin serves it);
+# distinct from the JAX coordination port handed to workloads.
+SLICE_RENDEZVOUS_PORT = 8475
+
+# Port baked into the emitted JAX_COORDINATOR_ADDRESS (rank-0 host); same
+# port example/multihost/jobset.yaml exposes on its headless Service.
+SLICE_JAX_COORDINATOR_PORT = 8476
+
+# Crash-safe membership file: the coordinator persists the formed slice
+# here, every client mirrors what it learned, and the node labeller reads
+# it for the slice-id/slice-rank labels.  Survives plugin restarts on the
+# host path mount.
+SLICE_STATE_FILE = "/var/lib/tpu-slice/membership.json"
+
+# Heartbeat cadence (client) and staleness cutoff (coordinator): a member
+# silent past the timeout drags the whole slice Unhealthy.
+SLICE_HEARTBEAT_PERIOD_S = 5.0
+SLICE_HEARTBEAT_TIMEOUT_S = 30.0
+
+# Env overrides for the --slice-* flags (DaemonSets set env more easily
+# than per-node args).
+ENV_SLICE_RENDEZVOUS = "TPU_DP_SLICE_RENDEZVOUS"
+ENV_SLICE_WORKERS = "TPU_DP_SLICE_WORKERS"
