@@ -86,6 +86,26 @@ def test_http_load_path_runs():
         assert stats[k] == stats[k] and stats[k] >= 0  # not NaN
 
 
+def test_http_burst_phase_reports_shed_mix():
+    """The backpressure phase: a post-load burst against the bench's
+    deliberately small pool must come back fully accounted — every
+    request a 200 or a shed 429, with the server-side counters
+    agreeing that shedding (not thread growth) absorbed the spike."""
+    stats = run("tiny", quantized=False, batch=2, steps=4,
+                prompt_len=8, max_len=64, http_clients=2,
+                http_requests=4, burst=16)
+    assert stats["burst_requests"] == 16.0
+    assert (stats["burst_ok"] + stats["burst_429"]
+            + stats["burst_errors"]) == 16.0
+    assert stats["burst_ok"] >= 1.0    # engine kept serving admits
+    assert stats["burst_errors"] == 0.0
+    if stats["burst_429"]:
+        # shed responses are accounted server-side too
+        assert (stats["connections_rejected"]
+                + stats["requests_throttled"]) >= stats["burst_429"]
+    assert stats["http_workers"] == 4.0  # clients + 2, fixed
+
+
 def test_load_checkpoint_params_serves_real_weights(tmp_path):
     """The serving CLI's --checkpoint path: restore a train-layout
     orbax checkpoint, (optionally) quantize on load, and decode — the

@@ -798,3 +798,114 @@ def test_response_format_openai(setup):
         assert status == 400
     finally:
         srv.stop()
+
+
+def test_schema_empty_object_additional_properties_false():
+    """ADVICE r5: {"type": "object", "additionalProperties": false}
+    with no (or empty) properties admits ONLY the empty object — the
+    old lowering fell through to json_object_regex, which permits
+    arbitrary members the schema forbids."""
+    from tpu_k8s_device_plugin.workloads.grammar import (
+        json_object_regex,
+        schema_to_regex,
+    )
+
+    pat = schema_to_regex({"type": "object",
+                           "additionalProperties": False})
+    assert pat == r"\{\}"
+    assert schema_to_regex({"type": "object", "properties": {},
+                            "additionalProperties": False}) == r"\{\}"
+    # the lenient-whitespace variant keeps its separator fragment
+    assert schema_to_regex(
+        {"type": "object", "additionalProperties": False},
+        ws=r"\s*") == r"\{\s*\}"
+    # without the additionalProperties:false marker a schemaless
+    # object still lowers to the general (members-allowed) form
+    assert schema_to_regex({"type": "object"}) == json_object_regex(3)
+    d = regex_to_dfa(pat)
+
+    def m(s):
+        cur = 0
+        for b in s.encode():
+            cur = int(d.table[cur, b])
+            if cur < 0:
+                return False
+        return bool(d.accepting[cur])
+
+    assert m("{}")
+    assert not m('{"a":1}') and not m("{ }")
+
+
+def test_grammar_cost_caps_reject_before_table(setup):
+    """ADVICE r5: client-supplied guided_regex cost is bounded — a
+    pattern compiling past --max-grammar-states (or past the pattern
+    length bound) answers 400 BEFORE the [N, V] token table build."""
+    from tpu_k8s_device_plugin.workloads.server import EngineServer
+
+    model, params, _ = setup
+    eng = ServingEngine(model, params, n_slots=1, eos_id=EOS)
+    tb = [bytes([i]) if i else b"" for i in range(CFG["vocab"])]
+    srv = EngineServer(eng, max_new_tokens=8, window=2,
+                       token_bytes=tb, max_grammar_states=8)
+    srv.start(host="127.0.0.1", port=0)
+    try:
+        # 12 literal chars -> 13 char-DFA states > the bound of 8
+        status, events = _post(srv.port, {
+            "tokens": [70], "guided_regex": "aaaaaaaaaaaa",
+            "stream": False})
+        assert status == 400
+        assert "states" in events[0]["error"]
+        # within the bound still serves
+        status, _ = _post(srv.port, {
+            "tokens": [70], "guided_regex": "ab", "stream": False})
+        assert status == 200
+        # the raw pattern-length bound rejects before compilation
+        status, events = _post(srv.port, {
+            "tokens": [70], "guided_regex": "a" * 5000,
+            "stream": False})
+        assert status == 400
+        assert "chars" in events[0]["error"]
+        assert srv.stats()["grammar_patterns"] == 1  # only "ab" got in
+    finally:
+        srv.stop()
+
+
+def test_concurrent_distinct_patterns_respect_max_grammars(setup):
+    """ADVICE r5 (_glock): concurrent first requests with DISTINCT
+    patterns race the compiled->registered handoff; the distinct
+    pattern count must never overshoot max_grammars, and every request
+    answers cleanly (200, or the cache-full 400)."""
+    import threading
+
+    from tpu_k8s_device_plugin.workloads.server import EngineServer
+
+    model, params, _ = setup
+    eng = ServingEngine(model, params, n_slots=2, eos_id=EOS)
+    tb = [bytes([i]) if i else b"" for i in range(CFG["vocab"])]
+    srv = EngineServer(eng, max_new_tokens=4, window=2,
+                       token_bytes=tb, max_grammars=3)
+    srv.start(host="127.0.0.1", port=0)
+    try:
+        patterns = [f"(ab|cd)+{c}" for c in "efghij"]
+        results = [None] * len(patterns)
+
+        def one(i):
+            results[i] = _post(srv.port, {
+                "tokens": [70], "guided_regex": patterns[i],
+                "stream": False})
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(len(patterns))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        statuses = [r[0] for r in results]
+        assert set(statuses) <= {200, 400}, statuses
+        served = statuses.count(200)
+        assert 1 <= served <= 3
+        # the bound held through the race: never more distinct
+        # patterns than max_grammars, pending or registered
+        assert srv.stats()["grammar_patterns"] <= 3
+    finally:
+        srv.stop()

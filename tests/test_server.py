@@ -62,6 +62,23 @@ def _post(port, payload, timeout=120):
         conn.close()
 
 
+def _streamed_tokens(events, index=None):
+    """Flatten streamed ids from BOTH wire shapes — coalesced window
+    frames ({"tokens": [...]}) and legacy per-token events — so every
+    oracle assertion covers whichever shape the request selected."""
+    out = []
+    for e in events:
+        if "done" in e or "error" in e:
+            continue
+        if index is not None and e.get("index", 0) != index:
+            continue
+        if "tokens" in e:
+            out.extend(e["tokens"])
+        elif "token" in e:
+            out.append(e["token"])
+    return out
+
+
 def test_three_concurrent_clients_oracle_matched(server, setup):
     # 3 clients > 2 slots: the third request queues and is admitted
     # mid-flight when a slot frees — its stream must still match the
@@ -87,9 +104,8 @@ def test_three_concurrent_clients_oracle_matched(server, setup):
         assert done.get("done") is True
         want = _solo(model, params, prompt, 8)
         assert done["tokens"] == want, f"client {i}"
-        # the streamed per-token events must agree with the final list
-        streamed = [e["token"] for e in events if "token" in e]
-        assert streamed == done["tokens"]
+        # the streamed window frames must agree with the final list
+        assert _streamed_tokens(events) == done["tokens"]
     st = server.stats()
     assert st["requests_served"] == 3
     assert st["running_requests"] == 0
@@ -188,12 +204,10 @@ def test_n_completions_over_http(setup):
             assert c["finish_reason"] == "length"
         for e in events[:-1]:
             assert "index" in e and 0 <= e["index"] < 3
-        # streamed events reassemble into exactly the choices
-        streams = {i: [] for i in range(3)}
-        for e in events[:-1]:
-            streams[e["index"]].append(e["token"])
+        # streamed frames reassemble into exactly the choices
         for c in choices:
-            assert streams[c["index"]] == c["tokens"]
+            assert _streamed_tokens(
+                events[:-1], index=c["index"]) == c["tokens"]
         # sampled siblings must actually diverge (distinct noise per
         # slot row — the failure mode n>1 exists to avoid is n
         # identical copies); statistically safe at temp 1.0/top-k 16
@@ -444,6 +458,7 @@ def test_parse_request_defaults():
     class FakeSrv(EngineServer):
         def __init__(self):
             self.default_max_new = eng_default
+            self.max_events = 256
 
     req = FakeSrv()._parse_request({"tokens": [1, 2]})
     assert isinstance(req, _Request)
@@ -944,3 +959,81 @@ def test_min_tokens_floors_stop_strings(text_server):
     status, events = _post(
         srv.port, {"prompt": "ab", "stop": [stop], "stream": False})
     assert len(events[0]["tokens"]) < 6
+
+
+def test_window_frames_match_per_token_stream(server, setup):
+    """Streaming equivalence (JSON-lines): the default coalesced
+    window frames must reassemble token-for-token into exactly what
+    the legacy per_token path streams, and both into the final
+    tokens array."""
+    prompt = [3, 14, 15, 92, 65]
+    st1, coal = _post(server.port,
+                      {"tokens": prompt, "max_new_tokens": 8})
+    st2, per = _post(server.port,
+                     {"tokens": prompt, "max_new_tokens": 8,
+                      "per_token": True})
+    assert st1 == st2 == 200
+    assert coal[-1]["tokens"] == per[-1]["tokens"]
+    assert (_streamed_tokens(coal) == _streamed_tokens(per)
+            == coal[-1]["tokens"])
+    # the per-token path really is per-token, the coalesced path
+    # really coalesces (window=4 here: >1 token per frame)
+    assert all("token" in e for e in per[:-1])
+    assert any(len(e.get("tokens", ())) > 1 for e in coal[:-1])
+
+
+def test_coalesced_text_and_sse_equivalence(text_server):
+    """Streaming equivalence (text + SSE): coalesced-window text
+    deltas, the per_token path, the unary body, and the OpenAI SSE
+    stream all reconstruct the same text for the same prompt."""
+    srv, model, params = text_server
+    body = {"prompt": "ab", "max_new_tokens": 8}
+    s1, coal = _post(srv.port, dict(body))
+    s2, per = _post(srv.port, dict(body, per_token=True))
+    s3, unary = _post(srv.port, dict(body, stream=False))
+    assert s1 == s2 == s3 == 200
+    assert (coal[-1]["tokens"] == per[-1]["tokens"]
+            == unary[0]["tokens"])
+    assert _streamed_tokens(coal) == _streamed_tokens(per)
+    joined = "".join(e["text"] for e in coal
+                     if "text" in e and "done" not in e)
+    joined_per = "".join(e["text"] for e in per
+                         if "text" in e and "done" not in e)
+    assert joined == joined_per
+    assert coal[-1]["text"] == unary[0]["text"]
+    assert coal[-1]["text"].startswith(joined)
+    # SSE reconstructs the same text
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                      timeout=120)
+    conn.request("POST", "/v1/completions", json.dumps({
+        "prompt": "ab", "temperature": 0, "max_tokens": 8,
+        "stream": True}), {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    raw = resp.read().decode()
+    conn.close()
+    chunks = [json.loads(d[len("data: "):])
+              for d in raw.splitlines()
+              if d.startswith("data: ") and not d.endswith("[DONE]")]
+    sse_text = "".join(c["choices"][0]["text"] for c in chunks)
+    assert sse_text == unary[0]["text"]
+
+
+def test_stop_match_ids_agree_with_text(text_server):
+    """ADVICE r5: the ids and text surfaces of one stop response must
+    agree — tokens truncate at the match-completing token, text at the
+    match start, both derived from the SAME match."""
+    srv, model, params = text_server
+    tok = _ByteTok()
+    full = _solo(model, params, tok.encode("ab"), 8)
+    text = tok.decode(full)
+    stop = text[3:5]  # completes at token 5, starts at char 3
+    status, events = _post(
+        srv.port, {"prompt": "ab", "stop": [stop], "stream": False,
+                   "min_tokens": 2})
+    assert status == 200
+    ev = events[0]
+    assert ev["finish_reason"] == "stop"
+    assert len(ev["tokens"]) == 5          # through the completing token
+    assert ev["text"] == text[:3]          # cut at the match start
+    # the surfaces agree: kept ids detokenize to text + the stop
+    assert tok.decode(ev["tokens"]) == ev["text"] + stop

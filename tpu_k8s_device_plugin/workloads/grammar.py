@@ -488,6 +488,13 @@ def schema_to_regex(schema: dict, depth: int = 3,
     if t == "object":
         props = schema.get("properties")
         if not props:
+            if schema.get("additionalProperties") is False:
+                # no properties + additionalProperties false admits
+                # ONLY the empty object; falling through to
+                # json_object_regex would permit arbitrary members —
+                # exactly the silent under-constraining the unsafe-
+                # keyword 400 path exists to prevent (ADVICE r5)
+                return f"\\{{{ws}\\}}"
             # a schemaless object is still an OBJECT, never a scalar
             return json_object_regex(max(depth, 1))
         import json as _json

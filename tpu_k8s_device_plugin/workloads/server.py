@@ -39,8 +39,13 @@ API (JSON over HTTP/1.1):
                    admit incrementally and share the prompt via the
                    automatic prefix cache).
                    stream=true (default): chunked body, one JSON line
-                   per event — {"token": t} ... then
+                   per event — coalesced window frames
+                   {"tokens": [t, ...]} (one per run_scan window, the
+                   engine-rate hot path) ... then
                    {"done": true, "tokens": [...], "finish_reason": r}
+                   per_token=true restores the legacy per-token shape
+                   {"token": t} (one line per token; logprobs requests
+                   use it implicitly — the per-token stats need it).
                    stream=false: single JSON body (the final event).
   POST /v1/completions   OpenAI-compatible text completions (needs
                    --tokenizer): string or token-array "prompt",
@@ -63,6 +68,16 @@ business and the engine's contract stays exact and model-agnostic.
 ``--tokenizer`` opts into the text surface server-side ("prompt"
 strings, stop STRINGS with streaming holdback, "text" deltas) without
 touching the compiled decode path.
+
+Load shedding (vLLM's admission-control posture): HTTP traffic is
+served by a FIXED worker pool (``--max-connections``) instead of a
+thread per connection, the admission heap is bounded
+(``--max-queue``), and overflow on either answers 429 +
+``Retry-After`` instead of growing threads or heap without bound.
+Per-request event queues are bounded too: a client that stops reading
+its stream is disconnected (its events dropped, its slot released)
+rather than buffering tokens forever — the documented slow-client
+policy.
 """
 
 from __future__ import annotations
@@ -76,7 +91,7 @@ import queue
 import threading
 import time
 from dataclasses import dataclass, field
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler, HTTPServer
 from typing import List, Optional
 
 from .grammar import (
@@ -95,6 +110,27 @@ log = logging.getLogger(__name__)
 # queue, longer ones amortize host round-trips harder
 DEFAULT_WINDOW = 8
 _IDLE_POLL_S = 0.05
+
+# client-supplied guided_regex length bound (ADVICE r5): pattern text
+# is attacker-controlled on the HTTP surface, and subset construction
+# is super-linear in it; server-lowered patterns (guided_json /
+# guided_choice) are bounded by --max-grammar-states instead
+_MAX_REGEX_LEN = 4096
+
+# pre-encoded JSON-lines skeletons for the hot streaming path: one
+# frame per run_scan window, built by byte concatenation — no dict, no
+# json.dumps, no per-token work on either thread
+_FRAME_PRE = b'{"tokens":['
+_FRAME_POST = b']}\n'
+
+
+def _tokens_frame(new, idx: int, n: int) -> bytes:
+    """One pre-serialized coalesced window frame: the JSON line
+    ``{"tokens": [...]}`` (index-tagged for n>1) as wire-ready bytes."""
+    body = ",".join(map(str, new)).encode()
+    if n > 1:
+        return b'{"tokens":[%s],"index":%d}\n' % (body, idx)
+    return _FRAME_PRE + body + _FRAME_POST
 
 
 def _holdback(text: str, stop_strs) -> int:
@@ -350,6 +386,10 @@ class _Request:
     n: int = 1
     events: "queue.Queue" = field(default_factory=queue.Queue)
     cancelled: bool = False
+    stream: bool = True               # streaming response requested
+    per_token: bool = False           # legacy {"token": t} event shape
+    openai: bool = False              # OpenAI route: text deltas only
+    dropped: bool = False             # slow-client disconnect fired
     admitted: int = 0                 # copies admitted so far (of n)
     emitted: dict = field(default_factory=dict)   # copy index -> count
     choices: list = field(default_factory=list)   # finished copies
@@ -373,6 +413,96 @@ class _Request:
     grammar_tdfa: object = None            # compiled, pre-registration
 
 
+class _PooledHTTPServer(HTTPServer):
+    """HTTP server with a FIXED worker pool and a bounded accept
+    queue, replacing ThreadingHTTPServer's thread-per-connection:
+    *workers* connections are served concurrently, up to *workers*
+    more wait in the hand-off queue, and anything beyond that is
+    answered 429 + Retry-After immediately on the accept thread (one
+    small pre-built response into a fresh socket's send buffer — it
+    cannot block on the client).  Thread count is a constant whatever
+    the burst, which is the point: the old thread-per-connection model
+    grew without bound exactly when the server was least able to
+    afford it."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+    request_queue_size = 128  # TCP accept backlog
+
+    _REJECT_BODY = (json.dumps({"error": {
+        "message": "connection limit reached; retry later",
+        "type": "rate_limit_exceeded"}}) + "\n").encode()
+    _REJECT = (b"HTTP/1.1 429 Too Many Requests\r\n"
+               b"Content-Type: application/json\r\n"
+               b"Retry-After: 1\r\n"
+               b"Content-Length: %d\r\n"
+               b"Connection: close\r\n\r\n" % len(_REJECT_BODY)
+               ) + _REJECT_BODY
+
+    def __init__(self, addr, handler, workers: int):
+        super().__init__(addr, handler)
+        self._conns: "queue.Queue" = queue.Queue(maxsize=workers)
+        self.connections_rejected = 0  # 429s shed at accept
+        self._pool = [
+            threading.Thread(target=self._worker,
+                             name=f"serve-http-{i}", daemon=True)
+            for i in range(workers)]
+        for t in self._pool:
+            t.start()
+
+    def process_request(self, request, client_address):
+        """Accept thread: hand the connection to the pool or shed it."""
+        try:
+            self._conns.put_nowait((request, client_address))
+        except queue.Full:
+            self.connections_rejected += 1
+            try:
+                request.settimeout(0.5)
+                request.sendall(self._REJECT)
+                # drain whatever request bytes already arrived so the
+                # close does not RST the 429 out of the peer's buffer
+                try:
+                    request.recv(1 << 20)
+                except OSError:
+                    pass
+            except OSError:
+                pass
+            self.shutdown_request(request)
+
+    def _worker(self):
+        while True:
+            item = self._conns.get()
+            if item is None:
+                return
+            request, client_address = item
+            try:
+                self.finish_request(request, client_address)
+            except Exception:
+                self.handle_error(request, client_address)
+            finally:
+                self.shutdown_request(request)
+
+    def pool_stats(self) -> dict:
+        return {
+            "http_workers": len(self._pool),
+            "connections_waiting": self._conns.qsize(),
+            "connections_rejected": self.connections_rejected,
+        }
+
+    def server_close(self):
+        super().server_close()
+        # best-effort pool drain: workers mid-stream see the
+        # scheduler's shutdown 503 and exit their connection; the
+        # sentinels release the idle ones (daemon threads back-stop)
+        for _ in self._pool:
+            try:
+                self._conns.put_nowait(None)
+            except queue.Full:
+                break
+        for t in self._pool:
+            t.join(timeout=1)
+
+
 class EngineServer:
     """Scheduler + HTTP surface around one ServingEngine.
 
@@ -386,14 +516,29 @@ class EngineServer:
                  window: int = DEFAULT_WINDOW,
                  tokenizer=None,
                  token_bytes: Optional[List[bytes]] = None,
-                 max_grammars: int = 64):
+                 max_grammars: int = 64,
+                 max_queue: int = 1024,
+                 max_connections: int = 64,
+                 max_events: int = 256,
+                 max_grammar_states: int = 8192,
+                 client_timeout: float = 120.0):
         """*tokenizer* (anything with ``encode(str) -> List[int]`` and
         ``decode(List[int]) -> str``, e.g. a transformers tokenizer)
         unlocks the text-level surface: ``"prompt"`` strings, STRING
         entries in ``"stop"`` (vLLM's stop strings — matched against
         the detokenized stream, held back across chunk boundaries),
         and ``"text"`` deltas in the response.  Without it the server
-        speaks token ids only, as before."""
+        speaks token ids only, as before.
+
+        *max_queue* bounds the admission heap and *max_connections*
+        the HTTP worker pool (each overflow answers 429 +
+        Retry-After); *max_events* bounds each request's event queue
+        (a client that stops draining is disconnected and its slot
+        released); *max_grammar_states* rejects guided-decoding
+        patterns whose char-DFA exceeds that many states BEFORE the
+        [N, V] token table is built; *client_timeout* is the
+        per-connection socket timeout so a stuck peer frees its pool
+        worker."""
         if engine.max_new_tokens is not None:
             raise ValueError(
                 "pass per-request budgets to EngineServer, not the "
@@ -414,6 +559,15 @@ class EngineServer:
         # grammar table for the engine's lifetime.
         self._token_bytes = token_bytes
         self.max_grammars = max_grammars
+        if max_queue < 1 or max_connections < 1 or max_events < 8:
+            raise ValueError(
+                "max_queue/max_connections must be >= 1 and "
+                "max_events >= 8")
+        self.max_queue = max_queue
+        self.max_connections = max_connections
+        self.max_events = max_events
+        self.max_grammar_states = max_grammar_states
+        self.client_timeout = client_timeout
         self._grammar_tdfas: dict = {}    # pattern -> TokenDfa
         self._grammar_gids: dict = {}     # pattern -> engine gid
         self._glock = threading.Lock()
@@ -428,10 +582,12 @@ class EngineServer:
         self._running: dict = {}          # slot -> (_Request, copy idx)
         self._head: Optional[_Request] = None  # partially admitted n>1
         self._stop = threading.Event()
-        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._httpd: Optional["_PooledHTTPServer"] = None
         self._scheduler: Optional[threading.Thread] = None
         self._requests_served = 0
         self._requests_rejected = 0
+        self._requests_throttled = 0   # 429: admission heap full
+        self._requests_dropped = 0     # slow clients disconnected
 
     # -- scheduler (sole owner of the engine) -------------------------------
 
@@ -485,15 +641,21 @@ class EngineServer:
                     # scheduler is the engine's sole owner; the pattern
                     # cache makes it once-per-pattern, so the steady
                     # state is a dict lookup
-                    gid = self._grammar_gids.get(req.grammar_key)
+                    with self._glock:
+                        gid = self._grammar_gids.get(req.grammar_key)
                     if gid is None:
                         gid = eng.register_grammar(req.grammar_tdfa)
-                        self._grammar_gids[req.grammar_key] = gid
                         with self._glock:
-                            # the engine's combined table now holds the
-                            # rows; keeping the standalone TokenDfa
-                            # would pin a second full [N, V] host copy
-                            # per pattern for the server's lifetime
+                            # one critical section for the registered/
+                            # pending handoff: handler threads read
+                            # BOTH maps for the max_grammars bound and
+                            # the compile-skip check, so the insert and
+                            # the pop must land atomically (ADVICE r5).
+                            # Dropping the standalone TokenDfa matters
+                            # too: keeping it would pin a second full
+                            # [N, V] host copy per pattern for the
+                            # server's lifetime
+                            self._grammar_gids[req.grammar_key] = gid
                             self._grammar_tdfas.pop(req.grammar_key,
                                                     None)
                     req.grammar_tdfa = None  # registered; drop the ref
@@ -527,7 +689,7 @@ class EngineServer:
                 # fail on validation (the free-slot guard rules out
                 # engine-full) — no partially-errored requests
                 self._requests_rejected += 1
-                req.events.put({"error": str(e), "code": 400})
+                self._push(req, {"error": str(e), "code": 400})
                 continue
             idx = req.admitted
             req.admitted += 1
@@ -538,14 +700,49 @@ class EngineServer:
             # the admit's first sampled token streams immediately
             self._emit(slot, req, idx, eng.output(slot))
 
+    def _push(self, req: _Request, ev) -> bool:
+        """Queue *ev* for *req*'s connection without ever blocking the
+        scheduler.  Event queues are BOUNDED (slow-client protection):
+        a full queue means the client stopped draining, and the
+        documented policy is disconnect, not unbounded buffering — the
+        request is cancelled (the scheduler sweep releases its slots),
+        the oldest undelivered event is dropped to make room, and a
+        terminal 503 lands so a handler blocked in ``events.get()``
+        wakes up and closes the connection."""
+        try:
+            req.events.put_nowait(ev)
+            return True
+        except queue.Full:
+            if not req.dropped:
+                req.dropped = True
+                req.cancelled = True
+                self._requests_dropped += 1
+                try:
+                    req.events.get_nowait()
+                except queue.Empty:
+                    pass
+                try:
+                    req.events.put_nowait({
+                        "error": "client not draining its stream; "
+                                 "disconnecting (slow-client policy)",
+                        "code": 503})
+                except queue.Full:
+                    pass
+            return False
+
     def _emit(self, slot: int, req: _Request, idx: int,
               tokens: List[int]) -> None:
         """Push copy *idx*'s unseen tokens, honoring the budget and
         retiring the slot when the copy is done; the request completes
-        when ALL n copies have.  With a tokenizer, stop STRINGS are
-        matched against the detokenized stream (a match truncates the
-        copy there) and "text" deltas ride alongside the token events,
-        holding back any tail that could still become a stop string."""
+        when ALL n copies have.  The hot path coalesces each run_scan
+        window's tokens into ONE pre-serialized JSON-lines frame
+        (``{"tokens": [...]}``) — no per-token dict, dumps, or queue
+        round-trip; ``per_token`` (and logprobs, whose stats are
+        per-token) fall back to the legacy ``{"token": t}`` events.
+        With a tokenizer, stop STRINGS are matched against the
+        detokenized stream (a match truncates the copy there) and
+        "text" deltas ride alongside the token frames, holding back
+        any tail that could still become a stop string."""
         eng = self.engine
         seen = req.emitted[idx]
         new = tokens[seen:req.max_new_tokens]
@@ -555,22 +752,37 @@ class EngineServer:
             st.feed(self.tokenizer, tokens, min(len(tokens),
                                                 req.max_new_tokens))
         stop_text = None  # truncated text when a stop string matched
+        stop_keep = None  # tokens kept by the match (<= seen possible)
         if req.stop_strs and new:
             # min_tokens floors stop strings too (vLLM: no stop check
             # below the floor): scanning starts only past the floor, so
             # a match can only complete at token min_tokens+1 or later
             keep = scanned = None
             if seen + len(new) > req.min_tokens:
-                keep, text = _find_stop(
-                    st, req.stop_strs, req.stop_scanned.get(idx, 0))
                 scanned = True
+                start = req.stop_scanned.get(idx, 0)
+                while True:
+                    keep, text = _find_stop(st, req.stop_strs, start)
+                    if keep is None or keep > req.min_tokens:
+                        break
+                    # a match COMPLETING at or below the floor never
+                    # fires (vLLM: no stop check below min_tokens) —
+                    # resume scanning past its completion instead of
+                    # clamping the cut to the floor, which used to
+                    # leave the ids surface at min_tokens+1 while the
+                    # text was cut at the (pre-floor) match start
+                    start = st.cum[keep]
             if keep is not None:
-                # kept tokens include the completing token, and at
-                # least the floor (the match itself may sit below it)
-                keep = max(keep, min(req.min_tokens + 1,
-                                     seen + len(new)))
+                # kept tokens include the completing token; keep may
+                # sit BELOW tokens already streamed (a detok stall or
+                # floor-deferred scan) — the final tokens array
+                # truncates to the kept count either way, so the ids
+                # and text surfaces of one response always agree
+                # (ADVICE r5; streamed frames past the match cannot be
+                # unsent, the final array is authoritative)
                 new = tokens[seen:keep] if keep > seen else []
                 stop_text = text
+                stop_keep = keep
             elif scanned:
                 # resume point advances ONLY past text a scan actually
                 # covered — below the floor nothing was scanned, and a
@@ -578,20 +790,32 @@ class EngineServer:
                 # post-floor scan
                 req.stop_scanned[idx] = len(st.text)
         lps = (eng.token_logprobs(slot) if req.logprobs else None)
-        for j, t in enumerate(new):
-            ev = {"token": int(t)}
-            if req.n > 1:
-                ev["index"] = idx
-            if lps is not None:
-                clp, top = lps[seen + j]
-                ev["logprob"] = clp
-                ev["top_logprobs"] = [[i, p] for i, p in top]
-            req.events.put(ev)
+        if new and req.stream and not req.openai:
+            # OpenAI streams carry text deltas only (raw ids never hit
+            # that wire); non-streaming requests need just the final
+            # event — neither pays for token frames
+            if lps is not None or req.per_token:
+                # legacy per-token shape (and logprobs, whose stats
+                # are inherently per-token)
+                for j, t in enumerate(new):
+                    ev = {"token": int(t)}
+                    if req.n > 1:
+                        ev["index"] = idx
+                    if lps is not None:
+                        clp, top = lps[seen + j]
+                        ev["logprob"] = clp
+                        ev["top_logprobs"] = [[i, p] for i, p in top]
+                    if not self._push(req, ev):
+                        break
+            else:
+                # engine-rate hot path: the whole window in one
+                # pre-encoded frame, one queue hop, one client write
+                self._push(req, _tokens_frame(new, idx, req.n))
         req.emitted[idx] = seen + len(new)
         finished = eng.finished(slot)
         done = (stop_text is not None
                 or req.emitted[idx] >= req.max_new_tokens or finished)
-        if req.detokenize:
+        if req.detokenize and req.stream:
             # the committed incremental text (never ends mid-char:
             # _DetokState withholds UTF-8-unstable tails, so the old
             # U+FFFD backscan is structurally unnecessary), capped at
@@ -617,7 +841,7 @@ class EngineServer:
                 ev = {"text": cur[len(sent):safe]}
                 if req.n > 1:
                     ev["index"] = idx
-                req.events.put(ev)
+                self._push(req, ev)
                 req.text_sent[idx] = cur[:safe]
         if req.cancelled:
             eng.release(slot)
@@ -625,7 +849,7 @@ class EngineServer:
             return
         if done:
             if stop_text is not None:
-                out = tokens[:req.emitted[idx]]
+                out = tokens[:stop_keep]
                 reason = "stop"
                 if not finished:
                     eng.release(slot)
@@ -688,7 +912,7 @@ class EngineServer:
                 # count BEFORE the event lands: a client reacting to
                 # the final chunk must not read a stale /stats counter
                 self._requests_served += 1
-                req.events.put(done)
+                self._push(req, done)
 
     def _scheduler_loop(self) -> None:
         eng = self.engine
@@ -748,11 +972,11 @@ class EngineServer:
         for req, _idx in self._running.values():
             if id(req) not in notified:
                 notified.add(id(req))
-                req.events.put(dict(bye))
+                self._push(req, dict(bye))
         self._running.clear()
         if self._head is not None:
             if id(self._head) not in notified:
-                self._head.events.put(dict(bye))
+                self._push(self._head, dict(bye))
             self._head = None
 
     # -- lifecycle ----------------------------------------------------------
@@ -766,10 +990,14 @@ class EngineServer:
             "n_slots", "active_slots", "free_slots",
             "registered_prefixes", "pending_requests",
             "running_requests", "running_copies", "window",
+            "http_workers", "connections_waiting", "max_queue",
         })
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # per-connection socket deadline: a peer that stops
+            # reading AND writing cannot pin a pool worker forever
+            timeout = server.client_timeout
 
             def do_GET(self):  # noqa: N802 (http.server API)
                 if self.path == "/healthz":
@@ -815,14 +1043,14 @@ class EngineServer:
                     self._send(400, "application/json",
                                json.dumps({"error": str(e)}) + "\n")
                     return
-                stream = bool(body.get("stream", True))
                 server._enqueue(req)
                 try:
-                    if stream:
+                    if req.stream:
                         self._stream(req)
                     else:
                         self._collect(req)
-                except (BrokenPipeError, ConnectionResetError):
+                except (BrokenPipeError, ConnectionResetError,
+                        TimeoutError):
                     req.cancelled = True
 
             def _openai_completions(self, chat: bool = False):
@@ -872,19 +1100,24 @@ class EngineServer:
                 except (ValueError, TypeError, KeyError) as e:
                     self._openai_error(400, str(e))
                     return
+                req.openai = True   # text deltas only on this wire
+                req.stream = stream
                 server._enqueue(req)
                 try:
                     if stream:
                         self._openai_stream(req, model_name, chat)
                     else:
                         self._openai_collect(req, model_name, chat)
-                except (BrokenPipeError, ConnectionResetError):
+                except (BrokenPipeError, ConnectionResetError,
+                        TimeoutError):
                     req.cancelled = True
 
             def _openai_error(self, code: int, message: str):
                 """OpenAI error wire shape; 5xx are server faults so
-                retry middleware retries them, 4xx are caller errors."""
+                retry middleware retries them, 429 is rate limiting
+                (with Retry-After), other 4xx are caller errors."""
                 kind = ("server_error" if code >= 500
+                        else "rate_limit_exceeded" if code == 429
                         else "invalid_request_error")
                 self._send(code, "application/json",
                            json.dumps({"error": {
@@ -991,7 +1224,7 @@ class EngineServer:
                 # not an in-band error line on a 200 (status-checking
                 # clients — curl -f, k8s probes — would see success)
                 first = req.events.get()
-                if "error" in first:
+                if isinstance(first, dict) and "error" in first:
                     self._send(first.get("code", 400),
                                "application/json",
                                json.dumps(first) + "\n")
@@ -1001,17 +1234,41 @@ class EngineServer:
                                  "application/jsonlines")
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
+                # the engine-rate write loop: drain every event the
+                # scheduler has already queued (pre-encoded window
+                # frames are raw bytes) into ONE chunked write — the
+                # socket sees at most one syscall per window, and a
+                # briefly-stalled reader catches up in one write
+                # instead of one per missed event
                 ev = first
-                while True:
-                    self._chunk(json.dumps(ev) + "\n")
-                    if "done" in ev or "error" in ev:
-                        break
-                    ev = req.events.get()
-                self._chunk("")  # terminating 0-length chunk
+                terminal = False
+                while not terminal:
+                    parts = []
+                    while True:
+                        if isinstance(ev, bytes):
+                            parts.append(ev)
+                        else:
+                            parts.append(
+                                (json.dumps(ev) + "\n").encode())
+                            if "done" in ev or "error" in ev:
+                                terminal = True
+                                break
+                        try:
+                            ev = req.events.get_nowait()
+                        except queue.Empty:
+                            break
+                    payload = b"".join(parts)
+                    self.wfile.write(b"%x\r\n" % len(payload)
+                                     + payload + b"\r\n")
+                    if not terminal:
+                        ev = req.events.get()
+                self.wfile.write(b"0\r\n\r\n")
 
             def _collect(self, req: _Request):
                 while True:
                     ev = req.events.get()
+                    if isinstance(ev, bytes):
+                        continue  # window frames: stream-only payload
                     if "error" in ev:
                         self._send(ev.get("code", 400),
                                    "application/json",
@@ -1026,20 +1283,24 @@ class EngineServer:
                 data = text.encode()
                 self.wfile.write(f"{len(data):x}\r\n".encode()
                                  + data + b"\r\n")
-                self.wfile.flush()
 
             def _send(self, code, ctype, body: str):
                 data = body.encode()
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                if code == 429:
+                    # OpenAI rate-limit semantics: tell the client
+                    # when to come back instead of letting it hammer
+                    self.send_header("Retry-After", "1")
                 self.end_headers()
                 self.wfile.write(data)
 
             def log_message(self, fmt, *args):
                 log.debug("serve-http: " + fmt, *args)
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd = _PooledHTTPServer((host, port), Handler,
+                                        workers=self.max_connections)
         threading.Thread(target=self._httpd.serve_forever,
                          name="serve-http", daemon=True).start()
         self._scheduler = threading.Thread(
@@ -1081,18 +1342,35 @@ class EngineServer:
         with self._lock:
             drained, self._pending = self._pending, []
         for _, _, req in drained:
-            req.events.put(dict(bye))
+            self._push(req, dict(bye))
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
 
     def _enqueue(self, req: _Request) -> None:
+        """Admit *req* to the bounded priority heap, or answer 429.
+        Overflow surfaces through the same first-event path every
+        handler already checks, so all four response surfaces (native
+        stream/unary, OpenAI SSE/unary) get a real 429 + Retry-After
+        instead of unbounded heap growth (vLLM's admission-control
+        semantics)."""
         with self._lock:
-            self._pending_seq += 1
-            req._seq = self._pending_seq
-            heapq.heappush(self._pending,
-                           (-req.priority, req._seq, req))
+            if len(self._pending) >= self.max_queue:
+                self._requests_throttled += 1
+                full = True
+            else:
+                self._pending_seq += 1
+                req._seq = self._pending_seq
+                heapq.heappush(self._pending,
+                               (-req.priority, req._seq, req))
+                full = False
+        if full:
+            self._push(req, {
+                "error": f"admission queue full ({self.max_queue} "
+                         "requests pending); retry later",
+                "code": 429})
+            return
         self._work.set()
 
     # -- request plumbing ---------------------------------------------------
@@ -1126,8 +1404,19 @@ class EngineServer:
                     f"grammar cache full ({self.max_grammars} distinct "
                     "patterns); raise --max-grammars or reuse patterns")
         if tdfa is None:
-            tdfa = token_dfa(regex_to_dfa(pattern),
-                             self._token_byte_table(),
+            cdfa = regex_to_dfa(pattern)
+            if self.max_grammar_states and \
+                    len(cdfa.table) > self.max_grammar_states:
+                # reject BEFORE the [N, V] token table: N states x a
+                # real vocabulary is the gigabytes-of-host-memory
+                # blowup the untrusted HTTP surface must not reach
+                # (ADVICE r5)
+                raise ValueError(
+                    f"pattern compiles to {len(cdfa.table)} DFA "
+                    f"states, over the --max-grammar-states bound "
+                    f"{self.max_grammar_states}; simplify the "
+                    "constraint")
+            tdfa = token_dfa(cdfa, self._token_byte_table(),
                              eos_id=self.engine.eos_id)
             with self._glock:
                 # re-check under the lock: concurrent first requests
@@ -1168,6 +1457,13 @@ class EngineServer:
             if not isinstance(regex, str) or not regex:
                 raise ValueError(
                     "'guided_regex' must be a non-empty pattern string")
+            if len(regex) > _MAX_REGEX_LEN:
+                # client-supplied pattern text is attacker-controlled
+                # and subset construction is super-linear in it; the
+                # compiled-state bound still applies after this
+                raise ValueError(
+                    f"'guided_regex' is {len(regex)} chars; the "
+                    f"served bound is {_MAX_REGEX_LEN}")
             return regex
         if choice is not None:
             if (not isinstance(choice, list) or not choice or not all(
@@ -1423,7 +1719,9 @@ class EngineServer:
                     "guided decoding needs an engine eos id (the "
                     "grammar gates completion on it)")
             grammar_key = pattern
-            if pattern not in self._grammar_gids:
+            with self._glock:
+                registered = pattern in self._grammar_gids
+            if not registered:
                 # compiles (or cache-hits) here on the handler thread;
                 # regex syntax errors and vocabulary dead-ends surface
                 # as this request's 400, never a scheduler stall.
@@ -1457,10 +1755,16 @@ class EngineServer:
             n=n,
             grammar_key=grammar_key,
             grammar_tdfa=grammar_tdfa,
+            stream=bool(body.get("stream", True)),
+            per_token=bool(body.get("per_token", False)),
+            # bounded: the slow-client disconnect policy (see _push)
+            events=queue.Queue(self.max_events),
         )
 
     def stats(self) -> dict:
         st = dict(self.engine.stats())
+        with self._glock:
+            grammar_patterns = self._grammar_count()
         st.update({
             "pending_requests": len(self._pending),
             # distinct REQUESTS (an n>1 request occupies n slots)
@@ -1469,9 +1773,14 @@ class EngineServer:
             "running_copies": len(self._running),
             "requests_served": self._requests_served,
             "requests_rejected": self._requests_rejected,
-            "grammar_patterns": self._grammar_count(),
+            "requests_throttled": self._requests_throttled,
+            "requests_dropped": self._requests_dropped,
+            "grammar_patterns": grammar_patterns,
             "window": self.window,
+            "max_queue": self.max_queue,
         })
+        if self._httpd is not None:
+            st.update(self._httpd.pool_stats())
         return st
 
 
@@ -1513,6 +1822,21 @@ def main(argv=None) -> int:
                    help="distinct guided-decoding patterns cached per "
                         "server lifetime (each occupies engine grammar "
                         "table rows)")
+    p.add_argument("--max-grammar-states", type=int, default=8192,
+                   help="reject guided-decoding patterns whose "
+                        "char-DFA exceeds this many states (before "
+                        "the [N, V] token table is built); 0 disables")
+    p.add_argument("--max-queue", type=int, default=1024,
+                   help="admission queue bound: requests past it get "
+                        "429 + Retry-After instead of unbounded heap "
+                        "growth")
+    p.add_argument("--max-connections", type=int, default=64,
+                   help="HTTP worker pool size (fixed thread count); "
+                        "connections past 2x this are shed with 429 "
+                        "at accept")
+    p.add_argument("--client-timeout", type=float, default=120.0,
+                   help="per-connection socket timeout in seconds: a "
+                        "stuck peer frees its pool worker")
     p.add_argument("--jump-len", type=int, default=8,
                    help="structural jump-ahead width: up to this many "
                         "DFA-forced tokens (a schema's keys and "
@@ -1610,7 +1934,11 @@ def main(argv=None) -> int:
             p.error(f"could not load tokenizer {args.tokenizer!r}: {e}")
     srv = EngineServer(engine, max_new_tokens=args.max_new_tokens,
                        window=args.window, tokenizer=tokenizer,
-                       max_grammars=args.max_grammars)
+                       max_grammars=args.max_grammars,
+                       max_grammar_states=args.max_grammar_states,
+                       max_queue=args.max_queue,
+                       max_connections=args.max_connections,
+                       client_timeout=args.client_timeout)
     srv.start(host=args.host, port=args.port)
     print(f"serving {args.config} (quantized={quantized}) on "
           f"http://{args.host}:{srv.port}  "

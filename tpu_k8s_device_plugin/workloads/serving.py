@@ -546,6 +546,12 @@ class ServingEngine:
         self.pres = np.zeros(n_slots, np.float32)
         self.freqs = np.zeros(n_slots, np.float32)
         self.reps = np.ones(n_slots, np.float32)
+        # device mirrors of the per-slot knob vectors, rebuilt only
+        # when an admit/retire touches them: run_scan used to pay ~15
+        # host->device conversions of unchanged arrays per window,
+        # which at short windows was a measurable slice of the serving
+        # hot path (None = stale, rebuilt on next scan)
+        self._knob_cache = None
         # output-token histogram for the penalties: [S, V] on device,
         # bumped per decode step only while some penalized request is
         # live, reset per slot at each PENALIZED admit (unpenalized
@@ -1204,6 +1210,7 @@ class ServingEngine:
         self.seeds[slot] = np.uint32((seed or 0) & 0xFFFFFFFF)
         self._seed_streams[slot] = int(seed_stream)
         self._seed_on[slot] = 0 if seed is None else 1
+        self._knob_cache = None  # device mirrors are stale now
         self._slot_draws[slot] = 0
         self._lp_want[slot] = lp_n
         self._lp_records[slot] = []
@@ -1852,8 +1859,23 @@ class ServingEngine:
         lp_k = self.logprobs_k if any(
             self._lp_want[s] for s in range(self.n_slots)
             if self.active[s]) else 0
-        aids = (jnp.asarray(self.adapters)
-                if self.model.n_adapters > 0 else None)
+        if self._knob_cache is None:
+            # rebuild the device mirrors once per admit/retire burst
+            # instead of once per window (values change only there)
+            self._knob_cache = (
+                jnp.asarray(self.temps), jnp.asarray(self.topks),
+                jnp.asarray(self.topps), jnp.asarray(self.minps),
+                jnp.asarray(self.pres), jnp.asarray(self.freqs),
+                jnp.asarray(self.reps), jnp.asarray(self.min_toks),
+                jnp.asarray(self.seeds),
+                jnp.asarray(self._seed_streams),
+                jnp.asarray(self._seed_on),
+                (jnp.asarray(self.adapters)
+                 if self.model.n_adapters > 0 else None),
+            )
+        (temps_d, topks_d, topps_d, minps_d, pres_d, freqs_d, reps_d,
+         min_toks_d, seeds_d, streams_d, seed_on_d,
+         aids) = self._knob_cache
         biased = self._bias_live()
         minned = self._min_live()
         grammared = self._grammar_live()
@@ -1867,16 +1889,16 @@ class ServingEngine:
             self.model, n_steps, sampled, lp_k, pen, rep, seeded,
             biased, minned, grammared, self.params, self.cache,
             jnp.asarray(self.last_token), jnp.asarray(self.lens, jnp.int32),
-            jnp.asarray(self.temps), jnp.asarray(self.topks),
-            jnp.asarray(self.topps), jnp.asarray(self.minps),
-            jnp.asarray(self.pres), jnp.asarray(self.freqs),
-            jnp.asarray(self.reps), self._counts, self._seen,
-            self._bias, self._min_mask, jnp.asarray(self.min_toks),
+            temps_d, topks_d,
+            topps_d, minps_d,
+            pres_d, freqs_d,
+            reps_d, self._counts, self._seen,
+            self._bias, self._min_mask, min_toks_d,
             jnp.asarray([len(self.outputs[s])
                          for s in range(self.n_slots)], jnp.int32),
             gtable, jnp.asarray(self.gstate),
-            jnp.asarray(self.seeds), jnp.asarray(self._seed_streams),
-            jnp.asarray(self._seed_on),
+            seeds_d, streams_d,
+            seed_on_d,
             jnp.asarray(self._slot_draws, jnp.int32), aids,
             self._rng, jnp.int32(self._draws),
         )
@@ -1889,6 +1911,48 @@ class ServingEngine:
         out: Dict[int, List[int]] = {
             s: [] for s in range(self.n_slots) if self.active[s]
         }
+        if not sampled and not lp_k and not grammared:
+            # greedy/unconstrained harvest fast path (the serving hot
+            # path): nothing sampled means no draw accounting, no
+            # logprob harvest, no DFA walk — each slot's column
+            # processes at C speed instead of one Python branch pass
+            # per token per step.  Semantics identical to the general
+            # loop below (_maybe_finish checks per token in eos >
+            # stop > budget order; ties resolve the same way here
+            # because the stop scan excludes the eos index and the
+            # budget cut only applies strictly before any eos/stop).
+            for s in range(self.n_slots):
+                self.lens[s] += n_steps
+            eos = None if self.eos_id is None else int(self.eos_id)
+            for s in list(out):
+                col = toks[:, s].tolist()
+                fin = None  # (index, reason), earliest token wins
+                if eos is not None and not self._ignore_eos[s]:
+                    try:
+                        fin = (col.index(eos), "eos")
+                    except ValueError:
+                        pass
+                stops = self._stops[s]
+                if stops:
+                    for i, t in enumerate(
+                            col if fin is None else col[:fin[0]]):
+                        if t in stops:
+                            fin = (i, "stop")
+                            break
+                if self.max_new_tokens is not None:
+                    room = self.max_new_tokens - len(self.outputs[s])
+                    if room <= n_steps and (
+                            fin is None or room - 1 < fin[0]):
+                        fin = (room - 1, "length")
+                kept = col if fin is None else col[:fin[0] + 1]
+                self.outputs[s].extend(kept)
+                out[s] = kept
+                self._tokens += len(kept)
+                if kept:
+                    self.last_token[s] = kept[-1]
+                if fin is not None:
+                    self._finish(s, fin[1])
+            return out
         draws_used = 0
         for i in range(n_steps):
             # mirror step()'s draw accounting: a draw is consumed only
@@ -2016,3 +2080,4 @@ class ServingEngine:
         self._ignore_eos[slot] = False
         self._seed_on[slot] = 0
         self._lp_want[slot] = 0  # records stay readable post-finish
+        self._knob_cache = None  # device mirrors are stale now
