@@ -85,7 +85,7 @@ def test_gauge_transitions_when_chip_wedges(v5e8_copy):
         assert after[key] == 0
         assert after["tpu_exporter_unhealthy_chips"] == 1
         assert after[
-            'tpu_device_uncorrectable_errors{chip="0000:00:06.0"}'] == 5
+            'tpu_device_uncorrectable_errors_total{chip="0000:00:06.0"}'] == 5
         assert after["tpu_exporter_scrapes_total"] == 2
     finally:
         srv.stop()
@@ -187,7 +187,10 @@ def test_plugin_debug_metrics_route(testdata, tmp_path):
         assert s['tpu_plugin_rpc_total{resource="tpu",rpc="allocate"}'] == 2
         assert s['tpu_plugin_devices_healthy{resource="tpu"}'] == 8
         assert s['tpu_plugin_devices_unhealthy{resource="tpu"}'] == 0
-        assert s["tpu_plugin_degraded_bounds_allocations"] == 1
+        # renamed in PR 3 (promlint: counters end in _total)
+        assert s["tpu_plugin_degraded_bounds_allocations_total"] == 1
+        # Allocate latency histogram moved with the RPCs
+        assert s['tpu_plugin_allocate_seconds_count{resource="tpu"}'] == 2
     finally:
         debug.stop()
         manager.stop()

@@ -1,0 +1,165 @@
+"""CI metrics-lint: scrape EVERY /metrics surface in-process and run
+tools/promlint.py over the bodies — the acceptance gate that all four
+surfaces render promlint-clean exposition through the one obs.Registry
+renderer.  Runs inside the race-stress loop too, so scrapes race real
+traffic (handler threads, scheduler, pulse beats)."""
+
+import json
+import os
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tools.promlint import lint
+from tpu_k8s_device_plugin import obs
+
+pytestmark = pytest.mark.filterwarnings("ignore")
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ) as resp:
+        return resp.status, resp.read().decode()
+
+
+def _assert_clean(body, surface):
+    errs = lint(body)
+    assert not errs, f"{surface} /metrics fails promlint: {errs[:5]}"
+
+
+def test_plugin_debug_surface_lints(testdata, tmp_path):
+    """Plugin debug /metrics (surface 1) + the slice metric set
+    (surface 4, same scrape) lint clean with live RPC traffic."""
+    from fake_kubelet import FakeKubelet
+    from tpu_k8s_device_plugin.manager import PluginManager
+    from tpu_k8s_device_plugin.observability import DebugServer
+    from tpu_k8s_device_plugin.proto import deviceplugin_pb2 as pluginapi
+    from tpu_k8s_device_plugin.slice import SliceMetrics, SliceState
+    from tpu_k8s_device_plugin.tpu.device_impl import TpuContainerImpl
+
+    root = os.path.join(testdata, "v5e-8")
+    impl = TpuContainerImpl(
+        sysfs_root=os.path.join(root, "sys"),
+        dev_root=os.path.join(root, "dev"),
+        tpu_env_path=os.path.join(root, "run", "tpu", "tpu-env"),
+    )
+    kubelet = FakeKubelet(str(tmp_path / "device-plugins")).start()
+    registry = obs.Registry()
+    # the slice metric set rides the same registry the CLI would share
+    state = SliceState(expected_workers=2, jax_port=8476,
+                       metrics=SliceMetrics(registry))
+    registry.on_collect(lambda: state.refresh_ages(10.0))
+    state.join("host-a", coords=(0,), now=0.0)
+    state.join("host-b", coords=(1,), now=0.0)
+    state.heartbeat("host-a", healthy=False, reason="wedged", now=1.0)
+    state.heartbeat("host-b", healthy=True, now=2.0)
+    manager = PluginManager(impl, kubelet_dir=kubelet.dir,
+                            kubelet_watch_interval_s=0.1,
+                            registry=registry)
+    manager.run(block=False)
+    debug = DebugServer(manager, port=0).start()
+    try:
+        assert kubelet.wait_for_registration()
+        stub = kubelet.plugin_stub("google.com_tpu")
+        stub.Allocate(pluginapi.AllocateRequest(
+            container_requests=[pluginapi.ContainerAllocateRequest(
+                devices_ids=["0000:00:04.0"])]))
+        status, body = _get(debug.port, "/metrics")
+        assert status == 200
+        _assert_clean(body, "plugin-debug")
+        # both surfaces present in the one scrape
+        assert "tpu_plugin_rpc_total" in body
+        assert "tpu_plugin_allocate_seconds_bucket" in body
+        assert "tpu_slice_membership_transitions_total" in body
+        assert "tpu_slice_heartbeat_age_seconds" in body
+    finally:
+        debug.stop()
+        manager.stop()
+        kubelet.stop()
+
+
+def test_health_exporter_surface_lints(testdata):
+    """Exporter /metrics (surface 2) lints clean over the fixture
+    tree, including the probe-duration histogram."""
+    from tpu_k8s_device_plugin.health.metrics import MetricsHTTPServer
+
+    root = os.path.join(testdata, "v5e-8")
+    srv = MetricsHTTPServer(port=0, host="127.0.0.1",
+                            sysfs_root=os.path.join(root, "sys"),
+                            dev_root=os.path.join(root, "dev")).start()
+    try:
+        for _ in range(2):  # second scrape reuses the live registry
+            status, body = _get(srv.port, "/metrics")
+        assert status == 200
+        _assert_clean(body, "health-exporter")
+        assert "tpu_device_health{" in body
+        assert "tpu_exporter_probe_seconds_bucket" in body
+        assert "tpu_exporter_scrapes_total 2" in body
+    finally:
+        srv.stop()
+
+
+def test_serving_surface_lints():
+    """Serving /metrics (surface 3) lints clean with real traffic:
+    served requests, a shed 429, and the latency histograms."""
+    from tpu_k8s_device_plugin.workloads.inference import make_decoder
+    from tpu_k8s_device_plugin.workloads.server import EngineServer
+    from tpu_k8s_device_plugin.workloads.serving import ServingEngine
+
+    model = make_decoder(vocab=128, d_model=64, n_heads=4, n_layers=2,
+                         d_ff=128, max_len=64, dtype=jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+    params = model.init(rng, tokens, pos)["params"]
+    eng = ServingEngine(model, params, n_slots=2)
+    srv = EngineServer(eng, max_new_tokens=4, window=2)
+    srv.start(host="127.0.0.1", port=0)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/generate",
+            data=json.dumps({"tokens": [1, 2, 3],
+                             "stream": False}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert resp.status == 200
+        status, body = _get(srv.port, "/metrics")
+        assert status == 200
+        _assert_clean(body, "serving")
+        samples = obs.parse_exposition(body)
+        by = {(n, tuple(sorted(ls.items()))): v for n, ls, v in samples}
+        assert by[("tpu_serve_request_seconds_count",
+                   (("outcome", "ok"),))] >= 1
+        assert by[("tpu_serve_ttft_seconds_count", ())] >= 1
+        assert by[("tpu_serve_token_seconds_count", ())] >= 1
+        # bridged stats renamed with the counter suffix
+        assert by[("tpu_serving_requests_served_total", ())] >= 1
+        # percentile estimation works end to end on the scraped body
+        p95 = obs.histogram_quantile(samples, "tpu_serve_ttft_seconds",
+                                     0.95)
+        assert p95 == p95 and p95 >= 0
+    finally:
+        srv.stop()
+
+
+def test_slice_registry_lints_standalone():
+    """The slice metric set lints clean on its own registry (the
+    bare-grpc deployment shape, no manager around it)."""
+    from tpu_k8s_device_plugin.slice import SliceMetrics, SliceState
+
+    metrics = SliceMetrics()
+    state = SliceState(expected_workers=2, jax_port=8476,
+                       heartbeat_timeout_s=5.0, metrics=metrics)
+    state.join("b-host", coords=(1,), now=0.0)
+    state.join("a-host", coords=(0,), now=0.0)
+    state.heartbeat("a-host", healthy=True, now=1.0)
+    state.heartbeat("b-host", healthy=False, reason="sysfs", now=1.5)
+    state.heartbeat("a-host", healthy=True, now=2.0)
+    state.heartbeat("b-host", healthy=True, now=3.0)
+    state.refresh_ages(now=4.0)
+    body = metrics.registry.render()
+    _assert_clean(body, "slice")
+    assert "tpu_slice_demotion_propagation_seconds_bucket" in body

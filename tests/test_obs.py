@@ -1,0 +1,188 @@
+"""obs core unit tests: registry/renderer invariants (promlint-clean by
+construction), histogram bucketing + quantile estimation, span timing,
+and the exposition parser the bench reads percentiles back through."""
+
+import logging
+import math
+import threading
+
+import pytest
+
+from tools.promlint import lint
+from tpu_k8s_device_plugin import obs
+
+
+def test_counter_requires_total_suffix():
+    r = obs.Registry()
+    with pytest.raises(ValueError):
+        r.counter("tpu_things", "Things.")
+    c = r.counter("tpu_things_total", "Things.")
+    c.inc()
+    c.inc(2)
+    assert c.value == 3
+
+
+def test_kind_and_label_mismatch_raise():
+    r = obs.Registry()
+    r.gauge("tpu_x", "X.", ("a",))
+    with pytest.raises(ValueError):
+        r.counter("tpu_x", "X.")  # kind drift
+    with pytest.raises(ValueError):
+        r.gauge("tpu_x", "X.", ("b",))  # label drift
+    # same signature returns the same family
+    assert r.gauge("tpu_x", "X.", ("a",)) is r.gauge("tpu_x", "X.", ("a",))
+
+
+def test_labels_must_match_declared_names():
+    r = obs.Registry()
+    c = r.counter("tpu_y_total", "Y.", ("kind",))
+    with pytest.raises(ValueError):
+        c.labels(wrong="x")
+    c.labels(kind="x").inc()
+    assert c.labels(kind="x").value == 1
+
+
+def test_render_is_promlint_clean_and_escaped():
+    r = obs.Registry()
+    r.counter("tpu_esc_total", "Weird \\ help\nline.", ("v",)).labels(
+        v='quote " backslash \\ newline \n done').inc()
+    r.gauge("tpu_esc_up", "Up.").set(1)
+    h = r.histogram("tpu_esc_seconds", "H.", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(50)
+    text = r.render()
+    assert lint(text) == []
+    # parse round-trip recovers the escaped label value
+    samples = obs.parse_exposition(text)
+    (labels,) = [ls for n, ls, _ in samples if n == "tpu_esc_total"]
+    assert labels["v"] == 'quote " backslash \\ newline \n done'
+
+
+def test_histogram_buckets_and_quantiles():
+    r = obs.Registry()
+    h = r.histogram("tpu_q_seconds", "Q.", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0):
+        h.observe(v)
+    h.observe_n(20.0, 2)  # bulk observe lands in +Inf
+    samples = obs.parse_exposition(r.render())
+    by = {(n, ls.get("le")): v for n, ls, v in samples}
+    assert by[("tpu_q_seconds_bucket", "0.1")] == 1
+    assert by[("tpu_q_seconds_bucket", "1")] == 3
+    assert by[("tpu_q_seconds_bucket", "10")] == 4
+    assert by[("tpu_q_seconds_bucket", "+Inf")] == 6
+    assert by[("tpu_q_seconds_count", None)] == 6
+    # interpolated median: target 3 of 6 → upper edge of the (0.1, 1]
+    # bucket
+    assert obs.histogram_quantile(samples, "tpu_q_seconds", 0.5) == \
+        pytest.approx(1.0)
+    # q=1 lands in +Inf → clamps to the highest finite bound
+    assert obs.histogram_quantile(samples, "tpu_q_seconds", 1.0) == 10.0
+    # absent series → NaN
+    assert math.isnan(obs.histogram_quantile(samples, "tpu_nope", 0.5))
+
+
+def test_histogram_quantile_label_filter_and_aggregate():
+    r = obs.Registry()
+    h = r.histogram("tpu_o_seconds", "O.", ("outcome",), buckets=(1.0,))
+    h.labels(outcome="ok").observe(0.5)
+    h.labels(outcome="error").observe(100.0)
+    samples = obs.parse_exposition(r.render())
+    assert obs.histogram_quantile(
+        samples, "tpu_o_seconds", 0.5, match={"outcome": "ok"}) <= 1.0
+    # unfiltered aggregates both children
+    agg = obs.histogram_quantile(samples, "tpu_o_seconds", 0.99)
+    assert agg == 1.0  # +Inf clamps to highest finite bound
+
+
+def test_clear_drops_stale_series():
+    r = obs.Registry()
+    g = r.gauge("tpu_stale", "S.", ("chip",))
+    g.labels(chip="a").set(1)
+    g.clear()
+    g.labels(chip="b").set(1)
+    text = r.render()
+    assert 'chip="a"' not in text and 'chip="b"' in text
+
+
+def test_collector_runs_at_render_and_failures_are_contained():
+    r = obs.Registry()
+    g = r.gauge("tpu_fresh", "F.")
+    r.on_collect(lambda: g.set(42))
+
+    def boom():
+        raise RuntimeError("collector bug")
+
+    r.on_collect(boom)
+    text = r.render()  # must not raise
+    assert "tpu_fresh 42" in text
+
+
+def test_concurrent_observes_keep_totals_consistent():
+    r = obs.Registry()
+    c = r.counter("tpu_conc_total", "C.")
+    h = r.histogram("tpu_conc_seconds", "H.", buckets=(0.5,))
+
+    def work():
+        for _ in range(1000):
+            c.inc()
+            h.observe(0.1)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8000
+    samples = obs.parse_exposition(r.render())
+    by = {(n, ls.get("le")): v for n, ls, v in samples}
+    assert by[("tpu_conc_seconds_count", None)] == 8000
+    assert by[("tpu_conc_seconds_bucket", "+Inf")] == 8000
+    assert lint(r.render()) == []
+
+
+def test_span_observes_histogram_and_logs_request_id(caplog):
+    r = obs.Registry()
+    h = r.histogram("tpu_span_seconds", "S.", ("outcome",),
+                    buckets=(60.0,))
+    logger = logging.getLogger("test.span")
+    with caplog.at_level(logging.DEBUG, logger="test.span"):
+        with obs.span("demo_op", histogram=h, request_id="req-7",
+                      logger=logger) as sp:
+            sp.annotate(items=3)
+    line = next(rec.message for rec in caplog.records
+                if "span=demo_op" in rec.message)
+    assert "request_id=req-7" in line
+    assert "outcome=ok" in line and "items=3" in line
+    samples = obs.parse_exposition(r.render())
+    by = {(n, ls.get("outcome")): v for n, ls, v in samples}
+    assert by[("tpu_span_seconds_count", "ok")] == 1
+
+
+def test_span_error_outcome_and_idempotent_end():
+    r = obs.Registry()
+    h = r.histogram("tpu_span2_seconds", "S.", ("outcome",),
+                    buckets=(60.0,))
+    with pytest.raises(RuntimeError):
+        with obs.span("failing", histogram=h):
+            raise RuntimeError("boom")
+    sp = obs.Span("twice", histogram=h)
+    sp.end(outcome="ok")
+    sp.end(outcome="ok")  # second end must not re-observe
+    samples = obs.parse_exposition(r.render())
+    by = {(n, ls.get("outcome")): v for n, ls, v in samples}
+    assert by[("tpu_span2_seconds_count", "error")] == 1
+    assert by[("tpu_span2_seconds_count", "ok")] == 1
+
+
+def test_promlint_rejects_the_old_renderer_mistakes():
+    """The violations PR 3's satellite fixed must actually be caught:
+    TYPE-without-HELP and counters without _total (the old impl-counter
+    rendering), and histograms missing +Inf."""
+    old_style = ("# TYPE tpu_plugin_degraded_bounds_allocations counter\n"
+                 "tpu_plugin_degraded_bounds_allocations 1\n")
+    errs = lint(old_style)
+    assert any("(C1)" in e for e in errs)
+    assert any("(H1)" in e for e in errs)
+    no_inf = ("# HELP h H.\n# TYPE h histogram\n"
+              'h_bucket{le="1"} 1\nh_sum 1\nh_count 1\n')
+    assert any("(B2)" in e for e in lint(no_inf))

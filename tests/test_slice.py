@@ -354,3 +354,58 @@ def test_membership_file_round_trip(tmp_path, coordinator, hosts):
     # coordinator's own copy matches too
     assert load_membership(coordinator.state.state_path) == \
         hosts[0].client.membership
+
+
+def test_slice_metrics_move_across_member_death():
+    """PR 3 observability satellite: across a simulated member death
+    (heartbeats stop, the staleness timeout demotes it), the rendered
+    heartbeat-age gauge must GROW for the dead member and the
+    membership-transition counters must record the demotion — and
+    recovery must move them again the other way."""
+    from tools.promlint import lint
+    from tpu_k8s_device_plugin import obs
+    from tpu_k8s_device_plugin.slice import SliceMetrics
+
+    metrics = SliceMetrics()
+    reg = metrics.registry
+    s = SliceState(expected_workers=2, jax_port=_JAX_PORT,
+                   heartbeat_timeout_s=5.0, metrics=metrics)
+    s.join("host-a", coords=(0,), session="a1", now=0.0)
+    s.join("host-b", coords=(1,), session="b1", now=0.0)
+    s.heartbeat("host-a", healthy=True, now=1.0)
+    s.heartbeat("host-b", healthy=True, now=1.0)
+
+    def series(now):
+        s.refresh_ages(now)
+        samples = obs.parse_exposition(reg.render())
+        return {(n, tuple(sorted(ls.items()))): v
+                for n, ls, v in samples}
+
+    before = series(now=2.0)
+    assert before[("tpu_slice_membership_transitions_total",
+                   (("kind", "formed"),))] == 1
+    age_key = ("tpu_slice_heartbeat_age_seconds",
+               (("hostname", "host-b"),))
+    assert before[age_key] == 1.0  # last heard at t=1
+
+    # host-b dies: only host-a keeps beating; past the 5s timeout the
+    # verdict flips and host-a's next heartbeat DELIVERS the demotion
+    v = s.heartbeat("host-a", healthy=True, now=9.0)
+    assert not v.slice_healthy and v.unhealthy_hostnames == ["host-b"]
+    dead = series(now=9.0)
+    assert dead[age_key] == 8.0  # age grew with the silence
+    assert dead[("tpu_slice_membership_transitions_total",
+                 (("kind", "slice_demoted"),))] == 1
+    # propagation observed for host-a (its heartbeat after the flip)
+    assert dead[("tpu_slice_demotion_propagation_seconds_count",
+                 ())] >= 1
+
+    # host-b comes back: age snaps down, recovery transition recorded
+    v = s.heartbeat("host-b", healthy=True, now=10.0)
+    assert v.slice_healthy
+    back = series(now=10.5)
+    assert back[age_key] == 0.5
+    assert back[("tpu_slice_membership_transitions_total",
+                 (("kind", "slice_recovered"),))] == 1
+    # the slice surface stays promlint-clean while it moves
+    assert lint(reg.render()) == []

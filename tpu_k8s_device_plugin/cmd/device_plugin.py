@@ -89,8 +89,15 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--debug-port", type=int, default=0, metavar="PORT",
-        help="serve /healthz, /debug/status, /debug/threads on loopback "
-             "at PORT; 0 disables (default)",
+        help="serve /healthz, /debug/status, /debug/threads, /metrics "
+             "on --debug-host at PORT; 0 disables (default)",
+    )
+    p.add_argument(
+        "--debug-host", default="127.0.0.1", metavar="ADDR",
+        help="bind address for --debug-port (default loopback; set "
+             "0.0.0.0 so Prometheus can scrape /metrics from the pod "
+             "IP — the debug surface has no auth, so only widen it on "
+             "a trusted pod network)",
     )
     p.add_argument("-v", "--verbose", action="count", default=0)
     p.add_argument("--version", action="version", version=__version__)
@@ -149,11 +156,13 @@ def _metadata_coords(topo):
     return ()
 
 
-def setup_slice(args, impl, driver_type):
+def setup_slice(args, impl, driver_type, registry=None):
     """Wire slice coordination when --slice-rendezvous is set: serve the
     coordinator if this is the named host, attach a client to the impl,
-    start its background join+heartbeat loop.  Returns
-    (coordinator|None, client|None)."""
+    start its background join+heartbeat loop.  *registry* (the node's
+    obs.Registry) turns the slice metrics set on — the plugin debug
+    /metrics scrape then carries join/heartbeat/membership series.
+    Returns (coordinator|None, client|None)."""
     from tpu_k8s_device_plugin.slice import SliceClient, SliceCoordinator
 
     address = args.slice_rendezvous
@@ -183,6 +192,7 @@ def setup_slice(args, impl, driver_type):
             expected_workers=args.slice_workers,
             bind_address=f"[::]:{port_s}",
             state_path=args.slice_state_file,
+            registry=registry,
         ).start()
         log.info("this host (%s) serves the slice rendezvous", hostname)
     client = SliceClient(
@@ -192,6 +202,7 @@ def setup_slice(args, impl, driver_type):
         chip_count=len(impl.chips),
         state_path=args.slice_state_file,
         local_health_fn=impl.local_health,
+        registry=registry,
     )
     impl.set_slice_client(client)
     client.start()
@@ -224,20 +235,28 @@ def main(argv=None) -> int:
     log.info("driver=%s resources=%s", driver_type,
              [f"{constants.RESOURCE_NAMESPACE}/{r}" for r in resources])
 
+    # the node's ONE metrics registry: plugin histograms, slice
+    # metrics, and the debug /metrics surface all render from it
+    from tpu_k8s_device_plugin import obs
+    registry = obs.Registry()
+
     coordinator = client = None
     if args.slice_rendezvous:
-        coordinator, client = setup_slice(args, impl, driver_type)
+        coordinator, client = setup_slice(args, impl, driver_type,
+                                          registry=registry)
 
     manager = PluginManager(
         impl,
         pulse_seconds=args.pulse,
         kubelet_dir=args.kubelet_dir,
         slice_client=client,
+        registry=registry,
     )
     debug_server = None
     if args.debug_port:
         from tpu_k8s_device_plugin.observability import DebugServer
-        debug_server = DebugServer(manager, args.debug_port).start()
+        debug_server = DebugServer(manager, args.debug_port,
+                                   host=args.debug_host).start()
     # k8s sends SIGTERM on pod shutdown; route it through the same cleanup
     # path as Ctrl-C so streams get the stop signal and the endpoint socket
     # is unlinked (≈ main.go signal handling)

@@ -6,19 +6,21 @@ the reference's health client dials
 (/root/reference/internal/pkg/exporter/health.go:35-37) and the health
 RPC is one service on it.  Round 3 shipped the gRPC health half only;
 this module adds the Prometheus half: a ``/metrics`` HTTP endpoint with
-per-chip health gauges and error counters, hand-rendered in the text
-exposition format (no client-library registry state to leak between
-tests).
+per-chip health gauges and error counters, rendered through the repo's
+shared :mod:`tpu_k8s_device_plugin.obs` registry (each server owns its
+own Registry instance, so no client-library-style global state leaks
+between tests).
 
-Exported series:
+Exported series (full reference: docs/user-guide/observability.md):
 
 - ``tpu_device_health{chip,device} 0|1`` — per-chip gauge, same probe
   as the gRPC health RPC (sysfs chip_state / UE count / node stat)
-- ``tpu_device_uncorrectable_errors{chip}`` — driver-reported fatal
-  error count (present only when the sysfs attr exists)
+- ``tpu_device_uncorrectable_errors_total{chip}`` — driver-reported
+  fatal error count (present only when the sysfs attr exists)
 - ``tpu_exporter_chips`` / ``tpu_exporter_unhealthy_chips`` — node
   rollups so one scrape answers "is this node degraded"
 - ``tpu_exporter_scrapes_total`` — exporter liveness
+- ``tpu_exporter_probe_seconds`` — probe-walk latency histogram
 """
 
 from __future__ import annotations
@@ -26,9 +28,11 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from tpu_k8s_device_plugin import obs
 from tpu_k8s_device_plugin.tpu import discovery, sysfs
 from tpu_k8s_device_plugin.types import constants
 
@@ -36,10 +40,10 @@ from .server import granular_health_available, probe_chip_states
 
 log = logging.getLogger(__name__)
 
-
-def _escape(v: str) -> str:
-    """Prometheus label-value escaping (backslash, quote, newline)."""
-    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+# label escaping lives in obs now (it used to be private here, and the
+# plugin debug renderer reached in for it); kept as an alias for any
+# external importer of the old name
+_escape = obs.escape_label_value
 
 
 def read_ue_count(sysfs_root: str, pci_address: str) -> Optional[int]:
@@ -57,56 +61,62 @@ def read_ue_count(sysfs_root: str, pci_address: str) -> Optional[int]:
 
 
 def render_metrics(sysfs_root: str = "/sys", dev_root: str = "/dev",
-                   scrapes: int = 0) -> str:
-    """One scrape: probe every chip and render the exposition text."""
+                   scrapes: int = 0,
+                   registry: Optional[obs.Registry] = None) -> str:
+    """One scrape: probe every chip and render the exposition text
+    through the shared :class:`obs.Registry` renderer.
+
+    *registry* keeps instruments alive across scrapes (the HTTP server
+    passes its own, so the probe-duration histogram accumulates); bare
+    calls get a fresh one — no state leaks between tests.
+
+    Rename (PR 3, promlint): ``tpu_device_uncorrectable_errors`` is now
+    ``tpu_device_uncorrectable_errors_total`` (counters must end in
+    ``_total``)."""
+    reg = registry if registry is not None else obs.Registry()
+    t0 = time.perf_counter()
     chips, _ = discovery.get_tpu_chips(sysfs_root, dev_root, "/nonexistent")
     states = probe_chip_states(sysfs_root, dev_root, chips=chips)
-    lines = [
-        "# HELP tpu_device_health Per-chip health (1 healthy, 0 unhealthy).",
-        "# TYPE tpu_device_health gauge",
-    ]
+    probe_dt = time.perf_counter() - t0
+
+    health = reg.gauge(
+        "tpu_device_health", "Per-chip health (1 healthy, 0 unhealthy).",
+        ("chip", "device"))
+    ue = reg.counter(
+        "tpu_device_uncorrectable_errors_total",
+        "Driver-reported fatal error count.", ("chip",))
+    # per-chip label sets rebuild from scratch: an unplugged chip must
+    # not leave a stale series in a long-lived registry
+    health.clear()
+    ue.clear()
     unhealthy = 0
     for cid in sorted(states):
         st = states[cid]
         up = 1 if st.health == "Healthy" else 0
         unhealthy += 1 - up
-        lines.append(
-            f'tpu_device_health{{chip="{_escape(cid)}",'
-            f'device="{_escape(st.device)}"}} {up}')
-    ue_lines = []
-    for cid in sorted(states):
+        health.labels(chip=cid, device=st.device).set(up)
         chip = chips.get(cid)
-        if chip is None:
-            continue
-        ue = read_ue_count(sysfs_root, chip.pci_address)
-        if ue is not None:
-            ue_lines.append(
-                f'tpu_device_uncorrectable_errors{{chip="{_escape(cid)}"}}'
-                f" {ue}")
-    if ue_lines:
-        lines += [
-            "# HELP tpu_device_uncorrectable_errors Driver-reported fatal "
-            "error count.",
-            "# TYPE tpu_device_uncorrectable_errors counter",
-            *ue_lines,
-        ]
-    lines += [
-        "# HELP tpu_exporter_granular_health Driver exposes chip_state/"
-        "UE attrs (0 = wedged-chip detection degraded to node stats).",
-        "# TYPE tpu_exporter_granular_health gauge",
-        "tpu_exporter_granular_health "
-        f"{1 if chips and granular_health_available(sysfs_root, chips) else 0}",
-        "# HELP tpu_exporter_chips Chips the exporter probes.",
-        "# TYPE tpu_exporter_chips gauge",
-        f"tpu_exporter_chips {len(states)}",
-        "# HELP tpu_exporter_unhealthy_chips Chips currently unhealthy.",
-        "# TYPE tpu_exporter_unhealthy_chips gauge",
-        f"tpu_exporter_unhealthy_chips {unhealthy}",
-        "# HELP tpu_exporter_scrapes_total Scrapes served.",
-        "# TYPE tpu_exporter_scrapes_total counter",
-        f"tpu_exporter_scrapes_total {scrapes}",
-    ]
-    return "\n".join(lines) + "\n"
+        if chip is not None:
+            n = read_ue_count(sysfs_root, chip.pci_address)
+            if n is not None:
+                ue.labels(chip=cid)._set(n)
+    reg.gauge(
+        "tpu_exporter_granular_health",
+        "Driver exposes chip_state/UE attrs (0 = wedged-chip detection "
+        "degraded to node stats).",
+    ).set(1 if chips and granular_health_available(sysfs_root, chips)
+          else 0)
+    reg.gauge("tpu_exporter_chips", "Chips the exporter probes.").set(
+        len(states))
+    reg.gauge("tpu_exporter_unhealthy_chips",
+              "Chips currently unhealthy.").set(unhealthy)
+    reg.counter("tpu_exporter_scrapes_total", "Scrapes served.")._set(
+        scrapes)
+    reg.histogram(
+        "tpu_exporter_probe_seconds",
+        "One full probe walk (discovery + per-chip sysfs state).",
+        buckets=obs.FAST_BUCKETS_S).observe(probe_dt)
+    return reg.render()
 
 
 class MetricsHTTPServer:
@@ -123,6 +133,9 @@ class MetricsHTTPServer:
         self._scrapes = 0
         self._lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
+        # persistent across scrapes so the probe-duration histogram
+        # accumulates a real distribution
+        self.registry = obs.Registry()
 
     @property
     def port(self) -> int:
@@ -144,10 +157,12 @@ class MetricsHTTPServer:
                     n = outer._scrapes
                 try:
                     body = render_metrics(
-                        outer._sysfs_root, outer._dev_root, scrapes=n)
-                except Exception as e:  # scrape must not kill the daemon
+                        outer._sysfs_root, outer._dev_root, scrapes=n,
+                        registry=outer.registry)
+                except Exception:  # scrape must not kill the daemon
                     log.exception("metrics scrape failed")
-                    self._send(500, "text/plain", f"scrape failed: {e}\n")
+                    self._send(500, "text/plain",
+                               "scrape failed; see exporter logs\n")
                     return
                 self._send(200,
                            "text/plain; version=0.0.4; charset=utf-8",

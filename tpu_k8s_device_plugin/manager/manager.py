@@ -31,8 +31,10 @@ from typing import Dict, List, Optional
 
 import grpc
 
+from tpu_k8s_device_plugin import obs
 from tpu_k8s_device_plugin.allocator import BestEffortPolicy
 from tpu_k8s_device_plugin.plugin import TpuDevicePlugin
+from tpu_k8s_device_plugin.plugin.plugin import PluginMetrics
 from tpu_k8s_device_plugin.proto import (
     deviceplugin_pb2 as pluginapi,
     deviceplugin_pb2_grpc as pluginapi_grpc,
@@ -104,10 +106,20 @@ class PluginManager:
         resource_namespace: str = constants.RESOURCE_NAMESPACE,
         kubelet_watch_interval_s: float = 1.0,
         slice_client=None,
+        registry: Optional[obs.Registry] = None,
     ):
         self.impl = device_impl
         self.pulse = pulse_seconds
         self.kubelet_dir = kubelet_dir
+        # the node's ONE metrics registry: plugin latency histograms,
+        # pulse rounds, slice metrics (when the CLI shares it), and the
+        # debug endpoint's bridged status snapshot all render from here
+        self.registry = registry if registry is not None else obs.Registry()
+        self._plugin_metrics = PluginMetrics(self.registry)
+        self._m_pulse = self.registry.histogram(
+            "tpu_plugin_pulse_round_seconds",
+            "One pulse round: rediscovery + slice heartbeat + "
+            "plugin beats.", buckets=obs.LATENCY_BUCKETS_S)
         # optional multi-host slice client: the pulse loop heartbeats it
         # BEFORE beating the plugins, so each ListAndWatch resend already
         # reflects this round's local probe and the peers' latest verdict
@@ -211,7 +223,8 @@ class PluginManager:
             if self._stop.is_set():
                 return
             ctx = DevicePluginContext(resource, BestEffortPolicy())
-            plugin = TpuDevicePlugin(self.impl, ctx)
+            plugin = TpuDevicePlugin(self.impl, ctx,
+                                     metrics=self._plugin_metrics)
             plugin.start()
             sp = _ServedPlugin(
                 resource,
@@ -338,20 +351,23 @@ class PluginManager:
         after a rediscovery is what pushes the changed device list down
         every open ListAndWatch stream."""
         while not self._stop.wait(self.pulse):
-            self._maybe_rediscover()
-            if self.slice_client is not None:
-                # heartbeat first: ships the fresh local probe to the
-                # coordinator and pulls the slice verdict this round's
-                # update_health frames will render (one wedged chip
-                # anywhere reaches every member within one pulse+heartbeat)
-                try:
-                    self.slice_client.heartbeat_now()
-                except Exception as e:
-                    log.warning("slice heartbeat failed: %s", e)
-            with self._plugins_lock:
-                plugins = list(self._plugins.values())
-            for sp in plugins:
-                sp.plugin.beat()
+            with obs.span("tpu_plugin_pulse_round",
+                          histogram=self._m_pulse, logger=log):
+                self._maybe_rediscover()
+                if self.slice_client is not None:
+                    # heartbeat first: ships the fresh local probe to the
+                    # coordinator and pulls the slice verdict this round's
+                    # update_health frames will render (one wedged chip
+                    # anywhere reaches every member within one
+                    # pulse+heartbeat)
+                    try:
+                        self.slice_client.heartbeat_now()
+                    except Exception as e:
+                        log.warning("slice heartbeat failed: %s", e)
+                with self._plugins_lock:
+                    plugins = list(self._plugins.values())
+                for sp in plugins:
+                    sp.plugin.beat()
 
     def _maybe_rediscover(self) -> None:
         """Runtime resource rediscovery (≈ dpm ResUpdateChan consumption,

@@ -1,0 +1,38 @@
+"""Unified metrics core: one registry, one renderer, spans.
+
+Every Prometheus surface in this repo (plugin debug endpoint, health
+exporter, serving server, slice metrics) renders through
+:class:`Registry`; see :mod:`.core` for the design notes and
+``docs/user-guide/observability.md`` for the full series reference.
+"""
+
+from .core import (
+    FAST_BUCKETS_S,
+    LATENCY_BUCKETS_S,
+    SLOW_BUCKETS_S,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    escape_help,
+    escape_label_value,
+    histogram_quantile,
+    parse_exposition,
+)
+from .span import Span, span
+
+__all__ = [
+    "FAST_BUCKETS_S",
+    "LATENCY_BUCKETS_S",
+    "SLOW_BUCKETS_S",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "Span",
+    "escape_help",
+    "escape_label_value",
+    "histogram_quantile",
+    "parse_exposition",
+    "span",
+]

@@ -1,0 +1,496 @@
+"""Dependency-free metrics core: the ONE registry/renderer every HTTP
+surface in this repo exposes Prometheus metrics through.
+
+Before this module the repo carried three divergent hand-rolled text
+renderers (plugin debug endpoint, health exporter, serving server), no
+histograms, and a cross-module private import for label escaping.  This
+is the common substrate they all rewire onto:
+
+- labeled :class:`Counter` / :class:`Gauge` / :class:`Histogram`
+  families with fixed bucket schemes,
+- a thread-safe :class:`Registry` with get-or-create instrument
+  constructors and render-time collector callbacks,
+- one promlint-clean text-exposition renderer (``# HELP`` + ``# TYPE``
+  for every family, counters forced to end in ``_total``, histogram
+  ``_bucket``/``_sum``/``_count`` triples with a ``+Inf`` bucket),
+- parsing + quantile-estimation helpers so benchmarks and tests can
+  read latency percentiles back out of a scraped exposition body.
+
+Stdlib only, by design: the exporter daemon and slice layer must stay
+importable on a bare grpc+protobuf image, and client-library registry
+state must never leak between tests (every surface owns its Registry
+instance; there is deliberately NO process-global default registry).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import re
+import threading
+from bisect import bisect_left
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+_METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+# Fixed bucket schemes (seconds).  Shared across surfaces so the same
+# dashboard query shape works on every histogram; pick by time scale:
+#
+# FAST_BUCKETS_S   sub-millisecond .. 1s: per-token decode, stream
+#                  writes, ListAndWatch frame builds, sysfs probes
+# LATENCY_BUCKETS_S  1ms .. 60s: request latency, TTFT, queue wait
+# SLOW_BUCKETS_S   100ms .. 10min: slice join/formation
+FAST_BUCKETS_S = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+    0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+)
+LATENCY_BUCKETS_S = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+    0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+SLOW_BUCKETS_S = (
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0,
+)
+
+
+def escape_label_value(v: str) -> str:
+    """Prometheus label-value escaping (backslash, quote, newline).
+
+    The one copy of the escaping rule: ``health.metrics`` and the
+    plugin debug renderer used to each carry their own (one reaching
+    into the other's private ``_escape``)."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace(
+        "\n", "\\n")
+
+
+def escape_help(v: str) -> str:
+    """HELP-line escaping (backslash and newline only, per exposition
+    format — quotes are legal in help text)."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt_value(v: float) -> str:
+    """Sample value formatting: integers render bare (promtool-friendly
+    and diff-stable), floats via repr (full precision)."""
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f in (math.inf, -math.inf):
+        return "+Inf" if f > 0 else "-Inf"
+    if f.is_integer() and abs(f) < 2**53:
+        return str(int(f))
+    return repr(f)
+
+
+def _fmt_le(bound: float) -> str:
+    """``le`` label formatting: +Inf for the top bucket, shortest exact
+    decimal otherwise (0.005, not 0.005000000000000001)."""
+    if bound == math.inf:
+        return "+Inf"
+    return format(bound, "g")
+
+
+class _Child:
+    """One labeled series of a counter/gauge family."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self._value = 0.0
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters can only go up; use a Gauge")
+        with self._lock:
+            self._value += amount
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    # counters bridging pre-existing monotonic ints (engine stats, RPC
+    # count dicts) adopt the externally-tracked total at render time
+    _set = set
+
+
+class _HistChild:
+    """One labeled series of a histogram family."""
+
+    __slots__ = ("_lock", "_bounds", "_counts", "_sum", "_count")
+
+    def __init__(self, lock: threading.Lock, bounds: Tuple[float, ...]):
+        self._lock = lock
+        self._bounds = bounds                 # includes trailing +Inf
+        self._counts = [0] * len(bounds)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        self.observe_n(value, 1)
+
+    def observe_n(self, value: float, n: int) -> None:
+        """Record *n* observations of *value* under one lock hop — the
+        per-window token path records a whole window at once."""
+        if n < 1:
+            return
+        i = bisect_left(self._bounds, value)
+        with self._lock:
+            self._counts[i] += n
+            self._sum += value * n
+            self._count += n
+
+    def snapshot(self) -> Tuple[List[int], float, int]:
+        with self._lock:
+            return list(self._counts), self._sum, self._count
+
+
+class _Family:
+    """Base: one metric family (name, help, kind, label names)."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str,
+                 labelnames: Tuple[str, ...] = ()):
+        if not _METRIC_NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        if not help:
+            raise ValueError(f"metric {name} needs non-empty help text")
+        for ln in labelnames:
+            if not _LABEL_NAME_RE.match(ln) or ln.startswith("__"):
+                raise ValueError(f"invalid label name {ln!r} on {name}")
+            if ln == "le" and self.kind == "histogram":
+                raise ValueError("'le' is reserved on histograms")
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], object] = {}
+
+    def _make_child(self):
+        raise NotImplementedError
+
+    def labels(self, **kv):
+        """Get-or-create the child for one label-value combination."""
+        if set(kv) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name} takes labels {self.labelnames}, "
+                f"got {tuple(sorted(kv))}")
+        key = tuple(str(kv[ln]) for ln in self.labelnames)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = self._make_child()
+            return child
+
+    def clear(self) -> None:
+        """Drop every child — for snapshot-style families whose label
+        sets are rebuilt from scratch each scrape (per-chip health,
+        per-member heartbeat age): a vanished chip must not leave a
+        stale series behind."""
+        with self._lock:
+            self._children.clear()
+
+    def _default(self):
+        return self.labels(**{})
+
+    def _sorted_children(self):
+        with self._lock:
+            return sorted(self._children.items())
+
+
+class Counter(_Family):
+    """Monotonic counter family.  Names MUST end in ``_total`` — the
+    renderer is promlint-clean by construction, not by review."""
+
+    kind = "counter"
+
+    def __init__(self, name, help, labelnames=()):
+        if not name.endswith("_total"):
+            raise ValueError(
+                f"counter {name!r} must end in '_total' (promlint)")
+        super().__init__(name, help, labelnames)
+
+    def _make_child(self):
+        return _Child(threading.Lock())
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default().inc(amount)
+
+    def _set(self, value: float) -> None:
+        """Adopt an externally-tracked monotonic total (bridge path for
+        counters whose source of truth predates the registry)."""
+        self._default()._set(value)
+
+    @property
+    def value(self) -> float:
+        return self._default().value
+
+    def render(self, out: List[str]) -> None:
+        for key, child in self._sorted_children():
+            out.append(_sample(self.name, self.labelnames, key,
+                               child.value))
+
+
+class Gauge(_Family):
+    kind = "gauge"
+
+    def _make_child(self):
+        return _Child(threading.Lock())
+
+    def set(self, value: float) -> None:
+        self._default().set(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        child = self._default()
+        with child._lock:
+            child._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._default().value
+
+    def render(self, out: List[str]) -> None:
+        for key, child in self._sorted_children():
+            out.append(_sample(self.name, self.labelnames, key,
+                               child.value))
+
+
+class Histogram(_Family):
+    kind = "histogram"
+
+    def __init__(self, name, help, labelnames=(),
+                 buckets: Iterable[float] = LATENCY_BUCKETS_S):
+        bounds = tuple(sorted(set(float(b) for b in buckets)))
+        if not bounds:
+            raise ValueError(f"histogram {name} needs >= 1 bucket")
+        if any(b != b for b in bounds):
+            raise ValueError(f"NaN bucket bound on {name}")
+        if bounds[-1] != math.inf:
+            bounds = bounds + (math.inf,)
+        self.buckets = bounds
+        super().__init__(name, help, labelnames)
+
+    def _make_child(self):
+        return _HistChild(threading.Lock(), self.buckets)
+
+    def observe(self, value: float) -> None:
+        self._default().observe(value)
+
+    def observe_n(self, value: float, n: int) -> None:
+        self._default().observe_n(value, n)
+
+    def render(self, out: List[str]) -> None:
+        for key, child in self._sorted_children():
+            counts, total, count = child.snapshot()
+            cum = 0
+            for bound, c in zip(self.buckets, counts):
+                cum += c
+                out.append(_sample(
+                    self.name + "_bucket",
+                    self.labelnames + ("le",),
+                    key + (_fmt_le(bound),), cum))
+            out.append(_sample(self.name + "_sum", self.labelnames,
+                               key, total))
+            out.append(_sample(self.name + "_count", self.labelnames,
+                               key, count))
+
+
+def _sample(name: str, labelnames: Tuple[str, ...],
+            labelvalues: Tuple[str, ...], value: float) -> str:
+    if labelnames:
+        body = ",".join(
+            f'{ln}="{escape_label_value(lv)}"'
+            for ln, lv in zip(labelnames, labelvalues))
+        return f"{name}{{{body}}} {_fmt_value(value)}"
+    return f"{name} {_fmt_value(value)}"
+
+
+class Registry:
+    """Thread-safe family registry + the one exposition renderer.
+
+    Instrument constructors are get-or-create: asking twice for the
+    same (name, kind) returns the same family, so a coordinator and a
+    client sharing a process share series instead of colliding.  Kind
+    or label-set mismatches on an existing name raise — silent type
+    drift is how the three old renderers diverged.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+        self._collectors: List[Callable[[], None]] = []
+
+    def _get_or_create(self, cls, name, help, labelnames, **kw):
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if not isinstance(fam, cls) or \
+                        fam.labelnames != tuple(labelnames):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{fam.kind}{fam.labelnames}")
+                return fam
+            fam = cls(name, help, tuple(labelnames), **kw)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help: str,
+                labelnames: Tuple[str, ...] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str,
+              labelnames: Tuple[str, ...] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str,
+                  labelnames: Tuple[str, ...] = (),
+                  buckets: Iterable[float] = LATENCY_BUCKETS_S
+                  ) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labelnames,
+                                   buckets=buckets)
+
+    def on_collect(self, fn: Callable[[], None]) -> None:
+        """Register a callback run at the top of every render() — the
+        hook snapshot-style surfaces use to refresh gauges (manager
+        status, heartbeat ages) right before the scrape reads them."""
+        with self._lock:
+            self._collectors.append(fn)
+
+    def render(self) -> str:
+        """The whole registry in Prometheus text exposition format."""
+        with self._lock:
+            collectors = list(self._collectors)
+            families = sorted(self._families.values(),
+                              key=lambda f: f.name)
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:
+                # a broken collector degrades one scrape's freshness,
+                # never the scrape itself
+                log.exception("metrics collector failed")
+        out: List[str] = []
+        for fam in families:
+            samples: List[str] = []
+            fam.render(samples)
+            if not samples:
+                continue
+            out.append(f"# HELP {fam.name} {escape_help(fam.help)}")
+            out.append(f"# TYPE {fam.name} {fam.kind}")
+            out.extend(samples)
+        return "\n".join(out) + "\n"
+
+
+# -- reading expositions back (benchmarks, lint, tests) ---------------------
+
+def parse_exposition(text: str) -> List[Tuple[str, Dict[str, str], float]]:
+    """Parse exposition text into (name, labels, value) samples.
+    Comment/blank lines are skipped; malformed sample lines raise."""
+    samples = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, labels, value = _parse_sample(line)
+        samples.append((name, labels, value))
+    return samples
+
+
+def _parse_sample(line: str) -> Tuple[str, Dict[str, str], float]:
+    m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)", line)
+    if not m:
+        raise ValueError(f"malformed sample line: {line!r}")
+    name = m.group(1)
+    rest = line[m.end():]
+    labels: Dict[str, str] = {}
+    if rest.startswith("{"):
+        i = 1
+        while True:
+            while i < len(rest) and rest[i] in ", ":
+                i += 1
+            if i < len(rest) and rest[i] == "}":
+                i += 1
+                break
+            lm = re.match(r'([a-zA-Z_][a-zA-Z0-9_]*)="', rest[i:])
+            if not lm:
+                raise ValueError(f"malformed labels in: {line!r}")
+            ln = lm.group(1)
+            i += lm.end()
+            buf = []
+            while i < len(rest):
+                c = rest[i]
+                if c == "\\":
+                    nxt = rest[i + 1:i + 2]
+                    buf.append({"\\": "\\", '"': '"', "n": "\n"}.get(
+                        nxt, "\\" + nxt))
+                    i += 2
+                elif c == '"':
+                    i += 1
+                    break
+                else:
+                    buf.append(c)
+                    i += 1
+            else:
+                raise ValueError(f"unterminated label value in: {line!r}")
+            labels[ln] = "".join(buf)
+        rest = rest[i:]
+    parts = rest.split()
+    if not parts:
+        raise ValueError(f"sample line has no value: {line!r}")
+    val = parts[0]
+    if val == "+Inf":
+        fval = math.inf
+    elif val == "-Inf":
+        fval = -math.inf
+    else:
+        fval = float(val)
+    return name, labels, fval
+
+
+def histogram_quantile(
+    samples: List[Tuple[str, Dict[str, str], float]],
+    name: str,
+    q: float,
+    match: Optional[Dict[str, str]] = None,
+) -> float:
+    """Estimate quantile *q* of histogram *name* from parsed exposition
+    samples (linear interpolation inside the bucket, the same estimate
+    PromQL's histogram_quantile makes).  ``match`` filters by label
+    subset; children passing the filter are aggregated.  Returns NaN
+    when the histogram is absent or empty."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile must be in [0, 1], got {q}")
+    by_le: Dict[float, float] = {}
+    for sname, labels, value in samples:
+        if sname != name + "_bucket" or "le" not in labels:
+            continue
+        if match and any(labels.get(k) != v for k, v in match.items()):
+            continue
+        le = math.inf if labels["le"] == "+Inf" else float(labels["le"])
+        by_le[le] = by_le.get(le, 0.0) + value
+    if not by_le or math.inf not in by_le:
+        return math.nan
+    total = by_le[math.inf]
+    if total <= 0:
+        return math.nan
+    target = q * total
+    prev_bound, prev_cum = 0.0, 0.0
+    for bound in sorted(by_le):
+        cum = by_le[bound]
+        if cum >= target:
+            if bound == math.inf:
+                return prev_bound  # PromQL: highest finite bound
+            if cum == prev_cum:
+                return bound
+            frac = (target - prev_cum) / (cum - prev_cum)
+            return prev_bound + frac * (bound - prev_bound)
+        prev_bound, prev_cum = bound, cum
+    return prev_bound

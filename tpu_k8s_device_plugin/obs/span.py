@@ -1,0 +1,121 @@
+"""Span: one timed operation that feeds a histogram and leaves a
+structured, request-id-tagged log line behind.
+
+The repo's hot paths (serving requests, Allocate RPCs, pulse rounds)
+need BOTH a latency distribution (the histogram a dashboard reads) and
+a per-occurrence trace (the log line an operator greps when one
+request misbehaves).  A Span is the single object that does both, so
+the two can never disagree about what was measured:
+
+    with span("tpu_plugin_allocate", histogram=m.allocate_seconds,
+              labels={"resource": "tpu"}, logger=log):
+        ...                       # outcome=ok on clean exit
+                                  # outcome=error if the body raises
+
+    sp = Span("tpu_serve_request", histogram=m.request_seconds,
+              request_id=rid)     # long-lived: ends on the terminal
+    ...                           # event, possibly on another thread
+    sp.end(outcome="throttled")
+
+If the histogram family declares an ``outcome`` label, the outcome is
+recorded there; otherwise it only reaches the log line.  ``end()`` is
+idempotent — exactly one observation and one log line per span, even
+when a handler thread and the scheduler race to finish a request.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+from .core import Histogram, escape_label_value
+
+_default_log = logging.getLogger(__name__)
+
+
+class Span:
+    """One timed operation (see module docstring)."""
+
+    __slots__ = ("name", "histogram", "request_id", "labels", "logger",
+                 "level", "t0", "_lock", "_done", "_notes")
+
+    def __init__(self, name: str,
+                 histogram: Optional[Histogram] = None,
+                 request_id: Optional[str] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 logger: Optional[logging.Logger] = None,
+                 level: int = logging.DEBUG):
+        self.name = name
+        self.histogram = histogram
+        self.request_id = request_id
+        self.labels = dict(labels or {})
+        self.logger = logger if logger is not None else _default_log
+        self.level = level
+        self.t0 = time.perf_counter()
+        self._lock = threading.Lock()
+        self._done = False
+        self._notes: Dict[str, object] = {}
+
+    def annotate(self, **kv) -> "Span":
+        """Attach extra key=value pairs to the eventual log line."""
+        self._notes.update(kv)
+        return self
+
+    def elapsed(self) -> float:
+        return time.perf_counter() - self.t0
+
+    def end(self, outcome: str = "ok") -> float:
+        """Finish the span: observe the histogram once, log once.
+        Idempotent — later calls return the recorded duration without
+        re-observing (terminal events can race across threads)."""
+        with self._lock:
+            if self._done:
+                return self._notes.get("_duration", 0.0)  # type: ignore
+            self._done = True
+            dt = time.perf_counter() - self.t0
+            self._notes["_duration"] = dt
+        hist = self.histogram
+        if hist is not None:
+            if hist.labelnames:
+                kv = dict(self.labels)
+                if "outcome" in hist.labelnames:
+                    kv["outcome"] = outcome
+                hist.labels(**kv).observe(dt)
+            else:
+                hist.observe(dt)
+        if self.logger.isEnabledFor(self.level):
+            parts = [f"span={self.name}"]
+            if self.request_id:
+                parts.append(f"request_id={self.request_id}")
+            parts.append(f"duration_s={dt:.6f}")
+            parts.append(f"outcome={outcome}")
+            for k in sorted(self.labels):
+                parts.append(
+                    f'{k}="{escape_label_value(self.labels[k])}"')
+            for k in sorted(self._notes):
+                if not k.startswith("_"):
+                    parts.append(f"{k}={self._notes[k]}")
+            self.logger.log(self.level, "%s", " ".join(parts))
+        return dt
+
+
+@contextmanager
+def span(name: str,
+         histogram: Optional[Histogram] = None,
+         request_id: Optional[str] = None,
+         labels: Optional[Dict[str, str]] = None,
+         logger: Optional[logging.Logger] = None,
+         level: int = logging.DEBUG):
+    """Context-manager form: outcome=ok on clean exit, outcome=error
+    (exception class name annotated) when the body raises."""
+    sp = Span(name, histogram=histogram, request_id=request_id,
+              labels=labels, logger=logger, level=level)
+    try:
+        yield sp
+    except BaseException as e:
+        sp.annotate(error=type(e).__name__).end(outcome="error")
+        raise
+    sp.end(outcome="ok")

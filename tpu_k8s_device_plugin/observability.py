@@ -27,7 +27,7 @@ import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, TYPE_CHECKING
 
-from tpu_k8s_device_plugin import __version__
+from tpu_k8s_device_plugin import __version__, obs
 
 if TYPE_CHECKING:
     from tpu_k8s_device_plugin.manager import PluginManager
@@ -85,47 +85,54 @@ def manager_status(manager: "PluginManager") -> dict:
     return status
 
 
-def render_plugin_metrics(manager: "PluginManager") -> str:
-    """The manager's debug snapshot as Prometheus text: kubelet RPC
-    counters (Allocate / ListAndWatch / preferred-allocation), device
-    health rollups, and the impl's degraded-allocation counter."""
-    from tpu_k8s_device_plugin.health.metrics import _escape as esc
+def update_plugin_metrics(manager: "PluginManager",
+                          registry: "obs.Registry") -> None:
+    """Refresh the snapshot-style plugin families (kubelet RPC
+    counters, device health rollups, impl counters) from the manager's
+    status.  The persistent instruments — Allocate latency, frame
+    build, pulse round, slice metrics — live on the same registry and
+    need no refreshing; this only bridges the state that predates it.
 
+    Renames (PR 3, promlint): impl counters gain the ``_total`` suffix
+    the exposition format requires of counters —
+    ``tpu_plugin_degraded_bounds_allocations`` is now
+    ``tpu_plugin_degraded_bounds_allocations_total``."""
     status = manager_status(manager)
-    lines = [
-        "# HELP tpu_plugin_rpc_total Kubelet device-plugin RPCs served.",
-        "# TYPE tpu_plugin_rpc_total counter",
-    ]
-    gauges = []
+    rpc = registry.counter(
+        "tpu_plugin_rpc_total", "Kubelet device-plugin RPCs served.",
+        ("resource", "rpc"))
+    healthy = registry.gauge(
+        "tpu_plugin_devices_healthy", "Devices advertised Healthy.",
+        ("resource",))
+    unhealthy = registry.gauge(
+        "tpu_plugin_devices_unhealthy", "Devices advertised Unhealthy.",
+        ("resource",))
+    for fam in (rpc, healthy, unhealthy):
+        fam.clear()  # a dropped resource must not leave stale series
     for resource, st in sorted(status["resources"].items()):
         if "error" in st:
             continue
-        for rpc, n in sorted(st.get("rpc_counts", {}).items()):
-            lines.append(
-                f'tpu_plugin_rpc_total{{resource="{esc(resource)}",'
-                f'rpc="{esc(rpc)}"}} {n}')
-        gauges += [
-            f'tpu_plugin_devices_healthy{{resource="{esc(resource)}"}} '
-            f'{st.get("healthy", 0)}',
-            f'tpu_plugin_devices_unhealthy{{resource="{esc(resource)}"}} '
-            f'{st.get("unhealthy", 0)}',
-        ]
-    if gauges:
-        lines += [
-            "# HELP tpu_plugin_devices_healthy Devices advertised Healthy.",
-            "# TYPE tpu_plugin_devices_healthy gauge",
-            *[g for g in gauges if "devices_healthy" in g],
-            "# HELP tpu_plugin_devices_unhealthy Devices advertised "
-            "Unhealthy.",
-            "# TYPE tpu_plugin_devices_unhealthy gauge",
-            *[g for g in gauges if "devices_unhealthy" in g],
-        ]
+        for rpc_name, n in sorted(st.get("rpc_counts", {}).items()):
+            rpc.labels(resource=resource, rpc=rpc_name)._set(n)
+        healthy.labels(resource=resource).set(st.get("healthy", 0))
+        unhealthy.labels(resource=resource).set(st.get("unhealthy", 0))
     for name, value in status.get("impl_counters", {}).items():
-        lines += [
-            f"# TYPE tpu_plugin_{name} counter",
-            f"tpu_plugin_{name} {value}",
-        ]
-    return "\n".join(lines) + "\n"
+        cname = f"tpu_plugin_{name}"
+        if not cname.endswith("_total"):
+            cname += "_total"
+        registry.counter(
+            cname, f"Device-impl counter {name} (node-wide).")._set(value)
+
+
+def render_plugin_metrics(manager: "PluginManager") -> str:
+    """The plugin debug /metrics body: the manager's obs.Registry
+    (Allocate/frame/pulse histograms, slice metrics) plus the bridged
+    status snapshot, through the one shared renderer."""
+    registry = getattr(manager, "registry", None)
+    if registry is None:  # bare managers in tests / external embedders
+        registry = obs.Registry()
+    update_plugin_metrics(manager, registry)
+    return registry.render()
 
 
 class DebugServer:
@@ -154,8 +161,14 @@ class DebugServer:
                     try:
                         body = json.dumps(manager_status(manager), indent=2)
                         self._send(200, "application/json", body + "\n")
-                    except Exception as e:
-                        self._send(500, "text/plain", f"{e}\n")
+                    except Exception:
+                        # full traceback to the LOG, generic body to the
+                        # CLIENT: raw exception text can leak paths and
+                        # internal state, and without the traceback the
+                        # operator had nothing to debug with
+                        log.exception("/debug/status failed")
+                        self._send(500, "text/plain",
+                                   "internal error; see plugin logs\n")
                 elif self.path == "/debug/threads":
                     self._send(200, "text/plain", thread_dump())
                 elif self.path == "/metrics":
@@ -165,8 +178,10 @@ class DebugServer:
                             "text/plain; version=0.0.4; charset=utf-8",
                             render_plugin_metrics(manager),
                         )
-                    except Exception as e:
-                        self._send(500, "text/plain", f"{e}\n")
+                    except Exception:
+                        log.exception("/metrics render failed")
+                        self._send(500, "text/plain",
+                                   "internal error; see plugin logs\n")
                 else:
                     self._send(404, "text/plain", "not found\n")
 

@@ -12,10 +12,12 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from typing import List, Optional
 
 import grpc
 
+from tpu_k8s_device_plugin import obs
 from tpu_k8s_device_plugin.proto import (
     deviceplugin_pb2 as pluginapi,
     deviceplugin_pb2_grpc as pluginapi_grpc,
@@ -28,12 +30,35 @@ _BEAT = "beat"
 _STOP = "stop"
 
 
+class PluginMetrics:
+    """Per-resource latency instruments shared by every plugin a
+    manager serves (one family, ``resource`` label).  Lives on the
+    manager's obs.Registry so the debug /metrics surface renders it."""
+
+    def __init__(self, registry: obs.Registry):
+        self.allocate_seconds = registry.histogram(
+            "tpu_plugin_allocate_seconds",
+            "Allocate RPC latency (env/mount/device-spec build).",
+            ("resource",), buckets=obs.FAST_BUCKETS_S)
+        self.frame_seconds = registry.histogram(
+            "tpu_plugin_list_and_watch_frame_seconds",
+            "Building one ListAndWatch frame (enumeration or health "
+            "refresh + response construction).",
+            ("resource",), buckets=obs.FAST_BUCKETS_S)
+        self.probe_seconds = registry.histogram(
+            "tpu_plugin_health_probe_seconds",
+            "One health probe (DeviceImpl.update_health) on a beat.",
+            ("resource",), buckets=obs.FAST_BUCKETS_S)
+
+
 class TpuDevicePlugin(pluginapi_grpc.DevicePluginServicer):
     """One instance serves one resource name."""
 
-    def __init__(self, device_impl: DeviceImpl, ctx: DevicePluginContext):
+    def __init__(self, device_impl: DeviceImpl, ctx: DevicePluginContext,
+                 metrics: Optional[PluginMetrics] = None):
         self.impl = device_impl
         self.ctx = ctx
+        self.metrics = metrics
         self._lock = threading.Lock()
         self._watchers: List[queue.Queue] = []
         self._stopped = False
@@ -90,6 +115,7 @@ class TpuDevicePlugin(pluginapi_grpc.DevicePluginServicer):
     def ListAndWatch(self, request, context):
         """Initial device list, then health-refreshed resends on every
         heartbeat (≈ plugin.go:146-170)."""
+        t0 = time.perf_counter()
         try:
             devices = self.impl.enumerate(self.ctx)
         except Exception as e:
@@ -108,7 +134,12 @@ class TpuDevicePlugin(pluginapi_grpc.DevicePluginServicer):
         context.add_callback(lambda: q.put(_STOP))
         try:
             self.last_devices = devices
-            yield pluginapi.ListAndWatchResponse(devices=devices)
+            frame = pluginapi.ListAndWatchResponse(devices=devices)
+            if self.metrics:
+                self.metrics.frame_seconds.labels(
+                    resource=self.ctx.resource_name()).observe(
+                        time.perf_counter() - t0)
+            yield frame
             while context.is_active():
                 msg = q.get()
                 if msg == _STOP:
@@ -117,13 +148,27 @@ class TpuDevicePlugin(pluginapi_grpc.DevicePluginServicer):
                         self.ctx.resource_name(),
                     )
                     return
+                t0 = time.perf_counter()
                 try:
                     devices = self.impl.update_health(self.ctx)
                 except Exception as e:
                     log.error("UpdateHealth failed: %s", e)
                     continue
+                finally:
+                    # probe duration records failed probes too — a
+                    # probe that times out is exactly the latency an
+                    # operator needs to see
+                    if self.metrics:
+                        self.metrics.probe_seconds.labels(
+                            resource=self.ctx.resource_name()).observe(
+                                time.perf_counter() - t0)
                 self.last_devices = devices
-                yield pluginapi.ListAndWatchResponse(devices=devices)
+                frame = pluginapi.ListAndWatchResponse(devices=devices)
+                if self.metrics:
+                    self.metrics.frame_seconds.labels(
+                        resource=self.ctx.resource_name()).observe(
+                            time.perf_counter() - t0)
+                yield frame
         finally:
             with self._lock:
                 if q in self._watchers:
@@ -139,11 +184,21 @@ class TpuDevicePlugin(pluginapi_grpc.DevicePluginServicer):
 
     def Allocate(self, request, context):
         self._count("allocate")
-        try:
-            return self.impl.allocate(self.ctx, request)
-        except Exception as e:
-            log.error("Allocate failed: %s", e)
-            context.abort(grpc.StatusCode.INTERNAL, str(e))
+        # span: latency histogram + a request-tagged log line per grant
+        # (outcome=error when impl.allocate raises → context.abort)
+        with obs.span(
+            "tpu_plugin_allocate",
+            histogram=self.metrics.allocate_seconds if self.metrics
+            else None,
+            labels={"resource": self.ctx.resource_name()},
+            logger=log,
+        ) as sp:
+            sp.annotate(containers=len(request.container_requests))
+            try:
+                return self.impl.allocate(self.ctx, request)
+            except Exception as e:
+                log.error("Allocate failed: %s", e)
+                context.abort(grpc.StatusCode.INTERNAL, str(e))
 
     def PreStartContainer(self, request, context):
         # Not required (pre_start_required=false), but answer gracefully.

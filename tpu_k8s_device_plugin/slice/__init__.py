@@ -18,6 +18,7 @@ Three layers:
 """
 
 from .client import SliceClient
+from .metrics import SliceMetrics
 from .server import SliceCoordinator
 from .state import (
     Membership,
@@ -30,6 +31,7 @@ __all__ = [
     "Membership",
     "SliceClient",
     "SliceCoordinator",
+    "SliceMetrics",
     "SliceState",
     "load_membership",
     "save_membership",

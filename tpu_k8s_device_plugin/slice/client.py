@@ -66,9 +66,22 @@ class SliceClient:
         chip_count: int = 0,
         state_path: Optional[str] = constants.SLICE_STATE_FILE,
         local_health_fn: Optional[LocalHealthFn] = None,
+        registry=None,
     ):
         self._address = rendezvous_address
         self.hostname = hostname or socket.gethostname()
+        # slice metrics (PR 3): join duration, learned-verdict
+        # transitions, and this host's own heartbeat age (refreshed at
+        # scrape time).  On the rendezvous host the coordinator shares
+        # the registry, so instrument families dedupe onto one set.
+        self.metrics = None
+        self._last_beat: Optional[float] = None
+        self._join_started: Optional[float] = None
+        if registry is not None:
+            from .metrics import SliceMetrics
+
+            self.metrics = SliceMetrics(registry)
+            registry.on_collect(self._refresh_age)
         self._coords = tuple(coords)
         self._chip_count = chip_count
         self._state_path = state_path
@@ -127,6 +140,8 @@ class SliceClient:
         existing rank without re-forming."""
         deadline = time.monotonic() + timeout_s if timeout_s > 0 else None
         backoff = _JOIN_BACKOFF_INITIAL_S
+        if self._join_started is None:
+            self._join_started = time.monotonic()
         while not self._stop.is_set():
             try:
                 membership = self._join_once()
@@ -158,6 +173,12 @@ class SliceClient:
         with self._lock:
             prior = self._membership
             self._membership = membership
+        if prior is None and self.metrics is not None \
+                and self._join_started is not None:
+            # formation latency as THIS host experienced it (first
+            # join attempt to adopted membership)
+            self.metrics.join_seconds.observe(
+                time.monotonic() - self._join_started)
         if prior is None or prior.generation != membership.generation:
             rank = membership.rank_of(self.hostname)
             log.info(
@@ -219,11 +240,18 @@ class SliceClient:
         fresh = _membership_from_msg(resp.membership)
         if fresh is not None:
             self._adopt(fresh)
+        self._last_beat = time.monotonic()
         with self._lock:
             prior = self._slice_healthy
             self._slice_healthy = resp.slice_healthy
             self._unhealthy_hosts = list(resp.unhealthy_hostnames)
         if prior is not None and prior != resp.slice_healthy:
+            if self.metrics is not None:
+                # the verdict as THIS host learned it (the coordinator
+                # counts slice_demoted/slice_recovered at the source)
+                self.metrics.transition(
+                    "verdict_recovered" if resp.slice_healthy
+                    else "verdict_demoted")
             log.warning(
                 "slice %s -> %s%s",
                 self.membership.slice_id if self.membership else "?",
@@ -257,6 +285,14 @@ class SliceClient:
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+
+    def _refresh_age(self) -> None:
+        """Scrape-time collector: this host's own heartbeat age (how
+        stale our view of the slice verdict is)."""
+        if self.metrics is None or self._last_beat is None:
+            return
+        self.metrics.heartbeat_age.labels(hostname=self.hostname).set(
+            max(0.0, time.monotonic() - self._last_beat))
 
     # -- the contract consumed by Allocate / update_health ------------------
 

@@ -1,0 +1,67 @@
+"""Slice observability: the metrics set for multi-host coordination.
+
+PR 1 shipped rendezvous, heartbeats, and slice-wide health with zero
+instrumentation — a slice that formed slowly, a member whose heartbeats
+aged out, or a demotion that took three pulses to reach the last member
+all looked identical from the outside.  This module is the metric set
+both halves (coordinator and per-host client) record into; on the
+rendezvous host the two share the plugin manager's registry, so the one
+debug ``/metrics`` scrape answers all of it.
+
+Series (full reference: docs/user-guide/observability.md):
+
+- ``tpu_slice_join_seconds`` — histogram, client-side: first Join poll
+  to adopted membership (how long formation kept this host waiting).
+- ``tpu_slice_heartbeat_age_seconds{hostname}`` — gauge, refreshed at
+  scrape time: seconds since each member was last heard from
+  (coordinator view) / since this host's last successful heartbeat
+  (client view).  The staleness a timeout demotion would act on.
+- ``tpu_slice_membership_transitions_total{kind}`` — counter:
+  ``formed``, ``member_unhealthy``, ``member_recovered``,
+  ``slice_demoted``, ``slice_recovered`` (coordinator) and
+  ``verdict_demoted`` / ``verdict_recovered`` (client's learned view).
+- ``tpu_slice_demotion_propagation_seconds`` — histogram,
+  coordinator-side: slice verdict flipping unhealthy → each member's
+  next heartbeat DELIVERING that verdict.  The window in which a
+  member still advertises devices Healthy against a wedged peer.
+- ``tpu_slice_heartbeats_total`` — heartbeats the coordinator served.
+
+Both halves accept ``metrics=None`` and stay zero-cost when unmetered
+(the fuzz harness and bare-grpc installs never touch obs state).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tpu_k8s_device_plugin import obs
+
+
+class SliceMetrics:
+    """The slice instrument set on one registry (see module docstring)."""
+
+    def __init__(self, registry: Optional[obs.Registry] = None):
+        reg = registry if registry is not None else obs.Registry()
+        self.registry = reg
+        self.join_seconds = reg.histogram(
+            "tpu_slice_join_seconds",
+            "Time from this host's first Join poll to adopted "
+            "membership.", buckets=obs.SLOW_BUCKETS_S)
+        self.heartbeat_age = reg.gauge(
+            "tpu_slice_heartbeat_age_seconds",
+            "Seconds since each slice member was last heard from "
+            "(refreshed at scrape time).", ("hostname",))
+        self.transitions = reg.counter(
+            "tpu_slice_membership_transitions_total",
+            "Slice membership and health transitions, by kind.",
+            ("kind",))
+        self.demotion_propagation = reg.histogram(
+            "tpu_slice_demotion_propagation_seconds",
+            "Unhealthy slice verdict -> delivery to each member's "
+            "next heartbeat.", buckets=obs.LATENCY_BUCKETS_S)
+        self.heartbeats = reg.counter(
+            "tpu_slice_heartbeats_total",
+            "Heartbeats the coordinator has served.")
+
+    def transition(self, kind: str) -> None:
+        self.transitions.labels(kind=kind).inc()

@@ -98,15 +98,33 @@ class SliceCoordinator:
         jax_port: int = constants.SLICE_JAX_COORDINATOR_PORT,
         state_path: Optional[str] = constants.SLICE_STATE_FILE,
         heartbeat_timeout_s: float = constants.SLICE_HEARTBEAT_TIMEOUT_S,
+        registry=None,
     ):
         self._lock = threading.Lock()
+        # slice metrics (PR 3): formation/transition counters, the
+        # demotion-propagation histogram, and a scrape-time collector
+        # refreshing per-member heartbeat ages.  The CLI passes the
+        # plugin manager's registry so the debug /metrics scrape on the
+        # rendezvous host carries the whole slice's coordination state.
+        self.metrics = None
+        if registry is not None:
+            from .metrics import SliceMetrics
+
+            self.metrics = SliceMetrics(registry)
         self.state = SliceState(
             expected_workers=expected_workers,
             jax_port=jax_port,
             state_path=state_path,
             heartbeat_timeout_s=heartbeat_timeout_s,
             epoch=time.monotonic(),
+            metrics=self.metrics,
         )
+        if registry is not None:
+            def _refresh():
+                with self._lock:
+                    self.state.refresh_ages(time.monotonic())
+
+            registry.on_collect(_refresh)
         self._bind_address = bind_address
         self._server: Optional[grpc.Server] = None
         self.port: int = 0

@@ -158,6 +158,7 @@ class SliceState:
         state_path: Optional[str] = None,
         heartbeat_timeout_s: float = 0.0,
         epoch: float = 0.0,
+        metrics=None,
     ):
         if expected_workers < 1:
             raise ValueError(f"expected_workers must be >= 1, got "
@@ -171,6 +172,18 @@ class SliceState:
         self._members: Dict[str, _Member] = {}
         self._membership: Optional[Membership] = None
         self._generation = 0
+        # optional SliceMetrics (slice.metrics): transition counters,
+        # demotion-propagation histogram, heartbeat-age refresh.  None
+        # keeps this layer importable/pure on bare-grpc installs and in
+        # the fuzz harness.
+        self._metrics = metrics
+        # propagation tracking: when the slice verdict flips unhealthy
+        # at time T, each member's NEXT heartbeat response delivers the
+        # demotion — observing (delivery - T) per member is the window
+        # in which that member still advertised Healthy devices
+        self._last_verdict: Optional[bool] = None
+        self._demoted_at: float = 0.0
+        self._awaiting_delivery: set = set()
         if state_path:
             prior = load_membership(state_path)
             if prior is not None:
@@ -272,6 +285,8 @@ class SliceState:
         log.info("slice %s formed: ranks %s, coordinator %s",
                  self._membership.slice_id, hostnames,
                  self._membership.coordinator_address)
+        if self._metrics is not None:
+            self._metrics.transition("formed")
         if self.state_path:
             try:
                 save_membership(self.state_path, self._membership)
@@ -314,7 +329,22 @@ class SliceState:
                 log.info("slice member %s -> %s%s", hostname,
                          "healthy" if healthy else "UNHEALTHY",
                          f" ({reason})" if reason else "")
-        return self.health(now)
+                if self._metrics is not None:
+                    self._metrics.transition(
+                        "member_recovered" if healthy
+                        else "member_unhealthy")
+        if self._metrics is not None:
+            self._metrics.heartbeats.inc()
+        view = self.health(now)
+        if (self._metrics is not None and not view.slice_healthy
+                and hostname in self._awaiting_delivery):
+            # this response carries the demoted verdict to *hostname*
+            # for the first time since the flip: the propagation window
+            # for this member closes here
+            self._awaiting_delivery.discard(hostname)
+            self._metrics.demotion_propagation.observe(
+                max(0.0, now - self._demoted_at))
+        return view
 
     def health(self, now: float = 0.0) -> HealthView:
         """Slice-wide verdict: every member healthy, present, and (when a
@@ -329,13 +359,41 @@ class SliceState:
                 if now - seen > self.heartbeat_timeout_s:
                     unhealthy.append(mb.hostname)
         formed = self._membership is not None
+        verdict = formed and not unhealthy
+        if formed and verdict != self._last_verdict:
+            if self._last_verdict is not None or not verdict:
+                if self._metrics is not None:
+                    self._metrics.transition(
+                        "slice_recovered" if verdict
+                        else "slice_demoted")
+            if not verdict:
+                # start the propagation clock: every member owes a
+                # delivery of this verdict on its next heartbeat
+                self._demoted_at = now
+                self._awaiting_delivery = set(self._members)
+            else:
+                self._awaiting_delivery = set()
+            self._last_verdict = verdict
         return HealthView(
-            slice_healthy=formed and not unhealthy,
+            slice_healthy=verdict,
             unhealthy_hostnames=sorted(unhealthy),
             membership=self._membership,
         )
 
     # -- introspection ------------------------------------------------------
+
+    def refresh_ages(self, now: float) -> None:
+        """Refresh the per-member heartbeat-age gauge (scrape-time
+        collector: ages are derived, not stored).  Members never heard
+        from this incarnation age from the coordinator epoch, matching
+        the staleness rule in :meth:`health`."""
+        if self._metrics is None:
+            return
+        gauge = self._metrics.heartbeat_age
+        gauge.clear()
+        for mb in self._members.values():
+            seen = mb.last_seen if mb.last_seen is not None else self._epoch
+            gauge.labels(hostname=mb.hostname).set(max(0.0, now - seen))
 
     @property
     def membership(self) -> Optional[Membership]:

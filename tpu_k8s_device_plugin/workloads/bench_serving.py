@@ -478,6 +478,14 @@ def _http_throughput(model, params, prompt, steps, clients,
             burst_statuses = _http_burst(
                 srv.port, burst, prompt_host[0].tolist(), lock)
         server_stats = srv.stats()
+        # scrape the PR 3 latency histograms over the wire: the
+        # reported percentiles come from /metrics itself, so the bench
+        # validates the series a production dashboard would read
+        mconn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                           timeout=30)
+        mconn.request("GET", "/metrics")
+        metrics_body = mconn.getresponse().read().decode()
+        mconn.close()
     finally:
         # a failure mid-bench must not leak the live server/engine
         # into the rest of the process
@@ -510,6 +518,18 @@ def _http_throughput(model, params, prompt, steps, clients,
         "http_over_engine_ratio":
             http_tps / eng_stats["tokens_per_sec"],
     }
+    # server-side percentiles, estimated from the scraped histogram
+    # buckets (what PromQL histogram_quantile would show a dashboard)
+    from tpu_k8s_device_plugin import obs
+
+    hist_samples = obs.parse_exposition(metrics_body)
+    for key, hname in (("hist_ttft", "tpu_serve_ttft_seconds"),
+                       ("hist_tpot", "tpu_serve_token_seconds"),
+                       ("hist_request", "tpu_serve_request_seconds")):
+        for q, tag in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            v = obs.histogram_quantile(hist_samples, hname, q)
+            if v == v:  # NaN = series absent (no samples)
+                out[f"{key}_ms_{tag}"] = v * 1e3
     if burst:
         out.update({
             "burst_requests": float(burst),

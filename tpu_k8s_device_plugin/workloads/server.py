@@ -85,6 +85,7 @@ from __future__ import annotations
 import argparse
 import bisect
 import heapq
+import itertools
 import json
 import logging
 import queue
@@ -93,6 +94,8 @@ import time
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, HTTPServer
 from typing import List, Optional
+
+from tpu_k8s_device_plugin import obs
 
 from .grammar import (
     json_value_regex,
@@ -104,6 +107,16 @@ from .grammar import (
 from .serving import ServingEngine
 
 log = logging.getLogger(__name__)
+
+# stats() keys that describe CURRENT state; everything else in stats()
+# is monotonic and bridges to /metrics as a counter (``_total`` names)
+_GAUGE_STATS = frozenset({
+    "n_slots", "active_slots", "free_slots",
+    "registered_prefixes", "pending_requests",
+    "running_requests", "running_copies", "window",
+    "http_workers", "connections_waiting", "max_queue",
+    "grammar_patterns",
+})
 
 # scheduler knobs: a window is one compiled run_scan; shorter windows
 # lower time-to-first-token for requests waiting in the admission
@@ -122,6 +135,10 @@ _MAX_REGEX_LEN = 4096
 # json.dumps, no per-token work on either thread
 _FRAME_PRE = b'{"tokens":['
 _FRAME_POST = b']}\n'
+
+# request-id source for the tracing spans; next() is atomic under the
+# GIL, so handler threads draw ids without a lock
+_RID_COUNTER = itertools.count(1)
 
 
 def _tokens_frame(new, idx: int, n: int) -> bytes:
@@ -411,6 +428,14 @@ class _Request:
     # pattern); the scheduler registers it with the engine at admit
     grammar_key: Optional[str] = None      # cache key (the pattern)
     grammar_tdfa: object = None            # compiled, pre-registration
+    # request tracing (PR 3): the span observes
+    # tpu_serve_request_seconds{outcome} exactly once per request and
+    # leaves a request_id-tagged log line; t_arrival anchors the
+    # queue-wait and TTFT histograms
+    rid: str = ""
+    t_arrival: float = 0.0
+    span: object = None
+    ttft_observed: bool = False
 
 
 class _PooledHTTPServer(HTTPServer):
@@ -439,10 +464,14 @@ class _PooledHTTPServer(HTTPServer):
                b"Connection: close\r\n\r\n" % len(_REJECT_BODY)
                ) + _REJECT_BODY
 
-    def __init__(self, addr, handler, workers: int):
+    def __init__(self, addr, handler, workers: int, shed_counter=None):
         super().__init__(addr, handler)
         self._conns: "queue.Queue" = queue.Queue(maxsize=workers)
-        self.connections_rejected = 0  # 429s shed at accept
+        # 429s shed at accept: an obs counter child when the owning
+        # EngineServer wires one (tpu_serve_shed_total{reason=
+        # "connections"}), a plain int for standalone embedders
+        self._shed = shed_counter
+        self._rejected_fallback = 0
         self._pool = [
             threading.Thread(target=self._worker,
                              name=f"serve-http-{i}", daemon=True)
@@ -455,7 +484,10 @@ class _PooledHTTPServer(HTTPServer):
         try:
             self._conns.put_nowait((request, client_address))
         except queue.Full:
-            self.connections_rejected += 1
+            if self._shed is not None:
+                self._shed.inc()
+            else:
+                self._rejected_fallback += 1
             try:
                 request.settimeout(0.5)
                 request.sendall(self._REJECT)
@@ -481,6 +513,11 @@ class _PooledHTTPServer(HTTPServer):
                 self.handle_error(request, client_address)
             finally:
                 self.shutdown_request(request)
+
+    @property
+    def connections_rejected(self) -> int:
+        return (int(self._shed.value) if self._shed is not None
+                else self._rejected_fallback)
 
     def pool_stats(self) -> dict:
         return {
@@ -586,8 +623,71 @@ class EngineServer:
         self._scheduler: Optional[threading.Thread] = None
         self._requests_served = 0
         self._requests_rejected = 0
-        self._requests_throttled = 0   # 429: admission heap full
-        self._requests_dropped = 0     # slow clients disconnected
+        # -- observability (PR 3): the serving registry -------------------
+        # request spans + latency histograms; /metrics renders THIS via
+        # the shared obs renderer (the old hand-rolled loop is gone).
+        # The 429-shed and slow-client-drop ad-hoc ints are promoted to
+        # real counters; stats() reads the counters back so the JSON
+        # and Prometheus surfaces cannot drift.
+        self.registry = obs.Registry()
+        reg = self.registry
+        self._m_ttft = reg.histogram(
+            "tpu_serve_ttft_seconds",
+            "Time from request arrival to its first generated token "
+            "(queue wait + prefill + first window included).",
+            buckets=obs.LATENCY_BUCKETS_S)
+        self._m_token = reg.histogram(
+            "tpu_serve_token_seconds",
+            "Per-token decode latency: each run_scan window observes "
+            "window_time/tokens once per token per stream.",
+            buckets=obs.FAST_BUCKETS_S)
+        self._m_request = reg.histogram(
+            "tpu_serve_request_seconds",
+            "End-to-end request latency by outcome (ok, rejected, "
+            "throttled, dropped, cancelled, shutdown).",
+            ("outcome",), buckets=obs.LATENCY_BUCKETS_S)
+        self._m_queue_wait = reg.histogram(
+            "tpu_serve_queue_wait_seconds",
+            "Time a request waited in the admission heap before its "
+            "first copy was admitted.", buckets=obs.LATENCY_BUCKETS_S)
+        self._m_admit = reg.histogram(
+            "tpu_serve_admit_seconds",
+            "One engine admit (prompt prefill / prefix-cache splice).",
+            buckets=obs.LATENCY_BUCKETS_S)
+        self._m_stream_write = reg.histogram(
+            "tpu_serve_stream_write_seconds",
+            "One chunked stream write (>= 1 coalesced window frames).",
+            buckets=obs.FAST_BUCKETS_S)
+        self._m_shed = reg.counter(
+            "tpu_serve_shed_total",
+            "Load shed with 429 + Retry-After, by admission surface.",
+            ("reason",))
+        self._shed_conns = self._m_shed.labels(reason="connections")
+        self._shed_queue = self._m_shed.labels(reason="queue")
+        self._m_dropped = reg.counter(
+            "tpu_serve_slow_client_drops_total",
+            "Clients disconnected for not draining their stream "
+            "(bounded event queue overflowed).")
+
+    # promoted ad-hoc ints: reads must keep working (tests, embedders)
+    # while the obs counters are the single source of truth
+    @property
+    def _requests_throttled(self) -> int:
+        return int(self._shed_queue.value)
+
+    @property
+    def _requests_dropped(self) -> int:
+        return int(self._m_dropped.value)
+
+    def _finish_request(self, req: _Request, outcome: str) -> None:
+        """Terminal accounting: end the request span exactly once
+        (observes tpu_serve_request_seconds{outcome} and logs the
+        request-id line).  Safe to race — Span.end is idempotent, and
+        handler threads (cancel paths) may race the scheduler."""
+        sp = req.span
+        if sp is not None:
+            req.span = None
+            sp.end(outcome=outcome)
 
     # -- scheduler (sole owner of the engine) -------------------------------
 
@@ -659,6 +759,10 @@ class EngineServer:
                             self._grammar_tdfas.pop(req.grammar_key,
                                                     None)
                     req.grammar_tdfa = None  # registered; drop the ref
+                if req.admitted == 0 and req.t_arrival:
+                    self._m_queue_wait.observe(
+                        time.perf_counter() - req.t_arrival)
+                t_admit = time.perf_counter()
                 slot = eng.admit(
                     req.tokens, temperature=req.temperature,
                     top_k=req.top_k, top_p=req.top_p,
@@ -690,7 +794,9 @@ class EngineServer:
                 # engine-full) — no partially-errored requests
                 self._requests_rejected += 1
                 self._push(req, {"error": str(e), "code": 400})
+                self._finish_request(req, "rejected")
                 continue
+            self._m_admit.observe(time.perf_counter() - t_admit)
             idx = req.admitted
             req.admitted += 1
             req.emitted[idx] = 0
@@ -716,7 +822,8 @@ class EngineServer:
             if not req.dropped:
                 req.dropped = True
                 req.cancelled = True
-                self._requests_dropped += 1
+                self._m_dropped.inc()
+                self._finish_request(req, "dropped")
                 try:
                     req.events.get_nowait()
                 except queue.Empty:
@@ -746,6 +853,11 @@ class EngineServer:
         eng = self.engine
         seen = req.emitted[idx]
         new = tokens[seen:req.max_new_tokens]
+        if new and not req.ttft_observed and req.t_arrival:
+            # first generated token of ANY copy: the TTFT the client
+            # perceives (queue wait + prefill + first window)
+            req.ttft_observed = True
+            self._m_ttft.observe(time.perf_counter() - req.t_arrival)
         st = None
         if (req.stop_strs or req.detokenize) and self.tokenizer:
             st = req.detok.setdefault(idx, _DetokState())
@@ -913,6 +1025,7 @@ class EngineServer:
                 # the final chunk must not read a stale /stats counter
                 self._requests_served += 1
                 self._push(req, done)
+                self._finish_request(req, "ok")
 
     def _scheduler_loop(self) -> None:
         eng = self.engine
@@ -931,6 +1044,7 @@ class EngineServer:
                     del self._running[slot]
             if not self._running:
                 continue
+            t_win = time.perf_counter()
             if eng.spec_ready():
                 # greedy-only traffic on a draft-loaded engine: one
                 # speculative round commits up to gamma+1 tokens per
@@ -958,8 +1072,16 @@ class EngineServer:
                     eng.step()
                 else:
                     eng.run_scan(window)
+            win_dt = time.perf_counter() - t_win
             for slot, (req, idx) in list(self._running.items()):
+                before = req.emitted.get(idx, 0)
                 self._emit(slot, req, idx, eng.output(slot))
+                k = req.emitted.get(idx, 0) - before
+                if k > 0:
+                    # the stream's inter-token latency this window:
+                    # window wall time spread over its k tokens,
+                    # weighted by token count (one bulk observe)
+                    self._m_token.observe_n(win_dt / k, k)
         # the scheduler owns _running/_head: it performs the shutdown
         # drain itself so stop() never mutates them while a device step
         # is still in flight (a stuck 5s join used to race here)
@@ -973,10 +1095,12 @@ class EngineServer:
             if id(req) not in notified:
                 notified.add(id(req))
                 self._push(req, dict(bye))
+                self._finish_request(req, "shutdown")
         self._running.clear()
         if self._head is not None:
             if id(self._head) not in notified:
                 self._push(self._head, dict(bye))
+                self._finish_request(self._head, "shutdown")
             self._head = None
 
     # -- lifecycle ----------------------------------------------------------
@@ -984,14 +1108,6 @@ class EngineServer:
     def start(self, host: str = "0.0.0.0", port: int = 8000
               ) -> "EngineServer":
         server = self
-        # stats keys that describe CURRENT state (everything else in
-        # stats() is monotonic and scrapes as a counter)
-        _GAUGE_STATS = frozenset({
-            "n_slots", "active_slots", "free_slots",
-            "registered_prefixes", "pending_requests",
-            "running_requests", "running_copies", "window",
-            "http_workers", "connections_waiting", "max_queue",
-        })
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -1006,22 +1122,22 @@ class EngineServer:
                     body = json.dumps(server.stats(), indent=2)
                     self._send(200, "application/json", body + "\n")
                 elif self.path == "/metrics":
-                    # Prometheus exposition of the same counters
-                    # (vLLM's server exposes /metrics; scrape configs
-                    # expect it from a serving pod)
-                    lines = []
-                    for k, v in sorted(server.stats().items()):
-                        if (not isinstance(v, (int, float))
-                                or isinstance(v, bool)):
-                            continue
-                        kind = ("gauge" if k in _GAUGE_STATS
-                                else "counter")
-                        lines.append(f"# TYPE tpu_serving_{k} {kind}")
-                        lines.append(f"tpu_serving_{k} {v}")
+                    # Prometheus exposition (vLLM's server exposes
+                    # /metrics; scrape configs expect it from a
+                    # serving pod): the obs registry — request/TTFT/
+                    # per-token histograms, shed counters — plus the
+                    # bridged engine stats
+                    try:
+                        body = server.render_metrics()
+                    except Exception:
+                        log.exception("/metrics render failed")
+                        self._send(500, "text/plain",
+                                   "internal error; see server logs\n")
+                        return
                     self._send(
                         200,
                         "text/plain; version=0.0.4; charset=utf-8",
-                        "\n".join(lines) + "\n")
+                        body)
                 else:
                     self._send(404, "text/plain", "not found\n")
 
@@ -1052,6 +1168,7 @@ class EngineServer:
                 except (BrokenPipeError, ConnectionResetError,
                         TimeoutError):
                     req.cancelled = True
+                    server._finish_request(req, "cancelled")
 
             def _openai_completions(self, chat: bool = False):
                 """OpenAI-compatible text completions (the interface
@@ -1111,6 +1228,7 @@ class EngineServer:
                 except (BrokenPipeError, ConnectionResetError,
                         TimeoutError):
                     req.cancelled = True
+                    server._finish_request(req, "cancelled")
 
             def _openai_error(self, code: int, message: str):
                 """OpenAI error wire shape; 5xx are server faults so
@@ -1258,8 +1376,11 @@ class EngineServer:
                         except queue.Empty:
                             break
                     payload = b"".join(parts)
+                    t_w = time.perf_counter()
                     self.wfile.write(b"%x\r\n" % len(payload)
                                      + payload + b"\r\n")
+                    server._m_stream_write.observe(
+                        time.perf_counter() - t_w)
                     if not terminal:
                         ev = req.events.get()
                 self.wfile.write(b"0\r\n\r\n")
@@ -1300,7 +1421,8 @@ class EngineServer:
                 log.debug("serve-http: " + fmt, *args)
 
         self._httpd = _PooledHTTPServer((host, port), Handler,
-                                        workers=self.max_connections)
+                                        workers=self.max_connections,
+                                        shed_counter=self._shed_conns)
         threading.Thread(target=self._httpd.serve_forever,
                          name="serve-http", daemon=True).start()
         self._scheduler = threading.Thread(
@@ -1343,6 +1465,7 @@ class EngineServer:
             drained, self._pending = self._pending, []
         for _, _, req in drained:
             self._push(req, dict(bye))
+            self._finish_request(req, "shutdown")
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -1357,7 +1480,6 @@ class EngineServer:
         semantics)."""
         with self._lock:
             if len(self._pending) >= self.max_queue:
-                self._requests_throttled += 1
                 full = True
             else:
                 self._pending_seq += 1
@@ -1366,10 +1488,12 @@ class EngineServer:
                                (-req.priority, req._seq, req))
                 full = False
         if full:
+            self._shed_queue.inc()
             self._push(req, {
                 "error": f"admission queue full ({self.max_queue} "
                          "requests pending); retry later",
                 "code": 429})
+            self._finish_request(req, "throttled")
             return
         self._work.set()
 
@@ -1728,7 +1852,7 @@ class EngineServer:
                 # Registered patterns skip the compile entirely — the
                 # engine's combined table already holds their rows
                 grammar_tdfa = self._compile_grammar(pattern)
-        return _Request(
+        req = _Request(
             tokens=tokens,
             max_new_tokens=max_new,
             temperature=float(body.get("temperature", 0.0)),
@@ -1760,6 +1884,18 @@ class EngineServer:
             # bounded: the slow-client disconnect policy (see _push)
             events=queue.Queue(self.max_events),
         )
+        # request tracing: the span starts at parse (its duration is
+        # the full wire-visible latency) and ends exactly once at the
+        # terminal outcome; the rid tags every structured log line
+        # (process-wide counter: unique across servers in one process)
+        req.rid = f"req-{next(_RID_COUNTER):x}"
+        req.t_arrival = time.perf_counter()
+        req.span = obs.Span(
+            "tpu_serve_request",
+            histogram=getattr(self, "_m_request", None),
+            request_id=req.rid, logger=log,
+        ).annotate(prompt_tokens=len(tokens), n=n)
+        return req
 
     def stats(self) -> dict:
         st = dict(self.engine.stats())
@@ -1773,6 +1909,7 @@ class EngineServer:
             "running_copies": len(self._running),
             "requests_served": self._requests_served,
             "requests_rejected": self._requests_rejected,
+            # promoted counters read back so /stats and /metrics agree
             "requests_throttled": self._requests_throttled,
             "requests_dropped": self._requests_dropped,
             "grammar_patterns": grammar_patterns,
@@ -1782,6 +1919,36 @@ class EngineServer:
         if self._httpd is not None:
             st.update(self._httpd.pool_stats())
         return st
+
+    def render_metrics(self) -> str:
+        """The serving /metrics body: the obs registry (request spans,
+        TTFT / per-token / queue-wait / admit / stream-write
+        histograms, shed + drop counters) plus every numeric stats()
+        entry bridged as ``tpu_serving_<key>``.
+
+        Rename (PR 3, promlint): bridged MONOTONIC stats now carry the
+        ``_total`` suffix counters require —
+        ``tpu_serving_requests_served`` is
+        ``tpu_serving_requests_served_total`` and so on; gauges keep
+        their old names."""
+        st = self.stats()
+        reg = self.registry
+        for k, v in st.items():
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                continue
+            if k in _GAUGE_STATS:
+                reg.gauge(f"tpu_serving_{k}",
+                          f"Server/engine gauge '{k}' (see /stats)."
+                          ).set(v)
+            else:
+                name = f"tpu_serving_{k}"
+                if not name.endswith("_total"):
+                    name += "_total"
+                reg.counter(
+                    name,
+                    f"Server/engine counter '{k}' (see /stats)."
+                )._set(v)
+        return reg.render()
 
 
 def main(argv=None) -> int:
