@@ -1,0 +1,510 @@
+"""End-to-end request tracing + flight recorder (PR 4).
+
+Covers the traceparent contract (parse/format round trip, malformed
+fallback), HTTP→engine propagation over a real socket (header echo,
+span breadcrumbs in /debug/traces, OpenMetrics exemplars, plain-text
+exposition staying exemplar-free), slice-client→coordinator propagation
+over real gRPC metadata, recorder ring overflow accounting, the
+SIGTERM flight-record dump (readable JSON-lines from a real subprocess),
+and the slow-span WARNING escalation.
+"""
+
+import json
+import logging
+import math
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from tools.promlint import lint
+from tpu_k8s_device_plugin import obs
+
+pytestmark = pytest.mark.filterwarnings("ignore")
+
+
+# -- traceparent contract ----------------------------------------------------
+
+def test_traceparent_roundtrip():
+    ctx = obs.new_trace()
+    assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+    back = obs.parse_traceparent(ctx.to_traceparent())
+    assert back.trace_id == ctx.trace_id
+    assert back.span_id == ctx.span_id
+    assert back.sampled
+
+
+def test_traceparent_child_links_parent():
+    ctx = obs.new_trace()
+    child = ctx.child()
+    assert child.trace_id == ctx.trace_id
+    assert child.parent_id == ctx.span_id
+    assert child.span_id != ctx.span_id
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "garbage", "00-short-span-01",
+    "00-" + "0" * 32 + "-1234567890abcdef-01",   # zero trace id
+    "00-" + "a" * 32 + "-" + "0" * 16 + "-01",   # zero span id
+    "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",   # version ff
+    "00-" + "A" * 32 + "-" + "b" * 16 + "-01",   # uppercase hex
+])
+def test_malformed_traceparent_falls_back_to_new_root(bad):
+    assert obs.parse_traceparent(bad) is None
+    ctx = obs.trace_from_header(bad)  # always yields a usable root
+    assert len(ctx.trace_id) == 32 and ctx.parent_id is None
+
+
+def test_wellformed_header_continues_the_trace():
+    root = obs.new_trace()
+    cont = obs.trace_from_header(root.to_traceparent())
+    assert cont.trace_id == root.trace_id
+    assert cont.parent_id == root.span_id
+
+
+# -- flight recorder ---------------------------------------------------------
+
+def test_recorder_ring_overflow_and_dropped_accounting():
+    reg = obs.Registry()
+    rec = obs.FlightRecorder(capacity=8, registry=reg)
+    ctx = obs.new_trace()
+    for i in range(20):
+        rec.record("ev", trace=ctx, i=i)
+    assert rec.recorded == 20
+    assert rec.dropped == 12
+    evs = rec.events()
+    assert len(evs) == 8
+    # drop-oldest: the survivors are the 8 NEWEST events
+    assert [e["attrs"]["i"] for e in evs] == list(range(12, 20))
+    samples = obs.parse_exposition(reg.render())
+    by = {n: v for n, ls, v in samples}
+    assert by["tpu_flight_events_total"] == 20
+    assert by["tpu_flight_dropped_events_total"] == 12
+
+
+def test_recorder_filters_and_trace_index():
+    rec = obs.FlightRecorder(capacity=64)
+    a, b = obs.new_trace(), obs.new_trace()
+    t_mid = None
+    rec.record("x", trace=a)
+    t_mid = time.time()
+    time.sleep(0.01)
+    rec.record("y", trace=b)
+    rec.record("x", trace=b)
+    assert {e["name"] for e in rec.events(trace_id=b.trace_id)} == \
+        {"x", "y"}
+    assert all(e["t_wall"] > t_mid for e in rec.events(since=t_mid))
+    idx = rec.trace_ids()
+    assert idx[0]["trace_id"] == b.trace_id  # most recent first
+    assert idx[0]["events"] == 2
+
+
+def test_sigterm_dump_is_readable_jsonlines(tmp_path):
+    """A real subprocess: install the dump handlers, record traced
+    events, SIGTERM it, and assert the dump parses as JSON-lines with
+    the trace id intact."""
+    dump_dir = tmp_path / "flight"
+    prog = f"""
+import os, signal, sys, time
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+from tpu_k8s_device_plugin import obs
+rec = obs.FlightRecorder(capacity=16)
+rec.install_dump_handlers({str(dump_dir)!r})
+ctx = obs.TraceContext(trace_id="ab" * 16, span_id="cd" * 8)
+rec.record("tpu_serve_request", trace=ctx, outcome="ok")
+rec.record("tpu_device_demoted", device="0000:00:04.0")
+print("READY", flush=True)
+time.sleep(30)
+"""
+    proc = subprocess.Popen([sys.executable, "-c", prog],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=20)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert rc == 128 + signal.SIGTERM
+    dumps = [p for p in os.listdir(dump_dir)
+             if p.startswith("flight-") and p.endswith(".jsonl")]
+    assert len(dumps) == 1, dumps
+    lines = [json.loads(line) for line in
+             open(dump_dir / dumps[0], encoding="utf-8")]
+    assert lines[0]["flight_record"] is True
+    assert lines[0]["events"] == 2
+    by_name = {rec["name"]: rec for rec in lines[1:]}
+    assert by_name["tpu_serve_request"]["trace_id"] == "ab" * 16
+    assert by_name["tpu_device_demoted"]["attrs"]["device"] == \
+        "0000:00:04.0"
+
+
+# -- span integration --------------------------------------------------------
+
+def test_span_logs_trace_and_feeds_recorder(caplog):
+    reg = obs.Registry()
+    rec = obs.FlightRecorder(registry=reg)
+    h = reg.histogram("tpu_tr_seconds", "T.", buckets=(1.0,))
+    ctx = obs.new_trace()
+    logger = logging.getLogger("test.trace.span")
+    with caplog.at_level(logging.DEBUG, logger="test.trace.span"):
+        obs.Span("op", histogram=h, trace=ctx, recorder=rec,
+                 logger=logger).end()
+    line = next(r.message for r in caplog.records
+                if "span=op" in r.message)
+    assert f"trace_id={ctx.trace_id}" in line
+    assert f"span_id={ctx.span_id}" in line
+    (ev,) = rec.events(name="op")
+    assert ev["trace_id"] == ctx.trace_id
+    assert ev["attrs"]["outcome"] == "ok"
+
+
+def test_slow_span_escalates_to_warning(caplog):
+    """The satellite bugfix: a pathological span must not vanish at
+    default (INFO+) log levels — past the threshold it logs WARNING."""
+    logger = logging.getLogger("test.trace.slow")
+    ctx = obs.new_trace()
+    with caplog.at_level(logging.INFO, logger="test.trace.slow"):
+        sp = obs.Span("slow_op", trace=ctx, logger=logger,
+                      slow_threshold_s=1e-9)
+        time.sleep(0.002)
+        sp.end()
+        # under the threshold: still DEBUG, invisible at INFO
+        fast = obs.Span("fast_op", logger=logger, slow_threshold_s=60.0)
+        fast.end()
+    warn = [r for r in caplog.records if r.levelno == logging.WARNING]
+    assert len(warn) == 1 and "span=slow_op" in warn[0].message
+    assert f"trace_id={ctx.trace_id}" in warn[0].message
+    assert "slow_threshold_s=" in warn[0].message
+    assert not any("span=fast_op" in r.message for r in caplog.records)
+
+
+def test_slow_threshold_defaults_to_5x_top_bucket():
+    reg = obs.Registry()
+    h = reg.histogram("tpu_thr_seconds", "T.", buckets=(0.5, 2.0))
+    sp = obs.Span("op", histogram=h)
+    assert sp.slow_threshold_s == pytest.approx(10.0)
+    assert obs.Span("op2").slow_threshold_s == 0.0  # no histogram
+
+
+# -- promlint exemplar rules -------------------------------------------------
+
+def test_promlint_exemplar_rules():
+    base = ("# HELP h H.\n# TYPE h histogram\n"
+            'h_bucket{le="+Inf"} 1 # {trace_id="ab"} 0.5 1.0\n'
+            "h_sum 0.5\nh_count 1\n")
+    # plain-text exposition: the exemplar itself is the violation
+    assert any("X1" in e for e in lint(base, openmetrics=False))
+    # OpenMetrics (autodetected via # EOF): clean
+    assert lint(base + "# EOF\n") == []
+    # exemplar on a gauge line: wrong sample kind
+    bad_kind = ("# HELP g G.\n# TYPE g gauge\n"
+                'g 1 # {trace_id="ab"} 0.5\n# EOF\n')
+    assert any("X2" in e for e in lint(bad_kind))
+    # oversized exemplar label set
+    big = "x" * 200
+    bad_len = ("# HELP h H.\n# TYPE h histogram\n"
+               f'h_bucket{{le="+Inf"}} 1 # {{trace_id="{big}"}} 0.5\n'
+               "h_sum 0.5\nh_count 1\n# EOF\n")
+    assert any("X3" in e for e in lint(bad_len))
+    # unparseable exemplar value
+    bad_val = ("# HELP h H.\n# TYPE h histogram\n"
+               'h_bucket{le="+Inf"} 1 # {trace_id="ab"} notanumber\n'
+               "h_sum 0.5\nh_count 1\n# EOF\n")
+    assert any("X4" in e for e in lint(bad_val))
+
+
+def test_registry_renders_exemplars_only_in_openmetrics():
+    reg = obs.Registry()
+    h = reg.histogram("tpu_ex_seconds", "E.", buckets=(1.0,))
+    ctx = obs.new_trace()
+    h.observe(0.5, trace_id=ctx.trace_id)
+    plain = reg.render()
+    om = reg.render(openmetrics=True)
+    assert "# {" not in plain and lint(plain) == []
+    assert f'trace_id="{ctx.trace_id}"' in om
+    assert om.rstrip().endswith("# EOF")
+    assert lint(om) == []
+    # the exemplar sits on the bucket the observation landed in
+    line = next(ln for ln in om.splitlines()
+                if ln.startswith('tpu_ex_seconds_bucket{le="1"}'))
+    assert "# {" in line
+
+
+# -- HTTP -> engine propagation over a real socket ---------------------------
+
+@pytest.fixture(scope="module")
+def traced_server():
+    jnp = pytest.importorskip("jax.numpy")
+    import jax
+
+    from tpu_k8s_device_plugin.workloads.inference import make_decoder
+    from tpu_k8s_device_plugin.workloads.server import EngineServer
+    from tpu_k8s_device_plugin.workloads.serving import ServingEngine
+
+    model = make_decoder(vocab=128, d_model=64, n_heads=4, n_layers=2,
+                         d_ff=128, max_len=64, dtype=jnp.float32)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+    params = model.init(jax.random.PRNGKey(0), tokens, pos)["params"]
+    eng = ServingEngine(model, params, n_slots=2)
+    srv = EngineServer(eng, max_new_tokens=4, window=2)
+    srv.start(host="127.0.0.1", port=0)
+    yield srv
+    srv.stop()
+
+
+def _get(port, path, headers=None):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 headers=headers or {})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, dict(resp.headers), resp.read().decode()
+
+
+def test_http_trace_propagates_to_engine_and_debug(traced_server):
+    srv = traced_server
+    root = obs.new_trace()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/generate",
+        data=json.dumps({"tokens": [1, 2, 3]}).encode(),
+        headers={"Content-Type": "application/json",
+                 "traceparent": root.to_traceparent()})
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        # the trace-id comes back in BOTH header forms
+        assert resp.headers["X-Trace-Id"] == root.trace_id
+        echoed = obs.parse_traceparent(resp.headers["traceparent"])
+        assert echoed.trace_id == root.trace_id
+        resp.read()
+    # the whole server-side path left breadcrumbs under the ONE id:
+    # admission -> queue wait -> run_scan windows -> stream writes
+    _, _, body = _get(srv.port,
+                      f"/debug/traces?trace_id={root.trace_id}")
+    events = json.loads(body)["events"]
+    names = {e["name"] for e in events}
+    for want in ("tpu_serve_queue_wait", "tpu_serve_admit",
+                 "tpu_serve_ttft", "tpu_serve_window",
+                 "tpu_serve_stream_write", "tpu_serve_request"):
+        assert want in names, (want, names)
+    assert all(e["trace_id"] == root.trace_id for e in events)
+    # terminal span records the outcome
+    done = [e for e in events if e["name"] == "tpu_serve_request"]
+    assert done and done[-1]["attrs"]["outcome"] == "ok"
+    # the index view lists the trace
+    _, _, body = _get(srv.port, "/debug/traces")
+    assert any(t["trace_id"] == root.trace_id
+               for t in json.loads(body)["traces"])
+    # /debug/events?since= filters on wall time
+    _, _, body = _get(srv.port, "/debug/events?since=0")
+    assert json.loads(body)["events"]
+    far_future = time.time() + 3600
+    _, _, body = _get(srv.port, f"/debug/events?since={far_future}")
+    assert json.loads(body)["events"] == []
+
+
+def test_http_exemplars_only_under_openmetrics(traced_server):
+    srv = traced_server
+    root = obs.new_trace()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/generate",
+        data=json.dumps({"tokens": [2, 3, 4], "stream": False}).encode(),
+        headers={"Content-Type": "application/json",
+                 "traceparent": root.to_traceparent()})
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        resp.read()
+    # plain exposition: no exemplars, promlint-clean, classic type
+    status, headers, plain = _get(srv.port, "/metrics")
+    assert headers["Content-Type"].startswith("text/plain")
+    assert "# {" not in plain
+    assert lint(plain) == [], lint(plain)[:5]
+    # OpenMetrics: exemplar carries the LAST trace through that bucket
+    status, headers, om = _get(
+        srv.port, "/metrics",
+        headers={"Accept": "application/openmetrics-text"})
+    assert "openmetrics" in headers["Content-Type"]
+    assert f'trace_id="{root.trace_id}"' in om
+    assert om.rstrip().endswith("# EOF")
+    assert lint(om) == [], lint(om)[:5]
+    # exemplars live on the serve histograms the issue names
+    assert any(ln.startswith("tpu_serve_ttft_seconds_bucket")
+               and "# {" in ln for ln in om.splitlines())
+
+
+def test_openai_id_carries_trace_id(traced_server):
+    srv = traced_server
+
+    class _Tok:
+        def encode(self, s):
+            return [ord(c) % 100 for c in s]
+
+        def decode(self, ids):
+            return "".join(chr(97 + int(i) % 26) for i in ids)
+
+    srv.tokenizer = _Tok()
+    try:
+        root = obs.new_trace()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/completions",
+            data=json.dumps({"prompt": "hi", "max_tokens": 2,
+                             "temperature": 0}).encode(),
+            headers={"Content-Type": "application/json",
+                     "traceparent": root.to_traceparent()})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            out = json.loads(resp.read())
+        # the completion id IS the trace id — no mapping table needed
+        assert out["id"] == f"cmpl-{root.trace_id}"
+    finally:
+        srv.tokenizer = None
+
+
+def test_malformed_header_gets_fresh_root_over_http(traced_server):
+    srv = traced_server
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/generate",
+        data=json.dumps({"tokens": [1, 2], "stream": False}).encode(),
+        headers={"Content-Type": "application/json",
+                 "traceparent": "not-a-traceparent"})
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        tid = resp.headers["X-Trace-Id"]
+        resp.read()
+    assert tid and len(tid) == 32  # a fresh, valid root
+
+
+# -- slice client -> coordinator propagation over real gRPC ------------------
+
+def test_slice_trace_propagates_client_to_coordinator(tmp_path):
+    grpc = pytest.importorskip("grpc")  # noqa: F841
+    from tpu_k8s_device_plugin.slice import SliceClient, SliceCoordinator
+
+    reg = obs.Registry()
+    coord_rec = obs.FlightRecorder(registry=reg)
+    coordinator = SliceCoordinator(
+        expected_workers=2, bind_address="127.0.0.1:0",
+        state_path=str(tmp_path / "membership.json"),
+        recorder=coord_rec).start()
+    address = f"127.0.0.1:{coordinator.port}"
+    clients = []
+    try:
+        client_rec = obs.FlightRecorder()
+        for i, name in enumerate(("host-a", "host-b")):
+            clients.append(SliceClient(
+                rendezvous_address=address, hostname=name, coords=(i,),
+                chip_count=4,
+                state_path=str(tmp_path / f"{name}.json"),
+                recorder=client_rec if name == "host-a" else None))
+        ctx = obs.new_trace()
+        # first beat: host-a's join attempt (slice not formed yet)
+        clients[0].heartbeat_now(trace=ctx)
+        # host-b completes formation
+        clients[1].heartbeat_now(trace=obs.new_trace())
+        # host-a joins the formed slice and heartbeats, same trace
+        clients[0].heartbeat_now(trace=ctx)
+        assert clients[0].membership is not None
+        # the coordinator's journal carries host-a's trace id on both
+        # the join and the heartbeat — cross-process, via gRPC metadata
+        joins = coord_rec.events(name="tpu_slice_join",
+                                 trace_id=ctx.trace_id)
+        beats = coord_rec.events(name="tpu_slice_heartbeat",
+                                 trace_id=ctx.trace_id)
+        assert joins and beats
+        assert all(e["attrs"]["hostname"] == "host-a"
+                   for e in joins + beats)
+        # the client journaled its adopted membership under the trace
+        adopted = client_rec.events(name="tpu_slice_membership_adopted",
+                                    trace_id=ctx.trace_id)
+        assert adopted and adopted[0]["attrs"]["workers"] == 2
+    finally:
+        for c in clients:
+            c.stop()
+        coordinator.stop()
+
+
+def test_untraced_slice_rpcs_still_get_a_root(tmp_path):
+    pytest.importorskip("grpc")
+    from tpu_k8s_device_plugin.slice import SliceClient, SliceCoordinator
+
+    coord_rec = obs.FlightRecorder()
+    coordinator = SliceCoordinator(
+        expected_workers=1, bind_address="127.0.0.1:0",
+        state_path=None, recorder=coord_rec).start()
+    client = SliceClient(
+        rendezvous_address=f"127.0.0.1:{coordinator.port}",
+        hostname="solo", coords=(0,), chip_count=1, state_path=None)
+    try:
+        client.heartbeat_now()  # no explicit trace anywhere
+        joins = coord_rec.events(name="tpu_slice_join")
+        assert joins and len(joins[0]["trace_id"]) == 32
+    finally:
+        client.stop()
+        coordinator.stop()
+
+
+def test_plugin_debug_traces_and_exemplars(testdata, tmp_path):
+    """The plugin side of the acceptance: an Allocate opens a root
+    trace tagged with its device ids, queryable via the DebugServer's
+    /debug/traces, with an exemplar on tpu_plugin_allocate_seconds
+    under the OpenMetrics scrape — and the plain scrape stays clean."""
+    pytest.importorskip("grpc")
+    from fake_kubelet import FakeKubelet
+    from tpu_k8s_device_plugin.manager import PluginManager
+    from tpu_k8s_device_plugin.observability import DebugServer
+    from tpu_k8s_device_plugin.proto import deviceplugin_pb2 as pluginapi
+    from tpu_k8s_device_plugin.tpu.device_impl import TpuContainerImpl
+
+    root = os.path.join(testdata, "v5e-8")
+    impl = TpuContainerImpl(
+        sysfs_root=os.path.join(root, "sys"),
+        dev_root=os.path.join(root, "dev"),
+        tpu_env_path=os.path.join(root, "run", "tpu", "tpu-env"))
+    kubelet = FakeKubelet(str(tmp_path / "device-plugins")).start()
+    manager = PluginManager(impl, kubelet_dir=kubelet.dir,
+                            kubelet_watch_interval_s=0.1)
+    manager.run(block=False)
+    debug = DebugServer(manager, port=0).start()
+    try:
+        assert kubelet.wait_for_registration()
+        stub = kubelet.plugin_stub("google.com_tpu")
+        stub.Allocate(pluginapi.AllocateRequest(
+            container_requests=[pluginapi.ContainerAllocateRequest(
+                devices_ids=["0000:00:04.0"])]))
+        _, _, body = _get(debug.port, "/debug/traces")
+        traces = json.loads(body)["traces"]
+        assert traces, "Allocate left no trace in the journal"
+        tid = traces[0]["trace_id"]
+        _, _, body = _get(debug.port, f"/debug/traces?trace_id={tid}")
+        events = json.loads(body)["events"]
+        alloc = [e for e in events
+                 if e["name"] == "tpu_plugin_allocate"]
+        assert alloc and "0000:00:04.0" in alloc[0]["attrs"]["devices"]
+        # exemplar on the allocate histogram, OpenMetrics only
+        _, headers, om = _get(
+            debug.port, "/metrics",
+            headers={"Accept": "application/openmetrics-text"})
+        assert "openmetrics" in headers["Content-Type"]
+        assert any(
+            ln.startswith("tpu_plugin_allocate_seconds_bucket")
+            and f'trace_id="{tid}"' in ln for ln in om.splitlines())
+        assert lint(om) == [], lint(om)[:5]
+        _, headers, plain = _get(debug.port, "/metrics")
+        assert "# {" not in plain and lint(plain) == []
+        # the journal is counted on the same registry the scrape serves
+        assert "tpu_flight_events_total" in plain
+    finally:
+        debug.stop()
+        manager.stop()
+        kubelet.stop()
+
+
+def test_histogram_quantile_still_works_on_openmetrics_body():
+    """The bench parses /metrics bodies; exemplar tails and # EOF must
+    not confuse the parser/quantile path."""
+    reg = obs.Registry()
+    h = reg.histogram("tpu_p_seconds", "P.", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 0.7):
+        h.observe(v, trace_id=obs.new_trace().trace_id)
+    samples = obs.parse_exposition(reg.render(openmetrics=True))
+    q = obs.histogram_quantile(samples, "tpu_p_seconds", 0.5)
+    assert not math.isnan(q) and 0.0 < q <= 1.0

@@ -25,8 +25,21 @@ format-validity basics):
   B2  every _bucket carries 'le' and the '+Inf' bucket exists
   B3  bucket cumulative counts are non-decreasing, +Inf == _count
 
+Exemplar rules (PR 4; the OpenMetrics renderer carries trace-id
+exemplars, and this linter gates them in CI):
+
+  X1  no exemplars in the plain text exposition (they are an
+      OpenMetrics-only construct; plain scrapers would choke)
+  X2  exemplars only on histogram '_bucket' or counter '_total' lines
+  X3  exemplar label set (the text inside '{...}') <= 128 chars
+  X4  exemplar values parse ('# {labels} value [timestamp]')
+
+Exposition mode: ``lint(text, openmetrics=None)`` auto-detects by the
+trailing ``# EOF`` terminator (required in OpenMetrics, absent in the
+plain format); pass True/False to pin it.
+
 Usage:
-  python tools/promlint.py FILE [FILE...]     # or '-' for stdin
+  python tools/promlint.py [--openmetrics] FILE [...]   # '-' = stdin
   from tools.promlint import lint             # -> list of error strings
 """
 
@@ -100,9 +113,46 @@ def _base_family(name: str, types: Dict[str, str]) -> str:
     return name
 
 
-def lint(text: str) -> List[str]:
+_EXEMPLAR_MAX_LABEL_CHARS = 128
+
+
+def _lint_exemplar(name: str, raw: str, line_no: int,
+                   errors: List[str]) -> None:
+    """Validate one exemplar tail (the text after ' # ') against the
+    X-rules; *name* is the sample's metric name."""
+    if not (name.endswith("_bucket") or name.endswith("_total")):
+        errors.append(
+            f"line {line_no}: exemplar on {name!r} (only _bucket/"
+            "_total lines may carry exemplars) (X2)")
+    m = re.match(r"^\{(.*)\}\s+(\S+)(?:\s+(\S+))?\s*$", raw)
+    if not m:
+        errors.append(
+            f"line {line_no}: malformed exemplar {raw!r} (X4)")
+        return
+    labelset, value, ts = m.groups()
+    # OpenMetrics: total exemplar label characters (names + values)
+    # bounded at 128 so scrape buffers stay predictable
+    if len(labelset) > _EXEMPLAR_MAX_LABEL_CHARS:
+        errors.append(
+            f"line {line_no}: exemplar label set is {len(labelset)} "
+            f"chars, over the {_EXEMPLAR_MAX_LABEL_CHARS} bound (X3)")
+    for raw_num, what in ((value, "value"), (ts, "timestamp")):
+        if raw_num is None:
+            continue
+        try:
+            float(raw_num)
+        except ValueError:
+            errors.append(
+                f"line {line_no}: unparseable exemplar {what} "
+                f"{raw_num!r} (X4)")
+
+
+def lint(text: str, openmetrics=None) -> List[str]:
     """Lint one exposition body; returns a list of error strings
-    (empty = clean)."""
+    (empty = clean).  *openmetrics* None auto-detects the mode from
+    the trailing ``# EOF`` terminator."""
+    if openmetrics is None:
+        openmetrics = text.rstrip("\n").endswith("# EOF")
     errors: List[str] = []
     helps: Dict[str, str] = {}
     types: Dict[str, str] = {}
@@ -159,6 +209,15 @@ def lint(text: str) -> List[str]:
             if not ok:
                 continue
             rest = rest[consumed:]
+        exemplar = None
+        if " # " in rest:
+            # OpenMetrics exemplar tail: '<value> [ts] # {labels} v [ts]'
+            rest, exemplar = rest.split(" # ", 1)
+            if not openmetrics:
+                errors.append(
+                    f"line {line_no}: exemplar in plain-text "
+                    "exposition (OpenMetrics only) (X1)")
+            _lint_exemplar(name, exemplar.strip(), line_no, errors)
         value_parts = rest.split()
         if not value_parts:
             errors.append(f"line {line_no}: sample has no value (V1)")
@@ -242,6 +301,10 @@ def lint(text: str) -> List[str]:
 
 
 def main(argv: List[str]) -> int:
+    openmetrics = None
+    if argv and argv[0] == "--openmetrics":
+        openmetrics = True
+        argv = argv[1:]
     paths = argv or ["-"]
     failed = False
     for path in paths:
@@ -250,7 +313,7 @@ def main(argv: List[str]) -> int:
         else:
             with open(path, "r", encoding="utf-8") as f:
                 text, label = f.read(), path
-        errors = lint(text)
+        errors = lint(text, openmetrics=openmetrics)
         for e in errors:
             print(f"{label}: {e}")
         failed = failed or bool(errors)

@@ -1,0 +1,143 @@
+#!/usr/bin/env python3
+"""trace-smoke: the end-to-end acceptance check for request tracing.
+
+One trace-id issued at the serving front door must be observable in
+every layer the tracing PR wired:
+
+  1. the response headers (X-Trace-Id / traceparent echo),
+  2. the span breadcrumbs in /debug/traces (admission, queue wait,
+     run_scan windows, stream writes, terminal request span),
+  3. an OpenMetrics exemplar on the serve histograms,
+  4. the plain-text /metrics staying exemplar-free AND promlint-clean,
+  5. the flight-record dump written when the server gets SIGTERM.
+
+The server runs as a REAL subprocess (random weights, tiny decoder, CPU)
+so the SIGTERM path is the production path, not a test double.  CI runs
+this in the ``trace-smoke`` job on every push; it is also runnable by
+hand:
+
+    JAX_PLATFORMS=cpu python tools/trace_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.promlint import lint                      # noqa: E402
+from tpu_k8s_device_plugin import obs                # noqa: E402
+
+_SERVER_PROG = """
+import json, sys
+sys.path.insert(0, {repo!r})
+import jax, jax.numpy as jnp
+from tpu_k8s_device_plugin.workloads.inference import make_decoder
+from tpu_k8s_device_plugin.workloads.server import EngineServer
+from tpu_k8s_device_plugin.workloads.serving import ServingEngine
+
+model = make_decoder(vocab=128, d_model=64, n_heads=4, n_layers=2,
+                     d_ff=128, max_len=64, dtype=jnp.float32)
+tokens = jnp.zeros((1, 8), jnp.int32)
+pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+params = model.init(jax.random.PRNGKey(0), tokens, pos)["params"]
+eng = ServingEngine(model, params, n_slots=2)
+srv = EngineServer(eng, max_new_tokens=4, window=2,
+                   flight_record_dir={dump_dir!r})
+# the CLI installs the SIGTERM dump chain; do the same here so the
+# smoke exercises the production shutdown path
+srv.recorder.install_dump_handlers({dump_dir!r})
+srv.start(host="127.0.0.1", port=0)
+print(json.dumps({{"port": srv.port}}), flush=True)
+import threading
+threading.Event().wait()
+"""
+
+
+def _get(port, path, headers=None):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 headers=headers or {})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return dict(resp.headers), resp.read().decode()
+
+
+def main() -> int:
+    dump_dir = tempfile.mkdtemp(prefix="trace-smoke-")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         _SERVER_PROG.format(repo=REPO, dump_dir=dump_dir)],
+        stdout=subprocess.PIPE, text=True, env=env)
+    try:
+        port = json.loads(proc.stdout.readline())["port"]
+        print(f"server up on :{port}")
+
+        root = obs.new_trace()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps({"tokens": [1, 2, 3]}).encode(),
+            headers={"Content-Type": "application/json",
+                     "traceparent": root.to_traceparent()})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert resp.headers["X-Trace-Id"] == root.trace_id, \
+                "response header does not echo the trace id"
+            resp.read()
+        print(f"1. header echo OK ({root.trace_id})")
+
+        _, body = _get(port, f"/debug/traces?trace_id={root.trace_id}")
+        names = {e["name"] for e in json.loads(body)["events"]}
+        for want in ("tpu_serve_queue_wait", "tpu_serve_admit",
+                     "tpu_serve_ttft", "tpu_serve_window",
+                     "tpu_serve_stream_write", "tpu_serve_request"):
+            assert want in names, f"missing {want} in {sorted(names)}"
+        print(f"2. /debug/traces spans OK ({sorted(names)})")
+
+        headers, om = _get(
+            port, "/metrics",
+            headers={"Accept": "application/openmetrics-text"})
+        assert "openmetrics" in headers["Content-Type"]
+        assert f'trace_id="{root.trace_id}"' in om, \
+            "trace id absent from OpenMetrics exemplars"
+        errs = lint(om)
+        assert not errs, f"OpenMetrics body fails promlint: {errs[:5]}"
+        print("3. OpenMetrics exemplar OK")
+
+        headers, plain = _get(port, "/metrics")
+        assert headers["Content-Type"].startswith("text/plain")
+        assert "# {" not in plain, \
+            "exemplar leaked into the plain-text exposition"
+        errs = lint(plain)
+        assert not errs, f"plain /metrics fails promlint: {errs[:5]}"
+        print("4. plain exposition exemplar-free + promlint-clean OK")
+
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=30)
+        dumps = [p for p in os.listdir(dump_dir)
+                 if p.startswith("flight-") and p.endswith(".jsonl")]
+        assert dumps, f"no flight-record dump in {dump_dir}"
+        with open(os.path.join(dump_dir, dumps[0]),
+                  encoding="utf-8") as f:
+            lines = [json.loads(line) for line in f]
+        assert lines[0].get("flight_record") is True
+        assert any(rec.get("trace_id") == root.trace_id
+                   for rec in lines[1:]), \
+            "trace id absent from the SIGTERM flight-record dump"
+        print(f"5. SIGTERM dump OK ({dumps[0]}, "
+              f"{lines[0]['events']} events)")
+        print("trace-smoke: PASS")
+        return 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

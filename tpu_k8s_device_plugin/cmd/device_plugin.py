@@ -93,6 +93,17 @@ def build_parser() -> argparse.ArgumentParser:
              "on --debug-host at PORT; 0 disables (default)",
     )
     p.add_argument(
+        "--flight-record-dir", dest="flight_record_dir",
+        default=os.environ.get(constants.ENV_FLIGHT_RECORD_DIR, ""),
+        metavar="DIR",
+        help="dump the flight-recorder event journal (Allocate spans, "
+             "device demotions/recoveries, slice transitions) as JSON "
+             "lines to DIR on exit/SIGTERM — mount a hostPath here in "
+             "the DaemonSet so post-mortems survive the pod.  Empty "
+             "disables the dump (the in-memory ring and /debug/traces "
+             f"stay on).  Env override: {constants.ENV_FLIGHT_RECORD_DIR}",
+    )
+    p.add_argument(
         "--debug-host", default="127.0.0.1", metavar="ADDR",
         help="bind address for --debug-port (default loopback; set "
              "0.0.0.0 so Prometheus can scrape /metrics from the pod "
@@ -156,12 +167,15 @@ def _metadata_coords(topo):
     return ()
 
 
-def setup_slice(args, impl, driver_type, registry=None):
+def setup_slice(args, impl, driver_type, registry=None, recorder=None):
     """Wire slice coordination when --slice-rendezvous is set: serve the
     coordinator if this is the named host, attach a client to the impl,
     start its background join+heartbeat loop.  *registry* (the node's
     obs.Registry) turns the slice metrics set on — the plugin debug
     /metrics scrape then carries join/heartbeat/membership series.
+    *recorder* (the node's FlightRecorder) journals membership
+    transitions and, on the rendezvous host, every member's
+    join/heartbeat with its trace-id.
     Returns (coordinator|None, client|None)."""
     from tpu_k8s_device_plugin.slice import SliceClient, SliceCoordinator
 
@@ -193,6 +207,7 @@ def setup_slice(args, impl, driver_type, registry=None):
             bind_address=f"[::]:{port_s}",
             state_path=args.slice_state_file,
             registry=registry,
+            recorder=recorder,
         ).start()
         log.info("this host (%s) serves the slice rendezvous", hostname)
     client = SliceClient(
@@ -203,6 +218,7 @@ def setup_slice(args, impl, driver_type, registry=None):
         state_path=args.slice_state_file,
         local_health_fn=impl.local_health,
         registry=registry,
+        recorder=recorder,
     )
     impl.set_slice_client(client)
     client.start()
@@ -235,15 +251,18 @@ def main(argv=None) -> int:
     log.info("driver=%s resources=%s", driver_type,
              [f"{constants.RESOURCE_NAMESPACE}/{r}" for r in resources])
 
-    # the node's ONE metrics registry: plugin histograms, slice
-    # metrics, and the debug /metrics surface all render from it
+    # the node's ONE metrics registry + flight recorder: plugin
+    # histograms, slice metrics, the debug /metrics surface, and the
+    # event journal behind /debug/traces all hang off this pair
     from tpu_k8s_device_plugin import obs
     registry = obs.Registry()
+    recorder = obs.FlightRecorder(registry=registry)
 
     coordinator = client = None
     if args.slice_rendezvous:
         coordinator, client = setup_slice(args, impl, driver_type,
-                                          registry=registry)
+                                          registry=registry,
+                                          recorder=recorder)
 
     manager = PluginManager(
         impl,
@@ -251,6 +270,7 @@ def main(argv=None) -> int:
         kubelet_dir=args.kubelet_dir,
         slice_client=client,
         registry=registry,
+        recorder=recorder,
     )
     debug_server = None
     if args.debug_port:
@@ -261,6 +281,11 @@ def main(argv=None) -> int:
     # path as Ctrl-C so streams get the stop signal and the endpoint socket
     # is unlinked (≈ main.go signal handling)
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+    if args.flight_record_dir:
+        # AFTER the sys.exit handler: the recorder's chaining SIGTERM
+        # handler dumps the journal first, then delegates to it (and
+        # atexit covers every orderly exit path)
+        recorder.install_dump_handlers(args.flight_record_dir)
     try:
         manager.run(block=True)
     finally:

@@ -62,7 +62,8 @@ def read_ue_count(sysfs_root: str, pci_address: str) -> Optional[int]:
 
 def render_metrics(sysfs_root: str = "/sys", dev_root: str = "/dev",
                    scrapes: int = 0,
-                   registry: Optional[obs.Registry] = None) -> str:
+                   registry: Optional[obs.Registry] = None,
+                   openmetrics: bool = False) -> str:
     """One scrape: probe every chip and render the exposition text
     through the shared :class:`obs.Registry` renderer.
 
@@ -116,7 +117,7 @@ def render_metrics(sysfs_root: str = "/sys", dev_root: str = "/dev",
         "tpu_exporter_probe_seconds",
         "One full probe walk (discovery + per-chip sysfs state).",
         buckets=obs.FAST_BUCKETS_S).observe(probe_dt)
-    return reg.render()
+    return reg.render(openmetrics=openmetrics)
 
 
 class MetricsHTTPServer:
@@ -155,17 +156,24 @@ class MetricsHTTPServer:
                 with outer._lock:
                     outer._scrapes += 1
                     n = outer._scrapes
+                # OpenMetrics negotiation for parity with the other
+                # surfaces (the exporter records no exemplars today,
+                # but a scraper asking for the format must get a
+                # format-valid body with the # EOF terminator)
+                om = obs.negotiate_openmetrics(
+                    self.headers.get("Accept"))
                 try:
                     body = render_metrics(
                         outer._sysfs_root, outer._dev_root, scrapes=n,
-                        registry=outer.registry)
+                        registry=outer.registry, openmetrics=om)
                 except Exception:  # scrape must not kill the daemon
                     log.exception("metrics scrape failed")
                     self._send(500, "text/plain",
                                "scrape failed; see exporter logs\n")
                     return
                 self._send(200,
-                           "text/plain; version=0.0.4; charset=utf-8",
+                           obs.OPENMETRICS_CONTENT_TYPE if om
+                           else obs.TEXT_CONTENT_TYPE,
                            body)
 
             def _send(self, code, ctype, body: str):

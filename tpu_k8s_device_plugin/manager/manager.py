@@ -107,6 +107,7 @@ class PluginManager:
         kubelet_watch_interval_s: float = 1.0,
         slice_client=None,
         registry: Optional[obs.Registry] = None,
+        recorder: Optional[obs.FlightRecorder] = None,
     ):
         self.impl = device_impl
         self.pulse = pulse_seconds
@@ -115,6 +116,13 @@ class PluginManager:
         # pulse rounds, slice metrics (when the CLI shares it), and the
         # debug endpoint's bridged status snapshot all render from here
         self.registry = registry if registry is not None else obs.Registry()
+        # the node's ONE flight recorder: Allocate/ListAndWatch spans,
+        # device demotions/recoveries, pulse rounds, and (when the CLI
+        # shares it) slice membership transitions journal here; the
+        # debug /debug/traces and /debug/events endpoints read it and
+        # --flight-record-dir dumps it on exit/SIGTERM
+        self.recorder = (recorder if recorder is not None
+                         else obs.FlightRecorder(registry=self.registry))
         self._plugin_metrics = PluginMetrics(self.registry)
         self._m_pulse = self.registry.histogram(
             "tpu_plugin_pulse_round_seconds",
@@ -224,7 +232,8 @@ class PluginManager:
                 return
             ctx = DevicePluginContext(resource, BestEffortPolicy())
             plugin = TpuDevicePlugin(self.impl, ctx,
-                                     metrics=self._plugin_metrics)
+                                     metrics=self._plugin_metrics,
+                                     recorder=self.recorder)
             plugin.start()
             sp = _ServedPlugin(
                 resource,
@@ -351,8 +360,16 @@ class PluginManager:
         after a rediscovery is what pushes the changed device list down
         every open ListAndWatch stream."""
         while not self._stop.wait(self.pulse):
+            # every pulse round is a ROOT trace: the slice heartbeat it
+            # drives carries the same trace-id over gRPC, so one id
+            # links a local probe to the coordinator's verdict
+            ctx = obs.new_trace()
+            with self._plugins_lock:
+                resources = sorted(self._plugins)
             with obs.span("tpu_plugin_pulse_round",
-                          histogram=self._m_pulse, logger=log):
+                          histogram=self._m_pulse, logger=log,
+                          trace=ctx, recorder=self.recorder) as sp:
+                sp.annotate(resources=",".join(resources) or "-")
                 self._maybe_rediscover()
                 if self.slice_client is not None:
                     # heartbeat first: ships the fresh local probe to the
@@ -361,7 +378,8 @@ class PluginManager:
                     # anywhere reaches every member within one
                     # pulse+heartbeat)
                     try:
-                        self.slice_client.heartbeat_now()
+                        self.slice_client.heartbeat_now(
+                            trace=ctx.child())
                     except Exception as e:
                         log.warning("slice heartbeat failed: %s", e)
                 with self._plugins_lock:

@@ -1,15 +1,21 @@
-"""Unified metrics core: one registry, one renderer, spans.
+"""Unified observability core: one registry, one renderer, spans,
+trace contexts, and the flight recorder.
 
 Every Prometheus surface in this repo (plugin debug endpoint, health
 exporter, serving server, slice metrics) renders through
-:class:`Registry`; see :mod:`.core` for the design notes and
-``docs/user-guide/observability.md`` for the full series reference.
+:class:`Registry`; request-scoped tracing rides :class:`TraceContext`
+(W3C ``traceparent``) through :class:`Span` log lines, OpenMetrics
+exemplars, and :class:`FlightRecorder` events.  See :mod:`.core` /
+:mod:`.trace` / :mod:`.recorder` for design notes and
+``docs/user-guide/observability.md`` for the full reference.
 """
 
 from .core import (
     FAST_BUCKETS_S,
     LATENCY_BUCKETS_S,
+    OPENMETRICS_CONTENT_TYPE,
     SLOW_BUCKETS_S,
+    TEXT_CONTENT_TYPE,
     Counter,
     Gauge,
     Histogram,
@@ -17,22 +23,39 @@ from .core import (
     escape_help,
     escape_label_value,
     histogram_quantile,
+    negotiate_openmetrics,
     parse_exposition,
 )
+from .recorder import Event, FlightRecorder
 from .span import Span, span
+from .trace import (
+    TraceContext,
+    new_trace,
+    parse_traceparent,
+    trace_from_header,
+)
 
 __all__ = [
     "FAST_BUCKETS_S",
     "LATENCY_BUCKETS_S",
+    "OPENMETRICS_CONTENT_TYPE",
     "SLOW_BUCKETS_S",
+    "TEXT_CONTENT_TYPE",
     "Counter",
+    "Event",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "Registry",
     "Span",
+    "TraceContext",
     "escape_help",
     "escape_label_value",
     "histogram_quantile",
+    "negotiate_openmetrics",
+    "new_trace",
     "parse_exposition",
+    "parse_traceparent",
     "span",
+    "trace_from_header",
 ]

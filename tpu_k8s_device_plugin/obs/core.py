@@ -28,10 +28,26 @@ import logging
 import math
 import re
 import threading
+import time
 from bisect import bisect_left
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 log = logging.getLogger(__name__)
+
+# Content types for the two exposition modes.  Every /metrics handler
+# negotiates via the Accept header: the OpenMetrics type unlocks
+# exemplars (last trace-id per histogram bucket) and the `# EOF`
+# terminator; the default text exposition stays byte-identical to
+# pre-exemplar output so promlint and existing scrapes never change.
+TEXT_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8")
+
+
+def negotiate_openmetrics(accept: Optional[str]) -> bool:
+    """True when the Accept header asks for the OpenMetrics exposition
+    (what a Prometheus server scraping with exemplar support sends)."""
+    return bool(accept) and "application/openmetrics-text" in accept
 
 _METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
@@ -125,7 +141,8 @@ class _Child:
 class _HistChild:
     """One labeled series of a histogram family."""
 
-    __slots__ = ("_lock", "_bounds", "_counts", "_sum", "_count")
+    __slots__ = ("_lock", "_bounds", "_counts", "_sum", "_count",
+                 "_exemplars")
 
     def __init__(self, lock: threading.Lock, bounds: Tuple[float, ...]):
         self._lock = lock
@@ -133,11 +150,19 @@ class _HistChild:
         self._counts = [0] * len(bounds)
         self._sum = 0.0
         self._count = 0
+        # bucket index -> (trace_id, observed value, wall time): the
+        # LAST traced observation per bucket, rendered as an
+        # OpenMetrics exemplar so a dashboard's slow bucket links to a
+        # concrete /debug/traces entry.  None until a traced observe.
+        self._exemplars: Optional[Dict[int, Tuple[str, float, float]]] \
+            = None
 
-    def observe(self, value: float) -> None:
-        self.observe_n(value, 1)
+    def observe(self, value: float, trace_id: Optional[str] = None
+                ) -> None:
+        self.observe_n(value, 1, trace_id=trace_id)
 
-    def observe_n(self, value: float, n: int) -> None:
+    def observe_n(self, value: float, n: int,
+                  trace_id: Optional[str] = None) -> None:
         """Record *n* observations of *value* under one lock hop — the
         per-window token path records a whole window at once."""
         if n < 1:
@@ -147,10 +172,18 @@ class _HistChild:
             self._counts[i] += n
             self._sum += value * n
             self._count += n
+            if trace_id:
+                if self._exemplars is None:
+                    self._exemplars = {}
+                self._exemplars[i] = (trace_id, value, time.time())
 
     def snapshot(self) -> Tuple[List[int], float, int]:
         with self._lock:
             return list(self._counts), self._sum, self._count
+
+    def exemplars(self) -> Dict[int, Tuple[str, float, float]]:
+        with self._lock:
+            return dict(self._exemplars) if self._exemplars else {}
 
 
 class _Family:
@@ -234,7 +267,7 @@ class Counter(_Family):
     def value(self) -> float:
         return self._default().value
 
-    def render(self, out: List[str]) -> None:
+    def render(self, out: List[str], openmetrics: bool = False) -> None:
         for key, child in self._sorted_children():
             out.append(_sample(self.name, self.labelnames, key,
                                child.value))
@@ -258,7 +291,7 @@ class Gauge(_Family):
     def value(self) -> float:
         return self._default().value
 
-    def render(self, out: List[str]) -> None:
+    def render(self, out: List[str], openmetrics: bool = False) -> None:
         for key, child in self._sorted_children():
             out.append(_sample(self.name, self.labelnames, key,
                                child.value))
@@ -282,22 +315,40 @@ class Histogram(_Family):
     def _make_child(self):
         return _HistChild(threading.Lock(), self.buckets)
 
-    def observe(self, value: float) -> None:
-        self._default().observe(value)
+    def observe(self, value: float,
+                trace_id: Optional[str] = None) -> None:
+        self._default().observe(value, trace_id=trace_id)
 
-    def observe_n(self, value: float, n: int) -> None:
-        self._default().observe_n(value, n)
+    def observe_n(self, value: float, n: int,
+                  trace_id: Optional[str] = None) -> None:
+        self._default().observe_n(value, n, trace_id=trace_id)
 
-    def render(self, out: List[str]) -> None:
+    @property
+    def top_finite_bucket(self) -> float:
+        """Highest finite bound — the anchor for slow-span escalation
+        (Span's default WARNING threshold is 5x this)."""
+        finite = [b for b in self.buckets if b != math.inf]
+        return finite[-1] if finite else 0.0
+
+    def render(self, out: List[str], openmetrics: bool = False) -> None:
         for key, child in self._sorted_children():
             counts, total, count = child.snapshot()
+            # exemplars render ONLY under the OpenMetrics content type:
+            # the plain text exposition must stay byte-compatible with
+            # pre-exemplar scrapes (and promlint-clean)
+            ex = child.exemplars() if openmetrics else {}
             cum = 0
-            for bound, c in zip(self.buckets, counts):
+            for i, (bound, c) in enumerate(zip(self.buckets, counts)):
                 cum += c
-                out.append(_sample(
+                line = _sample(
                     self.name + "_bucket",
                     self.labelnames + ("le",),
-                    key + (_fmt_le(bound),), cum))
+                    key + (_fmt_le(bound),), cum)
+                if i in ex:
+                    tid, val, ts = ex[i]
+                    line += (f' # {{trace_id="{escape_label_value(tid)}"'
+                             f"}} {_fmt_value(val)} {ts:.3f}")
+                out.append(line)
             out.append(_sample(self.name + "_sum", self.labelnames,
                                key, total))
             out.append(_sample(self.name + "_count", self.labelnames,
@@ -365,8 +416,14 @@ class Registry:
         with self._lock:
             self._collectors.append(fn)
 
-    def render(self) -> str:
-        """The whole registry in Prometheus text exposition format."""
+    def render(self, openmetrics: bool = False) -> str:
+        """The whole registry in exposition format.  Plain mode is the
+        Prometheus text format, unchanged.  *openmetrics* adds histogram
+        exemplars (last trace-id per bucket) and the ``# EOF``
+        terminator — serve it only under
+        :data:`OPENMETRICS_CONTENT_TYPE` (see
+        :func:`negotiate_openmetrics`) so plain-text scrapers never see
+        an exemplar."""
         with self._lock:
             collectors = list(self._collectors)
             families = sorted(self._families.values(),
@@ -381,12 +438,14 @@ class Registry:
         out: List[str] = []
         for fam in families:
             samples: List[str] = []
-            fam.render(samples)
+            fam.render(samples, openmetrics=openmetrics)
             if not samples:
                 continue
             out.append(f"# HELP {fam.name} {escape_help(fam.help)}")
             out.append(f"# TYPE {fam.name} {fam.kind}")
             out.extend(samples)
+        if openmetrics:
+            out.append("# EOF")
         return "\n".join(out) + "\n"
 
 

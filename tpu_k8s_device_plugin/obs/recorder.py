@@ -1,0 +1,255 @@
+"""Flight recorder: a bounded in-memory event journal with crash dumps.
+
+Histograms answer "how slow is the p99"; the flight recorder answers
+"what happened to THIS request" and — crucially — "what happened in the
+last minute before the process died".  It is the black-box layer the
+production accelerator stacks in PAPERS.md pair with their metrics:
+
+- a fixed-memory ring of :class:`Event` records (drop-oldest on
+  overflow, with a dropped-events counter on the owning registry so the
+  loss is itself observable),
+- every ``Span.end`` plus discrete lifecycle events (device
+  demotion/recovery, membership transitions, 429 sheds, slow-client
+  drops, grammar-cap rejections) land here, each stamped with trace-id,
+  monotonic + wall time, and key=value attrs,
+- read paths: ``events()`` / ``trace()`` snapshots feed the
+  ``/debug/events`` and ``/debug/traces`` endpoints,
+- crash safety: ``install_dump_handlers()`` wires atexit + a chaining
+  SIGTERM handler (and a faulthandler file for hard crashes) that write
+  the journal as JSON-lines to ``--flight-record-dir``, so a post-mortem
+  survives the process that produced it.
+
+Thread-safe, stdlib only, and deliberately cheap: one lock hop and one
+deque append per event — safe to call from the serving scheduler loop.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import logging
+import os
+import signal
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+DEFAULT_CAPACITY = 4096
+
+
+class Event:
+    """One journal entry (see module docstring)."""
+
+    __slots__ = ("name", "trace_id", "span_id", "t_wall", "t_mono",
+                 "attrs")
+
+    def __init__(self, name: str, trace_id: str = "", span_id: str = "",
+                 attrs: Optional[Dict[str, object]] = None):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.t_wall = time.time()
+        self.t_mono = time.monotonic()
+        self.attrs = attrs or {}
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "t_wall": self.t_wall,
+            "t_mono": self.t_mono,
+            "attrs": self.attrs,
+        }
+
+
+def _jsonable(v):
+    """Attrs must survive json.dumps in a signal-time dump; anything
+    exotic degrades to its str() at RECORD time, not dump time."""
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return str(v)
+
+
+class FlightRecorder:
+    """Thread-safe bounded ring journal (see module docstring)."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY, registry=None):
+        if capacity < 1:
+            raise ValueError("recorder capacity must be >= 1")
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=capacity)
+        self._recorded = 0
+        self._dropped = 0
+        # loss is observable: the registry (when the owning surface has
+        # one) carries the totals next to the latency histograms the
+        # events annotate
+        self._m_events = self._m_dropped = None
+        if registry is not None:
+            self._m_events = registry.counter(
+                "tpu_flight_events_total",
+                "Events recorded into the flight-recorder ring.")
+            self._m_dropped = registry.counter(
+                "tpu_flight_dropped_events_total",
+                "Events evicted from the full flight-recorder ring "
+                "(drop-oldest).")
+        self._dump_paths: List[str] = []
+        self._dump_installed = False
+
+    # -- write path ---------------------------------------------------------
+
+    def record(self, name: str, trace=None, trace_id: str = "",
+               span_id: str = "", **attrs) -> None:
+        """Append one event.  *trace* (a TraceContext) wins over the
+        explicit id strings; attrs are sanitized to JSON scalars now so
+        a SIGTERM-time dump can never fail on a live object."""
+        if trace is not None:
+            trace_id = trace.trace_id
+            span_id = trace.span_id
+        ev = Event(name, trace_id=trace_id, span_id=span_id,
+                   attrs={k: _jsonable(v) for k, v in attrs.items()})
+        with self._lock:
+            if len(self._ring) == self.capacity:
+                self._dropped += 1
+                if self._m_dropped is not None:
+                    self._m_dropped.inc()
+            self._ring.append(ev)  # deque(maxlen) evicts the oldest
+            self._recorded += 1
+        if self._m_events is not None:
+            self._m_events.inc()
+
+    # -- read paths ---------------------------------------------------------
+
+    @property
+    def recorded(self) -> int:
+        with self._lock:
+            return self._recorded
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def events(self, since: float = 0.0, trace_id: Optional[str] = None,
+               name: Optional[str] = None,
+               limit: int = 1000) -> List[dict]:
+        """Snapshot of matching events, oldest first.  *since* filters
+        on wall time (the /debug/events?since= contract), *trace_id*
+        on the stamped trace, *name* on the event name."""
+        with self._lock:
+            snap = list(self._ring)
+        out = []
+        for ev in snap:
+            if ev.t_wall <= since:
+                continue
+            if trace_id is not None and ev.trace_id != trace_id:
+                continue
+            if name is not None and ev.name != name:
+                continue
+            out.append(ev.to_dict())
+        return out[-limit:] if limit else out
+
+    def trace_ids(self, limit: int = 64) -> List[dict]:
+        """The most recent distinct trace ids with event counts —
+        the /debug/traces index view."""
+        with self._lock:
+            snap = list(self._ring)
+        counts: Dict[str, int] = {}
+        last: Dict[str, float] = {}
+        for ev in snap:
+            if not ev.trace_id:
+                continue
+            counts[ev.trace_id] = counts.get(ev.trace_id, 0) + 1
+            last[ev.trace_id] = ev.t_wall
+        order = sorted(counts, key=lambda t: last[t], reverse=True)
+        return [{"trace_id": t, "events": counts[t], "last_t_wall": last[t]}
+                for t in order[:limit]]
+
+    # -- crash dumps --------------------------------------------------------
+
+    def dump(self, path: str) -> int:
+        """Write the journal as JSON-lines to *path* (one event per
+        line, oldest first).  Returns the event count written."""
+        with self._lock:
+            snap = list(self._ring)
+            dropped = self._dropped
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            # header line first: a dump that was truncated mid-write is
+            # still identifiable, and the drop count frames what's missing
+            f.write(json.dumps({
+                "flight_record": True, "pid": os.getpid(),
+                "events": len(snap), "dropped": dropped,
+                "t_wall": time.time(),
+            }) + "\n")
+            for ev in snap:
+                f.write(json.dumps(ev.to_dict()) + "\n")
+        os.replace(tmp, path)  # a crash mid-dump never leaves half a file
+        return len(snap)
+
+    def dump_to_dir(self, dir_path: str) -> Optional[str]:
+        """One dump file in *dir_path*, named by pid + wall time so
+        restarts never clobber the post-mortem they should explain."""
+        try:
+            os.makedirs(dir_path, exist_ok=True)
+            path = os.path.join(
+                dir_path,
+                f"flight-{os.getpid()}-{int(time.time())}.jsonl")
+            self.dump(path)
+            return path
+        except OSError as e:
+            log.error("flight-record dump to %s failed: %s", dir_path, e)
+            return None
+
+    def install_dump_handlers(self, dir_path: str,
+                              signals=(signal.SIGTERM,)) -> None:
+        """Dump the journal on process exit: atexit (clean exits and
+        sys.exit paths), a CHAINING handler on each listed signal
+        (k8s sends SIGTERM on pod shutdown), and a faulthandler file in
+        *dir_path* for hard crashes the interpreter can still report.
+        Idempotent; signal installation is skipped off the main thread
+        (library embedders) — atexit still covers them."""
+        if self._dump_installed:
+            return
+        self._dump_installed = True
+
+        def _dump_once(_done=[False]):
+            if _done[0]:
+                return
+            _done[0] = True
+            path = self.dump_to_dir(dir_path)
+            if path:
+                log.info("flight record dumped to %s", path)
+
+        atexit.register(_dump_once)
+        try:
+            import faulthandler
+            os.makedirs(dir_path, exist_ok=True)
+            f = open(os.path.join(
+                dir_path, f"faulthandler-{os.getpid()}.log"), "w")
+            faulthandler.enable(file=f)
+        except (OSError, RuntimeError) as e:
+            log.warning("faulthandler file unavailable: %s", e)
+        for sig in signals:
+            try:
+                prev = signal.getsignal(sig)
+
+                def _handler(signum, frame, _prev=prev):
+                    _dump_once()
+                    if callable(_prev):
+                        _prev(signum, frame)
+                    elif _prev != signal.SIG_IGN:
+                        # default disposition: terminate with the
+                        # conventional 128+sig status
+                        raise SystemExit(128 + signum)
+
+                signal.signal(sig, _handler)
+            except (ValueError, OSError) as e:
+                # not the main thread, or an unsupported signal: the
+                # atexit hook still covers orderly shutdown
+                log.warning("cannot install dump handler for %s: %s",
+                            sig, e)

@@ -8,9 +8,13 @@ introspection a Go binary would get for free —
   GET /debug/status   JSON: served resources, per-device health, RPC
                       counters, topology summary
   GET /debug/threads  all-thread stack dump (the goroutine-dump analog)
+  GET /debug/traces   flight-recorder timelines (?trace_id=… for one
+                      trace, index of recent traces without it)
+  GET /debug/events   the raw event journal (?since=<unix seconds>)
   GET /metrics        the same counters in Prometheus exposition format
                       (per-resource RPC counters, device health rollups,
-                      degraded-allocation count)
+                      degraded-allocation count); the OpenMetrics Accept
+                      type adds trace-id exemplars
 
 Disabled unless --debug-port is set; binds loopback only (it exposes
 internal state and has no auth — same posture as Go's default pprof
@@ -26,6 +30,7 @@ import threading
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, TYPE_CHECKING
+from urllib.parse import parse_qs, urlparse
 
 from tpu_k8s_device_plugin import __version__, obs
 
@@ -124,15 +129,18 @@ def update_plugin_metrics(manager: "PluginManager",
             cname, f"Device-impl counter {name} (node-wide).")._set(value)
 
 
-def render_plugin_metrics(manager: "PluginManager") -> str:
+def render_plugin_metrics(manager: "PluginManager",
+                          openmetrics: bool = False) -> str:
     """The plugin debug /metrics body: the manager's obs.Registry
     (Allocate/frame/pulse histograms, slice metrics) plus the bridged
-    status snapshot, through the one shared renderer."""
+    status snapshot, through the one shared renderer.  *openmetrics*
+    adds trace-id exemplars + ``# EOF`` (serve only under the
+    OpenMetrics content type)."""
     registry = getattr(manager, "registry", None)
     if registry is None:  # bare managers in tests / external embedders
         registry = obs.Registry()
     update_plugin_metrics(manager, registry)
-    return registry.render()
+    return registry.render(openmetrics=openmetrics)
 
 
 class DebugServer:
@@ -155,9 +163,10 @@ class DebugServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
-                if self.path == "/healthz":
+                url = urlparse(self.path)
+                if url.path == "/healthz":
                     self._send(200, "text/plain", "ok\n")
-                elif self.path == "/debug/status":
+                elif url.path == "/debug/status":
                     try:
                         body = json.dumps(manager_status(manager), indent=2)
                         self._send(200, "application/json", body + "\n")
@@ -169,14 +178,48 @@ class DebugServer:
                         log.exception("/debug/status failed")
                         self._send(500, "text/plain",
                                    "internal error; see plugin logs\n")
-                elif self.path == "/debug/threads":
+                elif url.path == "/debug/threads":
                     self._send(200, "text/plain", thread_dump())
-                elif self.path == "/metrics":
+                elif url.path in ("/debug/traces", "/debug/events"):
+                    recorder = getattr(manager, "recorder", None)
+                    if recorder is None:
+                        self._send(404, "application/json", json.dumps(
+                            {"error": "no flight recorder on this "
+                                      "manager"}) + "\n")
+                        return
+                    q = parse_qs(url.query)
+                    if url.path == "/debug/traces":
+                        tid = q.get("trace_id", [None])[0]
+                        if tid:
+                            body = {"trace_id": tid,
+                                    "events": recorder.events(
+                                        trace_id=tid)}
+                        else:
+                            body = {"traces": recorder.trace_ids()}
+                    else:
+                        try:
+                            since = float(q.get("since", ["0"])[0])
+                        except ValueError:
+                            self._send(400, "application/json",
+                                       json.dumps({
+                                           "error": "'since' must be "
+                                           "a unix timestamp"}) + "\n")
+                            return
+                        body = {"since": since,
+                                "dropped": recorder.dropped,
+                                "events": recorder.events(since=since)}
+                    self._send(200, "application/json",
+                               json.dumps(body, indent=2) + "\n")
+                elif url.path == "/metrics":
+                    om = obs.negotiate_openmetrics(
+                        self.headers.get("Accept"))
                     try:
                         self._send(
                             200,
-                            "text/plain; version=0.0.4; charset=utf-8",
-                            render_plugin_metrics(manager),
+                            obs.OPENMETRICS_CONTENT_TYPE if om
+                            else obs.TEXT_CONTENT_TYPE,
+                            render_plugin_metrics(manager,
+                                                  openmetrics=om),
                         )
                     except Exception:
                         log.exception("/metrics render failed")

@@ -22,7 +22,11 @@ from tpu_k8s_device_plugin.proto import (
     deviceplugin_pb2 as pluginapi,
     deviceplugin_pb2_grpc as pluginapi_grpc,
 )
-from tpu_k8s_device_plugin.types import DeviceImpl, DevicePluginContext
+from tpu_k8s_device_plugin.types import (
+    DeviceImpl,
+    DevicePluginContext,
+    constants,
+)
 
 log = logging.getLogger(__name__)
 
@@ -55,10 +59,15 @@ class TpuDevicePlugin(pluginapi_grpc.DevicePluginServicer):
     """One instance serves one resource name."""
 
     def __init__(self, device_impl: DeviceImpl, ctx: DevicePluginContext,
-                 metrics: Optional[PluginMetrics] = None):
+                 metrics: Optional[PluginMetrics] = None,
+                 recorder: Optional[obs.FlightRecorder] = None):
         self.impl = device_impl
         self.ctx = ctx
         self.metrics = metrics
+        # flight recorder (PR 4): Allocate spans and device health
+        # transitions journal here so a post-mortem can say WHICH
+        # device demoted, when, and in which trace
+        self.recorder = recorder
         self._lock = threading.Lock()
         self._watchers: List[queue.Queue] = []
         self._stopped = False
@@ -82,6 +91,24 @@ class TpuDevicePlugin(pluginapi_grpc.DevicePluginServicer):
         """Consistent copy of the RPC counters (debug surface)."""
         with self._lock:
             return dict(self.rpc_counts)
+
+    def _record_health_diff(self, prev, devices, trace) -> None:
+        """Journal per-device health transitions between two
+        ListAndWatch frames: the discrete demotion/recovery events a
+        post-mortem needs (the gauges only show the rollup)."""
+        if self.recorder is None or prev is None:
+            return
+        prev_map = {d.ID: d.health for d in prev}
+        for d in devices:
+            old = prev_map.get(d.ID)
+            if old is None or old == d.health:
+                continue
+            self.recorder.record(
+                "tpu_device_recovered" if d.health == constants.HEALTHY
+                else "tpu_device_demoted",
+                trace=trace, device=d.ID,
+                resource=self.ctx.resource_name(),
+                health=d.health, was=old)
 
     # -- lifecycle signalling (≈ plugin.go heartbeat/signal channels) -------
 
@@ -116,6 +143,10 @@ class TpuDevicePlugin(pluginapi_grpc.DevicePluginServicer):
         """Initial device list, then health-refreshed resends on every
         heartbeat (≈ plugin.go:146-170)."""
         t0 = time.perf_counter()
+        # one ROOT trace per stream: every frame and health transition
+        # this stream produces shares it, so "what happened on this
+        # kubelet watch" is a single /debug/traces query
+        stream_trace = obs.new_trace()
         try:
             devices = self.impl.enumerate(self.ctx)
         except Exception as e:
@@ -139,6 +170,15 @@ class TpuDevicePlugin(pluginapi_grpc.DevicePluginServicer):
                 self.metrics.frame_seconds.labels(
                     resource=self.ctx.resource_name()).observe(
                         time.perf_counter() - t0)
+            if self.recorder is not None:
+                self.recorder.record(
+                    "tpu_plugin_list_and_watch_frame",
+                    trace=stream_trace,
+                    resource=self.ctx.resource_name(),
+                    devices=len(devices),
+                    unhealthy=sum(d.health != constants.HEALTHY
+                                  for d in devices),
+                    duration_s=time.perf_counter() - t0)
             yield frame
             while context.is_active():
                 msg = q.get()
@@ -162,6 +202,8 @@ class TpuDevicePlugin(pluginapi_grpc.DevicePluginServicer):
                         self.metrics.probe_seconds.labels(
                             resource=self.ctx.resource_name()).observe(
                                 time.perf_counter() - t0)
+                self._record_health_diff(self.last_devices, devices,
+                                         stream_trace)
                 self.last_devices = devices
                 frame = pluginapi.ListAndWatchResponse(devices=devices)
                 if self.metrics:
@@ -184,16 +226,22 @@ class TpuDevicePlugin(pluginapi_grpc.DevicePluginServicer):
 
     def Allocate(self, request, context):
         self._count("allocate")
-        # span: latency histogram + a request-tagged log line per grant
-        # (outcome=error when impl.allocate raises → context.abort)
+        # span: latency histogram + a trace-tagged log line per grant
+        # (outcome=error when impl.allocate raises → context.abort).
+        # Each Allocate opens a ROOT trace tagged with the granted
+        # device ids: the id in the span line / exemplar / recorder
+        # event is what stitches a pod's placement to later demotions
+        device_ids = [d for cr in request.container_requests
+                      for d in cr.devices_ids]
         with obs.span(
             "tpu_plugin_allocate",
             histogram=self.metrics.allocate_seconds if self.metrics
             else None,
             labels={"resource": self.ctx.resource_name()},
-            logger=log,
+            logger=log, trace=obs.new_trace(), recorder=self.recorder,
         ) as sp:
-            sp.annotate(containers=len(request.container_requests))
+            sp.annotate(containers=len(request.container_requests),
+                        devices=",".join(device_ids) or "-")
             try:
                 return self.impl.allocate(self.ctx, request)
             except Exception as e:

@@ -26,6 +26,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import grpc
 
+from tpu_k8s_device_plugin import obs
 from tpu_k8s_device_plugin.proto import (
     slice_pb2 as slicepb,
     slice_pb2_grpc as slicepb_grpc,
@@ -42,6 +43,14 @@ LocalHealthFn = Callable[[], Tuple[bool, str]]
 _JOIN_BACKOFF_INITIAL_S = 0.5
 _JOIN_BACKOFF_MAX_S = 15.0
 _RPC_TIMEOUT_S = 10.0
+
+
+def _trace_metadata(trace):
+    """gRPC metadata carrying the W3C traceparent (the HTTP header's
+    metadata analog), or () when the caller runs untraced."""
+    if trace is None:
+        return ()
+    return (("traceparent", trace.to_traceparent()),)
 
 
 def _membership_from_msg(m: slicepb.Membership) -> Optional[Membership]:
@@ -67,9 +76,13 @@ class SliceClient:
         state_path: Optional[str] = constants.SLICE_STATE_FILE,
         local_health_fn: Optional[LocalHealthFn] = None,
         registry=None,
+        recorder=None,
     ):
         self._address = rendezvous_address
         self.hostname = hostname or socket.gethostname()
+        # flight recorder (PR 4): membership transitions and learned
+        # verdicts journal here with the trace that delivered them
+        self._recorder = recorder
         # slice metrics (PR 3): join duration, learned-verdict
         # transitions, and this host's own heartbeat age (refreshed at
         # scrape time).  On the rendezvous host the coordinator shares
@@ -112,8 +125,10 @@ class SliceClient:
     def _channel(self) -> grpc.Channel:
         return grpc.insecure_channel(self._address)
 
-    def _join_once(self) -> Optional[Membership]:
-        """One Join poll; returns the membership when formed."""
+    def _join_once(self, trace=None) -> Optional[Membership]:
+        """One Join poll; returns the membership when formed.  *trace*
+        rides the gRPC metadata as a ``traceparent`` entry so the
+        coordinator's join span shares this member's trace."""
         with self._channel() as ch:
             stub = slicepb_grpc.SliceRendezvousStub(ch)
             resp = stub.Join(
@@ -124,6 +139,7 @@ class SliceClient:
                     session=self._session,
                 ),
                 timeout=_RPC_TIMEOUT_S,
+                metadata=_trace_metadata(trace),
             )
         if not resp.formed:
             log.info(
@@ -142,9 +158,13 @@ class SliceClient:
         backoff = _JOIN_BACKOFF_INITIAL_S
         if self._join_started is None:
             self._join_started = time.monotonic()
+        # one root trace covers the whole join (every poll carries it),
+        # so the coordinator's view of this host's formation is one
+        # /debug/traces query on the rendezvous node
+        join_trace = obs.new_trace()
         while not self._stop.is_set():
             try:
-                membership = self._join_once()
+                membership = self._join_once(trace=join_trace)
             except grpc.RpcError as e:
                 code = e.code() if hasattr(e, "code") else None
                 if code == grpc.StatusCode.FAILED_PRECONDITION:
@@ -157,7 +177,7 @@ class SliceClient:
                          "%.1fs", self._address, code, backoff)
                 membership = None
             if membership is not None:
-                self._adopt(membership)
+                self._adopt(membership, trace=join_trace)
                 return membership
             if deadline is not None and time.monotonic() >= deadline:
                 raise TimeoutError(
@@ -169,7 +189,7 @@ class SliceClient:
             backoff = min(backoff * 2, _JOIN_BACKOFF_MAX_S)
         raise RuntimeError("slice client stopped before the slice formed")
 
-    def _adopt(self, membership: Membership) -> None:
+    def _adopt(self, membership: Membership, trace=None) -> None:
         with self._lock:
             prior = self._membership
             self._membership = membership
@@ -181,6 +201,12 @@ class SliceClient:
                 time.monotonic() - self._join_started)
         if prior is None or prior.generation != membership.generation:
             rank = membership.rank_of(self.hostname)
+            if self._recorder is not None:
+                self._recorder.record(
+                    "tpu_slice_membership_adopted", trace=trace,
+                    slice_id=membership.slice_id,
+                    generation=membership.generation,
+                    rank=rank, workers=membership.num_workers)
             log.info(
                 "slice %s gen %d: rank %s of %d, coordinator %s",
                 membership.slice_id, membership.generation, rank,
@@ -195,18 +221,20 @@ class SliceClient:
 
     # -- heartbeat ----------------------------------------------------------
 
-    def heartbeat_now(self) -> None:
+    def heartbeat_now(self, trace=None) -> None:
         """One synchronous heartbeat: probe local health, report it, learn
         the slice verdict.  Joins first if the slice hasn't formed yet (a
         single non-blocking attempt).  Called from the manager's pulse
-        loop and from the background thread; errors degrade to 'no
-        verdict change', never raise."""
+        loop (which passes its pulse-round trace, so the coordinator's
+        heartbeat span shares it) and from the background thread; errors
+        degrade to 'no verdict change', never raise."""
+        ctx = trace if trace is not None else obs.new_trace()
         try:
             if self.membership is None:
-                membership = self._join_once()
+                membership = self._join_once(trace=ctx)
                 if membership is None:
                     return
-                self._adopt(membership)
+                self._adopt(membership, trace=ctx)
             healthy, reason = True, ""
             if self._local_health_fn is not None:
                 try:
@@ -227,6 +255,7 @@ class SliceClient:
                         generation=self.membership.generation,
                     ),
                     timeout=_RPC_TIMEOUT_S,
+                    metadata=_trace_metadata(ctx),
                 )
         except grpc.RpcError as e:
             # An unreachable coordinator is NOT a slice-wide Unhealthy
@@ -239,7 +268,7 @@ class SliceClient:
             return
         fresh = _membership_from_msg(resp.membership)
         if fresh is not None:
-            self._adopt(fresh)
+            self._adopt(fresh, trace=ctx)
         self._last_beat = time.monotonic()
         with self._lock:
             prior = self._slice_healthy
@@ -252,6 +281,17 @@ class SliceClient:
                 self.metrics.transition(
                     "verdict_recovered" if resp.slice_healthy
                     else "verdict_demoted")
+            if self._recorder is not None:
+                # the learned-verdict flip IS the demotion/recovery
+                # moment on this host — journal it with the heartbeat's
+                # trace so the post-mortem links it to the pulse round
+                self._recorder.record(
+                    "tpu_slice_verdict_recovered" if resp.slice_healthy
+                    else "tpu_slice_verdict_demoted",
+                    trace=ctx,
+                    slice_id=(self.membership.slice_id
+                              if self.membership else ""),
+                    unhealthy=",".join(resp.unhealthy_hostnames) or "-")
             log.warning(
                 "slice %s -> %s%s",
                 self.membership.slice_id if self.membership else "?",

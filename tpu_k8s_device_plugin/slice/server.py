@@ -17,6 +17,7 @@ from typing import Optional
 
 import grpc
 
+from tpu_k8s_device_plugin import obs
 from tpu_k8s_device_plugin.proto import (
     slice_pb2 as slicepb,
     slice_pb2_grpc as slicepb_grpc,
@@ -25,6 +26,21 @@ from tpu_k8s_device_plugin.types import constants
 from .state import Membership, SliceState
 
 log = logging.getLogger(__name__)
+
+
+def _trace_from_context(context):
+    """Continue the member's trace from the RPC metadata (the client
+    sends a ``traceparent`` entry — the gRPC analog of the HTTP
+    header), or open a fresh root for untraced callers."""
+    header = None
+    try:
+        for key, value in context.invocation_metadata():
+            if key == "traceparent":
+                header = value
+                break
+    except Exception:  # metadata access is best-effort, never fatal
+        pass
+    return obs.trace_from_header(header)
 
 
 def _membership_msg(m: Optional[Membership]) -> slicepb.Membership:
@@ -40,11 +56,16 @@ def _membership_msg(m: Optional[Membership]) -> slicepb.Membership:
 
 
 class _Servicer(slicepb_grpc.SliceRendezvousServicer):
-    def __init__(self, state: SliceState, lock: threading.Lock):
+    def __init__(self, state: SliceState, lock: threading.Lock,
+                 recorder=None):
         self._state = state
         self._lock = lock
+        self._recorder = recorder
 
     def Join(self, request, context):
+        # the member's trace rides the RPC metadata: the coordinator's
+        # join record shares it, so one id greps across both hosts
+        trace = _trace_from_context(context)
         with self._lock:
             res = self._state.join(
                 hostname=request.hostname,
@@ -53,6 +74,16 @@ class _Servicer(slicepb_grpc.SliceRendezvousServicer):
                 session=request.session,
                 now=time.monotonic(),
             )
+        if self._recorder is not None:
+            self._recorder.record(
+                "tpu_slice_join", trace=trace,
+                hostname=request.hostname, formed=res.formed,
+                joined=res.joined, expected=res.expected,
+                error=res.error or "")
+        log.debug("span=tpu_slice_join trace_id=%s hostname=%s "
+                  "formed=%s joined=%d/%d", trace.trace_id,
+                  request.hostname, res.formed, res.joined,
+                  res.expected)
         if res.error and res.membership is None:
             # a non-member knocking on a full-but-unformed slice, or a
             # malformed request: refuse loudly so the operator sees a
@@ -74,6 +105,7 @@ class _Servicer(slicepb_grpc.SliceRendezvousServicer):
         )
 
     def Heartbeat(self, request, context):
+        trace = _trace_from_context(context)
         with self._lock:
             view = self._state.heartbeat(
                 hostname=request.hostname,
@@ -81,6 +113,16 @@ class _Servicer(slicepb_grpc.SliceRendezvousServicer):
                 reason=request.reason,
                 now=time.monotonic(),
             )
+        if self._recorder is not None:
+            self._recorder.record(
+                "tpu_slice_heartbeat", trace=trace,
+                hostname=request.hostname, healthy=request.healthy,
+                reason=request.reason or "",
+                slice_healthy=view.slice_healthy)
+        log.debug("span=tpu_slice_heartbeat trace_id=%s hostname=%s "
+                  "healthy=%s slice_healthy=%s", trace.trace_id,
+                  request.hostname, request.healthy,
+                  view.slice_healthy)
         return slicepb.HeartbeatResponse(
             slice_healthy=view.slice_healthy,
             unhealthy_hostnames=view.unhealthy_hostnames,
@@ -99,8 +141,13 @@ class SliceCoordinator:
         state_path: Optional[str] = constants.SLICE_STATE_FILE,
         heartbeat_timeout_s: float = constants.SLICE_HEARTBEAT_TIMEOUT_S,
         registry=None,
+        recorder=None,
     ):
         self._lock = threading.Lock()
+        # flight recorder (PR 4): join/heartbeat events land here with
+        # each MEMBER'S trace-id from the RPC metadata — the
+        # coordinator's journal is the slice-wide timeline
+        self.recorder = recorder
         # slice metrics (PR 3): formation/transition counters, the
         # demotion-propagation histogram, and a scrape-time collector
         # refreshing per-member heartbeat ages.  The CLI passes the
@@ -134,7 +181,8 @@ class SliceCoordinator:
             concurrent.futures.ThreadPoolExecutor(max_workers=8)
         )
         slicepb_grpc.add_SliceRendezvousServicer_to_server(
-            _Servicer(self.state, self._lock), self._server
+            _Servicer(self.state, self._lock, recorder=self.recorder),
+            self._server
         )
         self.port = self._server.add_insecure_port(self._bind_address)
         if self.port == 0:

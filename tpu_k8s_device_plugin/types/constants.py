@@ -190,3 +190,9 @@ SLICE_HEARTBEAT_TIMEOUT_S = 30.0
 # than per-node args).
 ENV_SLICE_RENDEZVOUS = "TPU_DP_SLICE_RENDEZVOUS"
 ENV_SLICE_WORKERS = "TPU_DP_SLICE_WORKERS"
+
+# Flight recorder (PR 4): where the crash-safe event-journal dump lands
+# on exit/SIGTERM.  The DaemonSet mounts a hostPath here so the
+# post-mortem survives the pod; empty disables the dump.
+FLIGHT_RECORD_DIR = "/var/lib/tpu-flight-records"
+ENV_FLIGHT_RECORD_DIR = "TPU_DP_FLIGHT_RECORD_DIR"

@@ -343,6 +343,46 @@ def _http_burst(port, n_burst: int, tokens, lock):
     return statuses
 
 
+def _print_slowest_traces(port, traced, k=3):
+    """The bench explains its own tail: pull the *k* slowest benched
+    requests' server-side timelines from ``/debug/traces`` and print
+    each one's span breakdown — queue wait vs TTFT vs decode windows vs
+    stream writes — so a bad p99 comes with its own diagnosis."""
+    import http.client
+    import json as _json
+
+    for latency, tid in sorted(traced, reverse=True)[:k]:
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=30)
+            conn.request("GET", f"/debug/traces?trace_id={tid}")
+            body = _json.loads(conn.getresponse().read())
+            conn.close()
+        except OSError as e:
+            print(f"slow-trace {tid}: /debug/traces failed: {e}",
+                  flush=True)
+            continue
+        sums: dict = {}
+        counts: dict = {}
+        for ev in body.get("events", []):
+            d = ev.get("attrs", {}).get("duration_s")
+            if isinstance(d, (int, float)):
+                sums[ev["name"]] = sums.get(ev["name"], 0.0) + d
+                counts[ev["name"]] = counts.get(ev["name"], 0) + 1
+        parts = [f"total={latency * 1e3:.1f}ms"]
+        for name, label in (
+                ("tpu_serve_queue_wait", "queue_wait"),
+                ("tpu_serve_admit", "admit"),
+                ("tpu_serve_ttft", "ttft"),
+                ("tpu_serve_window", "windows"),
+                ("tpu_serve_stream_write", "stream_writes")):
+            if name in sums:
+                parts.append(
+                    f"{label}={sums[name] * 1e3:.1f}ms"
+                    + (f"/{counts[name]}x" if counts[name] > 1 else ""))
+        print(f"slow-trace {tid}: " + " ".join(parts), flush=True)
+
+
 def _http_throughput(model, params, prompt, steps, clients,
                      n_requests, slots, cancel_every: int = 0,
                      burst: int = 0):
@@ -360,6 +400,8 @@ def _http_throughput(model, params, prompt, steps, clients,
     import time
 
     import numpy as np
+
+    from tpu_k8s_device_plugin import obs
 
     from .server import EngineServer
     from .serving import ServingEngine
@@ -387,6 +429,7 @@ def _http_throughput(model, params, prompt, steps, clients,
     srv.start(host="127.0.0.1", port=0)
     lock = threading.Lock()
     ttfts, tpots, done_tokens, errors = [], [], [], []
+    traced = []  # (request latency, trace_id) for the tail breakdown
     cancelled = [0]
     seq = iter(range(n_requests))
 
@@ -402,12 +445,17 @@ def _http_throughput(model, params, prompt, steps, clients,
                 # mixed priorities: odd requests jump the queue
                 "priority": i % 2,
             })
+            # a fresh traceparent per benched request: the server-side
+            # trace (queue wait, admit, windows, stream writes) becomes
+            # queryable by the id THIS client chose
+            trace = obs.new_trace()
             conn = http.client.HTTPConnection(
                 "127.0.0.1", srv.port, timeout=600)
             t0 = time.perf_counter()
             try:
                 conn.request("POST", "/generate", body,
-                             {"Content-Type": "application/json"})
+                             {"Content-Type": "application/json",
+                              "traceparent": trace.to_traceparent()})
                 resp = conn.getresponse()
                 first = last = None
                 n_toks = 0
@@ -446,6 +494,7 @@ def _http_throughput(model, params, prompt, steps, clients,
                                 tpots.append(
                                     (last - first) / (n_toks - 1))
                             done_tokens.append(len(ev["tokens"]))
+                            traced.append((now - t0, trace.trace_id))
             finally:
                 conn.close()
 
@@ -486,6 +535,9 @@ def _http_throughput(model, params, prompt, steps, clients,
         mconn.request("GET", "/metrics")
         metrics_body = mconn.getresponse().read().decode()
         mconn.close()
+        # the tail explained: span breakdowns for the 3 slowest traced
+        # requests, straight from the server's flight recorder
+        _print_slowest_traces(srv.port, traced)
     finally:
         # a failure mid-bench must not leak the live server/engine
         # into the rest of the process
@@ -520,8 +572,6 @@ def _http_throughput(model, params, prompt, steps, clients,
     }
     # server-side percentiles, estimated from the scraped histogram
     # buckets (what PromQL histogram_quantile would show a dashboard)
-    from tpu_k8s_device_plugin import obs
-
     hist_samples = obs.parse_exposition(metrics_body)
     for key, hname in (("hist_ttft", "tpu_serve_ttft_seconds"),
                        ("hist_tpot", "tpu_serve_token_seconds"),

@@ -61,7 +61,20 @@ API (JSON over HTTP/1.1):
                    message/delta objects in the chat wire shape.
   GET  /healthz    liveness ("ok").
   GET  /stats      engine + server counters (JSON).
-  GET  /metrics    the same counters in Prometheus exposition format.
+  GET  /metrics    the same counters in Prometheus exposition format
+                   (Accept: application/openmetrics-text adds trace-id
+                   exemplars on the latency histograms).
+  GET  /debug/traces[?trace_id=…]   per-request event timelines from
+                   the flight recorder (index view without the param).
+  GET  /debug/events[?since=…]      the raw journal after a wall-time
+                   stamp (429 sheds, drops, grammar rejections, spans).
+
+Tracing: requests may carry a W3C ``traceparent`` header; the server
+continues that trace (or opens a fresh root) through admission, queue
+wait, run_scan windows, and stream writes, echoes the id back in
+``X-Trace-Id``/``traceparent`` response headers and OpenAI ``id``s,
+and journals every hop in the flight recorder (dumped to
+``--flight-record-dir`` on exit/SIGTERM).
 
 Token ids in, token ids out by default: tokenization is the caller's
 business and the engine's contract stays exact and model-agnostic.
@@ -94,6 +107,7 @@ import time
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, HTTPServer
 from typing import List, Optional
+from urllib.parse import parse_qs, urlparse
 
 from tpu_k8s_device_plugin import obs
 
@@ -428,14 +442,19 @@ class _Request:
     # pattern); the scheduler registers it with the engine at admit
     grammar_key: Optional[str] = None      # cache key (the pattern)
     grammar_tdfa: object = None            # compiled, pre-registration
-    # request tracing (PR 3): the span observes
+    # request tracing (PR 3/4): the span observes
     # tpu_serve_request_seconds{outcome} exactly once per request and
     # leaves a request_id-tagged log line; t_arrival anchors the
-    # queue-wait and TTFT histograms
+    # queue-wait and TTFT histograms.  trace is the request's
+    # TraceContext (continued from the caller's traceparent header or a
+    # fresh root): it tags every span log line, flight-recorder event,
+    # and OpenMetrics exemplar this request produces, and is echoed in
+    # the response headers / OpenAI ids
     rid: str = ""
     t_arrival: float = 0.0
     span: object = None
     ttft_observed: bool = False
+    trace: object = None
 
 
 class _PooledHTTPServer(HTTPServer):
@@ -464,13 +483,15 @@ class _PooledHTTPServer(HTTPServer):
                b"Connection: close\r\n\r\n" % len(_REJECT_BODY)
                ) + _REJECT_BODY
 
-    def __init__(self, addr, handler, workers: int, shed_counter=None):
+    def __init__(self, addr, handler, workers: int, shed_counter=None,
+                 recorder=None):
         super().__init__(addr, handler)
         self._conns: "queue.Queue" = queue.Queue(maxsize=workers)
         # 429s shed at accept: an obs counter child when the owning
         # EngineServer wires one (tpu_serve_shed_total{reason=
         # "connections"}), a plain int for standalone embedders
         self._shed = shed_counter
+        self._recorder = recorder
         self._rejected_fallback = 0
         self._pool = [
             threading.Thread(target=self._worker,
@@ -488,6 +509,13 @@ class _PooledHTTPServer(HTTPServer):
                 self._shed.inc()
             else:
                 self._rejected_fallback += 1
+            if self._recorder is not None:
+                # no request (and so no trace) exists yet at accept
+                # time: the shed is still a journal-worthy lifecycle
+                # event for the post-mortem timeline
+                self._recorder.record("tpu_serve_shed",
+                                      reason="connections",
+                                      peer=str(client_address[0]))
             try:
                 request.settimeout(0.5)
                 request.sendall(self._REJECT)
@@ -558,7 +586,9 @@ class EngineServer:
                  max_connections: int = 64,
                  max_events: int = 256,
                  max_grammar_states: int = 8192,
-                 client_timeout: float = 120.0):
+                 client_timeout: float = 120.0,
+                 flight_record_dir: Optional[str] = None,
+                 flight_record_capacity: int = 4096):
         """*tokenizer* (anything with ``encode(str) -> List[int]`` and
         ``decode(List[int]) -> str``, e.g. a transformers tokenizer)
         unlocks the text-level surface: ``"prompt"`` strings, STRING
@@ -668,6 +698,32 @@ class EngineServer:
             "tpu_serve_slow_client_drops_total",
             "Clients disconnected for not draining their stream "
             "(bounded event queue overflowed).")
+        # -- tracing + flight recorder (PR 4) -----------------------------
+        # every span end and lifecycle event (sheds, drops, grammar
+        # rejections) lands in this bounded ring, stamped with the
+        # request's trace-id; /debug/traces and /debug/events read it,
+        # and --flight-record-dir dumps it on exit/SIGTERM
+        self.recorder = obs.FlightRecorder(
+            capacity=flight_record_capacity, registry=reg)
+        self.flight_record_dir = flight_record_dir
+        if flight_record_dir:
+            self.recorder.install_dump_handlers(flight_record_dir)
+
+    def _mark(self, req: "_Request", name: str, duration_s: float,
+              **attrs) -> None:
+        """One traced sub-operation (queue wait, admit, window, stream
+        write): a flight-recorder event plus a span-style log line, both
+        carrying the request's trace-id — the breadcrumbs /debug/traces
+        stitches into a per-request timeline.  The matching histogram
+        observation stays at the call site (it may be a bulk observe)."""
+        self.recorder.record(name, trace=req.trace, rid=req.rid,
+                             duration_s=duration_s, **attrs)
+        if log.isEnabledFor(logging.DEBUG):
+            tid = req.trace.trace_id if req.trace is not None else ""
+            extra = " ".join(f"{k}={v}" for k, v in attrs.items())
+            log.debug("span=%s request_id=%s trace_id=%s "
+                      "duration_s=%.6f%s", name, req.rid, tid,
+                      duration_s, f" {extra}" if extra else "")
 
     # promoted ad-hoc ints: reads must keep working (tests, embedders)
     # while the obs counters are the single source of truth
@@ -760,8 +816,9 @@ class EngineServer:
                                                     None)
                     req.grammar_tdfa = None  # registered; drop the ref
                 if req.admitted == 0 and req.t_arrival:
-                    self._m_queue_wait.observe(
-                        time.perf_counter() - req.t_arrival)
+                    wait_dt = time.perf_counter() - req.t_arrival
+                    self._m_queue_wait.observe(wait_dt)
+                    self._mark(req, "tpu_serve_queue_wait", wait_dt)
                 t_admit = time.perf_counter()
                 slot = eng.admit(
                     req.tokens, temperature=req.temperature,
@@ -796,7 +853,10 @@ class EngineServer:
                 self._push(req, {"error": str(e), "code": 400})
                 self._finish_request(req, "rejected")
                 continue
-            self._m_admit.observe(time.perf_counter() - t_admit)
+            admit_dt = time.perf_counter() - t_admit
+            self._m_admit.observe(admit_dt)
+            self._mark(req, "tpu_serve_admit", admit_dt, slot=slot,
+                       copy=req.admitted)
             idx = req.admitted
             req.admitted += 1
             req.emitted[idx] = 0
@@ -823,6 +883,8 @@ class EngineServer:
                 req.dropped = True
                 req.cancelled = True
                 self._m_dropped.inc()
+                self.recorder.record("tpu_serve_slow_client_drop",
+                                     trace=req.trace, rid=req.rid)
                 self._finish_request(req, "dropped")
                 try:
                     req.events.get_nowait()
@@ -855,9 +917,14 @@ class EngineServer:
         new = tokens[seen:req.max_new_tokens]
         if new and not req.ttft_observed and req.t_arrival:
             # first generated token of ANY copy: the TTFT the client
-            # perceives (queue wait + prefill + first window)
+            # perceives (queue wait + prefill + first window); the
+            # trace-id rides along as the bucket's OpenMetrics exemplar
             req.ttft_observed = True
-            self._m_ttft.observe(time.perf_counter() - req.t_arrival)
+            ttft_dt = time.perf_counter() - req.t_arrival
+            self._m_ttft.observe(
+                ttft_dt,
+                trace_id=(req.trace.trace_id if req.trace else None))
+            self._mark(req, "tpu_serve_ttft", ttft_dt)
         st = None
         if (req.stop_strs or req.detokenize) and self.tokenizer:
             st = req.detok.setdefault(idx, _DetokState())
@@ -1082,6 +1149,8 @@ class EngineServer:
                     # window wall time spread over its k tokens,
                     # weighted by token count (one bulk observe)
                     self._m_token.observe_n(win_dt / k, k)
+                    self._mark(req, "tpu_serve_window", win_dt,
+                               tokens=k, slot=slot)
         # the scheduler owns _running/_head: it performs the shutdown
         # drain itself so stop() never mutates them while a device step
         # is still in flight (a stuck 5s join used to race here)
@@ -1116,19 +1185,26 @@ class EngineServer:
             timeout = server.client_timeout
 
             def do_GET(self):  # noqa: N802 (http.server API)
-                if self.path == "/healthz":
+                self._trace = None  # keep-alive: no stale echo
+                url = urlparse(self.path)
+                if url.path == "/healthz":
                     self._send(200, "text/plain", "ok\n")
-                elif self.path == "/stats":
+                elif url.path == "/stats":
                     body = json.dumps(server.stats(), indent=2)
                     self._send(200, "application/json", body + "\n")
-                elif self.path == "/metrics":
+                elif url.path == "/metrics":
                     # Prometheus exposition (vLLM's server exposes
                     # /metrics; scrape configs expect it from a
                     # serving pod): the obs registry — request/TTFT/
                     # per-token histograms, shed counters — plus the
-                    # bridged engine stats
+                    # bridged engine stats.  The OpenMetrics Accept
+                    # type additionally gets trace-id exemplars + EOF;
+                    # the plain exposition is byte-compatible with
+                    # pre-exemplar scrapes
+                    om = obs.negotiate_openmetrics(
+                        self.headers.get("Accept"))
                     try:
-                        body = server.render_metrics()
+                        body = server.render_metrics(openmetrics=om)
                     except Exception:
                         log.exception("/metrics render failed")
                         self._send(500, "text/plain",
@@ -1136,12 +1212,48 @@ class EngineServer:
                         return
                     self._send(
                         200,
-                        "text/plain; version=0.0.4; charset=utf-8",
+                        obs.OPENMETRICS_CONTENT_TYPE if om
+                        else obs.TEXT_CONTENT_TYPE,
                         body)
+                elif url.path == "/debug/traces":
+                    # ?trace_id=… -> that trace's event timeline;
+                    # without it, the recent-trace index
+                    q = parse_qs(url.query)
+                    tid = q.get("trace_id", [None])[0]
+                    if tid:
+                        body = {"trace_id": tid,
+                                "events": server.recorder.events(
+                                    trace_id=tid)}
+                    else:
+                        body = {"traces": server.recorder.trace_ids()}
+                    self._send(200, "application/json",
+                               json.dumps(body, indent=2) + "\n")
+                elif url.path == "/debug/events":
+                    # ?since=<wall seconds> -> events after that stamp
+                    q = parse_qs(url.query)
+                    try:
+                        since = float(q.get("since", ["0"])[0])
+                    except ValueError:
+                        self._send(400, "application/json", json.dumps(
+                            {"error": "'since' must be a unix "
+                                      "timestamp"}) + "\n")
+                        return
+                    body = {"since": since,
+                            "dropped": server.recorder.dropped,
+                            "events": server.recorder.events(
+                                since=since)}
+                    self._send(200, "application/json",
+                               json.dumps(body, indent=2) + "\n")
                 else:
                     self._send(404, "text/plain", "not found\n")
 
             def do_POST(self):  # noqa: N802
+                # trace intake: continue the caller's traceparent as a
+                # child context, or open a fresh root (malformed
+                # headers fall back, never reject); every response
+                # path echoes the trace-id back (see _send)
+                self._trace = obs.trace_from_header(
+                    self.headers.get("traceparent"))
                 if self.path == "/v1/completions":
                     self._openai_completions(chat=False)
                     return
@@ -1154,7 +1266,8 @@ class EngineServer:
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(length))
-                    req = server._parse_request(body)
+                    req = server._parse_request(body,
+                                                trace=self._trace)
                 except (ValueError, TypeError, KeyError) as e:
                     self._send(400, "application/json",
                                json.dumps({"error": str(e)}) + "\n")
@@ -1190,7 +1303,8 @@ class EngineServer:
                         raise ValueError(
                             "logprobs with stream=true is not "
                             "supported; request them unstreamed")
-                    req = server._parse_request(native)
+                    req = server._parse_request(native,
+                                                trace=self._trace)
                     if native.get("_lp_count") is not None:
                         # the client-requested count (may be 0): the
                         # response trims the engine's top list to it
@@ -1253,8 +1367,12 @@ class EngineServer:
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
                 self.send_header("Transfer-Encoding", "chunked")
+                self._trace_headers()
                 self.end_headers()
-                rid = f"cmpl-{id(req):x}"
+                # the completion id IS the trace id: a slow completion
+                # pasted into /debug/traces resolves without any
+                # id-to-id mapping step
+                rid = f"cmpl-{req.trace.trace_id}"
                 if chat:
                     # the chat stream contract: role arrives in the
                     # first chunk's delta, content in later deltas
@@ -1331,8 +1449,8 @@ class EngineServer:
                         self._send(
                             200, "application/json",
                             json.dumps(_openai_response(
-                                f"cmpl-{id(req):x}", model_name,
-                                req, ev, chat=chat,
+                                f"cmpl-{req.trace.trace_id}",
+                                model_name, req, ev, chat=chat,
                                 echo_text=echo_text)) + "\n")
                         return
 
@@ -1351,6 +1469,7 @@ class EngineServer:
                 self.send_header("Content-Type",
                                  "application/jsonlines")
                 self.send_header("Transfer-Encoding", "chunked")
+                self._trace_headers()
                 self.end_headers()
                 # the engine-rate write loop: drain every event the
                 # scheduler has already queued (pre-encoded window
@@ -1379,8 +1498,10 @@ class EngineServer:
                     t_w = time.perf_counter()
                     self.wfile.write(b"%x\r\n" % len(payload)
                                      + payload + b"\r\n")
-                    server._m_stream_write.observe(
-                        time.perf_counter() - t_w)
+                    write_dt = time.perf_counter() - t_w
+                    server._m_stream_write.observe(write_dt)
+                    server._mark(req, "tpu_serve_stream_write",
+                                 write_dt, bytes=len(payload))
                     if not terminal:
                         ev = req.events.get()
                 self.wfile.write(b"0\r\n\r\n")
@@ -1405,11 +1526,22 @@ class EngineServer:
                 self.wfile.write(f"{len(data):x}\r\n".encode()
                                  + data + b"\r\n")
 
+            def _trace_headers(self):
+                """Echo the request's trace back to the caller: the
+                raw id for greps (X-Trace-Id) and the propagable form
+                (traceparent) for clients that keep the chain going."""
+                ctx = getattr(self, "_trace", None)
+                if ctx is not None:
+                    self.send_header("X-Trace-Id", ctx.trace_id)
+                    self.send_header("traceparent",
+                                     ctx.to_traceparent())
+
             def _send(self, code, ctype, body: str):
                 data = body.encode()
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                self._trace_headers()
                 if code == 429:
                     # OpenAI rate-limit semantics: tell the client
                     # when to come back instead of letting it hammer
@@ -1422,7 +1554,8 @@ class EngineServer:
 
         self._httpd = _PooledHTTPServer((host, port), Handler,
                                         workers=self.max_connections,
-                                        shed_counter=self._shed_conns)
+                                        shed_counter=self._shed_conns,
+                                        recorder=self.recorder)
         threading.Thread(target=self._httpd.serve_forever,
                          name="serve-http", daemon=True).start()
         self._scheduler = threading.Thread(
@@ -1489,6 +1622,8 @@ class EngineServer:
                 full = False
         if full:
             self._shed_queue.inc()
+            self.recorder.record("tpu_serve_shed", trace=req.trace,
+                                 rid=req.rid, reason="queue")
             self._push(req, {
                 "error": f"admission queue full ({self.max_queue} "
                          "requests pending); retry later",
@@ -1524,6 +1659,9 @@ class EngineServer:
             tdfa = self._grammar_tdfas.get(pattern)
             if tdfa is None and self._grammar_count() >= \
                     self.max_grammars:
+                self.recorder.record("tpu_serve_grammar_rejected",
+                                     reason="cache_full",
+                                     patterns=self.max_grammars)
                 raise ValueError(
                     f"grammar cache full ({self.max_grammars} distinct "
                     "patterns); raise --max-grammars or reuse patterns")
@@ -1535,6 +1673,10 @@ class EngineServer:
                 # real vocabulary is the gigabytes-of-host-memory
                 # blowup the untrusted HTTP surface must not reach
                 # (ADVICE r5)
+                self.recorder.record("tpu_serve_grammar_rejected",
+                                     reason="states_cap",
+                                     states=len(cdfa.table),
+                                     bound=self.max_grammar_states)
                 raise ValueError(
                     f"pattern compiles to {len(cdfa.table)} DFA "
                     f"states, over the --max-grammar-states bound "
@@ -1550,6 +1692,9 @@ class EngineServer:
                 if pattern not in self._grammar_tdfas and \
                         pattern not in self._grammar_gids and \
                         self._grammar_count() >= self.max_grammars:
+                    self.recorder.record("tpu_serve_grammar_rejected",
+                                         reason="cache_full",
+                                         patterns=self.max_grammars)
                     raise ValueError(
                         f"grammar cache full ({self.max_grammars} "
                         "distinct patterns); raise --max-grammars or "
@@ -1585,6 +1730,9 @@ class EngineServer:
                 # client-supplied pattern text is attacker-controlled
                 # and subset construction is super-linear in it; the
                 # compiled-state bound still applies after this
+                self.recorder.record("tpu_serve_grammar_rejected",
+                                     reason="regex_len",
+                                     chars=len(regex))
                 raise ValueError(
                     f"'guided_regex' is {len(regex)} chars; the "
                     f"served bound is {_MAX_REGEX_LEN}")
@@ -1760,7 +1908,7 @@ class EngineServer:
             flat["logprobs"] = int(top_n or 0)
         return self._openai_to_native(flat)
 
-    def _parse_request(self, body: dict) -> _Request:
+    def _parse_request(self, body: dict, trace=None) -> _Request:
         tokens = body.get("tokens")
         prompt = body.get("prompt")
         detokenize = bool(body.get("detokenize", prompt is not None))
@@ -1887,13 +2035,18 @@ class EngineServer:
         # request tracing: the span starts at parse (its duration is
         # the full wire-visible latency) and ends exactly once at the
         # terminal outcome; the rid tags every structured log line
-        # (process-wide counter: unique across servers in one process)
+        # (process-wide counter: unique across servers in one process).
+        # The trace context (continued from the caller's traceparent or
+        # a fresh root) rides the span into its log line, the request
+        # histogram's exemplar, and the flight-recorder event
         req.rid = f"req-{next(_RID_COUNTER):x}"
+        req.trace = trace if trace is not None else obs.new_trace()
         req.t_arrival = time.perf_counter()
         req.span = obs.Span(
             "tpu_serve_request",
             histogram=getattr(self, "_m_request", None),
-            request_id=req.rid, logger=log,
+            request_id=req.rid, logger=log, trace=req.trace,
+            recorder=getattr(self, "recorder", None),
         ).annotate(prompt_tokens=len(tokens), n=n)
         return req
 
@@ -1920,11 +2073,13 @@ class EngineServer:
             st.update(self._httpd.pool_stats())
         return st
 
-    def render_metrics(self) -> str:
+    def render_metrics(self, openmetrics: bool = False) -> str:
         """The serving /metrics body: the obs registry (request spans,
         TTFT / per-token / queue-wait / admit / stream-write
         histograms, shed + drop counters) plus every numeric stats()
-        entry bridged as ``tpu_serving_<key>``.
+        entry bridged as ``tpu_serving_<key>``.  *openmetrics* adds
+        trace-id exemplars + the ``# EOF`` terminator (serve it only
+        under the OpenMetrics content type).
 
         Rename (PR 3, promlint): bridged MONOTONIC stats now carry the
         ``_total`` suffix counters require —
@@ -1948,7 +2103,7 @@ class EngineServer:
                     name,
                     f"Server/engine counter '{k}' (see /stats)."
                 )._set(v)
-        return reg.render()
+        return reg.render(openmetrics=openmetrics)
 
 
 def main(argv=None) -> int:
@@ -2004,6 +2159,14 @@ def main(argv=None) -> int:
     p.add_argument("--client-timeout", type=float, default=120.0,
                    help="per-connection socket timeout in seconds: a "
                         "stuck peer frees its pool worker")
+    p.add_argument("--flight-record-dir", default=None, metavar="DIR",
+                   help="dump the flight-recorder event journal (JSON "
+                        "lines) to DIR on exit/SIGTERM — the black-box "
+                        "post-mortem; unset disables the dump (the "
+                        "in-memory ring and /debug/traces stay on)")
+    p.add_argument("--flight-record-capacity", type=int, default=4096,
+                   help="flight-recorder ring size in events "
+                        "(drop-oldest past it)")
     p.add_argument("--jump-len", type=int, default=8,
                    help="structural jump-ahead width: up to this many "
                         "DFA-forced tokens (a schema's keys and "
@@ -2105,7 +2268,9 @@ def main(argv=None) -> int:
                        max_grammar_states=args.max_grammar_states,
                        max_queue=args.max_queue,
                        max_connections=args.max_connections,
-                       client_timeout=args.client_timeout)
+                       client_timeout=args.client_timeout,
+                       flight_record_dir=args.flight_record_dir,
+                       flight_record_capacity=args.flight_record_capacity)
     srv.start(host=args.host, port=args.port)
     print(f"serving {args.config} (quantized={quantized}) on "
           f"http://{args.host}:{srv.port}  "
