@@ -374,6 +374,54 @@ def test_rediscover_device_count_change_single_strategy(testdata, tmp_path):
     assert impl.rediscover() is False  # idempotent
 
 
+def test_kubelet_socket_flap_stress(kubelet, impl):
+    """Rapid kubelet delete/recreate cycles: exactly one
+    re-registration per recreate, and no leaked endpoint sockets or
+    plugin threads across the churn (PR 5 satellite)."""
+    import threading
+
+    m = PluginManager(
+        impl, pulse_seconds=0, kubelet_dir=kubelet.dir,
+        kubelet_watch_interval_s=0.05,
+    )
+    try:
+        m.run(block=False)
+        assert kubelet.wait_for_registration()
+        baseline_threads = threading.active_count()
+        cycles = 6
+        for i in range(cycles):
+            kubelet.register_event.clear()
+            kubelet.restart(wipe_dir=True)
+            assert kubelet.wait_for_registration(timeout=10.0), \
+                f"no re-registration after recreate {i + 1}"
+        # exactly one registration per recreate (plus the initial one):
+        # no duplicate storms, no missed cycles
+        assert len(kubelet.registrations) == cycles + 1
+        # no leaked sockets: the dp dir holds kubelet.sock + our one
+        # endpoint, nothing else
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            entries = sorted(os.listdir(kubelet.dir))
+            if entries == ["google.com_tpu", "kubelet.sock"]:
+                break
+            time.sleep(0.05)
+        assert sorted(os.listdir(kubelet.dir)) == \
+            ["google.com_tpu", "kubelet.sock"]
+        # no thread growth: the watch loop re-serves in place instead
+        # of spawning per flap (grpc's internal pool may wobble by a
+        # thread or two; a per-cycle leak would add >= cycles)
+        assert threading.active_count() <= baseline_threads + cycles - 1
+        # and the endpoint still answers
+        stub = kubelet.plugin_stub("google.com_tpu")
+        devs = next(iter(stub.ListAndWatch(pluginapi.Empty()))).devices
+        assert len(devs) == 8
+    finally:
+        m.stop()
+    # stop() joins its threads (PR 5 satellite): nothing it spawned
+    # may outlive it
+    assert m._threads == []
+
+
 def test_registration_survives_kubelet_downtime(impl, tmp_path):
     """Plugin comes up before the kubelet: retries fail, then the watch loop
     registers once the socket appears."""

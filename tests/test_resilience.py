@@ -1,0 +1,532 @@
+"""Resilience layer: retry/breaker/watchdog policies, deterministic
+fault injection, suppressed-error accounting, and the crash-containment
+paths they guard (manager thread joins, probe watchdog demotion, the
+serving scheduler supervisor).
+"""
+
+import inspect
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from tpu_k8s_device_plugin import obs, resilience
+from tpu_k8s_device_plugin.resilience import faults
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+import promlint  # noqa: E402
+
+
+# -- RetryPolicy -------------------------------------------------------------
+
+def test_retry_backoff_deterministic_per_seed():
+    a = resilience.RetryPolicy(jitter=0.3, seed=7)
+    b = resilience.RetryPolicy(jitter=0.3, seed=7)
+    c = resilience.RetryPolicy(jitter=0.3, seed=8)
+    sched_a = [a.backoff_s(i) for i in range(1, 6)]
+    sched_b = [b.backoff_s(i) for i in range(1, 6)]
+    sched_c = [c.backoff_s(i) for i in range(1, 6)]
+    assert sched_a == sched_b
+    assert sched_a != sched_c
+
+
+def test_retry_backoff_exponential_and_capped():
+    p = resilience.RetryPolicy(
+        initial_backoff_s=1.0, max_backoff_s=4.0, multiplier=2.0,
+        jitter=0.0)
+    assert [p.backoff_s(i) for i in range(1, 5)] == [1.0, 2.0, 4.0, 4.0]
+
+
+def test_retry_succeeds_after_transient_failures():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ValueError("transient")
+        return "ok"
+
+    p = resilience.RetryPolicy(max_attempts=5, initial_backoff_s=0.001,
+                               jitter=0.0)
+    reg = obs.Registry()
+    m = resilience.ResilienceMetrics(reg)
+    assert p.call(fn, op="t", retry_on=(ValueError,), metrics=m) == "ok"
+    assert len(calls) == 3
+    assert m.retries.labels(op="t").value == 2
+    assert m.giveups.labels(op="t").value == 0
+
+
+def test_retry_exhaustion_raises_last_and_counts_giveup():
+    p = resilience.RetryPolicy(max_attempts=3, initial_backoff_s=0.001,
+                               jitter=0.0)
+    reg = obs.Registry()
+    m = resilience.ResilienceMetrics(reg)
+    with pytest.raises(ValueError, match="always"):
+        p.call(lambda: (_ for _ in ()).throw(ValueError("always")),
+               op="t", retry_on=(ValueError,), metrics=m)
+    assert m.giveups.labels(op="t").value == 1
+
+
+def test_retry_non_retryable_propagates_immediately():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise KeyError("not transient")
+
+    p = resilience.RetryPolicy(max_attempts=5, initial_backoff_s=0.001)
+    with pytest.raises(KeyError):
+        p.call(fn, op="t", retry_on=(ValueError,))
+    assert len(calls) == 1
+
+
+def test_retry_deadline_stops_the_loop():
+    p = resilience.RetryPolicy(max_attempts=1000,
+                               initial_backoff_s=0.02, jitter=0.0,
+                               deadline_s=0.1)
+    t0 = time.monotonic()
+    with pytest.raises(ValueError):
+        p.call(lambda: (_ for _ in ()).throw(ValueError("x")), op="t",
+               retry_on=(ValueError,))
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_retry_stop_event_aborts_backoff():
+    stop = threading.Event()
+    calls = []
+
+    def fn():
+        calls.append(1)
+        stop.set()  # set mid-loop: the backoff wait must abort
+        raise ValueError("x")
+
+    p = resilience.RetryPolicy(max_attempts=100,
+                               initial_backoff_s=30.0, jitter=0.0)
+    t0 = time.monotonic()
+    with pytest.raises(ValueError):
+        p.call(fn, op="t", retry_on=(ValueError,), stop=stop)
+    assert time.monotonic() - t0 < 5.0
+    assert len(calls) == 1
+
+
+# -- CircuitBreaker ----------------------------------------------------------
+
+def test_breaker_opens_after_threshold_and_recovers():
+    reg = obs.Registry()
+    m = resilience.ResilienceMetrics(reg)
+    rec = obs.FlightRecorder(registry=reg)
+    br = resilience.CircuitBreaker("op1", failure_threshold=3,
+                                   reset_timeout_s=0.05, metrics=m,
+                                   recorder=rec)
+    boom = lambda: (_ for _ in ()).throw(RuntimeError("down"))  # noqa: E731
+    for _ in range(3):
+        with pytest.raises(RuntimeError):
+            br.call(boom)
+    assert br.state == resilience.BREAKER_OPEN
+    assert m.breaker_state.labels(op="op1").value == \
+        resilience.BREAKER_OPEN
+    # open: fail fast without running the callable
+    with pytest.raises(resilience.CircuitOpenError):
+        br.call(lambda: pytest.fail("must not run while open"))
+    # after the reset window ONE probe is admitted and closes it
+    time.sleep(0.06)
+    assert br.call(lambda: "alive") == "alive"
+    assert br.state == resilience.BREAKER_CLOSED
+    # transitions journaled for the chaos assertions
+    names = {e["attrs"]["to"] for e in
+             rec.events(name="tpu_breaker_transition")}
+    assert {"open", "half_open", "closed"} <= names
+
+
+def test_breaker_half_open_failure_reopens():
+    br = resilience.CircuitBreaker("op2", failure_threshold=1,
+                                   reset_timeout_s=0.02)
+    with pytest.raises(RuntimeError):
+        br.call(lambda: (_ for _ in ()).throw(RuntimeError("x")))
+    assert br.state == resilience.BREAKER_OPEN
+    time.sleep(0.03)
+    with pytest.raises(RuntimeError):
+        br.call(lambda: (_ for _ in ()).throw(RuntimeError("still")))
+    assert br.state == resilience.BREAKER_OPEN
+
+
+def test_breaker_admits_exactly_one_half_open_probe():
+    br = resilience.CircuitBreaker("op3", failure_threshold=1,
+                                   reset_timeout_s=0.01)
+    br.record_failure()
+    time.sleep(0.02)
+    assert br.allow()        # the probe slot
+    assert not br.allow()    # concurrent caller: refused
+    br.record_success()
+    assert br.allow()        # closed again
+
+
+# -- Watchdog ----------------------------------------------------------------
+
+def test_watchdog_passes_result_and_exceptions():
+    wd = resilience.Watchdog("w", timeout_s=5.0)
+    assert wd.call(lambda: 42) == 42
+    with pytest.raises(KeyError):
+        wd.call(lambda: (_ for _ in ()).throw(KeyError("inner")))
+
+
+def test_watchdog_abandons_hung_call_and_counts_trip():
+    reg = obs.Registry()
+    m = resilience.ResilienceMetrics(reg)
+    rec = obs.FlightRecorder(registry=reg)
+    wd = resilience.Watchdog("w2", timeout_s=0.05, metrics=m,
+                             recorder=rec)
+    release = threading.Event()
+    t0 = time.monotonic()
+    with pytest.raises(resilience.WatchdogTimeout):
+        wd.call(lambda: release.wait(10.0))
+    assert time.monotonic() - t0 < 5.0
+    assert m.watchdog_trips.labels(op="w2").value == 1
+    assert rec.events(name="tpu_watchdog_trip")
+    release.set()  # let the abandoned worker exit
+
+
+# -- suppressed-error accounting --------------------------------------------
+
+def test_suppressed_counts_by_site_and_renders_clean():
+    reg = obs.Registry()
+    m = resilience.ResilienceMetrics(reg)
+    resilience.suppressed("test.site", ValueError("swallowed"),
+                          metrics=m)
+    resilience.suppressed("test.site", OSError("again"), metrics=m)
+    assert m.suppressed.labels(site="test.site").value == 2
+    body = reg.render()
+    assert 'tpu_suppressed_errors_total{site="test.site"} 2' in body
+    assert promlint.lint(body) == []
+
+
+def test_resilience_families_promlint_clean():
+    """The satellite gate: every new resilience family renders through
+    the shared renderer promlint-clean, with populated series."""
+    reg = obs.Registry()
+    m = resilience.ResilienceMetrics(reg)
+    m.retries.labels(op="kubelet.register").inc()
+    m.giveups.labels(op="kubelet.register").inc()
+    m.breaker_state.labels(op="probe").set(resilience.BREAKER_OPEN)
+    m.breaker_transitions.labels(op="probe", to="open").inc()
+    m.watchdog_trips.labels(op="probe").inc()
+    m.suppressed.labels(site="manager.make_watcher").inc()
+    body = reg.render()
+    for fam in ("tpu_resilience_retries_total",
+                "tpu_resilience_giveups_total",
+                "tpu_breaker_state", "tpu_breaker_transitions_total",
+                "tpu_watchdog_trips_total",
+                "tpu_suppressed_errors_total"):
+        assert fam in body, fam
+    assert promlint.lint(body) == []
+
+
+# -- fault spec / injector ---------------------------------------------------
+
+def test_fault_spec_parses_the_documented_grammar():
+    spec = faults.FaultSpec.parse(
+        "slice.join:error:0.3;probe:hang:5;kubelet.register:drop:0.5")
+    assert [(r.op, r.kind) for r in spec.rules] == [
+        ("slice.join", "error"), ("probe", "hang"),
+        ("kubelet.register", "drop")]
+    assert spec.rules[0].prob == 0.3
+    assert spec.rules[1].arg == 5.0 and spec.rules[1].prob == 1.0
+    # optional hang probability as the 4th field
+    spec = faults.FaultSpec.parse("probe:hang:2:0.25")
+    assert spec.rules[0].arg == 2.0 and spec.rules[0].prob == 0.25
+
+
+@pytest.mark.parametrize("bad", [
+    "x:boom:1",         # unknown kind
+    "x:error:2",        # probability out of range
+    "x:hang:-1",        # negative hang
+    "x:error",          # missing arg
+    ":error:1",         # empty op
+    "x:error:0.5:0.5",  # error takes prob as arg, no 4th field
+    "x:error:abc",      # non-numeric arg
+])
+def test_fault_spec_rejects_malformed_rules(bad):
+    with pytest.raises(ValueError):
+        faults.FaultSpec.parse(bad)
+
+
+def test_injector_is_deterministic_per_seed():
+    spec = faults.FaultSpec.parse("op:error:0.4")
+
+    def run(seed):
+        inj = faults.FaultInjector(spec, seed=seed)
+        pattern = []
+        for _ in range(50):
+            try:
+                inj.fire("op")
+                pattern.append(0)
+            except faults.InjectedFault:
+                pattern.append(1)
+        return pattern
+
+    assert run(3) == run(3)
+    assert run(3) != run(4)
+
+
+def test_injector_counts_and_journals_fires():
+    reg = obs.Registry()
+    rec = obs.FlightRecorder(registry=reg)
+    inj = faults.FaultInjector(faults.FaultSpec.parse("op:drop:1"),
+                               seed=0, recorder=rec)
+    with pytest.raises(faults.InjectedFault):
+        inj.fire("op")
+    inj.fire("other.op")  # no rule: no-op
+    assert inj.fired == {"op:drop": 1}
+    assert inj.fired_count("op") == 1
+    [ev] = rec.events(name="tpu_fault_injected")
+    assert ev["attrs"]["op"] == "op" and ev["attrs"]["kind"] == "drop"
+
+
+def test_install_uninstall_and_env(monkeypatch):
+    assert faults.install("") is None and faults.ACTIVE is None
+    inj = faults.install("op:error:1", seed=5)
+    try:
+        assert faults.ACTIVE is inj and faults.active() is inj
+    finally:
+        faults.uninstall()
+    assert faults.ACTIVE is None
+    monkeypatch.setenv(faults.ENV_FAULTS, "op:hang:1")
+    monkeypatch.setenv(faults.ENV_FAULT_SEED, "9")
+    inj = faults.install_from_env()
+    try:
+        assert inj is not None and inj.seed == 9
+    finally:
+        faults.uninstall()
+
+
+# -- inert-when-unset: the acceptance-criteria no-op check -------------------
+
+def test_faults_disarmed_by_default():
+    assert faults.ACTIVE is None
+
+
+def test_hot_path_hooks_are_bare_attribute_checks():
+    """Every hot-path injection site must be the inline
+    ``if faults.ACTIVE is not None`` guard — one module-attribute load
+    and an identity test when disarmed, no function call."""
+    from tpu_k8s_device_plugin.health import client as health_client
+    from tpu_k8s_device_plugin.health import server as health_server
+    from tpu_k8s_device_plugin.manager import manager as manager_mod
+    from tpu_k8s_device_plugin.slice import client as slice_client
+    from tpu_k8s_device_plugin.workloads import server as serve_mod
+
+    guard = "if faults.ACTIVE is not None:"
+    for fn in (
+        serve_mod.EngineServer._scheduler_loop,
+        health_server.probe_chip_states,
+        slice_client.SliceClient._join_once,
+        slice_client.SliceClient.heartbeat_now,
+        manager_mod.PluginManager._register,
+        health_client.get_tpu_health,
+    ):
+        src = inspect.getsource(fn)
+        assert guard in src, f"{fn.__qualname__} lost the inline guard"
+        # and no unconditional fire() outside the guard
+        for line in src.splitlines():
+            if ".fire(" in line:
+                assert "ACTIVE" in line, fn.__qualname__
+
+
+# -- manager stop() joins its threads ----------------------------------------
+
+def test_manager_stop_joins_threads(testdata, tmp_path):
+    from tpu_k8s_device_plugin.manager import PluginManager
+    from tpu_k8s_device_plugin.tpu.device_impl import TpuContainerImpl
+
+    root = os.path.join(testdata, "v5e-8")
+    impl = TpuContainerImpl(
+        sysfs_root=os.path.join(root, "sys"),
+        dev_root=os.path.join(root, "dev"),
+        tpu_env_path=os.path.join(root, "run", "tpu", "tpu-env"),
+    )
+    m = PluginManager(impl, pulse_seconds=1,
+                      kubelet_dir=str(tmp_path / "dp"),
+                      kubelet_watch_interval_s=0.1)
+    os.makedirs(str(tmp_path / "dp"), exist_ok=True)
+    m.run(block=False)
+    spawned = list(m._threads)
+    assert spawned, "manager should have spawned watch + pulse threads"
+    m.stop()
+    for t in spawned:
+        assert not t.is_alive(), f"{t.name} leaked past stop()"
+    assert m._threads == []
+
+
+# -- probe watchdog: hung probe demotes within one call ----------------------
+
+def test_hung_probe_demotes_devices_within_one_pulse(testdata):
+    from tpu_k8s_device_plugin.tpu.device_impl import TpuContainerImpl
+    from tpu_k8s_device_plugin.types import DevicePluginContext, constants
+
+    release = threading.Event()
+    hang = {"on": True}
+
+    def probe():
+        if hang["on"]:
+            release.wait(10.0)
+        return {}
+
+    root = os.path.join(testdata, "v5e-8")
+    impl = TpuContainerImpl(
+        sysfs_root=os.path.join(root, "sys"),
+        dev_root=os.path.join(root, "dev"),
+        tpu_env_path=os.path.join(root, "run", "tpu", "tpu-env"),
+        health_fn=probe,
+        probe_watchdog_s=0.05,
+    )
+    ctx = DevicePluginContext("tpu")
+    impl.start(ctx)
+    impl.enumerate(ctx)
+    t0 = time.monotonic()
+    devs = impl.update_health(ctx)
+    assert time.monotonic() - t0 < 5.0, "pulse stalled on a hung probe"
+    assert devs and all(d.health == constants.UNHEALTHY for d in devs)
+    healthy, reason = impl.local_health()
+    assert not healthy and "hung" in reason
+    # recovery: the probe answers again -> devices re-promote
+    hang["on"] = False
+    release.set()
+    devs = impl.update_health(ctx)
+    assert all(d.health == constants.HEALTHY for d in devs)
+
+
+# -- serving scheduler crash containment -------------------------------------
+
+CFG = dict(vocab=128, d_model=64, n_heads=4, n_layers=2, d_ff=128)
+
+
+@pytest.fixture(scope="module")
+def engine_setup():
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_k8s_device_plugin.workloads.inference import make_decoder
+
+    model = make_decoder(**CFG, max_len=64, dtype=jnp.float32)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+    params = model.init(jax.random.PRNGKey(0), tokens, pos)["params"]
+    return model, params
+
+
+def _post(port, payload, timeout=120):
+    import http.client
+    import json
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", "/generate", json.dumps(payload),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _get(port, path):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def test_scheduler_crash_503s_then_supervisor_restarts(engine_setup):
+    from tpu_k8s_device_plugin.workloads.server import EngineServer
+    from tpu_k8s_device_plugin.workloads.serving import ServingEngine
+
+    model, params = engine_setup
+    eng = ServingEngine(model, params, n_slots=2)
+    srv = EngineServer(eng, max_new_tokens=8, window=4)
+    srv.start(host="127.0.0.1", port=0)
+    try:
+        status, _ = _post(srv.port, {"tokens": [1, 2, 3],
+                                     "max_new_tokens": 4,
+                                     "stream": False})
+        assert status == 200
+        faults.install("serve.step:error:1", seed=0,
+                       recorder=srv.recorder)
+        try:
+            status, body = _post(srv.port, {"tokens": [4, 5, 6],
+                                            "max_new_tokens": 4,
+                                            "stream": False})
+            assert status == 503, body
+        finally:
+            faults.uninstall()
+        assert srv.recorder.events(name="tpu_serve_scheduler_crash")
+        deadline = time.time() + 10.0
+        while (time.time() < deadline
+               and srv._m_sched_restarts.value < 1):
+            time.sleep(0.02)
+        assert srv._m_sched_restarts.value >= 1
+        assert srv.healthy()
+        status, _ = _get(srv.port, "/healthz")
+        assert status == 200
+        status, body = _post(srv.port, {"tokens": [7, 8, 9],
+                                        "max_new_tokens": 4,
+                                        "stream": False})
+        assert status == 200, body
+        # the crash is on /metrics too
+        body = srv.render_metrics()
+        assert "tpu_serve_scheduler_crashes_total 1" in body
+        assert promlint.lint(body) == []
+    finally:
+        faults.uninstall()
+        srv.stop()
+
+
+def test_scheduler_permanent_death_fails_healthz(engine_setup):
+    """Past the restart budget the server stops pretending: /healthz
+    503s and new requests answer an immediate 503."""
+    from tpu_k8s_device_plugin.workloads import server as serve_mod
+    from tpu_k8s_device_plugin.workloads.server import EngineServer
+    from tpu_k8s_device_plugin.workloads.serving import ServingEngine
+
+    model, params = engine_setup
+    eng = ServingEngine(model, params, n_slots=2)
+    srv = EngineServer(eng, max_new_tokens=8, window=4)
+    old = serve_mod._SCHED_MAX_RESTARTS
+    serve_mod._SCHED_MAX_RESTARTS = 2
+    srv.start(host="127.0.0.1", port=0)
+    try:
+        faults.install("serve.step:error:1", seed=0)
+        # each request crashes the loop once; the budget is 2
+        for _ in range(3):
+            status, _ = _post(srv.port, {"tokens": [1, 2],
+                                         "max_new_tokens": 4,
+                                         "stream": False})
+            assert status == 503
+            if srv._sched_dead:
+                break
+        deadline = time.time() + 10.0
+        while time.time() < deadline and not srv._sched_dead:
+            status, _ = _post(srv.port, {"tokens": [1, 2],
+                                         "max_new_tokens": 2,
+                                         "stream": False})
+            time.sleep(0.05)
+        assert srv._sched_dead
+        assert not srv.healthy()
+        status, body = _get(srv.port, "/healthz")
+        assert status == 503
+        status, body = _post(srv.port, {"tokens": [3],
+                                        "max_new_tokens": 2,
+                                        "stream": False})
+        assert status == 503
+        assert srv.recorder.events(name="tpu_serve_scheduler_dead")
+    finally:
+        faults.uninstall()
+        serve_mod._SCHED_MAX_RESTARTS = old
+        srv.stop()

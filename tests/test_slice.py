@@ -409,3 +409,54 @@ def test_slice_metrics_move_across_member_death():
                  (("kind", "slice_recovered"),))] == 1
     # the slice surface stays promlint-clean while it moves
     assert lint(reg.render()) == []
+
+
+def test_corrupt_membership_file_variants_load_as_none(tmp_path):
+    """A corrupt/truncated/alien state file means re-forming, never
+    crashing (PR 5 satellite): every breakage mode loads as None."""
+    p = str(tmp_path / "membership.json")
+    for payload in (
+        b"",                                  # empty
+        b"\x00\xff\xfe binary garbage",       # not JSON at all
+        b'{"version": 99, "hostnames": []}',  # unknown version
+        b'{"version": 1}',                    # missing fields
+        b'{"version": 1, "slice_id": "s", "generation": "NaNope", '
+        b'"hostnames": ["a"]}',               # wrong field type
+        b'{"version": 1, "slice_id": "s", "gen',  # truncated mid-write
+    ):
+        with open(p, "wb") as f:
+            f.write(payload)
+        assert load_membership(p) is None, payload
+
+
+def test_truncated_membership_file_recovery_over_grpc(hosts):
+    """A worker restarting onto a TRUNCATED state file (power loss
+    mid-disk-flush) must silently re-join and re-persist a clean file
+    with the same rank — the crash-safe contract end to end."""
+    _form(hosts)
+    a = hosts[0]
+    path = a.client._state_path
+    content = open(path).read()
+    with open(path, "w") as f:
+        f.write(content[: len(content) // 2])
+    assert load_membership(path) is None
+    restarted = SliceClient(
+        rendezvous_address=a.client._address,
+        hostname=a.name,
+        coords=(0,),
+        chip_count=len(a.impl.chips),
+        state_path=path,
+        join_backoff_initial_s=0.05,
+        join_backoff_max_s=0.2,
+    )
+    try:
+        # the corrupt file must not seed a membership
+        assert restarted.membership is None
+        m = restarted.join(timeout_s=10.0)
+        assert m.rank_of(a.name) == 0           # same rank, no re-form
+        assert m.generation == \
+            hosts[1].client.membership.generation
+        # and the state file is whole again
+        assert load_membership(path) == m
+    finally:
+        restarted.stop()

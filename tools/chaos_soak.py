@@ -1,0 +1,463 @@
+#!/usr/bin/env python
+"""Chaos soak: provoke every recovery path, assert reconvergence.
+
+Drives a REAL 2-host slice (coordinator + two plugin managers, each
+with its own fake kubelet over real gRPC sockets) plus a real serving
+engine through a seeded sweep of injected fault episodes:
+
+  1. kubelet.register drop   — every Register RPC lost; the retry
+                               policy burns its budget, then recovery
+                               re-registers on the next socket event
+  2. slice.join error        — join polls fail transiently; the
+                               jittered-backoff loop still forms
+  3. slice.heartbeat error   — total heartbeat loss; the breaker opens
+                               (fail-fast pulses), then closes on the
+                               half-open probe after the faults lift
+  4. probe hang              — the sysfs/libtpu probe wedges; the
+                               watchdog abandons it, devices demote
+                               within one pulse, recovery re-promotes
+  5. serve.step error        — the serving scheduler thread crashes;
+                               in-flight requests get 503, the
+                               supervisor restarts the loop, and the
+                               next request answers 200
+
+After every episode the system must reconverge: all devices
+re-advertised Healthy, the slice verdict healthy, serving answering
+200s — and the flight-recorder journals must contain the
+breaker/watchdog transition events that prove the resilience layer
+(not luck) did the recovering.
+
+Deterministic: ``--seed`` feeds the fault injector and every backoff
+jitter RNG, so a CI failure reproduces locally with the same seed
+(the ENGINE_FUZZ_SEED convention).
+
+Usage::
+
+    python tools/chaos_soak.py --seed 1            # full soak
+    python tools/chaos_soak.py --seed 1 --skip-serving   # no jax needed
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tests"))  # fake_kubelet
+
+from tpu_k8s_device_plugin import obs, resilience  # noqa: E402
+from tpu_k8s_device_plugin.health.server import probe_chip_states  # noqa: E402
+from tpu_k8s_device_plugin.manager import PluginManager  # noqa: E402
+from tpu_k8s_device_plugin.manager import manager as manager_mod  # noqa: E402
+from tpu_k8s_device_plugin.resilience import faults  # noqa: E402
+from tpu_k8s_device_plugin.slice import SliceClient, SliceCoordinator  # noqa: E402
+from tpu_k8s_device_plugin.tpu.device_impl import TpuContainerImpl  # noqa: E402
+from tpu_k8s_device_plugin.types import constants  # noqa: E402
+
+from fake_kubelet import FakeKubelet, ListAndWatchConsumer  # noqa: E402
+
+log = logging.getLogger("chaos-soak")
+
+_JAX_PORT = 8476
+PROBE_WATCHDOG_S = 0.5
+BREAKER_RESET_S = 0.2
+
+
+class ChaosHost:
+    """One slice member: fixture tree, impl (in-process sysfs probe),
+    slice client, fake kubelet, manager — all wired to one registry +
+    flight recorder so episodes can assert on the journal."""
+
+    def __init__(self, name, fixture, testdata, tmp, rendezvous, seed):
+        self.name = name
+        root = os.path.join(tmp, name)
+        shutil.copytree(os.path.join(testdata, fixture), root,
+                        symlinks=True)
+        self.sys_root = os.path.join(root, "sys")
+        self.dev_root = os.path.join(root, "dev")
+        self.registry = obs.Registry()
+        self.recorder = obs.FlightRecorder(registry=self.registry)
+        self.impl = TpuContainerImpl(
+            sysfs_root=self.sys_root,
+            dev_root=self.dev_root,
+            tpu_env_path=os.path.join(root, "run", "tpu", "tpu-env"),
+            health_fn=self._granular,
+            probe_watchdog_s=PROBE_WATCHDOG_S,
+        )
+        self.client = SliceClient(
+            rendezvous_address=rendezvous,
+            hostname=name,
+            coords=(self.impl.topology.worker_id,),
+            chip_count=len(self.impl.chips),
+            state_path=os.path.join(tmp, f"{name}-membership.json"),
+            local_health_fn=self.impl.local_health,
+            registry=self.registry,
+            recorder=self.recorder,
+            join_backoff_initial_s=0.05,
+            join_backoff_max_s=0.2,
+            breaker_reset_s=BREAKER_RESET_S,
+            seed=seed,
+        )
+        self.impl.set_slice_client(self.client)
+        self.kubelet = FakeKubelet(os.path.join(tmp, f"{name}-dp")).start()
+        self.manager = PluginManager(
+            self.impl,
+            pulse_seconds=0,
+            kubelet_dir=self.kubelet.dir,
+            kubelet_watch_interval_s=0.1,
+            slice_client=self.client,
+            registry=self.registry,
+            recorder=self.recorder,
+        )
+        self.consumer = None
+
+    def _granular(self):
+        states = probe_chip_states(self.sys_root, self.dev_root)
+        return {cid: st.health for cid, st in states.items()}
+
+    def pulse(self):
+        """One manual pulse round in the manager loop's order."""
+        self.client.heartbeat_now()
+        with self.manager._plugins_lock:
+            plugins = list(self.manager._plugins.values())
+        for sp in plugins:
+            sp.plugin.beat()
+
+    def open_stream(self):
+        stub = self.kubelet.plugin_stub("google.com_tpu")
+        self.consumer = ListAndWatchConsumer(stub)
+        return self.consumer.next_frame()
+
+    def wait_frame(self, predicate, pulses=10, timeout_s=10.0):
+        """Pulse until a ListAndWatch frame satisfies *predicate*."""
+        import queue as _q
+        deadline = time.time() + timeout_s
+        last = None
+        for _ in range(pulses):
+            self.pulse()
+            while time.time() < deadline:
+                try:
+                    last = self.consumer.frames.get(timeout=1.0)
+                except _q.Empty:
+                    break
+                if predicate(last):
+                    return last
+            if time.time() >= deadline:
+                break
+        raise AssertionError(
+            f"{self.name}: no matching frame within {timeout_s}s; "
+            f"last: {last}")
+
+    def journal(self, name):
+        return self.recorder.events(name=name)
+
+    def stop(self):
+        self.manager.stop()
+        self.client.stop()
+        self.kubelet.stop()
+
+
+def all_healthy(frame):
+    return frame.devices and all(
+        d.health == constants.HEALTHY for d in frame.devices)
+
+
+def all_unhealthy(frame):
+    return frame.devices and all(
+        d.health == constants.UNHEALTHY for d in frame.devices)
+
+
+def check(cond, msg):
+    if not cond:
+        raise AssertionError(msg)
+    log.info("OK: %s", msg)
+
+
+def episode_register_drop(hosts, seed):
+    """Every Register lost -> retries burn out -> recovery on the next
+    kubelet socket event once the faults lift."""
+    a = hosts[0]
+    inj = faults.install("kubelet.register:drop:1", seed=seed,
+                         recorder=a.recorder)
+    try:
+        a.kubelet.register_event.clear()
+        a.kubelet.restart(wipe_dir=False)
+        got = a.kubelet.wait_for_registration(timeout=3.0)
+        check(not got, "register blackhole: no registration landed")
+        check(inj.fired_count("kubelet.register") >= manager_mod._REGISTER_RETRIES,
+              f"retry policy burned its {manager_mod._REGISTER_RETRIES}-"
+              "attempt budget against the blackhole")
+        samples = obs.parse_exposition(a.registry.render())
+        retries = [v for n, lab, v in samples
+                   if n == "tpu_resilience_retries_total"
+                   and lab.get("op") == "kubelet.register"]
+        check(retries and retries[0] >= 1,
+              "tpu_resilience_retries_total{op=kubelet.register} counted")
+    finally:
+        faults.uninstall()
+    a.kubelet.restart(wipe_dir=False)
+    check(a.kubelet.wait_for_registration(timeout=10.0),
+          "re-registered after the faults lifted")
+
+
+def episode_join_error(hosts, coordinator, tmp, seed):
+    """A fresh client (worker restart) joins through transient join
+    errors via the shared backoff policy."""
+    registry = obs.Registry()
+    recorder = obs.FlightRecorder(registry=registry)
+    client = SliceClient(
+        rendezvous_address=f"127.0.0.1:{coordinator.port}",
+        hostname=hosts[0].name,     # same host restarting: rank kept
+        coords=(0,),
+        chip_count=len(hosts[0].impl.chips),
+        state_path=None,
+        registry=registry,
+        recorder=recorder,
+        join_backoff_initial_s=0.02,
+        join_backoff_max_s=0.1,
+        seed=seed,
+    )
+    inj = faults.install("slice.join:error:0.6", seed=seed,
+                         recorder=recorder)
+    try:
+        m = client.join(timeout_s=30.0)
+        check(m is not None and m.rank_of(hosts[0].name) == 0,
+              "join converged through 60% injected error rate "
+              f"({inj.fired_count('slice.join')} faults fired)")
+    finally:
+        faults.uninstall()
+        client.stop()
+
+
+def episode_heartbeat_loss(hosts, seed):
+    """Total heartbeat loss: breakers open (fail-fast pulses, verdict
+    frozen), then close via the half-open probe once faults lift."""
+    a, b = hosts
+    inj = faults.install("slice.heartbeat:error:1", seed=seed,
+                         recorder=a.recorder)
+    try:
+        for _ in range(4):      # > breaker threshold (3)
+            a.pulse()
+            b.pulse()
+        opened = [e for e in a.journal("tpu_breaker_transition")
+                  if e["attrs"].get("op") == "slice.heartbeat"
+                  and e["attrs"].get("to") == "open"]
+        check(opened, "heartbeat breaker opened in the journal")
+        check(inj.fired_count("slice.heartbeat") >= 3,
+              "injector dropped >= 3 heartbeats")
+        overlay = a.client.health_overlay()
+        check(overlay is not None and overlay[0],
+              "verdict frozen healthy through the outage (no "
+              "self-inflicted slice demotion)")
+    finally:
+        faults.uninstall()
+    time.sleep(BREAKER_RESET_S * 1.5)   # let the reset window pass
+    for _ in range(2):
+        a.pulse()
+        b.pulse()
+    closed = [e for e in a.journal("tpu_breaker_transition")
+              if e["attrs"].get("op") == "slice.heartbeat"
+              and e["attrs"].get("to") == "closed"]
+    check(closed, "heartbeat breaker closed after recovery")
+    a.wait_frame(all_healthy)
+    b.wait_frame(all_healthy)
+    check(True, "both hosts advertise Healthy after heartbeat recovery")
+
+
+def episode_probe_hang(hosts, seed):
+    """The probe wedges: the watchdog abandons it, the host reports
+    itself unhealthy, the slice demotes BOTH members within a pulse
+    exchange; recovery re-promotes everything."""
+    a, b = hosts
+    faults.install(f"probe:hang:{PROBE_WATCHDOG_S * 4}", seed=seed,
+                   recorder=a.recorder)
+    try:
+        t0 = time.monotonic()
+        a.pulse()               # watchdog trips inside this pulse
+        pulse_dt = time.monotonic() - t0
+        check(pulse_dt < PROBE_WATCHDOG_S * 4,
+              f"pulse returned in {pulse_dt:.1f}s — the watchdog "
+              "failed the hung probe instead of riding it out")
+        trips = [e for e in a.journal("tpu_watchdog_trip")
+                 if e["attrs"].get("op") == "probe"]
+        check(trips, "watchdog trip journaled for the probe")
+        b.pulse()               # B learns the slice verdict
+        b.wait_frame(all_unhealthy)
+        check(True, "peer demoted all devices after the probe hang")
+    finally:
+        faults.uninstall()
+    a.wait_frame(all_healthy)
+    b.wait_frame(all_healthy)
+    check(True, "both hosts re-advertise Healthy after probe recovery")
+
+
+def episode_scheduler_crash(seed):
+    """The serving scheduler crashes mid-decode: in-flight requests
+    get 503 (not a hang), the supervisor restarts the loop, and the
+    next request answers 200."""
+    import http.client
+    import json
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_k8s_device_plugin.workloads.inference import make_decoder
+    from tpu_k8s_device_plugin.workloads.server import EngineServer
+    from tpu_k8s_device_plugin.workloads.serving import ServingEngine
+
+    model = make_decoder(vocab=128, d_model=64, n_heads=4, n_layers=2,
+                         d_ff=128, max_len=64, dtype=jnp.float32)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+    params = model.init(jax.random.PRNGKey(0), tokens, pos)["params"]
+    eng = ServingEngine(model, params, n_slots=2)
+    srv = EngineServer(eng, max_new_tokens=8, window=4)
+    srv.start(host="127.0.0.1", port=0)
+
+    def post(payload, timeout=120):
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=timeout)
+        try:
+            conn.request("POST", "/generate", json.dumps(payload),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    def get(path):
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=30)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    try:
+        status, _ = post({"tokens": [3, 14, 15], "max_new_tokens": 4,
+                          "stream": False})
+        check(status == 200, "serving baseline request answered 200")
+        faults.install("serve.step:error:1", seed=seed,
+                       recorder=srv.recorder)
+        try:
+            status, body = post({"tokens": [9, 9, 8],
+                                 "max_new_tokens": 4, "stream": False})
+            check(status == 503,
+                  f"in-flight request got a real 503 on scheduler "
+                  f"crash (got {status}: {body[:80]!r})")
+        finally:
+            faults.uninstall()
+        crashes = srv.recorder.events(name="tpu_serve_scheduler_crash")
+        check(crashes, "scheduler crash journaled")
+        deadline = time.time() + 10.0
+        while (time.time() < deadline
+               and srv._m_sched_restarts.value < 1):
+            time.sleep(0.05)
+        check(srv._m_sched_restarts.value >= 1,
+              "supervisor restarted the scheduler")
+        status, _ = get("/healthz")
+        check(status == 200, "healthz back to 200 after restart")
+        status, body = post({"tokens": [2, 71, 82],
+                             "max_new_tokens": 4, "stream": False})
+        check(status == 200,
+              f"serving answers 200 again after the crash "
+              f"(got {status}: {body[:80]!r})")
+    finally:
+        srv.stop()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="chaos-soak")
+    p.add_argument("--seed", type=int,
+                   default=int(os.environ.get("ENGINE_FUZZ_SEED", "0")
+                               or 0),
+                   help="fault + jitter RNG seed (ENGINE_FUZZ_SEED "
+                        "env honored)")
+    p.add_argument("--testdata",
+                   default=os.path.join(_REPO, "testdata"))
+    p.add_argument("--skip-serving", action="store_true",
+                   help="skip the scheduler-crash episode (no jax "
+                        "needed)")
+    p.add_argument("-v", "--verbose", action="store_true")
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s")
+    log.info("chaos soak, seed=%d", args.seed)
+    manager_mod._REGISTER_RETRY_DELAY_S = 0.05  # soak-speed retries
+
+    tmp = tempfile.mkdtemp(prefix="chaos-soak-")
+    coordinator = SliceCoordinator(
+        expected_workers=2,
+        bind_address="127.0.0.1:0",
+        jax_port=_JAX_PORT,
+        state_path=os.path.join(tmp, "coordinator-membership.json"),
+        heartbeat_timeout_s=0.0,    # pulses are driven explicitly
+    ).start()
+    rendezvous = f"127.0.0.1:{coordinator.port}"
+    hosts = [
+        ChaosHost("host-a", "v5e-16-host0", args.testdata, tmp,
+                  rendezvous, args.seed),
+        ChaosHost("host-b", "v5e-16-host1", args.testdata, tmp,
+                  rendezvous, args.seed),
+    ]
+    try:
+        # -- formation + steady state ---------------------------------
+        import concurrent.futures
+        with concurrent.futures.ThreadPoolExecutor(2) as pool:
+            for f in [pool.submit(h.client.join, 20.0) for h in hosts]:
+                f.result(timeout=30.0)
+        for h in hosts:
+            h.manager.run(block=False)
+            check(h.kubelet.wait_for_registration(timeout=10.0),
+                  f"{h.name} registered with its kubelet")
+            frame = h.open_stream()
+            check(len(frame.devices) == 8,
+                  f"{h.name} advertises 8 devices")
+        for h in hosts:
+            h.pulse()
+        for h in hosts:
+            h.wait_frame(all_healthy)
+        log.info("=== episode 1: kubelet register drop ===")
+        episode_register_drop(hosts, args.seed)
+        log.info("=== episode 2: slice join error ===")
+        episode_join_error(hosts, coordinator, tmp, args.seed)
+        log.info("=== episode 3: slice heartbeat loss ===")
+        episode_heartbeat_loss(hosts, args.seed)
+        log.info("=== episode 4: probe hang ===")
+        episode_probe_hang(hosts, args.seed)
+        if not args.skip_serving:
+            log.info("=== episode 5: serving scheduler crash ===")
+            episode_scheduler_crash(args.seed)
+        # -- final convergence sweep ----------------------------------
+        for h in hosts:
+            h.pulse()
+        for h in hosts:
+            h.wait_frame(all_healthy)
+        m = hosts[0].client.membership
+        check(m is not None and m.hostnames == ("host-a", "host-b"),
+              "slice still formed with stable ranks")
+        transitions = (hosts[0].journal("tpu_breaker_transition")
+                       + hosts[0].journal("tpu_watchdog_trip"))
+        check(transitions,
+              "flight recorder journaled breaker/watchdog transitions")
+        log.info("CHAOS SOAK PASS (seed=%d)", args.seed)
+        return 0
+    finally:
+        faults.uninstall()
+        for h in hosts:
+            h.stop()
+        coordinator.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -104,6 +104,22 @@ def build_parser() -> argparse.ArgumentParser:
              f"stay on).  Env override: {constants.ENV_FLIGHT_RECORD_DIR}",
     )
     p.add_argument(
+        "--fault-spec", dest="fault_spec",
+        default=os.environ.get("TPU_DP_FAULTS", ""), metavar="SPEC",
+        help="arm deterministic fault injection (chaos testing ONLY): "
+             "op:kind:arg[;...] — e.g. 'kubelet.register:drop:0.5;"
+             "probe:hang:5'.  Empty (the default) leaves every hook a "
+             "no-op attribute check.  Env override: TPU_DP_FAULTS",
+    )
+    p.add_argument(
+        "--fault-seed", dest="fault_seed", type=int,
+        default=int(os.environ.get("TPU_DP_FAULT_SEED", "0") or 0),
+        metavar="N",
+        help="RNG seed for --fault-spec probabilities: the same seed "
+             "replays the same injection sequence.  Env override: "
+             "TPU_DP_FAULT_SEED (default 0)",
+    )
+    p.add_argument(
         "--debug-host", default="127.0.0.1", metavar="ADDR",
         help="bind address for --debug-port (default loopback; set "
              "0.0.0.0 so Prometheus can scrape /metrics from the pod "
@@ -254,9 +270,17 @@ def main(argv=None) -> int:
     # the node's ONE metrics registry + flight recorder: plugin
     # histograms, slice metrics, the debug /metrics surface, and the
     # event journal behind /debug/traces all hang off this pair
-    from tpu_k8s_device_plugin import obs
+    from tpu_k8s_device_plugin import obs, resilience
     registry = obs.Registry()
     recorder = obs.FlightRecorder(registry=registry)
+    # resilience wiring (PR 5): swallowed-fault accounting renders on
+    # this node's /metrics, and --fault-spec arms the injection hooks
+    # (they stay bare attribute checks when unset)
+    resilience.set_suppressed_metrics(
+        resilience.ResilienceMetrics(registry))
+    if args.fault_spec:
+        resilience.install(args.fault_spec, seed=args.fault_seed,
+                           recorder=recorder)
 
     coordinator = client = None
     if args.slice_rendezvous:

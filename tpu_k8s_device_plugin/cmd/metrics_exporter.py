@@ -33,6 +33,11 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
+    # chaos runs arm the exporter's probe hook via TPU_DP_FAULTS (the
+    # daemon has no flag surface worth growing for this); unset env
+    # leaves the hook a no-op attribute check
+    from tpu_k8s_device_plugin.resilience import faults
+    faults.install_from_env()
     # pod shutdown sends SIGTERM; exit through the finally so the socket is
     # removed rather than left stale for the next incarnation (skipped when
     # main() is driven from a worker thread, where signal.signal raises)

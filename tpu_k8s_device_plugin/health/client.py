@@ -5,6 +5,14 @@ TPU-native analog of the reference's exporter client
 insecure gRPC connection over the exporter's unix socket per poll, mapping
 device id → Healthy/Unhealthy.  Unreachable exporter returns {} — the
 plugin then falls back to its own simple health check.
+
+Resilience (PR 5): the once single-shot RPC now runs under the shared
+:class:`~tpu_k8s_device_plugin.resilience.RetryPolicy` (a transient
+exporter blip no longer costs a whole pulse of granular health), and
+the ``health.list`` fault hook lets the chaos harness provoke exactly
+that blip.  Hang containment lives one layer up: the device impl wraps
+this whole probe in a breaker + watchdog (see
+``device_impl._granular_health``).
 """
 
 from __future__ import annotations
@@ -15,36 +23,56 @@ from typing import Dict
 
 import grpc
 
+from tpu_k8s_device_plugin import resilience
 from tpu_k8s_device_plugin.proto import (
     tpuhealth_pb2 as hpb,
     tpuhealth_pb2_grpc as hpb_grpc,
 )
+from tpu_k8s_device_plugin.resilience import faults
 from tpu_k8s_device_plugin.types import constants
 
 log = logging.getLogger(__name__)
+
+# One retry after a short pause: enough to ride out an exporter restart
+# between List and retry, short enough that a down exporter degrades
+# this pulse to the simple health check instead of stalling it.
+_LIST_RETRY = resilience.RetryPolicy(
+    max_attempts=2, initial_backoff_s=0.2, max_backoff_s=1.0)
 
 
 def get_tpu_health(
     socket_path: str = constants.METRICS_EXPORTER_SOCKET,
     timeout_s: float = constants.EXPORTER_HEALTH_CHECK_TIMEOUT_S,
+    retry: "resilience.RetryPolicy" = None,
+    metrics: "resilience.ResilienceMetrics" = None,
+    recorder=None,
 ) -> Dict[str, str]:
     """Chip PCI address → "Healthy"/"Unhealthy" from the exporter daemon."""
     if not os.path.exists(socket_path):
         return {}
-    out: Dict[str, str] = {}
-    try:
+
+    def _list():
+        if faults.ACTIVE is not None:
+            faults.ACTIVE.fire("health.list")
         with grpc.insecure_channel(f"unix://{socket_path}") as ch:
             stub = hpb_grpc.TpuHealthServiceStub(ch)
-            resp = stub.List(hpb.ListTpuStateRequest(), timeout=timeout_s)
-        for state in resp.states:
-            health = state.health.strip().lower()
-            out[state.id] = (
-                constants.HEALTHY
-                if health == "healthy"
-                else constants.UNHEALTHY
-            )
-    except grpc.RpcError as e:
+            return stub.List(hpb.ListTpuStateRequest(), timeout=timeout_s)
+
+    try:
+        resp = (retry or _LIST_RETRY).call(
+            _list, op="health.list",
+            retry_on=(grpc.RpcError, faults.InjectedFault),
+            metrics=metrics, recorder=recorder, logger=log)
+    except (grpc.RpcError, faults.InjectedFault) as e:
         log.warning("tpu-metrics-exporter unreachable at %s: %s",
                     socket_path, e)
         return {}
+    out: Dict[str, str] = {}
+    for state in resp.states:
+        health = state.health.strip().lower()
+        out[state.id] = (
+            constants.HEALTHY
+            if health == "healthy"
+            else constants.UNHEALTHY
+        )
     return out

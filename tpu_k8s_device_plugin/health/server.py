@@ -26,6 +26,7 @@ from tpu_k8s_device_plugin.proto import (
     tpuhealth_pb2 as hpb,
     tpuhealth_pb2_grpc as hpb_grpc,
 )
+from tpu_k8s_device_plugin.resilience import faults
 from tpu_k8s_device_plugin.tpu import discovery, sysfs
 from tpu_k8s_device_plugin.types import constants
 
@@ -116,6 +117,11 @@ def probe_chip_states(
     *chips* skips the discovery walk when the caller already ran one
     (the Prometheus scrape renders health + error counters from a single
     enumeration)."""
+    # chaos hook for the libtpu/sysfs probe itself (inert attribute
+    # check when no fault spec is armed): `probe:hang:N` models a
+    # wedged driver read, `probe:error:p` a probe crash
+    if faults.ACTIVE is not None:
+        faults.ACTIVE.fire("probe")
     states: Dict[str, hpb.TpuState] = {}
     if chips is None:
         chips, _ = discovery.get_tpu_chips(

@@ -141,5 +141,12 @@ class DirWatcher:
     def __del__(self):
         try:
             self.close()
-        except Exception:
-            pass
+        except Exception as e:
+            # destructor during interpreter teardown: even the
+            # accounting must be best-effort, but a live process gets
+            # the DEBUG line + tpu_suppressed_errors_total{site}
+            try:
+                from tpu_k8s_device_plugin.resilience import suppressed
+                suppressed("tpuprobe.dirwatcher_del", e, logger=log)
+            except Exception:
+                pass

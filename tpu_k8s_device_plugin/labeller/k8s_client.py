@@ -21,6 +21,9 @@ import urllib.error
 import urllib.request
 from typing import Dict, Iterator, Optional
 
+from tpu_k8s_device_plugin import resilience
+from tpu_k8s_device_plugin.resilience import faults
+
 log = logging.getLogger(__name__)
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
@@ -33,6 +36,17 @@ class ApiError(Exception):
         self.body = body
 
 
+class TransientApiError(ApiError):
+    """5xx/429 — the API server's problem, safe to retry.  Subclasses
+    ApiError so existing ``except ApiError`` callers see no change."""
+
+
+# the failures worth retrying a node GET/PATCH over: connection-level
+# faults, server-side 5xx/429, and injected faults in chaos runs
+_RETRYABLE = (TransientApiError, urllib.error.URLError, TimeoutError,
+              ConnectionError, faults.InjectedFault)
+
+
 class NodeClient:
     """Talks to ``/api/v1/nodes`` with service-account credentials."""
 
@@ -42,6 +56,9 @@ class NodeClient:
         token_path: str = os.path.join(SA_DIR, "token"),
         ca_path: str = os.path.join(SA_DIR, "ca.crt"),
         timeout_s: float = 10.0,
+        retry: Optional["resilience.RetryPolicy"] = None,
+        resilience_metrics: Optional[
+            "resilience.ResilienceMetrics"] = None,
     ):
         if base_url is None:
             host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
@@ -50,6 +67,14 @@ class NodeClient:
         self.base_url = base_url.rstrip("/")
         self._token_path = token_path
         self._timeout = timeout_s
+        # shared policy: transient API-server faults (connection reset,
+        # 5xx, 429) retry with jittered backoff instead of failing the
+        # whole reconcile round
+        self._retry = retry if retry is not None else \
+            resilience.RetryPolicy(max_attempts=3,
+                                   initial_backoff_s=0.25,
+                                   max_backoff_s=2.0)
+        self._res_metrics = resilience_metrics
         self._ssl_ctx: Optional[ssl.SSLContext] = None
         if self.base_url.startswith("https") and os.path.exists(ca_path):
             self._ssl_ctx = ssl.create_default_context(cafile=ca_path)
@@ -71,24 +96,42 @@ class NodeClient:
         body: Optional[dict] = None,
         content_type: str = "application/json",
         timeout: Optional[float] = None,
+        retryable: bool = True,
     ):
-        req = urllib.request.Request(
-            self.base_url + path,
-            method=method,
-            data=json.dumps(body).encode() if body is not None else None,
-        )
-        token = self._token()
-        if token:
-            req.add_header("Authorization", f"Bearer {token}")
-        req.add_header("Accept", "application/json")
-        if body is not None:
-            req.add_header("Content-Type", content_type)
-        try:
-            return urllib.request.urlopen(
-                req, timeout=timeout or self._timeout, context=self._ssl_ctx
+        """One API-server round trip; *retryable* GET/PATCH calls run
+        under the shared RetryPolicy (long-poll WATCH passes False —
+        its reconnect loop belongs to the controller)."""
+        def _once():
+            if faults.ACTIVE is not None:
+                faults.ACTIVE.fire("k8s.request")
+            req = urllib.request.Request(
+                self.base_url + path,
+                method=method,
+                data=json.dumps(body).encode()
+                if body is not None else None,
             )
-        except urllib.error.HTTPError as e:
-            raise ApiError(e.code, e.read().decode(errors="replace")) from e
+            token = self._token()
+            if token:
+                req.add_header("Authorization", f"Bearer {token}")
+            req.add_header("Accept", "application/json")
+            if body is not None:
+                req.add_header("Content-Type", content_type)
+            try:
+                return urllib.request.urlopen(
+                    req, timeout=timeout or self._timeout,
+                    context=self._ssl_ctx
+                )
+            except urllib.error.HTTPError as e:
+                text = e.read().decode(errors="replace")
+                if e.code >= 500 or e.code == 429:
+                    raise TransientApiError(e.code, text) from e
+                raise ApiError(e.code, text) from e
+
+        if not retryable:
+            return _once()
+        return self._retry.call(
+            _once, op="k8s.request", retry_on=_RETRYABLE,
+            metrics=self._res_metrics, logger=log)
 
     # -- node verbs ---------------------------------------------------------
 
@@ -129,7 +172,8 @@ class NodeClient:
         )
         if resource_version:
             path += f"&resourceVersion={resource_version}"
-        with self._request("GET", path, timeout=timeout_s + 5) as resp:
+        with self._request("GET", path, timeout=timeout_s + 5,
+                           retryable=False) as resp:
             for line in resp:
                 line = line.strip()
                 if not line:

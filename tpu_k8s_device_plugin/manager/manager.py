@@ -31,8 +31,9 @@ from typing import Dict, List, Optional
 
 import grpc
 
-from tpu_k8s_device_plugin import obs
+from tpu_k8s_device_plugin import obs, resilience
 from tpu_k8s_device_plugin.allocator import BestEffortPolicy
+from tpu_k8s_device_plugin.resilience import faults
 from tpu_k8s_device_plugin.plugin import TpuDevicePlugin
 from tpu_k8s_device_plugin.plugin.plugin import PluginMetrics
 from tpu_k8s_device_plugin.proto import (
@@ -47,8 +48,14 @@ from tpu_k8s_device_plugin.types import (
 
 log = logging.getLogger(__name__)
 
+# Register retry shape (consumed by the shared RetryPolicy below; kept
+# as module constants so tests can shrink the delay)
 _REGISTER_RETRIES = 3
 _REGISTER_RETRY_DELAY_S = 3.0
+# bounded stop(): how long to wait for the watch/pulse threads to exit
+# before logging and moving on (they are daemons; a wedged probe must
+# not block process shutdown forever)
+_THREAD_JOIN_TIMEOUT_S = 5.0
 
 
 class _ServedPlugin:
@@ -123,6 +130,13 @@ class PluginManager:
         # --flight-record-dir dumps it on exit/SIGTERM
         self.recorder = (recorder if recorder is not None
                          else obs.FlightRecorder(registry=self.registry))
+        # shared resilience instrumentation: Register retries, the
+        # probe breaker/watchdog (wired into the impl below), and the
+        # suppressed-error counter all render from this registry
+        self.resilience = resilience.ResilienceMetrics(self.registry)
+        set_res = getattr(device_impl, "set_resilience", None)
+        if callable(set_res):
+            set_res(metrics=self.resilience, recorder=self.recorder)
         self._plugin_metrics = PluginMetrics(self.registry)
         self._m_pulse = self.registry.histogram(
             "tpu_plugin_pulse_round_seconds",
@@ -174,6 +188,20 @@ class PluginManager:
             self._plugins.clear()
         for sp in plugins:
             sp.shutdown()
+        # join the watch/pulse threads with a bound: a thread that
+        # fails to exit is a wedged call we must not wait on forever,
+        # but it must also not die silently (leaked threads across
+        # restarts are how socket flaps become fd exhaustion)
+        me = threading.current_thread()
+        for t in self._threads:
+            if t is me:
+                continue
+            t.join(timeout=_THREAD_JOIN_TIMEOUT_S)
+            if t.is_alive():
+                log.warning(
+                    "thread %s did not exit within %.0fs of stop()",
+                    t.name, _THREAD_JOIN_TIMEOUT_S)
+        self._threads = [t for t in self._threads if t.is_alive()]
 
     def update_resources(self, resources: List[str]) -> None:
         """Diff the advertised resource set, starting/stopping plugin
@@ -256,7 +284,11 @@ class PluginManager:
             self._register(resource, sp)
 
     def _register(self, resource: str, sp: _ServedPlugin) -> bool:
-        """Register RPC with retries (≈ dpm plugin.go:127-162)."""
+        """Register RPC through the shared RetryPolicy (≈ dpm
+        plugin.go:127-162, which hardcoded 3x3s; the policy adds
+        jittered exponential backoff, retry metrics, and stop-event
+        abort).  A final failure is non-fatal: the kubelet-watch loop
+        re-registers on the next socket event."""
         try:
             options = self.impl.get_options(sp.plugin.ctx)
         except Exception as e:
@@ -268,25 +300,35 @@ class PluginManager:
             resource_name=f"{self.namespace}/{resource}",
             options=options,
         )
-        for attempt in range(1, _REGISTER_RETRIES + 1):
-            if self._stop.is_set():
-                return False
-            try:
-                with grpc.insecure_channel(
-                    f"unix://{self.kubelet_socket}"
-                ) as ch:
-                    stub = pluginapi_grpc.RegistrationStub(ch)
-                    stub.Register(req, timeout=5.0)
-                log.info("registered %s/%s with kubelet", self.namespace, resource)
-                return True
-            except grpc.RpcError as e:
-                log.warning(
-                    "register %s attempt %d/%d failed: %s",
-                    resource, attempt, _REGISTER_RETRIES, e,
-                )
-                if attempt < _REGISTER_RETRIES:
-                    time.sleep(_REGISTER_RETRY_DELAY_S)
-        return False
+
+        def _rpc():
+            if faults.ACTIVE is not None:
+                faults.ACTIVE.fire("kubelet.register")
+            with grpc.insecure_channel(
+                f"unix://{self.kubelet_socket}"
+            ) as ch:
+                stub = pluginapi_grpc.RegistrationStub(ch)
+                stub.Register(req, timeout=5.0)
+
+        policy = resilience.RetryPolicy(
+            max_attempts=_REGISTER_RETRIES,
+            initial_backoff_s=_REGISTER_RETRY_DELAY_S,
+            max_backoff_s=_REGISTER_RETRY_DELAY_S * 4,
+        )
+        try:
+            policy.call(
+                _rpc, op="kubelet.register",
+                retry_on=(grpc.RpcError, faults.InjectedFault),
+                stop=self._stop, metrics=self.resilience,
+                recorder=self.recorder, logger=log)
+        except (grpc.RpcError, faults.InjectedFault) as e:
+            log.warning("register %s failed after retries: %s",
+                        resource, e)
+            return False
+        except resilience.CircuitOpenError:
+            return False  # stop() landed before the first attempt
+        log.info("registered %s/%s with kubelet", self.namespace, resource)
+        return True
 
     def _kubelet_watch_loop(self) -> None:
         """Re-register on kubelet socket re-creation; stop plugin servers
@@ -296,7 +338,12 @@ class PluginManager:
             try:
                 from tpu_k8s_device_plugin.hostinfo import tpuprobe
                 return tpuprobe.DirWatcher(self.kubelet_dir)
-            except Exception:
+            except Exception as e:
+                # no native shim / no inotify budget: poll instead —
+                # counted, not silent
+                resilience.suppressed("manager.make_watcher", e,
+                                      logger=log,
+                                      metrics=self.resilience)
                 return None
 
         watcher = make_watcher()
@@ -312,8 +359,10 @@ class PluginManager:
                     log.warning("inotify watch broke (%s); re-creating", e)
                     try:
                         watcher.close()
-                    except Exception:
-                        pass
+                    except Exception as ce:
+                        resilience.suppressed("manager.watcher_close",
+                                              ce, logger=log,
+                                              metrics=self.resilience)
                     watcher = make_watcher()
                     if watcher is None:
                         log.warning("watch re-creation failed; polling")

@@ -33,6 +33,7 @@ from typing import Optional, TYPE_CHECKING
 from urllib.parse import parse_qs, urlparse
 
 from tpu_k8s_device_plugin import __version__, obs
+from tpu_k8s_device_plugin.resilience import suppressed
 
 if TYPE_CHECKING:
     from tpu_k8s_device_plugin.manager import PluginManager
@@ -170,12 +171,17 @@ class DebugServer:
                     try:
                         body = json.dumps(manager_status(manager), indent=2)
                         self._send(200, "application/json", body + "\n")
-                    except Exception:
+                    except Exception as e:
                         # full traceback to the LOG, generic body to the
                         # CLIENT: raw exception text can leak paths and
                         # internal state, and without the traceback the
-                        # operator had nothing to debug with
+                        # operator had nothing to debug with; the
+                        # suppressed counter makes repeated failures
+                        # visible on /metrics
                         log.exception("/debug/status failed")
+                        suppressed("debug.status", e, logger=log,
+                                   metrics=getattr(manager, "resilience",
+                                                   None))
                         self._send(500, "text/plain",
                                    "internal error; see plugin logs\n")
                 elif url.path == "/debug/threads":
@@ -221,8 +227,12 @@ class DebugServer:
                             render_plugin_metrics(manager,
                                                   openmetrics=om),
                         )
-                    except Exception:
+                    except Exception as e:
                         log.exception("/metrics render failed")
+                        suppressed("debug.metrics_render", e,
+                                   logger=log,
+                                   metrics=getattr(manager, "resilience",
+                                                   None))
                         self._send(500, "text/plain",
                                    "internal error; see plugin logs\n")
                 else:

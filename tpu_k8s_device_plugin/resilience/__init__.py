@@ -1,0 +1,56 @@
+"""Unified resilience layer: retry, circuit breaker, watchdog, faults.
+
+Every failure-prone boundary in the node agents and the serving stack
+(kubelet Register, slice Join/Heartbeat, health List, the libtpu/sysfs
+probe, the k8s API client, the serving scheduler step) runs through the
+shared policies in :mod:`.policy` instead of ad-hoc ``for attempt in
+range(3)`` loops, and every one of those boundaries carries a
+deterministic fault-injection hook from :mod:`.faults` so the recovery
+paths can be provoked on demand (the chaos harness in
+``tools/chaos_soak.py``) instead of waiting for production to exercise
+them.  See ``docs/user-guide/resilience.md``.
+"""
+
+from .faults import (
+    FaultInjector,
+    FaultSpec,
+    InjectedFault,
+    active,
+    install,
+    install_from_env,
+    uninstall,
+)
+from .policy import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    CircuitBreaker,
+    CircuitOpenError,
+    ResilienceMetrics,
+    RetryPolicy,
+    Watchdog,
+    WatchdogTimeout,
+    set_suppressed_metrics,
+    suppressed,
+)
+
+__all__ = [
+    "BREAKER_CLOSED",
+    "BREAKER_HALF_OPEN",
+    "BREAKER_OPEN",
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "FaultInjector",
+    "FaultSpec",
+    "InjectedFault",
+    "ResilienceMetrics",
+    "RetryPolicy",
+    "Watchdog",
+    "WatchdogTimeout",
+    "active",
+    "install",
+    "install_from_env",
+    "set_suppressed_metrics",
+    "suppressed",
+    "uninstall",
+]

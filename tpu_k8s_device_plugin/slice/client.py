@@ -26,11 +26,12 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import grpc
 
-from tpu_k8s_device_plugin import obs
+from tpu_k8s_device_plugin import obs, resilience
 from tpu_k8s_device_plugin.proto import (
     slice_pb2 as slicepb,
     slice_pb2_grpc as slicepb_grpc,
 )
+from tpu_k8s_device_plugin.resilience import faults
 from tpu_k8s_device_plugin.types import constants
 from .state import Membership, load_membership, save_membership
 
@@ -43,6 +44,13 @@ LocalHealthFn = Callable[[], Tuple[bool, str]]
 _JOIN_BACKOFF_INITIAL_S = 0.5
 _JOIN_BACKOFF_MAX_S = 15.0
 _RPC_TIMEOUT_S = 10.0
+# heartbeat circuit breaker: after this many consecutive failed
+# heartbeats the client stops burning a full RPC timeout per pulse and
+# fails fast until the reset window admits one probe heartbeat
+_HB_BREAKER_THRESHOLD = 3
+_HB_BREAKER_RESET_S = 30.0
+# the RPC faults the retry/breaker machinery treats as transient
+_TRANSIENT = (grpc.RpcError, faults.InjectedFault)
 
 
 def _trace_metadata(trace):
@@ -77,9 +85,24 @@ class SliceClient:
         local_health_fn: Optional[LocalHealthFn] = None,
         registry=None,
         recorder=None,
+        join_backoff_initial_s: float = _JOIN_BACKOFF_INITIAL_S,
+        join_backoff_max_s: float = _JOIN_BACKOFF_MAX_S,
+        rpc_timeout_s: float = _RPC_TIMEOUT_S,
+        breaker_reset_s: float = _HB_BREAKER_RESET_S,
+        seed: int = 0,
     ):
         self._address = rendezvous_address
         self.hostname = hostname or socket.gethostname()
+        self._rpc_timeout_s = rpc_timeout_s
+        # jittered-backoff schedule shared with every other boundary
+        # in the repo (resilience.RetryPolicy); seeded so a chaos run
+        # replays the same join timing
+        self._join_policy = resilience.RetryPolicy(
+            max_attempts=1 << 30,
+            initial_backoff_s=join_backoff_initial_s,
+            max_backoff_s=join_backoff_max_s,
+            seed=seed,
+        )
         # flight recorder (PR 4): membership transitions and learned
         # verdicts journal here with the trace that delivered them
         self._recorder = recorder
@@ -90,11 +113,26 @@ class SliceClient:
         self.metrics = None
         self._last_beat: Optional[float] = None
         self._join_started: Optional[float] = None
+        self._res_metrics = None
         if registry is not None:
             from .metrics import SliceMetrics
 
             self.metrics = SliceMetrics(registry)
+            self._res_metrics = resilience.ResilienceMetrics(registry)
             registry.on_collect(self._refresh_age)
+        # a dead coordinator must not cost every pulse a full RPC
+        # timeout: the breaker fails heartbeats fast once it opens and
+        # admits one probe per reset window.  Verdict semantics are
+        # unchanged — a failed (or skipped) heartbeat keeps the last
+        # learned verdict, exactly like an unreachable coordinator.
+        self._hb_breaker = resilience.CircuitBreaker(
+            "slice.heartbeat",
+            failure_threshold=_HB_BREAKER_THRESHOLD,
+            reset_timeout_s=breaker_reset_s,
+            metrics=self._res_metrics,
+            recorder=recorder,
+            logger=log,
+        )
         self._coords = tuple(coords)
         self._chip_count = chip_count
         self._state_path = state_path
@@ -110,6 +148,11 @@ class SliceClient:
         self._unhealthy_hosts: List[str] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # ONE channel for the client's lifetime (gRPC reconnects a
+        # broken channel itself); the old fresh-channel-per-attempt
+        # pattern leaked a socket + connect handshake per backoff poll
+        self._ch: Optional[grpc.Channel] = None
+        self._ch_lock = threading.Lock()
         if state_path:
             prior = load_membership(state_path)
             if prior is not None and prior.rank_of(self.hostname) is not None:
@@ -123,24 +166,42 @@ class SliceClient:
     # -- join ---------------------------------------------------------------
 
     def _channel(self) -> grpc.Channel:
-        return grpc.insecure_channel(self._address)
+        """The client's one long-lived channel (created on first use,
+        closed by stop()); stopped clients get a fresh one so a
+        restarted client keeps working."""
+        with self._ch_lock:
+            if self._ch is None:
+                self._ch = grpc.insecure_channel(self._address)
+            return self._ch
+
+    def _close_channel(self) -> None:
+        with self._ch_lock:
+            ch, self._ch = self._ch, None
+        if ch is not None:
+            try:
+                ch.close()
+            except Exception as e:
+                resilience.suppressed("slice.channel_close", e,
+                                      logger=log,
+                                      metrics=self._res_metrics)
 
     def _join_once(self, trace=None) -> Optional[Membership]:
         """One Join poll; returns the membership when formed.  *trace*
         rides the gRPC metadata as a ``traceparent`` entry so the
         coordinator's join span shares this member's trace."""
-        with self._channel() as ch:
-            stub = slicepb_grpc.SliceRendezvousStub(ch)
-            resp = stub.Join(
-                slicepb.JoinRequest(
-                    hostname=self.hostname,
-                    coords=list(self._coords),
-                    chip_count=self._chip_count,
-                    session=self._session,
-                ),
-                timeout=_RPC_TIMEOUT_S,
-                metadata=_trace_metadata(trace),
-            )
+        if faults.ACTIVE is not None:
+            faults.ACTIVE.fire("slice.join")
+        stub = slicepb_grpc.SliceRendezvousStub(self._channel())
+        resp = stub.Join(
+            slicepb.JoinRequest(
+                hostname=self.hostname,
+                coords=list(self._coords),
+                chip_count=self._chip_count,
+                session=self._session,
+            ),
+            timeout=self._rpc_timeout_s,
+            metadata=_trace_metadata(trace),
+        )
         if not resp.formed:
             log.info(
                 "slice forming: %d/%d workers joined",
@@ -155,7 +216,7 @@ class SliceClient:
         Safe to call again after a restart: the coordinator hands back the
         existing rank without re-forming."""
         deadline = time.monotonic() + timeout_s if timeout_s > 0 else None
-        backoff = _JOIN_BACKOFF_INITIAL_S
+        attempt = 0
         if self._join_started is None:
             self._join_started = time.monotonic()
         # one root trace covers the whole join (every poll carries it),
@@ -165,7 +226,7 @@ class SliceClient:
         while not self._stop.is_set():
             try:
                 membership = self._join_once(trace=join_trace)
-            except grpc.RpcError as e:
+            except _TRANSIENT as e:
                 code = e.code() if hasattr(e, "code") else None
                 if code == grpc.StatusCode.FAILED_PRECONDITION:
                     # mis-sized slice or hostname drift: retrying cannot
@@ -173,8 +234,11 @@ class SliceClient:
                     raise RuntimeError(
                         f"slice join rejected: {e.details()}"
                     ) from e
-                log.info("rendezvous %s unreachable (%s); retrying in "
-                         "%.1fs", self._address, code, backoff)
+                log.info("rendezvous %s unreachable (%s); retrying",
+                         self._address, code if code is not None else e)
+                if self._res_metrics is not None:
+                    self._res_metrics.retries.labels(
+                        op="slice.join").inc()
                 membership = None
             if membership is not None:
                 self._adopt(membership, trace=join_trace)
@@ -184,9 +248,9 @@ class SliceClient:
                     f"slice did not form within {timeout_s:.0f}s "
                     f"(rendezvous {self._address})"
                 )
-            if self._stop.wait(backoff):
+            attempt += 1
+            if self._stop.wait(self._join_policy.backoff_s(attempt)):
                 break
-            backoff = min(backoff * 2, _JOIN_BACKOFF_MAX_S)
         raise RuntimeError("slice client stopped before the slice formed")
 
     def _adopt(self, membership: Membership, trace=None) -> None:
@@ -229,6 +293,14 @@ class SliceClient:
         heartbeat span shares it) and from the background thread; errors
         degrade to 'no verdict change', never raise."""
         ctx = trace if trace is not None else obs.new_trace()
+        if not self._hb_breaker.allow():
+            # circuit open: a dead coordinator already ate
+            # failure_threshold RPC timeouts — skip this pulse's
+            # heartbeat entirely (same verdict semantics as a failed
+            # one) and let the breaker's reset window admit the probe
+            log.debug("slice heartbeat skipped: breaker open for %s",
+                      self._address)
+            return
         try:
             if self.membership is None:
                 membership = self._join_once(trace=ctx)
@@ -245,27 +317,30 @@ class SliceClient:
                     # its chips
                     log.warning("local health probe failed: %s", e)
                     healthy, reason = False, f"local probe error: {e}"
-            with self._channel() as ch:
-                stub = slicepb_grpc.SliceRendezvousStub(ch)
-                resp = stub.Heartbeat(
-                    slicepb.HeartbeatRequest(
-                        hostname=self.hostname,
-                        healthy=healthy,
-                        reason=reason,
-                        generation=self.membership.generation,
-                    ),
-                    timeout=_RPC_TIMEOUT_S,
-                    metadata=_trace_metadata(ctx),
-                )
-        except grpc.RpcError as e:
+            if faults.ACTIVE is not None:
+                faults.ACTIVE.fire("slice.heartbeat")
+            stub = slicepb_grpc.SliceRendezvousStub(self._channel())
+            resp = stub.Heartbeat(
+                slicepb.HeartbeatRequest(
+                    hostname=self.hostname,
+                    healthy=healthy,
+                    reason=reason,
+                    generation=self.membership.generation,
+                ),
+                timeout=self._rpc_timeout_s,
+                metadata=_trace_metadata(ctx),
+            )
+        except _TRANSIENT as e:
             # An unreachable coordinator is NOT a slice-wide Unhealthy
             # verdict by itself (that would let one crashed pod demote
             # every node's devices); keep the last verdict and let the
             # coordinator's own staleness tracking judge us.
+            self._hb_breaker.record_failure()
             log.warning("slice heartbeat to %s failed: %s",
                         self._address,
                         e.code() if hasattr(e, "code") else e)
             return
+        self._hb_breaker.record_success()
         fresh = _membership_from_msg(resp.membership)
         if fresh is not None:
             self._adopt(fresh, trace=ctx)
@@ -325,6 +400,7 @@ class SliceClient:
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+        self._close_channel()
 
     def _refresh_age(self) -> None:
         """Scrape-time collector: this host's own heartbeat age (how

@@ -15,6 +15,7 @@ import os
 import threading
 from typing import Callable, Dict, List, Optional, TYPE_CHECKING
 
+from tpu_k8s_device_plugin import resilience
 from tpu_k8s_device_plugin.allocator import (
     AllocationError,
     devices_from_discovery,
@@ -47,6 +48,7 @@ class TpuContainerImpl(DeviceImpl):
         tpu_env_path: str = constants.TPU_ENV_FILE,
         health_fn: Optional[HealthFn] = None,
         slice_client: Optional["SliceClient"] = None,
+        probe_watchdog_s: float = constants.PROBE_WATCHDOG_TIMEOUT_S,
     ):
         self._strategy = resource_naming_strategy
         self._sysfs_root = sysfs_root
@@ -54,6 +56,16 @@ class TpuContainerImpl(DeviceImpl):
         self._tpu_env_path = tpu_env_path
         self._health_fn = health_fn
         self._slice = slice_client
+        # hung-probe containment: a libtpu/sysfs probe wedged inside a
+        # C call (dead NFS stat, stuck driver ioctl) must fail THIS
+        # pulse's health refresh, not freeze the pulse loop.  The
+        # watchdog abandons the hung call; the breaker stops paying
+        # the watchdog timeout once hanging is established; and
+        # _probe_wedged turns the trip into an Unhealthy verdict for
+        # every advertised device until a probe succeeds again.
+        self._probe_watchdog_s = probe_watchdog_s
+        self._probe_wedged = False
+        self.set_resilience()
 
         self.chips: Dict[str, TpuDevice] = {}
         self.topology: Optional[IciTopology] = None
@@ -351,16 +363,47 @@ class TpuContainerImpl(DeviceImpl):
         construction."""
         self._slice = client
 
+    def set_resilience(self, metrics=None, recorder=None) -> None:
+        """(Re)build the probe watchdog + breaker, optionally wired to
+        an obs registry's resilience families and the flight recorder
+        (the PluginManager calls this with its own pair)."""
+        self._probe_watchdog = resilience.Watchdog(
+            "probe", self._probe_watchdog_s,
+            metrics=metrics, recorder=recorder, logger=log)
+        self._probe_breaker = resilience.CircuitBreaker(
+            "probe", failure_threshold=3,
+            reset_timeout_s=self._probe_watchdog_s * 3,
+            metrics=metrics, recorder=recorder, logger=log)
+
     def _granular_health(self) -> Dict[str, str]:
         """Per-chip health overlay (exporter-fed sysfs chip_state watch);
-        {} when the probe is unwired or failing."""
+        {} when the probe is unwired or failing.
+
+        A probe that HANGS (vs fails fast) is a different beast: the
+        watchdog abandons it after ``probe_watchdog_s`` and the impl
+        flips ``_probe_wedged`` — update_health then demotes every
+        device, because a wedged probe usually means the driver/bus
+        under the chips is wedged too and we can no longer vouch for
+        them.  Fast failures keep today's semantics (fall back to the
+        simple node check).  The breaker stops a persistently-hanging
+        probe from costing one watchdog timeout per health call."""
         if self._health_fn is None:
             return {}
         try:
-            return self._health_fn()
+            out = self._probe_breaker.call(
+                lambda: self._probe_watchdog.call(self._health_fn))
+        except resilience.WatchdogTimeout:
+            self._probe_wedged = True
+            return {}
+        except resilience.CircuitOpenError:
+            # breaker open: skip the probe, keep the standing verdict
+            # (wedged stays wedged until a successful probe clears it)
+            return {}
         except Exception as e:
             log.warning("granular health probe failed: %s", e)
             return {}
+        self._probe_wedged = False
+        return out
 
     def local_health(self) -> "tuple[bool, str]":
         """This host's contribution to slice-wide health — what the slice
@@ -370,6 +413,8 @@ class TpuContainerImpl(DeviceImpl):
         if not self.simple_health_check():
             return False, "node health probe failed"
         per_chip = self._granular_health()
+        if self._probe_wedged:
+            return False, "health probe hung (watchdog abandoned it)"
         bad = sorted(
             cid for cid in self.chips
             if per_chip.get(cid, constants.HEALTHY) != constants.HEALTHY
@@ -394,6 +439,13 @@ class TpuContainerImpl(DeviceImpl):
             constants.HEALTHY if self.simple_health_check() else constants.UNHEALTHY
         )
         per_chip: Dict[str, str] = self._granular_health()
+        if self._probe_wedged:
+            # a hung probe means nothing can vouch for the chips; the
+            # watchdog already failed the call, so this frame (within
+            # ONE pulse of the hang) demotes everything rather than
+            # advertising capacity on a wedged bus
+            node_health = constants.UNHEALTHY
+            per_chip = {}
         # Slice-wide verdict: ANY member's wedged chip (or a silent member)
         # poisons the ICI collectives of every host, so a slice-Unhealthy
         # verdict demotes every local device — the kubelet then stops

@@ -118,6 +118,13 @@ SYSFS_UE_COUNT = "uncorrectable_errors"     # fatal (uncorrectable) error count
 # Exporter health check timeout, seconds (constants.go:92).
 EXPORTER_HEALTH_CHECK_TIMEOUT_S = 10.0
 
+# Watchdog deadline for one whole granular health probe (PR 5): a probe
+# wedged inside a C call past this is abandoned and the impl demotes
+# every device until a probe succeeds again.  Must exceed
+# EXPORTER_HEALTH_CHECK_TIMEOUT_S (a slow-but-bounded RPC is the
+# exporter's problem, not a hang).
+PROBE_WATCHDOG_TIMEOUT_S = 15.0
+
 # Unix socket of the companion tpu-metrics-exporter daemon
 # (≈ /var/lib/amd-metrics-exporter/..., exporter/health.go:35-37).
 METRICS_EXPORTER_SOCKET = (

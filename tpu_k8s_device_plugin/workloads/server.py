@@ -110,6 +110,7 @@ from typing import List, Optional
 from urllib.parse import parse_qs, urlparse
 
 from tpu_k8s_device_plugin import obs
+from tpu_k8s_device_plugin.resilience import faults
 
 from .grammar import (
     json_value_regex,
@@ -137,6 +138,15 @@ _GAUGE_STATS = frozenset({
 # queue, longer ones amortize host round-trips harder
 DEFAULT_WINDOW = 8
 _IDLE_POLL_S = 0.05
+
+# scheduler crash containment: the supervisor restarts a crashed
+# scheduler loop with capped exponential backoff; this many crashes in
+# a row (no _SCHED_CRASH_RESET_S of clean running between them) and
+# the server stops pretending — every in-flight AND future request
+# answers 503 and /healthz fails, so an orchestrator restarts the pod
+_SCHED_MAX_RESTARTS = 8
+_SCHED_CRASH_RESET_S = 60.0
+_SCHED_BACKOFF_MAX_S = 2.0
 
 # client-supplied guided_regex length bound (ADVICE r5): pattern text
 # is attacker-controlled on the HTTP surface, and subset construction
@@ -698,6 +708,17 @@ class EngineServer:
             "tpu_serve_slow_client_drops_total",
             "Clients disconnected for not draining their stream "
             "(bounded event queue overflowed).")
+        # crash containment (PR 5): a scheduler-thread death is
+        # counted, journaled, and survived (supervised restart) —
+        # never a silent hang with clients blocked on event queues
+        self._m_sched_crashes = reg.counter(
+            "tpu_serve_scheduler_crashes_total",
+            "Engine-scheduler loop crashes caught by the supervisor.")
+        self._m_sched_restarts = reg.counter(
+            "tpu_serve_scheduler_restarts_total",
+            "Engine-scheduler restarts after a crash (crashes past "
+            "the restart budget kill the server instead).")
+        self._sched_dead = False
         # -- tracing + flight recorder (PR 4) -----------------------------
         # every span end and lifecycle event (sheds, drops, grammar
         # rejections) lands in this bounded ring, stamped with the
@@ -1111,6 +1132,11 @@ class EngineServer:
                     del self._running[slot]
             if not self._running:
                 continue
+            # chaos hook (inert attribute check when no --fault-spec):
+            # fires only when real decode work is about to run, so an
+            # armed `serve.step` fault can never crash an idle loop
+            if faults.ACTIVE is not None:
+                faults.ACTIVE.fire("serve.step")
             t_win = time.perf_counter()
             if eng.spec_ready():
                 # greedy-only traffic on a draft-loaded engine: one
@@ -1156,9 +1182,74 @@ class EngineServer:
         # is still in flight (a stuck 5s join used to race here)
         self._drain_on_stop()
 
-    def _drain_on_stop(self) -> None:
+    def _scheduler_supervisor(self) -> None:
+        """Crash containment for the engine's sole owner.  A scheduler
+        crash used to be a silent hang: the thread died, every
+        connected client blocked forever on its event queue, and
+        /healthz kept answering ok.  Now each crash 503s the in-flight
+        requests (their slots released) and restarts the loop with
+        capped backoff; a crash LOOP (``_SCHED_MAX_RESTARTS`` in a row
+        without ``_SCHED_CRASH_RESET_S`` of clean running) marks the
+        server dead — new requests get an immediate 503 and /healthz
+        fails so the orchestrator replaces the pod."""
+        crashes = 0
+        last_crash = 0.0
+        while not self._stop.is_set():
+            try:
+                self._scheduler_loop()
+                return  # clean stop-path exit; loop already drained
+            except Exception as e:
+                now = time.monotonic()
+                crashes = (1 if now - last_crash > _SCHED_CRASH_RESET_S
+                           else crashes + 1)
+                last_crash = now
+                log.exception("engine scheduler crashed (%d/%d)",
+                              crashes, _SCHED_MAX_RESTARTS)
+                self._m_sched_crashes.inc()
+                self.recorder.record(
+                    "tpu_serve_scheduler_crash",
+                    error=f"{type(e).__name__}: {e}", crashes=crashes)
+                # contain: free every engine slot (their device state
+                # is suspect after an arbitrary crash point) and 503
+                # the requests that were riding them
+                for slot in list(self._running):
+                    try:
+                        self.engine.release(slot)
+                    except Exception as re:
+                        log.debug("post-crash release of slot %s "
+                                  "failed: %s", slot, re)
+                self._drain_on_stop(
+                    "engine scheduler crashed; request aborted — "
+                    "retry")
+                if crashes >= _SCHED_MAX_RESTARTS:
+                    break
+                self._m_sched_restarts.inc()
+                self.recorder.record("tpu_serve_scheduler_restart",
+                                     attempt=crashes)
+                if self._stop.wait(min(0.05 * (2 ** (crashes - 1)),
+                                       _SCHED_BACKOFF_MAX_S)):
+                    return
+        if self._stop.is_set():
+            return
+        # permanent death: drain the pending heap too and refuse new
+        # work at admission (see _enqueue) and /healthz
+        self._sched_dead = True
+        self.recorder.record("tpu_serve_scheduler_dead",
+                             crashes=crashes)
+        log.error("engine scheduler dead after %d consecutive "
+                  "crashes; serving 503s until restarted", crashes)
+        bye = {"error": "engine scheduler crashed; server needs a "
+                        "restart", "code": 503}
+        with self._lock:
+            drained, self._pending = self._pending, []
+        for _, _, req in drained:
+            self._push(req, dict(bye))
+            self._finish_request(req, "shutdown")
+
+    def _drain_on_stop(self, reason: str = "server shutting down"
+                       ) -> None:
         """Send every connected client a terminal 503. Idempotent."""
-        bye = {"error": "server shutting down", "code": 503}
+        bye = {"error": reason, "code": 503}
         notified = set()
         for req, _idx in self._running.values():
             if id(req) not in notified:
@@ -1188,7 +1279,14 @@ class EngineServer:
                 self._trace = None  # keep-alive: no stale echo
                 url = urlparse(self.path)
                 if url.path == "/healthz":
-                    self._send(200, "text/plain", "ok\n")
+                    if server.healthy():
+                        self._send(200, "text/plain", "ok\n")
+                    else:
+                        # a dead scheduler must flunk the liveness
+                        # probe, not keep the pod looking fine while
+                        # every request 503s
+                        self._send(503, "text/plain",
+                                   "engine scheduler dead\n")
                 elif url.path == "/stats":
                     body = json.dumps(server.stats(), indent=2)
                     self._send(200, "application/json", body + "\n")
@@ -1559,7 +1657,7 @@ class EngineServer:
         threading.Thread(target=self._httpd.serve_forever,
                          name="serve-http", daemon=True).start()
         self._scheduler = threading.Thread(
-            target=self._scheduler_loop, name="engine-scheduler",
+            target=self._scheduler_supervisor, name="engine-scheduler",
             daemon=True)
         self._scheduler.start()
         log.info("serving engine on http://%s:%d", host, self.port)
@@ -1569,6 +1667,17 @@ class EngineServer:
     def port(self) -> int:
         """Actual bound port (differs from the requested one for 0)."""
         return self._httpd.server_address[1] if self._httpd else 0
+
+    def healthy(self) -> bool:
+        """Liveness: the scheduler is (or can still be) driving the
+        engine.  False once the supervisor declared it dead or the
+        thread vanished without the stop flag."""
+        if self._sched_dead:
+            return False
+        t = self._scheduler
+        if t is None:
+            return True  # not started yet / stopped cleanly
+        return t.is_alive() or self._stop.is_set()
 
     def stop(self) -> None:
         self._stop.set()
@@ -1611,6 +1720,14 @@ class EngineServer:
         stream/unary, OpenAI SSE/unary) get a real 429 + Retry-After
         instead of unbounded heap growth (vLLM's admission-control
         semantics)."""
+        if self._sched_dead:
+            # nothing will ever pop the heap again: fail fast instead
+            # of letting the client block on an event queue forever
+            self._push(req, {
+                "error": "engine scheduler crashed; server needs a "
+                         "restart", "code": 503})
+            self._finish_request(req, "shutdown")
+            return
         with self._lock:
             if len(self._pending) >= self.max_queue:
                 full = True
@@ -2167,6 +2284,15 @@ def main(argv=None) -> int:
     p.add_argument("--flight-record-capacity", type=int, default=4096,
                    help="flight-recorder ring size in events "
                         "(drop-oldest past it)")
+    p.add_argument("--fault-spec", default=None, metavar="SPEC",
+                   help="arm deterministic fault injection (chaos "
+                        "testing ONLY): op:kind:arg[;...] — e.g. "
+                        "'serve.step:error:0.02'.  Unset (the "
+                        "default) leaves every hook a no-op attribute "
+                        "check.  Env: TPU_DP_FAULTS")
+    p.add_argument("--fault-seed", type=int, default=None,
+                   help="RNG seed for --fault-spec probabilities "
+                        "(default 0; env: TPU_DP_FAULT_SEED)")
     p.add_argument("--jump-len", type=int, default=8,
                    help="structural jump-ahead width: up to this many "
                         "DFA-forced tokens (a schema's keys and "
@@ -2271,6 +2397,17 @@ def main(argv=None) -> int:
                        client_timeout=args.client_timeout,
                        flight_record_dir=args.flight_record_dir,
                        flight_record_capacity=args.flight_record_capacity)
+    if args.fault_spec is not None or args.fault_seed is not None:
+        if args.fault_spec is None:
+            p.error("--fault-seed needs --fault-spec")
+        import os as _os
+        seed = (args.fault_seed if args.fault_seed is not None
+                else int(_os.environ.get(faults.ENV_FAULT_SEED, "0")
+                         or 0))
+        faults.install(args.fault_spec, seed=seed,
+                       recorder=srv.recorder)
+    else:
+        faults.install_from_env(recorder=srv.recorder)
     srv.start(host=args.host, port=args.port)
     print(f"serving {args.config} (quantized={quantized}) on "
           f"http://{args.host}:{srv.port}  "
