@@ -317,11 +317,13 @@ def test_hot_path_hooks_are_bare_attribute_checks():
     from tpu_k8s_device_plugin.health import server as health_server
     from tpu_k8s_device_plugin.manager import manager as manager_mod
     from tpu_k8s_device_plugin.slice import client as slice_client
-    from tpu_k8s_device_plugin.workloads import server as serve_mod
+    from tpu_k8s_device_plugin.workloads import scheduler as sched_mod
 
     guard = "if faults.ACTIVE is not None:"
     for fn in (
-        serve_mod.EngineServer._scheduler_loop,
+        # the serve.step/serve.schedule site moved with the scheduling
+        # loop into the iteration scheduler (PR 6)
+        sched_mod.IterationScheduler.iterate,
         health_server.probe_chip_states,
         slice_client.SliceClient._join_once,
         slice_client.SliceClient.heartbeat_now,

@@ -1,0 +1,348 @@
+"""Iteration-scheduler equivalence and mid-window admission.
+
+The scheduler's correctness bar is the house invariant: with a fixed
+request trace, token streams are BYTE-IDENTICAL with interleaving on
+vs. off — greedy, seeded sampled, grammar-constrained, APC hit and
+miss alike.  (Unseeded sampling depends on the global key stream by
+design; per-request seeds exist precisely to opt out — same posture as
+the engine fuzz.)  Plus the split-admission API itself: begin/step/
+finish must be the one-shot admit, and the exact-repeat fast paths
+(zero-extend full-prompt APC, prefix-affinity inplace placement,
+cached greedy first token) must change nothing but the work done.
+"""
+
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_k8s_device_plugin.workloads.grammar import (
+    regex_to_dfa,
+    token_dfa,
+)
+from tpu_k8s_device_plugin.workloads.inference import (
+    greedy_generate,
+    make_decoder,
+)
+from tpu_k8s_device_plugin.workloads.scheduler import IterationScheduler
+from tpu_k8s_device_plugin.workloads.serving import ServingEngine
+
+CFG = dict(vocab=96, d_model=64, n_heads=4, n_layers=2, d_ff=128)
+EOS = 0
+MAX_LEN = 64
+PATTERN = "(AB|CD)+E"  # bytes < 96
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model = make_decoder(**CFG, max_len=MAX_LEN, dtype=jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+    params = model.init(rng, tokens, pos)["params"]
+    tb = [bytes([i]) if i else b"" for i in range(CFG["vocab"])]
+    dfa = token_dfa(regex_to_dfa(PATTERN), tb, eos_id=EOS)
+    return model, params, dfa
+
+
+def _solo(model, params, prompt, n_steps):
+    out, _ = greedy_generate(
+        model, params, jnp.asarray(prompt, jnp.int32)[None, :], n_steps)
+    return np.asarray(out)[0].tolist()
+
+
+def _drive(model, params, dfa, trace, interleave, max_new=6,
+           n_slots=2, window=4, grammar=False):
+    """Run *trace* — a list of ``(arrival_iteration, key, kwargs)`` —
+    through an IterationScheduler and return {key: tokens}.  Fully
+    deterministic: arrivals keyed to iteration indices, dwell off."""
+    eng = ServingEngine(model, params, n_slots=n_slots, chunk=4,
+                        eos_id=EOS if grammar else None,
+                        max_new_tokens=max_new, auto_prefix_min=4,
+                        grammar=dfa if grammar else None)
+    intake: deque = deque()
+    tickets = {}
+    live = {}
+    results = {}
+
+    def pull():
+        if not intake:
+            return None
+        key, kwargs = intake.popleft()
+        t = sched.begin(**kwargs)
+        tickets[t] = key
+        return t
+
+    sched = IterationScheduler(eng, window=window, interleave=interleave,
+                               prefill_budget=2, pull=pull,
+                               sync_dwell_s=0.0)
+    arrivals = sorted(trace, key=lambda a: a[0])
+    ai = 0
+    for i in range(200):
+        while ai < len(arrivals) and arrivals[ai][0] <= i:
+            intake.append(arrivals[ai][1:])
+            ai += 1
+        res = sched.iterate()
+        for t in res.admitted:
+            live[t.slot] = tickets.pop(t)
+        for slot in list(live):
+            if eng.finished(slot):
+                results[live.pop(slot)] = eng.output(slot)
+        if (ai == len(arrivals) and not intake and not live
+                and not sched.busy()):
+            break
+    assert len(results) == len(trace), "trace did not drain"
+    return results
+
+
+def _assert_equivalent(model, params, dfa, trace, **kw):
+    on = _drive(model, params, dfa, trace, interleave=True, **kw)
+    off = _drive(model, params, dfa, trace, interleave=False, **kw)
+    assert on == off
+    return on
+
+
+def test_equivalence_greedy_apc_hit_and_miss(setup):
+    # distinct prompts (APC miss), an exact repeat (full-prompt hit,
+    # the zero-extend path), and a shared-prefix prompt (partial
+    # chunk-floored hit) — all mid-trace, slots recycling throughout
+    model, params, dfa = setup
+    pa = [3, 14, 15, 92, 65, 35, 89, 79]    # 2 chunks of 4
+    pb = [2, 71, 82, 81, 82]                # miss vs pa
+    trace = [
+        (0, "a0", dict(prompt=pa)),
+        (0, "b0", dict(prompt=pb)),
+        (1, "a1", dict(prompt=pa)),          # exact repeat -> full hit
+        (2, "ash", dict(prompt=pa[:4] + [9, 9])),   # shared chunk
+        (4, "b1", dict(prompt=pb)),
+        (5, "a2", dict(prompt=pa)),
+    ]
+    on = _assert_equivalent(model, params, dfa, trace)
+    # and every stream equals the solo oracle (the scheduler can never
+    # bend tokens, only schedule them)
+    for key, prompt in (("a0", pa), ("a1", pa), ("a2", pa), ("b0", pb)):
+        assert on[key] == _solo(model, params, prompt, 6)
+
+
+def test_equivalence_seeded_sampled(setup):
+    # seeded sampling is scheduling-invariant by design (a seeded
+    # slot's chain ignores neighbors and admission order) — the
+    # interleave must preserve that bit-for-bit
+    model, params, dfa = setup
+    pa = [3, 14, 15, 92, 65]
+    pb = [2, 71, 82]
+    trace = [
+        (0, "s1", dict(prompt=pa, temperature=1.0, seed=7)),
+        (0, "g0", dict(prompt=pb)),
+        (1, "s2", dict(prompt=pa, temperature=0.7, top_k=8, seed=41)),
+        (3, "s3", dict(prompt=pa, temperature=1.0, seed=7)),
+    ]
+    on = _assert_equivalent(model, params, dfa, trace)
+    # same seed, same prompt -> same stream, wherever it was scheduled
+    assert on["s1"] == on["s3"]
+
+
+def test_equivalence_grammar_constrained(setup):
+    model, params, dfa = setup
+    trace = [
+        (0, "g1", dict(prompt=[65, 66], grammar=True)),
+        (0, "u1", dict(prompt=[2, 71, 82])),
+        (2, "g2", dict(prompt=[67, 68], grammar=True)),
+    ]
+    _assert_equivalent(model, params, dfa, trace, grammar=True,
+                       max_new=8)
+
+
+def test_mid_window_admission_prefills_inside_open_window(setup):
+    # a request that arrives while a decode window is OPEN must begin
+    # prefilling before that window closes — the whole point of
+    # iteration-level scheduling (window-boundary admission was the
+    # r6 gap)
+    model, params, dfa = setup
+    eng = ServingEngine(model, params, n_slots=2, chunk=4,
+                        max_new_tokens=12, auto_prefix_min=4)
+    seen = {}
+    pb = [2, 71, 82, 81, 82, 44, 9, 1]
+
+    def pull():
+        # a is available from the start; b only materializes while a
+        # decode window is OPEN (scan dispatched, not yet harvested) —
+        # exactly the mid-window arrival the r6 loop made wait for the
+        # window to close
+        if "a" not in seen:
+            seen["a"] = sched.begin(prompt=[3, 14, 15, 92, 65])
+            return seen["a"]
+        if "b" not in seen and eng.scan_inflight:
+            seen["b"] = sched.begin(prompt=pb)
+            return seen["b"]
+        return None
+
+    sched = IterationScheduler(eng, window=8, interleave=True,
+                               prefill_budget=8, pull=pull,
+                               sync_dwell_s=0.0)
+    res1 = sched.iterate()           # admits a + first window: b
+    res2 = sched.iterate()           # arrives while it is open
+    assert "b" in seen
+    assert res1.steps > 0            # a window ran
+    tb = seen["b"]
+    assert tb.mid_window, "b was not admitted inside the open window"
+    assert tb.chunks_done == tb.chunks_total > 0
+    # finalized before that window's harvest (same-iteration admit)
+    assert tb in res1.admitted + res2.admitted
+    assert eng.active[tb.slot]
+    # and the stream is still the oracle's
+    out_b = None
+    for _ in range(30):
+        sched.iterate()
+        if eng.finished(tb.slot):
+            out_b = eng.output(tb.slot)
+            break
+    assert out_b == _solo(model, params, pb, 12)
+
+
+def test_begin_step_finish_equals_one_shot_admit(setup):
+    model, params, dfa = setup
+    prompt = [3, 14, 15, 92, 65, 35, 89]   # 2 chunks
+    one = ServingEngine(model, params, n_slots=2, chunk=4)
+    split = ServingEngine(model, params, n_slots=2, chunk=4)
+    s1 = one.admit(prompt)
+    st = split.begin_admit(prompt)
+    assert split.free_slots() == [1]       # slot 0 reserved
+    steps = 0
+    while split.admit_step(st):
+        steps += 1
+    assert st.chunks_total == 2
+    s2 = split.finish_admit(st)
+    assert s1 == s2
+    one.run(6)
+    split.run(6)
+    assert one.output(s1) == split.output(s2)
+
+
+def test_abort_admit_frees_the_reserved_slot(setup):
+    model, params, dfa = setup
+    eng = ServingEngine(model, params, n_slots=1, chunk=4)
+    st = eng.begin_admit([3, 14, 15, 92, 65])
+    with pytest.raises(RuntimeError):
+        eng.begin_admit([1, 2])            # engine full (reserved)
+    eng.abort_admit(st)
+    s = eng.admit([1, 2])                  # slot is back
+    assert s == 0
+
+
+def test_full_prompt_apc_admits_with_zero_extends(setup):
+    # an exact repeat of a resident prompt is pure data movement: no
+    # prefill extends run at all (prefill_tokens frozen), the donor's
+    # free slot is reused in place, and tokens stay bit-identical
+    model, params, dfa = setup
+    prompt = [3, 14, 15, 92, 65, 35, 89, 79]
+    eng = ServingEngine(model, params, n_slots=2, chunk=4,
+                        auto_prefix_min=4)
+    s0 = eng.admit(prompt)
+    eng.run(6)
+    first_run = eng.output(s0)
+    eng.release(s0)
+    before = eng.stats()["prefill_tokens"]
+    s1 = eng.admit(prompt)
+    assert s1 == s0                        # prefix-affinity placement
+    assert eng.stats()["prefill_tokens"] == before   # ZERO extends
+    assert eng.stats()["prefix_reused_tokens"] >= len(prompt)
+    eng.run(6)
+    assert eng.output(s1) == first_run
+    assert eng.output(s1)[:6] == _solo(model, params, prompt, 6)
+
+
+def test_full_prompt_apc_copy_path_when_donor_slot_busy(setup):
+    # the donor is still ACTIVE: the repeat admits into another slot
+    # via the row-copy path, still with zero extends, still oracle-
+    # exact
+    model, params, dfa = setup
+    prompt = [3, 14, 15, 92, 65, 35, 89, 79]
+    eng = ServingEngine(model, params, n_slots=2, chunk=4,
+                        auto_prefix_min=4)
+    s0 = eng.admit(prompt)
+    before = eng.stats()["prefill_tokens"]
+    s1 = eng.admit(prompt)
+    assert s1 != s0
+    assert eng.stats()["prefill_tokens"] == before
+    eng.run(6)
+    assert eng.output(s0) == eng.output(s1)
+    assert eng.output(s0)[:6] == _solo(model, params, prompt, 6)
+
+
+def test_prefix_chunk_knob(setup):
+    model, params, dfa = setup
+    # auto: the APC grid caps at 32 (max_len 64 -> 32, as before)
+    assert ServingEngine(model, params, n_slots=1).chunk == 32
+    # explicit grid
+    assert ServingEngine(model, params, n_slots=1,
+                         prefix_chunk=16).chunk == 16
+    # prefix_chunk must divide max_len (padding may never overflow)
+    with pytest.raises(ValueError, match="divide"):
+        ServingEngine(model, params, n_slots=1, prefix_chunk=24)
+    # an explicit chunk already pins the grid
+    with pytest.raises(ValueError, match="not both"):
+        ServingEngine(model, params, n_slots=1, chunk=8,
+                      prefix_chunk=16)
+    # None keeps the coarse (128-cap) grid
+    assert ServingEngine(model, params, n_slots=1,
+                         prefix_chunk=None).chunk == 32  # 64//2
+    # ... and the finer default grid changes nothing about tokens
+    prompt = [3, 14, 15, 92, 65, 35, 89, 79, 12, 44]
+    fine = ServingEngine(model, params, n_slots=1, prefix_chunk=8)
+    sf = fine.admit(prompt)
+    fine.run(6)
+    assert fine.output(sf)[:6] == _solo(model, params, prompt, 6)
+
+
+def test_supersede_aborts_pending_tickets(setup):
+    model, params, dfa = setup
+    eng = ServingEngine(model, params, n_slots=1, chunk=4)
+    sched = IterationScheduler(eng, window=4, sync_dwell_s=0.0)
+    t = sched.begin(prompt=[3, 14, 15, 92, 65])
+    assert sched.busy() and not eng.free_slots()
+    sched.supersede()
+    assert not sched.busy()
+    assert eng.free_slots() == [0]         # reservation released
+    # the superseded generation raises out of a stale iterate
+    from tpu_k8s_device_plugin.workloads.scheduler import (
+        SchedulerSuperseded,
+    )
+    with pytest.raises(SchedulerSuperseded):
+        sched._check(sched._gen - 1)
+    assert t.state.result is None
+
+
+def test_scheduler_metrics_families_render(setup):
+    # the new obs families land on the caller's registry and render
+    # promlint-clean alongside everything else (the metrics-lint job
+    # re-checks the full serving surface)
+    from tpu_k8s_device_plugin import obs
+    from tools import promlint
+
+    model, params, dfa = setup
+    reg = obs.Registry()
+    eng = ServingEngine(model, params, n_slots=1, chunk=4,
+                        max_new_tokens=3)
+    done = []
+    intake = deque([("r", dict(prompt=[3, 14, 15, 92, 65]))])
+
+    def pull():
+        if not intake:
+            return None
+        key, kwargs = intake.popleft()
+        t = sched.begin(**kwargs)
+        done.append(t)
+        return t
+
+    sched = IterationScheduler(eng, window=4, pull=pull,
+                               sync_dwell_s=0.0, registry=reg)
+    for _ in range(6):
+        sched.iterate()
+    body = reg.render()
+    assert "tpu_serve_prefill_chunk_seconds" in body
+    assert "tpu_serve_admit_to_first_step_seconds" in body
+    assert 'tpu_serve_scheduler_queue_depth{kind="decode"}' in body
+    assert promlint.lint(body) == []

@@ -20,6 +20,13 @@ engine through a seeded sweep of injected fault episodes:
                                in-flight requests get 503, the
                                supervisor restarts the loop, and the
                                next request answers 200
+  6. serve.schedule hang     — an iteration wedges mid-interleave; the
+                               schedule watchdog abandons it, in-flight
+                               requests drain with 503, the supervisor
+                               restarts the loop, and traffic
+                               reconverges (the abandoned worker bails
+                               on the supersession check instead of
+                               racing the restarted loop)
 
 After every episode the system must reconverge: all devices
 re-advertised Healthy, the slice verdict healthy, serving answering
@@ -374,6 +381,82 @@ def episode_scheduler_crash(seed):
         srv.stop()
 
 
+def episode_scheduler_hang(seed):
+    """An iteration hangs mid-interleave: the schedule watchdog trips
+    (WatchdogTimeout -> crash supervisor), in-flight requests drain
+    with 503 instead of hanging, the loop restarts, and the next
+    request answers 200.  The abandoned worker must NOT race the
+    restarted loop — the supersession check has it bail before any
+    engine work."""
+    import http.client
+    import json
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_k8s_device_plugin.workloads.inference import make_decoder
+    from tpu_k8s_device_plugin.workloads.server import EngineServer
+    from tpu_k8s_device_plugin.workloads.serving import ServingEngine
+
+    model = make_decoder(vocab=128, d_model=64, n_heads=4, n_layers=2,
+                         d_ff=128, max_len=64, dtype=jnp.float32)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+    params = model.init(jax.random.PRNGKey(0), tokens, pos)["params"]
+    eng = ServingEngine(model, params, n_slots=2)
+    srv = EngineServer(eng, max_new_tokens=8, window=4,
+                       schedule_watchdog_s=0.5)
+    srv.start(host="127.0.0.1", port=0)
+
+    def post(payload, timeout=120):
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=timeout)
+        try:
+            conn.request("POST", "/generate", json.dumps(payload),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    try:
+        status, _ = post({"tokens": [3, 14, 15], "max_new_tokens": 4,
+                          "stream": False})
+        check(status == 200, "serving baseline request answered 200")
+        faults.install("serve.schedule:hang:5", seed=seed,
+                       recorder=srv.recorder)
+        try:
+            status, body = post({"tokens": [9, 9, 8],
+                                 "max_new_tokens": 4, "stream": False})
+            check(status == 503,
+                  f"hung iteration drained the in-flight request with "
+                  f"a real 503 (got {status}: {body[:80]!r})")
+        finally:
+            faults.uninstall()
+        trips = [e for e in srv.recorder.events(name="tpu_watchdog_trip")
+                 if e["attrs"].get("op") == "serve.schedule"]
+        check(trips, "schedule-watchdog trip journaled")
+        deadline = time.time() + 10.0
+        while (time.time() < deadline
+               and srv._m_sched_restarts.value < 1):
+            time.sleep(0.05)
+        check(srv._m_sched_restarts.value >= 1,
+              "supervisor restarted the scheduler after the trip")
+        samples = obs.parse_exposition(srv.render_metrics())
+        wd = [v for n, lab, v in samples
+              if n == "tpu_watchdog_trips_total"
+              and lab.get("op") == "serve.schedule"]
+        check(wd and wd[0] >= 1,
+              "tpu_watchdog_trips_total{op=serve.schedule} counted")
+        status, body = post({"tokens": [2, 71, 82],
+                             "max_new_tokens": 4, "stream": False})
+        check(status == 200,
+              f"traffic reconverged after the hang "
+              f"(got {status}: {body[:80]!r})")
+    finally:
+        srv.stop()
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="chaos-soak")
     p.add_argument("--seed", type=int,
@@ -437,6 +520,8 @@ def main(argv=None) -> int:
         if not args.skip_serving:
             log.info("=== episode 5: serving scheduler crash ===")
             episode_scheduler_crash(args.seed)
+            log.info("=== episode 6: scheduler hang mid-interleave ===")
+            episode_scheduler_hang(args.seed)
         # -- final convergence sweep ----------------------------------
         for h in hosts:
             h.pulse()

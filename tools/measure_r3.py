@@ -64,6 +64,14 @@ PHASES = [
     # gather at a real vocab width
     ("serving_http_b8", 1800),
     ("grammar_overhead_b8", 1800),
+    # round-6 additions: the iteration scheduler (continuous batching
+    # with chunked/interleaved prefill + engine-level chunk-aligned
+    # APC) A/B on real hardware — the CPU-proxied http-smoke ratio
+    # (0.85 gate) needs an on-chip counterpart before the serving perf
+    # story can stop saying "CPU-proxied".  Same invocation either
+    # way; only the scheduler's interleave flips.
+    ("serving_sched_interleave_b8", 1800),
+    ("serving_sched_no_interleave_b8", 1800),
 ]
 
 
@@ -254,6 +262,28 @@ def phase_serving_http_b8():
 
     return run("llama3-8b", True, 8, 64, prompt_len=128, max_len=512,
                http_clients=16, http_requests=32)
+
+
+def phase_serving_sched_interleave_b8():
+    """Iteration scheduler ON (PR 6 default): chunked prefill
+    interleaved with open decode windows, mid-window admission,
+    adaptive windows, full-prompt APC fast path.  Compare
+    http_over_engine_ratio and the prefill/decode split against the
+    no-interleave phase below."""
+    from tpu_k8s_device_plugin.workloads.bench_serving import run
+
+    return run("llama3-8b", True, 8, 64, prompt_len=128, max_len=512,
+               http_clients=8, http_requests=32, interleave=True)
+
+
+def phase_serving_sched_no_interleave_b8():
+    """Same load with interleaving OFF (admissions run fully between
+    windows — the r6 cadence): the delta is the scheduler's on-chip
+    win, with bit-identical outputs either way."""
+    from tpu_k8s_device_plugin.workloads.bench_serving import run
+
+    return run("llama3-8b", True, 8, 64, prompt_len=128, max_len=512,
+               http_clients=8, http_requests=32, interleave=False)
 
 
 def phase_grammar_overhead_b8():

@@ -9,7 +9,8 @@ Spec grammar (``--fault-spec`` / ``TPU_DP_FAULTS``)::
     spec  := rule (';' rule)*
     rule  := op ':' kind ':' arg [':' prob]
     op    := dotted operation name (kubelet.register, slice.join,
-             slice.heartbeat, health.list, probe, serve.step, ...)
+             slice.heartbeat, health.list, probe, serve.step,
+             serve.schedule, ...)
     kind  := 'error' | 'drop' | 'hang'
     arg   := error/drop: probability in [0,1]
              hang: seconds to stall (optional prob as 4th field)
@@ -20,6 +21,8 @@ Examples::
     probe:hang:5                    # every probe stalls 5s
     kubelet.register:drop:0.5       # half the Registers are lost
     serve.step:error:0.02           # 2% of scheduler steps crash
+    serve.schedule:hang:5           # every scheduler iteration wedges
+                                    # 5s (trips the schedule watchdog)
 
 Determinism: the injector owns one ``random.Random(seed)``; the same
 seed and call sequence produce the same injections, so a chaos failure

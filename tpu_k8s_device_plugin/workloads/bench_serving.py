@@ -138,7 +138,8 @@ def load_checkpoint_params(config: str, max_len: int, quantized,
 def run(config: str, quantized, batch: int, steps: int,
         prompt_len: int, max_len: int, engine: bool = False,
         spec: int = 0, http_clients: int = 0, http_requests: int = 0,
-        cancel_every: int = 0, burst: int = 0):
+        cancel_every: int = 0, burst: int = 0,
+        interleave: bool = True):
     # fail fast for library callers too, not just the CLI: engine mode
     # consumes (warmup + rounds) run_scan windows of cache headroom,
     # and a mid-benchmark ValueError from run_scan is a worse place to
@@ -176,7 +177,8 @@ def run(config: str, quantized, batch: int, steps: int,
         stats = _http_throughput(
             model, params, prompt, steps, http_clients,
             http_requests or 4 * http_clients, slots=batch,
-            cancel_every=cancel_every, burst=burst)
+            cancel_every=cancel_every, burst=burst,
+            interleave=interleave)
     elif engine:
         stats = _engine_throughput(model, params, prompt, steps)
     else:
@@ -343,6 +345,43 @@ def _http_burst(port, n_burst: int, tokens, lock):
     return statuses
 
 
+def _trace_breakdown(port, traced):
+    """Admit→first-token breakdown aggregated over every traced
+    request, straight from ``/debug/traces``: mean milliseconds spent
+    in the queue, in admission (prefill + splice, possibly overlapped
+    with an open decode window), and to the first token.  The
+    per-request spans are the same ones `_print_slowest_traces` shows
+    for the tail."""
+    import http.client
+    import json as _json
+
+    sums = {"tpu_serve_queue_wait": [], "tpu_serve_admit": [],
+            "tpu_serve_ttft": []}
+    for _latency, tid in traced:
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=30)
+            conn.request("GET", f"/debug/traces?trace_id={tid}")
+            body = _json.loads(conn.getresponse().read())
+            conn.close()
+        except OSError:
+            continue
+        per = {}
+        for ev in body.get("events", []):
+            d = ev.get("attrs", {}).get("duration_s")
+            if isinstance(d, (int, float)) and ev["name"] in sums:
+                per[ev["name"]] = per.get(ev["name"], 0.0) + d
+        for name, v in per.items():
+            sums[name].append(v)
+    out = {}
+    for name, key in (("tpu_serve_queue_wait", "queue_wait_ms_mean"),
+                      ("tpu_serve_admit", "admit_ms_mean"),
+                      ("tpu_serve_ttft", "ttft_ms_mean")):
+        if sums[name]:
+            out[key] = 1e3 * sum(sums[name]) / len(sums[name])
+    return out
+
+
 def _print_slowest_traces(port, traced, k=3):
     """The bench explains its own tail: pull the *k* slowest benched
     requests' server-side timelines from ``/debug/traces`` and print
@@ -385,7 +424,7 @@ def _print_slowest_traces(port, traced, k=3):
 
 def _http_throughput(model, params, prompt, steps, clients,
                      n_requests, slots, cancel_every: int = 0,
-                     burst: int = 0):
+                     burst: int = 0, interleave: bool = True):
     """Front-door load test (VERDICT r4 #5): *clients* concurrent
     streaming HTTP clients drive *n_requests* total requests (mixed
     priorities; every *cancel_every*-th request disconnects after its
@@ -407,16 +446,11 @@ def _http_throughput(model, params, prompt, steps, clients,
     from .serving import ServingEngine
 
     prompt_host = np.asarray(prompt)
-    # chunk=32: with the bench's 128-token prompts the default
-    # 128-chunk grid floors every automatic-prefix match to zero
-    # ((t_p - 1) // 128 == 0), so repeat prompts paid FULL prefills —
-    # at 32, returning prompts reuse 96/128 rows from resident slots
-    # and admission stops dominating the front-door wall clock (the
-    # direct-engine comparison never pays prefill at all).  The chunk
-    # must divide max_len (padding may never overflow the cache), so
-    # odd max_len falls back to the auto grid
-    chunk = 32 if model.max_len % 32 == 0 else "auto"
-    eng = ServingEngine(model, params, n_slots=slots, chunk=chunk)
+    # the chunk-32 APC alignment this harness used to carry lives in
+    # the ENGINE now (prefix_chunk="auto", the ServingEngine default):
+    # every caller gets prefix reuse at chunk granularity, not just
+    # this bench
+    eng = ServingEngine(model, params, n_slots=slots)
     # a deliberately SMALL pool/queue: the load phase fits inside it,
     # and the burst phase overflows it — so the measured path is the
     # production admission-control path, not an unbounded one
@@ -425,7 +459,12 @@ def _http_throughput(model, params, prompt, steps, clients,
     # the throughput side of the dial for a load benchmark
     srv = EngineServer(eng, max_new_tokens=steps, window=16,
                        max_connections=clients + 2,
-                       max_queue=max(clients, slots, 4))
+                       max_queue=max(clients, slots, 4),
+                       interleave=interleave)
+    # pre-compile the scheduler's adaptive-window scan variants: each
+    # distinct window length is its own XLA compile, and it would
+    # otherwise land mid-traffic the first time the batch synchronizes
+    srv.warm_scheduler()
     srv.start(host="127.0.0.1", port=0)
     lock = threading.Lock()
     ttfts, tpots, done_tokens, errors = [], [], [], []
@@ -460,16 +499,26 @@ def _http_throughput(model, params, prompt, steps, clients,
                 first = last = None
                 n_toks = 0
                 for line in resp:
-                    if not line.strip():
+                    s = line.strip()
+                    if not s:
                         continue
                     now = time.perf_counter()
-                    ev = _json.loads(line)
-                    # coalesced window frames ({"tokens": [...]}) are
-                    # the default wire shape; legacy per-token events
-                    # ({"token": t}) still count one each
-                    k = (len(ev["tokens"])
-                         if "tokens" in ev and "done" not in ev
-                         else 1 if "token" in ev else 0)
+                    # the hot wire shape is the coalesced n=1 window
+                    # frame {"tokens":[a,b,...]}: count its ids by
+                    # comma instead of a full json parse — on shared
+                    # CPU the load generator must not steal cycles
+                    # from the engine it is measuring (terminal events
+                    # still parse fully below)
+                    if s.startswith(b'{"tokens":[') and s[-2:] == b']}':
+                        k = s.count(b",") + 1
+                        ev = None
+                    else:
+                        ev = _json.loads(s)
+                        # legacy per-token events ({"token": t}) still
+                        # count one each
+                        k = (len(ev["tokens"])
+                             if "tokens" in ev and "done" not in ev
+                             else 1 if "token" in ev else 0)
                     if k:
                         n_toks += k
                         last = now
@@ -504,15 +553,33 @@ def _http_throughput(model, params, prompt, steps, clients,
         # TWICE with the same prompt: the second admit hits the
         # automatic prefix cache, compiling the donor-splice +
         # tail-extend shapes the timed repeats rely on
-        for _ in range(2):
+        def _warm_one(i):
             warm = http.client.HTTPConnection("127.0.0.1", srv.port,
                                               timeout=600)
             warm.request("POST", "/generate", _json.dumps(
-                {"tokens": prompt_host[0].tolist(),
+                {"tokens": prompt_host[i % len(prompt_host)].tolist(),
                  "max_new_tokens": steps, "stream": False}),
                 {"Content-Type": "application/json"})
             warm.getresponse().read()
             warm.close()
+
+        for _ in range(2):
+            _warm_one(0)
+        # ... and ONCE concurrently at full width: the iteration
+        # scheduler's adaptive window sizes are each their own
+        # compiled scan (quantized multiples of the floor), and every
+        # distinct prompt's first admission is a cold prefill — both
+        # belong to warmup, not to the timed percentiles
+        warm_threads = [threading.Thread(target=_warm_one, args=(i,))
+                        for i in range(slots)]
+        for t in warm_threads:
+            t.start()
+        for t in warm_threads:
+            t.join()
+        # post-warmup snapshot: the timed phase's prefill/decode split
+        # is reported as DELTAS against this (warmup prefills are
+        # compile fodder, not workload)
+        stats_warm = srv.stats()
 
         t_start = time.perf_counter()
         threads = [threading.Thread(target=client_loop, args=(c,))
@@ -522,6 +589,9 @@ def _http_throughput(model, params, prompt, steps, clients,
         for t in threads:
             t.join()
         wall = time.perf_counter() - t_start
+        # timed-phase snapshot BEFORE the burst phase: the
+        # prefill/decode split must not absorb burst-request prefills
+        stats_load = srv.stats()
         burst_statuses = []
         if burst:
             burst_statuses = _http_burst(
@@ -536,8 +606,10 @@ def _http_throughput(model, params, prompt, steps, clients,
         metrics_body = mconn.getresponse().read().decode()
         mconn.close()
         # the tail explained: span breakdowns for the 3 slowest traced
-        # requests, straight from the server's flight recorder
+        # requests, straight from the server's flight recorder — plus
+        # the admit→first-token means over EVERY traced request
         _print_slowest_traces(srv.port, traced)
+        breakdown = _trace_breakdown(srv.port, traced)
     finally:
         # a failure mid-bench must not leak the live server/engine
         # into the rest of the process
@@ -569,13 +641,30 @@ def _http_throughput(model, params, prompt, steps, clients,
             100.0 * (1.0 - http_tps / eng_stats["tokens_per_sec"]),
         "http_over_engine_ratio":
             http_tps / eng_stats["tokens_per_sec"],
+        # prefill/decode split for the TIMED phase (warmup excluded):
+        # decode tokens/s is the emitted-token rate above; prefill
+        # tokens/s is how much prompt prefill the same wall clock
+        # absorbed (APC-discounted — full-prompt cache hits prefill 0)
+        "decode_tokens_per_sec": http_tps,
+        "prefill_tokens_per_sec":
+            (stats_load.get("prefill_tokens", 0)
+             - stats_warm.get("prefill_tokens", 0)) / wall,
+        "prefix_cache_hits": float(
+            stats_load.get("prefix_cache_hits", 0)
+            - stats_warm.get("prefix_cache_hits", 0)),
+        "prefix_reused_tokens": float(
+            stats_load.get("prefix_reused_tokens", 0)
+            - stats_warm.get("prefix_reused_tokens", 0)),
     }
+    out.update(breakdown)
     # server-side percentiles, estimated from the scraped histogram
     # buckets (what PromQL histogram_quantile would show a dashboard)
     hist_samples = obs.parse_exposition(metrics_body)
     for key, hname in (("hist_ttft", "tpu_serve_ttft_seconds"),
                        ("hist_tpot", "tpu_serve_token_seconds"),
-                       ("hist_request", "tpu_serve_request_seconds")):
+                       ("hist_request", "tpu_serve_request_seconds"),
+                       ("hist_admit_to_first_step",
+                        "tpu_serve_admit_to_first_step_seconds")):
         for q, tag in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
             v = obs.histogram_quantile(hist_samples, hname, q)
             if v == v:  # NaN = series absent (no samples)
@@ -634,6 +723,16 @@ def main(argv=None) -> int:
                         "simultaneous requests (half slow-reading) "
                         "against the fixed pool — reports the "
                         "200/429 shed mix (backpressure phase)")
+    p.add_argument("--no-interleave", action="store_true",
+                   help="with --http: disable iteration-level "
+                        "prefill/decode interleaving (A/B against the "
+                        "scheduler; outputs identical either way)")
+    p.add_argument("--assert-ratio", type=float, default=0.0,
+                   metavar="FLOOR",
+                   help="with --http: exit nonzero unless "
+                        "http_over_engine_ratio >= FLOOR (the CI "
+                        "regression gate for the continuous-batching "
+                        "target)")
     args = p.parse_args(argv)
 
     devs = jax.devices()
@@ -647,21 +746,31 @@ def main(argv=None) -> int:
         # silently running a different experiment than the one asked
         # for is worse than an error
         p.error(f"{' and '.join(modes)} are mutually exclusive")
-    if (args.requests or args.cancel_every or args.burst) \
+    if (args.requests or args.cancel_every or args.burst
+            or args.assert_ratio or args.no_interleave) \
             and not args.http:
-        p.error("--requests/--cancel-every/--burst only apply "
-                "with --http")
+        p.error("--requests/--cancel-every/--burst/--assert-ratio/"
+                "--no-interleave only apply with --http")
     quantized = "int4" if args.int4 else args.quantized
     try:
         stats = run(args.config, quantized, args.batch, args.steps,
                     args.prompt_len, args.max_len, engine=args.engine,
                     spec=args.spec, http_clients=args.http,
                     http_requests=args.requests,
-                    cancel_every=args.cancel_every, burst=args.burst)
+                    cancel_every=args.cancel_every, burst=args.burst,
+                    interleave=not args.no_interleave)
     except ValueError as e:
         p.error(str(e))
     for k, v in stats.items():
         print(f"{k}: {v}")
+    if args.assert_ratio:
+        ratio = stats.get("http_over_engine_ratio", 0.0)
+        if ratio < args.assert_ratio:
+            print(f"FAIL: http_over_engine_ratio {ratio:.3f} below "
+                  f"the {args.assert_ratio:.2f} floor", flush=True)
+            return 1
+        print(f"OK: http_over_engine_ratio {ratio:.3f} >= "
+              f"{args.assert_ratio:.2f}", flush=True)
     return 0
 
 

@@ -11,11 +11,16 @@ engine keeps all slots decoding.
 Design: ONE scheduler thread owns the engine (admission, decode,
 harvest — the engine is not thread-safe and never needs to be); HTTP
 handler threads only enqueue requests and drain per-request event
-queues.  Decode runs as ``run_scan`` windows (one compiled scan per
-window, no per-token host round-trip), with admission interleaved
-between windows so a request arriving mid-generation lands in a free
-slot without disturbing running streams — continuous batching over the
-wire, not just in a benchmark loop.
+queues.  The loop drives ``scheduler.IterationScheduler`` —
+iteration-level continuous batching: decode runs as ``run_scan``
+windows (one compiled scan per window, no per-token host round-trip)
+whose dispatch/harvest seam the scheduler uses to slide admission work
+INSIDE the open window — prefill chunks, new arrivals, and admission
+finishes all overlap in-flight decode, a request arriving mid-window
+starts prefilling before that window closes, and its first token
+streams the moment its splice lands.  Windows grow adaptively
+(quantized multiples of ``--window``; see docs §Continuous batching)
+when every running request still needs the steps.
 
 API (JSON over HTTP/1.1):
 
@@ -119,6 +124,11 @@ from .grammar import (
     token_bytes_of,
     token_dfa,
 )
+from .scheduler import (
+    ADAPTIVE_WINDOW_FACTOR,
+    DEFAULT_PREFILL_BUDGET,
+    IterationScheduler,
+)
 from .serving import ServingEngine
 
 log = logging.getLogger(__name__)
@@ -126,10 +136,10 @@ log = logging.getLogger(__name__)
 # stats() keys that describe CURRENT state; everything else in stats()
 # is monotonic and bridges to /metrics as a counter (``_total`` names)
 _GAUGE_STATS = frozenset({
-    "n_slots", "active_slots", "free_slots",
+    "n_slots", "active_slots", "free_slots", "reserved_slots",
     "registered_prefixes", "pending_requests",
-    "running_requests", "running_copies", "window",
-    "http_workers", "connections_waiting", "max_queue",
+    "running_requests", "running_copies", "admitting_copies",
+    "window", "http_workers", "connections_waiting", "max_queue",
     "grammar_patterns",
 })
 
@@ -598,7 +608,10 @@ class EngineServer:
                  max_grammar_states: int = 8192,
                  client_timeout: float = 120.0,
                  flight_record_dir: Optional[str] = None,
-                 flight_record_capacity: int = 4096):
+                 flight_record_capacity: int = 4096,
+                 interleave: bool = True,
+                 prefill_chunks: int = DEFAULT_PREFILL_BUDGET,
+                 schedule_watchdog_s: float = 0.0):
         """*tokenizer* (anything with ``encode(str) -> List[int]`` and
         ``decode(List[int]) -> str``, e.g. a transformers tokenizer)
         unlocks the text-level surface: ``"prompt"`` strings, STRING
@@ -729,6 +742,39 @@ class EngineServer:
         self.flight_record_dir = flight_record_dir
         if flight_record_dir:
             self.recorder.install_dump_handlers(flight_record_dir)
+        # -- iteration scheduler (continuous batching) --------------------
+        # the engine's sole driver: a unified work queue of decode
+        # windows and prefill chunks.  With interleave on (default),
+        # prefill chunks, new admissions, and admission finishes are
+        # dispatched while a decode window runs on the device — a
+        # request admitted mid-window starts prefilling before that
+        # window closes, and admission no longer stalls running
+        # streams.  interleave=False reproduces the old
+        # admit-fully-then-scan cadence (outputs are bit-identical
+        # either way — the equivalence tests pin it).
+        self.interleave = bool(interleave)
+        self._sched = IterationScheduler(
+            engine, window=window, interleave=interleave,
+            prefill_budget=prefill_chunks, pull=self._pull_ticket,
+            on_admit=self._bind_admitted,
+            budget_hint=self._budget_hint, registry=reg,
+            recorder=self.recorder)
+        self._tickets: dict = {}   # Ticket -> (_Request, copy idx)
+        # optional hang containment for the scheduler loop: a watchdog
+        # fails an iteration stuck past the deadline (WatchdogTimeout
+        # -> the crash supervisor 503s in-flight requests and
+        # restarts).  Off by default: a first-window compile can
+        # legitimately take tens of seconds, so the knob is for
+        # operators (and the chaos harness) who know their steady
+        # state.
+        self._sched_watchdog = None
+        if schedule_watchdog_s > 0:
+            from tpu_k8s_device_plugin import resilience
+
+            self._sched_watchdog = resilience.Watchdog(
+                op="serve.schedule", timeout_s=schedule_watchdog_s,
+                metrics=resilience.ResilienceMetrics(reg),
+                recorder=self.recorder)
 
     def _mark(self, req: "_Request", name: str, duration_s: float,
               **attrs) -> None:
@@ -768,14 +814,18 @@ class EngineServer:
 
     # -- scheduler (sole owner of the engine) -------------------------------
 
-    def _admit_pending(self) -> None:
-        """Admit copies of queued requests into free slots.  A request
-        with n > 1 admits one slot per copy, INCREMENTALLY as slots
-        free (continuous batching, not gang scheduling) — sibling
-        copies share the prompt, so the automatic prefix cache turns
-        every copy after the first into a tail-only prefill."""
+    def _pull_ticket(self):
+        """The iteration scheduler's intake: pop the next request copy
+        off the priority heap and hand it over as an admission ticket
+        (``begin_admit`` under the hood — validation errors 400 here,
+        prefill runs later, interleaved with decode).  A request with
+        n > 1 admits one ticket per copy, INCREMENTALLY as slots free
+        (continuous batching, not gang scheduling) — sibling copies
+        share the prompt, so the automatic prefix cache turns every
+        copy after the first into a tail-only prefill.  Returns None
+        when nothing is waiting."""
         eng = self.engine
-        while eng.free_slots():
+        while True:
             with self._lock:
                 head = self._head
                 top = self._pending[0] if self._pending else None
@@ -795,7 +845,7 @@ class EngineServer:
                 elif top is not None:
                     req = heapq.heappop(self._pending)[2]
                 else:
-                    return
+                    return None
             if req.cancelled:
                 continue
             try:
@@ -840,8 +890,7 @@ class EngineServer:
                     wait_dt = time.perf_counter() - req.t_arrival
                     self._m_queue_wait.observe(wait_dt)
                     self._mark(req, "tpu_serve_queue_wait", wait_dt)
-                t_admit = time.perf_counter()
-                slot = eng.admit(
+                ticket = self._sched.begin(
                     req.tokens, temperature=req.temperature,
                     top_k=req.top_k, top_p=req.top_p,
                     min_p=req.min_p,
@@ -867,25 +916,21 @@ class EngineServer:
                     min_tokens=req.min_tokens,
                     grammar=gid)
             except (ValueError, RuntimeError) as e:
-                # identical args per copy, so only the FIRST admit can
-                # fail on validation (the free-slot guard rules out
-                # engine-full) — no partially-errored requests
+                # identical args per copy, so only the FIRST begin can
+                # fail on validation (the scheduler pulls only with a
+                # free slot, ruling out engine-full) — no
+                # partially-errored requests
                 self._requests_rejected += 1
                 self._push(req, {"error": str(e), "code": 400})
                 self._finish_request(req, "rejected")
                 continue
-            admit_dt = time.perf_counter() - t_admit
-            self._m_admit.observe(admit_dt)
-            self._mark(req, "tpu_serve_admit", admit_dt, slot=slot,
-                       copy=req.admitted)
             idx = req.admitted
             req.admitted += 1
             req.emitted[idx] = 0
-            self._running[slot] = (req, idx)
+            self._tickets[ticket] = (req, idx)
             if req.admitted < req.n:
-                self._head = req  # next free slot continues this req
-            # the admit's first sampled token streams immediately
-            self._emit(slot, req, idx, eng.output(slot))
+                self._head = req  # the next pull continues this req
+            return ticket
 
     def _push(self, req: _Request, ev) -> bool:
         """Queue *ev* for *req*'s connection without ever blocking the
@@ -1117,55 +1162,45 @@ class EngineServer:
 
     def _scheduler_loop(self) -> None:
         eng = self.engine
+        sched = self._sched
         while not self._stop.is_set():
-            self._admit_pending()
-            if not self._running:
+            # drop requests whose client went away: running slots and
+            # admissions still prefilling alike
+            for slot, (req, _idx) in list(self._running.items()):
+                if req.cancelled:
+                    eng.release(slot)
+                    del self._running[slot]
+            for ticket, (req, _idx) in list(self._tickets.items()):
+                if req.cancelled:
+                    sched.cancel(ticket)
+                    del self._tickets[ticket]
+            if (not self._running and not sched.busy()
+                    and not self._intake_waiting()):
                 # idle: wait for work without spinning (admission is
                 # priority-then-FIFO; requests stay in the heap)
                 self._work.wait(timeout=_IDLE_POLL_S)
                 self._work.clear()
                 continue
-            # drop requests whose client went away
-            for slot, (req, _idx) in list(self._running.items()):
-                if req.cancelled:
-                    eng.release(slot)
-                    del self._running[slot]
-            if not self._running:
-                continue
-            # chaos hook (inert attribute check when no --fault-spec):
-            # fires only when real decode work is about to run, so an
-            # armed `serve.step` fault can never crash an idle loop
-            if faults.ACTIVE is not None:
-                faults.ACTIVE.fire("serve.step")
+            # chaos hooks (serve.step / serve.schedule) fire INSIDE
+            # iterate, after admission work and before the decode
+            # round — an armed fault can never crash an idle loop, and
+            # a crashed iteration's requests are already ticket-bound
+            # so the supervisor's drain 503s every one of them
             t_win = time.perf_counter()
-            if eng.spec_ready():
-                # greedy-only traffic on a draft-loaded engine: one
-                # speculative round commits up to gamma+1 tokens per
-                # slot for one host round-trip (spec_round handles the
-                # cache endgame itself); a sampled/logprobs admission
-                # flips the loop back to run_scan until it drains
-                eng.spec_round()
-            elif eng.forced_pending() and eng.jump_round() is not None:
-                # structural jump-ahead: a grammar slot's next tokens
-                # are DFA-forced (JSON keys/punctuation), so one
-                # fixed-width extend commits the whole chain.  A None
-                # return means the jump could not run safely (endgame
-                # headroom / parked-donor band) and did no device
-                # work — the elif is then false and the scan path
-                # below handles this iteration
-                pass
+            # one scheduler iteration: admission work (pull, prefill
+            # chunks, finishes) interleaved with at most one decode
+            # round — scan window, spec round, jump round, or endgame
+            # step (the scheduler replicates the old adaptive choice)
+            if self._sched_watchdog is not None:
+                res = self._sched_watchdog.call(sched.iterate)
             else:
-                headroom = min(
-                    eng.model.max_len - eng.lens[s]
-                    for s in self._running
-                )
-                window = min(self.window, headroom)
-                if window < 1:
-                    # a slot ran out of cache: one step() retires it
-                    eng.step()
-                else:
-                    eng.run_scan(window)
+                res = sched.iterate()
             win_dt = time.perf_counter() - t_win
+            # admissions were bound + their first tokens emitted the
+            # moment they resolved (the scheduler's on_admit callback
+            # fires mid-window); only decode output is left to stream
+            if not res.steps:
+                continue
             for slot, (req, idx) in list(self._running.items()):
                 before = req.emitted.get(idx, 0)
                 self._emit(slot, req, idx, eng.output(slot))
@@ -1181,6 +1216,75 @@ class EngineServer:
         # drain itself so stop() never mutates them while a device step
         # is still in flight (a stuck 5s join used to race here)
         self._drain_on_stop()
+
+    def _intake_waiting(self) -> bool:
+        """Anything in the priority heap (or a partially-admitted n>1
+        head) the scheduler could pull?"""
+        with self._lock:
+            return bool(self._pending) or self._head is not None
+
+    def _budget_hint(self, slot: int):
+        """Remaining-token hint for the scheduler's adaptive window:
+        how many more steps this slot's request needs.  None (= stay
+        at the window floor) for stop-STRING requests — their cut is
+        a server-side text scan, so harvest granularity is the only
+        thing bounding post-stop garbage decode."""
+        binding = self._running.get(slot)
+        if binding is None:
+            return None
+        req, idx = binding
+        if req.stop_strs:
+            return None
+        return max(1, req.max_new_tokens - req.emitted.get(idx, 0))
+
+    def _bind_admitted(self, ticket) -> None:
+        """An admission went live (the scheduler's on_admit callback,
+        possibly MID-WINDOW): bind the slot into ``_running`` and
+        stream the admission's first sampled token right away."""
+        eng = self.engine
+        binding = self._tickets.pop(ticket, None)
+        if binding is None:
+            # cancelled after its splice landed: free the slot
+            eng.release(ticket.slot)
+            return
+        req, idx = binding
+        admit_dt = ticket.t_done - ticket.t_begin
+        self._m_admit.observe(admit_dt)
+        self._mark(req, "tpu_serve_admit", admit_dt,
+                   slot=ticket.slot, copy=idx,
+                   chunks=ticket.chunks_total,
+                   mid_window=ticket.mid_window)
+        self._running[ticket.slot] = (req, idx)
+        self._emit(ticket.slot, req, idx, eng.output(ticket.slot))
+
+    def _admit_pending(self) -> None:
+        """Synchronously admit every queued request copy that fits —
+        the pre-scheduler cadence, kept as the deterministic hook for
+        tests and embedders that drive the engine without the loop
+        thread (the loop itself admits through ``iterate()``, where
+        prefill interleaves with open decode windows).  Binding and
+        first-token emission ride the scheduler's on_admit callback."""
+        self._sched._drain_admissions()
+
+    def warm_scheduler(self) -> None:
+        """Pre-compile the scheduler's quantized adaptive-window scan
+        variants.  Every distinct window length is its own XLA
+        compile; without this, the FIRST synchronized batch eats
+        seconds of compile mid-traffic (phase-dependent: whenever the
+        running requests first line up on a grown window).  The CLI
+        and the serving bench call it before taking traffic; tests
+        that never hit grown windows skip the cost.  Call BEFORE
+        start() or while idle — it drives the engine directly."""
+        eng = self.engine
+        slot = eng.admit([0], ignore_eos=True)
+        try:
+            for k in range(1, ADAPTIVE_WINDOW_FACTOR + 1):
+                n = self.window * k
+                if eng.lens[slot] + n > eng.model.max_len:
+                    break
+                eng.run_scan(n)
+        finally:
+            eng.release(slot)
 
     def _scheduler_supervisor(self) -> None:
         """Crash containment for the engine's sole owner.  A scheduler
@@ -1209,6 +1313,16 @@ class EngineServer:
                 self.recorder.record(
                     "tpu_serve_scheduler_crash",
                     error=f"{type(e).__name__}: {e}", crashes=crashes)
+                # invalidate the crashed iteration FIRST: a
+                # watchdog-abandoned worker that wakes later re-checks
+                # the generation and bails before touching the engine
+                # the restarted loop now owns; pending admissions are
+                # aborted (their requests 503 in the drain below)
+                try:
+                    self._sched.supersede()
+                except Exception as se:
+                    log.debug("post-crash scheduler supersede "
+                              "failed: %s", se)
                 # contain: free every engine slot (their device state
                 # is suspect after an arbitrary crash point) and 503
                 # the requests that were riding them
@@ -1250,6 +1364,10 @@ class EngineServer:
                        ) -> None:
         """Send every connected client a terminal 503. Idempotent."""
         bye = {"error": reason, "code": 503}
+        try:
+            self._sched.supersede()  # abort in-flight admissions
+        except Exception as se:
+            log.debug("drain-time scheduler supersede failed: %s", se)
         notified = set()
         for req, _idx in self._running.values():
             if id(req) not in notified:
@@ -1257,6 +1375,15 @@ class EngineServer:
                 self._push(req, dict(bye))
                 self._finish_request(req, "shutdown")
         self._running.clear()
+        # admissions still prefilling when the loop died: same 503
+        # (their tickets were aborted by supersede/stop — the slot
+        # reservation is gone either way)
+        for req, _idx in self._tickets.values():
+            if id(req) not in notified:
+                notified.add(id(req))
+                self._push(req, dict(bye))
+                self._finish_request(req, "shutdown")
+        self._tickets.clear()
         if self._head is not None:
             if id(self._head) not in notified:
                 self._push(self._head, dict(bye))
@@ -2177,6 +2304,7 @@ class EngineServer:
             "running_requests": len(
                 {id(r) for r, _ in self._running.values()}),
             "running_copies": len(self._running),
+            "admitting_copies": len(self._tickets),
             "requests_served": self._requests_served,
             "requests_rejected": self._requests_rejected,
             # promoted counters read back so /stats and /metrics agree
@@ -2244,6 +2372,31 @@ def main(argv=None) -> int:
     p.add_argument("--max-new-tokens", type=int, default=256,
                    help="default per-request budget")
     p.add_argument("--window", type=int, default=DEFAULT_WINDOW)
+    p.add_argument("--prefix-chunk", type=int, default=0,
+                   metavar="N",
+                   help="admission/prefix-cache grid: prompts prefill "
+                        "in N-token chunks and APC matches floor to "
+                        "whole chunks (must divide --max-len); 0 = "
+                        "engine auto (32-grid when max_len allows)")
+    p.add_argument("--no-interleave", action="store_true",
+                   help="disable iteration-level prefill/decode "
+                        "interleaving (admissions then run fully "
+                        "between decode windows, the pre-scheduler "
+                        "cadence; outputs are identical either way)")
+    p.add_argument("--prefill-chunks", type=int,
+                   default=DEFAULT_PREFILL_BUDGET, metavar="K",
+                   help="prefill chunks dispatched into one open "
+                        "decode window (interleave granularity): "
+                        "higher admits long prompts faster, lower "
+                        "bounds how long a window's harvest can be "
+                        "delayed behind prefill")
+    p.add_argument("--schedule-watchdog", type=float, default=0.0,
+                   metavar="SECONDS",
+                   help="fail a scheduler iteration stuck past this "
+                        "deadline (503 + supervised restart instead "
+                        "of a silent hang); 0 disables — first-window "
+                        "compiles can legitimately take tens of "
+                        "seconds, so size it to your steady state")
     p.add_argument("--logprobs-k", type=int, default=5,
                    help="engine-wide top-k logprobs cap (requests ask "
                         "for n <= k; 0 disables the stats entirely)")
@@ -2326,6 +2479,15 @@ def main(argv=None) -> int:
                 "exclusive")
     if args.jump_len < 1:
         p.error("--jump-len must be >= 1")
+    if args.prefix_chunk < 0:
+        p.error("--prefix-chunk must be >= 0 (0 = auto)")
+    if args.prefix_chunk and args.max_len % args.prefix_chunk:
+        p.error(f"--prefix-chunk {args.prefix_chunk} must divide "
+                f"--max-len {args.max_len}")
+    if args.prefill_chunks < 1:
+        p.error("--prefill-chunks must be >= 1")
+    if args.schedule_watchdog < 0:
+        p.error("--schedule-watchdog must be >= 0 (0 disables)")
     if args.checkpoint_step is not None and not args.checkpoint:
         p.error("--checkpoint-step needs --checkpoint (without it the "
                 "server would silently serve random weights)")
@@ -2376,6 +2538,7 @@ def main(argv=None) -> int:
         draft = "ngram"
     engine = ServingEngine(model, params, n_slots=args.n_slots,
                            eos_id=getattr(cfg, "eos_id", None),
+                           prefix_chunk=(args.prefix_chunk or "auto"),
                            mesh=mesh, logprobs_k=args.logprobs_k,
                            draft=draft, gamma=args.gamma,
                            ngram_n=args.spec_ngram or 3,
@@ -2396,7 +2559,10 @@ def main(argv=None) -> int:
                        max_connections=args.max_connections,
                        client_timeout=args.client_timeout,
                        flight_record_dir=args.flight_record_dir,
-                       flight_record_capacity=args.flight_record_capacity)
+                       flight_record_capacity=args.flight_record_capacity,
+                       interleave=not args.no_interleave,
+                       prefill_chunks=args.prefill_chunks,
+                       schedule_watchdog_s=args.schedule_watchdog)
     if args.fault_spec is not None or args.fault_seed is not None:
         if args.fault_spec is None:
             p.error("--fault-seed needs --fault-spec")
@@ -2408,6 +2574,9 @@ def main(argv=None) -> int:
                        recorder=srv.recorder)
     else:
         faults.install_from_env(recorder=srv.recorder)
+    # pre-compile the adaptive-window scan variants before taking
+    # traffic (each length is its own XLA compile; see warm_scheduler)
+    srv.warm_scheduler()
     srv.start(host=args.host, port=args.port)
     print(f"serving {args.config} (quantized={quantized}) on "
           f"http://{args.host}:{srv.port}  "

@@ -59,16 +59,27 @@ from .inference import (
 # the cache (see _resolve_chunk).
 DEFAULT_CHUNK = 128
 
+# Default admission grid when prefix caching is on (prefix_chunk=
+# "auto"): APC matches floor to whole chunks, so the grid bounds how
+# much of a repeated prompt is reusable — on the 128 grid a 128-token
+# prompt floors every match to ZERO ((t_p - 1) // 128 == 0) and repeat
+# prompts pay full prefills.  32 keeps matches fine-grained while the
+# per-chunk extend still amortizes dispatch; it is the chunk the
+# serving bench measured the front-door win with (BASELINE §ROUND-6),
+# now the engine default instead of a harness-side trick.
+PREFIX_CHUNK = 32
 
-def _resolve_chunk(max_len: int) -> Optional[int]:
+
+def _resolve_chunk(max_len: int,
+                   cap: int = DEFAULT_CHUNK) -> Optional[int]:
     """Pick the admission chunk for ``chunk="auto"``: the largest
-    divisor of *max_len* that is <= min(128, max_len // 2).  A divisor
+    divisor of *max_len* that is <= min(cap, max_len // 2).  A divisor
     guarantees ceil(t_p / c) * c <= max_len, so a prompt that passes
     the budget check is never rejected by chunk padding; the
     max_len // 2 cap leaves room for suffix extends after an unaligned
     explicit prefix.  Falls back to None (per-length compiles) for
     pathological max_len with no divisor >= 8."""
-    c = min(DEFAULT_CHUNK, max(1, max_len // 2))
+    c = min(cap, max(1, max_len // 2))
     while c > 1 and max_len % c:
         c -= 1
     return c if c >= 8 else None
@@ -408,6 +419,70 @@ def _scan_decode(model, n_steps, sampled, lp_k, pen, rep, seeded,
     return ys, cache, counts, seen
 
 
+class AdmitState:
+    """One in-flight chunked admission (begin_admit → admit_step* →
+    finish_admit).  Pure host-side carrier: the slot reservation, the
+    validated request knobs, the B=1 mini cache being prefilled (via
+    the chunk generator), and — after the finish dispatch — the
+    first-token pick still on device.  ``admit()`` drives one of these
+    end to end, so the split path and the one-shot path are the same
+    ops in the same order (the bit-identical-outputs invariant)."""
+
+    __slots__ = (
+        "slot", "prompt_np", "prompt", "t_p", "aid", "stops",
+        "temperature", "top_k", "top_p", "min_p", "presence_penalty",
+        "frequency_penalty", "repetition_penalty", "seed",
+        "seed_stream", "ignore_eos", "min_tokens", "lp_n", "plp_n",
+        "logit_bias", "gstart", "canon", "auto_src", "gen", "result",
+        "plp_dev", "chunks_total", "chunks_done", "pick", "pick_stats",
+        "spliced", "inplace", "first_cached",
+    )
+
+    def __init__(self):
+        self.gen = None
+        self.result = None
+        self.auto_src = None
+        self.plp_dev = []
+        self.chunks_total = 0
+        self.chunks_done = 0
+        self.pick = None
+        self.pick_stats = None
+        self.spliced = False
+        # exact-repeat fast paths: inplace = the donor IS the target
+        # slot (admission is one cache_lens fix, no row copy);
+        # first_cached = the donor's materialized greedy first token
+        # (no pick, no sync — argmax of the same logits row)
+        self.inplace = False
+        self.first_cached = None
+
+    @property
+    def ready(self) -> bool:
+        """All prefill chunks dispatched; finish_admit may run."""
+        return self.gen is None and self.result is not None
+
+
+class _ScanHandle:
+    """One dispatched-but-unharvested run_scan window (scan_dispatch /
+    scan_harvest).  Snapshots the dispatch-time slot view so mid-window
+    admissions (finish_admit between dispatch and harvest) never leak
+    into the window's bookkeeping: ``active`` is who was in the scan,
+    ``skip`` collects slots spliced after dispatch (their lens / draw
+    counters were set by finish_admit and must not be advanced for a
+    window they sat out)."""
+
+    __slots__ = ("ys", "n_steps", "sampled", "lp_k", "grammared",
+                 "active", "skip")
+
+    def __init__(self, ys, n_steps, sampled, lp_k, grammared, active):
+        self.ys = ys
+        self.n_steps = n_steps
+        self.sampled = sampled
+        self.lp_k = lp_k
+        self.grammared = grammared
+        self.active = active
+        self.skip = set()
+
+
 class ServingEngine:
     """Continuous-batching scheduler over one compiled decode step.
 
@@ -424,6 +499,7 @@ class ServingEngine:
         n_slots: int,
         eos_id: Optional[int] = None,
         chunk: Union[int, None, str] = "auto",
+        prefix_chunk: Union[int, None, str] = "auto",
         max_new_tokens: Optional[int] = None,
         mesh=None,
         rng: Optional[jax.Array] = None,
@@ -444,11 +520,41 @@ class ServingEngine:
             # compile-safe default: every admission reuses ONE compiled
             # extend shape no matter how many distinct prompt lengths
             # arrive (real traffic has hundreds; per-length compiles
-            # are a trap outside benchmarks)
-            chunk = _resolve_chunk(model.max_len)
+            # are a trap outside benchmarks).  ``prefix_chunk`` picks
+            # the grid: APC matches floor to whole chunks, so the
+            # admission chunk IS the prefix-reuse granularity — the
+            # chunk-32 alignment the serving bench used to carry as a
+            # harness-side trick is now the engine default ("auto").
+            # An int pins the grid explicitly (must divide max_len so
+            # chunk padding can never overflow the cache); None keeps
+            # the coarse 128-cap grid (cold-prefill-heavy workloads
+            # that never repeat prompts).
+            if prefix_chunk is None:
+                chunk = _resolve_chunk(model.max_len)
+            elif prefix_chunk == "auto":
+                chunk = (_resolve_chunk(model.max_len, cap=PREFIX_CHUNK)
+                         or _resolve_chunk(model.max_len))
+            elif isinstance(prefix_chunk, str):
+                raise ValueError(
+                    f"prefix_chunk must be an int, None, or 'auto', "
+                    f"got {prefix_chunk!r}")
+            else:
+                if prefix_chunk < 1:
+                    raise ValueError("prefix_chunk must be >= 1")
+                if model.max_len % prefix_chunk:
+                    raise ValueError(
+                        f"prefix_chunk {prefix_chunk} must divide "
+                        f"max_len {model.max_len} (a divisor is what "
+                        "guarantees chunk padding never overflows the "
+                        "cache)")
+                chunk = prefix_chunk
         elif isinstance(chunk, str):
             raise ValueError(f"chunk must be an int, None, or 'auto', "
                              f"got {chunk!r}")
+        elif prefix_chunk != "auto":
+            raise ValueError(
+                "pass chunk OR prefix_chunk, not both: an explicit "
+                "chunk already pins the admission/APC grid")
         if chunk is not None and chunk < 1:
             raise ValueError("chunk must be >= 1 when set")
         self.model = model
@@ -486,6 +592,17 @@ class ServingEngine:
         self.cache = self._place_cache(init_cache(model, n_slots))
         self.lens = [0] * n_slots          # host mirror of cache_lens
         self.active = [False] * n_slots
+        # slots held by an in-flight chunked admission (begin_admit
+        # reserved them; finish_admit/abort_admit releases).  Reserved
+        # slots are invisible to free_slots() but stay INACTIVE for
+        # every decode path — the scan treats them exactly like any
+        # parked slot until the splice lands
+        self._reserved = [False] * n_slots
+        # the one outstanding scan_dispatch handle (None when no
+        # deferred-harvest window is open); finish_admit adds its slot
+        # to the handle's skip set so harvest bookkeeping never
+        # clobbers a mid-window splice
+        self._inflight_scan = None
         self.last_token = np.zeros(n_slots, np.int32)
         self.outputs: List[List[int]] = [[] for _ in range(n_slots)]
         self._finished: Dict[int, List[int]] = {}
@@ -524,11 +641,15 @@ class ServingEngine:
         # Unchunked engines disable it (no grid to stay exact on).
         self.auto_prefix = bool(auto_prefix) and chunk is not None
         self.auto_prefix_min = auto_prefix_min
-        # per-slot resident prompt: (tokens, adapter, canon) where
-        # canon is the prefix length up to which the slot's cache rows
-        # lie on the chunk grid (decode appends never touch them)
-        self._slot_prompts: List[Optional[Tuple[np.ndarray, int, int]]] \
-            = [None] * n_slots
+        # per-slot resident prompt: (tokens, adapter, canon, last)
+        # where canon is the prefix length up to which the slot's
+        # cache rows lie on the chunk grid (decode appends never touch
+        # them) and last is the admission's final-prompt-position
+        # logits row ([V] device array) — what makes an EXACT repeat
+        # prompt a zero-extend admission: splice the donor rows, reuse
+        # the stored row (the same device value a cold admission
+        # computes, so tokens stay bit-identical)
+        self._slot_prompts: list = [None] * n_slots
         self._prefill_tokens = 0
         self._prefix_hits = 0
         self._prefix_reused_tokens = 0
@@ -733,17 +854,27 @@ class ServingEngine:
 
     # -- admission ---------------------------------------------------------
 
-    def free_slots(self) -> List[int]:
-        return [s for s in range(self.n_slots) if not self.active[s]]
+    @property
+    def scan_inflight(self) -> bool:
+        """A dispatched-but-unharvested window is open (the scheduler's
+        mid-window-admission stamp)."""
+        return self._inflight_scan is not None
 
-    def _extend_prompt(self, mini, toks, start: int,
-                       adapter: int = -1, plp_k: int = 0,
-                       plp_out: Optional[list] = None):
-        """Push *toks* [1, n] into the B=1 *mini* cache starting at
-        depth *start*; returns (mini, last real token's logits row).
-        With *plp_k*, per-chunk prompt-logprob stats (row j scores the
-        NEXT prompt token) are appended to *plp_out* as device arrays
-        — same compiled shapes as the extends themselves."""
+    def free_slots(self) -> List[int]:
+        return [s for s in range(self.n_slots)
+                if not self.active[s] and not self._reserved[s]]
+
+    def _extend_prompt_steps(self, mini, toks, start: int,
+                             adapter: int = -1, plp_k: int = 0,
+                             plp_out: Optional[list] = None):
+        """Generator form of :meth:`_extend_prompt`: yields the
+        in-progress ``(mini, last)`` after each dispatched chunk, and
+        the FINAL yield is exactly what ``_extend_prompt`` returns.
+        One implementation serves both the one-shot admit and the
+        iteration scheduler's chunk-at-a-time interleave, so the two
+        cannot drift (chunk decomposition, padding, plp rows, and the
+        final cache_lens fix are byte-for-byte the same ops in the
+        same order)."""
         n = int(toks.shape[1])
         aid = self._adapter_vec(adapter)
         if self.chunk is None:
@@ -758,7 +889,8 @@ class ServingEngine:
                 tgt = jnp.concatenate(
                     [toks[0, 1:], jnp.zeros((1,), jnp.int32)])
                 plp_out.append(_top_logprobs(logits[0], tgt, plp_k))
-            return mini, logits[0, n - 1]
+            yield mini, logits[0, n - 1]
+            return
         # fixed-size chunks: every chunk reuses ONE compiled extend; the
         # tail chunk pads with zeros whose K/V land beyond the true
         # length (fixed below) and whose outputs are discarded
@@ -791,7 +923,24 @@ class ServingEngine:
             off = n - 1 - i * c
             if 0 <= off < c:
                 last = logits[0, off]
-        return _set_len(mini, jnp.int32(0), jnp.int32(start + n)), last
+            if i + 1 < padded // c:
+                yield mini, last
+        yield _set_len(mini, jnp.int32(0), jnp.int32(start + n)), last
+
+    def _extend_prompt(self, mini, toks, start: int,
+                       adapter: int = -1, plp_k: int = 0,
+                       plp_out: Optional[list] = None):
+        """Push *toks* [1, n] into the B=1 *mini* cache starting at
+        depth *start*; returns (mini, last real token's logits row).
+        With *plp_k*, per-chunk prompt-logprob stats (row j scores the
+        NEXT prompt token) are appended to *plp_out* as device arrays
+        — same compiled shapes as the extends themselves."""
+        out = None
+        for out in self._extend_prompt_steps(
+                mini, toks, start, adapter=adapter, plp_k=plp_k,
+                plp_out=plp_out):
+            pass
+        return out
 
     def _draft_prefill(self, prompt):
         """Cold-prefill the draft with the FULL prompt on the engine's
@@ -844,7 +993,15 @@ class ServingEngine:
         t_p - 1 — the last prompt token always recomputes so admission
         has its logits row (same rule as vLLM's APC).  Returns
         (kind, ref, m) or None; donors are adapter-bound (the adapter
-        shapes the K/V)."""
+        shapes the K/V).
+
+        EXACT matches skip even that last token: a donor whose prompt
+        IS the new prompt (full length on the chunk grid) carries the
+        admission-time logits row of its final position, so the
+        admission is pure data movement — splice + the stored row —
+        with zero extends (kinds "reg_full"/"slot_full", m = t_p).
+        The row is the same device value a cold admission computes, so
+        tokens stay bit-identical (the house invariant)."""
         if not self.auto_prefix:
             return None
         c = self.chunk
@@ -853,16 +1010,23 @@ class ServingEngine:
         for h, (ptoks, _pc, _pl, paid) in self._prefixes.items():
             if paid != aid:
                 continue
-            m = (min(_lcp(pnp, ptoks), t_p - 1) // c) * c
+            lcp = _lcp(pnp, ptoks)
+            if lcp == t_p == len(ptoks):
+                return ("reg_full", h, t_p)
+            m = (min(lcp, t_p - 1) // c) * c
             if m > best_m:
                 best_m, best = m, ("reg", h, m)
         for s, rec in enumerate(self._slot_prompts):
             if rec is None:
                 continue
-            stoks, said, canon = rec
+            stoks, said, canon = rec[0], rec[1], rec[2]
             if said != aid:
                 continue
-            m = (min(_lcp(pnp, stoks), canon, t_p - 1) // c) * c
+            lcp = _lcp(pnp, stoks)
+            if (lcp == t_p == len(stoks) and canon == t_p
+                    and rec[3] is not None):
+                return ("slot_full", s, t_p)
+            m = (min(lcp, canon, t_p - 1) // c) * c
             if m > best_m:
                 best_m, best = m, ("slot", s, m)
         if best_m < max(1, self.auto_prefix_min):
@@ -926,7 +1090,56 @@ class ServingEngine:
         bit-identical.  ``temperature``/``top_k`` select this
         request's sampling (0 / None = greedy) and ``stop`` lists
         per-request stop-token ids — per-slot data, never a
-        recompile."""
+        recompile.
+
+        One-shot driver of the split admission API (begin_admit →
+        admit_step* → finish_admit) — the iteration scheduler runs the
+        same pieces spread across decode slices, so both paths are the
+        same ops in the same order and emit bit-identical tokens."""
+        st = self.begin_admit(
+            prompt, prefix=prefix, temperature=temperature,
+            top_k=top_k, top_p=top_p, min_p=min_p,
+            presence_penalty=presence_penalty,
+            frequency_penalty=frequency_penalty,
+            repetition_penalty=repetition_penalty,
+            seed=seed, seed_stream=seed_stream, adapter=adapter,
+            stop=stop, ignore_eos=ignore_eos, logprobs=logprobs,
+            prompt_logprobs=prompt_logprobs, logit_bias=logit_bias,
+            min_tokens=min_tokens, grammar=grammar)
+        try:
+            while self.admit_step(st):
+                pass
+            return self.finish_admit(st)
+        except BaseException:
+            if not st.spliced:
+                self.abort_admit(st)
+            raise
+
+    def begin_admit(self, prompt, prefix: Optional[int] = None,
+                    temperature: float = 0.0,
+                    top_k: Optional[int] = None,
+                    top_p: float = 1.0,
+                    min_p: float = 0.0,
+                    presence_penalty: float = 0.0,
+                    frequency_penalty: float = 0.0,
+                    repetition_penalty: float = 1.0,
+                    seed: Optional[int] = None,
+                    seed_stream: int = 0,
+                    adapter: Optional[int] = None,
+                    stop: Optional[List[int]] = None,
+                    ignore_eos: bool = False,
+                    logprobs: Optional[int] = None,
+                    prompt_logprobs: Optional[int] = None,
+                    logit_bias: Optional[Dict[int, float]] = None,
+                    min_tokens: int = 0,
+                    grammar: Union[bool, int] = False) -> AdmitState:
+        """Validate a request, reserve a free slot, and set up its
+        chunked prefill WITHOUT running it: the returned
+        :class:`AdmitState` is advanced one chunk per
+        :meth:`admit_step` and lands via :meth:`finish_admit` (or is
+        abandoned via :meth:`abort_admit`).  Every admit() validation
+        error raises HERE, before any engine state is touched, so a
+        rejected request can never strand a half-reserved slot."""
         # ONE host-side copy serves validation, auto-matching, and the
         # resident-prompt record; the device transfer happens once here
         prompt_np = np.asarray(prompt, np.int32).reshape(1, -1)
@@ -1062,6 +1275,8 @@ class ServingEngine:
 
         # validate EVERYTHING before touching any slot bookkeeping — a
         # rejected admit must leave the engine state untouched
+        auto_src = None
+        L = 0
         if prefix is not None:
             if prefix not in self._prefixes:
                 raise ValueError(f"unknown prefix handle {prefix}")
@@ -1089,98 +1304,225 @@ class ServingEngine:
                 raise ValueError(
                     f"padded prompt {start + padded} exceeds max_len "
                     f"{self.model.max_len} (shrink chunk or prompt)")
-        # recycling a slot must drop the previous request's finished
-        # record, or finished(slot) would report True for the new
-        # in-flight request
-        self._finished.pop(slot, None)
-        self._finish_reason.pop(slot, None)
-        self._prompt_lp[slot] = []
+        if (auto_src is not None and auto_src[0] == "slot_full"
+                and not self.active[auto_src[1]]
+                and not self._reserved[auto_src[1]]):
+            # prefix-affinity placement: an exact repeat goes back
+            # into its donor's FREE slot, where the "copy" is the
+            # identity — admission reduces to one cache_lens fix
+            slot = auto_src[1]
+
+        st = AdmitState()
+        st.slot = slot
+        st.prompt_np = prompt_np
+        st.prompt = prompt
+        st.t_p = t_p
+        st.aid = aid
+        st.stops = stops
+        st.temperature = temperature
+        st.top_k = top_k
+        st.top_p = top_p
+        st.min_p = min_p
+        st.presence_penalty = presence_penalty
+        st.frequency_penalty = frequency_penalty
+        st.repetition_penalty = repetition_penalty
+        st.seed = seed
+        st.seed_stream = seed_stream
+        st.ignore_eos = ignore_eos
+        st.min_tokens = min_tokens
+        st.lp_n = lp_n
+        st.plp_n = plp_n
+        st.logit_bias = logit_bias
+        st.gstart = gstart
+        st.auto_src = auto_src
+        # explicit-prefix admits with an unaligned prefix leave the
+        # suffix rows off the chunk grid — only the prefix part is
+        # reusable by future automatic matches
+        if (self.chunk is not None and prefix is not None
+                and L % self.chunk):
+            st.canon = L
+        else:
+            st.canon = t_p
+        if n <= 0:
+            st.chunks_total = 0
+        elif self.chunk is None:
+            st.chunks_total = 1
+        else:
+            st.chunks_total = (n + self.chunk - 1) // self.chunk
 
         if prefix is not None:
             if n > 0:
                 # copy before extending: extend_step DONATES its cache,
                 # and the registry entry must survive for the next admit
                 mini = jax.tree_util.tree_map(jnp.copy, pcache)
-                mini, last = self._extend_prompt(
+                st.gen = self._extend_prompt_steps(
                     mini, prompt[:, L:], start=L, adapter=aid)
             else:
                 # exact-prefix prompt: no extend runs, and _splice_slot
                 # does not donate its mini argument, so the registry
                 # cache splices directly — no copy
-                mini, last = pcache, plast
+                st.result = (pcache, plast)
         elif auto_src is not None:
             kind, ref, m = auto_src
-            if kind == "reg":
-                # registry entries must survive — copy before donating
-                src = jax.tree_util.tree_map(
-                    jnp.copy, self._prefixes[ref][1])
+            if kind == "reg_full":
+                # exact registry prompt: zero extends, no copy
+                # (_splice_slot does not donate its mini) — identical
+                # to an explicit exact-prefix handle admit
+                _, pc_full, pl_full, _ = self._prefixes[ref]
+                st.result = (pc_full, pl_full)
+            elif kind == "slot_full":
+                # exact resident prompt: reuse the donor rows and the
+                # stored final-position logits row — admission becomes
+                # pure data movement (the vLLM full-prompt cache hit)
+                rec_full = self._slot_prompts[ref]
+                if ref == slot and self._draft_model is None:
+                    # prefix-affinity placement put us IN the donor
+                    # slot: no copy at all, finish just fixes the
+                    # slot's cache_lens back to t_p
+                    st.inplace = True
+                    st.result = (None, rec_full[3])
+                else:
+                    src = self._place_cache(
+                        _slot_to_mini(self.cache, jnp.int32(ref)))
+                    st.result = (
+                        _set_len(src, jnp.int32(0), jnp.int32(t_p)),
+                        rec_full[3])
+                if len(rec_full) > 4:
+                    st.first_cached = rec_full[4]
             else:
-                src = self._place_cache(
-                    _slot_to_mini(self.cache, jnp.int32(ref)))
-            # rows beyond m are stale donor data masked out by the
-            # cache_lens reset; the suffix extend overwrites [m, ...)
-            mini = _set_len(src, jnp.int32(0), jnp.int32(m))
-            mini, last = self._extend_prompt(
-                mini, prompt[:, m:], start=m, adapter=aid)
-            self._prefix_hits += 1
-            self._prefix_reused_tokens += m
+                if kind == "reg":
+                    # registry entries must survive — copy before
+                    # donating
+                    src = jax.tree_util.tree_map(
+                        jnp.copy, self._prefixes[ref][1])
+                else:
+                    src = self._place_cache(
+                        _slot_to_mini(self.cache, jnp.int32(ref)))
+                # rows beyond m are stale donor data masked out by the
+                # cache_lens reset; the suffix extend overwrites
+                # [m, ...)
+                mini = _set_len(src, jnp.int32(0), jnp.int32(m))
+                st.gen = self._extend_prompt_steps(
+                    mini, prompt[:, m:], start=m, adapter=aid)
         else:
             mini = self._place_cache(init_cache(self.model, 1))
-            plp_dev: list = []
-            mini, last = self._extend_prompt(
+            st.gen = self._extend_prompt_steps(
                 mini, prompt, start=0, adapter=aid,
                 plp_k=self.logprobs_k if plp_n else 0,
-                plp_out=plp_dev)
-            if plp_n:
-                # host assembly: position 0 has no conditional (vLLM
-                # emits null there); position j scores prompt[j] from
-                # chunk (j-1)//c's row (j-1)%c
-                c = self.chunk or t_p
-                # ONE batched transfer for all chunks' stats: per-array
-                # np.asarray would serialize a device round-trip per
-                # chunk — painful for exactly the long prompts this
-                # feature scores
-                hosts = jax.device_get(plp_dev)
-                recs: list = [None]
-                for j in range(1, t_p):
-                    clp, tlp, tid = hosts[(j - 1) // c]
-                    r = (j - 1) % c
-                    recs.append((
-                        float(clp[r]),
-                        [(int(tid[r][q]), float(tlp[r][q]))
-                         for q in range(plp_n)],
-                    ))
-                self._prompt_lp[slot] = recs
+                plp_out=st.plp_dev)
+        # reservation is the LAST begin-side mutation: everything above
+        # may raise, and a rejected begin must leave the engine exactly
+        # as it found it
+        self._reserved[slot] = True
+        return st
 
-        self.cache = _splice_slot(self.cache, mini, jnp.int32(slot))
+    def admit_step(self, st: AdmitState) -> bool:
+        """Dispatch the next prefill chunk of an in-flight admission;
+        returns True while chunks remain.  Each call enqueues ONE
+        compiled extend (async dispatch — the host returns before the
+        device finishes), which is what lets the iteration scheduler
+        slide prefill chunks between decode slices."""
+        if st.gen is None:
+            return False
+        try:
+            st.result = next(st.gen)
+        except StopIteration:
+            st.gen = None
+            return False
+        st.chunks_done += 1
+        if st.chunks_done >= st.chunks_total:
+            st.gen.close()
+            st.gen = None
+            return False
+        return True
+
+    def abort_admit(self, st: AdmitState) -> None:
+        """Abandon an in-flight admission (client went away before its
+        prefill landed): the reserved slot returns to the free pool and
+        the mini cache is dropped.  Tokens already prefilled show up in
+        ``prefill_tokens`` (they did run); nothing else was touched."""
+        if st.spliced:
+            raise RuntimeError(
+                "admission already finished; release() the slot")
+        if st.gen is not None:
+            st.gen.close()
+            st.gen = None
+        st.result = None
+        self._reserved[st.slot] = False
+
+    def finish_admit(self, st: AdmitState) -> int:
+        """Land a fully-prefilled admission: splice the mini cache into
+        the slot, arm the request's knobs, and sample its first token.
+        Returns the slot id (the request is live from here)."""
+        self._finish_admit_dispatch(st)
+        return self._finish_admit_resolve(st)
+
+    def _finish_admit_dispatch(self, st: AdmitState) -> None:
+        """Device-dispatch half of finish_admit: splice + knob arming +
+        the first-token pick, all enqueued WITHOUT a host-device sync —
+        the pick stays on device (``st.pick``) until
+        :meth:`_finish_admit_resolve` materializes it.  The scheduler
+        runs this between a window's dispatch and harvest (the one
+        blocking sync then covers the scan AND the admission)."""
+        if not st.ready:
+            raise RuntimeError("admission prefill not finished "
+                               "(admit_step until it returns False)")
+        slot = st.slot
+        mini, last = st.result
+        # a default-knob admission into a reset slot writes only
+        # values the slot already holds (reset_slot_params reset the
+        # sampling vectors; the remaining three are checked here), so
+        # the device knob mirrors stay valid and the next window skips
+        # ~a dozen host->device rebuilds
+        knobs_same = (st.seed is None and st.aid == -1
+                      and self._clean_greedy_admit(st)
+                      and int(self.min_toks[slot]) == 0
+                      and int(self.seeds[slot]) == 0
+                      and int(self._seed_streams[slot])
+                      == int(st.seed_stream))
+        # recycling a slot must drop the previous request's finished
+        # record, or finished(slot) would report True for the new
+        # in-flight request
+        self._finished.pop(slot, None)
+        self._finish_reason.pop(slot, None)
+        self._prompt_lp[slot] = []
+        if st.auto_src is not None:
+            self._prefix_hits += 1
+            self._prefix_reused_tokens += st.auto_src[2]
+        if st.inplace:
+            # the donor rows already live in this slot: restore the
+            # prompt length over the parked-clamp value and the splice
+            # is done
+            self.cache = _set_len(self.cache, jnp.int32(slot),
+                                  jnp.int32(st.t_p))
+        else:
+            self.cache = _splice_slot(self.cache, mini,
+                                      jnp.int32(slot))
         if self._draft_model is not None:
             self._draft_cache = _splice_slot(
-                self._draft_cache, self._draft_prefill(prompt),
+                self._draft_cache, self._draft_prefill(st.prompt),
                 jnp.int32(slot))
-        # explicit-prefix admits with an unaligned prefix leave the
-        # suffix rows off the chunk grid — only the prefix part is
-        # reusable by future automatic matches
-        if (self.chunk is not None and prefix is not None
-                and L % self.chunk):
-            canon = L
-        else:
-            canon = t_p
-        self._slot_prompts[slot] = (prompt_np[0], aid, canon)
-        self.lens[slot] = t_p
+        # the final-position logits row rides the record: an exact
+        # repeat of this prompt admits with zero extends (see
+        # _auto_match's "slot_full"); resolve fills the cached greedy
+        # first token when this admission qualifies
+        self._slot_prompts[slot] = (st.prompt_np[0], st.aid, st.canon,
+                                    last, None)
+        self.lens[slot] = st.t_p
         self.active[slot] = True
-        self.temps[slot] = temperature
-        self.topks[slot] = top_k or 0
-        self.topps[slot] = top_p
-        self.minps[slot] = min_p
-        self.pres[slot] = presence_penalty
-        self.freqs[slot] = frequency_penalty
-        self.reps[slot] = repetition_penalty
-        self.adapters[slot] = aid
-        self._stops[slot] = stops
-        self._ignore_eos[slot] = bool(ignore_eos)
-        if logit_bias:
+        self.temps[slot] = st.temperature
+        self.topks[slot] = st.top_k or 0
+        self.topps[slot] = st.top_p
+        self.minps[slot] = st.min_p
+        self.pres[slot] = st.presence_penalty
+        self.freqs[slot] = st.frequency_penalty
+        self.reps[slot] = st.repetition_penalty
+        self.adapters[slot] = st.aid
+        self._stops[slot] = st.stops
+        self._ignore_eos[slot] = bool(st.ignore_eos)
+        if st.logit_bias:
             bias_np = np.zeros(self.model.vocab, np.float32)
-            for bk, bv in logit_bias.items():
+            for bk, bv in st.logit_bias.items():
                 bias_np[int(bk)] = float(bv)
             row_dev = jnp.asarray(bias_np)  # ONE host-to-device copy
             self._bias = _set_count_row(
@@ -1194,85 +1536,159 @@ class ServingEngine:
                 self._bias = _zero_count_row(self._bias, slot)
                 self._bias_on[slot] = False
             bias_row = None
-        self.gstate[slot] = gstart
-        self.min_toks[slot] = min_tokens
+        self.gstate[slot] = st.gstart
+        self.min_toks[slot] = st.min_tokens
         min_row = None
-        if min_tokens:
+        if st.min_tokens:
             mask_np = np.zeros(self.model.vocab, np.float32)
             if self.eos_id is not None:
                 mask_np[self.eos_id] = -1e6
-            for t in stops:
+            for t in st.stops:
                 mask_np[t] = -1e6
             row_dev = jnp.asarray(mask_np)
             self._min_mask = _set_count_row(
                 self._min_mask, jnp.int32(slot), row_dev)
             min_row = row_dev[None, :]  # first pick has 0 emitted
-        self.seeds[slot] = np.uint32((seed or 0) & 0xFFFFFFFF)
-        self._seed_streams[slot] = int(seed_stream)
-        self._seed_on[slot] = 0 if seed is None else 1
-        self._knob_cache = None  # device mirrors are stale now
+        self.seeds[slot] = np.uint32((st.seed or 0) & 0xFFFFFFFF)
+        self._seed_streams[slot] = int(st.seed_stream)
+        self._seed_on[slot] = 0 if st.seed is None else 1
+        if not knobs_same:
+            self._knob_cache = None  # device mirrors are stale now
         self._slot_draws[slot] = 0
-        self._lp_want[slot] = lp_n
+        self._lp_want[slot] = st.lp_n
         self._lp_records[slot] = []
         # first token: the OUTPUT histogram is empty by definition
         # (presence/frequency no-op), but the repetition penalty scopes
         # over the prompt — host bincount, no per-length compiles
         draws_before = self._draws
-        rep_on = repetition_penalty != 1.0
+        rep_on = st.repetition_penalty != 1.0
         if rep_on:
             seen_row = jnp.asarray(np.bincount(
-                prompt_np[0], minlength=self.model.vocab
+                st.prompt_np[0], minlength=self.model.vocab
             ).astype(np.float32))[None, :]
         else:
             seen_row = self._zero_vocab_row
-        first_lg = last[None, :]
-        if bias_row is not None:
-            first_lg = first_lg + bias_row
-        if min_row is not None:
-            first_lg = first_lg + min_row
-        if gstart >= 0:
-            # derived mask from the host table row (one V-float build;
-            # admit is host-paced anyway)
-            first_lg = first_lg + jnp.asarray(
-                (self._gtable_np[gstart] < 0).astype(np.float32)
-                * np.float32(-1e9))[None, :]
-        first = int(self._sample(
-            first_lg,
-            np.asarray([temperature], np.float32),
-            np.asarray([top_k or 0], np.int32),
-            np.asarray([top_p], np.float32),
-            np.asarray([min_p], np.float32),
-            np.asarray([presence_penalty], np.float32),
-            np.asarray([frequency_penalty], np.float32),
-            np.asarray([repetition_penalty], np.float32),
-            self._zero_vocab_row, seen_row,
-            self.seeds[slot:slot + 1],
-            self._seed_streams[slot:slot + 1],
-            self._seed_on[slot:slot + 1],
-            np.asarray([0], np.int32))[0])
-        if self._draws != draws_before:
-            # the admit consumed a draw: this slot's own chain moved
-            self._slot_draws[slot] = 1
-        if presence_penalty or frequency_penalty:
-            self._counts = _zero_count_row(self._counts, slot)
-            self._counts = _bump_one(self._counts, slot, first)
-        if rep_on:
-            self._seen = _set_count_row(
-                self._seen, jnp.int32(slot), seen_row[0])
-            self._seen = _bump_one(self._seen, slot, first)
-        if lp_n:
-            clp, tlp, tid = _top_logprobs(
-                first_lg, jnp.asarray([first], jnp.int32),
-                self.logprobs_k)
+        if (st.first_cached is not None
+                and self._clean_greedy_admit(st)):
+            # clean-greedy exact repeat: the donor's materialized
+            # first token IS argmax of this same logits row — no
+            # pick, no draw, no sync (the greedy path never touches
+            # the key stream, so skipping it is stream-exact too)
+            st.pick = None
+        else:
+            st.first_cached = None
+            first_lg = last[None, :]
+            if bias_row is not None:
+                first_lg = first_lg + bias_row
+            if min_row is not None:
+                first_lg = first_lg + min_row
+            if st.gstart >= 0:
+                # derived mask from the host table row (one V-float
+                # build; admit is host-paced anyway)
+                first_lg = first_lg + jnp.asarray(
+                    (self._gtable_np[st.gstart] < 0).astype(np.float32)
+                    * np.float32(-1e9))[None, :]
+            st.pick = self._sample_dev(
+                first_lg,
+                np.asarray([st.temperature], np.float32),
+                np.asarray([st.top_k or 0], np.int32),
+                np.asarray([st.top_p], np.float32),
+                np.asarray([st.min_p], np.float32),
+                np.asarray([st.presence_penalty], np.float32),
+                np.asarray([st.frequency_penalty], np.float32),
+                np.asarray([st.repetition_penalty], np.float32),
+                self._zero_vocab_row, seen_row,
+                self.seeds[slot:slot + 1],
+                self._seed_streams[slot:slot + 1],
+                self._seed_on[slot:slot + 1],
+                np.asarray([0], np.int32))
+            if self._draws != draws_before:
+                # the admit consumed a draw: this slot's own chain
+                # moved
+                self._slot_draws[slot] = 1
+            if st.presence_penalty or st.frequency_penalty:
+                self._counts = _zero_count_row(self._counts, slot)
+                self._counts = _bump_one(self._counts, slot,
+                                         st.pick[0])
+            if rep_on:
+                self._seen = _set_count_row(
+                    self._seen, jnp.int32(slot), seen_row[0])
+                self._seen = _bump_one(self._seen, slot, st.pick[0])
+            if st.lp_n:
+                st.pick_stats = _top_logprobs(
+                    first_lg, jnp.asarray(st.pick, jnp.int32),
+                    self.logprobs_k)
+        st.spliced = True
+        self._reserved[slot] = False
+        # a window dispatched before this splice must not advance the
+        # new slot's host mirrors at harvest (lens / draw chains were
+        # just set HERE, for a window the slot sat out)
+        if self._inflight_scan is not None:
+            self._inflight_scan.skip.add(slot)
+
+    def _finish_admit_resolve(self, st: AdmitState) -> int:
+        """Host half of finish_admit: materialize the first-token pick
+        (the admission's ONE blocking sync) and finish the host-side
+        bookkeeping that needs its value."""
+        slot = st.slot
+        if st.plp_n:
+            # host assembly: position 0 has no conditional (vLLM
+            # emits null there); position j scores prompt[j] from
+            # chunk (j-1)//c's row (j-1)%c
+            c = self.chunk or st.t_p
+            # ONE batched transfer for all chunks' stats: per-array
+            # np.asarray would serialize a device round-trip per
+            # chunk — painful for exactly the long prompts this
+            # feature scores
+            hosts = jax.device_get(st.plp_dev)
+            recs: list = [None]
+            for j in range(1, st.t_p):
+                clp, tlp, tid = hosts[(j - 1) // c]
+                r = (j - 1) % c
+                recs.append((
+                    float(clp[r]),
+                    [(int(tid[r][q]), float(tlp[r][q]))
+                     for q in range(st.plp_n)],
+                ))
+            self._prompt_lp[slot] = recs
+        if st.pick is None:
+            first = int(st.first_cached)
+        else:
+            first = int(np.asarray(st.pick)[0])
+        if st.lp_n:
+            clp, tlp, tid = st.pick_stats
             self._record_logprobs(slot, float(np.asarray(clp)[0]),
                                   np.asarray(tlp)[0], np.asarray(tid)[0])
-        if gstart >= 0:
-            self.gstate[slot] = int(self._gtable_np[gstart, first])
+        if st.gstart >= 0:
+            self.gstate[slot] = int(self._gtable_np[st.gstart, first])
+        if self._clean_greedy_admit(st):
+            # make this slot a zero-sync donor for the next exact
+            # repeat: the materialized greedy first token rides the
+            # resident-prompt record
+            rec = self._slot_prompts[slot]
+            self._slot_prompts[slot] = rec[:4] + (first,)
         self.last_token[slot] = first
         self.outputs[slot] = [first]
         self._tokens += 1
         self._maybe_finish(slot, first)
         return slot
+
+    @staticmethod
+    def _clean_greedy_admit(st: AdmitState) -> bool:
+        """Pure-greedy, unmasked admission: the first token is exactly
+        argmax of the final prompt logits row — a host int that can be
+        stored with the resident-prompt record and reused by the next
+        exact repeat without a pick or a sync.  Any knob that bends
+        the pick (sampling, penalties, bias, min_tokens floor,
+        grammar) or needs its stats (logprobs) disqualifies both
+        storing and reuse."""
+        return (st.temperature == 0.0 and not (st.top_k or 0)
+                and st.top_p == 1.0 and st.min_p == 0.0
+                and st.presence_penalty == 0.0
+                and st.frequency_penalty == 0.0
+                and st.repetition_penalty == 1.0
+                and not st.logit_bias and not st.min_tokens
+                and st.gstart < 0 and not st.lp_n)
 
     def _pen_live(self) -> bool:
         """Any presence/frequency-penalized request live?  Gates the
@@ -1319,10 +1735,14 @@ class ServingEngine:
             [(int(top_id[j]), float(top_lp[j])) for j in range(n)],
         ))
 
-    def _harvest_logprobs(self, clp, tlp, tid) -> None:
+    def _harvest_logprobs(self, clp, tlp, tid, eligible=None) -> None:
         """Record one decode step's [S]-wide logprob stats for every
-        active slot that asked (host arrays)."""
+        active slot that asked (host arrays).  *eligible* restricts to
+        slots that were IN the scan (a mid-window admission's slot is
+        active by harvest time but its scan row is garbage)."""
         for s in range(self.n_slots):
+            if eligible is not None and not eligible[s]:
+                continue
             if self.active[s] and self._lp_want[s]:
                 self._record_logprobs(s, float(clp[s]), tlp[s], tid[s])
 
@@ -1342,28 +1762,39 @@ class ServingEngine:
         didn't ask."""
         return list(self._lp_records[slot])
 
-    def _sample(self, logits, temps, topks, topps, minps, pres, freqs,
-                reps, counts, seen, seeds, seed_streams, seed_on,
-                seed_idx):
+    def _sample_dev(self, logits, temps, topks, topps, minps, pres,
+                    freqs, reps, counts, seen, seeds, seed_streams,
+                    seed_on, seed_idx):
+        """:meth:`_sample` without the host materialization: returns
+        the picked tokens as a DEVICE array (async dispatch).  Draw
+        accounting is identical — the split admission path defers only
+        the sync, never the key-stream bookkeeping."""
         if not _knobs_live(temps, topks, topps, minps, pres, freqs,
                            reps):
             # all-greedy batch (the default): plain argmax — no vocab
             # sort, no Gumbel draw, and the key stream stays untouched
             # so adding a sampled request never shifts greedy outputs
-            return np.asarray(
-                jnp.argmax(logits, axis=-1), dtype=np.int32)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
         key = jax.random.fold_in(self._rng, self._draws)
         self._draws += 1
         seeded = bool(np.asarray(seed_on).any())
+        return _pick_tokens(logits, jnp.asarray(temps),
+                            jnp.asarray(topks),
+                            jnp.asarray(topps), jnp.asarray(minps),
+                            jnp.asarray(pres), jnp.asarray(freqs),
+                            jnp.asarray(reps), counts, seen, key,
+                            seeded, jnp.asarray(seeds),
+                            jnp.asarray(seed_streams),
+                            jnp.asarray(seed_on),
+                            jnp.asarray(seed_idx))
+
+    def _sample(self, logits, temps, topks, topps, minps, pres, freqs,
+                reps, counts, seen, seeds, seed_streams, seed_on,
+                seed_idx):
         return np.asarray(
-            _pick_tokens(logits, jnp.asarray(temps), jnp.asarray(topks),
-                         jnp.asarray(topps), jnp.asarray(minps),
-                         jnp.asarray(pres), jnp.asarray(freqs),
-                         jnp.asarray(reps), counts, seen, key,
-                         seeded, jnp.asarray(seeds),
-                         jnp.asarray(seed_streams),
-                         jnp.asarray(seed_on),
-                         jnp.asarray(seed_idx)),
+            self._sample_dev(logits, temps, topks, topps, minps, pres,
+                             freqs, reps, counts, seen, seeds,
+                             seed_streams, seed_on, seed_idx),
             dtype=np.int32)
 
     # -- decoding ----------------------------------------------------------
@@ -1837,11 +2268,32 @@ class ServingEngine:
         masking, not branching — exactly like inactive slots in
         ``step``).  Every active slot must have *n_steps* of cache
         headroom.  Returns {slot: [tokens]} for slots active at entry.
-        """
+
+        Equal to ``scan_harvest(scan_dispatch(n_steps))`` — the split
+        form is what the iteration scheduler uses to slide prefill
+        chunks and admission finishes inside the open window."""
         if n_steps < 1:
             raise ValueError("n_steps must be >= 1")
         if not any(self.active):
             return {}
+        return self.scan_harvest(self.scan_dispatch(n_steps))
+
+    def scan_dispatch(self, n_steps: int) -> _ScanHandle:
+        """Dispatch *n_steps* decode steps as one compiled scan and
+        return WITHOUT waiting for the device: the handle carries the
+        window's device futures plus a snapshot of who was in it.
+        Between dispatch and :meth:`scan_harvest` the host may run
+        admission work (prefill chunks, splices, first-token picks) —
+        all async dispatches that overlap the window's device time —
+        but no other decode path (one window outstanding at most)."""
+        if n_steps < 1:
+            raise ValueError("n_steps must be >= 1")
+        if self._inflight_scan is not None:
+            raise RuntimeError(
+                "a dispatched window is already outstanding "
+                "(scan_harvest it first)")
+        if not any(self.active):
+            raise RuntimeError("no active slots to scan")
         for s in range(self.n_slots):
             if self.active[s] and \
                     self.lens[s] + n_steps > self.model.max_len:
@@ -1902,6 +2354,27 @@ class ServingEngine:
             jnp.asarray(self._slot_draws, jnp.int32), aids,
             self._rng, jnp.int32(self._draws),
         )
+        handle = _ScanHandle(ys, n_steps, sampled, lp_k, grammared,
+                             list(self.active))
+        self._inflight_scan = handle
+        return handle
+
+    def scan_harvest(self, handle: _ScanHandle) -> Dict[int, List[int]]:
+        """Materialize a dispatched window's tokens (the window's ONE
+        blocking sync) and run the host bookkeeping for every slot that
+        was IN the window.  Slots spliced after the dispatch
+        (``handle.skip``) keep the lens / draw-chain values their
+        finish_admit just set — they sat the window out."""
+        self._inflight_scan = None
+        ys, n_steps = handle.ys, handle.n_steps
+        sampled, lp_k = handle.sampled, handle.lp_k
+        grammared = handle.grammared
+        skip = handle.skip
+        # "in the window AND not yet retired" — with no mid-window
+        # admissions this is exactly the dispatch-time active set, so
+        # run_scan behaves as it always did
+        live = [handle.active[s] and self.active[s]
+                for s in range(self.n_slots)]
         toks = np.asarray(ys[0], dtype=np.int32)  # [n_steps, S]
         if lp_k:
             clps = np.asarray(ys[1])   # [n_steps, S]
@@ -1909,7 +2382,7 @@ class ServingEngine:
             tids = np.asarray(ys[3])   # [n_steps, S, k]
         self._steps += n_steps
         out: Dict[int, List[int]] = {
-            s: [] for s in range(self.n_slots) if self.active[s]
+            s: [] for s in range(self.n_slots) if live[s]
         }
         if not sampled and not lp_k and not grammared:
             # greedy/unconstrained harvest fast path (the serving hot
@@ -1922,7 +2395,8 @@ class ServingEngine:
             # because the stop scan excludes the eos index and the
             # budget cut only applies strictly before any eos/stop).
             for s in range(self.n_slots):
-                self.lens[s] += n_steps
+                if s not in skip:
+                    self.lens[s] += n_steps
             eos = None if self.eos_id is None else int(self.eos_id)
             for s in list(out):
                 col = toks[:, s].tolist()
@@ -1953,6 +2427,14 @@ class ServingEngine:
                 if fin is not None:
                     self._finish(s, fin[1])
             return out
+        if skip:
+            # mid-window admissions' knobs must not leak into the
+            # window's draw accounting: mask them out of the liveness
+            # checks (their vectors were armed AFTER the dispatch)
+            m = np.ones(self.n_slots, bool)
+            m[list(skip)] = False
+        else:
+            m = None
         draws_used = 0
         for i in range(n_steps):
             # mirror step()'s draw accounting: a draw is consumed only
@@ -1961,16 +2443,22 @@ class ServingEngine:
             # stream a later admission sees is identical whichever
             # scheduling API ran this window — the scan's keys for
             # post-retirement steps produced only discarded tokens
-            if sampled and _knobs_live(self.temps, self.topks,
-                                       self.topps, self.minps,
-                                       self.pres, self.freqs,
-                                       self.reps):
+            if sampled and (
+                    _knobs_live(self.temps, self.topks, self.topps,
+                                self.minps, self.pres, self.freqs,
+                                self.reps) if m is None else
+                    _knobs_live(self.temps[m], self.topks[m],
+                                self.topps[m], self.minps[m],
+                                self.pres[m], self.freqs[m],
+                                self.reps[m])):
                 draws_used += 1
             if lp_k:
-                self._harvest_logprobs(clps[i], tlps[i], tids[i])
+                self._harvest_logprobs(clps[i], tlps[i], tids[i],
+                                       eligible=handle.active)
             for s in range(self.n_slots):
-                self.lens[s] += 1
-                if not self.active[s]:
+                if s not in skip:
+                    self.lens[s] += 1
+                if not (handle.active[s] and self.active[s]):
                     continue
                 tok = int(toks[i, s])
                 if grammared and self.gstate[s] >= 0:
@@ -1985,8 +2473,11 @@ class ServingEngine:
                 self._maybe_finish(s, tok)
         self._draws += draws_used
         # per-slot chains advance in lockstep with the global counter
-        # (step() does the same once per sampled call)
-        self._slot_draws = [d + draws_used for d in self._slot_draws]
+        # (step() does the same once per sampled call); mid-window
+        # admissions keep the chain finish_admit just reset
+        self._slot_draws = [
+            d if s in skip else d + draws_used
+            for s, d in enumerate(self._slot_draws)]
         # lens advanced n_steps per slot in-device; the loop above
         # advanced the host mirror the same amount
         return out
@@ -2030,6 +2521,7 @@ class ServingEngine:
             "n_slots": self.n_slots,
             "active_slots": sum(self.active),
             "free_slots": self.n_slots - sum(self.active),
+            "reserved_slots": sum(self._reserved),
             "finished_requests": self._completed,
             "registered_prefixes": len(self._prefixes),
             "tokens_emitted": self._tokens,
