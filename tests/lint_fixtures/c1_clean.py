@@ -1,0 +1,19 @@
+"""C1 clean twin: nested acquisition, but always the same order."""
+
+import threading
+
+
+class Ordered:
+    def __init__(self):
+        self._alpha_lock = threading.Lock()
+        self._beta_lock = threading.Lock()
+
+    def forward(self):
+        with self._alpha_lock:
+            with self._beta_lock:
+                return 1
+
+    def backward(self):
+        with self._alpha_lock:
+            with self._beta_lock:
+                return 2

@@ -1,0 +1,19 @@
+"""C1 seeded violation: two locks taken in opposite orders."""
+
+import threading
+
+
+class Crossed:
+    def __init__(self):
+        self._alpha_lock = threading.Lock()
+        self._beta_lock = threading.Lock()
+
+    def forward(self):
+        with self._alpha_lock:
+            with self._beta_lock:
+                return 1
+
+    def backward(self):
+        with self._beta_lock:
+            with self._alpha_lock:
+                return 2

@@ -1,0 +1,15 @@
+"""C1 cross-module half A: holds module lock A, calls into module B."""
+
+import threading
+
+_a_lock = threading.Lock()
+
+
+def lock_a_then_call_b():
+    with _a_lock:
+        lock_b_inner()
+
+
+def lock_a_inner():
+    with _a_lock:
+        return 1

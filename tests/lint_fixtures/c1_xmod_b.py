@@ -1,0 +1,16 @@
+"""C1 cross-module half B: holds module lock B, calls back into A —
+together with half A this closes an inter-module lock cycle."""
+
+import threading
+
+_b_lock = threading.Lock()
+
+
+def lock_b_then_call_a():
+    with _b_lock:
+        lock_a_inner()
+
+
+def lock_b_inner():
+    with _b_lock:
+        return 2

@@ -1,0 +1,23 @@
+"""C2 clean twin: the blocking work happens outside the lock, and
+waits under a lock carry a timeout."""
+
+import threading
+import time
+
+
+class NoStall:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+
+    def sleepy(self):
+        with self._lock:
+            step = self._next_step()
+        time.sleep(step)
+
+    def bounded(self):
+        with self._lock:
+            self._done.wait(0.5)
+
+    def _next_step(self):
+        return 0.01

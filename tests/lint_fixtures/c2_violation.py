@@ -1,0 +1,22 @@
+"""C2 seeded violation: unbounded blocking while a lock is held."""
+
+import threading
+import time
+
+
+class Stall:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+
+    def sleepy(self):
+        with self._lock:
+            time.sleep(1.0)
+
+    def device_sync(self, x):
+        with self._lock:
+            x.block_until_ready()
+
+    def forever(self):
+        with self._lock:
+            self._done.wait()

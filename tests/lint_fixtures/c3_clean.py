@@ -1,0 +1,30 @@
+"""C3 clean twin: every lifecycle the rule accepts — daemonized,
+directly joined, attribute joined from stop(), and list-joined."""
+
+import threading
+
+
+class Owner:
+    def __init__(self):
+        self._thread = threading.Thread(target=print)
+
+    def stop(self):
+        self._thread.join(timeout=5.0)
+
+
+def daemonized():
+    threading.Thread(target=print, daemon=True).start()
+
+
+def joined_local():
+    t = threading.Thread(target=print)
+    t.start()
+    t.join()
+
+
+def joined_pool():
+    threads = [threading.Thread(target=print) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()

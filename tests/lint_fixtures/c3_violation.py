@@ -1,0 +1,9 @@
+"""C3 seeded violation: a non-daemon thread nobody ever joins."""
+
+import threading
+
+
+def fire_and_forget():
+    t = threading.Thread(target=print)
+    t.start()
+    return t

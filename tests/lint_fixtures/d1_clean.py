@@ -1,0 +1,9 @@
+# tpulint: deterministic-path
+"""D1 clean twin: a seeded Random instance and caller-injected time."""
+
+import random
+
+
+def draw(seed: int, now: float):
+    rng = random.Random(seed)
+    return rng.random(), now

@@ -1,0 +1,12 @@
+# tpulint: deterministic-path
+"""D1 seeded violation: global RNG + wall clock inside a declared
+deterministic path."""
+
+import random
+import time
+
+
+def draw():
+    jitter = random.random()
+    stamp = time.time()
+    return jitter, stamp

@@ -1,0 +1,15 @@
+"""O1 clean twin: Registry-built families, promlint-valid names,
+bounded labels."""
+
+from tpu_k8s_device_plugin import obs
+
+
+def build(reg: obs.Registry):
+    requests = reg.counter("tpu_fixture_requests_total",
+                           "well-formed counter", ("op",))
+    inflight = reg.gauge("tpu_fixture_inflight",
+                         "well-formed gauge")
+    latency = reg.histogram("tpu_fixture_latency_seconds",
+                            "well-formed histogram",
+                            buckets=obs.LATENCY_BUCKETS_S)
+    return requests, inflight, latency

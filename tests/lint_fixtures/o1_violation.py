@@ -1,0 +1,16 @@
+"""O1 seeded violations: a family constructed outside any Registry, a
+counter without its _total suffix, and an unbounded-cardinality
+label at the definition site."""
+
+from tpu_k8s_device_plugin import obs
+
+
+def build(reg):
+    direct = obs.Counter("tpu_fixture_direct_total",
+                         "constructed outside a Registry")
+    unsuffixed = reg.counter("tpu_fixture_requests",
+                             "counter missing _total")
+    leaky = reg.gauge("tpu_fixture_inflight",
+                      "per-request label cardinality",
+                      ("request_id",))
+    return direct, unsuffixed, leaky

@@ -1,0 +1,13 @@
+"""Pragma contract fixture: a pragma with NO justification text must
+not suppress anything and is itself a P1 finding."""
+
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def unjustified():
+    with _lock:
+        # tpulint: disable=C2
+        time.sleep(0.001)

@@ -1,0 +1,18 @@
+"""Pragma contract fixture: a real violation suppressed by a justified
+pragma (line-above and same-line forms)."""
+
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def line_above():
+    with _lock:
+        # tpulint: disable=C2 -- fixture: bounded 1ms sleep on a test-local lock
+        time.sleep(0.001)
+
+
+def same_line():
+    with _lock:
+        time.sleep(0.001)  # tpulint: disable=C2 -- fixture: bounded 1ms sleep on a test-local lock

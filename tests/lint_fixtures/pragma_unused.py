@@ -1,0 +1,7 @@
+"""Pragma contract fixture: a justified pragma whose violation is gone
+— clean by default, a P2 finding under --strict."""
+
+
+def harmless():
+    # tpulint: disable=C2 -- fixture: the sleep this excused was deleted
+    return 42

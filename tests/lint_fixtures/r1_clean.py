@@ -1,0 +1,15 @@
+"""R1 clean twin: the same boundary routed through RetryPolicy (and a
+fault hook, chaos-harness style)."""
+
+import subprocess
+
+from tpu_k8s_device_plugin.resilience import RetryPolicy, faults
+
+
+def covered_probe():
+    if faults.ACTIVE is not None:
+        faults.ACTIVE.fire("fixture.probe")
+    policy = RetryPolicy(max_attempts=2, seed=0)
+    return policy.call(
+        lambda: subprocess.run(["true"], check=False),
+        op="fixture.probe")

@@ -1,0 +1,8 @@
+"""R1 seeded violation: a naked subprocess boundary — no retry, no
+breaker, no fault hook; its failure path cannot be provoked."""
+
+import subprocess
+
+
+def naked_probe():
+    return subprocess.run(["true"], check=False)

@@ -1,0 +1,31 @@
+"""R2 clean twin: every handling shape the rule accepts — log,
+re-raise, and resilience.suppressed() accounting."""
+
+import logging
+
+from tpu_k8s_device_plugin.resilience import suppressed
+
+log = logging.getLogger(__name__)
+
+
+def logged(fn):
+    try:
+        return fn()
+    except Exception as e:
+        log.warning("fixture call failed: %s", e)
+    return None
+
+
+def reraised(fn):
+    try:
+        return fn()
+    except Exception as e:
+        raise RuntimeError("wrapped") from e
+
+
+def accounted(fn):
+    try:
+        return fn()
+    except Exception as e:
+        suppressed("fixture.accounted", e, logger=log)
+    return None

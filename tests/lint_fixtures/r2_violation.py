@@ -1,0 +1,9 @@
+"""R2 seeded violation: the classic silent swallow."""
+
+
+def swallow(fn):
+    try:
+        return fn()
+    except Exception:
+        pass
+    return None

@@ -1,0 +1,4 @@
+# Namespace package marker so `python -m tools.tpulint` and the
+# `tpulint` console entry point resolve the same code (pyproject ships
+# `tools*`).  The standalone scripts in this directory (promlint,
+# chaos_soak, trace_smoke, measure_r3) stay runnable as plain files.

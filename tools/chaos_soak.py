@@ -43,6 +43,7 @@ Usage::
     python tools/chaos_soak.py --seed 1            # full soak
     python tools/chaos_soak.py --seed 1 --skip-serving   # no jax needed
 """
+# tpulint: disable-file=R1 -- chaos DRIVER: these probe requests deliberately hit a faulted server raw; the resilience machinery under test lives on the server side, and wrapping the prober would mask whether recovery actually happened
 
 from __future__ import annotations
 

@@ -23,6 +23,7 @@ NOT here: zigzag-vs-contiguous ring on ICI (VERDICT r2 #7) — rings
 need >= 2 devices and the tunnel exposes ONE chip; recorded as
 hardware-blocked in BASELINE.md.
 """
+# tpulint: disable-file=R1 -- measurement runner: each phase subprocess already has a timeout and its failure is recorded as the phase result; retrying would double-count warmup effects
 
 from __future__ import annotations
 

@@ -1,0 +1,47 @@
+"""tpulint: project-invariant static analysis for this repo.
+
+``tools/promlint.py`` lints what the metrics renderers EMIT;  tpulint
+lints what the code IS — the conventions PRs 1-6 introduced (lock
+discipline, resilience coverage at every boundary, no silent exception
+swallows, registry-only metric families, seeded determinism in the
+engine paths) become checked invariants instead of review folklore.
+
+Dependency-free (ast + tokenize), like promlint.  Entry points:
+
+- ``python -m tools.tpulint --strict`` (what CI's ``code-lint`` runs)
+- the ``tpulint`` console script (same ``cli.main``)
+- ``from tools.tpulint import lint_paths`` for the test suite
+
+Rule catalog and pragma grammar: ``docs/user-guide/
+static-analysis.md``.
+"""
+
+from .core import (
+    DEFAULT_EXCLUDES,
+    FileContext,
+    Finding,
+    Pragma,
+    Project,
+    RULES,
+    Rule,
+    lint_paths,
+    register,
+    render_human,
+    render_json,
+)
+from . import rules  # noqa: F401  (importing registers the rule set)
+
+__all__ = [
+    "DEFAULT_EXCLUDES",
+    "FileContext",
+    "Finding",
+    "Pragma",
+    "Project",
+    "RULES",
+    "Rule",
+    "lint_paths",
+    "register",
+    "render_human",
+    "render_json",
+    "rules",
+]

@@ -1,0 +1,66 @@
+"""tpulint command line.
+
+``python -m tools.tpulint [--strict] [--json] [PATH ...]`` — the CI
+``code-lint`` job and the ``tpulint`` console script both land here, so
+there is exactly one implementation to trust.  With no paths the
+default target set is the shipped package plus ``tools/`` (relative to
+the repo root, located by walking up from this file).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from .core import RULES, lint_paths, render_human, render_json
+from . import rules as _rules  # noqa: F401  (registers the rule set)
+
+DEFAULT_TARGETS = ("tpu_k8s_device_plugin", "tools")
+
+
+def _repo_root() -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(here))
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tpulint",
+        description="project-invariant static analysis "
+                    "(rule catalog: docs/user-guide/static-analysis.md)")
+    parser.add_argument("paths", nargs="*",
+                        help="files/directories to lint (default: "
+                             f"{' '.join(DEFAULT_TARGETS)} under the "
+                             "repo root)")
+    parser.add_argument("--strict", action="store_true",
+                        help="also fail on unused pragmas (P2)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable output")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule_id in sorted(RULES):
+            rule = RULES[rule_id]
+            print(f"{rule_id}  {rule.name}: {rule.doc}")
+        return 0
+
+    root = _repo_root()
+    paths: List[str] = list(args.paths)
+    if not paths:
+        paths = [os.path.join(root, t) for t in DEFAULT_TARGETS]
+    findings = lint_paths(paths, strict=args.strict, root=root)
+    if args.json:
+        print(render_json(findings))
+    elif findings:
+        print(render_human(findings))
+    else:
+        print("tpulint: clean")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,448 @@
+"""tpulint core: the dependency-free AST analysis framework.
+
+``tools/promlint.py`` proved the shape — a stdlib-only linter gating CI
+catches invariant regressions before runtime.  This module generalizes
+it from metric exposition text to the repo's Python source: a rule
+registry, one shared per-file analysis pass (qualified names, lock
+discovery, pragma collection), suppression pragmas with REQUIRED
+justification text, and JSON/human output.  The project-specific rules
+themselves live in :mod:`.rules`; see ``docs/user-guide/
+static-analysis.md`` for the catalog.
+
+Suppression contract (enforced, not advisory):
+
+- ``# tpulint: disable=C2 -- <why this site is safe>`` on the flagged
+  line (or the line directly above it) suppresses that rule there;
+- ``# tpulint: disable-file=R1 -- <why>`` anywhere in the file
+  suppresses the rule for the whole file;
+- a pragma with no ``-- justification`` text is itself a finding (P1),
+  as is one naming an unknown rule;
+- under ``--strict`` an unused pragma is a finding too (P2): stale
+  suppressions must not outlive the code they excused.
+
+A file whose first 30 lines carry ``# tpulint: deterministic-path``
+opts into the seeded-determinism rule set (D1) in addition to any
+paths the rule matches by name — the invariant is declared next to the
+code that holds it.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+PRAGMA_RE = re.compile(
+    r"#\s*tpulint:\s*(disable|disable-file)="
+    r"([A-Za-z0-9_,]+)\s*(?:--\s*(\S.*))?")
+DETERMINISTIC_MARK_RE = re.compile(r"#\s*tpulint:\s*deterministic-path\b")
+_DETERMINISTIC_MARK_SCAN_LINES = 30
+
+# directory/file names never linted (generated code, fixtures that are
+# DELIBERATE violations, caches)
+DEFAULT_EXCLUDES = (
+    "__pycache__",
+    "lint_fixtures",
+    "_pb2.py",
+    "_pb2_grpc.py",
+    ".jax_cache",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one location."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"rule": self.rule, "path": self.path,
+                "line": self.line, "message": self.message}
+
+
+@dataclasses.dataclass
+class Pragma:
+    """One parsed suppression comment."""
+
+    line: int
+    rules: Tuple[str, ...]
+    justification: str
+    file_scope: bool
+    used: bool = False
+
+
+class LockId:
+    """Canonical identity of one lock object.
+
+    ``module.Class.attr`` for ``self.attr = threading.Lock()``,
+    ``module.func.name`` for a local, ``module.name`` for a module
+    global.  Identity is structural: every instance of a class shares
+    the class's lock id, which is exactly the granularity a
+    lock-ORDER discipline is stated at.
+    """
+
+    __slots__ = ("key",)
+
+    def __init__(self, key: str):
+        self.key = key
+
+    def __hash__(self) -> int:
+        return hash(self.key)
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, LockId) and other.key == self.key
+
+    def __repr__(self) -> str:
+        return self.key
+
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    """``threading.Lock()`` / ``threading.RLock()`` /
+    ``threading.Condition()`` (or the bare imported names)."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return (isinstance(fn.value, ast.Name)
+                and fn.value.id == "threading"
+                and fn.attr in _LOCK_FACTORIES)
+    return isinstance(fn, ast.Name) and fn.id in _LOCK_FACTORIES
+
+
+class FileContext:
+    """Everything the rules need about one source file, computed once:
+    the AST, parent/qualname maps, pragma table, discovered locks."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.module_name = _module_name(relpath)
+        self.pragmas: List[Pragma] = []
+        self.deterministic = False
+        self._collect_pragmas()
+        # parent + qualified-name maps (functions and classes)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        self.qualnames: Dict[ast.AST, str] = {}
+        self._map_scopes()
+        # lock discovery: (class name or "", attr/var name) -> LockId
+        self.class_lock_attrs: Dict[Tuple[str, str], LockId] = {}
+        self.local_locks: Dict[Tuple[str, str], LockId] = {}
+        self._discover_locks()
+
+    # -- pragmas -------------------------------------------------------------
+
+    def _collect_pragmas(self) -> None:
+        # real COMMENT tokens only: a pragma EXAMPLE quoted in a
+        # docstring must not register as a live suppression
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(self.source).readline))
+        except (tokenize.TokenError, IndentationError):
+            return
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            i = tok.start[0]
+            m = PRAGMA_RE.search(tok.string)
+            if m:
+                kind, rules, justification = m.groups()
+                self.pragmas.append(Pragma(
+                    line=i,
+                    rules=tuple(r.strip() for r in rules.split(",")
+                                if r.strip()),
+                    justification=(justification or "").strip(),
+                    file_scope=(kind == "disable-file"),
+                ))
+            if (i <= _DETERMINISTIC_MARK_SCAN_LINES
+                    and DETERMINISTIC_MARK_RE.search(tok.string)):
+                self.deterministic = True
+
+    # -- scope maps ----------------------------------------------------------
+
+    def _map_scopes(self) -> None:
+        def walk(node: ast.AST, prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    qual = f"{prefix}.{child.name}" if prefix else child.name
+                    self.qualnames[child] = qual
+                    walk(child, qual)
+                else:
+                    walk(child, prefix)
+
+        walk(self.tree, "")
+
+    def enclosing_function(self, node: ast.AST
+                           ) -> Optional[ast.FunctionDef]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def qualname(self, node: ast.AST) -> str:
+        base = self.qualnames.get(node, "")
+        return f"{self.module_name}.{base}" if base else self.module_name
+
+    # -- lock discovery ------------------------------------------------------
+
+    def _discover_locks(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = node.value
+            if value is None or not _is_lock_ctor(value):
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for tgt in targets:
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    cls = self.enclosing_class(node)
+                    cls_name = cls.name if cls is not None else ""
+                    key = (cls_name, tgt.attr)
+                    self.class_lock_attrs[key] = LockId(
+                        f"{self.module_name}.{cls_name}.{tgt.attr}")
+                elif isinstance(tgt, ast.Name):
+                    fn = self.enclosing_function(node)
+                    scope = fn.name if fn is not None else ""
+                    self.local_locks[(scope, tgt.id)] = LockId(
+                        f"{self.module_name}.{scope}.{tgt.id}"
+                        if scope else f"{self.module_name}.{tgt.id}")
+
+    def lock_for_with_item(self, expr: ast.AST,
+                           func: Optional[ast.FunctionDef]
+                           ) -> Optional[LockId]:
+        """Resolve ``with <expr>:`` to a lock identity, or None when the
+        expression is not lock-shaped.  Known locks (discovered
+        assignments) resolve exactly; otherwise an attribute/name whose
+        name contains ``lock`` or ``cond`` resolves structurally so
+        locks assigned in another file still participate."""
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"):
+            cls = self.enclosing_class(expr)
+            cls_name = cls.name if cls is not None else ""
+            known = self.class_lock_attrs.get((cls_name, expr.attr))
+            if known is not None:
+                return known
+            if _lockish_name(expr.attr):
+                return LockId(
+                    f"{self.module_name}.{cls_name}.{expr.attr}")
+            return None
+        if isinstance(expr, ast.Name):
+            scope = func.name if func is not None else ""
+            known = (self.local_locks.get((scope, expr.id))
+                     or self.local_locks.get(("", expr.id)))
+            if known is not None:
+                return known
+            if _lockish_name(expr.id):
+                return LockId(f"{self.module_name}.{scope}.{expr.id}"
+                              if scope else
+                              f"{self.module_name}.{expr.id}")
+        return None
+
+
+def _lockish_name(name: str) -> bool:
+    low = name.lower()
+    return "lock" in low or low.endswith("_cond") or low == "cond"
+
+
+def _module_name(relpath: str) -> str:
+    mod = relpath[:-3] if relpath.endswith(".py") else relpath
+    mod = mod.replace(os.sep, ".").replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+class Project:
+    """The whole-run container: every FileContext plus the cross-file
+    state project rules accumulate (the lock-acquisition graph)."""
+
+    def __init__(self, contexts: Sequence[FileContext]):
+        self.contexts = list(contexts)
+        # C1 state, filled by the lock-order rule during check_file:
+        # direct edges (held -> acquired) and deferred call edges
+        # resolved against the project-wide function index in finalize.
+        self.lock_edges: Dict[Tuple[LockId, LockId],
+                              Tuple[str, int]] = {}
+        self.deferred_calls: List[Tuple[LockId, str, Optional[str],
+                                        str, int]] = []
+        # function index: bare name -> [(qualname, class name or None,
+        # [LockId acquired anywhere in the function])]
+        self.functions: Dict[str, List[Tuple[str, Optional[str],
+                                             List[LockId]]]] = {}
+
+
+class Rule:
+    """Base class: one invariant.  ``check_file`` runs per file;
+    ``finalize`` runs once after every file (for cross-file rules)."""
+
+    id = "X0"
+    name = "unnamed"
+    doc = ""
+
+    def check_file(self, ctx: FileContext,
+                   project: Project) -> List[Finding]:
+        return []
+
+    def finalize(self, project: Project) -> List[Finding]:
+        return []
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register(rule_cls: type) -> type:
+    inst = rule_cls()
+    if inst.id in RULES:
+        raise ValueError(f"duplicate rule id {inst.id}")
+    RULES[inst.id] = inst
+    return rule_cls
+
+
+# -- the driver --------------------------------------------------------------
+
+def iter_python_files(paths: Iterable[str],
+                      excludes: Sequence[str] = DEFAULT_EXCLUDES
+                      ) -> List[str]:
+    out: List[str] = []
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py") and not _excluded(path, excludes):
+                out.append(path)
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs
+                             if not _excluded(d, excludes))
+            for f in sorted(files):
+                full = os.path.join(root, f)
+                if f.endswith(".py") and not _excluded(full, excludes):
+                    out.append(full)
+    return out
+
+
+def _excluded(path: str, excludes: Sequence[str]) -> bool:
+    return any(pat in path for pat in excludes)
+
+
+def lint_paths(paths: Iterable[str],
+               strict: bool = False,
+               root: Optional[str] = None,
+               excludes: Sequence[str] = DEFAULT_EXCLUDES
+               ) -> List[Finding]:
+    """Lint every Python file under *paths*; returns findings after
+    pragma suppression (plus the pragma-hygiene findings themselves).
+    *root* anchors the relative paths in messages (default: cwd)."""
+    root = root or os.getcwd()
+    contexts: List[FileContext] = []
+    findings: List[Finding] = []
+    for path in iter_python_files(paths, excludes):
+        rel = os.path.relpath(path, root)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+            contexts.append(FileContext(path, rel, source))
+        except (OSError, SyntaxError, ValueError) as e:
+            findings.append(Finding(
+                "E0", rel, getattr(e, "lineno", 0) or 0,
+                f"cannot parse: {e}"))
+    project = Project(contexts)
+    raw: List[Finding] = []
+    for ctx in contexts:
+        for rule in RULES.values():
+            raw.extend(rule.check_file(ctx, project))
+    for rule in RULES.values():
+        raw.extend(rule.finalize(project))
+    by_rel = {ctx.relpath: ctx for ctx in contexts}
+    findings.extend(_apply_pragmas(raw, by_rel, strict=strict))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def _apply_pragmas(raw: List[Finding],
+                   contexts: Dict[str, FileContext],
+                   strict: bool) -> List[Finding]:
+    """Filter findings through the pragma tables, then emit the
+    pragma-hygiene findings (P1 always, P2 unused under strict)."""
+    kept: List[Finding] = []
+    for finding in raw:
+        ctx = contexts.get(finding.path)
+        if ctx is None:
+            kept.append(finding)
+            continue
+        suppressed = False
+        for pragma in ctx.pragmas:
+            if finding.rule not in pragma.rules:
+                continue
+            if pragma.file_scope or pragma.line in (finding.line,
+                                                    finding.line - 1):
+                pragma.used = True
+                # a pragma with no justification never suppresses: the
+                # P1 finding below AND the original finding both stand
+                if pragma.justification:
+                    suppressed = True
+        if not suppressed:
+            kept.append(finding)
+    for ctx in contexts.values():
+        for pragma in ctx.pragmas:
+            for rule_id in pragma.rules:
+                if rule_id not in RULES and not rule_id.startswith("E"):
+                    kept.append(Finding(
+                        "P1", ctx.relpath, pragma.line,
+                        f"pragma names unknown rule {rule_id!r}"))
+            if not pragma.justification:
+                kept.append(Finding(
+                    "P1", ctx.relpath, pragma.line,
+                    "pragma without justification: write "
+                    "'# tpulint: disable=RULE -- <why this site is "
+                    "safe>'"))
+            elif strict and not pragma.used:
+                kept.append(Finding(
+                    "P2", ctx.relpath, pragma.line,
+                    f"unused pragma (rules {','.join(pragma.rules)}): "
+                    "the code it excused is gone; delete it"))
+    return kept
+
+
+def render_human(findings: Sequence[Finding]) -> str:
+    lines = [f.format() for f in findings]
+    lines.append(f"tpulint: {len(findings)} finding(s)")
+    return "\n".join(lines)
+
+
+def render_json(findings: Sequence[Finding]) -> str:
+    return json.dumps(
+        {"findings": [f.to_dict() for f in findings],
+         "count": len(findings)},
+        indent=1, sort_keys=True)

@@ -1,0 +1,766 @@
+"""The project-invariant rules (see ``docs/user-guide/
+static-analysis.md`` for the catalog with worked examples).
+
+Each rule encodes a convention PRs 1-6 established but nothing
+enforced until now:
+
+C1 lock-order        the inter-module lock-acquisition graph is acyclic
+C2 blocking-under-lock  no sleeps/subprocess/socket/device-sync calls
+                     while any lock is held
+C3 thread-lifecycle  every Thread is daemonized or has a join path
+R1 resilience-coverage  network/subprocess boundaries route through
+                     RetryPolicy/CircuitBreaker/Watchdog/a fault hook
+R2 silent-swallow    no ``except Exception`` without a log line, a
+                     re-raise, or resilience.suppressed() accounting
+O1 metric-definition metric families are built through a Registry with
+                     promlint-compatible names and bounded labels
+D1 unseeded-nondeterminism  no bare ``random.*`` / ``time.time()``
+                     inside the declared deterministic paths
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .core import (
+    FileContext,
+    Finding,
+    LockId,
+    Project,
+    Rule,
+    register,
+)
+
+# -- shared lock-scope walker ------------------------------------------------
+
+
+def _walk_lock_scopes(
+    ctx: FileContext,
+) -> Iterator[Tuple[str, ast.AST, Tuple[LockId, ...],
+                    Optional[ast.FunctionDef]]]:
+    """Yield ``("acquire", with_item_expr, held_before, func)`` for each
+    lock acquisition and ``("call", call_node, held, func)`` for each
+    call made while at least one lock is held.  Nested function bodies
+    restart with an empty held set (a closure defined under a lock does
+    not execute under it)."""
+
+    def visit(node: ast.AST, held: Tuple[LockId, ...],
+              func: Optional[ast.FunctionDef]
+              ) -> Iterator[Tuple[str, ast.AST, Tuple[LockId, ...],
+                                  Optional[ast.FunctionDef]]]:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for child in node.body:
+                yield from visit(child, (), node)
+            return
+        if isinstance(node, ast.ClassDef):
+            for child in node.body:
+                yield from visit(child, (), func)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired: List[LockId] = []
+            for item in node.items:
+                lock = ctx.lock_for_with_item(item.context_expr, func)
+                if lock is not None:
+                    yield ("acquire", item.context_expr,
+                           held + tuple(acquired), func)
+                    acquired.append(lock)
+                else:
+                    # the context expression itself may contain calls
+                    # made while the already-held locks are held
+                    for sub in ast.walk(item.context_expr):
+                        if isinstance(sub, ast.Call) and held:
+                            yield ("call", sub, held, func)
+            new_held = held + tuple(acquired)
+            for child in node.body:
+                yield from visit(child, new_held, func)
+            return
+        if isinstance(node, ast.Call) and held:
+            yield ("call", node, held, func)
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child, held, func)
+
+    for top in ctx.tree.body:
+        yield from visit(top, (), None)
+
+
+def _held_locks_of(expr_event: Tuple[str, ast.AST, Tuple[LockId, ...],
+                                     Optional[ast.FunctionDef]]
+                   ) -> Tuple[LockId, ...]:
+    return expr_event[2]
+
+
+def _dotted(node: ast.AST) -> str:
+    """'a.b.c' for Name/Attribute chains, '' otherwise."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _callee(call: ast.Call, ctx: FileContext
+            ) -> Tuple[Optional[str], str]:
+    """(class hint, bare name) of the called function.
+
+    class hint '' = same-module function; a class name = a ``self.``
+    method of that class; None = method resolved by name only."""
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return "", fn.id
+    if isinstance(fn, ast.Attribute):
+        if isinstance(fn.value, ast.Name) and fn.value.id == "self":
+            cls = ctx.enclosing_class(call)
+            return (cls.name if cls is not None else None), fn.attr
+        return None, fn.attr
+    return None, ""
+
+
+# -- C1: lock-order ----------------------------------------------------------
+
+
+@register
+class LockOrderRule(Rule):
+    """Build the project-wide lock-acquisition graph (lock A held while
+    lock B is acquired => edge A->B, including one level of
+    interprocedural edges through project-local calls) and flag every
+    cycle: two threads taking the locks in opposite orders is the
+    classic deadlock, and nothing short of a graph check catches it
+    across modules."""
+
+    id = "C1"
+    name = "lock-order"
+    doc = "inter-module lock acquisition graph must be acyclic"
+
+    # method names so generic that by-name resolution would wire
+    # unrelated locks together (dict.get, list.append, Queue.put, ...)
+    _AMBIGUOUS = {
+        "get", "put", "append", "add", "set", "pop", "update", "start",
+        "stop", "close", "run", "send", "write", "read", "join",
+        "wait", "clear", "items", "values", "keys", "copy",
+    }
+
+    def check_file(self, ctx: FileContext,
+                   project: Project) -> List[Finding]:
+        acquired_by_func: Dict[ast.AST, List[LockId]] = {}
+        for kind, node, held, func in _walk_lock_scopes(ctx):
+            if kind == "acquire":
+                lock = ctx.lock_for_with_item(node, func)
+                if lock is None:
+                    continue
+                if func is not None:
+                    acquired_by_func.setdefault(func, []).append(lock)
+                for h in held:
+                    if h == lock:
+                        continue  # re-entry is C2/B territory, not order
+                    project.lock_edges.setdefault(
+                        (h, lock), (ctx.relpath, node.lineno))
+            else:
+                assert isinstance(node, ast.Call)
+                cls_hint, name = _callee(node, ctx)
+                if not name or name in self._AMBIGUOUS:
+                    continue
+                for h in held:
+                    project.deferred_calls.append(
+                        (h, name, cls_hint, ctx.relpath, node.lineno))
+        # the function index the deferred edges resolve against
+        for node, locks in acquired_by_func.items():
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            cls = ctx.enclosing_class(node)
+            project.functions.setdefault(node.name, []).append(
+                (ctx.qualname(node),
+                 cls.name if cls is not None else None,
+                 list(dict.fromkeys(locks))))
+        return []
+
+    def finalize(self, project: Project) -> List[Finding]:
+        edges: Dict[Tuple[LockId, LockId], Tuple[str, int]] = dict(
+            project.lock_edges)
+        for held, name, cls_hint, relpath, lineno in \
+                project.deferred_calls:
+            candidates = project.functions.get(name, [])
+            if cls_hint == "":
+                matched = [c for c in candidates if c[1] is None]
+            elif cls_hint is not None:
+                matched = [c for c in candidates if c[1] == cls_hint]
+            else:
+                matched = candidates
+            if not matched or len(matched) > 3:
+                continue  # unresolvable or too ambiguous to trust
+            for _, _, locks in matched:
+                for lock in locks:
+                    if lock == held:
+                        continue
+                    edges.setdefault((held, lock), (relpath, lineno))
+        adj: Dict[LockId, List[LockId]] = {}
+        for (a, b) in edges:
+            adj.setdefault(a, []).append(b)
+            adj.setdefault(b, [])
+        findings: List[Finding] = []
+        for cycle in _find_cycles(adj):
+            witness = edges.get((cycle[0], cycle[1])) or next(
+                iter(edges.values()))
+            path = " -> ".join(l.key for l in cycle + [cycle[0]])
+            findings.append(Finding(
+                self.id, witness[0], witness[1],
+                f"lock-order cycle: {path} (two threads taking these "
+                "locks in opposite orders deadlock)"))
+        return findings
+
+
+def _find_cycles(adj: Dict[LockId, List[LockId]]
+                 ) -> List[List[LockId]]:
+    """Minimal cycle enumeration: one representative cycle per
+    strongly-connected component of size > 1 (Tarjan, iterative)."""
+    index: Dict[LockId, int] = {}
+    low: Dict[LockId, int] = {}
+    on_stack: Set[LockId] = set()
+    stack: List[LockId] = []
+    counter = [0]
+    sccs: List[List[LockId]] = []
+
+    def strongconnect(root: LockId) -> None:
+        work: List[Tuple[LockId, int]] = [(root, 0)]
+        while work:
+            v, i = work.pop()
+            if i == 0:
+                index[v] = low[v] = counter[0]
+                counter[0] += 1
+                stack.append(v)
+                on_stack.add(v)
+            recurse = False
+            neighbors = adj.get(v, [])
+            for j in range(i, len(neighbors)):
+                w = neighbors[j]
+                if w not in index:
+                    work.append((v, j + 1))
+                    work.append((w, 0))
+                    recurse = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if recurse:
+                continue
+            if low[v] == index[v]:
+                scc: List[LockId] = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == v:
+                        break
+                if len(scc) > 1:
+                    sccs.append(list(reversed(scc)))
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[v])
+
+    for v in sorted(adj, key=lambda l: l.key):
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+# -- C2: blocking-under-lock -------------------------------------------------
+
+
+@register
+class BlockingUnderLockRule(Rule):
+    """A lock held across a sleep, a subprocess, a socket connect, or a
+    device sync (``block_until_ready``) serializes every other thread
+    behind an operation with unbounded latency — the exact shape the
+    PR-5 watchdog exists to contain at runtime; this catches it at
+    review time."""
+
+    id = "C2"
+    name = "blocking-under-lock"
+    doc = "no unbounded blocking calls while a lock is held"
+
+    _DOTTED = {
+        "time.sleep",
+        "socket.create_connection",
+        "subprocess.run", "subprocess.call", "subprocess.check_call",
+        "subprocess.check_output", "subprocess.Popen",
+        "urllib.request.urlopen",
+    }
+    _BARE = {"sleep", "urlopen"}
+    _ATTRS = {"block_until_ready"}
+
+    def check_file(self, ctx: FileContext,
+                   project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for kind, node, held, _func in _walk_lock_scopes(ctx):
+            if kind != "call" or not held:
+                continue
+            assert isinstance(node, ast.Call)
+            dotted = _dotted(node.func)
+            blocked = None
+            if dotted in self._DOTTED:
+                blocked = dotted
+            elif isinstance(node.func, ast.Name) \
+                    and node.func.id in self._BARE \
+                    and dotted in self._BARE:
+                blocked = dotted
+            elif isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                if attr in self._ATTRS:
+                    blocked = f"...{attr}()"
+                elif attr in ("wait", "join") and not node.args \
+                        and not node.keywords:
+                    # no-timeout wait()/join() block forever;
+                    # str.join always takes an argument, so a bare
+                    # .join() here really is a thread/process join
+                    blocked = f"unbounded ...{attr}()"
+            if blocked is not None:
+                locks = ", ".join(h.key for h in held)
+                findings.append(Finding(
+                    self.id, ctx.relpath, node.lineno,
+                    f"blocking call {blocked} while holding "
+                    f"{locks}: every thread contending that lock "
+                    "stalls behind it"))
+        return findings
+
+
+# -- C3: thread-lifecycle ----------------------------------------------------
+
+
+@register
+class ThreadLifecycleRule(Rule):
+    """Every ``threading.Thread`` must be daemonized or reachable from
+    an owner's stop()/join() path: a forgotten non-daemon thread turns
+    clean shutdown into a hang (the manager's stop() joins _threads
+    with a bound for exactly this reason)."""
+
+    id = "C3"
+    name = "thread-lifecycle"
+    doc = "threads are daemonized or joined"
+
+    def check_file(self, ctx: FileContext,
+                   project: Project) -> List[Finding]:
+        joined, daemonized = self._join_and_daemon_sets(ctx)
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _dotted(node.func) not in ("threading.Thread", "Thread"):
+                continue
+            if _dotted(node.func) == "Thread" \
+                    and "threading" not in ctx.source:
+                continue
+            if self._has_daemon_kwarg(node):
+                continue
+            target = self._creation_target(ctx, node)
+            if target is not None and (target in joined
+                                       or target in daemonized):
+                continue
+            where = f" (assigned to {target!r})" if target else ""
+            findings.append(Finding(
+                self.id, ctx.relpath, node.lineno,
+                f"Thread{where} is neither daemon=True nor joined "
+                "anywhere in this module: it will outlive its owner's "
+                "stop() and can hang interpreter shutdown"))
+        return findings
+
+    @staticmethod
+    def _has_daemon_kwarg(call: ast.Call) -> bool:
+        for kw in call.keywords:
+            if kw.arg == "daemon":
+                # daemon=<expr> counts: a computed daemon-ness is a
+                # deliberate choice, not a forgotten default
+                if isinstance(kw.value, ast.Constant):
+                    return bool(kw.value.value)
+                return True
+        return False
+
+    def _creation_target(self, ctx: FileContext,
+                         call: ast.Call) -> Optional[str]:
+        """The name the created thread lands in: assignment target,
+        the container a list-comprehension fills, or the container of
+        an ``X.append(Thread(...))``."""
+        node: ast.AST = call
+        while True:
+            parent = ctx.parents.get(node)
+            if parent is None:
+                return None
+            if isinstance(parent, ast.Assign):
+                for tgt in parent.targets:
+                    name = _target_name(tgt)
+                    if name:
+                        return name
+                return None
+            if isinstance(parent, ast.Call) and node in parent.args \
+                    and isinstance(parent.func, ast.Attribute) \
+                    and parent.func.attr == "append":
+                return _target_name(parent.func.value)
+            if isinstance(parent, (ast.ListComp, ast.GeneratorExp,
+                                   ast.List, ast.Tuple, ast.IfExp)):
+                node = parent
+                continue
+            if isinstance(parent, (ast.FunctionDef, ast.Module,
+                                   ast.ClassDef)):
+                return None
+            node = parent
+
+    @staticmethod
+    def _join_and_daemon_sets(ctx: FileContext
+                              ) -> Tuple[Set[str], Set[str]]:
+        joined: Set[str] = set()
+        daemonized: Set[str] = set()
+        # for-loop variables mapped to the containers they iterate: a
+        # `for t in threads: t.join()` marks `threads` joined.  One
+        # variable may drive several loops (warm_threads, then
+        # threads), so the map holds ALL containers per variable.
+        loop_containers: Dict[str, Set[str]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.For) \
+                    and isinstance(node.target, ast.Name):
+                container = _target_name(node.iter)
+                if container:
+                    loop_containers.setdefault(
+                        node.target.id, set()).add(container)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "join":
+                name = _target_name(node.func.value)
+                if name:
+                    joined.add(name)
+                    joined.update(loop_containers.get(name, ()))
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.targets[0], ast.Attribute) \
+                    and node.targets[0].attr == "daemon":
+                name = _target_name(node.targets[0].value)
+                if name:
+                    daemonized.add(name)
+        return joined, daemonized
+
+
+def _target_name(node: ast.AST) -> Optional[str]:
+    """'x' for Name x, 'attr' for self.attr/obj.attr (the attribute
+    name alone — join sites and creation sites share it)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+# -- R1: resilience-coverage -------------------------------------------------
+
+
+@register
+class ResilienceCoverageRule(Rule):
+    """PR 5's contract: every network/subprocess boundary routes
+    through RetryPolicy/CircuitBreaker/Watchdog or carries a registered
+    fault hook, so chaos runs can provoke its failure path.  A naked
+    boundary is untested recovery by definition."""
+
+    id = "R1"
+    name = "resilience-coverage"
+    doc = "network/subprocess call sites route through the resilience layer"
+
+    _BOUNDARIES = {
+        "subprocess.run", "subprocess.call", "subprocess.check_call",
+        "subprocess.check_output", "subprocess.Popen",
+        "urllib.request.urlopen",
+        "socket.create_connection",
+        "http.client.HTTPConnection", "http.client.HTTPSConnection",
+        "grpc.insecure_channel", "grpc.secure_channel",
+    }
+    _EVIDENCE_NAMES = {
+        "RetryPolicy", "CircuitBreaker", "Watchdog", "InjectedFault",
+        "suppressed",
+    }
+    _EVIDENCE_SUBSTR = ("retry", "breaker", "watchdog", "policy",
+                        "fault")
+
+    def check_file(self, ctx: FileContext,
+                   project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            if dotted not in self._BOUNDARIES:
+                continue
+            func = ctx.enclosing_function(node)
+            if func is not None and self._has_evidence(func):
+                continue
+            cls = ctx.enclosing_class(node)
+            if cls is not None and self._has_evidence(cls):
+                continue
+            if func is None and cls is None \
+                    and self._has_evidence(ctx.tree):
+                # module-level boundary (import-time probe):
+                # module-wide evidence is the best anchor available
+                continue
+            findings.append(Finding(
+                self.id, ctx.relpath, node.lineno,
+                f"boundary call {dotted} has no RetryPolicy/"
+                "CircuitBreaker/Watchdog/fault-hook in its "
+                "enclosing scope: its failure path cannot be "
+                "provoked by the chaos harness"))
+        return findings
+
+    def _has_evidence(self, scope: ast.AST) -> bool:
+        for node in ast.walk(scope):
+            ident = None
+            if isinstance(node, ast.Name):
+                ident = node.id
+            elif isinstance(node, ast.Attribute):
+                ident = node.attr
+            if ident is None:
+                continue
+            if ident in self._EVIDENCE_NAMES:
+                return True
+            low = ident.lower()
+            if any(s in low for s in self._EVIDENCE_SUBSTR):
+                return True
+        return False
+
+
+# -- R2: silent-swallow ------------------------------------------------------
+
+
+@register
+class SilentSwallowRule(Rule):
+    """PR 5 fixed ~30 silent ``except Exception: pass`` sites by hand;
+    this rule keeps them fixed.  A broad handler must log, re-raise, or
+    account through ``resilience.suppressed()`` /
+    ``tpu_suppressed_errors_total`` — a fault that vanishes is a fault
+    that floods unnoticed."""
+
+    id = "R2"
+    name = "silent-swallow"
+    doc = "broad except handlers must log, re-raise, or count"
+
+    _LOG_ATTRS = {"debug", "info", "warning", "warn", "error",
+                  "exception", "critical", "log", "handle_error",
+                  "abort"}
+
+    def check_file(self, ctx: FileContext,
+                   project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._is_broad(node.type):
+                continue
+            if self._handles(node.body):
+                continue
+            findings.append(Finding(
+                self.id, ctx.relpath, node.lineno,
+                "broad except handler swallows silently: log it, "
+                "re-raise, or route through resilience.suppressed() "
+                "so tpu_suppressed_errors_total sees it"))
+        return findings
+
+    @staticmethod
+    def _is_broad(type_node: Optional[ast.AST]) -> bool:
+        if type_node is None:
+            return True  # bare except
+        names: List[str] = []
+        nodes = (type_node.elts if isinstance(type_node, ast.Tuple)
+                 else [type_node])
+        for n in nodes:
+            if isinstance(n, ast.Name):
+                names.append(n.id)
+            elif isinstance(n, ast.Attribute):
+                names.append(n.attr)
+        return any(n in ("Exception", "BaseException") for n in names)
+
+    def _handles(self, body: Sequence[ast.stmt]) -> bool:
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Raise):
+                    return True
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                if isinstance(fn, ast.Attribute):
+                    if fn.attr in self._LOG_ATTRS:
+                        return True
+                    if fn.attr == "inc":
+                        return True  # counter accounting
+                    if fn.attr == "suppressed":
+                        return True
+                elif isinstance(fn, ast.Name):
+                    if fn.id in ("suppressed", "print"):
+                        return True
+        return False
+
+
+# -- O1: metric-definition ---------------------------------------------------
+
+
+@register
+class MetricDefinitionRule(Rule):
+    """Metric families must be built through a Registry (get-or-create
+    + one renderer: the invariant PR 3 introduced), with names promlint
+    would accept at the DEFINITION site and label sets whose
+    cardinality is bounded — a request-id label is a series-per-request
+    memory leak on every scrape path."""
+
+    id = "O1"
+    name = "metric-definition"
+    doc = "families built via Registry, promlint-compatible, bounded labels"
+
+    _CTORS = {"Counter", "Gauge", "Histogram"}
+    _METHODS = {"counter", "gauge", "histogram"}
+    _HIGH_CARDINALITY = {
+        "request_id", "trace_id", "span_id", "rid", "uid", "url",
+        "path", "id", "pod", "pod_name", "container_id", "timestamp",
+        "le",
+    }
+    _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+    _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+    def check_file(self, ctx: FileContext,
+                   project: Project) -> List[Finding]:
+        in_obs = ".obs." in f".{ctx.module_name}." \
+            or ctx.module_name.endswith(".obs")
+        imports_obs = self._imports_obs(ctx)
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            # direct family construction outside the obs package
+            if not in_obs and imports_obs:
+                ctor = None
+                if isinstance(fn, ast.Name) and fn.id in self._CTORS:
+                    ctor = fn.id
+                elif isinstance(fn, ast.Attribute) \
+                        and fn.attr in self._CTORS \
+                        and isinstance(fn.value, ast.Name) \
+                        and fn.value.id == "obs":
+                    ctor = fn.attr
+                if ctor is not None:
+                    findings.append(Finding(
+                        self.id, ctx.relpath, node.lineno,
+                        f"obs.{ctor} constructed directly: build "
+                        "families via Registry.counter()/gauge()/"
+                        "histogram() so get-or-create dedup and the "
+                        "one renderer apply"))
+                    continue
+            # definition-site lint on registry.counter/gauge/histogram
+            if not (isinstance(fn, ast.Attribute)
+                    and fn.attr in self._METHODS):
+                continue
+            if not node.args or not isinstance(node.args[0],
+                                               ast.Constant) \
+                    or not isinstance(node.args[0].value, str):
+                continue
+            name = node.args[0].value
+            if not name.startswith("tpu_"):
+                # the project namespace; also filters unrelated
+                # .counter()-shaped calls on non-registry objects
+                continue
+            if not self._NAME_RE.match(name):
+                findings.append(Finding(
+                    self.id, ctx.relpath, node.lineno,
+                    f"metric name {name!r} is not promlint-valid"))
+            if fn.attr == "counter" and not name.endswith("_total"):
+                findings.append(Finding(
+                    self.id, ctx.relpath, node.lineno,
+                    f"counter {name!r} must end in '_total' "
+                    "(promlint C1 at the definition site)"))
+            for label, lineno in self._labelnames(node):
+                if not self._LABEL_RE.match(label):
+                    findings.append(Finding(
+                        self.id, ctx.relpath, lineno,
+                        f"label {label!r} on {name} is not a valid "
+                        "Prometheus label name"))
+                if label in self._HIGH_CARDINALITY:
+                    findings.append(Finding(
+                        self.id, ctx.relpath, lineno,
+                        f"label {label!r} on {name} is unbounded-"
+                        "cardinality (one series per value): carry it "
+                        "in an exemplar or the flight recorder, not a "
+                        "label"))
+        return findings
+
+    @staticmethod
+    def _imports_obs(ctx: FileContext) -> bool:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and node.module \
+                    and "obs" in node.module.split("."):
+                return True
+            if isinstance(node, ast.ImportFrom) \
+                    and any(a.name == "obs" for a in node.names):
+                return True
+        return False
+
+    @staticmethod
+    def _labelnames(call: ast.Call
+                    ) -> List[Tuple[str, int]]:
+        candidates: List[ast.AST] = []
+        if len(call.args) >= 3:
+            candidates.append(call.args[2])
+        for kw in call.keywords:
+            if kw.arg == "labelnames":
+                candidates.append(kw.value)
+        out: List[Tuple[str, int]] = []
+        for cand in candidates:
+            if isinstance(cand, (ast.Tuple, ast.List)):
+                for elt in cand.elts:
+                    if isinstance(elt, ast.Constant) \
+                            and isinstance(elt.value, str):
+                        out.append((elt.value, elt.lineno))
+        return out
+
+
+# -- D1: unseeded-nondeterminism ---------------------------------------------
+
+
+@register
+class UnseededNondeterminismRule(Rule):
+    """The engine/scheduler equivalence suites replay byte-identically
+    from a seed; one bare ``random.*`` or wall-clock read in those
+    paths and "interleave on == interleave off" stops being checkable.
+    Applies to the declared deterministic paths (the
+    ``# tpulint: deterministic-path`` marker) plus the known suffixes.
+    """
+
+    id = "D1"
+    name = "unseeded-nondeterminism"
+    doc = "no bare random/time.time in deterministic paths"
+
+    _SUFFIXES = (
+        "workloads/serving.py",
+        "workloads/scheduler.py",
+        "slice/state.py",
+    )
+
+    def check_file(self, ctx: FileContext,
+                   project: Project) -> List[Finding]:
+        rel = ctx.relpath.replace("\\", "/")
+        if not (ctx.deterministic
+                or any(rel.endswith(s) for s in self._SUFFIXES)):
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            if dotted.startswith("random.") \
+                    and dotted != "random.Random":
+                findings.append(Finding(
+                    self.id, ctx.relpath, node.lineno,
+                    f"{dotted} uses the process-global RNG in a "
+                    "deterministic path: construct a seeded "
+                    "random.Random and thread it through"))
+            elif dotted in ("time.time", "time.time_ns"):
+                findings.append(Finding(
+                    self.id, ctx.relpath, node.lineno,
+                    f"{dotted}() is a wall-clock read in a "
+                    "deterministic path: inject now= from the caller "
+                    "like slice.state does"))
+        return findings

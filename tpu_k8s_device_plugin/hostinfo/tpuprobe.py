@@ -35,6 +35,7 @@ def _build() -> bool:
         "-shared", "-o", _SO_PATH, _SRC,
     ]
     try:
+        # tpulint: disable=R1 -- one-shot g++ build at import with its own 120s timeout; failure logs and degrades to the portable sysfs parser, a retry would rebuild the same failure
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         return True
     except (subprocess.SubprocessError, OSError) as e:
@@ -148,5 +149,6 @@ class DirWatcher:
             try:
                 from tpu_k8s_device_plugin.resilience import suppressed
                 suppressed("tpuprobe.dirwatcher_del", e, logger=log)
+            # tpulint: disable=R2 -- interpreter teardown: the accounting import itself can fail mid-shutdown; a __del__ must never raise
             except Exception:
                 pass

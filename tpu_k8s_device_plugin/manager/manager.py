@@ -224,6 +224,10 @@ class PluginManager:
                 try:
                     devices = self.impl.enumerate(plugin.ctx)
                 except Exception as e:
+                    # surfaced to the /debug caller in the payload, and
+                    # logged so the failure is greppable without one
+                    log.debug("debug-status enumerate failed for %s: %s",
+                              resource, e)
                     out[resource] = {"error": str(e)}
                     continue
             out[resource] = {

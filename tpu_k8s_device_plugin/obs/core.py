@@ -30,7 +30,18 @@ import re
 import threading
 import time
 from bisect import bisect_left
-from typing import Callable, Dict, Iterable, List, Optional, Tuple
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    Generic,
+    Iterable,
+    List,
+    Optional,
+    Tuple,
+    TypeVar,
+    cast,
+)
 
 log = logging.getLogger(__name__)
 
@@ -47,7 +58,7 @@ OPENMETRICS_CONTENT_TYPE = (
 def negotiate_openmetrics(accept: Optional[str]) -> bool:
     """True when the Accept header asks for the OpenMetrics exposition
     (what a Prometheus server scraping with exemplar support sends)."""
-    return bool(accept) and "application/openmetrics-text" in accept
+    return accept is not None and "application/openmetrics-text" in accept
 
 _METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
@@ -114,7 +125,7 @@ class _Child:
 
     __slots__ = ("_lock", "_value")
 
-    def __init__(self, lock: threading.Lock):
+    def __init__(self, lock: threading.Lock) -> None:
         self._lock = lock
         self._value = 0.0
 
@@ -144,10 +155,11 @@ class _HistChild:
     __slots__ = ("_lock", "_bounds", "_counts", "_sum", "_count",
                  "_exemplars")
 
-    def __init__(self, lock: threading.Lock, bounds: Tuple[float, ...]):
+    def __init__(self, lock: threading.Lock,
+                 bounds: Tuple[float, ...]) -> None:
         self._lock = lock
         self._bounds = bounds                 # includes trailing +Inf
-        self._counts = [0] * len(bounds)
+        self._counts: List[int] = [0] * len(bounds)
         self._sum = 0.0
         self._count = 0
         # bucket index -> (trace_id, observed value, wall time): the
@@ -186,13 +198,19 @@ class _HistChild:
             return dict(self._exemplars) if self._exemplars else {}
 
 
-class _Family:
+# the child type one family hands out: _Child for counters/gauges,
+# _HistChild for histograms — generic so strict-typed callers get the
+# right .inc()/.observe() surface back from .labels()
+_C = TypeVar("_C", _Child, _HistChild)
+
+
+class _Family(Generic[_C]):
     """Base: one metric family (name, help, kind, label names)."""
 
-    kind = "untyped"
+    kind: str = "untyped"
 
     def __init__(self, name: str, help: str,
-                 labelnames: Tuple[str, ...] = ()):
+                 labelnames: Tuple[str, ...] = ()) -> None:
         if not _METRIC_NAME_RE.match(name):
             raise ValueError(f"invalid metric name {name!r}")
         if not help:
@@ -206,12 +224,15 @@ class _Family:
         self.help = help
         self.labelnames = tuple(labelnames)
         self._lock = threading.Lock()
-        self._children: Dict[Tuple[str, ...], object] = {}
+        self._children: Dict[Tuple[str, ...], _C] = {}
 
-    def _make_child(self):
+    def _make_child(self) -> _C:
         raise NotImplementedError
 
-    def labels(self, **kv):
+    def render(self, out: List[str], openmetrics: bool = False) -> None:
+        raise NotImplementedError
+
+    def labels(self, **kv: object) -> _C:
         """Get-or-create the child for one label-value combination."""
         if set(kv) != set(self.labelnames):
             raise ValueError(
@@ -232,27 +253,28 @@ class _Family:
         with self._lock:
             self._children.clear()
 
-    def _default(self):
+    def _default(self) -> _C:
         return self.labels(**{})
 
-    def _sorted_children(self):
+    def _sorted_children(self) -> List[Tuple[Tuple[str, ...], _C]]:
         with self._lock:
             return sorted(self._children.items())
 
 
-class Counter(_Family):
+class Counter(_Family[_Child]):
     """Monotonic counter family.  Names MUST end in ``_total`` — the
     renderer is promlint-clean by construction, not by review."""
 
     kind = "counter"
 
-    def __init__(self, name, help, labelnames=()):
+    def __init__(self, name: str, help: str,
+                 labelnames: Tuple[str, ...] = ()) -> None:
         if not name.endswith("_total"):
             raise ValueError(
                 f"counter {name!r} must end in '_total' (promlint)")
         super().__init__(name, help, labelnames)
 
-    def _make_child(self):
+    def _make_child(self) -> _Child:
         return _Child(threading.Lock())
 
     def inc(self, amount: float = 1.0) -> None:
@@ -273,10 +295,10 @@ class Counter(_Family):
                                child.value))
 
 
-class Gauge(_Family):
+class Gauge(_Family[_Child]):
     kind = "gauge"
 
-    def _make_child(self):
+    def _make_child(self) -> _Child:
         return _Child(threading.Lock())
 
     def set(self, value: float) -> None:
@@ -297,11 +319,12 @@ class Gauge(_Family):
                                child.value))
 
 
-class Histogram(_Family):
+class Histogram(_Family[_HistChild]):
     kind = "histogram"
 
-    def __init__(self, name, help, labelnames=(),
-                 buckets: Iterable[float] = LATENCY_BUCKETS_S):
+    def __init__(self, name: str, help: str,
+                 labelnames: Tuple[str, ...] = (),
+                 buckets: Iterable[float] = LATENCY_BUCKETS_S) -> None:
         bounds = tuple(sorted(set(float(b) for b in buckets)))
         if not bounds:
             raise ValueError(f"histogram {name} needs >= 1 bucket")
@@ -312,7 +335,7 @@ class Histogram(_Family):
         self.buckets = bounds
         super().__init__(name, help, labelnames)
 
-    def _make_child(self):
+    def _make_child(self) -> _HistChild:
         return _HistChild(threading.Lock(), self.buckets)
 
     def observe(self, value: float,
@@ -375,12 +398,14 @@ class Registry:
     drift is how the three old renderers diverged.
     """
 
-    def __init__(self):
+    def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._families: Dict[str, _Family] = {}
+        self._families: Dict[str, _Family[Any]] = {}
         self._collectors: List[Callable[[], None]] = []
 
-    def _get_or_create(self, cls, name, help, labelnames, **kw):
+    def _get_or_create(self, cls: type, name: str, help: str,
+                       labelnames: Iterable[str],
+                       **kw: Any) -> "_Family[Any]":
         with self._lock:
             fam = self._families.get(name)
             if fam is not None:
@@ -390,24 +415,28 @@ class Registry:
                         f"metric {name!r} already registered as "
                         f"{fam.kind}{fam.labelnames}")
                 return fam
-            fam = cls(name, help, tuple(labelnames), **kw)
-            self._families[name] = fam
-            return fam
+            made: _Family[Any] = cls(name, help, tuple(labelnames),
+                                     **kw)
+            self._families[name] = made
+            return made
 
     def counter(self, name: str, help: str,
                 labelnames: Tuple[str, ...] = ()) -> Counter:
-        return self._get_or_create(Counter, name, help, labelnames)
+        return cast(Counter,
+                    self._get_or_create(Counter, name, help, labelnames))
 
     def gauge(self, name: str, help: str,
               labelnames: Tuple[str, ...] = ()) -> Gauge:
-        return self._get_or_create(Gauge, name, help, labelnames)
+        return cast(Gauge,
+                    self._get_or_create(Gauge, name, help, labelnames))
 
     def histogram(self, name: str, help: str,
                   labelnames: Tuple[str, ...] = (),
                   buckets: Iterable[float] = LATENCY_BUCKETS_S
                   ) -> Histogram:
-        return self._get_or_create(Histogram, name, help, labelnames,
-                                   buckets=buckets)
+        return cast(Histogram,
+                    self._get_or_create(Histogram, name, help,
+                                        labelnames, buckets=buckets))
 
     def on_collect(self, fn: Callable[[], None]) -> None:
         """Register a callback run at the top of every render() — the
@@ -454,7 +483,7 @@ class Registry:
 def parse_exposition(text: str) -> List[Tuple[str, Dict[str, str], float]]:
     """Parse exposition text into (name, labels, value) samples.
     Comment/blank lines are skipped; malformed sample lines raise."""
-    samples = []
+    samples: List[Tuple[str, Dict[str, str], float]] = []
     for line in text.splitlines():
         if not line or line.startswith("#"):
             continue
@@ -483,7 +512,7 @@ def _parse_sample(line: str) -> Tuple[str, Dict[str, str], float]:
                 raise ValueError(f"malformed labels in: {line!r}")
             ln = lm.group(1)
             i += lm.end()
-            buf = []
+            buf: List[str] = []
             while i < len(rest):
                 c = rest[i]
                 if c == "\\":

@@ -33,7 +33,19 @@ import signal
 import threading
 import time
 from collections import deque
-from typing import Dict, List, Optional
+from types import FrameType
+from typing import (
+    TYPE_CHECKING,
+    Deque,
+    Dict,
+    Iterable,
+    List,
+    Optional,
+)
+
+if TYPE_CHECKING:  # typing only: no runtime import-order coupling
+    from .core import Counter, Registry
+    from .trace import TraceContext
 
 log = logging.getLogger(__name__)
 
@@ -47,15 +59,15 @@ class Event:
                  "attrs")
 
     def __init__(self, name: str, trace_id: str = "", span_id: str = "",
-                 attrs: Optional[Dict[str, object]] = None):
+                 attrs: Optional[Dict[str, object]] = None) -> None:
         self.name = name
         self.trace_id = trace_id
         self.span_id = span_id
         self.t_wall = time.time()
         self.t_mono = time.monotonic()
-        self.attrs = attrs or {}
+        self.attrs: Dict[str, object] = attrs if attrs is not None else {}
 
-    def to_dict(self) -> dict:
+    def to_dict(self) -> Dict[str, object]:
         return {
             "name": self.name,
             "trace_id": self.trace_id,
@@ -66,7 +78,7 @@ class Event:
         }
 
 
-def _jsonable(v):
+def _jsonable(v: object) -> object:
     """Attrs must survive json.dumps in a signal-time dump; anything
     exotic degrades to its str() at RECORD time, not dump time."""
     if isinstance(v, (str, int, float, bool)) or v is None:
@@ -77,18 +89,20 @@ def _jsonable(v):
 class FlightRecorder:
     """Thread-safe bounded ring journal (see module docstring)."""
 
-    def __init__(self, capacity: int = DEFAULT_CAPACITY, registry=None):
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 registry: Optional["Registry"] = None) -> None:
         if capacity < 1:
             raise ValueError("recorder capacity must be >= 1")
         self.capacity = capacity
         self._lock = threading.Lock()
-        self._ring: deque = deque(maxlen=capacity)
+        self._ring: Deque[Event] = deque(maxlen=capacity)
         self._recorded = 0
         self._dropped = 0
         # loss is observable: the registry (when the owning surface has
         # one) carries the totals next to the latency histograms the
         # events annotate
-        self._m_events = self._m_dropped = None
+        self._m_events: Optional["Counter"] = None
+        self._m_dropped: Optional["Counter"] = None
         if registry is not None:
             self._m_events = registry.counter(
                 "tpu_flight_events_total",
@@ -102,8 +116,9 @@ class FlightRecorder:
 
     # -- write path ---------------------------------------------------------
 
-    def record(self, name: str, trace=None, trace_id: str = "",
-               span_id: str = "", **attrs) -> None:
+    def record(self, name: str, trace: Optional["TraceContext"] = None,
+               trace_id: str = "", span_id: str = "",
+               **attrs: object) -> None:
         """Append one event.  *trace* (a TraceContext) wins over the
         explicit id strings; attrs are sanitized to JSON scalars now so
         a SIGTERM-time dump can never fail on a live object."""
@@ -136,13 +151,13 @@ class FlightRecorder:
 
     def events(self, since: float = 0.0, trace_id: Optional[str] = None,
                name: Optional[str] = None,
-               limit: int = 1000) -> List[dict]:
+               limit: int = 1000) -> List[Dict[str, object]]:
         """Snapshot of matching events, oldest first.  *since* filters
         on wall time (the /debug/events?since= contract), *trace_id*
         on the stamped trace, *name* on the event name."""
         with self._lock:
             snap = list(self._ring)
-        out = []
+        out: List[Dict[str, object]] = []
         for ev in snap:
             if ev.t_wall <= since:
                 continue
@@ -153,7 +168,7 @@ class FlightRecorder:
             out.append(ev.to_dict())
         return out[-limit:] if limit else out
 
-    def trace_ids(self, limit: int = 64) -> List[dict]:
+    def trace_ids(self, limit: int = 64) -> List[Dict[str, object]]:
         """The most recent distinct trace ids with event counts —
         the /debug/traces index view."""
         with self._lock:
@@ -206,7 +221,8 @@ class FlightRecorder:
             return None
 
     def install_dump_handlers(self, dir_path: str,
-                              signals=(signal.SIGTERM,)) -> None:
+                              signals: Iterable[int] = (signal.SIGTERM,)
+                              ) -> None:
         """Dump the journal on process exit: atexit (clean exits and
         sys.exit paths), a CHAINING handler on each listed signal
         (k8s sends SIGTERM on pod shutdown), and a faulthandler file in
@@ -217,7 +233,7 @@ class FlightRecorder:
             return
         self._dump_installed = True
 
-        def _dump_once(_done=[False]):
+        def _dump_once(_done: List[bool] = [False]) -> None:
             if _done[0]:
                 return
             _done[0] = True
@@ -238,7 +254,8 @@ class FlightRecorder:
             try:
                 prev = signal.getsignal(sig)
 
-                def _handler(signum, frame, _prev=prev):
+                def _handler(signum: int, frame: Optional[FrameType],
+                             _prev: object = prev) -> None:
                     _dump_once()
                     if callable(_prev):
                         _prev(signum, frame)

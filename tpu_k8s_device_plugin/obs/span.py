@@ -41,9 +41,13 @@ import logging
 import threading
 import time
 from contextlib import contextmanager
-from typing import Dict, Optional
+from typing import TYPE_CHECKING, Dict, Iterator, Optional
 
 from .core import Histogram, escape_label_value
+
+if TYPE_CHECKING:  # typing only: no runtime import-order coupling
+    from .recorder import FlightRecorder
+    from .trace import TraceContext
 
 _default_log = logging.getLogger(__name__)
 
@@ -66,9 +70,9 @@ class Span:
                  labels: Optional[Dict[str, str]] = None,
                  logger: Optional[logging.Logger] = None,
                  level: int = logging.DEBUG,
-                 trace=None,
-                 recorder=None,
-                 slow_threshold_s: Optional[float] = None):
+                 trace: Optional["TraceContext"] = None,
+                 recorder: Optional["FlightRecorder"] = None,
+                 slow_threshold_s: Optional[float] = None) -> None:
         self.name = name
         self.histogram = histogram
         self.request_id = request_id
@@ -86,7 +90,7 @@ class Span:
         self._done = False
         self._notes: Dict[str, object] = {}
 
-    def annotate(self, **kv) -> "Span":
+    def annotate(self, **kv: object) -> "Span":
         """Attach extra key=value pairs to the eventual log line."""
         self._notes.update(kv)
         return self
@@ -101,7 +105,8 @@ class Span:
         threads)."""
         with self._lock:
             if self._done:
-                return self._notes.get("_duration", 0.0)  # type: ignore
+                prior = self._notes.get("_duration", 0.0)
+                return prior if isinstance(prior, float) else 0.0
             self._done = True
             dt = time.perf_counter() - self.t0
             self._notes["_duration"] = dt
@@ -159,9 +164,9 @@ def span(name: str,
          labels: Optional[Dict[str, str]] = None,
          logger: Optional[logging.Logger] = None,
          level: int = logging.DEBUG,
-         trace=None,
-         recorder=None,
-         slow_threshold_s: Optional[float] = None):
+         trace: Optional["TraceContext"] = None,
+         recorder: Optional["FlightRecorder"] = None,
+         slow_threshold_s: Optional[float] = None) -> Iterator[Span]:
     """Context-manager form: outcome=ok on clean exit, outcome=error
     (exception class name annotated) when the body raises."""
     sp = Span(name, histogram=histogram, request_id=request_id,

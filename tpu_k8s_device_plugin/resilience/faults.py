@@ -50,7 +50,10 @@ import os
 import random
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import TYPE_CHECKING, Dict, List, Optional
+
+if TYPE_CHECKING:  # typing only: the runtime stays stdlib-importable
+    from tpu_k8s_device_plugin.obs import FlightRecorder
 
 log = logging.getLogger(__name__)
 
@@ -64,7 +67,7 @@ class InjectedFault(Exception):
     """A fault fired by the injector (never raised in production
     configs: constructing one requires an installed spec)."""
 
-    def __init__(self, op: str, kind: str):
+    def __init__(self, op: str, kind: str) -> None:
         super().__init__(f"injected {kind} at {op}")
         self.op = op
         self.kind = kind
@@ -75,13 +78,14 @@ class FaultRule:
 
     __slots__ = ("op", "kind", "arg", "prob")
 
-    def __init__(self, op: str, kind: str, arg: float, prob: float):
+    def __init__(self, op: str, kind: str, arg: float,
+                 prob: float) -> None:
         self.op = op
         self.kind = kind
         self.arg = arg
         self.prob = prob
 
-    def __repr__(self):
+    def __repr__(self) -> str:
         return (f"FaultRule({self.op}:{self.kind}:{self.arg:g}"
                 f":{self.prob:g})")
 
@@ -89,7 +93,7 @@ class FaultRule:
 class FaultSpec:
     """A parsed ``--fault-spec`` string (rules in declaration order)."""
 
-    def __init__(self, rules: List[FaultRule], text: str = ""):
+    def __init__(self, rules: List[FaultRule], text: str = "") -> None:
         self.rules = rules
         self.text = text
 
@@ -145,7 +149,8 @@ class FaultInjector:
     so a chaos soak can assert exactly which faults landed.
     """
 
-    def __init__(self, spec: FaultSpec, seed: int = 0, recorder=None):
+    def __init__(self, spec: FaultSpec, seed: int = 0,
+                 recorder: Optional["FlightRecorder"] = None) -> None:
         self.spec = spec
         self.seed = seed
         self._rng = random.Random(seed)
@@ -202,7 +207,8 @@ def active() -> Optional[FaultInjector]:
 
 
 def install(spec_text: str, seed: int = 0,
-            recorder=None) -> Optional[FaultInjector]:
+            recorder: Optional["FlightRecorder"] = None
+            ) -> Optional[FaultInjector]:
     """Parse and arm *spec_text*; empty/blank disarms.  Returns the
     installed injector (None when disarmed)."""
     global ACTIVE
@@ -222,7 +228,8 @@ def uninstall() -> None:
     ACTIVE = None
 
 
-def install_from_env(recorder=None) -> Optional[FaultInjector]:
+def install_from_env(recorder: Optional["FlightRecorder"] = None
+                     ) -> Optional[FaultInjector]:
     """Arm from ``TPU_DP_FAULTS`` / ``TPU_DP_FAULT_SEED`` when set —
     the env path the DaemonSet and chaos subprocesses use."""
     spec = os.environ.get(ENV_FAULTS, "")

@@ -34,9 +34,23 @@ import logging
 import random
 import threading
 import time
-from typing import Callable, Optional, Tuple, Type
+from typing import (
+    TYPE_CHECKING,
+    Callable,
+    Dict,
+    List,
+    Optional,
+    Tuple,
+    Type,
+    TypeVar,
+)
+
+if TYPE_CHECKING:  # typing only: the runtime stays stdlib-importable
+    from tpu_k8s_device_plugin.obs import FlightRecorder, Registry
 
 log = logging.getLogger(__name__)
+
+_T = TypeVar("_T")
 
 # tpu_breaker_state{op} gauge values (documented in the metric help
 # text and docs/user-guide/resilience.md)
@@ -44,8 +58,9 @@ BREAKER_CLOSED = 0
 BREAKER_OPEN = 1
 BREAKER_HALF_OPEN = 2
 
-_STATE_NAMES = {BREAKER_CLOSED: "closed", BREAKER_OPEN: "open",
-                BREAKER_HALF_OPEN: "half_open"}
+_STATE_NAMES: Dict[int, str] = {
+    BREAKER_CLOSED: "closed", BREAKER_OPEN: "open",
+    BREAKER_HALF_OPEN: "half_open"}
 
 
 class CircuitOpenError(RuntimeError):
@@ -65,7 +80,7 @@ class ResilienceMetrics:
     once-silent ``except Exception: pass`` sites now increment.
     """
 
-    def __init__(self, registry):
+    def __init__(self, registry: "Registry") -> None:
         self.retries = registry.counter(
             "tpu_resilience_retries_total",
             "Retried attempts (attempt 2 and later) per operation.",
@@ -140,7 +155,7 @@ class RetryPolicy:
                  multiplier: float = 2.0,
                  jitter: float = 0.1,
                  deadline_s: float = 0.0,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None) -> None:
         if max_attempts < 1:
             raise ValueError("max_attempts must be >= 1")
         if not 0.0 <= jitter < 1.0:
@@ -164,11 +179,12 @@ class RetryPolicy:
             base *= 1.0 + self._rng.uniform(-self.jitter, self.jitter)
         return max(0.0, base)
 
-    def call(self, fn: Callable, *, op: str,
+    def call(self, fn: Callable[[], _T], *, op: str,
              retry_on: Tuple[Type[BaseException], ...] = (Exception,),
              stop: Optional[threading.Event] = None,
              metrics: Optional[ResilienceMetrics] = None,
-             recorder=None, logger: Optional[logging.Logger] = None):
+             recorder: Optional["FlightRecorder"] = None,
+             logger: Optional[logging.Logger] = None) -> _T:
         """Run *fn* under this policy.  Exceptions outside *retry_on*
         propagate immediately; the final retryable failure propagates
         after the budget is spent.  *stop* aborts the backoff sleep
@@ -229,8 +245,8 @@ class CircuitBreaker:
                  failure_threshold: int = 3,
                  reset_timeout_s: float = 30.0,
                  metrics: Optional[ResilienceMetrics] = None,
-                 recorder=None,
-                 logger: Optional[logging.Logger] = None):
+                 recorder: Optional["FlightRecorder"] = None,
+                 logger: Optional[logging.Logger] = None) -> None:
         if failure_threshold < 1:
             raise ValueError("failure_threshold must be >= 1")
         self.op = op
@@ -299,7 +315,7 @@ class CircuitBreaker:
                 self._opened_at = time.monotonic()
                 self._transition(BREAKER_OPEN)
 
-    def call(self, fn: Callable):
+    def call(self, fn: Callable[[], _T]) -> _T:
         """Run *fn* through the breaker: :class:`CircuitOpenError`
         when open, outcome recorded otherwise.  BaseExceptions
         (KeyboardInterrupt) pass through without counting."""
@@ -332,8 +348,8 @@ class Watchdog:
 
     def __init__(self, op: str, timeout_s: float,
                  metrics: Optional[ResilienceMetrics] = None,
-                 recorder=None,
-                 logger: Optional[logging.Logger] = None):
+                 recorder: Optional["FlightRecorder"] = None,
+                 logger: Optional[logging.Logger] = None) -> None:
         if timeout_s <= 0:
             raise ValueError("watchdog timeout must be > 0")
         self.op = op
@@ -342,15 +358,17 @@ class Watchdog:
         self._recorder = recorder
         self._log = logger or log
 
-    def call(self, fn: Callable):
-        box: list = []
+    def call(self, fn: Callable[[], _T]) -> _T:
+        results: List[_T] = []
+        errors: List[BaseException] = []
         done = threading.Event()
 
-        def run():
+        def run() -> None:
             try:
-                box.append((True, fn()))
-            except BaseException as e:  # propagated, not swallowed
-                box.append((False, e))
+                results.append(fn())
+            # tpulint: disable=R2 -- not a swallow: the exception is re-raised to the waiter below
+            except BaseException as e:
+                errors.append(e)
             finally:
                 done.set()
 
@@ -368,7 +386,6 @@ class Watchdog:
                                       timeout_s=self.timeout_s)
             raise WatchdogTimeout(
                 f"{self.op} exceeded {self.timeout_s:.1f}s watchdog")
-        ok, value = box[0]
-        if ok:
-            return value
-        raise value
+        if errors:
+            raise errors[0]
+        return results[0]

@@ -22,7 +22,7 @@ import socket
 import threading
 import time
 import uuid
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import grpc
 
@@ -33,6 +33,7 @@ from tpu_k8s_device_plugin.proto import (
 )
 from tpu_k8s_device_plugin.resilience import faults
 from tpu_k8s_device_plugin.types import constants
+from .metrics import SliceMetrics
 from .state import Membership, load_membership, save_membership
 
 log = logging.getLogger(__name__)
@@ -53,7 +54,8 @@ _HB_BREAKER_RESET_S = 30.0
 _TRANSIENT = (grpc.RpcError, faults.InjectedFault)
 
 
-def _trace_metadata(trace):
+def _trace_metadata(trace: Optional[obs.TraceContext]
+                    ) -> Tuple[Tuple[str, str], ...]:
     """gRPC metadata carrying the W3C traceparent (the HTTP header's
     metadata analog), or () when the caller runs untraced."""
     if trace is None:
@@ -61,7 +63,14 @@ def _trace_metadata(trace):
     return (("traceparent", trace.to_traceparent()),)
 
 
-def _membership_from_msg(m: slicepb.Membership) -> Optional[Membership]:
+def _rpc_status_code(e: BaseException) -> Optional[Any]:
+    """The grpc status code of an RpcError, or None for non-RPC faults
+    (an InjectedFault carries no code)."""
+    code = getattr(e, "code", None)
+    return code() if callable(code) else None
+
+
+def _membership_from_msg(m: Any) -> Optional[Membership]:
     if not m.hostnames:
         return None
     return Membership(
@@ -83,14 +92,14 @@ class SliceClient:
         chip_count: int = 0,
         state_path: Optional[str] = constants.SLICE_STATE_FILE,
         local_health_fn: Optional[LocalHealthFn] = None,
-        registry=None,
-        recorder=None,
+        registry: Optional[obs.Registry] = None,
+        recorder: Optional[obs.FlightRecorder] = None,
         join_backoff_initial_s: float = _JOIN_BACKOFF_INITIAL_S,
         join_backoff_max_s: float = _JOIN_BACKOFF_MAX_S,
         rpc_timeout_s: float = _RPC_TIMEOUT_S,
         breaker_reset_s: float = _HB_BREAKER_RESET_S,
         seed: int = 0,
-    ):
+    ) -> None:
         self._address = rendezvous_address
         self.hostname = hostname or socket.gethostname()
         self._rpc_timeout_s = rpc_timeout_s
@@ -110,13 +119,11 @@ class SliceClient:
         # transitions, and this host's own heartbeat age (refreshed at
         # scrape time).  On the rendezvous host the coordinator shares
         # the registry, so instrument families dedupe onto one set.
-        self.metrics = None
+        self.metrics: Optional[SliceMetrics] = None
         self._last_beat: Optional[float] = None
         self._join_started: Optional[float] = None
-        self._res_metrics = None
+        self._res_metrics: Optional[resilience.ResilienceMetrics] = None
         if registry is not None:
-            from .metrics import SliceMetrics
-
             self.metrics = SliceMetrics(registry)
             self._res_metrics = resilience.ResilienceMetrics(registry)
             registry.on_collect(self._refresh_age)
@@ -185,7 +192,8 @@ class SliceClient:
                                       logger=log,
                                       metrics=self._res_metrics)
 
-    def _join_once(self, trace=None) -> Optional[Membership]:
+    def _join_once(self, trace: Optional[obs.TraceContext] = None
+                   ) -> Optional[Membership]:
         """One Join poll; returns the membership when formed.  *trace*
         rides the gRPC metadata as a ``traceparent`` entry so the
         coordinator's join span shares this member's trace."""
@@ -227,12 +235,14 @@ class SliceClient:
             try:
                 membership = self._join_once(trace=join_trace)
             except _TRANSIENT as e:
-                code = e.code() if hasattr(e, "code") else None
+                code = _rpc_status_code(e)
                 if code == grpc.StatusCode.FAILED_PRECONDITION:
                     # mis-sized slice or hostname drift: retrying cannot
                     # fix it, surface the coordinator's explanation
+                    details = getattr(e, "details", None)
                     raise RuntimeError(
-                        f"slice join rejected: {e.details()}"
+                        "slice join rejected: "
+                        f"{details() if callable(details) else e}"
                     ) from e
                 log.info("rendezvous %s unreachable (%s); retrying",
                          self._address, code if code is not None else e)
@@ -253,7 +263,8 @@ class SliceClient:
                 break
         raise RuntimeError("slice client stopped before the slice formed")
 
-    def _adopt(self, membership: Membership, trace=None) -> None:
+    def _adopt(self, membership: Membership,
+               trace: Optional[obs.TraceContext] = None) -> None:
         with self._lock:
             prior = self._membership
             self._membership = membership
@@ -285,7 +296,8 @@ class SliceClient:
 
     # -- heartbeat ----------------------------------------------------------
 
-    def heartbeat_now(self, trace=None) -> None:
+    def heartbeat_now(self, trace: Optional[obs.TraceContext] = None
+                      ) -> None:
         """One synchronous heartbeat: probe local health, report it, learn
         the slice verdict.  Joins first if the slice hasn't formed yet (a
         single non-blocking attempt).  Called from the manager's pulse
@@ -302,11 +314,13 @@ class SliceClient:
                       self._address)
             return
         try:
-            if self.membership is None:
-                membership = self._join_once(trace=ctx)
-                if membership is None:
+            current = self.membership
+            if current is None:
+                joined = self._join_once(trace=ctx)
+                if joined is None:
                     return
-                self._adopt(membership, trace=ctx)
+                self._adopt(joined, trace=ctx)
+                current = joined
             healthy, reason = True, ""
             if self._local_health_fn is not None:
                 try:
@@ -325,7 +339,7 @@ class SliceClient:
                     hostname=self.hostname,
                     healthy=healthy,
                     reason=reason,
-                    generation=self.membership.generation,
+                    generation=current.generation,
                 ),
                 timeout=self._rpc_timeout_s,
                 metadata=_trace_metadata(ctx),
@@ -336,9 +350,10 @@ class SliceClient:
             # every node's devices); keep the last verdict and let the
             # coordinator's own staleness tracking judge us.
             self._hb_breaker.record_failure()
+            code = _rpc_status_code(e)
             log.warning("slice heartbeat to %s failed: %s",
                         self._address,
-                        e.code() if hasattr(e, "code") else e)
+                        code if code is not None else e)
             return
         self._hb_breaker.record_success()
         fresh = _membership_from_msg(resp.membership)
@@ -383,7 +398,7 @@ class SliceClient:
         if self._thread is not None:
             return self
 
-        def loop():
+        def loop() -> None:
             while not self._stop.is_set():
                 self.heartbeat_now()
                 if self._stop.wait(period_s):

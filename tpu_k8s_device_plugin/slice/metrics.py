@@ -40,7 +40,7 @@ from tpu_k8s_device_plugin import obs
 class SliceMetrics:
     """The slice instrument set on one registry (see module docstring)."""
 
-    def __init__(self, registry: Optional[obs.Registry] = None):
+    def __init__(self, registry: Optional[obs.Registry] = None) -> None:
         reg = registry if registry is not None else obs.Registry()
         self.registry = reg
         self.join_seconds = reg.histogram(

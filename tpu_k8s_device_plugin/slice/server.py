@@ -13,11 +13,11 @@ import concurrent.futures
 import logging
 import threading
 import time
-from typing import Optional
+from typing import Any, Optional
 
 import grpc
 
-from tpu_k8s_device_plugin import obs
+from tpu_k8s_device_plugin import obs, resilience
 from tpu_k8s_device_plugin.proto import (
     slice_pb2 as slicepb,
     slice_pb2_grpc as slicepb_grpc,
@@ -28,7 +28,7 @@ from .state import Membership, SliceState
 log = logging.getLogger(__name__)
 
 
-def _trace_from_context(context):
+def _trace_from_context(context: Any) -> obs.TraceContext:
     """Continue the member's trace from the RPC metadata (the client
     sends a ``traceparent`` entry — the gRPC analog of the HTTP
     header), or open a fresh root for untraced callers."""
@@ -38,12 +38,15 @@ def _trace_from_context(context):
             if key == "traceparent":
                 header = value
                 break
-    except Exception:  # metadata access is best-effort, never fatal
-        pass
+    except Exception as e:
+        # metadata access is best-effort, never fatal — but the
+        # swallow is accounted (tpu_suppressed_errors_total) so a
+        # flood of malformed metadata stays visible
+        resilience.suppressed("slice.trace_metadata", e, logger=log)
     return obs.trace_from_header(header)
 
 
-def _membership_msg(m: Optional[Membership]) -> slicepb.Membership:
+def _membership_msg(m: Optional[Membership]) -> Any:
     if m is None:
         return slicepb.Membership()
     return slicepb.Membership(
@@ -57,12 +60,12 @@ def _membership_msg(m: Optional[Membership]) -> slicepb.Membership:
 
 class _Servicer(slicepb_grpc.SliceRendezvousServicer):
     def __init__(self, state: SliceState, lock: threading.Lock,
-                 recorder=None):
+                 recorder: Optional[obs.FlightRecorder] = None) -> None:
         self._state = state
         self._lock = lock
         self._recorder = recorder
 
-    def Join(self, request, context):
+    def Join(self, request: Any, context: Any) -> Any:
         # the member's trace rides the RPC metadata: the coordinator's
         # join record shares it, so one id greps across both hosts
         trace = _trace_from_context(context)
@@ -104,7 +107,7 @@ class _Servicer(slicepb_grpc.SliceRendezvousServicer):
             membership=_membership_msg(res.membership),
         )
 
-    def Heartbeat(self, request, context):
+    def Heartbeat(self, request: Any, context: Any) -> Any:
         trace = _trace_from_context(context)
         with self._lock:
             view = self._state.heartbeat(
@@ -140,9 +143,9 @@ class SliceCoordinator:
         jax_port: int = constants.SLICE_JAX_COORDINATOR_PORT,
         state_path: Optional[str] = constants.SLICE_STATE_FILE,
         heartbeat_timeout_s: float = constants.SLICE_HEARTBEAT_TIMEOUT_S,
-        registry=None,
-        recorder=None,
-    ):
+        registry: Optional[obs.Registry] = None,
+        recorder: Optional[obs.FlightRecorder] = None,
+    ) -> None:
         self._lock = threading.Lock()
         # flight recorder (PR 4): join/heartbeat events land here with
         # each MEMBER'S trace-id from the RPC metadata — the
@@ -153,10 +156,10 @@ class SliceCoordinator:
         # refreshing per-member heartbeat ages.  The CLI passes the
         # plugin manager's registry so the debug /metrics scrape on the
         # rendezvous host carries the whole slice's coordination state.
-        self.metrics = None
-        if registry is not None:
-            from .metrics import SliceMetrics
+        from .metrics import SliceMetrics
 
+        self.metrics: Optional[SliceMetrics] = None
+        if registry is not None:
             self.metrics = SliceMetrics(registry)
         self.state = SliceState(
             expected_workers=expected_workers,
@@ -167,7 +170,7 @@ class SliceCoordinator:
             metrics=self.metrics,
         )
         if registry is not None:
-            def _refresh():
+            def _refresh() -> None:
                 with self._lock:
                     self.state.refresh_ages(time.monotonic())
 

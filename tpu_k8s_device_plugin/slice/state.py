@@ -1,3 +1,4 @@
+# tpulint: deterministic-path -- the engine equivalence suites replay this file's decisions from seeds; D1 bans bare random/time.time() here
 """Rendezvous state machine + crash-safe membership persistence.
 
 Pure logic layer: no gRPC, no wall clock (callers inject ``now``), so the
@@ -21,7 +22,18 @@ import logging
 import os
 import tempfile
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import (
+    TYPE_CHECKING,
+    Any,
+    Dict,
+    List,
+    Optional,
+    Set,
+    Tuple,
+)
+
+if TYPE_CHECKING:  # typing only: this layer stays pure/metrics-free
+    from .metrics import SliceMetrics
 
 log = logging.getLogger(__name__)
 
@@ -47,7 +59,7 @@ class Membership:
         except ValueError:
             return None
 
-    def to_dict(self) -> dict:
+    def to_dict(self) -> Dict[str, Any]:
         return {
             "version": _STATE_VERSION,
             "slice_id": self.slice_id,
@@ -57,7 +69,7 @@ class Membership:
         }
 
     @classmethod
-    def from_dict(cls, d: dict) -> "Membership":
+    def from_dict(cls, d: Dict[str, Any]) -> "Membership":
         return cls(
             slice_id=str(d["slice_id"]),
             generation=int(d["generation"]),
@@ -158,8 +170,8 @@ class SliceState:
         state_path: Optional[str] = None,
         heartbeat_timeout_s: float = 0.0,
         epoch: float = 0.0,
-        metrics=None,
-    ):
+        metrics: Optional["SliceMetrics"] = None,
+    ) -> None:
         if expected_workers < 1:
             raise ValueError(f"expected_workers must be >= 1, got "
                              f"{expected_workers}")
@@ -183,7 +195,7 @@ class SliceState:
         # in which that member still advertised Healthy devices
         self._last_verdict: Optional[bool] = None
         self._demoted_at: float = 0.0
-        self._awaiting_delivery: set = set()
+        self._awaiting_delivery: Set[str] = set()
         if state_path:
             prior = load_membership(state_path)
             if prior is not None:

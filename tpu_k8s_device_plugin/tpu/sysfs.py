@@ -2,7 +2,10 @@
 
 from __future__ import annotations
 
+import logging
 import os
+
+log = logging.getLogger(__name__)
 
 
 def _native():
@@ -13,7 +16,12 @@ def _native():
         try:
             from tpu_k8s_device_plugin.hostinfo import tpuprobe
             _NATIVE = tpuprobe
-        except Exception:
+        except Exception as e:
+            # expected on hosts without a toolchain: the pure-python
+            # fallback below IS the handling, but the reason must not
+            # vanish (tpulint R2)
+            log.debug("native tpuprobe shim unavailable (%s); using "
+                      "portable sysfs parsing", e)
             _NATIVE = None
     return _NATIVE
 

@@ -105,7 +105,12 @@ def _step_flops(step, *args):
             float(flops) if flops and flops > 0 else None,
             compiled,
         )
-    except Exception:
+    except Exception as e:
+        # no AOT/cost-analysis on this backend: MFU is simply omitted
+        # from the report, but say why instead of swallowing (tpulint
+        # R2) — a bench that silently drops a column looks healthy
+        print(f"# cost_analysis unavailable ({type(e).__name__}: {e}); "
+              "skipping FLOPs/MFU")
         return None, None
 
 

@@ -1,3 +1,4 @@
+# tpulint: deterministic-path -- the engine equivalence suites replay this file's decisions from seeds; D1 bans bare random/time.time() here
 """Iteration-level scheduler: chunked prefill interleaved with decode.
 
 BASELINE §ROUND-6 priced the HTTP front door's remaining ~0.45× gap

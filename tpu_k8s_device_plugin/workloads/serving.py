@@ -1,3 +1,4 @@
+# tpulint: deterministic-path -- the engine equivalence suites replay this file's decisions from seeds; D1 bans bare random/time.time() here
 """Slot-based continuous batching on the KV-cache decode engine.
 
 What vLLM does for the reference's serving example
