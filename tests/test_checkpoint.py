@@ -164,6 +164,97 @@ def test_restore_onto_different_mesh_shape(tmp_path):
     assert np.isfinite(float(loss))
 
 
+def test_torn_checkpoints_skipped_not_fatal(tmp_path):
+    """Crash-safety satellite: torn/partial step dirs — an interrupted
+    external copy, a truncated metadata file, an empty dir — are
+    SKIPPED by latest_step/list_steps/restore_checkpoint, never raised
+    on; the newest WHOLE checkpoint wins."""
+    import shutil
+
+    _, params, _, _ = _setup()
+    for s in (1, 3):
+        save_checkpoint(str(tmp_path), s, {"params": params})
+    assert latest_step(str(tmp_path)) == 3
+
+    # torn variant 1: an empty step dir (mkdir happened, nothing else)
+    os.makedirs(tmp_path / "step_5")
+    # torn variant 2: a truncated copy — every file cut to 1 byte,
+    # including the orbax metadata (rsync died early)
+    shutil.copytree(tmp_path / "step_3", tmp_path / "step_7")
+    for root, _, files in os.walk(tmp_path / "step_7"):
+        for name in files:
+            with open(os.path.join(root, name), "r+b") as f:
+                f.truncate(1)
+
+    assert list_steps(str(tmp_path)) == [1, 3]
+    assert latest_step(str(tmp_path)) == 3
+    restored = restore_checkpoint(str(tmp_path),
+                                  template={"params": params})
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["final_norm"]["scale"]),
+        np.asarray(params["final_norm"]["scale"]))
+
+    # torn variant 3: metadata intact but array payloads truncated —
+    # structurally complete, so restore must FALL BACK to the next
+    # older whole checkpoint instead of raising
+    shutil.copytree(tmp_path / "step_3", tmp_path / "step_9")
+    for root, _, files in os.walk(tmp_path / "step_9"):
+        for name in files:
+            if name in ("_CHECKPOINT_METADATA", "_METADATA"):
+                continue
+            with open(os.path.join(root, name), "r+b") as f:
+                f.truncate(1)
+    restored = restore_checkpoint(str(tmp_path),
+                                  template={"params": params})
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["final_norm"]["scale"]),
+        np.asarray(params["final_norm"]["scale"]))
+    # an EXPLICIT step still addresses exactly what was asked for
+    with pytest.raises(FileNotFoundError):
+        restore_checkpoint(str(tmp_path), step=4,
+                           template={"params": params})
+
+
+def test_save_commits_atomically(tmp_path):
+    """A crash mid-save must leave no step dir at all (the temp dir is
+    the only casualty, swept by the next save) — the commit is the
+    final rename."""
+    import orbax.checkpoint as ocp
+
+    from tpu_k8s_device_plugin.workloads import checkpoint as ckpt_mod
+
+    _, params, _, _ = _setup()
+
+    real_save = ocp.PyTreeCheckpointer.save
+    calls = {"n": 0}
+
+    def exploding_save(self, path, *a, **k):
+        calls["n"] += 1
+        real_save(self, path, *a, **k)
+        raise RuntimeError("SIGKILL stand-in after the tree write")
+
+    ocp.PyTreeCheckpointer.save = exploding_save
+    try:
+        with pytest.raises(RuntimeError, match="SIGKILL stand-in"):
+            save_checkpoint(str(tmp_path), 4, {"params": params})
+    finally:
+        ocp.PyTreeCheckpointer.save = real_save
+    assert calls["n"] == 1
+    assert list_steps(str(tmp_path)) == []
+    assert not any(
+        name.startswith("step_") for name in os.listdir(tmp_path)
+    ), "no torn step dir may survive a crashed save"
+
+    # the next save sweeps any leftover temp dir and lands whole
+    (tmp_path / f"{ckpt_mod._TMP_PREFIX}orphan").mkdir()
+    save_checkpoint(str(tmp_path), 4, {"params": params})
+    assert list_steps(str(tmp_path)) == [4]
+    assert not any(
+        name.startswith(ckpt_mod._TMP_PREFIX)
+        for name in os.listdir(tmp_path)
+    ), "orphaned temp dirs must be swept"
+
+
 def test_quantize_after_restore_serves(tmp_path):
     # the serving handoff: restore a trained tree, quantize, decode
     from tpu_k8s_device_plugin.workloads.inference import (

@@ -111,6 +111,47 @@ class TestGenerators:
         assert labels[f"{base}.slice-id"] == "abc123def456"
         assert labels[f"{base}.slice-rank"] == "1"
 
+    def test_slice_shape_labels_track_reshape(self, testdata, tmp_path,
+                                              monkeypatch):
+        """Gang schedulers place against the REAL topology: generation,
+        current worker count, and the degraded flag all come from the
+        membership file and move when the slice reshapes."""
+        from tpu_k8s_device_plugin.slice import Membership, save_membership
+
+        monkeypatch.setattr("socket.gethostname", lambda: "host-a")
+        root = os.path.join(testdata, "v5e-16-host0")
+        kwargs = dict(
+            driver_type=constants.CONTAINER,
+            sysfs_root=os.path.join(root, "sys"),
+            dev_root=os.path.join(root, "dev"),
+            tpu_env_path=os.path.join(root, "run", "tpu", "tpu-env"),
+        )
+        base = constants.LABEL_PREFIX
+        state = tmp_path / "membership.json"
+        save_membership(str(state), Membership(
+            slice_id="aaa111", generation=1,
+            hostnames=("host-a", "host-b"),
+            coordinator_address="host-a:8476",
+        ))
+        labels = generate_labels(LabelContext.collect(
+            slice_state_path=str(state), **kwargs))
+        assert labels[f"{base}.slice-generation"] == "1"
+        assert labels[f"{base}.slice-workers"] == "2"
+        assert labels[f"{base}.slice-degraded"] == "false"
+
+        # host-b evicted: survivors re-formed into a degraded gen 2
+        save_membership(str(state), Membership(
+            slice_id="bbb222", generation=2, hostnames=("host-a",),
+            coordinator_address="host-a:8476",
+            reshaped_from=("aaa111",), degraded=True,
+        ))
+        labels = generate_labels(LabelContext.collect(
+            slice_state_path=str(state), **kwargs))
+        assert labels[f"{base}.slice-id"] == "bbb222"
+        assert labels[f"{base}.slice-generation"] == "2"
+        assert labels[f"{base}.slice-workers"] == "1"
+        assert labels[f"{base}.slice-degraded"] == "true"
+
     def test_v5p_partitioned_host(self, testdata):
         labels = generate_labels(ctx_for(testdata, "v5p-8-core"))
         base = constants.LABEL_PREFIX
@@ -348,6 +389,58 @@ class TestController:
         n = len(fake_api.patches)
         assert c.reconcile() == {}
         assert len(fake_api.patches) == n
+
+    def test_dissolved_slice_clears_stale_labels_on_node(
+        self, testdata, fake_api, tmp_path, monkeypatch
+    ):
+        """Satellite: when the membership file disappears (slice
+        dissolved / state mount wiped), the next reconcile must
+        actively PATCH the stale slice-* labels off the Node — a gang
+        scheduler must never place against a slice that no longer
+        exists."""
+        from tpu_k8s_device_plugin.slice import Membership, save_membership
+
+        monkeypatch.setattr("socket.gethostname", lambda: "host-a")
+        root = os.path.join(testdata, "v5e-16-host0")
+        kwargs = dict(
+            driver_type=constants.CONTAINER,
+            sysfs_root=os.path.join(root, "sys"),
+            dev_root=os.path.join(root, "dev"),
+            tpu_env_path=os.path.join(root, "run", "tpu", "tpu-env"),
+        )
+        state = tmp_path / "membership.json"
+        save_membership(str(state), Membership(
+            slice_id="abc123def456", generation=3,
+            hostnames=("host-a", "host-b"),
+            coordinator_address="host-a:8476",
+        ))
+        compute = lambda: generate_labels(LabelContext.collect(
+            slice_state_path=str(state), **kwargs))
+        c = NodeLabelController(
+            NodeClient(base_url=fake_api.url), "test-node", compute
+        )
+        c.reconcile()
+        applied = fake_api.node["metadata"]["labels"]
+        base = constants.LABEL_PREFIX
+        slice_keys = [
+            f"{prefix}.{key}"
+            for prefix in (base, constants.LABEL_PREFIX_BETA)
+            for key in ("slice-id", "slice-rank", "slice-generation",
+                        "slice-workers", "slice-degraded")
+        ]
+        for key in slice_keys:
+            assert key in applied, key
+        assert applied[f"{base}.slice-id"] == "abc123def456"
+
+        # the slice dissolves: membership file gone
+        os.unlink(state)
+        delta = c.reconcile()
+        for key in slice_keys:
+            assert delta[key] is None, key
+            assert key not in fake_api.node["metadata"]["labels"], key
+        # non-slice labels are untouched
+        assert fake_api.node["metadata"]["labels"][
+            f"{base}.topology"] == "4x4"
 
     def test_reconcile_recomputes(self, testdata, fake_api):
         """Labels must track live state (the reference computes once at

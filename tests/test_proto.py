@@ -136,14 +136,18 @@ def test_slice_roundtrip_and_service_shape():
     jr = spb.JoinResponse(
         formed=True, rank=1, joined=2, expected=2,
         membership=spb.Membership(
-            slice_id="abc123", generation=1, num_workers=2,
+            slice_id="abc123", generation=2, num_workers=2,
             hostnames=["host-a", "host-b"],
             coordinator_address="host-a:8476",
+            reshaped_from=["def456"], degraded=True,
         ),
     )
     jr2 = spb.JoinResponse.FromString(jr.SerializeToString())
     assert jr2.rank == 1 and tuple(jr2.membership.hostnames) == (
         "host-a", "host-b")
+    # reshape lineage rides the wire (fields 6/7, PR 8)
+    assert tuple(jr2.membership.reshaped_from) == ("def456",)
+    assert jr2.membership.degraded is True
 
     hb = spb.HeartbeatRequest(hostname="host-b", healthy=False,
                               reason="chip_state=dead", generation=1)

@@ -11,6 +11,7 @@ is exactly the conjunction of member health.  CI sweeps this with
 several ENGINE_FUZZ_SEED values (see .github/workflows/test.yml).
 """
 
+import json
 import os
 import random
 
@@ -126,3 +127,81 @@ def test_rendezvous_fuzz(tmp_path):
                          session=f"{h}-reborn", now=now)
         assert res.formed and res.rank == expected.index(h)
         assert state.membership == membership
+
+
+def test_reshape_determinism_fuzz(tmp_path):
+    """Reshape must be a pure function of WHO died, never of heartbeat
+    interleaving: several independent coordinator replicas see the same
+    formation and the same member death, but drive survivor heartbeats
+    in different random orders — every replica (including one
+    crash-recovered from its state file mid-flight) must converge on a
+    byte-identical reshaped Membership: same ranks, same generation,
+    same lineage."""
+    rnd = random.Random(SEED ^ 0x5E5A9E)
+    for round_i in range(ROUNDS):
+        n = rnd.randint(2, 6)
+        hosts = [f"host-{i:02d}" for i in range(n)]
+        coord_vals = list(range(n))
+        rnd.shuffle(coord_vals)
+        specs = {
+            h: ((coord_vals[i],) if rnd.random() < 0.7 else ())
+            for i, h in enumerate(hosts)
+        }
+        join_order = list(hosts)
+        rnd.shuffle(join_order)
+        victim = rnd.choice(hosts)
+        survivors = [h for h in hosts if h != victim]
+        grace, timeout = 3.0, 5.0
+
+        replicas = []
+        for j in range(3):
+            st = SliceState(
+                n, _JAX_PORT,
+                state_path=str(tmp_path / f"r{round_i}-c{j}.json"),
+                heartbeat_timeout_s=timeout, reshape_grace_s=grace)
+            # identical formation on every replica
+            for h in join_order:
+                st.join(h, coords=specs[h], chip_count=8,
+                        session=f"{h}-s0", now=0.0)
+            assert st.membership is not None
+            replicas.append(st)
+        gen1 = replicas[0].membership
+        assert all(r.membership == gen1 for r in replicas)
+
+        # replica 2 additionally crashes and recovers mid-flight: the
+        # reshaped result must still match (coords persisted)
+        replicas[2] = SliceState(
+            n, _JAX_PORT,
+            state_path=str(tmp_path / f"r{round_i}-c2.json"),
+            heartbeat_timeout_s=timeout, reshape_grace_s=grace)
+        assert replicas[2].membership == gen1
+
+        # the victim dies at t=0; survivors heartbeat at the SAME
+        # timestamps on every replica but in per-replica random order
+        for t in (6.0, 8.0, 9.5):
+            for st in replicas:
+                order = list(survivors)
+                rnd.shuffle(order)
+                for h in order:
+                    st.heartbeat(h, healthy=True, now=t)
+
+        dumps = []
+        for st in replicas:
+            m = st.membership
+            assert m is not None
+            if len(survivors) >= 1:
+                assert m.generation == gen1.generation + 1, (
+                    round_i, victim, m)
+                assert set(m.hostnames) == set(survivors)
+                assert m.reshaped_from == (gen1.slice_id,)
+                assert m.degraded
+                # ranks contiguous in the documented order over the
+                # surviving member set
+                expected = sorted(
+                    survivors,
+                    key=lambda h: (0, specs[h], h) if specs[h]
+                    else (1, (), h))
+                assert list(m.hostnames) == expected
+            dumps.append(json.dumps(m.to_dict(), sort_keys=True))
+        assert len(set(dumps)) == 1, (
+            f"round {round_i}: replicas diverged:\n" + "\n".join(dumps))

@@ -1,0 +1,399 @@
+"""Elastic slices: degraded-mode reshape instead of demote-all.
+
+State-machine level: with a reshape grace configured, an unhealthy
+verdict opens a bounded window — recovery inside it cancels (the
+original generation holds, demote-all semantics meanwhile), expiry
+evicts the still-unhealthy members and re-forms the survivors into a
+smaller valid slice under the next generation, with contiguous ranks in
+the same deterministic coords-then-hostname order, a ``reshaped_from``
+lineage, and crash-safe persistence.  A returning member joins the NEXT
+generation, never resurrecting the old one.  With the default grace of
+0, behavior is bit-for-bit the old demote-all (tests/test_slice.py runs
+unchanged against it).
+
+Client/gRPC level: the survivor adopts the new generation atomically
+and re-emits the TPU_WORKER_ID/TPU_WORKER_HOSTNAMES/JAX_* identity
+contract for the new shape; an evicted host answers standalone health
+(overlay None) and rejoins the next generation once locally healthy;
+the transition is journaled and metered through the obs machinery.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from tools.promlint import lint
+from tpu_k8s_device_plugin import obs
+from tpu_k8s_device_plugin.slice import (
+    SliceClient,
+    SliceCoordinator,
+    SliceMetrics,
+    SliceState,
+    load_membership,
+)
+from tpu_k8s_device_plugin.types import constants
+from tpu_k8s_device_plugin.workloads.checkpoint import ReshapeSignal
+
+_JAX_PORT = 8476
+
+
+def _form_three(state, now=0.0):
+    for i, h in enumerate(("host-a", "host-b", "host-c")):
+        state.join(h, coords=(i,), chip_count=8, session=f"{h}-s0",
+                   now=now)
+    assert state.membership is not None
+    return state.membership
+
+
+class TestStateMachine:
+    def test_default_grace_preserves_demote_all(self):
+        """grace 0 (the default): a member unhealthy forever demotes the
+        slice forever — no eviction, no new generation, the bit-for-bit
+        pre-reshape contract."""
+        s = SliceState(2, _JAX_PORT, heartbeat_timeout_s=5.0)
+        s.join("host-a", coords=(0,), now=0.0)
+        s.join("host-b", coords=(1,), now=0.0)
+        gen1 = s.membership
+        for t in range(10, 1000, 50):
+            v = s.heartbeat("host-a", healthy=True, now=float(t))
+            assert not v.slice_healthy
+            assert v.unhealthy_hostnames == ["host-b"]
+        assert s.membership == gen1
+        assert s.membership.generation == 1
+
+    def test_reshape_after_grace_expiry(self, tmp_path):
+        path = str(tmp_path / "membership.json")
+        s = SliceState(3, _JAX_PORT, state_path=path,
+                       heartbeat_timeout_s=5.0, reshape_grace_s=3.0)
+        gen1 = _form_three(s)
+        assert not gen1.degraded and gen1.reshaped_from == ()
+        # host-c goes silent; the survivors keep beating
+        v = s.heartbeat("host-a", True, now=6.0)   # window opens
+        assert not v.slice_healthy, "demote-all holds inside the window"
+        v = s.heartbeat("host-b", True, now=8.0)   # still inside grace
+        assert not v.slice_healthy
+        assert v.membership.generation == 1
+        v = s.heartbeat("host-a", True, now=9.5)   # grace expired
+        assert v.slice_healthy, "survivors re-promoted after the reshape"
+        m = s.membership
+        assert m.generation == 2
+        assert m.hostnames == ("host-a", "host-b")
+        assert m.rank_of("host-a") == 0 and m.rank_of("host-b") == 1
+        assert m.coordinator_address == f"host-a:{_JAX_PORT}"
+        assert m.reshaped_from == (gen1.slice_id,)
+        assert m.degraded
+        # crash-safe: the state file carries the reshaped generation
+        assert load_membership(path) == m
+
+    def test_flap_back_inside_grace_cancels(self):
+        metrics = SliceMetrics()
+        s = SliceState(2, _JAX_PORT, heartbeat_timeout_s=5.0,
+                       reshape_grace_s=10.0, metrics=metrics)
+        s.join("host-a", coords=(0,), now=0.0)
+        s.join("host-b", coords=(1,), now=0.0)
+        gen1 = s.membership
+        v = s.heartbeat("host-a", True, now=6.0)   # b stale, window opens
+        assert not v.slice_healthy
+        v = s.heartbeat("host-b", True, now=8.0)   # flaps back in grace
+        assert v.slice_healthy
+        assert s.membership == gen1, "original generation holds"
+        samples = obs.parse_exposition(metrics.registry.render())
+        cancelled = [val for n, lab, val in samples
+                     if n == "tpu_slice_reshape_total"
+                     and lab.get("outcome") == "cancelled"]
+        assert cancelled == [1.0]
+        assert not [val for n, lab, val in samples
+                    if n == "tpu_slice_reshape_total"
+                    and lab.get("outcome") == "reshaped"]
+
+    def test_evicted_member_rejoins_next_generation(self):
+        s = SliceState(3, _JAX_PORT, heartbeat_timeout_s=5.0,
+                       reshape_grace_s=3.0)
+        gen1 = _form_three(s)
+        s.heartbeat("host-a", True, now=6.0)   # window opens
+        s.heartbeat("host-b", True, now=8.0)   # b stays fresh
+        s.heartbeat("host-a", True, now=9.5)   # expiry evicts only c
+        gen2 = s.membership
+        assert gen2.generation == 2 and gen2.degraded
+        assert gen2.hostnames == ("host-a", "host-b")
+        # the evicted member returns: next generation, not the old one
+        res = s.join("host-c", coords=(2,), chip_count=8,
+                     session="host-c-reborn", now=12.0)
+        assert res.formed and res.rank == 2
+        gen3 = s.membership
+        assert gen3.generation == 3
+        assert gen3.hostnames == ("host-a", "host-b", "host-c")
+        assert gen3.reshaped_from == (gen1.slice_id, gen2.slice_id)
+        assert not gen3.degraded, "back at full strength"
+
+    def test_no_survivors_keeps_demote_all(self):
+        metrics = SliceMetrics()
+        s = SliceState(2, _JAX_PORT, heartbeat_timeout_s=5.0,
+                       reshape_grace_s=1.0, metrics=metrics)
+        s.join("host-a", coords=(0,), now=0.0)
+        s.join("host-b", coords=(1,), now=0.0)
+        gen1 = s.membership
+        # BOTH report unhealthy: nothing to re-form onto
+        s.heartbeat("host-a", False, reason="wedged", now=1.0)
+        s.heartbeat("host-b", False, reason="wedged", now=1.5)
+        v = s.heartbeat("host-a", False, reason="wedged", now=4.0)
+        assert not v.slice_healthy
+        assert s.membership == gen1
+        samples = obs.parse_exposition(metrics.registry.render())
+        assert [val for n, lab, val in samples
+                if n == "tpu_slice_reshape_total"
+                and lab.get("outcome") == "no_survivors"] == [1.0]
+
+    def test_reshaped_state_recovers_after_coordinator_crash(
+        self, tmp_path
+    ):
+        path = str(tmp_path / "membership.json")
+        s = SliceState(3, _JAX_PORT, state_path=path,
+                       heartbeat_timeout_s=5.0, reshape_grace_s=3.0)
+        _form_three(s)
+        s.heartbeat("host-a", True, now=6.0)
+        s.heartbeat("host-b", True, now=8.0)
+        s.heartbeat("host-a", True, now=9.5)
+        gen2 = s.membership
+        # coordinator crash: the revived one adopts the RESHAPED slice
+        revived = SliceState(3, _JAX_PORT, state_path=path,
+                             heartbeat_timeout_s=5.0, reshape_grace_s=3.0)
+        assert revived.membership == gen2
+        # the revived coordinator forgot who it evicted, but a degraded
+        # slice below its configured size re-admits the returnee anyway
+        res = revived.join("host-c", coords=(2,), chip_count=8,
+                           session="host-c-reborn", now=0.0)
+        assert res.formed and res.rank == 2
+        assert revived.membership.generation == gen2.generation + 1
+        assert not revived.membership.degraded
+
+    def test_stranger_still_rejected_on_whole_slice(self):
+        """Reshape enabled must NOT open the door for strangers: a full
+        healthy slice refuses unknown hosts exactly as before."""
+        s = SliceState(2, _JAX_PORT, heartbeat_timeout_s=5.0,
+                       reshape_grace_s=3.0)
+        s.join("host-a", coords=(0,), now=0.0)
+        s.join("host-b", coords=(1,), now=0.0)
+        res = s.join("host-z", session="z-s0", now=1.0)
+        assert res.error and "not a member" in res.error
+        assert s.membership.generation == 1
+
+    def test_reshape_metrics_render_promlint_clean(self):
+        metrics = SliceMetrics()
+        s = SliceState(2, _JAX_PORT, heartbeat_timeout_s=5.0,
+                       reshape_grace_s=1.0, metrics=metrics)
+        s.join("host-a", coords=(0,), now=0.0)
+        s.join("host-b", coords=(1,), now=0.0)
+        s.heartbeat("host-a", True, now=6.0)
+        s.heartbeat("host-a", True, now=8.0)
+        assert s.membership.generation == 2
+        samples = obs.parse_exposition(metrics.registry.render())
+        assert [val for n, lab, val in samples
+                if n == "tpu_slice_reshape_total"
+                and lab.get("outcome") == "reshaped"] == [1.0]
+        assert [val for n, lab, val in samples
+                if n == "tpu_slice_reshape_seconds_count"] == [1.0]
+        assert lint(metrics.registry.render()) == []
+
+
+@pytest.fixture
+def grace_coordinator(tmp_path):
+    registry = obs.Registry()
+    recorder = obs.FlightRecorder(registry=registry)
+    c = SliceCoordinator(
+        expected_workers=2,
+        bind_address="127.0.0.1:0",
+        jax_port=_JAX_PORT,
+        state_path=str(tmp_path / "coordinator-membership.json"),
+        heartbeat_timeout_s=0.3,
+        reshape_grace_s=0.4,
+        registry=registry,
+        recorder=recorder,
+    ).start()
+    yield c
+    c.stop()
+
+
+def _client(coordinator, tmp_path, name, rank_coord, health=None,
+            recorder=None, registry=None):
+    return SliceClient(
+        rendezvous_address=f"127.0.0.1:{coordinator.port}",
+        hostname=name,
+        coords=(rank_coord,),
+        chip_count=8,
+        state_path=str(tmp_path / f"{name}-membership.json"),
+        local_health_fn=health,
+        recorder=recorder,
+        registry=registry,
+        join_backoff_initial_s=0.05,
+        join_backoff_max_s=0.2,
+    )
+
+
+def _join_pair(a, b):
+    with_threads = []
+    for c in (b, a):
+        t = threading.Thread(target=c.join, args=(15.0,))
+        t.start()
+        with_threads.append(t)
+    for t in with_threads:
+        t.join(timeout=20.0)
+        assert not t.is_alive()
+
+
+def _beat_until(client, predicate, timeout_s=10.0, period_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        client.heartbeat_now()
+        if predicate():
+            return
+        time.sleep(period_s)
+    raise AssertionError("condition not reached within "
+                         f"{timeout_s}s; membership={client.membership}")
+
+
+def test_grpc_reshape_end_to_end(grace_coordinator, tmp_path):
+    """A member dies; the survivor adopts the reshaped generation over
+    real gRPC, re-emits the identity contract for the new shape, flips
+    back healthy, and every hop is journaled."""
+    registry = obs.Registry()
+    recorder = obs.FlightRecorder(registry=registry)
+    a = _client(grace_coordinator, tmp_path, "host-a", 0,
+                recorder=recorder, registry=registry)
+    b = _client(grace_coordinator, tmp_path, "host-b", 1)
+    signal = ReshapeSignal(str(tmp_path / "host-a-membership.json"),
+                           generation=0)
+    try:
+        _join_pair(a, b)
+        gen1 = a.membership
+        assert gen1.num_workers == 2
+        signal.baseline = gen1.generation
+        a.set_reshape_callback(signal.fire)
+        a.heartbeat_now()
+        b.heartbeat_now()
+        assert a.health_overlay() == (True, [])
+        env1 = a.slice_env()
+        assert env1[constants.ENV_TPU_SLICE_GENERATION] == "1"
+        assert env1[constants.ENV_JAX_NUM_PROCESSES] == "2"
+
+        b.stop()     # the member dies: heartbeats cease
+        # demote-all first (the member might return), then the reshape
+        _beat_until(a, lambda: a.membership.generation > gen1.generation)
+        m = a.membership
+        assert m.generation == gen1.generation + 1
+        assert m.hostnames == ("host-a",)
+        assert m.reshaped_from == (gen1.slice_id,)
+        assert m.degraded
+
+        # identity contract re-emitted for the new shape
+        env2 = a.slice_env()
+        assert env2[constants.ENV_TPU_WORKER_ID] == "0"
+        assert env2[constants.ENV_TPU_WORKER_HOSTNAMES] == "host-a"
+        assert env2[constants.ENV_JAX_NUM_PROCESSES] == "1"
+        assert env2[constants.ENV_JAX_PROCESS_ID] == "0"
+        assert env2[constants.ENV_TPU_SLICE_GENERATION] == str(
+            m.generation)
+        assert env2[constants.ENV_JAX_COORDINATOR_ADDRESS] == \
+            f"host-a:{_JAX_PORT}"
+
+        # the survivor's devices flip back healthy in the next frame
+        _beat_until(a, lambda: a.health_overlay() == (True, []))
+
+        # the workload-side hook fired with the new membership
+        assert signal.triggered
+        assert signal.check().generation == m.generation
+
+        # journaled on both sides
+        coord_events = grace_coordinator.recorder.events(
+            name="tpu_slice_reshaped")
+        assert coord_events
+        assert coord_events[-1]["attrs"]["generation"] == m.generation
+        assert coord_events[-1]["attrs"]["degraded"] is True
+        adopted = [e for e in recorder.events(
+            name="tpu_slice_membership_adopted")
+            if e["attrs"].get("generation") == m.generation]
+        assert adopted and adopted[-1]["attrs"]["workers"] == 1
+        # client-side transition counter moved
+        samples = obs.parse_exposition(registry.render())
+        assert [v for n, lab, v in samples
+                if n == "tpu_slice_membership_transitions_total"
+                and lab.get("kind") == "reshape_adopted"] == [1.0]
+        # the survivor's local state file carries the new generation
+        # (what the labeller and ReshapeSignal read)
+        on_disk = load_membership(str(
+            tmp_path / "host-a-membership.json"))
+        assert on_disk == m
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_evicted_client_standalone_then_rejoins(grace_coordinator,
+                                                tmp_path):
+    """A wedged member is evicted: it learns the eviction on its next
+    heartbeat, answers standalone health (overlay None — its devices
+    must not inherit a verdict about a slice it left), and rejoins the
+    NEXT generation the moment its chips recover."""
+    health = {"ok": True}
+    a = _client(grace_coordinator, tmp_path, "host-a", 0)
+    b = _client(grace_coordinator, tmp_path, "host-b", 1,
+                health=lambda: (health["ok"], "" if health["ok"]
+                                else "chips wedged"))
+    try:
+        _join_pair(a, b)
+        gen1 = a.membership
+        health["ok"] = False       # b's chips wedge
+        b.heartbeat_now()
+        # survivors beat until the grace window evicts b
+        _beat_until(a, lambda: a.membership.generation > gen1.generation)
+        gen2 = a.membership
+        assert gen2.hostnames == ("host-a",)
+
+        # b keeps beating (still wedged): learns the eviction, stays out
+        b.heartbeat_now()
+        assert b.membership.rank_of("host-b") is None
+        assert b.health_overlay() is None, (
+            "evicted host must advertise standalone health, not the "
+            "old slice verdict")
+        assert b.slice_env() == {}
+
+        # chips recover -> the very next heartbeat rejoins, next gen
+        health["ok"] = True
+        _beat_until(
+            b, lambda: b.membership.rank_of("host-b") is not None)
+        gen3 = b.membership
+        assert gen3.generation == gen2.generation + 1
+        assert gen3.hostnames == ("host-a", "host-b")
+        assert gen3.reshaped_from == (gen1.slice_id, gen2.slice_id)
+        assert not gen3.degraded
+        # the survivor learns the regrown generation on its next beat
+        _beat_until(a, lambda: a.membership == gen3)
+        assert a.slice_env()[constants.ENV_JAX_NUM_PROCESSES] == "2"
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_membership_file_round_trips_lineage(tmp_path):
+    """The crash-safe file carries lineage/degraded, and pre-reshape
+    files (no such keys) still load — forward compatibility both ways."""
+    from tpu_k8s_device_plugin.slice import Membership, save_membership
+
+    path = str(tmp_path / "m.json")
+    m = Membership(
+        slice_id="abc", generation=4, hostnames=("h0", "h1"),
+        coordinator_address="h0:8476",
+        reshaped_from=("x1", "x2"), degraded=True,
+    )
+    save_membership(path, m)
+    assert load_membership(path) == m
+    # a pre-reshape writer's file: no lineage keys
+    with open(path, "w") as f:
+        json.dump({"version": 1, "slice_id": "old", "generation": 1,
+                   "hostnames": ["h0"],
+                   "coordinator_address": "h0:8476"}, f)
+    old = load_membership(path)
+    assert old is not None
+    assert old.reshaped_from == () and old.degraded is False

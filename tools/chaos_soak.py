@@ -27,6 +27,21 @@ engine through a seeded sweep of injected fault episodes:
                                reconverges (the abandoned worker bails
                                on the supersession check instead of
                                racing the restarted loop)
+  7. member loss + reshape   -- a slice member dies mid-traffic; after
+                               the staleness timeout the verdict
+                               demotes (demote-all while it might
+                               return), the reshape grace window
+                               expires, the survivor re-forms into a
+                               smaller degraded generation and serves
+                               Healthy at the reduced shape -- all
+                               journal-proven (tpu_slice_reshaped,
+                               membership_adopted gen+1, lineage)
+  8. member flap in grace    -- the member goes silent past the
+                               staleness timeout but returns INSIDE the
+                               reshape grace window: no reshape, the
+                               original generation holds bit-for-bit
+                               (outcome=cancelled counted, no
+                               tpu_slice_reshaped event)
 
 After every episode the system must reconverge: all devices
 re-advertised Healthy, the slice verdict healthy, serving answering
@@ -61,6 +76,7 @@ sys.path.insert(0, os.path.join(_REPO, "tests"))  # fake_kubelet
 
 from tpu_k8s_device_plugin import obs, resilience  # noqa: E402
 from tpu_k8s_device_plugin.health.server import probe_chip_states  # noqa: E402
+from tpu_k8s_device_plugin.proto import deviceplugin_pb2 as pluginapi  # noqa: E402
 from tpu_k8s_device_plugin.manager import PluginManager  # noqa: E402
 from tpu_k8s_device_plugin.manager import manager as manager_mod  # noqa: E402
 from tpu_k8s_device_plugin.resilience import faults  # noqa: E402
@@ -458,6 +474,179 @@ def episode_scheduler_hang(seed):
         srv.stop()
 
 
+def _reshape_slice(tmp, testdata, seed, suffix, grace, hb_timeout):
+    """A dedicated 2-host slice with live staleness + reshape grace (the
+    main soak coordinator drives heartbeats manually with no timeout, so
+    eviction-by-silence needs its own)."""
+    registry = obs.Registry()
+    recorder = obs.FlightRecorder(registry=registry)
+    coordinator = SliceCoordinator(
+        expected_workers=2,
+        bind_address="127.0.0.1:0",
+        jax_port=_JAX_PORT,
+        state_path=os.path.join(tmp, f"coordinator-{suffix}.json"),
+        heartbeat_timeout_s=hb_timeout,
+        reshape_grace_s=grace,
+        registry=registry,
+        recorder=recorder,
+    ).start()
+    rendezvous = f"127.0.0.1:{coordinator.port}"
+    hosts = [
+        ChaosHost(f"host-{suffix}0", "v5e-16-host0", testdata, tmp,
+                  rendezvous, seed),
+        ChaosHost(f"host-{suffix}1", "v5e-16-host1", testdata, tmp,
+                  rendezvous, seed),
+    ]
+    import concurrent.futures
+    with concurrent.futures.ThreadPoolExecutor(2) as pool:
+        for f in [pool.submit(h.client.join, 20.0) for h in hosts]:
+            f.result(timeout=30.0)
+    for h in hosts:
+        h.manager.run(block=False)
+        check(h.kubelet.wait_for_registration(timeout=10.0),
+              f"{h.name} registered with its kubelet")
+        h.open_stream()
+        h.pulse()
+        h.wait_frame(all_healthy)
+    return coordinator, recorder, registry, hosts
+
+
+def episode_member_loss_reshape(testdata, tmp, seed):
+    """(7) Member loss mid-traffic: staleness demotes the slice
+    (demote-all while the member might return), the grace window
+    expires, the survivor re-forms into a smaller degraded generation
+    and serves Healthy at the reduced shape — within one staleness
+    timeout + one grace window + a couple of pulses, journal-proven."""
+    hb_timeout, grace = 0.4, 0.6
+    coordinator, recorder, registry, hosts = _reshape_slice(
+        tmp, testdata, seed, "r", grace, hb_timeout)
+    survivor, victim = hosts
+    try:
+        gen1 = survivor.client.membership
+        check(gen1 is not None and gen1.num_workers == 2
+              and not gen1.degraded,
+              "2-host slice formed whole before the loss")
+        t_kill = time.monotonic()
+        victim.stop()           # the member dies mid-traffic
+        # the survivor's own pulses must first deliver the demote-all
+        # verdict (the member might still return), then — at grace
+        # expiry — the reshaped generation
+        survivor.pulse()
+        deadline = time.time() + hb_timeout + grace + 8.0
+        while time.time() < deadline:
+            survivor.pulse()
+            m = survivor.client.membership
+            if m is not None and m.generation > gen1.generation:
+                break
+            time.sleep(0.05)
+        adopted_after = time.monotonic() - t_kill
+        m = survivor.client.membership
+        check(m is not None and m.generation == gen1.generation + 1,
+              "survivor adopted the next generation "
+              f"({adopted_after:.1f}s after the kill)")
+        check(m.hostnames == (survivor.name,),
+              "reshaped membership is the survivor alone (rank 0)")
+        check(m.reshaped_from == (gen1.slice_id,),
+              "lineage carries the original slice id")
+        check(m.degraded, "reshaped membership marked degraded")
+        check(adopted_after <= hb_timeout + grace + 3.0,
+              "reshape landed within one staleness timeout + one grace "
+              f"window + pulse slack ({adopted_after:.1f}s)")
+        # journal evidence on both sides
+        reshaped = recorder.events(name="tpu_slice_reshaped")
+        check(reshaped and reshaped[-1]["attrs"]["generation"]
+              == m.generation,
+              "coordinator journaled tpu_slice_reshaped for gen "
+              f"{m.generation}")
+        check(reshaped[-1]["attrs"]["degraded"] is True,
+              "journal marks the reshaped generation degraded")
+        adoptions = [e for e in survivor.journal(
+            "tpu_slice_membership_adopted")
+            if e["attrs"].get("generation") == m.generation]
+        check(adoptions, "survivor journaled the gen-2 adoption")
+        samples = obs.parse_exposition(registry.render())
+        reshapes = [v for n, lab, v in samples
+                    if n == "tpu_slice_reshape_total"
+                    and lab.get("outcome") == "reshaped"]
+        check(reshapes and reshapes[0] >= 1,
+              "tpu_slice_reshape_total{outcome=reshaped} counted")
+        secs = [v for n, lab, v in samples
+                if n == "tpu_slice_reshape_seconds_count" and not lab]
+        check(secs and secs[0] >= 1,
+              "tpu_slice_reshape_seconds observed the window")
+        # the survivor must SERVE at the reduced shape: devices Healthy
+        # and the Allocate contract re-emitted for 1 worker
+        frame = survivor.wait_frame(all_healthy)
+        check(len(frame.devices) == 8,
+              "survivor re-advertises all 8 local devices Healthy at "
+              "the reduced shape")
+        stub = survivor.kubelet.plugin_stub("google.com_tpu")
+        resp = stub.Allocate(pluginapi.AllocateRequest(
+            container_requests=[pluginapi.ContainerAllocateRequest(
+                devices_ids=[d.ID for d in frame.devices])]))
+        env = dict(resp.container_responses[0].envs)
+        check(env.get(constants.ENV_TPU_WORKER_ID) == "0"
+              and env.get(constants.ENV_TPU_WORKER_HOSTNAMES)
+              == survivor.name
+              and env.get(constants.ENV_JAX_NUM_PROCESSES) == "1"
+              and env.get(constants.ENV_TPU_SLICE_GENERATION)
+              == str(m.generation),
+              "survivor serves the re-emitted identity contract at the "
+              "reduced shape")
+    finally:
+        survivor.stop()
+        coordinator.stop()
+
+
+def episode_member_flap_no_reshape(testdata, tmp, seed):
+    """(8) The member goes silent past the staleness timeout (verdict
+    demotes, reshape window opens) but flaps BACK inside the grace
+    window: no reshape — the original generation holds bit-for-bit."""
+    # grace must comfortably exceed the bounded demote-frame wait below
+    # plus pulse slack on a loaded CI box: the point of this episode is
+    # the member returning INSIDE the window
+    hb_timeout, grace = 0.4, 10.0
+    coordinator, recorder, registry, hosts = _reshape_slice(
+        tmp, testdata, seed, "f", grace, hb_timeout)
+    a, b = hosts
+    try:
+        gen1 = a.client.membership
+        # b goes silent past the staleness timeout; a's pulse trips it
+        time.sleep(hb_timeout * 2)
+        a.pulse()
+        overlay = a.client.health_overlay()
+        check(overlay is not None and not overlay[0],
+              "verdict demoted while the member is silent (demote-all "
+              "inside the grace window)")
+        a.wait_frame(all_unhealthy, pulses=5, timeout_s=3.0)
+        check(True, "survivor demoted all devices during the window")
+        # the member flaps back BEFORE the grace expires
+        b.pulse()
+        a.pulse()
+        for h in (a, b):
+            h.wait_frame(all_healthy)
+        m = a.client.membership
+        check(m == gen1,
+              "original generation holds bit-for-bit after the flap "
+              f"(gen {m.generation}, {len(m.hostnames)} workers)")
+        check(not recorder.events(name="tpu_slice_reshaped"),
+              "no reshape journaled for an in-grace flap")
+        samples = obs.parse_exposition(registry.render())
+        cancelled = [v for n, lab, v in samples
+                     if n == "tpu_slice_reshape_total"
+                     and lab.get("outcome") == "cancelled"]
+        check(cancelled and cancelled[0] >= 1,
+              "tpu_slice_reshape_total{outcome=cancelled} counted")
+        reshaped = [v for n, lab, v in samples
+                    if n == "tpu_slice_reshape_total"
+                    and lab.get("outcome") == "reshaped"]
+        check(not reshaped, "no reshape outcome counted")
+    finally:
+        for h in hosts:
+            h.stop()
+        coordinator.stop()
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="chaos-soak")
     p.add_argument("--seed", type=int,
@@ -523,6 +712,10 @@ def main(argv=None) -> int:
             episode_scheduler_crash(args.seed)
             log.info("=== episode 6: scheduler hang mid-interleave ===")
             episode_scheduler_hang(args.seed)
+        log.info("=== episode 7: member loss -> reshape ===")
+        episode_member_loss_reshape(args.testdata, tmp, args.seed)
+        log.info("=== episode 8: member flap inside the grace window ===")
+        episode_member_flap_no_reshape(args.testdata, tmp, args.seed)
         # -- final convergence sweep ----------------------------------
         for h in hosts:
             h.pulse()

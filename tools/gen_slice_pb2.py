@@ -47,6 +47,8 @@ msg("Membership", [
     ("num_workers", 3, I32, OPT, None),
     ("hostnames", 4, S, REP, None),
     ("coordinator_address", 5, S, OPT, None),
+    ("reshaped_from", 6, S, REP, None),
+    ("degraded", 7, B, OPT, None),
 ])
 msg("JoinResponse", [
     ("formed", 1, B, OPT, None),

@@ -35,6 +35,10 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# `python tools/measure_r3.py` puts tools/ (not the repo root) on
+# sys.path[0]; without this bootstrap every phase's
+# tpu_k8s_device_plugin import fails the moment a chip is attached
+sys.path.insert(0, REPO)
 OUT = os.path.join(REPO, "MEASURE_r03.json")
 
 # (name, timeout seconds); order: cheap headline stuff first so a short
@@ -73,6 +77,14 @@ PHASES = [
     # way; only the scheduler's interleave flips.
     ("serving_sched_interleave_b8", 1800),
     ("serving_sched_no_interleave_b8", 1800),
+    # round-7 addition: elastic-slice availability — kill one member of
+    # a formed (in-process, loopback-gRPC) slice during alexnet
+    # training and measure the checkpoint-resume gap: member death ->
+    # reshape detected + final checkpoint, restore + first step under
+    # the survivor's new identity, and the whole serving gap.  The
+    # CPU-proxied chaos episode 7 proves the mechanism; this phase puts
+    # an on-chip number on it.
+    ("reshape_under_load", 900),
 ]
 
 
@@ -385,6 +397,97 @@ def phase_int4_bytes():
         out["int4_over_int8"] = round(
             out["int4_bytes_accessed"] / out["int8_bytes_accessed"], 3)
     return out
+
+
+def phase_reshape_under_load():
+    """Checkpoint-resume gap of an elastic-slice reshape under training
+    load (ROADMAP: the availability story needs an on-chip number the
+    day the tunnel returns).
+
+    A 2-member in-process slice forms (real coordinator + clients over
+    loopback gRPC, the production code path); alexnet trains with the
+    elastic loop; mid-run one member is killed.  Measured, on whatever
+    chip is attached: kill -> reshaped generation adopted (detect_s),
+    the final checkpoint save (checkpoint_s), restore + first step back
+    under the survivor identity (resume_s), and the whole serving gap
+    (gap_s = last step before the kill -> first step after resume)."""
+    import shutil
+    import tempfile
+    import threading
+
+    from tpu_k8s_device_plugin.slice import SliceClient, SliceCoordinator
+    from tpu_k8s_device_plugin.workloads import bench_main, checkpoint
+
+    tmp = tempfile.mkdtemp(prefix="reshape-r3-")
+    coordinator = SliceCoordinator(
+        expected_workers=2, bind_address="127.0.0.1:0", jax_port=8476,
+        state_path=os.path.join(tmp, "coordinator.json"),
+        heartbeat_timeout_s=0.5, reshape_grace_s=1.0,
+    ).start()
+    addr = f"127.0.0.1:{coordinator.port}"
+    clients = [
+        SliceClient(rendezvous_address=addr, hostname=f"host-{i}",
+                    coords=(i,), chip_count=1,
+                    state_path=os.path.join(tmp, f"host-{i}.json"))
+        for i in range(2)
+    ]
+    try:
+        threads = [threading.Thread(target=c.join, args=(30.0,))
+                   for c in clients]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=40.0)
+        survivor, victim = clients
+        gen1 = survivor.membership.generation
+        signal = checkpoint.ReshapeSignal(
+            os.path.join(tmp, "host-0.json"), generation=gen1)
+        # both members heartbeat in the background; the "kill" is the
+        # victim's heartbeats stopping
+        survivor.start(period_s=0.2)
+        victim.start(period_s=0.2)
+
+        ckpt_dir = os.path.join(tmp, "ckpts")
+        # warm start: a few steps + checkpoint so the resume is honest
+        rc = bench_main.run_elastic(
+            batch=64, steps=5, checkpoint_dir=ckpt_dir,
+            checkpoint_every=0, slice_state="", signal=signal)
+        assert rc == 0, f"warmup train failed rc={rc}"
+        t_kill = time.time()
+        victim.stop()           # the member dies under load
+        rc = bench_main.run_elastic(
+            batch=64, steps=10_000, checkpoint_dir=ckpt_dir,
+            checkpoint_every=0, slice_state="", signal=signal)
+        t_ckpt_done = time.time()
+        assert rc == checkpoint.RESHAPE_EXIT_CODE, (
+            f"elastic loop should exit {checkpoint.RESHAPE_EXIT_CODE} "
+            f"on reshape, got {rc}")
+        detect_s = None
+        m = signal.check()
+        if m is not None:
+            detect_s = round(t_ckpt_done - t_kill, 3)
+        # the restart: restore + run one step under the new identity
+        t0 = time.time()
+        rc = bench_main.run_elastic(
+            batch=64, steps=checkpoint.latest_step(ckpt_dir) + 1,
+            checkpoint_dir=ckpt_dir, checkpoint_every=0,
+            slice_state="",
+            signal=checkpoint.ReshapeSignal(
+                os.path.join(tmp, "host-0.json"),
+                generation=m.generation if m else gen1))
+        resume_s = round(time.time() - t0, 3)
+        assert rc == 0, f"resume failed rc={rc}"
+        return {
+            "detect_and_checkpoint_s": detect_s,
+            "resume_s": resume_s,
+            "gap_s": round(time.time() - t_kill, 3),
+            "reshaped_generation": m.generation if m else None,
+        }
+    finally:
+        for c in clients:
+            c.stop()
+        coordinator.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 # -- orchestration ------------------------------------------------------------

@@ -84,6 +84,22 @@ def build_parser() -> argparse.ArgumentParser:
              f"{constants.ENV_SLICE_WORKERS}",
     )
     p.add_argument(
+        "--slice-reshape-grace", "--slice_reshape_grace",
+        dest="slice_reshape_grace", type=float, metavar="SECONDS",
+        default=float(
+            os.environ.get(constants.ENV_SLICE_RESHAPE_GRACE, "0") or 0),
+        help="degraded-mode reshape grace window in seconds.  0 (the "
+             "default) keeps demote-all semantics: an unhealthy member "
+             "demotes every member's devices until it recovers.  > 0: "
+             "members still unhealthy/absent when the window expires are "
+             "evicted and the survivors re-form into a smaller slice "
+             "under the next generation (workloads checkpoint-restart "
+             "under the new identity).  Only meaningful on the "
+             "rendezvous host; pass it to every member anyway (identical "
+             "flags).  Env override: "
+             f"{constants.ENV_SLICE_RESHAPE_GRACE}",
+    )
+    p.add_argument(
         "--slice-state-file", default=constants.SLICE_STATE_FILE,
         help=argparse.SUPPRESS,
     )
@@ -224,6 +240,7 @@ def setup_slice(args, impl, driver_type, registry=None, recorder=None):
             state_path=args.slice_state_file,
             registry=registry,
             recorder=recorder,
+            reshape_grace_s=args.slice_reshape_grace,
         ).start()
         log.info("this host (%s) serves the slice rendezvous", hostname)
     client = SliceClient(
@@ -260,6 +277,14 @@ def main(argv=None) -> int:
 
     if args.slice_workers and not args.slice_rendezvous:
         log.error("--slice-workers without --slice-rendezvous has no effect")
+        return 2
+    if args.slice_reshape_grace and not args.slice_rendezvous:
+        log.error("--slice-reshape-grace without --slice-rendezvous "
+                  "has no effect")
+        return 2
+    if args.slice_reshape_grace < 0:
+        log.error("invalid --slice-reshape-grace %.1f; must be >= 0",
+                  args.slice_reshape_grace)
         return 2
 
     impl, driver_type = select_device_impl(args)

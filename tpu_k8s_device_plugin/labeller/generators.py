@@ -183,6 +183,32 @@ def _slice_rank(ctx: LabelContext) -> str:
     return str(rank) if rank is not None else ""
 
 
+def _slice_generation(ctx: LabelContext) -> str:
+    """Membership generation: bumps whenever the member set changes
+    (degraded-mode reshape, evicted member returning) — gang schedulers
+    and operators can tell a re-formed slice from the original."""
+    m = ctx.slice_membership
+    return str(m.generation) if m is not None else ""
+
+
+def _slice_workers(ctx: LabelContext) -> str:
+    """Hosts in the CURRENT generation.  Unlike num-workers (the
+    metadata-declared slice size), this shrinks when a reshape evicts a
+    member — the real remaining shape schedulers should place against."""
+    m = ctx.slice_membership
+    return str(m.num_workers) if m is not None else ""
+
+
+def _slice_degraded(ctx: LabelContext) -> str:
+    """'true' while the slice runs below its configured worker count
+    (a reshape evicted members), 'false' on a whole slice — the
+    anti-affinity key for jobs that must not land on reduced capacity."""
+    m = ctx.slice_membership
+    if m is None:
+        return ""
+    return "true" if m.degraded else "false"
+
+
 # key → generator; keys are the SUPPORTED_LABELS flag names
 # (≈ labelGenerators, main.go:123).
 LABEL_GENERATORS: Dict[str, Callable[[LabelContext], str]] = {
@@ -202,6 +228,9 @@ LABEL_GENERATORS: Dict[str, Callable[[LabelContext], str]] = {
     "core-partition": _core_partition,
     "slice-id": _slice_id,
     "slice-rank": _slice_rank,
+    "slice-generation": _slice_generation,
+    "slice-workers": _slice_workers,
+    "slice-degraded": _slice_degraded,
 }
 
 assert set(LABEL_GENERATORS) == set(constants.SUPPORTED_LABELS)

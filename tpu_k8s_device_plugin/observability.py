@@ -84,6 +84,12 @@ def manager_status(manager: "PluginManager") -> dict:
             "rank": client.rank,
             "hostnames": list(m.hostnames) if m else [],
             "coordinator_address": m.coordinator_address if m else "",
+            # reshape state: which generation this host serves, whether
+            # it runs below the configured size, and the lineage of
+            # slice ids it was re-formed from
+            "generation": m.generation if m else 0,
+            "degraded": m.degraded if m else False,
+            "reshaped_from": list(m.reshaped_from) if m else [],
             # null until the first heartbeat verdict arrives
             "healthy": None if overlay is None else overlay[0],
             "unhealthy_hostnames": [] if overlay is None else overlay[1],

@@ -10,11 +10,14 @@ host's.
 Three layers:
 
 - :mod:`.state` — the pure rendezvous state machine (deterministic ranks,
-  crash-safe membership file), fuzzable without gRPC or a clock;
+  crash-safe membership file, degraded-mode reshape: a bounded grace
+  window instead of demote-all, with ``reshaped_from`` lineage across
+  generations), fuzzable without gRPC or a clock;
 - :mod:`.server` — the coordinator, serving ``SliceRendezvous`` for the
   whole slice from one member;
 - :mod:`.client` — per-host join (retries + exponential backoff),
-  heartbeat, and the env contract Allocate injects into containers.
+  heartbeat, eviction/rejoin across reshapes, and the env contract
+  Allocate injects into containers.
 """
 
 from .client import SliceClient

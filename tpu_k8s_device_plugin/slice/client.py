@@ -78,6 +78,8 @@ def _membership_from_msg(m: Any) -> Optional[Membership]:
         generation=m.generation,
         hostnames=tuple(m.hostnames),
         coordinator_address=m.coordinator_address,
+        reshaped_from=tuple(m.reshaped_from),
+        degraded=m.degraded,
     )
 
 
@@ -147,8 +149,18 @@ class SliceClient:
         # fresh per process start: lets the coordinator tell a worker
         # restart apart from a duplicate hostname
         self._session = uuid.uuid4().hex
+        # rising-edge guard so an eviction journals/counts once, not
+        # once per pulse while we wait to rejoin
+        self._evicted_flag = False
         self._lock = threading.Lock()
         self._membership: Optional[Membership] = None
+        # reshape hook: called (old_membership, new_membership) whenever a
+        # NEW generation is adopted over a previous one — the workload
+        # layer (ReshapeSignal) and tests hang checkpoint triggers here.
+        # Exceptions are suppressed-but-accounted: a broken callback must
+        # not break heartbeats.
+        self._on_reshape: Optional[
+            Callable[[Optional[Membership], Membership], None]] = None
         # None until the first heartbeat answer: "no verdict yet" must not
         # flip devices Unhealthy while the slice is still forming
         self._slice_healthy: Optional[bool] = None
@@ -263,6 +275,16 @@ class SliceClient:
                 break
         raise RuntimeError("slice client stopped before the slice formed")
 
+    def set_reshape_callback(
+        self,
+        fn: Optional[Callable[[Optional[Membership], Membership], None]],
+    ) -> None:
+        """Wire the workload-side reshape hook (e.g.
+        ``workloads.checkpoint.ReshapeSignal.fire``): invoked with
+        (old_membership, new_membership) when a new generation is
+        adopted."""
+        self._on_reshape = fn
+
     def _adopt(self, membership: Membership,
                trace: Optional[obs.TraceContext] = None) -> None:
         with self._lock:
@@ -281,10 +303,14 @@ class SliceClient:
                     "tpu_slice_membership_adopted", trace=trace,
                     slice_id=membership.slice_id,
                     generation=membership.generation,
-                    rank=rank, workers=membership.num_workers)
+                    rank=rank, workers=membership.num_workers,
+                    degraded=membership.degraded,
+                    reshaped_from=",".join(membership.reshaped_from)
+                    or "-")
             log.info(
-                "slice %s gen %d: rank %s of %d, coordinator %s",
-                membership.slice_id, membership.generation, rank,
+                "slice %s gen %d%s: rank %s of %d, coordinator %s",
+                membership.slice_id, membership.generation,
+                " (degraded)" if membership.degraded else "", rank,
                 membership.num_workers, membership.coordinator_address,
             )
             if self._state_path:
@@ -293,6 +319,19 @@ class SliceClient:
                 except OSError as e:
                     log.error("cannot persist slice membership to %s: %s",
                               self._state_path, e)
+            if prior is not None:
+                # the identity contract just CHANGED under this host — a
+                # reshape (or regrow) — which is what workloads key
+                # checkpoint-restarts off
+                if self.metrics is not None:
+                    self.metrics.transition("reshape_adopted")
+                if self._on_reshape is not None:
+                    try:
+                        self._on_reshape(prior, membership)
+                    except Exception as e:
+                        resilience.suppressed(
+                            "slice.reshape_callback", e, logger=log,
+                            metrics=self._res_metrics)
 
     # -- heartbeat ----------------------------------------------------------
 
@@ -359,6 +398,16 @@ class SliceClient:
         fresh = _membership_from_msg(resp.membership)
         if fresh is not None:
             self._adopt(fresh, trace=ctx)
+            if fresh.rank_of(self.hostname) is None:
+                # the slice reshaped WITHOUT us: this host was evicted
+                # (grace window expired while it was wedged/silent).
+                # Rejoin into the next generation once local chips are
+                # healthy; the learned verdict below belongs to a slice
+                # we are no longer part of, so skip it.
+                self._last_beat = time.monotonic()
+                self._handle_eviction(healthy, ctx)
+                return
+        self._evicted_flag = False
         self._last_beat = time.monotonic()
         with self._lock:
             prior = self._slice_healthy
@@ -389,6 +438,43 @@ class SliceClient:
                 f" (members: {list(resp.unhealthy_hostnames)})"
                 if not resp.slice_healthy else "",
             )
+
+    def _handle_eviction(self, healthy: bool,
+                         trace: Optional[obs.TraceContext]) -> None:
+        """This host learned it is no longer a member (evicted by a
+        reshape).  Journal it once, then — as soon as local chips are
+        healthy — rejoin so the coordinator re-forms the NEXT generation
+        around survivors + us.  While evicted, health_overlay() answers
+        None: the devices advertise standalone (local) health only."""
+        if not self._evicted_flag:
+            self._evicted_flag = True
+            m = self.membership
+            log.warning(
+                "evicted from slice %s (gen %d reshape); will rejoin the "
+                "next generation when locally healthy",
+                m.slice_id if m else "?",
+                m.generation if m else -1)
+            if self.metrics is not None:
+                self.metrics.transition("evicted")
+            if self._recorder is not None:
+                self._recorder.record(
+                    "tpu_slice_evicted", trace=trace,
+                    slice_id=m.slice_id if m else "",
+                    generation=m.generation if m else -1,
+                    hostname=self.hostname)
+        if not healthy:
+            return
+        try:
+            rejoined = self._join_once(trace=trace)
+        except _TRANSIENT as e:
+            code = _rpc_status_code(e)
+            log.warning("rejoin after eviction failed: %s",
+                        code if code is not None else e)
+            return
+        if rejoined is not None \
+                and rejoined.rank_of(self.hostname) is not None:
+            self._evicted_flag = False
+            self._adopt(rejoined, trace=trace)
 
     def start(
         self, period_s: float = constants.SLICE_HEARTBEAT_PERIOD_S
@@ -453,13 +539,23 @@ class SliceClient:
             constants.ENV_JAX_COORDINATOR_ADDRESS: m.coordinator_address,
             constants.ENV_JAX_NUM_PROCESSES: str(m.num_workers),
             constants.ENV_JAX_PROCESS_ID: str(rank),
+            # generation stamp: workloads compare it against the live
+            # membership file (ReshapeSignal) to detect that the slice
+            # reshaped under them
+            constants.ENV_TPU_SLICE_GENERATION: str(m.generation),
         }
 
     def health_overlay(self) -> Optional[Tuple[bool, List[str]]]:
         """(slice_healthy, unhealthy hostnames), or None while no verdict
         has arrived yet — ListAndWatch must not flap devices Unhealthy
-        just because the slice is still forming."""
+        just because the slice is still forming.  Also None while this
+        host is evicted from a reshaped slice: its devices advertise
+        standalone (local) health, not a verdict about a slice it no
+        longer belongs to."""
         with self._lock:
             if self._slice_healthy is None:
+                return None
+            m = self._membership
+            if m is not None and m.rank_of(self.hostname) is None:
                 return None
             return self._slice_healthy, list(self._unhealthy_hosts)

@@ -25,6 +25,16 @@ Series (full reference: docs/user-guide/observability.md):
   next heartbeat DELIVERING that verdict.  The window in which a
   member still advertises devices Healthy against a wedged peer.
 - ``tpu_slice_heartbeats_total`` — heartbeats the coordinator served.
+- ``tpu_slice_reshape_total{outcome}`` — counter, coordinator-side:
+  degraded-mode reshape window outcomes — ``reshaped`` (members evicted,
+  survivors re-formed smaller), ``cancelled`` (every member recovered
+  inside the grace window), ``grown`` (an evicted member returned and a
+  bigger next generation formed), ``no_survivors`` (window expired with
+  nothing left to re-form onto).  The client counts ``reshape_adopted``
+  under ``tpu_slice_membership_transitions_total`` when it learns a new
+  generation.
+- ``tpu_slice_reshape_seconds`` — histogram, coordinator-side: reshape
+  window opening (unhealthy verdict) → reshaped membership formed.
 
 Both halves accept ``metrics=None`` and stay zero-cost when unmetered
 (the fuzz harness and bare-grpc installs never touch obs state).
@@ -62,6 +72,17 @@ class SliceMetrics:
         self.heartbeats = reg.counter(
             "tpu_slice_heartbeats_total",
             "Heartbeats the coordinator has served.")
+        self.reshapes = reg.counter(
+            "tpu_slice_reshape_total",
+            "Degraded-mode reshape window outcomes, by kind.",
+            ("outcome",))
+        self.reshape_seconds = reg.histogram(
+            "tpu_slice_reshape_seconds",
+            "Reshape window opening (unhealthy verdict) -> reshaped "
+            "membership formed.", buckets=obs.SLOW_BUCKETS_S)
 
     def transition(self, kind: str) -> None:
         self.transitions.labels(kind=kind).inc()
+
+    def reshape_outcome(self, outcome: str) -> None:
+        self.reshapes.labels(outcome=outcome).inc()

@@ -55,6 +55,8 @@ def _membership_msg(m: Optional[Membership]) -> Any:
         num_workers=m.num_workers,
         hostnames=list(m.hostnames),
         coordinator_address=m.coordinator_address,
+        reshaped_from=list(m.reshaped_from),
+        degraded=m.degraded,
     )
 
 
@@ -65,11 +67,38 @@ class _Servicer(slicepb_grpc.SliceRendezvousServicer):
         self._lock = lock
         self._recorder = recorder
 
+    def _record_generation_change(
+        self,
+        before: Optional[Membership],
+        after: Optional[Membership],
+        trace: obs.TraceContext,
+    ) -> None:
+        """Journal a reshape/regrow: the locked call just made a NEW
+        generation (grace-window eviction or an evicted member
+        returning) — the journal entry is the slice-wide evidence the
+        chaos episodes assert on.  *before*/*after* are captured inside
+        the state lock so concurrent RPCs journal their own transition,
+        not each other's."""
+        if self._recorder is None:
+            return
+        if after is None or before is None \
+                or after.generation == before.generation:
+            return
+        self._recorder.record(
+            "tpu_slice_reshaped", trace=trace,
+            slice_id=after.slice_id,
+            generation=after.generation,
+            workers=after.num_workers,
+            degraded=after.degraded,
+            reshaped_from=",".join(after.reshaped_from) or "-",
+            previous=before.slice_id)
+
     def Join(self, request: Any, context: Any) -> Any:
         # the member's trace rides the RPC metadata: the coordinator's
         # join record shares it, so one id greps across both hosts
         trace = _trace_from_context(context)
         with self._lock:
+            before = self._state.membership
             res = self._state.join(
                 hostname=request.hostname,
                 coords=tuple(request.coords),
@@ -77,6 +106,8 @@ class _Servicer(slicepb_grpc.SliceRendezvousServicer):
                 session=request.session,
                 now=time.monotonic(),
             )
+            after = self._state.membership
+        self._record_generation_change(before, after, trace)
         if self._recorder is not None:
             self._recorder.record(
                 "tpu_slice_join", trace=trace,
@@ -110,12 +141,15 @@ class _Servicer(slicepb_grpc.SliceRendezvousServicer):
     def Heartbeat(self, request: Any, context: Any) -> Any:
         trace = _trace_from_context(context)
         with self._lock:
+            before = self._state.membership
             view = self._state.heartbeat(
                 hostname=request.hostname,
                 healthy=request.healthy,
                 reason=request.reason,
                 now=time.monotonic(),
             )
+            after = self._state.membership
+        self._record_generation_change(before, after, trace)
         if self._recorder is not None:
             self._recorder.record(
                 "tpu_slice_heartbeat", trace=trace,
@@ -145,6 +179,7 @@ class SliceCoordinator:
         heartbeat_timeout_s: float = constants.SLICE_HEARTBEAT_TIMEOUT_S,
         registry: Optional[obs.Registry] = None,
         recorder: Optional[obs.FlightRecorder] = None,
+        reshape_grace_s: float = constants.SLICE_RESHAPE_GRACE_S,
     ) -> None:
         self._lock = threading.Lock()
         # flight recorder (PR 4): join/heartbeat events land here with
@@ -168,6 +203,7 @@ class SliceCoordinator:
             heartbeat_timeout_s=heartbeat_timeout_s,
             epoch=time.monotonic(),
             metrics=self.metrics,
+            reshape_grace_s=reshape_grace_s,
         )
         if registry is not None:
             def _refresh() -> None:

@@ -42,12 +42,19 @@ _STATE_VERSION = 1
 
 @dataclass(frozen=True)
 class Membership:
-    """The agreed slice: hostnames indexed by rank + coordinator address."""
+    """The agreed slice: hostnames indexed by rank + coordinator address.
+
+    ``reshaped_from`` is the degraded-mode lineage: the slice_ids of the
+    generations this one was reshaped (or re-grown) from, oldest first —
+    empty for a first formation.  ``degraded`` is true while the slice
+    runs below its configured worker count."""
 
     slice_id: str
     generation: int
     hostnames: Tuple[str, ...]
     coordinator_address: str
+    reshaped_from: Tuple[str, ...] = ()
+    degraded: bool = False
 
     @property
     def num_workers(self) -> int:
@@ -66,6 +73,8 @@ class Membership:
             "generation": self.generation,
             "hostnames": list(self.hostnames),
             "coordinator_address": self.coordinator_address,
+            "reshaped_from": list(self.reshaped_from),
+            "degraded": self.degraded,
         }
 
     @classmethod
@@ -75,19 +84,36 @@ class Membership:
             generation=int(d["generation"]),
             hostnames=tuple(str(h) for h in d["hostnames"]),
             coordinator_address=str(d.get("coordinator_address", "")),
+            # absent in pre-reshape state files: loads as a first formation
+            reshaped_from=tuple(
+                str(s) for s in d.get("reshaped_from", ())),
+            degraded=bool(d.get("degraded", False)),
         )
 
 
-def save_membership(path: str, membership: Membership) -> None:
+def save_membership(
+    path: str,
+    membership: Membership,
+    member_coords: Optional[Dict[str, Tuple[int, ...]]] = None,
+) -> None:
     """Atomic write (tmp + rename in the target dir): a crash mid-write
     must leave either the old file or the new one, never a torn JSON —
-    the whole point of the state file is surviving exactly such crashes."""
+    the whole point of the state file is surviving exactly such crashes.
+
+    *member_coords* (coordinator only) additionally persists each
+    member's ICI coordinate so a re-form AFTER a coordinator crash still
+    ranks by physical mesh order instead of falling back to hostname
+    sort; clients omit it and the key stays absent."""
+    payload = membership.to_dict()
+    if member_coords:
+        payload["member_coords"] = {
+            h: list(c) for h, c in sorted(member_coords.items())}
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, prefix=".membership-")
     try:
         with os.fdopen(fd, "w", encoding="utf-8") as f:
-            json.dump(membership.to_dict(), f, indent=1)
+            json.dump(payload, f, indent=1)
         os.replace(tmp, path)
     except BaseException:
         try:
@@ -95,6 +121,20 @@ def save_membership(path: str, membership: Membership) -> None:
         except OSError:
             pass
         raise
+
+
+def load_member_coords(path: str) -> Dict[str, Tuple[int, ...]]:
+    """The persisted per-member ICI coordinates ({} when absent or
+    unreadable) — the coordinator's crash-recovery complement to
+    :func:`load_membership`."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            d = json.load(f)
+        raw = d.get("member_coords", {})
+        return {str(h): tuple(int(x) for x in c)
+                for h, c in raw.items()}
+    except (OSError, ValueError, TypeError, AttributeError):
+        return {}
 
 
 def load_membership(path: str) -> Optional[Membership]:
@@ -171,6 +211,7 @@ class SliceState:
         heartbeat_timeout_s: float = 0.0,
         epoch: float = 0.0,
         metrics: Optional["SliceMetrics"] = None,
+        reshape_grace_s: float = 0.0,
     ) -> None:
         if expected_workers < 1:
             raise ValueError(f"expected_workers must be >= 1, got "
@@ -180,6 +221,16 @@ class SliceState:
         self.state_path = state_path
         # 0 disables staleness demotion (tests drive heartbeats manually)
         self.heartbeat_timeout_s = heartbeat_timeout_s
+        # 0 disables degraded-mode reshaping: the slice stays demoted
+        # until every member recovers (the pre-reshape contract).  > 0:
+        # an unhealthy verdict opens a reshape window; members still
+        # unhealthy at expiry are evicted and the survivors re-form
+        # under the next generation.
+        self.reshape_grace_s = reshape_grace_s
+        self._reshape_started: Optional[float] = None
+        # hosts evicted by a reshape: a returning one is re-admitted
+        # into the NEXT generation (never resurrects the old one)
+        self._evicted: Set[str] = set()
         self._epoch = epoch
         self._members: Dict[str, _Member] = {}
         self._membership: Optional[Membership] = None
@@ -201,11 +252,17 @@ class SliceState:
             if prior is not None:
                 # Crash recovery: adopt the persisted slice as-is.  Members
                 # exist from the start (ranks already assigned); they
-                # refresh their sessions as they heartbeat/rejoin.
+                # refresh their sessions as they heartbeat/rejoin.  Their
+                # persisted ICI coordinates come back too, so a LATER
+                # re-form (reshape/regrow) still ranks by physical mesh
+                # order.
+                prior_coords = load_member_coords(state_path)
                 self._membership = prior
                 self._generation = prior.generation
                 for hostname in prior.hostnames:
-                    self._members[hostname] = _Member(hostname=hostname)
+                    self._members[hostname] = _Member(
+                        hostname=hostname,
+                        coords=prior_coords.get(hostname, ()))
                 log.info(
                     "recovered slice %s gen %d (%d workers) from %s",
                     prior.slice_id, prior.generation,
@@ -228,6 +285,23 @@ class SliceState:
         member = self._members.get(hostname)
         if member is None:
             if self._membership is not None:
+                if self.reshape_grace_s > 0 and (
+                    hostname in self._evicted
+                    # a restarted coordinator forgets who it evicted:
+                    # while the slice runs degraded below its configured
+                    # size, an unknown joiner is treated as a returning
+                    # member (repair), never on a full healthy slice
+                    or (self._membership.degraded
+                        and len(self._members) < self.expected)
+                ):
+                    # A member evicted by a reshape is returning: it joins
+                    # the NEXT generation — survivors + returnee re-form
+                    # immediately (rank contract changes, workloads
+                    # checkpoint-restart) — never the generation it was
+                    # evicted from.
+                    return self._readmit(
+                        hostname, coords=coords, chip_count=chip_count,
+                        session=session, now=now)
                 # Formed slice, unknown host: ranks are already handed to
                 # running containers — admitting a stranger would silently
                 # change the contract under them.
@@ -270,11 +344,12 @@ class SliceState:
             membership=m,
         )
 
-    def _form(self) -> None:
+    def _form(self, lineage: Tuple[str, ...] = ()) -> None:
         """Assign deterministic ranks: members WITH ICI coordinates sort
         first by coordinate (rank order then matches the physical mesh,
         which is what TPU_WORKER_ID means to libtpu), coordinate-less
-        members after them by hostname.  Join order never matters."""
+        members after them by hostname.  Join order never matters.
+        *lineage* carries the reshape ancestry into the new generation."""
         ordered = sorted(
             self._members.values(),
             key=lambda mb: (0, mb.coords, mb.hostname) if mb.coords
@@ -293,20 +368,60 @@ class SliceState:
             generation=self._generation,
             hostnames=tuple(hostnames),
             coordinator_address=f"{hostnames[0]}:{self.jax_port}",
+            reshaped_from=lineage,
+            degraded=len(hostnames) < self.expected,
         )
-        log.info("slice %s formed: ranks %s, coordinator %s",
-                 self._membership.slice_id, hostnames,
-                 self._membership.coordinator_address)
+        log.info("slice %s formed (gen %d%s): ranks %s, coordinator %s",
+                 self._membership.slice_id, self._generation,
+                 ", degraded" if self._membership.degraded else "",
+                 hostnames, self._membership.coordinator_address)
         if self._metrics is not None:
             self._metrics.transition("formed")
         if self.state_path:
             try:
-                save_membership(self.state_path, self._membership)
+                save_membership(
+                    self.state_path, self._membership,
+                    member_coords={mb.hostname: mb.coords
+                                   for mb in ordered})
             except OSError as e:
                 # Keep serving: persistence failing degrades crash
                 # recovery, not the live slice.
                 log.error("cannot persist slice state to %s: %s",
                           self.state_path, e)
+
+    def _readmit(
+        self,
+        hostname: str,
+        coords: Tuple[int, ...],
+        chip_count: int,
+        session: str,
+        now: float,
+    ) -> JoinResult:
+        """Re-admit a reshape-evicted host: survivors + returnee re-form
+        into the next generation (lineage extended with the generation
+        being left behind)."""
+        old = self._membership
+        assert old is not None
+        self._evicted.discard(hostname)
+        self._members[hostname] = _Member(
+            hostname=hostname, coords=tuple(coords),
+            chip_count=chip_count, session=session, last_seen=now,
+        )
+        log.info("evicted member %s returned; re-forming slice %s into "
+                 "the next generation", hostname, old.slice_id)
+        self._form(lineage=old.reshaped_from + (old.slice_id,))
+        if self._metrics is not None:
+            self._metrics.reshape_outcome("grown")
+        m = self._membership
+        assert m is not None
+        rank = m.rank_of(hostname)
+        return JoinResult(
+            formed=True,
+            rank=rank if rank is not None else -1,
+            joined=len(self._members),
+            expected=self.expected,
+            membership=m,
+        )
 
     def leave(self, hostname: str) -> None:
         """Explicit departure.  Before formation the seat frees up; after,
@@ -358,9 +473,9 @@ class SliceState:
                 max(0.0, now - self._demoted_at))
         return view
 
-    def health(self, now: float = 0.0) -> HealthView:
-        """Slice-wide verdict: every member healthy, present, and (when a
-        timeout is configured) recently heard from."""
+    def _unhealthy(self, now: float) -> List[str]:
+        """Members currently dragging the verdict down: reported
+        unhealthy, departed, or (when a timeout is configured) silent."""
         unhealthy: List[str] = []
         for mb in self._members.values():
             if not mb.healthy or mb.departed:
@@ -370,6 +485,72 @@ class SliceState:
                 seen = mb.last_seen if mb.last_seen is not None else self._epoch
                 if now - seen > self.heartbeat_timeout_s:
                     unhealthy.append(mb.hostname)
+        return unhealthy
+
+    def _reshape_tick(self, unhealthy: List[str], now: float) -> List[str]:
+        """Degraded-mode reshape window (reshape_grace_s > 0, formed
+        slice).  An unhealthy verdict opens the window; recovery inside
+        it cancels (the original generation holds, demote-all semantics
+        meanwhile); at expiry the still-unhealthy members are evicted
+        and the survivors re-form into a smaller slice under the next
+        generation.  Returns the (possibly recomputed) unhealthy set."""
+        if not unhealthy:
+            if self._reshape_started is not None:
+                # every member recovered inside the grace window: no
+                # reshape, the original generation holds
+                self._reshape_started = None
+                log.info("reshape window cancelled: all members of slice "
+                         "%s recovered within the grace period",
+                         self._membership.slice_id
+                         if self._membership else "?")
+                if self._metrics is not None:
+                    self._metrics.reshape_outcome("cancelled")
+            return unhealthy
+        started = self._reshape_started
+        if started is None:
+            self._reshape_started = now
+            log.warning(
+                "reshape window opened: members %s unhealthy; evicting "
+                "in %.1fs unless they recover", sorted(unhealthy),
+                self.reshape_grace_s)
+            return unhealthy
+        if now - started < self.reshape_grace_s:
+            return unhealthy
+        evict = set(unhealthy)
+        survivors = [h for h in self._members if h not in evict]
+        if not survivors:
+            # no valid smaller topology to re-form onto; stay demoted
+            # and keep watching (a fresh window restarts the clock)
+            self._reshape_started = None
+            if self._metrics is not None:
+                self._metrics.reshape_outcome("no_survivors")
+            return unhealthy
+        old = self._membership
+        assert old is not None
+        self._reshape_started = None
+        for h in sorted(evict):
+            self._members.pop(h, None)
+            self._evicted.add(h)
+        log.warning(
+            "reshaping slice %s: evicted %s after %.1fs grace; "
+            "re-forming over survivors %s", old.slice_id, sorted(evict),
+            now - started, sorted(survivors))
+        self._form(lineage=old.reshaped_from + (old.slice_id,))
+        if self._metrics is not None:
+            self._metrics.reshape_outcome("reshaped")
+            self._metrics.reshape_seconds.observe(max(0.0, now - started))
+        # evicted members owe no verdict deliveries anymore
+        self._awaiting_delivery -= evict
+        return self._unhealthy(now)
+
+    def health(self, now: float = 0.0) -> HealthView:
+        """Slice-wide verdict: every member healthy, present, and (when a
+        timeout is configured) recently heard from.  With a reshape grace
+        configured, a persistently-unhealthy member set is evicted here
+        (see :meth:`_reshape_tick`) instead of demoting forever."""
+        unhealthy = self._unhealthy(now)
+        if self._membership is not None and self.reshape_grace_s > 0:
+            unhealthy = self._reshape_tick(unhealthy, now)
         formed = self._membership is not None
         verdict = formed and not unhealthy
         if formed and verdict != self._last_verdict:

@@ -27,6 +27,9 @@ SUPPORTED_LABELS = [
     "core-partition",                # current partition granularity (chip / core)
     "slice-id",                      # formed-slice identity hash (pod affinity key)
     "slice-rank",                    # this host's rendezvous-assigned rank
+    "slice-generation",              # membership generation (bumps on reshape)
+    "slice-workers",                 # hosts in the CURRENT generation (shrinks on reshape)
+    "slice-degraded",                # "true" when reshaped below the configured size
 ]
 
 # Label prefixes.  The reference emits both amd.com/gpu.* and a legacy
@@ -165,6 +168,11 @@ ENV_TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
 ENV_JAX_COORDINATOR_ADDRESS = "JAX_COORDINATOR_ADDRESS"
 ENV_JAX_NUM_PROCESSES = "JAX_NUM_PROCESSES"
 ENV_JAX_PROCESS_ID = "JAX_PROCESS_ID"
+# Membership generation the identity above belongs to: workloads compare
+# it against the live membership file (workloads.checkpoint.ReshapeSignal)
+# to detect that the slice reshaped under them and a checkpoint-restart
+# is due.
+ENV_TPU_SLICE_GENERATION = "TPU_SLICE_GENERATION"
 
 # Host-local metadata file written by the TPU VM runtime / GKE (fixture-able
 # stand-in for the GCE metadata server's tpu-env attribute).
@@ -193,10 +201,21 @@ SLICE_STATE_FILE = "/var/lib/tpu-slice/membership.json"
 SLICE_HEARTBEAT_PERIOD_S = 5.0
 SLICE_HEARTBEAT_TIMEOUT_S = 30.0
 
+# Degraded-mode reshape grace window, seconds.  0 (the default) disables
+# reshaping entirely: an unhealthy member demotes the whole slice until
+# it recovers, exactly the pre-reshape behavior.  > 0: once the slice
+# verdict flips unhealthy, the coordinator waits this long; members still
+# unhealthy/absent at expiry are evicted and the survivors re-form into a
+# smaller slice under the next generation (workloads restart from
+# checkpoint under the new identity — see docs/user-guide/resilience.md
+# §Reshape runbook).
+SLICE_RESHAPE_GRACE_S = 0.0
+
 # Env overrides for the --slice-* flags (DaemonSets set env more easily
 # than per-node args).
 ENV_SLICE_RENDEZVOUS = "TPU_DP_SLICE_RENDEZVOUS"
 ENV_SLICE_WORKERS = "TPU_DP_SLICE_WORKERS"
+ENV_SLICE_RESHAPE_GRACE = "TPU_DP_SLICE_RESHAPE_GRACE_S"
 
 # Flight recorder (PR 4): where the crash-safe event-journal dump lands
 # on exit/SIGTERM.  The DaemonSet mounts a hostPath here so the

@@ -136,6 +136,96 @@ def run_sharded(batch: int, steps: int, warmup: int, s2d: bool = True,
     )
 
 
+def run_elastic(
+    batch: int,
+    steps: int,
+    checkpoint_dir: str,
+    checkpoint_every: int,
+    slice_state: str,
+    s2d: bool = True,
+    sharded: bool = False,
+    pool=None,
+    signal=None,
+) -> int:
+    """Checkpointed train loop for elastic slices: resume from the
+    newest whole checkpoint, save every *checkpoint_every* steps, and —
+    when the slice reshapes under us (ReshapeSignal observes the
+    membership generation moving past the one our TPU_SLICE_GENERATION
+    identity was issued for) — checkpoint immediately and exit with
+    RESHAPE_EXIT_CODE so the orchestrator restarts this pod under the
+    new generation's TPU_WORKER_ID/JAX_* contract.  Reformation becomes
+    a restart, not a loss (docs/user-guide/resilience.md §Reshape
+    runbook)."""
+    from . import checkpoint as ckpt
+    from .alexnet import create_train_state, synthetic_batch, train_step
+
+    if signal is None:
+        signal = ckpt.ReshapeSignal(slice_state)
+    rng = jax.random.PRNGKey(0)
+    model, state = create_train_state(
+        rng, batch_size=batch, s2d=s2d, pool=_resolve_pool(pool))
+    params, opt_state, tx = state["params"], state["opt_state"], state["tx"]
+    images, labels = synthetic_batch(rng, batch, s2d=s2d)
+    shardings = None
+    if sharded:
+        from .parallel import make_mesh, make_sharded_train_step
+
+        mesh = make_mesh()
+        step_fn, params, opt_state, (img_sh, lbl_sh) = \
+            make_sharded_train_step(model, tx, mesh, params, opt_state)
+        images = jax.device_put(images, img_sh)
+        labels = jax.device_put(labels, lbl_sh)
+        shardings = jax.tree_util.tree_map(
+            lambda l: l.sharding, {"params": params,
+                                   "opt_state": opt_state})
+    else:
+        step_fn = jax.jit(functools.partial(train_step, model, tx))
+
+    start = 0
+    latest = ckpt.latest_step(checkpoint_dir)
+    if latest is not None:
+        restored = ckpt.restore_checkpoint(
+            checkpoint_dir,
+            template={"params": params, "opt_state": opt_state},
+            shardings=shardings,
+        )
+        params, opt_state = restored["params"], restored["opt_state"]
+        start = latest
+        print(f"resumed from checkpoint step {latest}", flush=True)
+
+    def save(done_steps):
+        ckpt.save_checkpoint(
+            checkpoint_dir, done_steps,
+            {"params": params, "opt_state": opt_state}, keep_last=3)
+
+    loss = None
+    for i in range(start, steps):
+        params, opt_state, loss = step_fn(params, opt_state, images, labels)
+        done = i + 1
+        membership = signal.check()
+        if membership is not None:
+            float(loss)  # drain the dispatched step before serializing
+            save(done)
+            print(
+                f"slice reshaped to gen {membership.generation} "
+                f"({membership.num_workers} worker(s)"
+                f"{', degraded' if membership.degraded else ''}); "
+                f"checkpointed step {done}; exiting "
+                f"{ckpt.RESHAPE_EXIT_CODE} for restart under the new "
+                "identity", flush=True,
+            )
+            return ckpt.RESHAPE_EXIT_CODE
+        if checkpoint_every and done % checkpoint_every == 0 \
+                and done < steps:
+            save(done)
+    if loss is not None:
+        print(f"final loss after {steps} steps: {float(loss):.4f}",
+              flush=True)
+    if steps > start:
+        save(steps)
+    return 0
+
+
 def _maybe_init_distributed() -> bool:
     """Join a multi-host slice when the deployment wired one up.
 
@@ -181,6 +271,17 @@ def main(argv=None) -> int:
     p.add_argument("--pool", choices=("xla", "pallas", "fused"),
                    default=None,
                    help="max-pool impl (default: $ALEXNET_POOL or xla)")
+    p.add_argument("--checkpoint-dir", default="",
+                   help="elastic mode: checkpoint/resume under this dir "
+                        "(PVC mount); on a slice reshape the loop saves "
+                        "and exits 77 for a restart under the new "
+                        "identity")
+    p.add_argument("--checkpoint-every", type=int, default=10,
+                   help="steps between periodic checkpoints in elastic "
+                        "mode (default 10; 0 = only reshape/final saves)")
+    p.add_argument("--slice-state", default=None,
+                   help="slice membership file the reshape watch reads "
+                        "(default: the device plugin's standard path)")
     args = p.parse_args(argv)
     if args.steps < 1:
         p.error("--steps must be >= 1")
@@ -193,6 +294,15 @@ def main(argv=None) -> int:
         )
     devs = jax.devices()
     print(f"devices: {len(devs)} x {devs[0].platform}", flush=True)
+    if args.checkpoint_dir:
+        from tpu_k8s_device_plugin.types import constants
+
+        return run_elastic(
+            args.batch, args.steps, args.checkpoint_dir,
+            args.checkpoint_every,
+            args.slice_state or constants.SLICE_STATE_FILE,
+            sharded=args.sharded, pool=args.pool,
+        )
     if args.sharded:
         ips = run_sharded(args.batch, args.steps, args.warmup,
                           pool=args.pool)

@@ -9,6 +9,12 @@ JAX checkpoints on TPU pods:
 
 * **whole-pytree save/restore** via orbax's PyTree handler — params,
   optimizer state, and the step counter in one atomic directory;
+* **crash-safe saves**: every save writes into a hidden temp dir and
+  commits with one ``os.replace``; a pod killed mid-save leaves a
+  ``.step_*.tmp-*`` orphan (swept by the next save), never a torn
+  ``step_N`` that a resume would trip over.  ``latest_step`` /
+  ``restore_checkpoint`` additionally *skip* torn or partial step dirs
+  (external copies, pre-atomic writers) instead of raising;
 * **sharding-aware restore**: pass the target shardings (e.g. from
   ``transformer.lm_tree_shardings``) and every leaf is restored
   DIRECTLY onto its mesh placement — no host-memory staging of the
@@ -17,7 +23,15 @@ JAX checkpoints on TPU pods:
 * **k8s-shaped layout**: one directory per step under a base dir (the
   pod's PVC/GCS mount), ``latest_step`` discovery, and keep-last-N
   garbage collection, so a rescheduled pod resumes from wherever its
-  predecessor died.
+  predecessor died;
+* **elastic-slice restarts**: :class:`ReshapeSignal` watches the slice
+  membership file the device plugin maintains; when the slice reshapes
+  under a running job (a member was evicted, survivors re-formed into
+  a smaller generation — see docs/user-guide/resilience.md §Reshape
+  runbook), the train loop checkpoints and exits with
+  :data:`RESHAPE_EXIT_CODE` so the orchestrator restarts it under the
+  new generation's ``TPU_WORKER_ID``/``JAX_*`` identity.  Reformation
+  becomes a restart, not a loss.
 
 Resume-equivalence is oracle-tested ACROSS processes: one interpreter
 trains, checkpoints, and is SIGKILLed (no cleanup — a preempted pod);
@@ -29,19 +43,65 @@ mesh shape than the save ran on is exercised too
 
 from __future__ import annotations
 
+import json
+import logging
 import os
 import re
 import shutil
-from typing import Any, Dict, Optional
+import tempfile
+import threading
+from typing import Any, Dict, List, Optional
 
 import jax
 import orbax.checkpoint as ocp
 
+from tpu_k8s_device_plugin.slice.state import Membership, load_membership
+from tpu_k8s_device_plugin.types import constants
+
+log = logging.getLogger(__name__)
+
 _STEP_RE = re.compile(r"^step_(\d+)$")
+_TMP_PREFIX = ".step-tmp-"
+# orbax's own commit artifact: its metadata JSON.  A step dir missing it
+# (or with an unparseable one — a truncated copy) is torn and skipped.
+_ORBAX_METADATA = ("_CHECKPOINT_METADATA", "_METADATA")
+
+# Exit code a reshape-interrupted workload leaves with after its final
+# checkpoint: distinct from crash codes so supervisors/JobSets can tell
+# "restart me under the new slice identity" from a real failure.
+RESHAPE_EXIT_CODE = 77
 
 
 def _step_dir(base: str, step: int) -> str:
     return os.path.join(base, f"step_{step}")
+
+
+def _step_complete(path: str) -> bool:
+    """Structural torn-dir check: the dir must hold a parseable orbax
+    metadata file.  Our own saves commit atomically (tmp + rename), so
+    this guards against external copies interrupted mid-transfer and
+    truncated files."""
+    for name in _ORBAX_METADATA:
+        meta = os.path.join(path, name)
+        if os.path.isfile(meta):
+            try:
+                with open(meta, "r", encoding="utf-8") as f:
+                    json.load(f)
+                return True
+            except (OSError, ValueError):
+                return False
+    return False
+
+
+def _sweep_orphans(base: str) -> None:
+    """Remove temp dirs a crashed save left behind (best-effort)."""
+    try:
+        names = os.listdir(base)
+    except OSError:
+        return
+    for name in names:
+        if name.startswith(_TMP_PREFIX):
+            shutil.rmtree(os.path.join(base, name), ignore_errors=True)
 
 
 def save_checkpoint(
@@ -50,30 +110,54 @@ def save_checkpoint(
 ) -> str:
     """Atomically save *state* (any pytree — typically
     ``{"params": ..., "opt_state": ...}``) under ``base_dir/step_<n>``.
+
+    The tree is written into a hidden temp dir in the same filesystem
+    and committed with one ``os.replace`` — a crash at ANY point leaves
+    either no ``step_<n>`` or a whole one, never a torn directory.
     With *keep_last*, older step dirs beyond the newest N are removed
     after a successful save (never before)."""
     if step < 0:
         raise ValueError(f"step must be >= 0, got {step}")
-    path = os.path.abspath(_step_dir(base_dir, step))
-    ckpt = ocp.PyTreeCheckpointer()
-    ckpt.save(path, state, force=True)
+    base = os.path.abspath(base_dir)
+    os.makedirs(base, exist_ok=True)
+    _sweep_orphans(base)
+    final = _step_dir(base, step)
+    tmp = tempfile.mkdtemp(dir=base, prefix=_TMP_PREFIX)
+    try:
+        ckpt = ocp.PyTreeCheckpointer()
+        ckpt.save(tmp, state, force=True)
+        if os.path.isdir(final):
+            # overwrite semantics of the old force=True save: drop the
+            # stale step before the commit rename
+            shutil.rmtree(final)
+        os.replace(tmp, final)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
     if keep_last is not None:
         if keep_last < 1:
             raise ValueError("keep_last must be >= 1 when set")
-        for old in sorted(list_steps(base_dir))[:-keep_last]:
-            shutil.rmtree(_step_dir(base_dir, old), ignore_errors=True)
-    return path
+        for old in list_steps(base)[:-keep_last]:
+            shutil.rmtree(_step_dir(base, old), ignore_errors=True)
+    return final
 
 
-def list_steps(base_dir: str):
-    """Completed checkpoint steps under *base_dir* (ascending)."""
+def list_steps(base_dir: str) -> List[int]:
+    """Completed checkpoint steps under *base_dir* (ascending).  Torn or
+    partial step dirs are skipped, not raised on — a resume must come up
+    from the newest WHOLE checkpoint."""
     if not os.path.isdir(base_dir):
         return []
     steps = []
     for name in os.listdir(base_dir):
         m = _STEP_RE.match(name)
-        if m:
-            steps.append(int(m.group(1)))
+        if not m:
+            continue
+        if not _step_complete(os.path.join(base_dir, name)):
+            log.warning("skipping torn checkpoint dir %s",
+                        os.path.join(base_dir, name))
+            continue
+        steps.append(int(m.group(1)))
     return sorted(steps)
 
 
@@ -88,7 +172,13 @@ def restore_checkpoint(
     template: Any = None,
     shardings: Any = None,
 ) -> Dict[str, Any]:
-    """Restore the checkpoint at *step* (default: latest).
+    """Restore the checkpoint at *step* (default: newest restorable).
+
+    Without an explicit *step*, torn checkpoints are skipped: if the
+    newest step dir fails to restore (truncated files under a complete-
+    looking structure), the next older one is tried, so a damaged tail
+    never strands a resumable job.  An explicit *step* restores exactly
+    that one or raises.
 
     ``template`` is an abstract/example pytree giving the structure and
     leaf shapes/dtypes; with ``shardings`` (a matching pytree of
@@ -96,14 +186,33 @@ def restore_checkpoint(
     device placement — pass ``lm_tree_shardings(mesh, template)`` to
     resume a sharded training job without staging the full tree on one
     host."""
-    if step is None:
-        step = latest_step(base_dir)
-        if step is None:
-            raise FileNotFoundError(
-                f"no checkpoints under {base_dir!r}")
-    path = os.path.abspath(_step_dir(base_dir, step))
-    if not os.path.isdir(path):
-        raise FileNotFoundError(f"no checkpoint at {path!r}")
+    if step is not None:
+        path = os.path.abspath(_step_dir(base_dir, step))
+        if not os.path.isdir(path):
+            raise FileNotFoundError(f"no checkpoint at {path!r}")
+        return _restore_one(path, template, shardings)
+    candidates = list_steps(base_dir)
+    if not candidates:
+        raise FileNotFoundError(f"no checkpoints under {base_dir!r}")
+    last_err: Optional[BaseException] = None
+    for cand in reversed(candidates):
+        path = os.path.abspath(_step_dir(base_dir, cand))
+        try:
+            return _restore_one(path, template, shardings)
+        except Exception as e:
+            # a structurally-complete dir that still fails to load is
+            # torn below the metadata (truncated array files): fall back
+            # to the next older whole checkpoint
+            log.warning("checkpoint %s unrestorable (%s); trying older",
+                        path, e)
+            last_err = e
+    raise FileNotFoundError(
+        f"no restorable checkpoint under {base_dir!r} "
+        f"(last error: {last_err})")
+
+
+def _restore_one(path: str, template: Any, shardings: Any
+                 ) -> Dict[str, Any]:
     ckpt = ocp.PyTreeCheckpointer()
     if template is None:
         return ckpt.restore(path)
@@ -121,3 +230,76 @@ def restore_checkpoint(
     # leaf into an ArrayRestoreArgs carrying its sharding
     restore_args = ocp.checkpoint_utils.construct_restore_args(target)
     return ckpt.restore(path, target, restore_args=restore_args)
+
+
+class ReshapeSignal:
+    """Cooperative elastic-slice restart hook for train loops.
+
+    The device plugin stamps every slice-coordinated container with
+    ``TPU_SLICE_GENERATION`` (the membership generation its
+    ``TPU_WORKER_ID``/``JAX_*`` identity belongs to) and keeps the
+    crash-safe membership file current as the slice reshapes.  A train
+    loop polls :meth:`check` between steps; once the live generation
+    moves past the baseline — survivors re-formed without a member, or
+    an evicted member returned — the loop saves a final checkpoint and
+    exits with :data:`RESHAPE_EXIT_CODE` so the orchestrator restarts
+    it under the new generation's identity::
+
+        signal = ReshapeSignal(state_path)
+        for step in range(start, steps):
+            params, opt_state, loss = train_step(...)
+            if signal.check() is not None:
+                save_checkpoint(ckpt_dir, step, state)
+                raise SystemExit(RESHAPE_EXIT_CODE)
+
+    In-process integrations (tests, single-binary harnesses) can skip
+    the file watch and wire :meth:`fire` straight to
+    ``SliceClient.set_reshape_callback``.
+    """
+
+    def __init__(
+        self,
+        state_path: str = constants.SLICE_STATE_FILE,
+        generation: Optional[int] = None,
+    ) -> None:
+        self._path = state_path
+        self._lock = threading.Lock()
+        self._fired: Optional[Membership] = None
+        if generation is not None:
+            self.baseline = generation
+        else:
+            env_gen = os.environ.get(constants.ENV_TPU_SLICE_GENERATION)
+            if env_gen:
+                # the generation Allocate stamped this container with: the
+                # authoritative baseline even if the file already moved on
+                self.baseline = int(env_gen)
+            else:
+                m = load_membership(state_path)
+                self.baseline = m.generation if m is not None else 0
+
+    def fire(self, old: Optional[Membership], new: Membership) -> None:
+        """Direct wiring for ``SliceClient.set_reshape_callback``."""
+        with self._lock:
+            self._fired = new
+
+    def check(self) -> Optional[Membership]:
+        """The new membership once the slice has reshaped past this
+        job's baseline generation; None while the identity holds.  A
+        dissolved slice (membership file gone) is NOT a reshape — the
+        job keeps running on whatever devices it holds."""
+        with self._lock:
+            if self._fired is not None:
+                return self._fired
+        m = load_membership(self._path)
+        if m is None or self.baseline <= 0:
+            return None
+        if m.generation != self.baseline:
+            with self._lock:
+                self._fired = m
+            return m
+        return None
+
+    @property
+    def triggered(self) -> bool:
+        with self._lock:
+            return self._fired is not None
