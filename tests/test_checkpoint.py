@@ -255,6 +255,44 @@ def test_save_commits_atomically(tmp_path):
     ), "orphaned temp dirs must be swept"
 
 
+def test_multihost_save_shares_tmp_and_gates_commit(tmp_path,
+                                                    monkeypatch):
+    """Multi-host sharded saves (every rank on one shared RWX volume):
+    orbax's save is a collective, so every process must write into ONE
+    deterministic tmp dir, and only process 0 may sweep orphans, commit
+    the rename, and garbage-collect — a non-primary rank doing any of
+    those would tear peers' in-flight saves."""
+    from tpu_k8s_device_plugin.workloads import checkpoint as ckpt_mod
+
+    _, params, _, _ = _setup()
+    barriers = []
+    monkeypatch.setattr(ckpt_mod, "_process_count", lambda: 2)
+    monkeypatch.setattr(ckpt_mod, "_barrier",
+                        lambda name: barriers.append(name))
+    orphan = tmp_path / f"{ckpt_mod._TMP_PREFIX}orphan"
+    orphan.mkdir()
+
+    # rank 1: writes shards into the shared tmp name, nothing else
+    monkeypatch.setattr(ckpt_mod, "_process_index", lambda: 1)
+    save_checkpoint(str(tmp_path), 4, {"params": params}, keep_last=1)
+    assert (tmp_path / f"{ckpt_mod._TMP_PREFIX}4").is_dir(), \
+        "non-primary must write into the deterministic shared tmp dir"
+    assert not (tmp_path / "step_4").exists(), \
+        "only process 0 commits the rename"
+    assert orphan.is_dir(), "only process 0 sweeps orphans"
+    assert barriers, "multi-host saves must fence on barriers"
+
+    # rank 0: sweeps, commits, GCs
+    monkeypatch.setattr(ckpt_mod, "_process_index", lambda: 0)
+    save_checkpoint(str(tmp_path), 4, {"params": params}, keep_last=1)
+    assert list_steps(str(tmp_path)) == [4]
+    assert not orphan.exists()
+    assert not any(
+        name.startswith(ckpt_mod._TMP_PREFIX)
+        for name in os.listdir(tmp_path)
+    )
+
+
 def test_quantize_after_restore_serves(tmp_path):
     # the serving handoff: restore a trained tree, quantize, decode
     from tpu_k8s_device_plugin.workloads.inference import (

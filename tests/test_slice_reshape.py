@@ -161,13 +161,110 @@ class TestStateMachine:
         revived = SliceState(3, _JAX_PORT, state_path=path,
                              heartbeat_timeout_s=5.0, reshape_grace_s=3.0)
         assert revived.membership == gen2
-        # the revived coordinator forgot who it evicted, but a degraded
-        # slice below its configured size re-admits the returnee anyway
+        # the evicted set is persisted too: the revived coordinator
+        # recognizes the returnee instead of treating it as a stranger
+        assert revived._evicted == {"host-c"}
         res = revived.join("host-c", coords=(2,), chip_count=8,
                            session="host-c-reborn", now=0.0)
         assert res.formed and res.rank == 2
         assert revived.membership.generation == gen2.generation + 1
         assert not revived.membership.degraded
+
+    def test_returnee_rejected_when_seat_refilled(self):
+        """A replacement host fills the degraded seat; the originally-
+        evicted member then returns: it must be rejected (the slice is
+        back at full strength) — over-admitting would hand out more
+        ranks than the physical topology holds and generation-bump
+        (checkpoint-restart) every workload on a healthy slice."""
+        s = SliceState(3, _JAX_PORT, heartbeat_timeout_s=5.0,
+                       reshape_grace_s=3.0)
+        _form_three(s)
+        s.heartbeat("host-a", True, now=6.0)   # window opens on host-c
+        s.heartbeat("host-b", True, now=8.0)
+        s.heartbeat("host-a", True, now=9.5)   # expiry evicts host-c
+        assert s.membership.hostnames == ("host-a", "host-b")
+        # a fresh replacement node repairs the degraded seat
+        res = s.join("host-z", coords=(2,), chip_count=8,
+                     session="z-s0", now=10.0)
+        assert res.formed
+        gen3 = s.membership
+        assert gen3.hostnames == ("host-a", "host-b", "host-z")
+        assert not gen3.degraded
+        # the evicted original returns to a full slice: rejected, and
+        # the running generation holds
+        res = s.join("host-c", coords=(2,), chip_count=8,
+                     session="host-c-reborn", now=12.0)
+        assert res.error and "not a member" in res.error
+        assert s.membership == gen3
+
+    def test_late_blip_gets_full_grace(self):
+        """Per-member windows: a member that blips just before another
+        member's window expires is NOT swept into that eviction — a
+        single global window would grant it near-zero individual
+        grace."""
+        s = SliceState(3, _JAX_PORT, reshape_grace_s=3.0)
+        _form_three(s)
+        s.heartbeat("host-c", False, reason="wedged", now=0.0)
+        s.heartbeat("host-b", False, reason="blip", now=2.5)
+        v = s.heartbeat("host-a", True, now=3.5)  # c expires; b survives
+        m = s.membership
+        assert m.generation == 2
+        assert m.hostnames == ("host-a", "host-b"), \
+            "the late-blipping member keeps its own full grace window"
+        assert not v.slice_healthy
+        assert v.unhealthy_hostnames == ["host-b"]
+        # b recovers inside ITS window: no second reshape
+        v = s.heartbeat("host-b", True, now=4.0)
+        assert v.slice_healthy
+        assert s.membership.generation == 2
+
+    def test_client_save_preserves_coordinator_keys(self, tmp_path):
+        """On the rendezvous host the coordinator's SliceState and the
+        local SliceClient share one --slice-state-file: a client-side
+        save (no coordinator extras) must preserve member_coords and
+        the evicted set, or a post-crash re-form falls back to
+        hostname-sorted ranks and forgets returnees."""
+        from tpu_k8s_device_plugin.slice.state import (
+            load_evicted,
+            load_member_coords,
+            save_membership,
+        )
+
+        path = str(tmp_path / "membership.json")
+        s = SliceState(3, _JAX_PORT, state_path=path,
+                       heartbeat_timeout_s=5.0, reshape_grace_s=3.0)
+        # ICI mesh order is the REVERSE of hostname order
+        s.join("host-a", coords=(2,), chip_count=8, now=0.0)
+        s.join("host-b", coords=(1,), chip_count=8, now=0.0)
+        s.join("host-c", coords=(0,), chip_count=8, now=0.0)
+        gen1 = s.membership
+        assert gen1.hostnames == ("host-c", "host-b", "host-a")
+        coords = load_member_coords(path)
+        assert coords == {"host-a": (2,), "host-b": (1,),
+                          "host-c": (0,)}
+        # the co-located client adopts and persists the SAME membership
+        # without coordinator extras: both keys must survive
+        save_membership(path, gen1)
+        assert load_member_coords(path) == coords
+        # coordinator crashes and revives from the (client-rewritten)
+        # file; host-a goes silent and the survivors reshape — ranks
+        # must still follow the persisted ICI coords, not hostname sort
+        revived = SliceState(3, _JAX_PORT, state_path=path,
+                             heartbeat_timeout_s=5.0,
+                             reshape_grace_s=3.0)
+        revived.heartbeat("host-c", True, now=6.0)
+        revived.heartbeat("host-b", True, now=8.0)
+        revived.heartbeat("host-c", True, now=9.5)
+        m = revived.membership
+        assert m.generation == gen1.generation + 1
+        assert m.hostnames == ("host-c", "host-b"), \
+            "re-form after crash must keep physical mesh order"
+        # eviction persisted; a client save still must not clobber it
+        assert load_evicted(path) == {"host-a"}
+        save_membership(path, m)
+        assert load_evicted(path) == {"host-a"}
+        assert load_member_coords(path) == {"host-b": (1,),
+                                            "host-c": (0,)}
 
     def test_stranger_still_rejected_on_whole_slice(self):
         """Reshape enabled must NOT open the door for strangers: a full

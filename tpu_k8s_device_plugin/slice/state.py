@@ -95,6 +95,7 @@ def save_membership(
     path: str,
     membership: Membership,
     member_coords: Optional[Dict[str, Tuple[int, ...]]] = None,
+    evicted: Optional[Set[str]] = None,
 ) -> None:
     """Atomic write (tmp + rename in the target dir): a crash mid-write
     must leave either the old file or the new one, never a torn JSON —
@@ -103,11 +104,30 @@ def save_membership(
     *member_coords* (coordinator only) additionally persists each
     member's ICI coordinate so a re-form AFTER a coordinator crash still
     ranks by physical mesh order instead of falling back to hostname
-    sort; clients omit it and the key stays absent."""
+    sort; *evicted* (coordinator only) persists the reshape-evicted set
+    so a revived coordinator still recognizes returnees.  Callers that
+    omit them (clients) PRESERVE whatever the file already holds — on
+    the rendezvous host the coordinator and the local client share one
+    state file, and a client-side save must not clobber the
+    coordinator's crash-recovery keys."""
     payload = membership.to_dict()
-    if member_coords:
+    prior: Optional[Dict[str, Any]] = None
+    if member_coords is None or evicted is None:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                loaded = json.load(f)
+            prior = loaded if isinstance(loaded, dict) else None
+        except (OSError, ValueError):
+            prior = None
+    if member_coords is not None:
         payload["member_coords"] = {
             h: list(c) for h, c in sorted(member_coords.items())}
+    elif prior is not None and "member_coords" in prior:
+        payload["member_coords"] = prior["member_coords"]
+    if evicted is not None:
+        payload["evicted"] = sorted(str(h) for h in evicted)
+    elif prior is not None and "evicted" in prior:
+        payload["evicted"] = prior["evicted"]
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, prefix=".membership-")
@@ -135,6 +155,18 @@ def load_member_coords(path: str) -> Dict[str, Tuple[int, ...]]:
                 for h, c in raw.items()}
     except (OSError, ValueError, TypeError, AttributeError):
         return {}
+
+
+def load_evicted(path: str) -> Set[str]:
+    """The persisted reshape-evicted hostnames (empty when absent or
+    unreadable) — lets a revived coordinator keep recognizing returnees
+    instead of treating them as strangers."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            d = json.load(f)
+        return {str(h) for h in d.get("evicted", ())}
+    except (OSError, ValueError, TypeError, AttributeError):
+        return set()
 
 
 def load_membership(path: str) -> Optional[Membership]:
@@ -223,11 +255,15 @@ class SliceState:
         self.heartbeat_timeout_s = heartbeat_timeout_s
         # 0 disables degraded-mode reshaping: the slice stays demoted
         # until every member recovers (the pre-reshape contract).  > 0:
-        # an unhealthy verdict opens a reshape window; members still
-        # unhealthy at expiry are evicted and the survivors re-form
-        # under the next generation.
+        # a member's first unhealthy observation opens its own reshape
+        # window; members still unhealthy at their window's expiry are
+        # evicted and the survivors re-form under the next generation.
         self.reshape_grace_s = reshape_grace_s
-        self._reshape_started: Optional[float] = None
+        # per-member window clocks: hostname -> first time it was seen
+        # unhealthy in the current incident.  Per-member (not one global
+        # window) so a member that blips just before another member's
+        # window expires still gets its full grace period.
+        self._unhealthy_since: Dict[str, float] = {}
         # hosts evicted by a reshape: a returning one is re-admitted
         # into the NEXT generation (never resurrects the old one)
         self._evicted: Set[str] = set()
@@ -259,6 +295,10 @@ class SliceState:
                 prior_coords = load_member_coords(state_path)
                 self._membership = prior
                 self._generation = prior.generation
+                # the evicted set survives the crash too: returnees are
+                # recognized as such, not treated as strangers
+                self._evicted = (load_evicted(state_path)
+                                 - set(prior.hostnames))
                 for hostname in prior.hostnames:
                     self._members[hostname] = _Member(
                         hostname=hostname,
@@ -285,15 +325,25 @@ class SliceState:
         member = self._members.get(hostname)
         if member is None:
             if self._membership is not None:
-                if self.reshape_grace_s > 0 and (
-                    hostname in self._evicted
-                    # a restarted coordinator forgets who it evicted:
-                    # while the slice runs degraded below its configured
-                    # size, an unknown joiner is treated as a returning
-                    # member (repair), never on a full healthy slice
-                    or (self._membership.degraded
-                        and len(self._members) < self.expected)
-                ):
+                # Readmission requires an OPEN SEAT: re-forming past
+                # expected_workers would hand out more ranks than the
+                # physical topology holds (JAX_NUM_PROCESSES > hosts),
+                # and a full healthy slice must never be generation-
+                # bumped (checkpoint-restarting every workload) by a
+                # returnee whose seat was already refilled.
+                if (self.reshape_grace_s > 0
+                        and len(self._members) < self.expected
+                        and (
+                            hostname in self._evicted
+                            # a coordinator revived from a pre-eviction-
+                            # persistence state file (or whose persist
+                            # failed) forgets who it evicted: while the
+                            # slice runs degraded below its configured
+                            # size, an unknown joiner is treated as a
+                            # returning member (repair), never on a
+                            # full healthy slice
+                            or self._membership.degraded
+                        )):
                     # A member evicted by a reshape is returning: it joins
                     # the NEXT generation — survivors + returnee re-form
                     # immediately (rank contract changes, workloads
@@ -382,7 +432,8 @@ class SliceState:
                 save_membership(
                     self.state_path, self._membership,
                     member_coords={mb.hostname: mb.coords
-                                   for mb in ordered})
+                                   for mb in ordered},
+                    evicted=set(self._evicted))
             except OSError as e:
                 # Keep serving: persistence failing degrades crash
                 # recovery, not the live slice.
@@ -488,17 +539,26 @@ class SliceState:
         return unhealthy
 
     def _reshape_tick(self, unhealthy: List[str], now: float) -> List[str]:
-        """Degraded-mode reshape window (reshape_grace_s > 0, formed
-        slice).  An unhealthy verdict opens the window; recovery inside
-        it cancels (the original generation holds, demote-all semantics
-        meanwhile); at expiry the still-unhealthy members are evicted
-        and the survivors re-form into a smaller slice under the next
-        generation.  Returns the (possibly recomputed) unhealthy set."""
-        if not unhealthy:
-            if self._reshape_started is not None:
-                # every member recovered inside the grace window: no
+        """Degraded-mode reshape windows (reshape_grace_s > 0, formed
+        slice).  Each member's FIRST unhealthy observation opens that
+        member's own grace window (a single global window would evict a
+        member that blips just before another member's expiry with
+        near-zero individual grace); recovery inside the window cancels
+        that member's clock (the original generation holds, demote-all
+        semantics meanwhile); a member still unhealthy when its own
+        window expires is evicted and the survivors — including members
+        whose windows are still running — re-form into a smaller slice
+        under the next generation.  Returns the (possibly recomputed)
+        unhealthy set."""
+        current = set(unhealthy)
+        recovered = [h for h in self._unhealthy_since if h not in current]
+        for h in recovered:
+            # recovered inside its window: this member's clock cancels
+            del self._unhealthy_since[h]
+        if not current:
+            if recovered:
+                # every member recovered inside its grace window: no
                 # reshape, the original generation holds
-                self._reshape_started = None
                 log.info("reshape window cancelled: all members of slice "
                          "%s recovered within the grace period",
                          self._membership.slice_id
@@ -506,39 +566,43 @@ class SliceState:
                 if self._metrics is not None:
                     self._metrics.reshape_outcome("cancelled")
             return unhealthy
-        started = self._reshape_started
-        if started is None:
-            self._reshape_started = now
+        fresh = sorted(h for h in current
+                       if h not in self._unhealthy_since)
+        for h in fresh:
+            self._unhealthy_since[h] = now
+        if fresh:
             log.warning(
-                "reshape window opened: members %s unhealthy; evicting "
-                "in %.1fs unless they recover", sorted(unhealthy),
-                self.reshape_grace_s)
+                "reshape window opened for members %s; evicting in "
+                "%.1fs unless they recover", fresh, self.reshape_grace_s)
+        evict = {h for h in current
+                 if now - self._unhealthy_since[h] >= self.reshape_grace_s}
+        if not evict:
             return unhealthy
-        if now - started < self.reshape_grace_s:
-            return unhealthy
-        evict = set(unhealthy)
         survivors = [h for h in self._members if h not in evict]
         if not survivors:
             # no valid smaller topology to re-form onto; stay demoted
-            # and keep watching (a fresh window restarts the clock)
-            self._reshape_started = None
+            # and keep watching (fresh windows restart the clocks)
+            self._unhealthy_since.clear()
             if self._metrics is not None:
                 self._metrics.reshape_outcome("no_survivors")
             return unhealthy
         old = self._membership
         assert old is not None
-        self._reshape_started = None
+        # incident duration: from the oldest evicted member's window
+        incident_started = min(self._unhealthy_since[h] for h in evict)
         for h in sorted(evict):
             self._members.pop(h, None)
             self._evicted.add(h)
+            del self._unhealthy_since[h]
         log.warning(
             "reshaping slice %s: evicted %s after %.1fs grace; "
             "re-forming over survivors %s", old.slice_id, sorted(evict),
-            now - started, sorted(survivors))
+            now - incident_started, sorted(survivors))
         self._form(lineage=old.reshaped_from + (old.slice_id,))
         if self._metrics is not None:
             self._metrics.reshape_outcome("reshaped")
-            self._metrics.reshape_seconds.observe(max(0.0, now - started))
+            self._metrics.reshape_seconds.observe(
+                max(0.0, now - incident_started))
         # evicted members owe no verdict deliveries anymore
         self._awaiting_delivery -= evict
         return self._unhealthy(now)

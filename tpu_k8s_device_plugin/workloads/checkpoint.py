@@ -11,10 +11,13 @@ JAX checkpoints on TPU pods:
   optimizer state, and the step counter in one atomic directory;
 * **crash-safe saves**: every save writes into a hidden temp dir and
   commits with one ``os.replace``; a pod killed mid-save leaves a
-  ``.step_*.tmp-*`` orphan (swept by the next save), never a torn
+  ``.step-tmp-<n>`` orphan (swept by the next save), never a torn
   ``step_N`` that a resume would trip over.  ``latest_step`` /
   ``restore_checkpoint`` additionally *skip* torn or partial step dirs
-  (external copies, pre-atomic writers) instead of raising;
+  (external copies, pre-atomic writers) instead of raising.  Multi-host
+  sharded saves (every rank on one shared RWX volume) share one
+  deterministic tmp dir per step; process 0 alone sweeps, commits, and
+  garbage-collects, fenced by cross-process barriers;
 * **sharding-aware restore**: pass the target shardings (e.g. from
   ``transformer.lm_tree_shardings``) and every leaf is restored
   DIRECTLY onto its mesh placement — no host-memory staging of the
@@ -48,7 +51,6 @@ import logging
 import os
 import re
 import shutil
-import tempfile
 import threading
 from typing import Any, Dict, List, Optional
 
@@ -93,15 +95,46 @@ def _step_complete(path: str) -> bool:
     return False
 
 
-def _sweep_orphans(base: str) -> None:
-    """Remove temp dirs a crashed save left behind (best-effort)."""
+def _sweep_orphans(base: str, keep: Optional[str] = None) -> None:
+    """Remove temp dirs a crashed save left behind (best-effort).
+    *keep* names the in-flight tmp dir of the CURRENT save, which must
+    survive the sweep (another process may already be writing into it —
+    multi-host saves share one deterministic tmp name)."""
     try:
         names = os.listdir(base)
     except OSError:
         return
     for name in names:
-        if name.startswith(_TMP_PREFIX):
+        if name.startswith(_TMP_PREFIX) and name != keep:
             shutil.rmtree(os.path.join(base, name), ignore_errors=True)
+
+
+def _process_index() -> int:
+    """This host's JAX process index; 0 when the distributed runtime is
+    not initialized (single-process tests, plain CPU runs)."""
+    try:
+        return jax.process_index()
+    except Exception as e:
+        log.debug("jax.process_index() unavailable (%s); assuming 0", e)
+        return 0
+
+
+def _process_count() -> int:
+    try:
+        return jax.process_count()
+    except Exception as e:
+        log.debug("jax.process_count() unavailable (%s); assuming 1", e)
+        return 1
+
+
+def _barrier(name: str) -> None:
+    """Cross-process sync point for multi-host saves; a no-op outside a
+    multi-controller runtime."""
+    if _process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
 
 
 def save_checkpoint(
@@ -115,30 +148,56 @@ def save_checkpoint(
     and committed with one ``os.replace`` — a crash at ANY point leaves
     either no ``step_<n>`` or a whole one, never a torn directory.
     With *keep_last*, older step dirs beyond the newest N are removed
-    after a successful save (never before)."""
+    after a successful save (never before).
+
+    Multi-host safe: under an initialized ``jax.distributed`` runtime
+    (the ``--sharded`` multihost deployment, every rank saving onto one
+    shared RWX volume) orbax's sharded save is a collective, so every
+    process writes into the SAME deterministic tmp dir
+    (``.step-tmp-<step>``), and only process 0 sweeps orphans, renames
+    the committed dir into place, and garbage-collects old steps —
+    each mutation fenced by a cross-process barrier so no rank returns
+    before the step dir exists."""
     if step < 0:
         raise ValueError(f"step must be >= 0, got {step}")
     base = os.path.abspath(base_dir)
+    primary = _process_index() == 0
     os.makedirs(base, exist_ok=True)
-    _sweep_orphans(base)
     final = _step_dir(base, step)
-    tmp = tempfile.mkdtemp(dir=base, prefix=_TMP_PREFIX)
+    # deterministic, shared by every process: orbax's sharded save is a
+    # collective that requires one directory slice-wide; a per-process
+    # mkdtemp would tear multi-host checkpoints
+    tmp = os.path.join(base, f"{_TMP_PREFIX}{step}")
+    if primary:
+        _sweep_orphans(base, keep=os.path.basename(tmp))
+        # stale tmp of a crashed save of this same step: clear it before
+        # any peer starts writing shards into it
+        shutil.rmtree(tmp, ignore_errors=True)
+    _barrier(f"ckpt_save_pre_{step}")
     try:
         ckpt = ocp.PyTreeCheckpointer()
         ckpt.save(tmp, state, force=True)
-        if os.path.isdir(final):
-            # overwrite semantics of the old force=True save: drop the
-            # stale step before the commit rename
-            shutil.rmtree(final)
-        os.replace(tmp, final)
+        # every process's shards must be durable before the commit rename
+        _barrier(f"ckpt_save_written_{step}")
+        if primary:
+            if os.path.isdir(final):
+                # overwrite semantics of the old force=True save: drop
+                # the stale step before the commit rename (os.replace
+                # onto a non-empty dir raises ENOTEMPTY)
+                shutil.rmtree(final)
+            os.replace(tmp, final)
     except BaseException:
-        shutil.rmtree(tmp, ignore_errors=True)
+        if primary:
+            shutil.rmtree(tmp, ignore_errors=True)
         raise
+    # no process may observe (or GC around) a not-yet-committed step
+    _barrier(f"ckpt_save_committed_{step}")
     if keep_last is not None:
         if keep_last < 1:
             raise ValueError("keep_last must be >= 1 when set")
-        for old in list_steps(base)[:-keep_last]:
-            shutil.rmtree(_step_dir(base, old), ignore_errors=True)
+        if primary:
+            for old in list_steps(base)[:-keep_last]:
+                shutil.rmtree(_step_dir(base, old), ignore_errors=True)
     return final
 
 
