@@ -1,0 +1,410 @@
+"""Paged-KV equivalence suite + QoS engine mechanics.
+
+The paged engine's correctness bar is the house invariant extended to
+storage: with a fixed request trace, token streams are BYTE-IDENTICAL
+between the contiguous cache and the page pool — greedy, seeded
+sampled, penalized, grammar-constrained, LoRA mixes, APC hits (exact
+and partial, shared pages and CoW), and spec-decode alike; and the
+pool must beat contiguous where it claims to: strictly more requests
+in flight than full-length reservations would allow, on a
+shared-prefix workload.  (int8 pool storage is the one documented
+lossy opt-out — asserted running, not bit-equal.)
+"""
+
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_k8s_device_plugin.workloads.grammar import (
+    regex_to_dfa,
+    token_dfa,
+)
+from tpu_k8s_device_plugin.workloads.inference import (
+    attach_lora,
+    make_decoder,
+)
+from tpu_k8s_device_plugin.workloads.kv_pool import PagePoolExhausted
+from tpu_k8s_device_plugin.workloads.scheduler import IterationScheduler
+from tpu_k8s_device_plugin.workloads.serving import ServingEngine
+
+CFG = dict(vocab=96, d_model=64, n_heads=4, n_layers=2, d_ff=128)
+EOS = 0
+MAX_LEN = 64
+PATTERN = "(AB|CD)+E"
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model = make_decoder(**CFG, max_len=MAX_LEN, dtype=jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+    params = model.init(rng, tokens, pos)["params"]
+    tb = [bytes([i]) if i else b"" for i in range(CFG["vocab"])]
+    dfa = token_dfa(regex_to_dfa(PATTERN), tb, eos_id=EOS)
+    return model, params, dfa
+
+
+def _mk(model, params, paged, dfa=None, draft=None, **kw):
+    return ServingEngine(
+        model, params, n_slots=kw.pop("n_slots", 3), chunk=8,
+        eos_id=kw.pop("eos_id", None), max_new_tokens=kw.pop("max_new", 6),
+        auto_prefix_min=4, grammar=dfa, draft=draft,
+        kv_paging=paged, **kw)
+
+
+def _drain(eng, trace):
+    """Run a trace of admit-kwargs dicts through the raw engine with
+    slot recycling; returns outputs in trace order."""
+    out = [None] * len(trace)
+    live = {}
+    i = 0
+    while i < len(trace) or live:
+        while i < len(trace) and eng.free_slots():
+            s = eng.admit(**trace[i])
+            live[s] = i
+            i += 1
+        eng.step()
+        for s in list(live):
+            if eng.finished(s):
+                out[live.pop(s)] = eng.output(s)
+    return out
+
+
+TRACE = [
+    dict(prompt=list(range(1, 13))),
+    dict(prompt=list(range(40, 60)), temperature=0.8, seed=7),
+    dict(prompt=[5, 6, 7, 8, 9], temperature=0.5, seed=3,
+         presence_penalty=0.4, frequency_penalty=0.2),
+    dict(prompt=list(range(1, 13))),                  # exact repeat
+    dict(prompt=list(range(40, 56)) + [88, 89, 90]),  # partial prefix
+    dict(prompt=[11] * 9, repetition_penalty=1.3, temperature=0.6,
+         seed=5),
+    dict(prompt=list(range(1, 13)), logit_bias={4: 5.0, 9: -4.0}),
+    dict(prompt=[70, 71, 72, 73], min_tokens=3, stop=[71]),
+]
+
+
+def test_equivalence_step_paths(setup):
+    model, params, _ = setup
+    a = _drain(_mk(model, params, False), TRACE)
+    b = _drain(_mk(model, params, True), TRACE)
+    assert a == b
+
+
+def test_equivalence_run_scan_windows(setup):
+    model, params, _ = setup
+
+    def scan_drain(paged):
+        eng = _mk(model, params, paged, max_new=16, n_slots=2)
+        s1 = eng.admit(list(range(1, 10)))
+        s2 = eng.admit(list(range(20, 28)), temperature=0.9, seed=11,
+                       top_p=0.9)
+        outs = [dict(eng.run_scan(4)) for _ in range(3)]
+        return outs, eng.output(s1), eng.output(s2)
+
+    assert scan_drain(False) == scan_drain(True)
+
+
+def test_equivalence_grammar(setup):
+    model, params, dfa = setup
+
+    def run(paged):
+        eng = _mk(model, params, paged, dfa=dfa, eos_id=EOS,
+                  max_new=10)
+        s = eng.admit([65, 66], grammar=True)
+        while any(eng.active):
+            eng.step()
+        return eng.output(s), eng.finish_reason(s)
+
+    assert run(False) == run(True)
+
+
+def test_equivalence_lora_mixed_batch(setup):
+    _, params, _ = setup
+    lmodel = make_decoder(**CFG, max_len=MAX_LEN, dtype=jnp.float32,
+                          n_adapters=2)
+    lparams = attach_lora(params, lmodel, jax.random.PRNGKey(3))
+
+    def run(paged):
+        eng = ServingEngine(lmodel, lparams, n_slots=2, chunk=8,
+                            max_new_tokens=6, auto_prefix_min=4,
+                            kv_paging=paged)
+        a = eng.admit(list(range(1, 10)), adapter=0)
+        b = eng.admit(list(range(1, 10)), adapter=1)
+        while any(eng.active):
+            eng.step()
+        return eng.output(a), eng.output(b)
+
+    assert run(False) == run(True)
+
+
+def test_equivalence_spec_decode_ngram(setup):
+    model, params, _ = setup
+
+    def run(paged):
+        eng = ServingEngine(model, params, n_slots=2, chunk=8,
+                            max_new_tokens=10, draft="ngram", gamma=3,
+                            auto_prefix_min=4, kv_paging=paged)
+        a = eng.admit([7, 8, 9, 7, 8, 9, 7, 8])
+        b = eng.admit(list(range(30, 40)))
+        while any(eng.active):
+            eng.spec_round()
+        return eng.output(a), eng.output(b)
+
+    assert run(False) == run(True)
+
+
+def test_equivalence_interleaved_scheduler(setup):
+    """The PR-6 equivalence harness, third axis: paged vs contiguous
+    under the iteration scheduler with mid-window admissions."""
+    model, params, _ = setup
+
+    def drive(paged):
+        eng = ServingEngine(model, params, n_slots=2, chunk=4,
+                            max_new_tokens=6, auto_prefix_min=4,
+                            kv_paging=paged)
+        intake = deque()
+        tickets, live, results = {}, {}, {}
+
+        def pull():
+            if not intake:
+                return None
+            key, kwargs = intake.popleft()
+            t = sched.begin(**kwargs)
+            tickets[t] = key
+            return t
+
+        sched = IterationScheduler(eng, window=4, interleave=True,
+                                   prefill_budget=2, pull=pull,
+                                   sync_dwell_s=0.0)
+        trace = [
+            (0, "a", dict(prompt=list(range(1, 10)))),
+            (0, "b", dict(prompt=list(range(1, 10)), temperature=0.7,
+                          seed=9)),
+            (2, "c", dict(prompt=list(range(1, 8)) + [80, 81])),
+            (4, "d", dict(prompt=list(range(1, 10)))),
+        ]
+        ai = 0
+        for i in range(200):
+            while ai < len(trace) and trace[ai][0] <= i:
+                intake.append(trace[ai][1:])
+                ai += 1
+            res = sched.iterate()
+            for t in res.admitted:
+                live[t.slot] = tickets.pop(t)
+            for slot in list(live):
+                if eng.finished(slot):
+                    results[live.pop(slot)] = eng.output(slot)
+            if ai == len(trace) and not intake and not live \
+                    and not sched.busy():
+                break
+        assert len(results) == len(trace)
+        return results
+
+    assert drive(False) == drive(True)
+
+
+def test_oversubscription_beats_full_reservation(setup):
+    """THE acceptance claim: a pool sized for 2 full-length
+    reservations holds 4 concurrent shared-prefix requests, with
+    outputs bit-identical to the contiguous engine."""
+    model, params, _ = setup
+    pool_pages = 16          # 16 * 8 rows = 2 * max_len
+    eng = _mk(model, params, True, n_slots=4, max_new=8,
+              kv_pages=pool_pages)
+    ref = _mk(model, params, False, n_slots=4, max_new=8)
+    prefix = list(range(1, 33))
+    slots = [eng.admit(prefix + [60 + i, 70 + i]) for i in range(4)]
+    refs = [ref.admit(prefix + [60 + i, 70 + i]) for i in range(4)]
+    assert sum(eng.active) == 4          # > the 2 reservations allow
+    st = eng.stats()
+    assert st["kv_pages_shared"] > 0
+    eng.run(12)
+    ref.run(12)
+    for s, r in zip(slots, refs):
+        assert eng.output(s) == ref.output(r)
+    eng._pool.check()
+
+
+def test_exact_repeat_shares_pages_and_cow_fires(setup):
+    """A busy donor's exact repeat maps the donor's pages by reference
+    (zero-copy admission); the repeat's first append past the shared
+    rows pays exactly one CoW page copy."""
+    model, params, _ = setup
+    eng = _mk(model, params, True, n_slots=3, max_new=6)
+    ref = _mk(model, params, False, n_slots=3, max_new=6)
+    p = list(range(1, 12))   # t_p=11: partial tail page -> CoW on append
+    a = eng.admit(p)
+    ra = ref.admit(p)
+    # donor stays BUSY so prefix-affinity cannot reuse its slot
+    b = eng.admit(p)
+    rb = ref.admit(p)
+    st = eng.stats()
+    assert st["kv_pages_shared"] > 0, "exact repeat did not share"
+    cow_before = eng._pool.cow_copies
+    eng.step()
+    ref.step()
+    assert eng._pool.cow_copies > cow_before, "append into shared page must CoW"
+    eng.run(10)
+    ref.run(10)
+    assert eng.output(a) == ref.output(ra)
+    assert eng.output(b) == ref.output(rb)
+    eng._pool.check()
+
+
+def test_preempt_resume_bit_exact(setup):
+    model, params, _ = setup
+    eng = _mk(model, params, True, n_slots=2, max_new=12)
+    ref = _mk(model, params, False, n_slots=2, max_new=12)
+    a, b = list(range(1, 10)), list(range(30, 40))
+    sa, sb = eng.admit(a), eng.admit(b, temperature=0.7, seed=13,
+                                     repetition_penalty=1.2)
+    ra, rb = ref.admit(a), ref.admit(b, temperature=0.7, seed=13,
+                                     repetition_penalty=1.2)
+    for _ in range(3):
+        eng.step()
+        ref.step()
+    state = eng.preempt(sb)
+    assert eng.stats()["kv_preemptions"] == 1
+    for _ in range(2):
+        eng.step()
+        ref.step()
+    sb2 = eng.resume(state)
+    while any(eng.active):
+        eng.step()
+    while any(ref.active):
+        ref.step()
+    assert eng.output(sa) == ref.output(ra)
+    # the seeded+penalized stream continues exactly where it left off
+    assert eng.output(sb2) == ref.output(rb)
+    eng._pool.check()
+
+
+def test_pool_exhaustion_raises_at_begin(setup):
+    model, params, _ = setup
+    eng = _mk(model, params, True, n_slots=3, max_new=4, kv_pages=8)
+    eng.admit(list(range(1, 30)))        # 4 pages prompt (+1 growth)
+    eng.admit(list(range(40, 64)))       # 3 pages
+    with pytest.raises(PagePoolExhausted):
+        eng.admit(list(range(60, 90)))   # nothing reclaimable
+    # both originals still healthy
+    eng.run(6)
+    eng._pool.check()
+
+
+def test_full_pool_still_shares_exact_repeats(setup):
+    """With the pool completely spoken for, a cold admission 429s —
+    but an exact repeat of the resident prompt still admits, because
+    sharing needs ZERO new pages.  429s become policy, and the policy
+    knows about sharing."""
+    model, params, _ = setup
+    eng = _mk(model, params, True, n_slots=3, max_new=4, kv_pages=8)
+    eng.admit(list(range(1, 60)))        # 8 pages: the whole pool
+    eng.admit(list(range(1, 60)))        # shares all 8 by reference
+    assert sum(eng.active) == 2
+    assert eng.stats()["kv_pages_shared"] == 8
+    with pytest.raises(PagePoolExhausted):
+        eng.admit(list(range(2, 61)))    # cold: no pages left
+    eng._pool.check()
+
+
+def test_parked_donor_pages_reclaimed_under_pressure(setup):
+    """release() keeps donor pages (APC), but pool pressure evicts the
+    LRU parked record instead of failing admission — the bounded
+    answer to release-survives-forever donor rows."""
+    model, params, _ = setup
+    eng = _mk(model, params, True, n_slots=2, max_new=4, kv_pages=10)
+    s1 = eng.admit(list(range(1, 25)))    # 3 prompt pages (+ growth)
+    eng.run(8)
+    eng.release(s1)
+    assert eng._pool.used_pages() > 0     # parked donor pins pages
+    # a fat admission (7 pages > what's free) forces the reclaim
+    s2 = eng.admit(list(range(5, 60)))
+    assert eng.stats()["prefix_evictions"] >= 1
+    eng.run(6)
+    eng._pool.check()
+
+
+def test_prefix_registry_lru_cap(setup):
+    model, params, _ = setup
+    eng = ServingEngine(model, params, n_slots=2, chunk=8,
+                        max_new_tokens=4, prefix_registry_max=2)
+    h1 = eng.register_prefix(list(range(1, 9)))
+    h2 = eng.register_prefix(list(range(10, 18)))
+    # touch h1 so h2 is the LRU
+    eng.admit(list(range(1, 9)) + [50], prefix=h1)
+    h3 = eng.register_prefix(list(range(20, 28)))
+    st = eng.stats()
+    assert st["registered_prefixes"] == 2
+    assert st["prefix_evictions"] == 1
+    assert h2 not in eng._prefixes          # LRU went
+    assert h1 in eng._prefixes and h3 in eng._prefixes
+    with pytest.raises(ValueError):
+        eng.admit(list(range(10, 18)) + [51], prefix=h2)
+
+
+def test_int8_pool_runs_and_stays_close(setup):
+    """kv_dtype=int8 is the documented lossy mode: it must run every
+    path and keep the same shape of output, not the same bits."""
+    model, params, _ = setup
+    eng = _mk(model, params, True, max_new=6, kv_dtype="int8")
+    s1 = eng.admit(list(range(1, 12)))
+    s2 = eng.admit(list(range(1, 12)))    # share + CoW on int8 pages
+    eng.run(10)
+    assert len(eng.output(s1)) == 6 and len(eng.output(s2)) == 6
+    # exact repeats share quantized pages bit-for-bit: both streams
+    # read identical storage, so they agree with each other
+    assert eng.output(s1) == eng.output(s2)
+    eng._pool.check()
+
+
+def test_paged_ctor_validation(setup):
+    model, params, _ = setup
+    with pytest.raises(ValueError):
+        _mk(model, params, True, kv_page_size=7)      # 7 !| 64
+    with pytest.raises(ValueError):
+        _mk(model, params, True, kv_page_size=16)     # 16 !| chunk 8
+    with pytest.raises(ValueError):
+        _mk(model, params, True, kv_dtype="fp8")
+    with pytest.raises(ValueError):
+        _mk(model, params, True, kv_pages=3)          # < one sequence
+
+
+def test_engine_trace_fuzz_pool_integrity(setup):
+    """A longer mixed trace through the paged engine, then the
+    allocator oracle: nothing leaked, nothing double-freed, and a
+    full drain returns every page."""
+    import os
+
+    seed = int(os.environ.get("ENGINE_FUZZ_SEED", "0") or 0)
+    rng = np.random.RandomState(777 + seed)
+    model, params, _ = setup
+    eng = _mk(model, params, True, n_slots=3, max_new=4, kv_pages=18)
+    live = []
+    for _ in range(60):
+        op = rng.randint(3)
+        if op == 0 and eng.free_slots():
+            base = int(rng.randint(1, 50))
+            n = int(rng.randint(4, 20))
+            try:
+                live.append(eng.admit(list(range(base, base + n)),
+                                      temperature=float(rng.rand()),
+                                      seed=int(rng.randint(100))))
+            except PagePoolExhausted:
+                pass
+        elif op == 1 and any(eng.active):
+            eng.step()
+        elif op == 2 and live:
+            s = live.pop(int(rng.randint(len(live))))
+            eng.release(s)
+        for s in list(live):
+            if eng.finished(s):
+                live.remove(s)
+        eng._pool.check()
+    for s in list(live):
+        eng.release(s)
+    eng._pool.check()

@@ -85,6 +85,21 @@ PHASES = [
     # CPU-proxied chaos episode 7 proves the mechanism; this phase puts
     # an on-chip number on it.
     ("reshape_under_load", 900),
+    # round-9 addition: the paged-vs-contiguous KV A/B on real HBM.
+    # The CPU equivalence suite proves tokens identical; what only the
+    # chip can answer is the gather-formulation's decode cost (the
+    # pool view is an XLA gather per layer, not a Pallas kernel yet)
+    # and the pool's real HBM headroom under the shared-prefix load.
+    # Pair with serving_sched_interleave_b8 (identical invocation,
+    # contiguous) and compare tokens_per_sec_http + kv_pool_occupancy
+    # / kv_shared_page_ratio.
+    ("serving_paged_kv_b8", 1800),
+    # the same paged load with int8 pool storage: decode is
+    # KV-bandwidth-bound at depth, so the ~53% byte cut should read as
+    # tok/s — and the output drift vs the exact pool needs eyeballing
+    # before anyone serves it (lossy mode: NOT covered by the
+    # equivalence gate)
+    ("serving_paged_kv_int8_b8", 1800),
 ]
 
 
@@ -297,6 +312,49 @@ def phase_serving_sched_no_interleave_b8():
 
     return run("llama3-8b", True, 8, 64, prompt_len=128, max_len=512,
                http_clients=8, http_requests=32, interleave=False)
+
+
+def phase_serving_paged_kv_b8():
+    """Paged KV pool under the serving_sched_interleave_b8 load (the
+    contiguous A side): same clients, same prompts, storage behind a
+    block-table gather.  Watch http_over_engine_ratio vs the A side
+    plus the pool telemetry the bench scrapes off /metrics."""
+    from tpu_k8s_device_plugin.workloads.bench_serving import run
+
+    return run("llama3-8b", True, 8, 64, prompt_len=128, max_len=512,
+               http_clients=8, http_requests=32, interleave=True,
+               kv_paging=True, tenants=2)
+
+
+def phase_serving_paged_kv_int8_b8():
+    """Paged pool with int8 KV storage (per-row scales): the
+    bandwidth rung below bf16 pages.  Lossy — compare outputs by hand
+    before believing the tok/s."""
+    from tpu_k8s_device_plugin.workloads.bench_serving import (
+        build_model_and_params,
+    )
+    from tpu_k8s_device_plugin.workloads.serving import ServingEngine
+
+    cfg, model, params = build_model_and_params(
+        "llama3-8b", True, 512)
+    eng = ServingEngine(model, params, n_slots=8,
+                        kv_paging=True, kv_dtype="int8")
+    import time as _t
+
+    prompt = list(range(1, 129))
+    slots = [eng.admit(prompt[:64 + i]) for i in range(8)]
+    eng.run_scan(8)  # warm/compile
+    t0 = _t.perf_counter()
+    for _ in range(4):
+        eng.run_scan(16)
+    dt = _t.perf_counter() - t0
+    st = eng.stats()
+    return {
+        "tokens_per_sec": 8 * 64 / dt,
+        "kv_pages_free": st["kv_pages_free"],
+        "kv_pages_shared": st["kv_pages_shared"],
+        "sample_output_head": eng.output(slots[0])[:8],
+    }
 
 
 def phase_grammar_overhead_b8():

@@ -140,7 +140,8 @@ def run(config: str, quantized, batch: int, steps: int,
         prompt_len: int, max_len: int, engine: bool = False,
         spec: int = 0, http_clients: int = 0, http_requests: int = 0,
         cancel_every: int = 0, burst: int = 0,
-        interleave: bool = True):
+        interleave: bool = True, kv_paging: bool = False,
+        tenants: int = 0):
     # fail fast for library callers too, not just the CLI: engine mode
     # consumes (warmup + rounds) run_scan windows of cache headroom,
     # and a mid-benchmark ValueError from run_scan is a worse place to
@@ -179,7 +180,8 @@ def run(config: str, quantized, batch: int, steps: int,
             model, params, prompt, steps, http_clients,
             http_requests or 4 * http_clients, slots=batch,
             cancel_every=cancel_every, burst=burst,
-            interleave=interleave)
+            interleave=interleave, kv_paging=kv_paging,
+            tenants=tenants)
     elif engine:
         stats = _engine_throughput(model, params, prompt, steps)
     else:
@@ -425,7 +427,8 @@ def _print_slowest_traces(port, traced, k=3):
 
 def _http_throughput(model, params, prompt, steps, clients,
                      n_requests, slots, cancel_every: int = 0,
-                     burst: int = 0, interleave: bool = True):
+                     burst: int = 0, interleave: bool = True,
+                     kv_paging: bool = False, tenants: int = 0):
     """Front-door load test (VERDICT r4 #5): *clients* concurrent
     streaming HTTP clients drive *n_requests* total requests (mixed
     priorities; every *cancel_every*-th request disconnects after its
@@ -451,17 +454,30 @@ def _http_throughput(model, params, prompt, steps, clients,
     # the ENGINE now (prefix_chunk="auto", the ServingEngine default):
     # every caller gets prefix reuse at chunk granularity, not just
     # this bench
-    eng = ServingEngine(model, params, n_slots=slots)
+    eng = ServingEngine(model, params, n_slots=slots,
+                        kv_paging=kv_paging)
     # a deliberately SMALL pool/queue: the load phase fits inside it,
     # and the burst phase overflows it — so the measured path is the
     # production admission-control path, not an unbounded one
     # window 16: half the per-window fixed cost of the old 8 for ~13
     # ms of extra worst-case queueing TTFT at tiny-config step rates —
     # the throughput side of the dial for a load benchmark
+    tenant_quotas = None
+    if tenants:
+        from .server import parse_tenant_quotas
+
+        # mixed-priority tenants: tenant-0 is the heavy "batch" lane
+        # (weight 1), the rest are interactive lanes at weight 4 — no
+        # rate caps, so the phase measures WFQ scheduling, not sheds
+        tenant_quotas = parse_tenant_quotas(
+            ["tenant-0=0:0:1"]
+            + [f"tenant-{i}=0:0:4" for i in range(1, tenants)])
     srv = EngineServer(eng, max_new_tokens=steps, window=16,
                        max_connections=clients + 2,
-                       max_queue=max(clients, slots, 4),
-                       interleave=interleave)
+                       max_queue=max(clients, slots, 4, n_requests
+                                     if tenants else 0),
+                       interleave=interleave,
+                       tenant_quotas=tenant_quotas)
     # pre-compile the scheduler's adaptive-window scan variants: each
     # distinct window length is its own XLA compile, and it would
     # otherwise land mid-traffic the first time the batch synchronizes
@@ -479,12 +495,17 @@ def _http_throughput(model, params, prompt, steps, clients,
                 i = next(seq, None)
             if i is None:
                 return
-            body = _json.dumps({
+            req_body = {
                 "tokens": prompt_host[i % len(prompt_host)].tolist(),
                 "max_new_tokens": steps,
                 # mixed priorities: odd requests jump the queue
                 "priority": i % 2,
-            })
+            }
+            if tenants:
+                # round-robin tenant identities: tenant-0 is the
+                # heavy batch lane, the others the interactive lanes
+                req_body["tenant"] = f"tenant-{i % tenants}"
+            body = _json.dumps(req_body)
             # a fresh traceparent per benched request: the server-side
             # trace (queue wait, admit, windows, stream writes) becomes
             # queryable by the id THIS client chose
@@ -657,6 +678,29 @@ def _http_throughput(model, params, prompt, steps, clients,
             stats_load.get("prefix_reused_tokens", 0)
             - stats_warm.get("prefix_reused_tokens", 0)),
     }
+    if kv_paging:
+        # KV pool economics straight off the production surfaces: the
+        # /metrics families a dashboard reads plus /stats occupancy —
+        # occupancy and sharing say how far the pool dedupes the
+        # repeated-prompt workload, preemptions/CoW say what the
+        # pressure policy actually did
+        total = max(1, server_stats.get("kv_pages", 0))
+        used = total - server_stats.get("kv_pages_free", 0)
+        out.update({
+            "kv_paging": True,
+            "kv_pages_total": float(server_stats.get("kv_pages", 0)),
+            "kv_pool_occupancy": used / total,
+            "kv_shared_page_ratio":
+                server_stats.get("kv_pages_shared", 0) / max(1, used),
+            "kv_cow_copies": float(server_stats.get(
+                "kv_cow_copies", 0)),
+            "kv_preemptions": float(server_stats.get(
+                "kv_preemptions", 0)),
+            "prefix_evictions": float(server_stats.get(
+                "prefix_evictions", 0)),
+        })
+    if tenants:
+        out["tenants"] = float(tenants)
     out.update(breakdown)
     # server-side percentiles, estimated from the scraped histogram
     # buckets (what PromQL histogram_quantile would show a dashboard)
@@ -734,6 +778,16 @@ def main(argv=None) -> int:
                         "http_over_engine_ratio >= FLOOR (the CI "
                         "regression gate for the continuous-batching "
                         "target)")
+    p.add_argument("--kv-paging", action="store_true",
+                   help="with --http: serve from the paged KV pool "
+                        "(reports pool occupancy, shared-page ratio, "
+                        "CoW copies and preemption counts from the "
+                        "production /metrics surface)")
+    p.add_argument("--tenants", type=int, default=0, metavar="N",
+                   help="with --http: tag requests with N round-robin "
+                        "tenant identities under weighted fair "
+                        "queueing (tenant-0 = the weight-1 batch "
+                        "lane, the rest weight-4 interactive lanes)")
     args = p.parse_args(argv)
 
     devs = jax.devices()
@@ -748,10 +802,14 @@ def main(argv=None) -> int:
         # for is worse than an error
         p.error(f"{' and '.join(modes)} are mutually exclusive")
     if (args.requests or args.cancel_every or args.burst
-            or args.assert_ratio or args.no_interleave) \
+            or args.assert_ratio or args.no_interleave
+            or args.kv_paging or args.tenants) \
             and not args.http:
         p.error("--requests/--cancel-every/--burst/--assert-ratio/"
-                "--no-interleave only apply with --http")
+                "--no-interleave/--kv-paging/--tenants only apply "
+                "with --http")
+    if args.tenants < 0:
+        p.error("--tenants must be >= 0")
     quantized = "int4" if args.int4 else args.quantized
     try:
         stats = run(args.config, quantized, args.batch, args.steps,
@@ -759,7 +817,8 @@ def main(argv=None) -> int:
                     spec=args.spec, http_clients=args.http,
                     http_requests=args.requests,
                     cancel_every=args.cancel_every, burst=args.burst,
-                    interleave=not args.no_interleave)
+                    interleave=not args.no_interleave,
+                    kv_paging=args.kv_paging, tenants=args.tenants)
     except ValueError as e:
         p.error(str(e))
     for k, v in stats.items():

@@ -298,6 +298,25 @@ class CachedBlock(nn.Module):
         query t of slot b sees cache positions < lens[b] + t + 1.
         T == 1 is classic token decode; T > 1 is a chunked-prefill /
         speculative-verify step.
+
+    Paged mode (``kv_page_size > 0``, extend only — the vLLM
+    PagedAttention layout on XLA gathers instead of a custom kernel):
+    the ``cache`` collection stores a PHYSICAL POOL
+    ``[P+1, page_size, Hkv, Dh]`` per layer instead of per-slot rows,
+    and the caller passes ``block_tables [B, max_len/page_size]``
+    int32 mapping each slot's logical pages to pool pages (page P is
+    the scratch page unmapped entries point at).  Appends scatter
+    this call's K/V to ``pool[table[pos // page], pos % page]``;
+    attention gathers the pool back into the SAME logical
+    ``[B, max_len]`` view the contiguous layout stores, so the banded
+    mask — and therefore every output bit — is unchanged.  Persistent
+    HBM is the pool (pages allocated on demand, shared prefixes
+    deduplicated by the allocator); the gathered view is a transient.
+    With ``kv_quant`` the pool stores int8 with one f32 scale per
+    (page row, KV head) — ``k_scale``/``v_scale``
+    ``[P+1, page_size, Hkv]`` ride the cache collection, quantized on
+    scatter and dequantized inside the gather (lossy: NOT part of the
+    bit-identical contract).
     """
 
     d_model: int
@@ -317,11 +336,14 @@ class CachedBlock(nn.Module):
     n_adapters: int = 0   # >0: per-request LoRA (multi-adapter serving)
     lora_rank: int = 8
     lora_scale: float = 1.0
+    kv_page_size: int = 0   # >0: paged KV pool (extend mode only)
+    kv_quant: bool = False  # paged pool stores int8 + per-row scales
 
     @nn.compact
     def __call__(
         self, x: jax.Array, positions: jax.Array, decode: bool = False,
         adapter_ids: Optional[jax.Array] = None,  # [B] int32, -1 = base
+        block_tables: Optional[jax.Array] = None,  # [B, T_max/page]
     ) -> jax.Array:
         B, T, _ = x.shape
         if self.quantized == "int4" and self.n_experts > 0:
@@ -379,7 +401,9 @@ class CachedBlock(nn.Module):
 
         # the cache stores the GROUPED heads — the whole point of GQA
         # serving: cache reads (the decode bandwidth bound) shrink by
-        # n_heads / n_kv_heads
+        # n_heads / n_kv_heads.  Paged modules get POOL-shaped arrays
+        # from the caller (init_pool_cache); the init shape below only
+        # matters for contiguous model.init paths.
         cache_kwargs = dict(
             shape=(B, self.max_len, n_kv, head_dim),
             dtype=self.dtype,
@@ -397,6 +421,11 @@ class CachedBlock(nn.Module):
         )
 
         if not decode:
+            if self.kv_page_size:
+                raise NotImplementedError(
+                    "paged KV serves the EXTEND path only: prefill "
+                    "runs on contiguous B=1 mini caches (the engine "
+                    "splices them into pool pages)")
             # prefill: cache head <- prompt K/V; plain causal attention
             # over the prompt (positions are the natural 0..T-1 here)
             cached_k.value = lax.dynamic_update_slice(
@@ -422,6 +451,58 @@ class CachedBlock(nn.Module):
                 att = flash_attention(q, kf, vf, causal=True)
             else:
                 att = local_causal_attention(q, kf, vf, positions)
+        elif self.kv_page_size:
+            # paged extend: scatter this call's K/V into pool pages by
+            # block-table indirection, then gather the pool back into
+            # the contiguous [B, max_len] logical view and run the SAME
+            # banded attention — valid rows are value-identical to the
+            # contiguous layout, masked rows contribute exactly zero
+            # either way (softmax of -inf), so tokens stay bit-exact.
+            if block_tables is None:
+                raise ValueError(
+                    "paged extend needs block_tables ([B, n_pages] "
+                    "int32 — the engine passes its pool's tables)")
+            ps = self.kv_page_size
+            lens = cache_lens.value
+            # clamp exactly like the contiguous vmapped
+            # dynamic_update_slice: parked slots' garbage confines to
+            # the band [max_len - T, max_len) of their OWN tail pages
+            # (or scratch), which the engine's donor bounds keep clear
+            # of any row another slot reads
+            start = jnp.minimum(lens, self.max_len - T)
+            pos = start[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+            pidx = pos // ps                                    # [B, T]
+            off = pos % ps                                      # [B, T]
+            phys = jnp.take_along_axis(block_tables, pidx, axis=1)
+            if self.kv_quant:
+                n_pool = cached_k.value.shape[0]
+                k_scale = self.variable(
+                    "cache", "k_scale", jnp.zeros,
+                    (n_pool, ps, n_kv), jnp.float32)
+                v_scale = self.variable(
+                    "cache", "v_scale", jnp.zeros,
+                    (n_pool, ps, n_kv), jnp.float32)
+                kq, ks = quantize_kv_rows(k)
+                vq, vs = quantize_kv_rows(v)
+                cached_k.value = cached_k.value.at[phys, off].set(kq)
+                cached_v.value = cached_v.value.at[phys, off].set(vq)
+                k_scale.value = k_scale.value.at[phys, off].set(ks)
+                v_scale.value = v_scale.value.at[phys, off].set(vs)
+                view_k = _gather_pool_view(
+                    cached_k.value, block_tables, self.dtype,
+                    k_scale.value)
+                view_v = _gather_pool_view(
+                    cached_v.value, block_tables, self.dtype,
+                    v_scale.value)
+            else:
+                cached_k.value = cached_k.value.at[phys, off].set(k)
+                cached_v.value = cached_v.value.at[phys, off].set(v)
+                view_k = _gather_pool_view(
+                    cached_k.value, block_tables, self.dtype)
+                view_v = _gather_pool_view(
+                    cached_v.value, block_tables, self.dtype)
+            cache_lens.value = lens + T
+            att = _decode_attention(q, view_k, view_v, lens)
         else:
             # extend: per-slot append at lens[b] (vmapped so every slot
             # writes at its own depth), then banded attention against
@@ -468,6 +549,46 @@ class CachedBlock(nn.Module):
             h = nn.gelu(h)
             x = x + proj(self.d_model, "mlp_down", h)
         return x
+
+
+# int8 KV quantization grid: symmetric per-(token row, KV head) scale
+# over the head dim — the GPTQ-style recipe at the granularity the
+# pool stores (one f32 per Dh values; at Dh=64+bf16 storage that is
+# ~53% of the full-precision bytes)
+_KV_QMAX = 127.0
+
+
+def quantize_kv_rows(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """[..., Hkv, Dh] K/V rows → (int8 values, f32 per-row scales
+    [..., Hkv]).  Symmetric: q = round(x / s * 127), s = max|x| over
+    Dh (0-rows get scale 1 so they round-trip to exact zeros)."""
+    s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    s = jnp.where(s == 0.0, 1.0, s)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / s[..., None]
+                           * _KV_QMAX), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def dequantize_kv_rows(q: jax.Array, s: jax.Array,
+                       dtype: Any) -> jax.Array:
+    """Inverse of :func:`quantize_kv_rows` (values, not bits)."""
+    return (q.astype(jnp.float32) * (s / _KV_QMAX)[..., None]
+            ).astype(dtype)
+
+
+def _gather_pool_view(pool, block_tables, dtype, scale=None):
+    """Pool pages → the contiguous logical view
+    ``[B, max_len, Hkv, Dh]`` the banded attention masks: one gather
+    by block table, reshaped.  With *scale* the pool is int8 and rows
+    dequantize on the way out.  Rows of unmapped (scratch) entries are
+    garbage — all of them sit at logical positions >= the slot's lens,
+    where the -inf mask zeroes them exactly."""
+    B = block_tables.shape[0]
+    v = pool[block_tables]           # [B, n_pages, page, Hkv, Dh]
+    if scale is not None:
+        v = dequantize_kv_rows(v, scale[block_tables], dtype)
+    n_kv, hd = v.shape[-2], v.shape[-1]
+    return v.reshape(B, -1, n_kv, hd)
 
 
 def _decode_attention(q, k_cache, v_cache, lens):
@@ -530,12 +651,15 @@ class DecodeTransformerLM(nn.Module):
     n_adapters: int = 0   # >0: per-request LoRA stacks on every block
     lora_rank: int = 8
     lora_scale: float = 1.0
+    kv_page_size: int = 0   # >0: paged KV pool (extend path)
+    kv_quant: bool = False  # pool stores int8 + per-row scales
 
     @nn.compact
     def __call__(
         self, tokens: jax.Array, positions: jax.Array,
         decode: bool = False,
         adapter_ids: Optional[jax.Array] = None,
+        block_tables: Optional[jax.Array] = None,
     ) -> jax.Array:
         x = nn.Embed(self.vocab, self.d_model, dtype=self.dtype,
                      name="embed")(tokens)
@@ -550,8 +674,11 @@ class DecodeTransformerLM(nn.Module):
                 rope_theta=self.rope_theta,
                 n_adapters=self.n_adapters, lora_rank=self.lora_rank,
                 lora_scale=self.lora_scale,
+                kv_page_size=self.kv_page_size,
+                kv_quant=self.kv_quant,
                 name=f"block_{i}",
-            )(x, positions, decode=decode, adapter_ids=adapter_ids)
+            )(x, positions, decode=decode, adapter_ids=adapter_ids,
+              block_tables=block_tables)
         x = nn.RMSNorm(dtype=self.dtype, name="final_norm")(x)
         dense = _dense_cls(self.quantized)
         logits = dense(self.vocab, use_bias=False, dtype=self.dtype,
@@ -609,7 +736,7 @@ def init_cache(model: DecodeTransformerLM, batch: int):
     jax.jit, static_argnums=(0,), donate_argnums=(2,)
 )
 def extend_step(model: "DecodeTransformerLM", params, cache, tokens,
-                positions, adapter_ids=None):
+                positions, adapter_ids=None, block_tables=None):
     """One banded extend (``decode=True``, any T >= 1): returns
     ``(logits, new cache)``.  THE compiled serving step — the engine
     (serving.py) and speculative decoding (speculative.py) share this
@@ -618,13 +745,44 @@ def extend_step(model: "DecodeTransformerLM", params, cache, tokens,
     place instead of copying the whole cache every token (decode is
     HBM-bound; an un-donated cache would double its traffic and peak
     footprint).  Callers must rebind: ``logits, cache = extend_step(
-    model, params, cache, ...)``."""
+    model, params, cache, ...)``.  Paged models (``kv_page_size>0``)
+    additionally take their pool's *block_tables* (NOT donated — the
+    host mirror stays authoritative)."""
     logits, mut = model.apply(
         {"params": params, "cache": cache},
         tokens, positions, decode=True, adapter_ids=adapter_ids,
-        mutable=["cache"],
+        block_tables=block_tables, mutable=["cache"],
     )
     return logits, mut["cache"]
+
+
+def init_pool_cache(model: "DecodeTransformerLM", batch: int,
+                    n_pages: int, page_size: int,
+                    kv_quant: bool = False):
+    """Fresh all-zero PAGED cache pytree: per layer a physical pool
+    ``[n_pages + 1, page_size, Hkv, Dh]`` (the +1 is the scratch page
+    clamped garbage writes land in) plus the usual ``cache_lens``
+    ``[batch]``.  With *kv_quant* the pools are int8 and per-row f32
+    scale arrays ride alongside.  Block tables live with the
+    allocator (kv_pool.PagePool), not in the cache pytree — the host
+    mirror is authoritative and the engine uploads it per dispatch."""
+    head_dim = model.d_model // model.n_heads
+    n_kv = model.n_kv_heads or model.n_heads
+    kv = (n_pages + 1, page_size, n_kv, head_dim)
+    out = {}
+    for i in range(model.n_layers):
+        buf = {
+            "cached_k": jnp.zeros(kv, jnp.int8 if kv_quant
+                                  else model.dtype),
+            "cached_v": jnp.zeros(kv, jnp.int8 if kv_quant
+                                  else model.dtype),
+            "cache_lens": jnp.zeros((batch,), jnp.int32),
+        }
+        if kv_quant:
+            buf["k_scale"] = jnp.zeros(kv[:3], jnp.float32)
+            buf["v_scale"] = jnp.zeros(kv[:3], jnp.float32)
+        out[f"block_{i}"] = buf
+    return out
 
 
 @functools.partial(jax.jit, static_argnums=(0,))

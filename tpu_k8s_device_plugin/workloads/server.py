@@ -129,6 +129,7 @@ from .scheduler import (
     DEFAULT_PREFILL_BUDGET,
     IterationScheduler,
 )
+from .kv_pool import PagePoolExhausted
 from .serving import ServingEngine
 
 log = logging.getLogger(__name__)
@@ -141,6 +142,8 @@ _GAUGE_STATS = frozenset({
     "running_requests", "running_copies", "admitting_copies",
     "window", "http_workers", "connections_waiting", "max_queue",
     "grammar_patterns",
+    "kv_pages", "kv_pages_free", "kv_pages_shared",
+    "kv_page_size",
 })
 
 # scheduler knobs: a window is one compiled run_scan; shorter windows
@@ -432,6 +435,11 @@ class _Request:
     seed: Optional[int] = None
     priority: int = 0                 # higher admits first
     _seq: int = 0                     # enqueue order (FIFO in a level)
+    tenant: str = ""                  # QoS accounting identity
+    _vft: float = 0.0                 # WFQ virtual finish time
+    # preemption-by-page-eviction: copy idx -> engine checkpoint; the
+    # scheduler resumes these before admitting anything new of ours
+    preempted: dict = field(default_factory=dict)
     logprobs: Optional[int] = None
     prompt_logprobs: Optional[int] = None
     n: int = 1
@@ -475,6 +483,62 @@ class _Request:
     span: object = None
     ttft_observed: bool = False
     trace: object = None
+
+
+class TenantQuota:
+    """Per-tenant QoS config: a token-rate budget (token bucket over
+    ESTIMATED tokens — prompt + requested budget — charged at
+    admission) and a WFQ weight.  ``rate <= 0`` disables the bucket
+    (weight-only tenants); ``weight`` scales the tenant's share of
+    the admission heap under contention."""
+
+    __slots__ = ("rate", "burst", "weight", "tokens", "stamp",
+                 "_last_vft")
+
+    def __init__(self, rate: float, burst: Optional[float] = None,
+                 weight: float = 1.0):
+        if weight <= 0:
+            raise ValueError("tenant weight must be > 0")
+        self.rate = float(rate)
+        self.burst = float(burst if burst is not None
+                           else max(rate, 1.0))
+        self.weight = float(weight)
+        self.tokens = self.burst       # bucket starts full
+        self.stamp = time.monotonic()
+        self._last_vft = 0.0           # WFQ backlog marker
+
+    def try_charge(self, cost: float) -> bool:
+        """Refill-then-charge; False = over quota (shed with 429)."""
+        if self.rate <= 0:
+            return True
+        now = time.monotonic()
+        self.tokens = min(self.burst,
+                          self.tokens + (now - self.stamp) * self.rate)
+        self.stamp = now
+        if self.tokens < cost:
+            return False
+        self.tokens -= cost
+        return True
+
+
+def parse_tenant_quotas(specs) -> dict:
+    """``name=rate[:burst[:weight]]`` (repeatable; name ``*`` is the
+    default for unknown tenants) -> {name: TenantQuota}."""
+    out: dict = {}
+    for spec in specs or ():
+        name, _, rest = spec.partition("=")
+        if not name or not rest:
+            raise ValueError(
+                f"bad --tenant-quota {spec!r} (want "
+                "name=rate[:burst[:weight]])")
+        parts = rest.split(":")
+        if len(parts) > 3:
+            raise ValueError(f"bad --tenant-quota {spec!r}")
+        rate = float(parts[0])
+        burst = float(parts[1]) if len(parts) > 1 else None
+        weight = float(parts[2]) if len(parts) > 2 else 1.0
+        out[name] = TenantQuota(rate, burst, weight)
+    return out
 
 
 class _PooledHTTPServer(HTTPServer):
@@ -611,7 +675,8 @@ class EngineServer:
                  flight_record_capacity: int = 4096,
                  interleave: bool = True,
                  prefill_chunks: int = DEFAULT_PREFILL_BUDGET,
-                 schedule_watchdog_s: float = 0.0):
+                 schedule_watchdog_s: float = 0.0,
+                 tenant_quotas: Optional[dict] = None):
         """*tokenizer* (anything with ``encode(str) -> List[int]`` and
         ``decode(List[int]) -> str``, e.g. a transformers tokenizer)
         unlocks the text-level surface: ``"prompt"`` strings, STRING
@@ -717,10 +782,44 @@ class EngineServer:
             ("reason",))
         self._shed_conns = self._m_shed.labels(reason="connections")
         self._shed_queue = self._m_shed.labels(reason="queue")
+        self._shed_quota = self._m_shed.labels(reason="quota")
         self._m_dropped = reg.counter(
             "tpu_serve_slow_client_drops_total",
             "Clients disconnected for not draining their stream "
             "(bounded event queue overflowed).")
+        # -- paged KV pool + multi-tenant QoS -----------------------------
+        # Pool occupancy/sharing gauges and the preemption/CoW/eviction
+        # counters refresh from engine stats at scrape time; they render
+        # (as zeros) on contiguous engines too, so dashboards see one
+        # schema.  Tenant quotas: token buckets over estimated tokens,
+        # weighted fair queueing in the admission heap (vft ordering
+        # WITHIN a priority level), preemption-by-page-eviction when the
+        # paged pool runs dry — 429s become per-tenant policy instead of
+        # the global --max-queue constant.
+        self._m_kv_pages_free = reg.gauge(
+            "tpu_serve_kv_pages_free",
+            "Free physical pages in the paged KV pool (0 when paging "
+            "is off).")
+        self._m_kv_pages_shared = reg.gauge(
+            "tpu_serve_kv_pages_shared",
+            "Physical KV pages referenced by more than one slot "
+            "(copy-on-write prefix sharing).")
+        self._m_kv_preempt = reg.counter(
+            "tpu_serve_kv_preemptions_total",
+            "Slots preempted by page eviction (KV checkpointed to "
+            "host, pages freed, request re-queued).")
+        self._m_kv_cow = reg.counter(
+            "tpu_serve_kv_cow_copies_total",
+            "Copy-on-write page copies (an append into a shared "
+            "prefix page).")
+        self._m_prefix_evict = reg.counter(
+            "tpu_serve_prefix_evictions_total",
+            "Prefix-registry/parked-donor records evicted by the LRU "
+            "cap or pool-pressure reclaim.")
+        reg.on_collect(self._collect_kv)
+        self.tenant_quotas = dict(tenant_quotas or {})
+        self._qos = bool(self.tenant_quotas)
+        self._vtime = 0.0              # WFQ virtual clock (under _lock)
         # crash containment (PR 5): a scheduler-thread death is
         # counted, journaled, and survived (supervised restart) —
         # never a silent hang with clients blocked on event queues
@@ -775,6 +874,70 @@ class EngineServer:
                 op="serve.schedule", timeout_s=schedule_watchdog_s,
                 metrics=resilience.ResilienceMetrics(reg),
                 recorder=self.recorder)
+        # preemption-by-page-eviction: the paged engine escalates a
+        # failed page allocation to this policy (scheduler thread) —
+        # checkpoint the lowest-priority running slot to host, free its
+        # pages, re-queue its request for later resume
+        if getattr(engine, "kv_paging", False):
+            engine.set_preempt_cb(self._preempt_for_pages)
+
+    def _collect_kv(self) -> None:
+        """Scrape-time refresh of the KV-pool/QoS families from engine
+        stats (counters _set to the engine's monotonic values)."""
+        st = self.engine.stats()
+        self._m_kv_pages_free.set(st.get("kv_pages_free", 0))
+        self._m_kv_pages_shared.set(st.get("kv_pages_shared", 0))
+        self._m_kv_preempt._set(st.get("kv_preemptions", 0))
+        self._m_kv_cow._set(st.get("kv_cow_copies", 0))
+        self._m_prefix_evict._set(st.get("prefix_evictions", 0))
+
+    def _resolve_quota(self, tenant: str) -> Optional["TenantQuota"]:
+        """Per-tenant QoS state; the ``*`` spec is a TEMPLATE — each
+        unknown tenant gets its own bucket and WFQ chain cloned from
+        it (shared state would let one tenant drain another's
+        budget).  Caller holds ``_lock``."""
+        q = self.tenant_quotas.get(tenant)
+        if q is None:
+            d = self.tenant_quotas.get("*")
+            if d is None:
+                return None
+            q = TenantQuota(d.rate, d.burst, d.weight)
+            self.tenant_quotas[tenant] = q
+        return q
+
+    def _preempt_for_pages(self, exclude_slot: int = -1) -> bool:
+        """The engine's page-pressure escalation (scheduler thread):
+        preempt the lowest-priority, most-recently-admitted running
+        copy (never *exclude_slot* — the slot the engine is trying to
+        grow).  The evicted copy's checkpoint rides its request back
+        into the admission heap; the pull path resumes it when pages
+        free up.  Returns False when nothing is preemptible."""
+        cands = [
+            (req.priority, i, slot, req, idx)
+            for i, (slot, (req, idx)) in
+            enumerate(self._running.items())
+            if slot != exclude_slot and not req.cancelled
+        ]
+        if not cands:
+            return False
+        cands.sort(key=lambda c: (c[0], -c[1]))
+        _, _, slot, req, idx = cands[0]
+        try:
+            state = self.engine.preempt(slot)
+        except (RuntimeError, ValueError):
+            return False
+        del self._running[slot]
+        req.preempted[idx] = state
+        self.recorder.record("tpu_serve_kv_preempt", trace=req.trace,
+                             rid=req.rid, slot=slot, copy=idx,
+                             tenant=req.tenant)
+        with self._lock:
+            self._pending_seq += 1
+            heapq.heappush(
+                self._pending,
+                (-req.priority, req._vft, self._pending_seq, req))
+        self._work.set()
+        return True
 
     def _mark(self, req: "_Request", name: str, duration_s: float,
               **attrs) -> None:
@@ -835,18 +998,56 @@ class EngineServer:
                     # remaining copies of a partially-admitted n>1
                     # request — the head goes back into the heap at
                     # its ORIGINAL position within its level
-                    req = heapq.heappop(self._pending)[2]
+                    req = heapq.heappop(self._pending)[-1]
                     heapq.heappush(
                         self._pending,
-                        (-head.priority, head._seq, head))
+                        (-head.priority, head._vft, head._seq, head))
                     self._head = None
                 elif head is not None:
                     req, self._head = head, None
                 elif top is not None:
-                    req = heapq.heappop(self._pending)[2]
+                    req = heapq.heappop(self._pending)[-1]
                 else:
                     return None
+                # WFQ virtual clock follows the served frontier
+                if self._qos and req._vft > self._vtime:
+                    self._vtime = req._vft
             if req.cancelled:
+                # preempted checkpoints of a cancelled request are
+                # dropped (their pages were freed at preemption)
+                req.preempted.clear()
+                continue
+            if req.preempted:
+                # resume an evicted copy before admitting anything
+                # new of this request: the checkpoint already holds
+                # its tokens — re-queueing it behind fresh work would
+                # strand a half-finished stream
+                idx = next(iter(req.preempted))
+                try:
+                    slot = eng.resume(req.preempted[idx])
+                except (RuntimeError, PagePoolExhausted):
+                    # still no capacity: back on the heap, stop
+                    # pulling this round (decode progress frees pages)
+                    with self._lock:
+                        self._pending_seq += 1
+                        heapq.heappush(
+                            self._pending,
+                            (-req.priority, req._vft,
+                             self._pending_seq, req))
+                    return None
+                del req.preempted[idx]
+                self._running[slot] = (req, idx)
+                self.recorder.record(
+                    "tpu_serve_kv_resume", trace=req.trace,
+                    rid=req.rid, slot=slot, copy=idx,
+                    tenant=req.tenant)
+                if req.preempted:
+                    with self._lock:
+                        self._pending_seq += 1
+                        heapq.heappush(
+                            self._pending,
+                            (-req.priority, req._vft,
+                             self._pending_seq, req))
                 continue
             try:
                 if not req.budget_capped:
@@ -915,6 +1116,24 @@ class EngineServer:
                     logit_bias=req.logit_bias,
                     min_tokens=req.min_tokens,
                     grammar=gid)
+            except PagePoolExhausted:
+                # page pressure, not a bad request: preempt a
+                # STRICTLY lower-priority running copy and retry this
+                # one (re-entering via _head keeps its heap position);
+                # nothing preemptible means the pool is honestly full
+                # — the request waits its turn
+                if (min((r.priority for r, _ in
+                         self._running.values()), default=req.priority)
+                        < req.priority and self._preempt_for_pages()):
+                    self._head = req
+                    continue
+                with self._lock:
+                    self._pending_seq += 1
+                    heapq.heappush(
+                        self._pending,
+                        (-req.priority, req._vft,
+                         self._pending_seq, req))
+                return None
             except (ValueError, RuntimeError) as e:
                 # identical args per copy, so only the FIRST begin can
                 # fail on validation (the scheduler pulls only with a
@@ -1356,7 +1575,7 @@ class EngineServer:
                         "restart", "code": 503}
         with self._lock:
             drained, self._pending = self._pending, []
-        for _, _, req in drained:
+        for *_k, req in drained:
             self._push(req, dict(bye))
             self._finish_request(req, "shutdown")
 
@@ -1832,7 +2051,7 @@ class EngineServer:
         bye = {"error": "server shutting down", "code": 503}
         with self._lock:
             drained, self._pending = self._pending, []
-        for _, _, req in drained:
+        for *_k, req in drained:
             self._push(req, dict(bye))
             self._finish_request(req, "shutdown")
         if self._httpd is not None:
@@ -1855,14 +2074,51 @@ class EngineServer:
                          "restart", "code": 503})
             self._finish_request(req, "shutdown")
             return
+        if self._qos:
+            # per-tenant token-rate quota: charge the ESTIMATE (prompt
+            # + requested budget, all n copies) at admission — over
+            # quota is a 429 the tenant earned, not a global verdict
+            cost = float(
+                (len(req.tokens) + req.max_new_tokens) * req.n)
+            with self._lock:
+                quota = self._resolve_quota(req.tenant)
+                ok = quota is None or quota.try_charge(cost)
+            if not ok:
+                self._shed_quota.inc()
+                self.recorder.record(
+                    "tpu_serve_shed", trace=req.trace, rid=req.rid,
+                    reason="quota", tenant=req.tenant)
+                self._push(req, {
+                    "error": f"tenant {req.tenant or '(default)'} "
+                             "over token-rate quota; retry later",
+                    "code": 429})
+                self._finish_request(req, "throttled")
+                return
         with self._lock:
             if len(self._pending) >= self.max_queue:
                 full = True
             else:
                 self._pending_seq += 1
                 req._seq = self._pending_seq
-                heapq.heappush(self._pending,
-                               (-req.priority, req._seq, req))
+                if self._qos:
+                    # weighted fair queueing WITHIN a priority level:
+                    # virtual finish time = max(virtual clock, the
+                    # tenant's last vft) + cost/weight, so a bursting
+                    # tenant queues behind its own backlog while the
+                    # quiet tenant's occasional request keeps jumping
+                    # near the virtual clock
+                    quota = self._resolve_quota(req.tenant)
+                    weight = quota.weight if quota is not None else 1.0
+                    base = max(self._vtime, quota._last_vft
+                               if quota is not None else 0.0)
+                    req._vft = base + float(
+                        (len(req.tokens) + req.max_new_tokens)
+                        * req.n) / weight
+                    if quota is not None:
+                        quota._last_vft = req._vft
+                heapq.heappush(
+                    self._pending,
+                    (-req.priority, req._vft, req._seq, req))
                 full = False
         if full:
             self._shed_queue.inc()
@@ -2027,6 +2283,9 @@ class EngineServer:
 
         native["max_new_tokens"] = int(
             opt("max_tokens", opt("max_completion_tokens", 16)))
+        if opt("user") is not None:
+            # OpenAI's end-user identity doubles as the QoS tenant
+            native["tenant"] = str(opt("user"))
         # OpenAI defaults temperature to 1.0 (sampled); clients wanting
         # greedy pass 0 explicitly, exactly as with OpenAI/vLLM
         native["temperature"] = float(opt("temperature", 1.0))
@@ -2265,6 +2524,7 @@ class EngineServer:
             seed=(None if body.get("seed") is None
                   else int(body["seed"])),
             priority=int(body.get("priority", 0)),
+            tenant=str(body.get("tenant", "") or ""),
             logprobs=None if logprobs is None else int(logprobs),
             prompt_logprobs=(None if prompt_logprobs is None
                              else int(prompt_logprobs)),
@@ -2450,6 +2710,35 @@ def main(argv=None) -> int:
                    help="structural jump-ahead width: up to this many "
                         "DFA-forced tokens (a schema's keys and "
                         "punctuation) commit per multi-token extend")
+    p.add_argument("--kv-paging", action="store_true",
+                   help="paged KV cache: fixed-size pages + free-list "
+                        "allocator with copy-on-write prefix sharing "
+                        "and preemption-by-page-eviction (outputs "
+                        "bit-identical to the contiguous default)")
+    p.add_argument("--kv-page-size", type=int, default=0, metavar="N",
+                   help="KV page size in tokens (0 = the admission "
+                        "chunk; must divide it and --max-len)")
+    p.add_argument("--kv-pages", type=int, default=0, metavar="P",
+                   help="physical KV pool size in pages (0 = full "
+                        "reservation, n_slots * max_len/page; smaller "
+                        "oversubscribes — shared prefixes and "
+                        "preemption absorb the pressure)")
+    p.add_argument("--kv-dtype", choices=["int8"], default=None,
+                   help="quantize paged KV pool storage (int8 values "
+                        "+ per-row f32 scales; ~47%% of the bf16 "
+                        "bytes, NOT bit-identical to contiguous)")
+    p.add_argument("--tenant-quota", action="append", default=None,
+                   metavar="NAME=RATE[:BURST[:WEIGHT]]",
+                   help="per-tenant QoS (repeatable; NAME '*' is the "
+                        "default tenant): token-rate quota (tokens/s "
+                        "over prompt+budget estimates, 429 past it) "
+                        "and weighted fair queueing in the admission "
+                        "heap; requests carry 'tenant' (native) or "
+                        "'user' (OpenAI)")
+    p.add_argument("--prefix-registry-max", type=int, default=256,
+                   help="LRU cap on registered prefixes + the bound "
+                        "feeding tpu_serve_prefix_evictions_total "
+                        "(each entry pins a full-length KV copy)")
     p.add_argument("--checkpoint", default=None, metavar="DIR",
                    help="serve REAL weights: an orbax checkpoint dir "
                         "(workloads.checkpoint layout, state "
@@ -2491,6 +2780,17 @@ def main(argv=None) -> int:
     if args.checkpoint_step is not None and not args.checkpoint:
         p.error("--checkpoint-step needs --checkpoint (without it the "
                 "server would silently serve random weights)")
+    if (args.kv_page_size or args.kv_pages or args.kv_dtype) \
+            and not args.kv_paging:
+        p.error("--kv-page-size/--kv-pages/--kv-dtype need --kv-paging")
+    if args.kv_page_size < 0 or args.kv_pages < 0:
+        p.error("--kv-page-size/--kv-pages must be >= 0")
+    if args.prefix_registry_max < 1:
+        p.error("--prefix-registry-max must be >= 1")
+    try:
+        tenant_quotas = parse_tenant_quotas(args.tenant_quota)
+    except ValueError as e:
+        p.error(str(e))
 
     quantized = "int4" if args.int4 else args.quantized
     mesh = None
@@ -2542,7 +2842,12 @@ def main(argv=None) -> int:
                            mesh=mesh, logprobs_k=args.logprobs_k,
                            draft=draft, gamma=args.gamma,
                            ngram_n=args.spec_ngram or 3,
-                           jump_len=args.jump_len)
+                           jump_len=args.jump_len,
+                           kv_paging=args.kv_paging,
+                           kv_pages=args.kv_pages or None,
+                           kv_page_size=args.kv_page_size,
+                           kv_dtype=args.kv_dtype,
+                           prefix_registry_max=args.prefix_registry_max)
     tokenizer = None
     if args.tokenizer:
         try:
@@ -2562,7 +2867,8 @@ def main(argv=None) -> int:
                        flight_record_capacity=args.flight_record_capacity,
                        interleave=not args.no_interleave,
                        prefill_chunks=args.prefill_chunks,
-                       schedule_watchdog_s=args.schedule_watchdog)
+                       schedule_watchdog_s=args.schedule_watchdog,
+                       tenant_quotas=tenant_quotas)
     if args.fault_spec is not None or args.fault_seed is not None:
         if args.fault_spec is None:
             p.error("--fault-seed needs --fault-spec")

@@ -48,10 +48,14 @@ from jax import lax
 
 from .inference import (
     DecodeTransformerLM,
+    dequantize_kv_rows,
     extend_step,
     init_cache,
+    init_pool_cache,
+    quantize_kv_rows,
     validate_top_k,
 )
+from .kv_pool import PagePool, PagePoolExhausted
 
 # Upper bound for the auto-selected prefill chunk.  128 rides the MXU
 # tile (128 lanes) and keeps peak prefill attention memory at
@@ -151,6 +155,125 @@ def _slot_to_mini(cache, slot):
             "cache_lens": lax.dynamic_slice(
                 buf["cache_lens"], (slot,), (1,)),
         }
+    return out
+
+
+# -- paged-pool device helpers (kv_pool.PagePool makes the decisions;
+# these move the bytes; one compiled variant each per pool shape) ------
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _paged_splice(cache, mini, targets, slot, new_len):
+    """Scatter a contiguous B=1 *mini* cache into pool pages.
+    *targets* [n_tables] holds the physical page per logical page —
+    SCRATCH for entries the slot does not own (shared prefix pages,
+    unmapped tail), so their mini rows land in the garbage page
+    instead of corrupting a neighbor.  Also sets cache_lens[slot].
+    Quantized pools quantize on the way in."""
+    out = {}
+    for layer, buf in cache.items():
+        m = mini[layer]
+        ps = buf["cached_k"].shape[1]
+        nt = targets.shape[0]
+        n_kv, hd = buf["cached_k"].shape[2], buf["cached_k"].shape[3]
+        mk = m["cached_k"][0].reshape(nt, ps, n_kv, hd)
+        mv = m["cached_v"][0].reshape(nt, ps, n_kv, hd)
+        o = dict(buf)
+        if "k_scale" in buf:
+            kq, ks = quantize_kv_rows(mk)
+            vq, vs = quantize_kv_rows(mv)
+            o["cached_k"] = buf["cached_k"].at[targets].set(kq)
+            o["cached_v"] = buf["cached_v"].at[targets].set(vq)
+            o["k_scale"] = buf["k_scale"].at[targets].set(ks)
+            o["v_scale"] = buf["v_scale"].at[targets].set(vs)
+        else:
+            o["cached_k"] = buf["cached_k"].at[targets].set(
+                mk.astype(buf["cached_k"].dtype))
+            o["cached_v"] = buf["cached_v"].at[targets].set(
+                mv.astype(buf["cached_v"].dtype))
+        o["cache_lens"] = buf["cache_lens"].at[slot].set(new_len)
+        out[layer] = o
+    return out
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _paged_gather_mini(cache, table_row, dtype):
+    """Gather one slot's pool pages back into a contiguous B=1 mini
+    cache (the paged analog of _slot_to_mini — what seeds a suffix
+    extend or a donor copy).  NOT donated: the pool must survive.
+    Quantized pools dequantize on the way out (exact for rows that
+    round-tripped through the same scales).  cache_lens is a zero the
+    caller overwrites via _set_len."""
+    out = {}
+    for layer, buf in cache.items():
+        ps = buf["cached_k"].shape[1]
+        nt = table_row.shape[0]
+        k = buf["cached_k"][table_row]   # [nt, ps, n_kv, hd]
+        v = buf["cached_v"][table_row]
+        if "k_scale" in buf:
+            k = dequantize_kv_rows(k, buf["k_scale"][table_row], dtype)
+            v = dequantize_kv_rows(v, buf["v_scale"][table_row], dtype)
+        n_kv, hd = k.shape[-2], k.shape[-1]
+        out[layer] = {
+            "cached_k": k.reshape(1, nt * ps, n_kv, hd),
+            "cached_v": v.reshape(1, nt * ps, n_kv, hd),
+            "cache_lens": jnp.zeros((1,), jnp.int32),
+        }
+    return out
+
+
+@jax.jit
+def _paged_gather_raw(cache, table_row):
+    """One slot's pool pages in STORAGE form ([n_tables, page, ...],
+    int8 + scales when quantized) — the exact-round-trip snapshot
+    preemption checkpoints to host."""
+    out = {}
+    for layer, buf in cache.items():
+        d = {"k": buf["cached_k"][table_row],
+             "v": buf["cached_v"][table_row]}
+        if "k_scale" in buf:
+            d["ks"] = buf["k_scale"][table_row]
+            d["vs"] = buf["v_scale"][table_row]
+        out[layer] = d
+    return out
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _paged_restore_raw(cache, raw, targets, slot, new_len):
+    """Scatter a preemption snapshot back into freshly-allocated pages
+    (*targets*, SCRATCH beyond the restored length) — the inverse of
+    _paged_gather_raw, bit-exact storage either dtype."""
+    out = {}
+    for layer, buf in cache.items():
+        r = raw[layer]
+        o = dict(buf)
+        o["cached_k"] = buf["cached_k"].at[targets].set(r["k"])
+        o["cached_v"] = buf["cached_v"].at[targets].set(r["v"])
+        if "k_scale" in buf:
+            o["k_scale"] = buf["k_scale"].at[targets].set(r["ks"])
+            o["v_scale"] = buf["v_scale"].at[targets].set(r["vs"])
+        o["cache_lens"] = buf["cache_lens"].at[slot].set(new_len)
+        out[layer] = o
+    return out
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _copy_page(cache, src, dst):
+    """Physical page copy in every layer (k, v, scales) — the
+    copy-on-write data movement behind kv_pool.PagePool.cow."""
+    out = {}
+    for layer, buf in cache.items():
+        o = dict(buf)
+        o["cached_k"] = buf["cached_k"].at[dst].set(
+            buf["cached_k"][src])
+        o["cached_v"] = buf["cached_v"].at[dst].set(
+            buf["cached_v"][src])
+        if "k_scale" in buf:
+            o["k_scale"] = buf["k_scale"].at[dst].set(
+                buf["k_scale"][src])
+            o["v_scale"] = buf["v_scale"].at[dst].set(
+                buf["v_scale"][src])
+        out[layer] = o
     return out
 
 
@@ -343,7 +466,7 @@ def _scan_decode(model, n_steps, sampled, lp_k, pen, rep, seeded,
                  seen, bias, min_mask, min_toks, emitted0,
                  gtable, gstate0,
                  seeds, seed_streams, seed_on, seed_base, adapter_ids,
-                 rng, draws0):
+                 rng, draws0, btables=None):
     """n_steps decode steps in one lax.scan.  The per-step sampling key
     is fold_in(rng, draws0 + i) — the same chain ``step`` consumes one
     link of per call, so scan and step-by-step emit identical streams.
@@ -357,7 +480,8 @@ def _scan_decode(model, n_steps, sampled, lp_k, pen, rep, seeded,
         logits, mut = model.apply(
             {"params": params, "cache": cache},
             tok[:, None], pos[:, None], decode=True,
-            adapter_ids=adapter_ids, mutable=["cache"],
+            adapter_ids=adapter_ids, block_tables=btables,
+            mutable=["cache"],
         )
         lg = logits[:, -1, :]
         if biased:
@@ -436,7 +560,8 @@ class AdmitState:
         "seed_stream", "ignore_eos", "min_tokens", "lp_n", "plp_n",
         "logit_bias", "gstart", "canon", "auto_src", "gen", "result",
         "plp_dev", "chunks_total", "chunks_done", "pick", "pick_stats",
-        "spliced", "inplace", "first_cached",
+        "spliced", "inplace", "first_cached", "share_pages",
+        "prefill_end",
     )
 
     def __init__(self):
@@ -455,6 +580,17 @@ class AdmitState:
         # (no pick, no sync — argmax of the same logits row)
         self.inplace = False
         self.first_cached = None
+        # paged mode: physical pages this admission will map by
+        # REFERENCE (the copy-on-write prefix share).  Refcounts are
+        # taken at begin — the pin that keeps a donor's pages alive
+        # however the donor slot churns before finish — and released
+        # by abort or consumed by the finish-time mapping.
+        self.share_pages = []
+        # paged mode: rows [0, prefill_end) hold real prefill content
+        # (shared prefix + chunk-padded suffix); the slot owns pages
+        # from the shared boundary up to here, decode appends allocate
+        # on demand past it
+        self.prefill_end = 0
 
     @property
     def ready(self) -> bool:
@@ -512,6 +648,11 @@ class ServingEngine:
         ngram_n: int = 3,
         grammar=None,
         jump_len: int = 8,
+        kv_paging: bool = False,
+        kv_pages: Optional[int] = None,
+        kv_page_size: int = 0,
+        kv_dtype: Optional[str] = None,
+        prefix_registry_max: int = 256,
     ):
         if n_slots < 1:
             raise ValueError("n_slots must be >= 1")
@@ -590,7 +731,68 @@ class ServingEngine:
             self._kv_sharding = None
             self._len_sharding = None
         self.params = params
-        self.cache = self._place_cache(init_cache(model, n_slots))
+        # -- paged KV pool (opt-in; contiguous stays the default and
+        # bit-for-bit intact) ------------------------------------------------
+        # Storage becomes a [P+1, page, Hkv, Dh] physical pool per
+        # layer + a host-side free-list allocator with per-slot block
+        # tables (kv_pool.PagePool).  APC admission maps shared
+        # prefixes to SHARED read-only pages (refcounted,
+        # copy-on-write on append) instead of copying donor rows, and
+        # preemption can checkpoint a slot's pages to host and free
+        # them under pressure.  Decode gathers the pool back into the
+        # contiguous logical view inside the same compiled step, so
+        # tokens are bit-identical to the contiguous engine (pinned by
+        # the paged equivalence suite); int8 pool storage (kv_dtype)
+        # is the one lossy opt-out.
+        self._paged = bool(kv_paging)
+        self._pool: Optional[PagePool] = None
+        self._pmodel = None
+        self._btables_dev = None
+        self._kv_quant = False
+        self._preempt_cb = None      # server-installed eviction policy
+        self._kv_preemptions = 0
+        self._prefix_evictions = 0
+        self._park_seq = [0] * n_slots
+        self._park_counter = 0
+        if kv_paging:
+            if chunk is None:
+                raise ValueError(
+                    "kv_paging needs a chunked engine (pass chunk or "
+                    "prefix_chunk; paged splices land whole pages on "
+                    "the admission grid)")
+            ps = int(kv_page_size) or chunk
+            if ps < 1:
+                raise ValueError("kv_page_size must be >= 1")
+            if model.max_len % ps:
+                raise ValueError(
+                    f"kv_page_size {ps} must divide max_len "
+                    f"{model.max_len}")
+            if chunk % ps:
+                raise ValueError(
+                    f"kv_page_size {ps} must divide the admission "
+                    f"chunk {chunk}: APC matches floor to whole "
+                    "chunks, and whole-page sharing needs the chunk "
+                    "grid to lie on the page grid")
+            if kv_dtype not in (None, "int8"):
+                raise ValueError(
+                    f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
+            self._kv_quant = kv_dtype == "int8"
+            n_tables = model.max_len // ps
+            pages = (int(kv_pages) if kv_pages is not None
+                     else n_slots * n_tables)
+            self._pool = PagePool(pages, ps, n_slots, model.max_len)
+            self._pmodel = model.clone(kv_page_size=ps,
+                                       kv_quant=self._kv_quant)
+            self.cache = self._place_pool_cache(
+                init_pool_cache(model, n_slots, pages, ps,
+                                self._kv_quant))
+        else:
+            self.cache = self._place_cache(init_cache(model, n_slots))
+        if prefix_registry_max < 1:
+            raise ValueError("prefix_registry_max must be >= 1")
+        self.prefix_registry_max = prefix_registry_max
+        self._prefix_touch: Dict[int, int] = {}  # handle -> use seq
+        self._use_seq = 0
         self.lens = [0] * n_slots          # host mirror of cache_lens
         self.active = [False] * n_slots
         # slots held by an in-flight chunked admission (begin_admit
@@ -853,6 +1055,294 @@ class ServingEngine:
             for layer, buf in cache.items()
         }
 
+    def _place_pool_cache(self, cache):
+        """TP shardings for the paged pool (no-op meshless): pools
+        shard on the KV-head axis like the contiguous cache; scales
+        follow their pool's head axis."""
+        if self._kv_sharding is None:
+            return cache
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        scale_s = NamedSharding(self.mesh, P(None, None, "model"))
+        out = {}
+        for layer, buf in cache.items():
+            o = {
+                "cached_k": jax.device_put(buf["cached_k"],
+                                           self._kv_sharding),
+                "cached_v": jax.device_put(buf["cached_v"],
+                                           self._kv_sharding),
+                "cache_lens": jax.device_put(buf["cache_lens"],
+                                             self._len_sharding),
+            }
+            if "k_scale" in buf:
+                o["k_scale"] = jax.device_put(buf["k_scale"], scale_s)
+                o["v_scale"] = jax.device_put(buf["v_scale"], scale_s)
+            out[layer] = o
+        return out
+
+    # -- paged-pool plumbing -----------------------------------------------
+
+    @property
+    def kv_paging(self) -> bool:
+        return self._paged
+
+    def _bt(self):
+        """Device mirror of the pool's block tables, re-uploaded only
+        when host-side mappings changed (same staleness discipline as
+        the knob cache)."""
+        assert self._pool is not None
+        if self._btables_dev is None or self._pool.dirty:
+            self._btables_dev = jnp.asarray(self._pool.tables)
+            self._pool.dirty = False
+        return self._btables_dev
+
+    def set_preempt_cb(self, cb) -> None:
+        """Install the server's preemption policy: ``cb(exclude_slot)
+        -> bool`` must free pool pages (typically by preempting a
+        lower-priority slot via :meth:`preempt`) and return whether it
+        made progress.  The engine calls it only after reclaiming
+        parked donor pages failed to satisfy an allocation."""
+        self._preempt_cb = cb
+
+    def _alloc_page(self) -> int:
+        assert self._pool is not None
+        while True:
+            try:
+                return self._pool.alloc()
+            except PagePoolExhausted:
+                if self._reclaim_parked():
+                    continue
+                if (self._preempt_cb is not None
+                        and self._preempt_cb(-1)):
+                    continue
+                raise
+
+    def _reclaim_parked(self) -> bool:
+        """Evict the least-recently-parked donor record whose pages
+        only the record pins — the bounded answer to
+        release-survives-forever donor rows under pool pressure."""
+        assert self._pool is not None
+        best = None
+        for s in range(self.n_slots):
+            if (self.active[s] or self._reserved[s]
+                    or self._slot_prompts[s] is None
+                    or not self._pool.mapped(s)):
+                continue
+            if best is None or self._park_seq[s] < self._park_seq[best]:
+                best = s
+        if best is None:
+            return False
+        self._drop_donor(best)
+        return True
+
+    def _drop_donor(self, slot: int) -> None:
+        assert self._pool is not None
+        self._pool.clear_slot(slot)
+        self._slot_prompts[slot] = None
+        self._prefix_evictions += 1
+
+    def _make_writable(self, slot: int, idx: int) -> None:
+        """Guarantee (slot, idx) maps a page this slot may append
+        into: map a fresh page, or copy-on-write a shared one."""
+        pool = self._pool
+        assert pool is not None
+        e = pool.entry(slot, idx)
+        if e == pool.scratch:
+            pool.map(slot, idx, self._alloc_page())
+        elif not pool.writable(slot, idx):
+            new = self._alloc_page()
+            self.cache = _copy_page(self.cache, jnp.int32(e),
+                                    jnp.int32(new))
+            pool.cow(slot, idx, new)
+
+    def _ensure_append_pages(self, n_new: int) -> None:
+        """Pre-dispatch page budget: every ACTIVE slot gets writable
+        pages covering its next *n_new* appends (fresh allocations
+        past the prefill, CoW where a shared prefix page is about to
+        be written).  Runs on the host before the decode dispatch;
+        allocation failure escalates reclaim → preemption callback →
+        PagePoolExhausted."""
+        if not self._paged:
+            return
+        assert self._pool is not None
+        for s in range(self.n_slots):
+            if not self.active[s]:
+                continue
+            start = self.lens[s]
+            if start >= self.model.max_len:
+                continue
+            end = min(start + n_new, self.model.max_len)
+            for idx in self._pool.pages_for(start, end):
+                if not self.active[s]:
+                    break  # the preemption policy evicted this slot
+                self._make_writable(s, idx)
+
+    def preempt(self, slot: int) -> Dict[str, object]:
+        """Preemption-by-page-eviction: checkpoint an ACTIVE slot's KV
+        pages to host (storage-exact — int8 pools round-trip their raw
+        bytes + scales), free the pages, and return an opaque state
+        :meth:`resume` re-admits from.  Host bookkeeping (outputs,
+        knobs, draw chains, grammar state) rides the state; penalty
+        histograms are rebuilt from token counts at resume, which
+        reproduces the device values exactly (unit float increments).
+        Seeded/greedy/grammar streams continue bit-identically after
+        resume; unseeded sampled streams keep the documented
+        global-stream caveat."""
+        if not self._paged:
+            raise RuntimeError("preemption needs kv_paging=True")
+        assert self._pool is not None
+        if not self.active[slot]:
+            raise ValueError(f"slot {slot} is not active")
+        row = jnp.asarray(self._pool.tables[slot])
+        raw = jax.device_get(_paged_gather_raw(self.cache, row))
+        state: Dict[str, object] = {
+            "kv": raw,
+            "lens": int(self.lens[slot]),
+            "outputs": list(self.outputs[slot]),
+            "last_token": int(self.last_token[slot]),
+            "record": self._slot_prompts[slot],
+            "stops": self._stops[slot],
+            "ignore_eos": self._ignore_eos[slot],
+            "temperature": float(self.temps[slot]),
+            "top_k": int(self.topks[slot]),
+            "top_p": float(self.topps[slot]),
+            "min_p": float(self.minps[slot]),
+            "presence_penalty": float(self.pres[slot]),
+            "frequency_penalty": float(self.freqs[slot]),
+            "repetition_penalty": float(self.reps[slot]),
+            "adapter": int(self.adapters[slot]),
+            "seed": int(self.seeds[slot]),
+            "seed_stream": int(self._seed_streams[slot]),
+            "seed_on": int(self._seed_on[slot]),
+            "slot_draws": int(self._slot_draws[slot]),
+            "lp_want": int(self._lp_want[slot]),
+            "lp_records": list(self._lp_records[slot]),
+            "prompt_lp": list(self._prompt_lp[slot]),
+            "min_toks": int(self.min_toks[slot]),
+            "gstate": int(self.gstate[slot]),
+            "bias": (np.asarray(self._bias[slot])
+                     if self._bias_on[slot] else None),
+        }
+        self.active[slot] = False
+        self._pool.clear_slot(slot)
+        self._slot_prompts[slot] = None
+        self.lens[slot] = 0
+        self._reset_slot_params(slot)
+        self._kv_preemptions += 1
+        if self._inflight_scan is not None:
+            # a window dispatched before the preemption must not
+            # advance host mirrors the resume will overwrite
+            self._inflight_scan.skip.add(slot)
+        return state
+
+    def resume(self, state: Dict[str, object]) -> int:
+        """Re-admit a :meth:`preempt` checkpoint into a free slot:
+        allocate pages, scatter the raw snapshot back, and restore
+        every host mirror.  Raises RuntimeError (no free slot) or
+        PagePoolExhausted (still under pressure) — the caller
+        re-queues and retries later."""
+        if not self._paged:
+            raise RuntimeError("preemption needs kv_paging=True")
+        pool = self._pool
+        assert pool is not None
+        free = self.free_slots()
+        if not free:
+            raise RuntimeError("no free slots")
+        slot = free[0]
+        lens = int(state["lens"])  # type: ignore[arg-type]
+        if self._slot_prompts[slot] is not None:
+            self._drop_donor(slot)
+        pool.clear_slot(slot)
+        n_pages = (lens + pool.page_size - 1) // pool.page_size
+        got: List[int] = []
+        try:
+            for _ in range(n_pages):
+                # reclaim parked donor pages (but never preempt — the
+                # resuming request is itself the yielding party) before
+                # giving up
+                while True:
+                    try:
+                        got.append(pool.alloc())
+                        break
+                    except PagePoolExhausted:
+                        if not self._reclaim_parked():
+                            raise
+        except PagePoolExhausted:
+            for p in got:
+                pool.give_back(p)
+            raise
+        targets = np.full(pool.n_tables, pool.scratch, np.int32)
+        for idx, p in enumerate(got):
+            pool.map(slot, idx, p)
+            targets[idx] = p
+        self.cache = _paged_restore_raw(
+            self.cache, state["kv"], jnp.asarray(targets),
+            jnp.int32(slot), jnp.int32(lens))
+        self.lens[slot] = lens
+        self.outputs[slot] = list(state["outputs"])  # type: ignore[arg-type]
+        self.last_token[slot] = state["last_token"]
+        self._slot_prompts[slot] = state["record"]
+        self._stops[slot] = state["stops"]
+        self._ignore_eos[slot] = state["ignore_eos"]
+        self.temps[slot] = state["temperature"]
+        self.topks[slot] = state["top_k"]
+        self.topps[slot] = state["top_p"]
+        self.minps[slot] = state["min_p"]
+        self.pres[slot] = state["presence_penalty"]
+        self.freqs[slot] = state["frequency_penalty"]
+        self.reps[slot] = state["repetition_penalty"]
+        self.adapters[slot] = state["adapter"]
+        self.seeds[slot] = np.uint32(state["seed"])
+        self._seed_streams[slot] = state["seed_stream"]
+        self._seed_on[slot] = state["seed_on"]
+        self._slot_draws[slot] = int(state["slot_draws"])  # type: ignore[arg-type]
+        self._lp_want[slot] = int(state["lp_want"])  # type: ignore[arg-type]
+        self._lp_records[slot] = list(state["lp_records"])  # type: ignore[arg-type]
+        self._prompt_lp[slot] = list(state["prompt_lp"])  # type: ignore[arg-type]
+        self.min_toks[slot] = state["min_toks"]
+        self.gstate[slot] = state["gstate"]
+        self._finished.pop(slot, None)
+        self._finish_reason.pop(slot, None)
+        # penalty histograms rebuild exactly: every device increment
+        # was +1.0 on f32 counts, so host bincounts reproduce them
+        if state["presence_penalty"] or state["frequency_penalty"]:
+            cnt = np.bincount(
+                np.asarray(state["outputs"], np.int64),
+                minlength=self.model.vocab).astype(np.float32)
+            self._counts = _set_count_row(
+                self._counts, jnp.int32(slot), jnp.asarray(cnt))
+        rec = state["record"]
+        if state["repetition_penalty"] != 1.0:
+            hist = list(state["outputs"])  # type: ignore[arg-type]
+            if rec is not None:
+                hist = np.asarray(rec[0], np.int64).tolist() + hist
+            sn = np.bincount(
+                np.asarray(hist, np.int64),
+                minlength=self.model.vocab).astype(np.float32)
+            self._seen = _set_count_row(
+                self._seen, jnp.int32(slot), jnp.asarray(sn))
+        if state["bias"] is not None:
+            self._bias = _set_count_row(
+                self._bias, jnp.int32(slot),
+                jnp.asarray(state["bias"]))
+            self._bias_on[slot] = True
+        elif self._bias_on[slot]:
+            self._bias = _zero_count_row(self._bias, slot)
+            self._bias_on[slot] = False
+        if state["min_toks"]:
+            mask_np = np.zeros(self.model.vocab, np.float32)
+            if self.eos_id is not None:
+                mask_np[self.eos_id] = -1e6
+            for t in state["stops"]:  # type: ignore[union-attr]
+                mask_np[int(t)] = -1e6
+            self._min_mask = _set_count_row(
+                self._min_mask, jnp.int32(slot), jnp.asarray(mask_np))
+        self.active[slot] = True
+        self._knob_cache = None
+        if self._inflight_scan is not None:
+            self._inflight_scan.skip.add(slot)
+        return slot
+
     # -- admission ---------------------------------------------------------
 
     @property
@@ -1034,31 +1524,108 @@ class ServingEngine:
             return None
         return best
 
+    def _touch_prefix(self, handle: int) -> None:
+        """LRU stamp: a registry entry was used (registered, matched,
+        or explicitly admitted against)."""
+        self._use_seq += 1
+        self._prefix_touch[handle] = self._use_seq
+
     def register_prefix(self, tokens, adapter: Optional[int] = None) -> int:
         """Prefill a shared prompt prefix (e.g. a system prompt) ONCE
         and reuse it across admits: ``admit(prompt, prefix=handle)``
         skips recomputing the first ``len(tokens)`` positions.  Returns
         an opaque handle.  A prefix is bound to its ``adapter`` (the
         adapter shapes the prefix K/V!); admits must request the same
-        one."""
+        one.
+
+        The registry is BOUNDED (``prefix_registry_max``, default a
+        generous 256): each handle pins a full [1, T_max, Hkv, Dh]
+        per-layer cache, so a long-lived server registering freely
+        would grow host/device bookkeeping without limit.  Past the
+        cap, the least-recently-used entry is evicted (counted in
+        ``prefix_evictions``) — exactly what an explicit
+        :meth:`release_prefix` would have done."""
         toks = jnp.asarray(tokens, jnp.int32).reshape(1, -1)
         if int(toks.shape[1]) < 1:
             raise ValueError("empty prefix")
         aid = self._check_adapter(adapter)
+        while len(self._prefixes) >= self.prefix_registry_max:
+            lru = min(self._prefixes,
+                      key=lambda h: self._prefix_touch.get(h, 0))
+            self._prefixes.pop(lru, None)
+            self._prefix_touch.pop(lru, None)
+            self._prefix_evictions += 1
         mini = self._place_cache(init_cache(self.model, 1))
         mini, last = self._extend_prompt(mini, toks, start=0, adapter=aid)
         handle = self._next_prefix
         self._next_prefix += 1
         self._prefixes[handle] = (
             np.asarray(toks[0], np.int32), mini, last, aid)
+        self._touch_prefix(handle)
         return handle
 
     def release_prefix(self, handle: int) -> None:
         """Drop a registered prefix.  Each handle retains a full
         [1, T_max, Hkv, Dh] per-layer cache (sized for max_len, not the
         prefix — splice and extend need full rows), so long-running
-        engines should release prefixes they no longer admit against."""
+        engines should release prefixes they no longer admit against
+        (the ``prefix_registry_max`` LRU cap is the backstop)."""
         self._prefixes.pop(handle, None)
+        self._prefix_touch.pop(handle, None)
+
+    def _slot_src(self, ref: int):
+        """Donor slot rows as a B=1 mini cache: a contiguous copy-out,
+        or a pool gather by the donor's block table in paged mode."""
+        if self._paged:
+            assert self._pool is not None
+            return self._place_cache(_paged_gather_mini(
+                self.cache, jnp.asarray(self._pool.tables[ref]),
+                self.model.dtype))
+        return self._place_cache(_slot_to_mini(self.cache,
+                                               jnp.int32(ref)))
+
+    def _paged_land(self, st: AdmitState, mini) -> None:
+        """Finish-side block-table build for a paged admission: clear
+        the slot's stale mappings, install the begin-time prefix
+        shares, allocate owned pages for the prefilled suffix, and
+        splice the mini into THOSE pages only (shared entries target
+        the scratch page — a shared page is never written while
+        shared).  Pure-share landings (exact repeats) skip the splice:
+        one cache_lens fix and the tokens flow."""
+        pool = self._pool
+        assert pool is not None
+        slot = st.slot
+        ps = pool.page_size
+        # incref-at-begin makes this safe even when the donor IS this
+        # slot: clear unrefs the old mappings, the share refs keep the
+        # pages alive, map_shared re-installs them
+        pool.clear_slot(slot)
+        pool.map_shared(slot, st.share_pages)
+        shared_n = len(st.share_pages)
+        st.share_pages = []  # consumed by the table
+        end_page = (st.prefill_end + ps - 1) // ps
+        try:
+            for idx in range(shared_n, end_page):
+                pool.map(slot, idx, self._alloc_page())
+        except PagePoolExhausted:
+            # roll the landing back; the slot reservation stands and
+            # the caller aborts or retries (rare: the begin-time gate
+            # budgeted these pages — only a mid-flight decode burst
+            # can have taken them).  The previous occupant's donor
+            # record lost its pages with the clear, so it dies too.
+            pool.clear_slot(slot)
+            self._slot_prompts[slot] = None
+            raise
+        if mini is None:
+            self.cache = _set_len(self.cache, jnp.int32(slot),
+                                  jnp.int32(st.t_p))
+        else:
+            targets = np.full(pool.n_tables, pool.scratch, np.int32)
+            row = pool.tables[slot]
+            targets[shared_n:end_page] = row[shared_n:end_page]
+            self.cache = _paged_splice(
+                self.cache, mini, jnp.asarray(targets),
+                jnp.int32(slot), jnp.int32(st.t_p))
 
     def admit(self, prompt, prefix: Optional[int] = None,
               temperature: float = 0.0,
@@ -1351,7 +1918,42 @@ class ServingEngine:
         else:
             st.chunks_total = (n + self.chunk - 1) // self.chunk
 
+        if self._paged:
+            # page-budget gate: rows [start_shared, prefill_end) need
+            # owned pages at finish.  Reclaim parked donor pages until
+            # the budget fits or raise HERE (nothing mutated yet) —
+            # PagePoolExhausted at begin is the server's cue to apply
+            # QoS policy (preempt a lower-priority slot or re-queue),
+            # where the contiguous engine could only ever say
+            # "no free slots".
+            assert self._pool is not None
+            ps = self._pool.page_size
+            c = self.chunk
+            st.prefill_end = (start + ((n + c - 1) // c) * c
+                              if n > 0 else t_p)
+            if auto_src is not None and auto_src[0] == "slot_full" \
+                    and self._draft_model is None:
+                # inplace or page-share landing: nothing allocated
+                shared_est = (t_p + ps - 1) // ps
+            elif auto_src is not None and auto_src[0] == "slot":
+                shared_est = auto_src[2] // ps
+            else:
+                shared_est = 0
+            need = (st.prefill_end + ps - 1) // ps - shared_est
+            if need > self._pool.n_pages:
+                raise ValueError(
+                    f"prompt needs {need} KV pages, pool holds "
+                    f"{self._pool.n_pages}")
+            while (self._pool.free_pages() < need
+                   and self._reclaim_parked()):
+                pass
+            if self._pool.free_pages() < need:
+                raise PagePoolExhausted(
+                    f"admission needs {need} KV pages, "
+                    f"{self._pool.free_pages()} free")
+
         if prefix is not None:
+            self._touch_prefix(prefix)
             if n > 0:
                 # copy before extending: extend_step DONATES its cache,
                 # and the registry entry must survive for the next admit
@@ -1365,6 +1967,8 @@ class ServingEngine:
                 st.result = (pcache, plast)
         elif auto_src is not None:
             kind, ref, m = auto_src
+            if kind in ("reg", "reg_full"):
+                self._touch_prefix(ref)
             if kind == "reg_full":
                 # exact registry prompt: zero extends, no copy
                 # (_splice_slot does not donate its mini) — identical
@@ -1382,9 +1986,19 @@ class ServingEngine:
                     # slot's cache_lens back to t_p
                     st.inplace = True
                     st.result = (None, rec_full[3])
+                elif self._paged and self._draft_model is None:
+                    # paged exact repeat into a DIFFERENT slot: no
+                    # copy either — the slot maps the donor's pages by
+                    # reference (refcounted; the first append past the
+                    # shared rows pays one CoW page copy instead of
+                    # the contiguous path's full-row splice)
+                    assert self._pool is not None
+                    st.share_pages = self._pool.share(
+                        ref, (t_p + self._pool.page_size - 1)
+                        // self._pool.page_size)
+                    st.result = (None, rec_full[3])
                 else:
-                    src = self._place_cache(
-                        _slot_to_mini(self.cache, jnp.int32(ref)))
+                    src = self._slot_src(ref)
                     st.result = (
                         _set_len(src, jnp.int32(0), jnp.int32(t_p)),
                         rec_full[3])
@@ -1397,8 +2011,17 @@ class ServingEngine:
                     src = jax.tree_util.tree_map(
                         jnp.copy, self._prefixes[ref][1])
                 else:
-                    src = self._place_cache(
-                        _slot_to_mini(self.cache, jnp.int32(ref)))
+                    src = self._slot_src(ref)
+                    if self._paged:
+                        # the matched prefix pages map by reference;
+                        # only the suffix (and the boundary page, if
+                        # the grid ever splits one) lands owned.  The
+                        # gathered mini still materializes the prefix
+                        # rows — the suffix extend attends to them —
+                        # but the POOL keeps one copy.
+                        assert self._pool is not None
+                        st.share_pages = self._pool.share(
+                            ref, m // self._pool.page_size)
                 # rows beyond m are stale donor data masked out by the
                 # cache_lens reset; the suffix extend overwrites
                 # [m, ...)
@@ -1413,7 +2036,8 @@ class ServingEngine:
                 plp_out=st.plp_dev)
         # reservation is the LAST begin-side mutation: everything above
         # may raise, and a rejected begin must leave the engine exactly
-        # as it found it
+        # as it found it (share_pages refcounts are rolled back by
+        # abort_admit, the one begin-side effect with a paired undo)
         self._reserved[slot] = True
         return st
 
@@ -1449,6 +2073,11 @@ class ServingEngine:
             st.gen.close()
             st.gen = None
         st.result = None
+        if st.share_pages:
+            # roll back the begin-time prefix-share refcounts
+            assert self._pool is not None
+            self._pool.unshare(st.share_pages)
+            st.share_pages = []
         self._reserved[st.slot] = False
 
     def finish_admit(self, st: AdmitState) -> int:
@@ -1496,6 +2125,8 @@ class ServingEngine:
             # is done
             self.cache = _set_len(self.cache, jnp.int32(slot),
                                   jnp.int32(st.t_p))
+        elif self._paged:
+            self._paged_land(st, mini)
         else:
             self.cache = _splice_slot(self.cache, mini,
                                       jnp.int32(slot))
@@ -1800,6 +2431,21 @@ class ServingEngine:
 
     # -- decoding ----------------------------------------------------------
 
+    def _engine_extend(self, tokens, positions, aids):
+        """One extend on the ENGINE cache (vs. the B=1 admission
+        minis, which always run contiguous): the paged engine swaps in
+        its paged model twin + block tables, everything else is the
+        same compiled step."""
+        if self._paged:
+            logits, self.cache = extend_step(
+                self._pmodel, self.params, self.cache, tokens,
+                positions, aids, self._bt())
+        else:
+            logits, self.cache = extend_step(
+                self.model, self.params, self.cache, tokens,
+                positions, aids)
+        return logits
+
     def step(self) -> Dict[int, int]:
         """One decode step for every active slot, each picking its
         next token with its own temperature/top-k (0/None = greedy).
@@ -1811,13 +2457,14 @@ class ServingEngine:
                 self._finish(s)
         if not any(self.active):
             return {}
+        self._ensure_append_pages(1)
+        if not any(self.active):
+            return {}  # the page-pressure policy preempted the rest
         tokens = jnp.asarray(self.last_token)[:, None]
         positions = jnp.asarray(self.lens, jnp.int32)[:, None]
         aids = (jnp.asarray(self.adapters)
                 if self.model.n_adapters > 0 else None)
-        logits, self.cache = extend_step(
-            self.model, self.params, self.cache, tokens, positions,
-            aids)
+        logits = self._engine_extend(tokens, positions, aids)
         self._steps += 1
         sidx = np.asarray(self._slot_draws, np.int32)
         draws_before = self._draws
@@ -1937,6 +2584,9 @@ class ServingEngine:
             # only costs accept rate on later rounds (the target verify
             # is ground truth), never token correctness.
             return {s: [t] for s, t in self.step().items()}
+        self._ensure_append_pages(g + 1)
+        if not any(self.active):
+            return {}
         first = jnp.asarray(self.last_token)          # [S]
         pos0 = jnp.asarray(self.lens, jnp.int32)      # [S]
         if self._ngram:
@@ -1963,9 +2613,7 @@ class ServingEngine:
             g + 1, dtype=jnp.int32)[None, :]
         aids = (jnp.asarray(self.adapters)
                 if self.model.n_adapters > 0 else None)
-        logits, self.cache = extend_step(
-            self.model, self.params, self.cache, verify, positions,
-            aids)
+        logits = self._engine_extend(verify, positions, aids)
         if self._bias_live():
             # logit_bias composes with greedy spec: the verify rule is
             # the SAME biased argmax plain decoding uses, so tokens
@@ -2190,6 +2838,9 @@ class ServingEngine:
                 post[s] = st
             else:
                 chains[s] = []
+        self._ensure_append_pages(T)
+        if not any(self.active):
+            return {}
         toks = np.zeros((self.n_slots, T), np.int32)
         toks[:, 0] = self.last_token
         for s, c in chains.items():
@@ -2201,9 +2852,8 @@ class ServingEngine:
                      + jnp.arange(T, dtype=jnp.int32)[None, :])
         aids = (jnp.asarray(self.adapters)
                 if self.model.n_adapters > 0 else None)
-        logits, self.cache = extend_step(
-            self.model, self.params, self.cache, jnp.asarray(toks),
-            positions, aids)
+        logits = self._engine_extend(jnp.asarray(toks), positions,
+                                     aids)
         # bonus pick from each slot's post-chain position
         lg = jnp.take_along_axis(
             logits, jnp.asarray(k)[:, None, None], axis=1)[:, 0, :]
@@ -2301,6 +2951,10 @@ class ServingEngine:
                 raise ValueError(
                     f"slot {s} has {self.model.max_len - self.lens[s]} "
                     f"cache rows left, need {n_steps}")
+        self._ensure_append_pages(n_steps)
+        if not any(self.active):
+            raise RuntimeError(
+                "page-pressure policy preempted every active slot")
         sampled = _knobs_live(self.temps, self.topks, self.topps,
                               self.minps, self.pres, self.freqs,
                               self.reps)
@@ -2339,7 +2993,8 @@ class ServingEngine:
             # tiny fixed shape keeps the jit cache key stable
             gtable = jnp.zeros((1, 1), jnp.int32)
         ys, self.cache, self._counts, self._seen = _scan_decode(
-            self.model, n_steps, sampled, lp_k, pen, rep, seeded,
+            self._pmodel if self._paged else self.model,
+            n_steps, sampled, lp_k, pen, rep, seeded,
             biased, minned, grammared, self.params, self.cache,
             jnp.asarray(self.last_token), jnp.asarray(self.lens, jnp.int32),
             temps_d, topks_d,
@@ -2354,6 +3009,7 @@ class ServingEngine:
             seed_on_d,
             jnp.asarray(self._slot_draws, jnp.int32), aids,
             self._rng, jnp.int32(self._draws),
+            self._bt() if self._paged else None,
         )
         handle = _ScanHandle(ys, n_steps, sampled, lp_k, grammared,
                              list(self.active))
@@ -2518,7 +3174,7 @@ class ServingEngine:
     def stats(self) -> Dict[str, int]:
         """Engine counters for the debug/observability endpoint:
         slot occupancy, total emitted tokens, decode steps taken."""
-        return {
+        out = {
             "n_slots": self.n_slots,
             "active_slots": sum(self.active),
             "free_slots": self.n_slots - sum(self.active),
@@ -2535,7 +3191,13 @@ class ServingEngine:
             "spec_accepted": self._spec_accepted,
             "jump_rounds": self._jump_rounds,
             "jump_forced_tokens": self._jump_forced,
+            "prefix_evictions": self._prefix_evictions,
         }
+        if self._paged:
+            assert self._pool is not None
+            out.update(self._pool.stats())
+            out["kv_preemptions"] = self._kv_preemptions
+        return out
 
     def release(self, slot: int) -> None:
         """Free a slot (abandons any in-flight generation)."""
@@ -2574,3 +3236,7 @@ class ServingEngine:
         self._seed_on[slot] = 0
         self._lp_want[slot] = 0  # records stay readable post-finish
         self._knob_cache = None  # device mirrors are stale now
+        # parked-donor LRU stamp: under pool pressure the OLDEST
+        # parked record's pages are reclaimed first
+        self._park_counter += 1
+        self._park_seq[slot] = self._park_counter
