@@ -1,0 +1,685 @@
+"""Router tier: routing determinism, failover, stream pass-through.
+
+Three layers of coverage:
+
+1. Pure routing units (no sockets, no jax): affinity-key alignment,
+   consistent-hash determinism across router restarts and registration
+   orders, least-loaded selection off statz snapshots, the overload
+   gate, staleness eviction, registration validation, promlint-clean
+   metric families.
+2. Fake-replica integration (stdlib sockets, no jax): pre-stream
+   failover onto the live replica with breaker + failover accounting,
+   and the mid-stream death path — the router must terminate the
+   stream with a WELL-FORMED in-band error frame (JSON-lines and SSE
+   framings both), never a silent truncation.
+3. Real-engine equivalence (jax, tiny decoder): JSON-lines and SSE
+   streams BYTE-IDENTICAL through the router hop vs direct-to-replica,
+   traceparent/X-Trace-Id propagation with the X-Replica stamp, and
+   the /statz surface in lock-step with the /metrics families.
+"""
+
+import http.client
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from tpu_k8s_device_plugin import obs
+from tpu_k8s_device_plugin.workloads.router import (
+    DEFAULT_PREFIX_CHUNK,
+    RouterServer,
+    affinity_key,
+)
+
+# ---------------------------------------------------------------------------
+# layer 1: pure routing units
+
+
+def test_affinity_key_chunk_alignment():
+    chunk = 32
+    base = list(range(1, 65))             # 64 tokens = 2 chunks
+    k64 = affinity_key({"tokens": base}, chunk)
+    # extra tokens INSIDE the last partial chunk do not change the key
+    assert affinity_key({"tokens": base + [99, 98]}, chunk) == k64
+    assert affinity_key({"tokens": base + [1]}, chunk) == k64
+    # a full extra chunk DOES
+    assert affinity_key(
+        {"tokens": base + list(range(100, 132))}, chunk) != k64
+    # sub-chunk prompts hash whole (deterministic, never None)
+    short = affinity_key({"tokens": [5, 6, 7]}, chunk)
+    assert short == affinity_key({"tokens": [5, 6, 7]}, chunk)
+    assert short != affinity_key({"tokens": [5, 6, 8]}, chunk)
+    # string prompts hash their text; bools are not token ids
+    assert affinity_key({"prompt": "hello"}, chunk) is not None
+    assert affinity_key({"tokens": [True, False]}, chunk) is None
+    assert affinity_key({}, chunk) is None
+
+
+def _mk_router(**kw):
+    kw.setdefault("statz_interval_s", 60.0)  # poller effectively off
+    kw.setdefault("replica_ttl_s", 60.0)
+    return RouterServer(**kw)
+
+
+def test_consistent_hash_stable_across_restart_and_order():
+    """Same prompt -> same replica: across fresh RouterServer
+    instances (a router restart) and across registration orders (the
+    ring depends only on the replica-id set)."""
+    reps = [{"address": f"127.0.0.1:{9000 + i}",
+             "replica_id": f"replica-{i}"} for i in range(4)]
+    keys = [affinity_key({"tokens": [i * 7 + j for j in range(64)]},
+                         DEFAULT_PREFIX_CHUNK) for i in range(20)]
+    rt1 = _mk_router()
+    for r in reps:
+        rt1.register(dict(r))
+    rt2 = _mk_router()                   # "restarted" router
+    for r in reversed(reps):             # different order
+        rt2.register(dict(r))
+    t1 = [rt1.affinity_target(k) for k in keys]
+    t2 = [rt2.affinity_target(k) for k in keys]
+    assert t1 == t2
+    # the hash actually spreads (not everything on one replica)
+    assert len(set(t1)) > 1
+
+
+def test_pick_prefers_affinity_then_least_loaded():
+    rt = _mk_router()
+    rt.register({"address": "127.0.0.1:9001", "replica_id": "a",
+                 "capacity": 4})
+    rt.register({"address": "127.0.0.1:9002", "replica_id": "b",
+                 "capacity": 4})
+    key = next(
+        affinity_key({"tokens": [i] * 32}, 32) for i in range(1, 99)
+        if rt.affinity_target(
+            affinity_key({"tokens": [i] * 32}, 32)) == "a")
+    rep, hit = rt.pick(key)
+    assert rep is not None and rep.rid == "a" and hit
+    # load the affinity target past the overload gate -> least-loaded
+    with rt._lock:
+        rt._replicas["a"].statz = {
+            "queue_depth": 100, "in_flight": 4, "capacity": 4,
+            "scheduler_alive": True}
+        rt._replicas["b"].statz = {
+            "queue_depth": 0, "in_flight": 1, "capacity": 4,
+            "scheduler_alive": True}
+    rep, hit = rt.pick(key)
+    assert rep is not None and rep.rid == "b" and not hit
+    # no key at all -> pure least-loaded
+    rep, hit = rt.pick(None)
+    assert rep is not None and rep.rid == "b" and not hit
+
+
+def test_pick_skips_dead_scheduler_and_open_breaker():
+    rt = _mk_router()
+    rt.register({"address": "127.0.0.1:9001", "replica_id": "a"})
+    rt.register({"address": "127.0.0.1:9002", "replica_id": "b"})
+    with rt._lock:
+        rt._replicas["a"].statz = {"scheduler_alive": False}
+    rep, _ = rt.pick(None)
+    assert rep is not None and rep.rid == "b"
+    # open b's breaker too -> nothing routable
+    with rt._lock:
+        brk = rt._replicas["b"].breaker
+    for _ in range(rt.breaker_threshold):
+        brk.record_failure()
+    rep, _ = rt.pick(None)
+    assert rep is None
+    assert not rt.healthy()
+
+
+def test_stale_replica_evicted():
+    rt = _mk_router(replica_ttl_s=0.2)
+    rt.register({"address": "127.0.0.1:9001", "replica_id": "a"})
+    assert [r["replica_id"] for r in rt.replicas()] == ["a"]
+    time.sleep(0.3)
+    rt._poll_once()
+    assert rt.replicas() == []
+    samples = obs.parse_exposition(rt.registry.render())
+    evs = [v for n, lab, v in samples
+           if n == "tpu_router_replica_evictions_total"]
+    assert evs and evs[0] == 1
+    # re-registration resurrects it (fresh breaker, fresh stamp)
+    rt.register({"address": "127.0.0.1:9001", "replica_id": "a"})
+    assert [r["replica_id"] for r in rt.replicas()] == ["a"]
+
+
+def test_register_validation():
+    rt = _mk_router()
+    with pytest.raises(ValueError):
+        rt.register({})
+    with pytest.raises(ValueError):
+        rt.register({"address": "no-port"})
+    with pytest.raises(ValueError):
+        rt.register({"address": "host:notaport"})
+    out = rt.register({"address": "10.0.0.1:8000"})
+    assert out["ok"] and out["replica_id"] == "10.0.0.1:8000"
+
+
+def test_router_metric_families_promlint_clean():
+    import sys
+    sys.path.insert(0, "tools")
+    import promlint
+
+    rt = _mk_router()
+    rt.register({"address": "127.0.0.1:9001", "replica_id": "a"})
+    rt._m_requests.labels(replica="a", outcome="ok").inc()
+    rt._m_route.observe(0.01)
+    rt._m_failovers.inc()
+    rt._m_affinity.inc()
+    rt._m_shed.labels(reason="no_replicas").inc()
+    errors = promlint.lint(rt.registry.render())
+    assert errors == [], errors
+
+
+# ---------------------------------------------------------------------------
+# layer 2: fake replicas (stdlib sockets, no jax)
+
+
+class _FakeReplica:
+    """A scriptable stand-in replica: answers /statz, and /generate
+    with either a complete chunked stream or a deliberate mid-stream
+    connection drop (unterminated chunked body)."""
+
+    def __init__(self, frames, die_after=None, content_type=None):
+        self.frames = [f if isinstance(f, bytes) else f.encode()
+                       for f in frames]
+        self.die_after = die_after       # frames sent before dying
+        self.content_type = content_type or "application/jsonlines"
+        self.requests = 0
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET,
+                              socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(8)
+        self.port = self._sock.getsockname()[1]
+        self._stop = False
+        self._thread = threading.Thread(target=self._serve,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def address(self):
+        return f"127.0.0.1:{self.port}"
+
+    def _serve(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        try:
+            data = b""
+            while b"\r\n\r\n" not in data:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    return
+                data += chunk
+            head, _, rest = data.partition(b"\r\n\r\n")
+            first = head.split(b"\r\n")[0].decode()
+            if first.startswith("GET /statz"):
+                body = json.dumps({
+                    "scheduler_alive": True, "queue_depth": 0,
+                    "in_flight": 0, "capacity": 4}).encode()
+                conn.sendall(
+                    b"HTTP/1.1 200 OK\r\nContent-Type: "
+                    b"application/json\r\nContent-Length: %d\r\n\r\n%s"
+                    % (len(body), body))
+                return
+            # POST /generate: drain the body per Content-Length
+            length = 0
+            for line in head.split(b"\r\n"):
+                if line.lower().startswith(b"content-length:"):
+                    length = int(line.split(b":")[1])
+            while len(rest) < length:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    return
+                rest += chunk
+            self.requests += 1
+            conn.sendall(
+                b"HTTP/1.1 200 OK\r\nContent-Type: %s\r\n"
+                b"Transfer-Encoding: chunked\r\n\r\n"
+                % self.content_type.encode())
+            for i, frame in enumerate(self.frames):
+                if self.die_after is not None and i >= self.die_after:
+                    conn.close()        # mid-stream death, no 0-chunk
+                    return
+                conn.sendall(b"%x\r\n%s\r\n" % (len(frame), frame))
+                time.sleep(0.01)
+            conn.sendall(b"0\r\n\r\n")
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def stop(self):
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _wait_samples(rt, predicate, timeout_s=5.0):
+    """Poll the router registry until *predicate*(samples) is truthy
+    (the handler thread increments outcome counters just AFTER the
+    terminator byte the client unblocks on — a scrape immediately
+    after the response races it)."""
+    deadline = time.time() + timeout_s
+    while True:
+        samples = obs.parse_exposition(rt.registry.render())
+        got = predicate(samples)
+        if got or time.time() >= deadline:
+            return got, samples
+
+
+def _post_router(port, payload, path="/generate", headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    conn.request("POST", path, json.dumps(payload), hdrs)
+    resp = conn.getresponse()
+    body = resp.read()
+    out_headers = dict(resp.headers)
+    conn.close()
+    return resp.status, out_headers, body
+
+
+@pytest.fixture()
+def live_router():
+    # breaker_threshold=1: one observed failure opens the breaker, so
+    # the failover/abort assertions below are deterministic instead of
+    # racing the statz poller for the second strike
+    rt = RouterServer(statz_interval_s=0.2, replica_ttl_s=30.0,
+                      breaker_reset_s=30.0, breaker_threshold=1,
+                      seed=7)
+    rt.start(host="127.0.0.1", port=0)
+    yield rt
+    rt.stop()
+
+
+def _key_for(rt, rid, n=64):
+    """A token prompt whose affinity target is *rid*."""
+    for i in range(1, 500):
+        cand = [(i + j) % 1000 + 1 for j in range(n)]
+        if rt.affinity_target(
+                affinity_key({"tokens": cand}, rt.prefix_chunk)) == rid:
+            return cand
+    raise AssertionError(f"no prompt hashed to {rid}")
+
+
+def test_pre_stream_failover_onto_live_replica(live_router):
+    """Affinity target dead before any byte: the request retries on
+    the live replica, the breaker opens, the failover is counted."""
+    rt = live_router
+    ok = _FakeReplica(
+        ['{"tokens":[1,2]}\n', '{"done": true, "tokens": [1, 2]}\n'])
+    # a dead address: bind a port, close it again
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+    try:
+        rt.register({"address": ok.address, "replica_id": "live"})
+        rt.register({"address": f"127.0.0.1:{dead_port}",
+                     "replica_id": "dead"})
+        prompt = _key_for(rt, "dead")
+        status, headers, body = _post_router(
+            rt.port, {"tokens": prompt, "max_new_tokens": 2})
+        assert status == 200
+        assert headers.get("X-Replica") == "live"
+        assert body.endswith(b'{"done": true, "tokens": [1, 2]}\n')
+        fo, _ = _wait_samples(rt, lambda samples: [
+            v for n, lab, v in samples
+            if n == "tpu_router_failovers_total" and v >= 1])
+        assert fo
+        from tpu_k8s_device_plugin import resilience
+        with rt._lock:
+            state = rt._replicas["dead"].breaker.state
+        assert state == resilience.BREAKER_OPEN
+        # journal carries the failover + the routed outcome
+        names = [e["name"] for e in rt.recorder.events()]
+        assert "tpu_router_failover" in names
+        assert "tpu_router_routed" in names
+    finally:
+        ok.stop()
+
+
+def test_unroutable_when_everything_down(live_router):
+    rt = live_router
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+    rt.register({"address": f"127.0.0.1:{dead_port}",
+                 "replica_id": "dead"})
+    status, headers, body = _post_router(
+        rt.port, {"tokens": [1, 2, 3], "max_new_tokens": 2})
+    assert status == 503
+    err = json.loads(body)
+    assert "error" in err and err["code"] == 503
+    # and with NO replicas at all, the other 503 flavor
+    with rt._lock:
+        rt._replicas.clear()
+        rt._rebuild_ring_locked()
+    status, _, body = _post_router(
+        rt.port, {"tokens": [1, 2, 3], "max_new_tokens": 2})
+    assert status == 503
+    samples = obs.parse_exposition(rt.registry.render())
+    shed = [v for n, lab, v in samples
+            if n == "tpu_router_shed_total"
+            and lab.get("reason") == "no_replicas"]
+    assert shed and shed[0] >= 2
+
+
+def test_mid_stream_death_emits_wellformed_jsonlines_frame(
+        live_router):
+    """The replica dies after 2 frames: the client's stream must end
+    with a parseable JSON error line and a clean chunked terminator
+    (http.client raises on a truncated chunked body — reading to EOF
+    without an exception IS the well-formedness proof)."""
+    rt = live_router
+    fake = _FakeReplica(
+        ['{"tokens":[1,2]}\n', '{"tokens":[3,4]}\n',
+         '{"tokens":[5,6]}\n', '{"done": true}\n'],
+        die_after=2)
+    try:
+        rt.register({"address": fake.address, "replica_id": "dying"})
+        status, headers, body = _post_router(
+            rt.port, {"tokens": [9] * 64, "max_new_tokens": 8})
+        assert status == 200
+        lines = body.strip().split(b"\n")
+        # the passed-through frames arrive untouched...
+        assert lines[0] == b'{"tokens":[1,2]}'
+        assert lines[1] == b'{"tokens":[3,4]}'
+        # ...and the terminal line is the router's structured error
+        last = json.loads(lines[-1])
+        assert last["code"] == 502 and "mid-stream" in last["error"]
+        got, _ = _wait_samples(rt, lambda samples: [
+            v for n, lab, v in samples
+            if n == "tpu_router_requests_total"
+            and lab.get("replica") == "dying"
+            and lab.get("outcome") == "stream_abort"])
+        assert got and got[0] == 1
+        names = [e["name"] for e in rt.recorder.events()]
+        assert "tpu_router_stream_abort" in names
+    finally:
+        fake.stop()
+
+
+def test_mid_stream_death_emits_wellformed_sse_frame(live_router):
+    """Same death, SSE framing: the terminal frame is a `data:` event
+    carrying the OpenAI error shape."""
+    rt = live_router
+    fake = _FakeReplica(
+        ["data: {\"id\":\"cmpl-1\"}\n\n", "data: {\"x\":2}\n\n",
+         "data: [DONE]\n\n"],
+        die_after=1, content_type="text/event-stream")
+    try:
+        rt.register({"address": fake.address, "replica_id": "dying"})
+        status, headers, body = _post_router(
+            rt.port, {"prompt": "hi", "max_tokens": 4},
+            path="/v1/completions")
+        assert status == 200
+        assert body.startswith(b"data: {\"id\":\"cmpl-1\"}\n\n")
+        tail = body.split(b"\n\n")[-2]          # last complete event
+        assert tail.startswith(b"data: ")
+        err = json.loads(tail[len(b"data: "):])
+        assert err["error"]["type"] == "server_error"
+    finally:
+        fake.stop()
+
+
+def test_router_statz_poll_updates_load(live_router):
+    rt = live_router
+    fake = _FakeReplica(['{"done": true}\n'])
+    try:
+        rt.register({"address": fake.address, "replica_id": "a"})
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            with rt._lock:
+                snap = dict(rt._replicas["a"].statz)
+            if snap:
+                break
+            time.sleep(0.05)
+        assert snap.get("capacity") == 4
+        assert snap.get("scheduler_alive") is True
+    finally:
+        fake.stop()
+
+
+# ---------------------------------------------------------------------------
+# layer 3: real-engine equivalence (jax)
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from tpu_k8s_device_plugin.workloads.inference import make_decoder  # noqa: E402
+from tpu_k8s_device_plugin.workloads.server import EngineServer  # noqa: E402
+from tpu_k8s_device_plugin.workloads.serving import ServingEngine  # noqa: E402
+
+CFG = dict(vocab=128, d_model=64, n_heads=4, n_layers=2, d_ff=128)
+
+
+class _ByteTok:
+    def encode(self, s):
+        return list(s.encode("latin-1"))
+
+    def decode(self, ids):
+        return bytes(int(t) % 256 for t in ids).decode("latin-1")
+
+
+@pytest.fixture(scope="module")
+def engine_stack():
+    model = make_decoder(**CFG, max_len=64, dtype=jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+    params = model.init(rng, tokens, pos)["params"]
+    eng = ServingEngine(model, params, n_slots=2)
+    srv = EngineServer(eng, max_new_tokens=8, window=4,
+                       tokenizer=_ByteTok())
+    srv.start(host="127.0.0.1", port=0)
+    rt = RouterServer(statz_interval_s=0.2, replica_ttl_s=30.0,
+                      seed=3)
+    rt.start(host="127.0.0.1", port=0)
+    srv.start_registration(f"http://127.0.0.1:{rt.port}",
+                           replica_id="r0", model="test",
+                           interval_s=0.3)
+    deadline = time.time() + 10
+    while time.time() < deadline and not rt.healthy():
+        time.sleep(0.05)
+    assert rt.healthy()
+    yield srv, rt
+    rt.stop()
+    srv.stop()
+
+
+def _raw_post(port, payload, path="/generate", headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    conn.request("POST", path, json.dumps(payload), hdrs)
+    resp = conn.getresponse()
+    body = resp.read()
+    out = dict(resp.headers)
+    conn.close()
+    return resp.status, out, body
+
+
+def test_jsonlines_stream_byte_identical_through_router(engine_stack):
+    srv, rt = engine_stack
+    payload = {"tokens": [3, 14, 15, 9, 2, 6], "max_new_tokens": 8}
+    # warm both paths once (compile + APC donor) so the compared pair
+    # are the same cadence: an APC repeat direct vs through the hop
+    _raw_post(srv.port, payload)
+    st_d, hd_d, body_d = _raw_post(srv.port, payload)
+    st_r, hd_r, body_r = _raw_post(rt.port, payload)
+    assert st_d == st_r == 200
+    assert body_d == body_r          # BYTE-identical, framing included
+    assert hd_r.get("X-Replica") == "r0"
+    assert hd_d.get("Content-Type") == hd_r.get("Content-Type")
+
+
+def test_per_token_stream_byte_identical_through_router(engine_stack):
+    srv, rt = engine_stack
+    payload = {"tokens": [7, 7, 3], "max_new_tokens": 6,
+               "per_token": True}
+    _raw_post(srv.port, payload)
+    _, _, body_d = _raw_post(srv.port, payload)
+    _, _, body_r = _raw_post(rt.port, payload)
+    assert body_d == body_r
+
+
+def test_unary_response_byte_identical_through_router(engine_stack):
+    srv, rt = engine_stack
+    payload = {"tokens": [5, 17, 3], "max_new_tokens": 5,
+               "stream": False}
+    _, _, body_d = _raw_post(srv.port, payload)
+    _, _, body_r = _raw_post(rt.port, payload)
+    assert body_d == body_r
+    assert json.loads(body_r)["done"] is True
+
+
+def test_sse_stream_byte_identical_through_router(engine_stack):
+    """OpenAI SSE through the hop: byte-identical modulo the fields
+    that are EXPECTED to differ per request (the cmpl-<trace-id> id
+    and the created stamp) — so the comparison normalizes those and
+    then requires byte equality, and separately pins the raw framing
+    (data:/[DONE]) untouched."""
+    import re
+
+    srv, rt = engine_stack
+    payload = {"prompt": "abc", "max_tokens": 6, "stream": True,
+               "temperature": 0.0}
+    _raw_post(srv.port, payload, path="/v1/completions")
+
+    def norm(b):
+        b = re.sub(rb"cmpl-[0-9a-f]+", b"cmpl-X", b)
+        return re.sub(rb'"created": \d+', b'"created": 0', b)
+
+    st_d, _, body_d = _raw_post(srv.port, payload,
+                                path="/v1/completions")
+    st_r, hd_r, body_r = _raw_post(rt.port, payload,
+                                   path="/v1/completions")
+    assert st_d == st_r == 200
+    assert norm(body_d) == norm(body_r)
+    assert body_r.rstrip().endswith(b"data: [DONE]")
+    assert hd_r.get("X-Replica") == "r0"
+
+
+def test_traceparent_propagates_through_hop(engine_stack):
+    srv, rt = engine_stack
+    trace_id = "ab" * 16
+    tp = f"00-{trace_id}-{'cd' * 8}-01"
+    st, headers, _ = _raw_post(
+        rt.port, {"tokens": [4, 4, 4], "max_new_tokens": 2},
+        headers={"traceparent": tp})
+    assert st == 200
+    # the replica continued OUR trace: same trace-id comes back in
+    # both echo headers, through the router hop
+    assert headers.get("X-Trace-Id") == trace_id
+    assert headers.get("traceparent", "").split("-")[1] == trace_id
+    assert headers.get("X-Replica") == "r0"
+    # and the replica's journal holds the trace (the hop really
+    # carried it, not just echoed it)
+    evs = srv.recorder.events(trace_id=trace_id)
+    assert evs
+
+
+def test_affinity_deterministic_across_router_restart(engine_stack):
+    """Same prompt -> same replica across a router RESTART with the
+    same replica set (the ring is id-derived, not session-derived)."""
+    srv, rt = engine_stack
+    prompt = [9, 9, 8, 7, 1, 5]
+    st1, hd1, _ = _raw_post(rt.port, {"tokens": prompt,
+                                      "max_new_tokens": 2})
+    rt2 = RouterServer(statz_interval_s=0.2, seed=99)  # fresh router
+    rt2.start(host="127.0.0.1", port=0)
+    try:
+        rt2.register({"address": f"127.0.0.1:{srv.port}",
+                      "replica_id": "r0"})
+        st2, hd2, _ = _raw_post(rt2.port, {"tokens": prompt,
+                                           "max_new_tokens": 2})
+        assert st1 == st2 == 200
+        assert hd1.get("X-Replica") == hd2.get("X-Replica") == "r0"
+        key = affinity_key({"tokens": prompt}, DEFAULT_PREFIX_CHUNK)
+        assert rt.affinity_target(key) == rt2.affinity_target(key)
+    finally:
+        rt2.stop()
+
+
+def test_statz_lockstep_with_metrics(engine_stack):
+    """The /statz snapshot must agree with the tpu_serving_* families
+    the SAME server renders — the router's load signal and the
+    dashboards must never tell different stories."""
+    srv, rt = engine_stack
+    # some traffic so the counters are non-trivial
+    _raw_post(srv.port, {"tokens": [2, 71, 82], "max_new_tokens": 3})
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                      timeout=30)
+    conn.request("GET", "/statz")
+    statz = json.loads(conn.getresponse().read())
+    conn.close()
+    assert set(statz) == {
+        "scheduler_alive", "queue_depth", "in_flight", "capacity",
+        "kv_pages", "kv_pages_free", "requests_served", "shed"}
+    assert set(statz["shed"]) == {"connections", "queue", "quota"}
+    samples = obs.parse_exposition(srv.render_metrics())
+
+    def metric(name):
+        vals = [v for n, lab, v in samples if n == name]
+        return vals[0] if vals else None
+
+    assert statz["scheduler_alive"] is True
+    assert statz["queue_depth"] == metric(
+        "tpu_serving_pending_requests")
+    assert statz["capacity"] == metric("tpu_serving_n_slots")
+    # contiguous engine: the kv bridge gauges only exist under
+    # --kv-paging, but the tpu_serve_* pool family renders 0 always
+    assert statz["kv_pages"] == (metric("tpu_serving_kv_pages") or 0)
+    assert statz["kv_pages_free"] == metric(
+        "tpu_serve_kv_pages_free")
+    assert statz["requests_served"] == metric(
+        "tpu_serving_requests_served_total")
+    assert statz["in_flight"] == (
+        metric("tpu_serving_running_copies")
+        + metric("tpu_serving_admitting_copies"))
+    shed = {lab.get("reason"): v for n, lab, v in samples
+            if n == "tpu_serve_shed_total"}
+    for reason in ("connections", "queue", "quota"):
+        assert statz["shed"][reason] == shed.get(reason, 0)
+
+
+def test_router_429_passthrough_not_failover(engine_stack):
+    """A replica 429 (queue shed) is POLICY, not failure: it passes
+    through with its Retry-After instead of being retried onto another
+    replica (which would amplify load exactly when shedding)."""
+    srv, rt = engine_stack
+    old_max = srv.max_queue
+    srv.max_queue = 0                      # everything sheds
+    try:
+        st, headers, body = _raw_post(
+            rt.port, {"tokens": [1, 2, 3], "max_new_tokens": 2})
+        assert st == 429
+        assert "Retry-After" in headers
+        assert headers.get("X-Replica") == "r0"
+        err = json.loads(body)
+        assert err["code"] == 429
+    finally:
+        srv.max_queue = old_max
+    shed, _ = _wait_samples(rt, lambda samples: [
+        v for n, lab, v in samples
+        if n == "tpu_router_requests_total"
+        and lab.get("replica") == "r0"
+        and lab.get("outcome") == "shed"])
+    assert shed and shed[0] >= 1

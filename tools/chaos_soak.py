@@ -593,6 +593,265 @@ def episode_tenant_burst_page_pressure(seed):
         srv.stop()
 
 
+def episode_router_replica_kill(seed):
+    """Episode 10: a serving replica is SIGKILLed under burst behind
+    the router tier.  The surviving replica absorbs the load: only the
+    requests mid-stream ON THE DEAD REPLICA error — each with a
+    well-formed in-band error frame and a clean chunked terminator,
+    never a silent truncation — every post-kill request lands 200 on
+    the survivor (pre-stream failover / routing-around), the victim's
+    circuit breaker opens, and when the replica comes back under the
+    same identity the breaker closes and affinity traffic returns to
+    it.  All journal/metric-proven on the router's own surfaces."""
+    import http.client
+    import json
+    import os
+    import subprocess
+    import sys
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_k8s_device_plugin.workloads.bench_serving import (
+        _free_port,
+        _wait_http_ok,
+    )
+    from tpu_k8s_device_plugin.workloads.inference import make_decoder
+    from tpu_k8s_device_plugin.workloads.router import (
+        RouterServer,
+        affinity_key,
+    )
+    from tpu_k8s_device_plugin.workloads.server import EngineServer
+    from tpu_k8s_device_plugin.workloads.serving import ServingEngine
+
+    rt = RouterServer(statz_interval_s=0.5, replica_ttl_s=5.0,
+                      breaker_reset_s=0.5, seed=seed)
+    rt.start(host="127.0.0.1", port=0)
+
+    # survivor: in-process tiny engine registered as replica-a
+    model = make_decoder(vocab=128, d_model=64, n_heads=4, n_layers=2,
+                         d_ff=128, max_len=256, dtype=jnp.float32)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+    params = model.init(jax.random.PRNGKey(0), tokens, pos)["params"]
+    eng = ServingEngine(model, params, n_slots=2)
+    survivor = EngineServer(eng, max_new_tokens=200, window=4)
+    survivor.start(host="127.0.0.1", port=0)
+    survivor.start_registration(
+        f"http://127.0.0.1:{rt.port}", replica_id="replica-a",
+        model="chaos-tiny", interval_s=0.3)
+
+    # victim: a REAL replica subprocess (the CLI a pod runs), so the
+    # kill is a kill — no graceful drain, sockets die mid-chunk
+    victim_port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    # victim max_len 2048: the burst streams need SECONDS of decode
+    # left when the SIGKILL lands (a short stream fits entirely in
+    # socket buffers before the kill and aborts nothing)
+    victim = subprocess.Popen(
+        [sys.executable, "-m",
+         "tpu_k8s_device_plugin.workloads.server",
+         "--config", "tiny", "--n-slots", "2", "--max-len", "2048",
+         "--max-new-tokens", "2000", "--window", "4",
+         "--host", "127.0.0.1", "--port", str(victim_port),
+         "--register-with", f"http://127.0.0.1:{rt.port}",
+         "--replica-id", "replica-b", "--register-interval", "0.3"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    revived = None
+    try:
+        _wait_http_ok(victim_port, "/healthz", 600)
+        _wait_http_ok(
+            rt.port, "/replicas", 30,
+            lambda b: sum(r["healthy"] for r in b["replicas"]) >= 2)
+        check(True, "router sees both replicas healthy")
+
+        # deterministic prompts pinned to each replica via the ring
+        import random
+        rng = random.Random(seed)
+
+        def prompt_for(rid):
+            while True:
+                cand = [rng.randrange(1, 128) for _ in range(32)]
+                if rt.affinity_target(
+                        affinity_key({"tokens": cand}, 32)) == rid:
+                    return cand
+
+        p_victim = prompt_for("replica-b")
+        p_surv = prompt_for("replica-a")
+
+        def stream(prompt, budget):
+            """One streaming request through the router; returns
+            (status, X-Replica, event lines, first-line event)."""
+            conn = http.client.HTTPConnection("127.0.0.1", rt.port,
+                                              timeout=120)
+            conn.request("POST", "/generate", json.dumps(
+                {"tokens": prompt, "max_new_tokens": budget,
+                 "ignore_eos": True}),
+                {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            replica = resp.headers.get("X-Replica")
+            lines = []
+            first = threading.Event()
+            try:
+                for line in resp:
+                    if line.strip():
+                        lines.append(line.strip())
+                        first.set()
+            finally:
+                conn.close()
+            return resp.status, replica, lines
+
+        # baseline: affinity routes each prompt to its ring target
+        st, rep, lines = stream(p_victim, 8)
+        check(st == 200 and rep == "replica-b",
+              f"affinity routed the victim-bound prompt to replica-b "
+              f"(got {st} via {rep})")
+        st, rep, lines = stream(p_surv, 8)
+        check(st == 200 and rep == "replica-a",
+              f"affinity routed the survivor-bound prompt to "
+              f"replica-a (got {st} via {rep})")
+
+        # -- burst + kill ---------------------------------------------
+        results = {}
+        started = threading.Event()
+
+        def burst_one(key, prompt, budget):
+            conn = http.client.HTTPConnection("127.0.0.1", rt.port,
+                                              timeout=120)
+            try:
+                conn.request("POST", "/generate", json.dumps(
+                    {"tokens": prompt, "max_new_tokens": budget,
+                     "ignore_eos": True}),
+                    {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                replica = resp.headers.get("X-Replica")
+                lines = []
+                for line in resp:
+                    if line.strip():
+                        lines.append(line.strip())
+                        if replica == "replica-b":
+                            started.set()
+                results[key] = (resp.status, replica, lines, None)
+            # tpulint: disable=R2 -- not a swallow: the exception is captured into results and asserted on by the episode (a truncated stream must FAIL it)
+            except Exception as e:
+                results[key] = (-1, None, [], e)
+            finally:
+                conn.close()
+
+        burst = (
+            [threading.Thread(target=burst_one,
+                              args=(f"v{i}", p_victim, 1500))
+             for i in range(2)]
+            + [threading.Thread(target=burst_one,
+                                args=(f"s{i}", p_surv, 24))
+               for i in range(2)])
+        for t in burst:
+            t.start()
+        check(started.wait(timeout=60),
+              "victim streams flowing before the kill")
+        victim.kill()          # SIGKILL: no drain, sockets die
+        victim.wait(timeout=30)
+        t_kill = time.monotonic()
+        for t in burst:
+            t.join(timeout=120)
+
+        aborted = completed = 0
+        for key, (st, rep, lines, exc) in sorted(results.items()):
+            check(exc is None,
+                  f"burst request {key} ended with a parseable "
+                  f"stream, not a transport error ({exc})")
+            check(st == 200 and lines,
+                  f"burst request {key} got headers + frames")
+            last = json.loads(lines[-1])
+            if "done" in last:
+                completed += 1
+            else:
+                # the well-formed in-band error frame: structured
+                # JSON naming the dead replica, code 502
+                check("error" in last and last.get("code") == 502
+                      and rep == "replica-b",
+                      f"aborted stream {key} ended with a well-formed "
+                      f"502 error frame on the dead replica ({last})")
+                aborted += 1
+        check(aborted >= 1,
+              f"at least one in-flight stream on the dead replica "
+              f"aborted mid-stream ({aborted} did)")
+        check(completed >= 2,
+              f"streams off the dead replica completed normally "
+              f"({completed} did)")
+
+        # post-kill: every new request lands on the survivor, 200
+        for i in range(4):
+            st, rep, lines = stream(p_victim, 8)
+            check(st == 200 and rep == "replica-a",
+                  f"post-kill request {i} failed over to the "
+                  f"survivor (got {st} via {rep})")
+            check(json.loads(lines[-1]).get("done") is True,
+                  f"post-kill request {i} completed")
+        reconverge_s = time.monotonic() - t_kill
+        check(reconverge_s < 60.0,
+              f"post-kill traffic reconverged in {reconverge_s:.1f}s")
+
+        # journal + metric proof
+        names = [e["name"] for e in rt.recorder.events()]
+        check("tpu_router_stream_abort" in names,
+              "mid-stream abort journaled")
+        opened = [e for e in rt.recorder.events(
+            name="tpu_breaker_transition")
+            if e["attrs"].get("op") == "router.replica.replica-b"
+            and e["attrs"].get("to") == "open"]
+        check(opened, "victim breaker opened in the journal")
+        samples = obs.parse_exposition(rt.registry.render())
+        aborts = [v for n, lab, v in samples
+                  if n == "tpu_router_requests_total"
+                  and lab.get("replica") == "replica-b"
+                  and lab.get("outcome") == "stream_abort"]
+        check(aborts and aborts[0] >= 1,
+              "tpu_router_requests_total{replica-b,stream_abort} "
+              "counted")
+        healthy = {lab.get("replica"): v for n, lab, v in samples
+                   if n == "tpu_router_replica_healthy"}
+        check(healthy.get("replica-a") == 1,
+              "tpu_router_replica_healthy{replica-a} = 1")
+        check(healthy.get("replica-b", 0) == 0,
+              "tpu_router_replica_healthy{replica-b} = 0 after kill")
+
+        # -- revival: same identity, breaker closes, affinity returns -
+        eng2 = ServingEngine(model, params, n_slots=2)
+        revived = EngineServer(eng2, max_new_tokens=200, window=4)
+        revived.start(host="127.0.0.1", port=victim_port)
+        revived.start_registration(
+            f"http://127.0.0.1:{rt.port}", replica_id="replica-b",
+            advertise=f"127.0.0.1:{victim_port}",
+            model="chaos-tiny", interval_s=0.3)
+        _wait_http_ok(
+            rt.port, "/replicas", 30,
+            lambda b: sum(r["healthy"] for r in b["replicas"]) >= 2)
+        closed = [e for e in rt.recorder.events(
+            name="tpu_breaker_transition")
+            if e["attrs"].get("op") == "router.replica.replica-b"
+            and e["attrs"].get("to") == "closed"]
+        check(closed, "victim breaker closed after revival")
+        st, rep, lines = stream(p_victim, 8)
+        check(st == 200 and rep == "replica-b",
+              f"affinity traffic returned to the revived replica "
+              f"(got {st} via {rep})")
+    finally:
+        if revived is not None:
+            revived.stop()
+        survivor.stop()
+        rt.stop()
+        victim.kill()
+        try:
+            victim.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            pass
+
+
 def _reshape_slice(tmp, testdata, seed, suffix, grace, hb_timeout):
     """A dedicated 2-host slice with live staleness + reshape grace (the
     main soak coordinator drives heartbeats manually with no timeout, so
@@ -839,6 +1098,9 @@ def main(argv=None) -> int:
             log.info("=== episode 9: tenant burst under KV page "
                      "pressure ===")
             episode_tenant_burst_page_pressure(args.seed)
+            log.info("=== episode 10: replica kill under burst "
+                     "through the router ===")
+            episode_router_replica_kill(args.seed)
         # -- final convergence sweep ----------------------------------
         for h in hosts:
             h.pulse()

@@ -100,6 +100,14 @@ PHASES = [
     # before anyone serves it (lossy mode: NOT covered by the
     # equivalence gate)
     ("serving_paged_kv_int8_b8", 1800),
+    # round-10 addition: the router-tier A/B on real chips.  CPU
+    # router-smoke proves the mechanism (scaling gate, failover); what
+    # only hardware can answer is whether 2 single-chip replicas
+    # behind the router actually deliver ~2x the single-replica HBM-
+    # bound tok/s (they decode independently — the router adds one
+    # socket hop), and what the hop costs TTFT at real decode rates.
+    # Compare tokens_per_sec_router_{1,n} + affinity_hit_rate.
+    ("serving_router_2rep_b8", 2400),
 ]
 
 
@@ -355,6 +363,24 @@ def phase_serving_paged_kv_int8_b8():
         "kv_pages_shared": st["kv_pages_shared"],
         "sample_output_head": eng.output(slots[0])[:8],
     }
+
+
+def phase_serving_router_2rep_b8():
+    """Router-tier A/B on hardware: 2 single-chip 8B-int8 replica
+    subprocesses (each pinned to its own TPU via TPU_VISIBLE_DEVICES
+    when >= 2 chips are granted) behind the in-process router, vs the
+    same load through the router at 1 replica.  run_router reports
+    both aggregates + the affinity hit rate; scaling below ~1.8x on
+    independent chips means the hop (or the affinity split) is the
+    bottleneck, not the engines."""
+    from tpu_k8s_device_plugin.workloads.bench_serving import (
+        run_router,
+    )
+
+    return run_router("llama3-8b", True, n_replicas=2, clients=8,
+                      n_requests=32, slots=8, steps=64,
+                      prompt_len=128, max_len=512, kill=False,
+                      seed=1)
 
 
 def phase_grammar_overhead_b8():

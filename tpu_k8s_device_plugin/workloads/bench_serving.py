@@ -734,6 +734,314 @@ def _http_throughput(model, params, prompt, steps, clients,
     return out
 
 
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_http_ok(port, path, timeout_s, predicate=None):
+    """Poll GET path until 200 (and *predicate*(json) when given)."""
+    import http.client
+    import json as _json
+    import time
+
+    deadline = time.time() + timeout_s
+    last = None
+    while time.time() < deadline:
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=5)
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            body = resp.read()
+            conn.close()
+            last = (resp.status, body[:120])
+            if resp.status == 200:
+                if predicate is None:
+                    return True
+                if predicate(_json.loads(body)):
+                    return True
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.25)
+    raise RuntimeError(f"{path} on :{port} not ready within "
+                       f"{timeout_s}s (last: {last})")
+
+
+def _spawn_replica(config, quantized, idx, port, router_port, slots,
+                   steps, prompt_len, max_len):
+    """One serving replica subprocess through the REAL CLI (the same
+    path a pod runs), self-registering with the router."""
+    import os
+    import subprocess
+    import sys
+
+    cmd = [
+        sys.executable, "-m",
+        "tpu_k8s_device_plugin.workloads.server",
+        "--config", config,
+        "--n-slots", str(slots),
+        "--max-len", str(max_len),
+        "--max-new-tokens", str(steps),
+        "--window", "16",
+        "--host", "127.0.0.1", "--port", str(port),
+        "--register-with", f"http://127.0.0.1:{router_port}",
+        "--replica-id", f"replica-{idx}",
+        "--register-interval", "0.5",
+    ]
+    if quantized == "int4":
+        cmd.append("--int4")
+    elif quantized:
+        cmd.append("--quantized")
+    return subprocess.Popen(
+        cmd, env=dict(os.environ),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def _router_load(router_port, prompts, steps, clients, n_requests,
+                 lock):
+    """Drive *n_requests* streaming requests (round-robin over
+    *prompts* — repeats are the affinity workload) through the router
+    with *clients* concurrent clients.  Returns (wall, done_tokens,
+    statuses, errors)."""
+    import http.client
+    import json as _json
+    import threading
+    import time
+
+    done_tokens, statuses, errors = [], [], []
+    seq = iter(range(n_requests))
+
+    def client_loop():
+        while True:
+            with lock:
+                i = next(seq, None)
+            if i is None:
+                return
+            body = _json.dumps({
+                "tokens": prompts[i % len(prompts)],
+                "max_new_tokens": steps,
+            })
+            status = -1
+            try:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", router_port, timeout=600)
+                conn.request("POST", "/generate", body,
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                status = resp.status
+                n_toks = 0
+                bad = None
+                for line in resp:
+                    s = line.strip()
+                    if not s:
+                        continue
+                    if s.startswith(b'{"tokens":[') and s[-2:] == b']}':
+                        n_toks += s.count(b",") + 1
+                        continue
+                    ev = _json.loads(s)
+                    if "error" in ev:
+                        bad = ev["error"]
+                    elif "done" in ev:
+                        with lock:
+                            done_tokens.append(len(ev["tokens"]))
+                conn.close()
+                if bad is not None:
+                    with lock:
+                        errors.append(bad)
+            except OSError as e:
+                with lock:
+                    errors.append(str(e))
+            with lock:
+                statuses.append(status)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client_loop)
+               for _ in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=900)
+    return time.perf_counter() - t0, done_tokens, statuses, errors
+
+
+def run_router(config, quantized, n_replicas, clients, n_requests,
+               slots, steps, prompt_len, max_len, kill=False,
+               seed=0):
+    """Multi-replica mode: N replica subprocesses (the real
+    ``workloads.server`` CLI, self-registering) behind an in-process
+    ``workloads.router`` tier.  Phase 1 measures aggregate tokens/sec
+    through the router with ONE replica, phase 2 with all N — the
+    ratio is the scaling number the router-smoke CI job gates.  Also
+    reports per-replica request share and the affinity hit rate from
+    the router's own /metrics, and (with *kill*) SIGKILLs a replica
+    and proves the survivors absorb the follow-on traffic with zero
+    non-429 errors."""
+    import http.client
+    import json as _json
+    import random
+    import threading
+    import time
+
+    from tpu_k8s_device_plugin import obs
+
+    from .router import RouterServer
+
+    if n_requests < 2 * n_replicas:
+        raise ValueError(
+            f"--requests {n_requests} too small for --router "
+            f"{n_replicas} (need >= {2 * n_replicas})")
+    from .router import affinity_key
+
+    cfg = CONFIGS[config]
+    rng = random.Random(seed)
+    # a handful of DISTINCT prompts, each repeated many times: the
+    # affinity workload (repeat traffic must pin to the replica whose
+    # KV pool is already warm).  The set is BALANCED over the ring —
+    # every replica id gets the same number of affine prompts (the
+    # ring depends only on the ids, so a throwaway router computes the
+    # mapping before any replica exists) — so the scaling measurement
+    # reflects the router, not one seed's hash luck
+    n_prompts = max(2, 2 * n_replicas)
+    probe = RouterServer()
+    for i in range(n_replicas):
+        probe.register({"address": f"127.0.0.1:{9000 + i}",
+                        "replica_id": f"replica-{i}"})
+    want = {f"replica-{i}": n_prompts // n_replicas
+            for i in range(n_replicas)}
+    prompts = []
+    while sum(want.values()):
+        cand = [rng.randrange(1, cfg.vocab)
+                for _ in range(prompt_len)]
+        target = probe.affinity_target(
+            affinity_key({"tokens": cand}, probe.prefix_chunk))
+        if want.get(target, 0):
+            want[target] -= 1
+            prompts.append(cand)
+    lock = threading.Lock()
+    rt = RouterServer(statz_interval_s=0.25, replica_ttl_s=5.0,
+                      breaker_reset_s=1.0, seed=seed)
+    rt.start(host="127.0.0.1", port=0)
+    procs = []
+    out = {"router": True, "replicas": float(n_replicas)}
+
+    def scrape_router():
+        conn = http.client.HTTPConnection("127.0.0.1", rt.port,
+                                          timeout=10)
+        conn.request("GET", "/metrics")
+        body = conn.getresponse().read().decode()
+        conn.close()
+        return obs.parse_exposition(body)
+
+    try:
+        # -- phase 1: one replica through the router ------------------
+        port0 = _free_port()
+        procs.append(_spawn_replica(
+            config, quantized, 0, port0, rt.port, slots, steps,
+            prompt_len, max_len))
+        _wait_http_ok(port0, "/healthz", 600)
+        _wait_http_ok(
+            rt.port, "/replicas", 30,
+            lambda b: sum(r["healthy"] for r in b["replicas"]) >= 1)
+        # warm every prompt through the router (compile + APC donor)
+        _router_load(rt.port, prompts, steps, min(clients, 4),
+                     len(prompts), lock)
+        wall, toks, statuses, errors = _router_load(
+            rt.port, prompts, steps, clients, n_requests, lock)
+        if errors:
+            raise RuntimeError(
+                f"single-replica phase errored: {errors[0]}")
+        tps_1 = sum(toks) / wall
+        out["tokens_per_sec_router_1"] = tps_1
+        out["requests_completed_1"] = float(len(toks))
+        if n_replicas > 1:
+            # -- phase 2: the full fleet ------------------------------
+            for idx in range(1, n_replicas):
+                procs.append(_spawn_replica(
+                    config, quantized, idx, _free_port(), rt.port,
+                    slots, steps, prompt_len, max_len))
+            _wait_http_ok(
+                rt.port, "/replicas", 600,
+                lambda b: sum(r["healthy"] for r in b["replicas"])
+                >= n_replicas)
+            # re-warm: prompts re-mapped onto the grown ring, and each
+            # replica's first window sizes still need compiling
+            _router_load(rt.port, prompts, steps, min(clients, 4),
+                         2 * len(prompts), lock)
+            base = scrape_router()
+            base_req = {
+                lab.get("replica"): v for n, lab, v in base
+                if n == "tpu_router_requests_total"
+                and lab.get("outcome") == "ok"}
+            base_aff = sum(
+                v for n, lab, v in base
+                if n == "tpu_router_affinity_hits_total")
+            wall, toks, statuses, errors = _router_load(
+                rt.port, prompts, steps, clients, n_requests, lock)
+            if errors:
+                raise RuntimeError(
+                    f"router phase errored: {errors[0]}")
+            tps_n = sum(toks) / wall
+            out["tokens_per_sec_router_n"] = tps_n
+            out["requests_completed_n"] = float(len(toks))
+            out["scaling_x"] = tps_n / tps_1
+            out["scaling_efficiency"] = tps_n / tps_1 / n_replicas
+            samples = scrape_router()
+            served = {
+                lab.get("replica"): v - base_req.get(
+                    lab.get("replica"), 0.0)
+                for n, lab, v in samples
+                if n == "tpu_router_requests_total"
+                and lab.get("outcome") == "ok"}
+            total_ok = sum(served.values()) or 1.0
+            for rid in sorted(served):
+                out[f"share_{rid}"] = served[rid] / total_ok
+            aff = sum(v for n, lab, v in samples
+                      if n == "tpu_router_affinity_hits_total")
+            out["affinity_hit_rate"] = (aff - base_aff) / total_ok
+        if kill:
+            # -- kill phase: SIGKILL one replica, survivors absorb ----
+            victim = procs[-1]
+            victim.kill()
+            victim.wait(timeout=30)
+            t0 = time.perf_counter()
+            _w, ktoks, kstatuses, kerrors = _router_load(
+                rt.port, prompts, steps, min(clients, 4),
+                4 * max(1, n_replicas - 1), lock)
+            out["kill_requests"] = float(len(kstatuses))
+            out["kill_ok"] = float(
+                sum(s == 200 for s in kstatuses))
+            out["kill_429"] = float(
+                sum(s == 429 for s in kstatuses))
+            out["kill_errors"] = float(
+                sum(s not in (200, 429) for s in kstatuses)
+                + len(kerrors))
+            out["kill_recovery_s"] = time.perf_counter() - t0
+            samples = scrape_router()
+            out["failovers_total"] = sum(
+                v for n, lab, v in samples
+                if n == "tpu_router_failovers_total")
+    finally:
+        rt.stop()
+        import subprocess
+
+        for proc in procs:
+            proc.kill()
+        for proc in procs:
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                pass
+    out["config"] = config
+    out["quantized"] = quantized
+    return out
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tpu-serving-bench")
     p.add_argument("--config", choices=sorted(CONFIGS), default="tiny")
@@ -788,6 +1096,26 @@ def main(argv=None) -> int:
                         "tenant identities under weighted fair "
                         "queueing (tenant-0 = the weight-1 batch "
                         "lane, the rest weight-4 interactive lanes)")
+    p.add_argument("--router", type=int, default=0, metavar="N",
+                   help="with --http: multi-replica mode — spawn N "
+                        "serving-replica subprocesses (the real CLI, "
+                        "self-registering) behind the in-process "
+                        "router tier; reports aggregate tokens/sec, "
+                        "per-replica share, affinity hit rate, and "
+                        "scaling vs 1 replica through the same hop")
+    p.add_argument("--assert-scaling", type=float, default=0.0,
+                   metavar="FLOOR",
+                   help="with --router: exit nonzero unless the "
+                        "N-replica aggregate is >= FLOOR x the "
+                        "1-replica aggregate (the router-smoke CI "
+                        "gate)")
+    p.add_argument("--router-kill", action="store_true",
+                   help="with --router: SIGKILL one replica after the "
+                        "timed phases and prove the survivors absorb "
+                        "the follow-on traffic (zero non-429 errors, "
+                        "failovers counted)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="prompt/jitter RNG seed for --router")
     args = p.parse_args(argv)
 
     devs = jax.devices()
@@ -803,14 +1131,51 @@ def main(argv=None) -> int:
         p.error(f"{' and '.join(modes)} are mutually exclusive")
     if (args.requests or args.cancel_every or args.burst
             or args.assert_ratio or args.no_interleave
-            or args.kv_paging or args.tenants) \
+            or args.kv_paging or args.tenants or args.router) \
             and not args.http:
         p.error("--requests/--cancel-every/--burst/--assert-ratio/"
-                "--no-interleave/--kv-paging/--tenants only apply "
-                "with --http")
+                "--no-interleave/--kv-paging/--tenants/--router only "
+                "apply with --http")
     if args.tenants < 0:
         p.error("--tenants must be >= 0")
+    if args.router < 0:
+        p.error("--router must be >= 0")
+    if (args.assert_scaling or args.router_kill) and not args.router:
+        p.error("--assert-scaling/--router-kill need --router")
+    if args.router and (args.cancel_every or args.burst
+                        or args.assert_ratio or args.kv_paging
+                        or args.tenants or args.no_interleave):
+        p.error("--router is its own mode: the single-replica phase "
+                "flags do not apply")
     quantized = "int4" if args.int4 else args.quantized
+    if args.router:
+        try:
+            stats = run_router(
+                args.config, quantized, args.router,
+                clients=args.http,
+                n_requests=args.requests or 8 * args.http,
+                slots=args.batch, steps=args.steps,
+                prompt_len=args.prompt_len, max_len=args.max_len,
+                kill=args.router_kill, seed=args.seed)
+        except (ValueError, RuntimeError) as e:
+            p.error(str(e))
+        for k, v in stats.items():
+            print(f"{k}: {v}")
+        rc = 0
+        if args.assert_scaling:
+            scaling = stats.get("scaling_x", 0.0)
+            if scaling < args.assert_scaling:
+                print(f"FAIL: scaling_x {scaling:.3f} below the "
+                      f"{args.assert_scaling:.2f} floor", flush=True)
+                rc = 1
+            else:
+                print(f"OK: scaling_x {scaling:.3f} >= "
+                      f"{args.assert_scaling:.2f}", flush=True)
+        if args.router_kill and stats.get("kill_errors", 0):
+            print(f"FAIL: {stats['kill_errors']:.0f} non-429 errors "
+                  "after the replica kill", flush=True)
+            rc = 1
+        return rc
     try:
         stats = run(args.config, quantized, args.batch, args.steps,
                     args.prompt_len, args.max_len, engine=args.engine,

@@ -1,0 +1,1071 @@
+"""Multi-replica serving router: prefix-affinity + least-loaded + failover.
+
+One engine behind one HTTP server is the per-replica throughput
+ceiling; this module is the horizontal-scale tier above it — a thin,
+dependency-free HTTP router that fronts N ``workloads.server``
+replicas and multiplies aggregate tokens/sec while PRESERVING the
+prefix-cache hit rates the paged-KV copy-on-write pool makes cheap
+(the replica-routing posture of production serving stacks: a shared
+prefix is only warm on the replica that decoded it last).
+
+Deliberately jax-free (stdlib + ``obs`` + ``resilience`` only): the
+router runs on any box — a 1-vCPU sidecar, the bench driver, a CI
+runner — and never pays an accelerator runtime import for what is
+pure socket work.
+
+Replica discovery (slice-coordinator-style registration + heartbeats):
+replicas self-register over ``POST /register`` with their address,
+model id, and capacity (``workloads.server --register-with`` does this
+on a loop); each re-registration is the heartbeat, and a replica that
+stops heartbeating AND stops answering the ``/statz`` poll past
+``replica_ttl_s`` is evicted.  No config files, no ordering: replicas
+may register before or after the router takes traffic, and a restarted
+router relearns the fleet from the next heartbeat round.
+
+Routing is two-tier:
+
+1. **Prefix affinity** — a consistent hash (SHA-1 ring, ``vnodes``
+   virtual points per replica) over the prompt's leading
+   ``prefix_chunk``-aligned tokens.  Repeat and shared-prefix traffic
+   lands on the replica whose paged KV pool already holds those pages
+   (the engine's APC matches whole admission chunks, so the hash key
+   aligns to the same grid).  The ring depends ONLY on the sorted
+   replica ids — the same prompt maps to the same replica across
+   router restarts and registration orderings.
+2. **Least-loaded fallback** — when the affinity target is down,
+   breaker-open, or overloaded (queue depth + in-flight past
+   ``overload_factor``x its capacity), the request falls back to the
+   lowest ``(queue + in_flight) / capacity`` replica.  The load signal
+   is each replica's ``/statz`` JSON snapshot (queue depth, in-flight,
+   free KV pages, scheduler health), polled on a short cadence and
+   cached — the hot path never parses Prometheus text or blocks on a
+   health probe.
+
+Failover rides the resilience layer: a per-replica
+:class:`~tpu_k8s_device_plugin.resilience.CircuitBreaker` plus a
+seeded :class:`~tpu_k8s_device_plugin.resilience.RetryPolicy`.  A
+connect error or 5xx BEFORE any body byte was forwarded retries on the
+next-best replica (the failed one excluded, its breaker recording the
+failure); once streaming has started the router never re-frames or
+replays — a mid-stream replica death terminates the stream with a
+well-formed in-band error frame (JSON-lines or SSE, matching the
+response content type) and opens the breaker so the next request
+routes around the corpse.
+
+Streaming is passed through BYTE-IDENTICAL: the router de-chunks the
+replica's response and re-chunks the same bytes — it never parses,
+buffers whole, or re-frames a stream (the equivalence suite pins
+router-vs-direct byte equality for JSON-lines and SSE).  ``traceparent``
+propagates through the hop as a child context and every response
+carries ``X-Replica`` naming the replica that served it.
+
+API:
+
+  POST /generate | /v1/completions | /v1/chat/completions
+                     proxied to one replica (affinity -> least-loaded)
+  POST /register     replica registration + heartbeat:
+                     {"address": "host:port", "replica_id"?, "model"?,
+                      "capacity"?} -> {"ok": true, "interval_s": ...}
+  GET  /healthz      200 when >= 1 routable replica, else 503
+  GET  /replicas     the replica table (id, address, health, load)
+  GET  /metrics      tpu_router_* families (Prometheus exposition;
+                     OpenMetrics content negotiation like every other
+                     surface)
+  GET  /debug/events the router's flight-recorder journal
+
+Metric families::
+
+    tpu_router_requests_total{replica,outcome}   ok | upstream_error |
+                                 stream_abort | client_gone | shed |
+                                 client_error | unroutable
+    tpu_router_route_seconds         routing decision -> upstream
+                                     response headers (per attempt)
+    tpu_router_replica_healthy{replica}          1 routable, 0 not
+    tpu_router_failovers_total       retries that moved a request to
+                                     another replica
+    tpu_router_affinity_hits_total   requests served by their
+                                     prefix-affinity target
+    tpu_router_shed_total{reason}    router-side 429/503 sheds
+    tpu_router_replica_evictions_total   stale replicas dropped
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import http.client
+import json
+import logging
+import queue
+import threading
+import time
+from bisect import bisect_left
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from typing import (
+    Any,
+    Dict,
+    List,
+    Optional,
+    Protocol,
+    Set,
+    Tuple,
+    Type,
+)
+
+from tpu_k8s_device_plugin import obs, resilience
+from tpu_k8s_device_plugin.resilience import faults
+
+log = logging.getLogger(__name__)
+
+# the engine's default APC admission grid (ServingEngine
+# prefix_chunk="auto" lowers to 32 when max_len allows): hashing on
+# the same grid means two prompts sharing an APC-matchable prefix
+# share an affinity key
+DEFAULT_PREFIX_CHUNK = 32
+
+# proxied endpoints (everything else on POST is 404)
+PROXY_PATHS = ("/generate", "/v1/completions", "/v1/chat/completions")
+
+# hop-by-hop headers the router owns itself and never copies through
+_HOP_HEADERS = frozenset({
+    "connection", "keep-alive", "transfer-encoding", "content-length",
+    "te", "trailer", "upgrade", "proxy-connection", "server", "date",
+})
+
+_STREAM_READ = 65536  # upstream read granularity on the stream path
+
+
+def _now() -> float:
+    return time.monotonic()
+
+
+def _sha1_int(data: bytes) -> int:
+    return int.from_bytes(hashlib.sha1(data).digest()[:8], "big")
+
+
+def affinity_key(body: Dict[str, Any],
+                 prefix_chunk: int) -> Optional[bytes]:
+    """The consistent-hash key for one request body, or None when the
+    body carries nothing hashable (the replica will 400 it anyway).
+
+    Token prompts hash their leading ``prefix_chunk``-aligned tokens —
+    the engine's APC matches whole admission chunks, so requests that
+    can share cached KV pages share a key (a sub-chunk prompt hashes
+    whole: it can never APC-match, but determinism still holds).
+    String prompts / chat messages hash their full text: the router
+    cannot tokenize, so string affinity is exact-prefix-by-content —
+    still deterministic, still repeat-friendly."""
+    tokens = body.get("tokens")
+    if tokens is None:
+        tokens = body.get("prompt")
+    if tokens is None:
+        tokens = body.get("messages")
+    if isinstance(tokens, list) and tokens and all(
+            isinstance(t, int) and not isinstance(t, bool)
+            for t in tokens):
+        aligned = len(tokens) - len(tokens) % prefix_chunk
+        key = tokens[:aligned] if aligned else tokens
+        return b",".join(str(int(t)).encode() for t in key)
+    if isinstance(tokens, str) and tokens:
+        return tokens.encode("utf-8", "surrogatepass")
+    if isinstance(tokens, list) and tokens and all(
+            isinstance(m, dict) for m in tokens):
+        # chat messages: the rendered prompt is the replica's business;
+        # the JSON text is a stable stand-in for content affinity
+        try:
+            return json.dumps(tokens, sort_keys=True).encode()
+        except (TypeError, ValueError):
+            return None
+    return None
+
+
+@dataclass
+class Replica:
+    """One registered serving replica and its cached load signal."""
+
+    rid: str
+    address: str                      # "host:port"
+    model: str = ""
+    capacity: int = 0
+    registered_at: float = 0.0        # wall clock, for /replicas
+    last_seen: float = 0.0            # monotonic: heartbeat OR statz
+    statz: Dict[str, Any] = field(default_factory=dict)
+    statz_at: float = 0.0             # monotonic stamp of the snapshot
+    breaker: Optional[resilience.CircuitBreaker] = None
+
+    def host_port(self) -> Tuple[str, int]:
+        host, _, port = self.address.rpartition(":")
+        return host, int(port)
+
+    def load_score(self) -> float:
+        """Normalized queue pressure for least-loaded ordering: lower
+        is better.  An unknown snapshot scores a neutral 1.0 so a
+        fresh replica takes traffic without being preferred over a
+        provably-idle one."""
+        if not self.statz:
+            return 1.0
+        depth = float(self.statz.get("queue_depth", 0)) \
+            + float(self.statz.get("in_flight", 0))
+        cap = float(self.capacity
+                    or self.statz.get("capacity", 0) or 1.0)
+        return depth / max(cap, 1.0)
+
+    def overloaded(self, factor: float) -> bool:
+        """Past the affinity overload gate?  Only a KNOWN snapshot can
+        say yes — affinity is the default, not the exception."""
+        if not self.statz:
+            return False
+        depth = float(self.statz.get("queue_depth", 0)) \
+            + float(self.statz.get("in_flight", 0))
+        cap = float(self.capacity
+                    or self.statz.get("capacity", 0) or 1.0)
+        return depth >= factor * max(cap, 1.0)
+
+    def scheduler_alive(self) -> bool:
+        if not self.statz:
+            return True  # unknown: the breaker is the arbiter
+        return bool(self.statz.get("scheduler_alive", True))
+
+
+class _IncCounter(Protocol):
+    """The slice of an obs counter child the pooled server needs."""
+
+    def inc(self, amount: float = 1.0) -> None: ...
+
+
+class _UpstreamError(Exception):
+    """A pre-stream replica failure (connect error or 5xx): safe to
+    retry on another replica — no body byte reached the client."""
+
+    def __init__(self, message: str, status: int = 0) -> None:
+        super().__init__(message)
+        self.status = status
+
+
+class _PooledRouterHTTPServer(HTTPServer):
+    """Fixed-worker HTTP server for the router (the serving server's
+    pooled-accept posture without importing its jax-heavy module):
+    *workers* connections proxy concurrently, *workers* more wait, and
+    overflow is shed 429 on the accept thread."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+    request_queue_size = 128
+
+    _REJECT_BODY = (json.dumps({"error": {
+        "message": "router connection limit reached; retry later",
+        "type": "rate_limit_exceeded"}}) + "\n").encode()
+    _REJECT = (b"HTTP/1.1 429 Too Many Requests\r\n"
+               b"Content-Type: application/json\r\n"
+               b"Retry-After: 1\r\n"
+               b"Content-Length: %d\r\n"
+               b"Connection: close\r\n\r\n" % len(_REJECT_BODY)
+               ) + _REJECT_BODY
+
+    def __init__(self, addr: Tuple[str, int],
+                 handler: Type[BaseHTTPRequestHandler],
+                 workers: int, shed: _IncCounter) -> None:
+        super().__init__(addr, handler)
+        self._conns: "queue.Queue[Optional[Tuple[Any, Any]]]" = \
+            queue.Queue(maxsize=workers)
+        self._shed = shed
+        self._pool = [
+            threading.Thread(target=self._worker,
+                             name=f"router-http-{i}", daemon=True)
+            for i in range(workers)]
+        for t in self._pool:
+            t.start()
+
+    def process_request(self, request: Any,
+                        client_address: Any) -> None:
+        try:
+            self._conns.put_nowait((request, client_address))
+        except queue.Full:
+            self._shed.inc()
+            try:
+                request.settimeout(0.5)
+                request.sendall(self._REJECT)
+                try:
+                    request.recv(1 << 20)
+                except OSError:
+                    pass
+            except OSError:
+                pass
+            self.shutdown_request(request)
+
+    def _worker(self) -> None:
+        while True:
+            item = self._conns.get()
+            if item is None:
+                return
+            request, client_address = item
+            try:
+                self.finish_request(request, client_address)
+            except Exception:
+                self.handle_error(request, client_address)
+            finally:
+                self.shutdown_request(request)
+
+    def server_close(self) -> None:
+        super().server_close()
+        for _ in self._pool:
+            try:
+                self._conns.put_nowait(None)
+            except queue.Full:
+                break
+        for t in self._pool:
+            t.join(timeout=1)
+
+
+class RouterServer:
+    """The router tier: replica table + consistent-hash ring + proxy.
+
+    >>> rt = RouterServer().start(port=0)
+    >>> # replicas: python -m ...workloads.server --register-with \\
+    >>> #     http://host:rt.port
+    >>> rt.stop()
+    """
+
+    def __init__(self,
+                 prefix_chunk: int = DEFAULT_PREFIX_CHUNK,
+                 replica_ttl_s: float = 10.0,
+                 statz_interval_s: float = 0.5,
+                 max_connections: int = 64,
+                 failover_attempts: int = 3,
+                 overload_factor: float = 4.0,
+                 vnodes: int = 64,
+                 breaker_threshold: int = 2,
+                 breaker_reset_s: float = 2.0,
+                 connect_timeout_s: float = 5.0,
+                 client_timeout_s: float = 600.0,
+                 seed: Optional[int] = None,
+                 registry: Optional[obs.Registry] = None,
+                 flight_record_dir: Optional[str] = None,
+                 flight_record_capacity: int = 4096) -> None:
+        if prefix_chunk < 1:
+            raise ValueError("prefix_chunk must be >= 1")
+        if failover_attempts < 1:
+            raise ValueError("failover_attempts must be >= 1")
+        if vnodes < 1:
+            raise ValueError("vnodes must be >= 1")
+        self.prefix_chunk = prefix_chunk
+        self.replica_ttl_s = replica_ttl_s
+        self.statz_interval_s = statz_interval_s
+        self.max_connections = max_connections
+        self.failover_attempts = failover_attempts
+        self.overload_factor = overload_factor
+        self.vnodes = vnodes
+        self.breaker_threshold = breaker_threshold
+        self.breaker_reset_s = breaker_reset_s
+        self.connect_timeout_s = connect_timeout_s
+        self.client_timeout_s = client_timeout_s
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, Replica] = {}
+        # the ring caches (point -> rid) sorted by point; rebuilt only
+        # when the replica-ID SET changes, so lookups are O(log n)
+        self._ring: List[Tuple[int, str]] = []
+        self._stop = threading.Event()
+        self._httpd: Optional[_PooledRouterHTTPServer] = None
+        self._poller: Optional[threading.Thread] = None
+        # seeded like every other resilience consumer: a chaos run
+        # replays the same failover backoff schedule from its seed
+        self.retry = resilience.RetryPolicy(
+            max_attempts=failover_attempts, initial_backoff_s=0.02,
+            max_backoff_s=0.25, seed=seed)
+        self.registry = registry if registry is not None \
+            else obs.Registry()
+        reg = self.registry
+        self._rmetrics = resilience.ResilienceMetrics(reg)
+        self.recorder = obs.FlightRecorder(
+            capacity=flight_record_capacity, registry=reg)
+        if flight_record_dir:
+            self.recorder.install_dump_handlers(flight_record_dir)
+        self._m_requests = reg.counter(
+            "tpu_router_requests_total",
+            "Requests routed, by serving replica and outcome (ok, "
+            "client_error, shed, upstream_error, stream_abort, "
+            "client_gone, unroutable).", ("replica", "outcome"))
+        self._m_route = reg.histogram(
+            "tpu_router_route_seconds",
+            "Routing decision through upstream response headers for "
+            "one attempt (connect + request write + headers).",
+            buckets=obs.FAST_BUCKETS_S)
+        self._m_healthy = reg.gauge(
+            "tpu_router_replica_healthy",
+            "1 when the replica is routable (fresh + breaker not "
+            "open + scheduler alive), else 0.", ("replica",))
+        self._m_failovers = reg.counter(
+            "tpu_router_failovers_total",
+            "Pre-stream retries that moved a request onto another "
+            "replica after a connect error or 5xx.")
+        self._m_affinity = reg.counter(
+            "tpu_router_affinity_hits_total",
+            "Requests served by their prefix-affinity target replica "
+            "(consistent hash over the chunk-aligned prompt prefix).")
+        self._m_shed = reg.counter(
+            "tpu_router_shed_total",
+            "Router-side sheds by reason (connections = worker pool "
+            "full at accept, no_replicas = nothing routable).",
+            ("reason",))
+        self._shed_conns = self._m_shed.labels(reason="connections")
+        self._m_evictions = reg.counter(
+            "tpu_router_replica_evictions_total",
+            "Replicas evicted for staleness (no heartbeat and no "
+            "/statz answer within the TTL).")
+        reg.on_collect(self._collect_health)
+
+    # -- replica table ------------------------------------------------------
+
+    def register(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """Registration AND heartbeat (idempotent): upsert the replica
+        row, refresh its liveness stamp.  Raises ValueError on a
+        malformed payload (the HTTP surface answers 400)."""
+        address = payload.get("address")
+        if not isinstance(address, str) or ":" not in address:
+            raise ValueError("'address' must be \"host:port\"")
+        host, _, port_s = address.rpartition(":")
+        if not host or not port_s.isdigit():
+            raise ValueError("'address' must be \"host:port\"")
+        rid = str(payload.get("replica_id") or address)
+        model = str(payload.get("model") or "")
+        capacity = int(payload.get("capacity") or 0)
+        with self._lock:
+            rep = self._replicas.get(rid)
+            fresh = rep is None
+            if rep is None:
+                rep = Replica(
+                    rid=rid, address=address, model=model,
+                    capacity=capacity, registered_at=time.time(),
+                    breaker=resilience.CircuitBreaker(
+                        op=f"router.replica.{rid}",
+                        failure_threshold=self.breaker_threshold,
+                        reset_timeout_s=self.breaker_reset_s,
+                        metrics=self._rmetrics,
+                        recorder=self.recorder))
+                self._replicas[rid] = rep
+                self._rebuild_ring_locked()
+            rep.address = address
+            rep.model = model or rep.model
+            rep.capacity = capacity or rep.capacity
+            rep.last_seen = _now()
+            # an inline statz piggybacked on the heartbeat freshens the
+            # load signal without waiting for the next poll round
+            inline = payload.get("statz")
+            if isinstance(inline, dict):
+                rep.statz = inline
+                rep.statz_at = rep.last_seen
+        if fresh:
+            log.info("replica registered: %s at %s (model=%s cap=%d)",
+                     rid, address, model, capacity)
+            self.recorder.record("tpu_router_replica_registered",
+                                 replica=rid, address=address,
+                                 model=model, capacity=capacity)
+        return {"ok": True, "replica_id": rid,
+                "interval_s": max(self.replica_ttl_s / 3.0, 0.2)}
+
+    def _rebuild_ring_locked(self) -> None:
+        """The consistent-hash ring over the CURRENT replica-id set.
+        Points depend only on the ids (``sha1(rid#v)``), never on
+        registration order or wall time — the property the
+        same-prompt-same-replica-across-restarts test pins."""
+        ring: List[Tuple[int, str]] = []
+        for rid in self._replicas:
+            for v in range(self.vnodes):
+                ring.append((_sha1_int(f"{rid}#{v}".encode()), rid))
+        ring.sort()
+        self._ring = ring
+
+    def _evict_stale_locked(self) -> List[str]:
+        now = _now()
+        dead = [rid for rid, rep in self._replicas.items()
+                if now - rep.last_seen > self.replica_ttl_s]
+        for rid in dead:
+            del self._replicas[rid]
+        if dead:
+            self._rebuild_ring_locked()
+        return dead
+
+    def _routable(self, rep: Replica) -> bool:
+        """May traffic go to *rep* right now?  Fresh, breaker closed,
+        scheduler alive.  Deliberately side-effect-free: the half-open
+        probe slot belongs to the /statz poller (which records the
+        probe's outcome), so a health CHECK must never consume it —
+        recovery is detected by the poll loop and the breaker closes
+        within about one poll interval of the replica coming back."""
+        if _now() - rep.last_seen > self.replica_ttl_s:
+            return False
+        if not rep.scheduler_alive():
+            return False
+        assert rep.breaker is not None
+        return rep.breaker.state == resilience.BREAKER_CLOSED
+
+    def affinity_target(self, key: Optional[bytes]) -> Optional[str]:
+        """The ring's verdict for *key* over ALL registered replicas
+        (health is the pick's business, not the hash's — a temporarily
+        sick target must get its traffic back when it recovers, not
+        have it re-hashed away forever)."""
+        if key is None:
+            return None
+        with self._lock:
+            ring = self._ring
+        if not ring:
+            return None
+        h = _sha1_int(key)
+        i = bisect_left(ring, (h, ""))
+        if i == len(ring):
+            i = 0
+        return ring[i][1]
+
+    def _note_evictions(self, dead: List[str]) -> None:
+        for rid in dead:
+            self._m_evictions.inc()
+            self.recorder.record("tpu_router_replica_evicted",
+                                 replica=rid)
+            log.warning("replica %s evicted (stale past %.1fs)",
+                        rid, self.replica_ttl_s)
+
+    def pick(self, key: Optional[bytes],
+             exclude: Optional[Set[str]] = None
+             ) -> Tuple[Optional[Replica], bool]:
+        """Choose the replica for one attempt: the prefix-affinity
+        target when it is routable and not overloaded, else the
+        least-loaded routable replica.  Returns (replica,
+        affinity_hit); (None, False) when nothing is routable."""
+        exclude = exclude or set()
+        target = self.affinity_target(key)
+        with self._lock:
+            dead = self._evict_stale_locked()
+            candidates = [r for rid, r in self._replicas.items()
+                          if rid not in exclude]
+        self._note_evictions(dead)
+        if target is not None and target not in exclude:
+            for rep in candidates:
+                if rep.rid == target and self._routable(rep) \
+                        and not rep.overloaded(self.overload_factor):
+                    return rep, True
+        routable = [r for r in candidates if self._routable(r)]
+        if not routable:
+            return None, False
+        routable.sort(key=lambda r: (r.load_score(), r.rid))
+        return routable[0], False
+
+    def replicas(self) -> List[Dict[str, Any]]:
+        """The /replicas debug view (sorted, JSON-ready)."""
+        with self._lock:
+            reps = list(self._replicas.values())
+        now = _now()
+        out = []
+        for rep in sorted(reps, key=lambda r: r.rid):
+            assert rep.breaker is not None
+            out.append({
+                "replica_id": rep.rid,
+                "address": rep.address,
+                "model": rep.model,
+                "capacity": rep.capacity,
+                "healthy": self._routable(rep),
+                "breaker_state": rep.breaker.state,
+                "age_s": round(now - rep.last_seen, 3),
+                "load_score": round(rep.load_score(), 4),
+                "statz": rep.statz,
+            })
+        return out
+
+    def _collect_health(self) -> None:
+        """Scrape-time refresh of tpu_router_replica_healthy."""
+        with self._lock:
+            reps = list(self._replicas.values())
+        for rep in reps:
+            self._m_healthy.labels(replica=rep.rid).set(
+                1 if self._routable(rep) else 0)
+
+    # -- statz poller -------------------------------------------------------
+
+    def _fetch_statz(self, rep: Replica) -> Dict[str, Any]:
+        host, port = rep.host_port()
+        conn = http.client.HTTPConnection(
+            host, port, timeout=self.connect_timeout_s)
+        try:
+            conn.request("GET", "/statz")
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status != 200:
+                raise _UpstreamError(
+                    f"/statz answered {resp.status}", resp.status)
+            out = json.loads(body)
+            if not isinstance(out, dict):
+                raise _UpstreamError("/statz body is not an object")
+            return out
+        finally:
+            conn.close()
+
+    def _poll_once(self) -> None:
+        with self._lock:
+            dead = self._evict_stale_locked()
+            reps = list(self._replicas.values())
+        self._note_evictions(dead)
+        for rep in reps:
+            if self._stop.is_set():
+                return
+            assert rep.breaker is not None
+            if not rep.breaker.allow():
+                continue
+            if faults.ACTIVE is not None:
+                try:
+                    faults.ACTIVE.fire("router.statz")
+                except Exception as e:
+                    rep.breaker.record_failure()
+                    resilience.suppressed(
+                        "router.statz_poll", e, logger=log,
+                        metrics=self._rmetrics)
+                    continue
+            try:
+                snap = self._fetch_statz(rep)
+            except (OSError, ValueError, _UpstreamError) as e:
+                rep.breaker.record_failure()
+                resilience.suppressed("router.statz_poll", e,
+                                      logger=log,
+                                      metrics=self._rmetrics)
+                continue
+            rep.breaker.record_success()
+            with self._lock:
+                cur = self._replicas.get(rep.rid)
+                if cur is not None:
+                    cur.statz = snap
+                    cur.statz_at = cur.last_seen = _now()
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.statz_interval_s):
+            self._poll_once()
+
+    # -- proxy --------------------------------------------------------------
+
+    def _open_upstream(self, rep: Replica, path: str, body: bytes,
+                       headers: Dict[str, str]
+                       ) -> Tuple[http.client.HTTPConnection,
+                                  http.client.HTTPResponse]:
+        """One upstream attempt up to response HEADERS; raises
+        :class:`_UpstreamError` on anything retryable.  The breaker
+        records the outcome (a 5xx is a replica failure; 2xx-4xx
+        means the replica is alive and answering)."""
+        assert rep.breaker is not None
+        if not rep.breaker.allow():
+            raise _UpstreamError(f"{rep.rid}: breaker open")
+        host, port = rep.host_port()
+        if faults.ACTIVE is not None:
+            try:
+                faults.ACTIVE.fire("router.proxy")
+            except Exception as e:
+                rep.breaker.record_failure()
+                raise _UpstreamError(f"injected: {e}") from e
+        conn = http.client.HTTPConnection(
+            host, port, timeout=self.client_timeout_s)
+        try:
+            conn.request("POST", path, body, headers)
+            resp = conn.getresponse()
+        except OSError as e:
+            conn.close()
+            rep.breaker.record_failure()
+            raise _UpstreamError(f"{rep.rid}: {e}") from e
+        if resp.status >= 500:
+            # the replica answered but is broken (scheduler dead,
+            # shutdown drain): drain the body and fail this attempt so
+            # the request can land somewhere healthy
+            try:
+                detail = resp.read(4096).decode("utf-8", "replace")
+            except OSError:
+                detail = ""
+            conn.close()
+            rep.breaker.record_failure()
+            raise _UpstreamError(
+                f"{rep.rid}: upstream {resp.status}: "
+                f"{detail.strip()[:200]}", resp.status)
+        rep.breaker.record_success()
+        return conn, resp
+
+    @staticmethod
+    def _error_frame(content_type: str, message: str,
+                     code: int) -> bytes:
+        """A WELL-FORMED in-band terminal error for a broken stream,
+        in the stream's own framing: a JSON line for the native
+        JSON-lines wire, an SSE error event for the OpenAI wire.  A
+        client parsing the stream sees a structured error, never a
+        silent truncation that looks like success."""
+        payload = {"error": message, "code": code}
+        if content_type.startswith("text/event-stream"):
+            wire = {"error": {"message": message,
+                              "type": "server_error"}}
+            return ("data: " + json.dumps(wire) + "\n\n").encode()
+        return (json.dumps(payload) + "\n").encode()
+
+    def proxy(self, handler: "BaseHTTPRequestHandler", path: str,
+              body: bytes, trace: "obs.TraceContext") -> None:
+        """Route one request: pick -> forward -> stream back.  All the
+        failover semantics live here; see the module docstring."""
+        t_arrival = time.perf_counter()
+        try:
+            parsed = json.loads(body) if body else {}
+            key = affinity_key(parsed, self.prefix_chunk) \
+                if isinstance(parsed, dict) else None
+        except (ValueError, TypeError):
+            key = None
+        headers = {
+            "Content-Type": handler.headers.get(
+                "Content-Type", "application/json"),
+            "traceparent": trace.to_traceparent(),
+        }
+        tried: Set[str] = set()
+        conn: Optional[http.client.HTTPConnection] = None
+        resp: Optional[http.client.HTTPResponse] = None
+        rep: Optional[Replica] = None
+        hit = False
+        last_err: Optional[_UpstreamError] = None
+        for attempt in range(1, self.failover_attempts + 1):
+            rep, hit = self.pick(key, exclude=tried)
+            if rep is None:
+                break
+            if attempt > 1:
+                # a prior attempt failed and a DIFFERENT replica is
+                # taking the request: that handoff is the failover
+                self._m_failovers.inc()
+                self.recorder.record(
+                    "tpu_router_failover", trace=trace,
+                    replica=rep.rid, attempt=attempt)
+            tried.add(rep.rid)
+            t0 = time.perf_counter()
+            try:
+                conn, resp = self._open_upstream(
+                    rep, path, body, headers)
+            except _UpstreamError as e:
+                last_err = e
+                self._m_route.observe(time.perf_counter() - t0)
+                self.recorder.record(
+                    "tpu_router_attempt_failed", trace=trace,
+                    replica=rep.rid, attempt=attempt, error=str(e))
+                if attempt < self.failover_attempts:
+                    # seeded jitter between failover attempts: brief,
+                    # bounded, replayable
+                    time.sleep(self.retry.backoff_s(attempt))
+                continue
+            self._m_route.observe(time.perf_counter() - t0)
+            break
+        if resp is None or conn is None or rep is None:
+            reason = ("no healthy replicas"
+                      if not tried else
+                      f"all {len(tried)} replica(s) failed: "
+                      f"{last_err}")
+            self._m_shed.labels(reason="no_replicas").inc()
+            self._m_requests.labels(
+                replica="none",
+                outcome="unroutable" if not tried
+                else "upstream_error").inc()
+            self.recorder.record("tpu_router_unroutable", trace=trace,
+                                 tried=",".join(sorted(tried)),
+                                 error=str(last_err) if last_err
+                                 else "")
+            code = 503
+            body_out = (json.dumps(
+                {"error": reason, "code": code}) + "\n").encode()
+            handler.send_response(code)
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Content-Length", str(len(body_out)))
+            handler.send_header("Retry-After", "1")
+            handler.end_headers()
+            try:
+                handler.wfile.write(body_out)
+            except OSError:
+                pass
+            return
+        # -- stream the response back, byte-identical -------------------
+        outcome = "ok" if resp.status < 400 else (
+            "shed" if resp.status == 429 else "client_error")
+        if hit:
+            self._m_affinity.inc()
+        self.recorder.record(
+            "tpu_router_routed", trace=trace, replica=rep.rid,
+            status=resp.status, affinity=hit, attempts=len(tried),
+            duration_s=time.perf_counter() - t_arrival)
+        content_type = resp.headers.get("Content-Type",
+                                        "application/json")
+        chunked = (resp.headers.get("Transfer-Encoding", "")
+                   .lower() == "chunked")
+        try:
+            handler.send_response(resp.status)
+            for name, value in resp.headers.items():
+                if name.lower() in _HOP_HEADERS:
+                    continue
+                handler.send_header(name, value)
+            handler.send_header("X-Replica", rep.rid)
+            if chunked:
+                handler.send_header("Transfer-Encoding", "chunked")
+                handler.end_headers()
+                streamed = self._stream_through(
+                    handler, conn, resp, rep, content_type, trace)
+                if streamed != "ok":
+                    outcome = streamed
+                self._m_requests.labels(replica=rep.rid,
+                                        outcome=outcome).inc()
+                return
+            payload = resp.read()
+            handler.send_header("Content-Length", str(len(payload)))
+            handler.end_headers()
+            handler.wfile.write(payload)
+        except OSError as e:
+            # body read/send failed: mid-body upstream death on a
+            # Content-Length response cannot be patched in-band —
+            # the short read IS the client's signal
+            outcome = "stream_abort"
+            assert rep.breaker is not None
+            rep.breaker.record_failure()
+            self.recorder.record("tpu_router_stream_abort",
+                                 trace=trace, replica=rep.rid,
+                                 error=str(e))
+        finally:
+            conn.close()
+        self._m_requests.labels(replica=rep.rid,
+                                outcome=outcome).inc()
+
+    def _stream_through(self, handler: "BaseHTTPRequestHandler",
+                        conn: http.client.HTTPConnection,
+                        resp: http.client.HTTPResponse, rep: Replica,
+                        content_type: str,
+                        trace: "obs.TraceContext") -> str:
+        """The pass-through loop: de-chunk upstream, re-chunk the SAME
+        bytes to the client.  Upstream death mid-stream emits a
+        well-formed error frame + terminator and opens the breaker;
+        client death just abandons the upstream read.  Returns the
+        outcome label ("ok", "stream_abort", "client_gone")."""
+        outcome = "ok"
+        try:
+            while True:
+                try:
+                    # read1, NOT read: read(n) on a chunked response
+                    # blocks until n bytes accumulate — it would turn
+                    # the pass-through into a 64 KiB store-and-forward
+                    # buffer; read1 hands back each upstream chunk's
+                    # available bytes as they arrive
+                    chunk = resp.read1(_STREAM_READ)
+                except (OSError, http.client.HTTPException) as e:
+                    # replica died mid-stream: forward whatever valid
+                    # payload the failed read salvaged, then an
+                    # in-band structured error + clean chunked
+                    # terminator; the breaker opens
+                    outcome = "stream_abort"
+                    assert rep.breaker is not None
+                    rep.breaker.record_failure()
+                    self.recorder.record(
+                        "tpu_router_stream_abort", trace=trace,
+                        replica=rep.rid, error=str(e))
+                    partial = getattr(e, "partial", b"") or b""
+                    if partial:
+                        handler.wfile.write(
+                            b"%x\r\n%s\r\n" % (len(partial), partial))
+                    frame = self._error_frame(
+                        content_type,
+                        f"replica {rep.rid} died mid-stream; "
+                        "retry the request", 502)
+                    handler.wfile.write(
+                        b"%x\r\n%s\r\n" % (len(frame), frame))
+                    break
+                if not chunk:
+                    break
+                handler.wfile.write(
+                    b"%x\r\n%s\r\n" % (len(chunk), chunk))
+            handler.wfile.write(b"0\r\n\r\n")
+        except OSError:
+            # the CLIENT went away: nothing to send an error to
+            outcome = "client_gone"
+            self.recorder.record("tpu_router_client_gone",
+                                 trace=trace, replica=rep.rid)
+        return outcome
+
+    def healthy(self) -> bool:
+        """>= 1 routable replica."""
+        with self._lock:
+            reps = list(self._replicas.values())
+        return any(self._routable(r) for r in reps)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self, host: str = "0.0.0.0",
+              port: int = 8100) -> "RouterServer":
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            timeout = router.client_timeout_s
+
+            def do_GET(self) -> None:  # noqa: N802 (http.server API)
+                if self.path == "/healthz":
+                    if router.healthy():
+                        self._send(200, "text/plain", b"ok\n")
+                    else:
+                        self._send(503, "text/plain",
+                                   b"no healthy replicas\n")
+                elif self.path == "/replicas":
+                    body = json.dumps(
+                        {"replicas": router.replicas()},
+                        indent=2).encode() + b"\n"
+                    self._send(200, "application/json", body)
+                elif self.path == "/metrics":
+                    om = obs.negotiate_openmetrics(
+                        self.headers.get("Accept"))
+                    try:
+                        body = router.registry.render(
+                            openmetrics=om).encode()
+                    except Exception:
+                        log.exception("/metrics render failed")
+                        self._send(500, "text/plain",
+                                   b"internal error\n")
+                        return
+                    self._send(200, obs.OPENMETRICS_CONTENT_TYPE
+                               if om else obs.TEXT_CONTENT_TYPE, body)
+                elif self.path.startswith("/debug/events"):
+                    body = json.dumps({
+                        "dropped": router.recorder.dropped,
+                        "events": router.recorder.events(),
+                    }, indent=2).encode() + b"\n"
+                    self._send(200, "application/json", body)
+                else:
+                    self._send(404, "text/plain", b"not found\n")
+
+            def do_POST(self) -> None:  # noqa: N802
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b""
+                if self.path == "/register":
+                    try:
+                        out = router.register(
+                            json.loads(body) if body else {})
+                    except (ValueError, TypeError) as e:
+                        self._send(400, "application/json",
+                                   (json.dumps({"error": str(e)})
+                                    + "\n").encode())
+                        return
+                    self._send(200, "application/json",
+                               (json.dumps(out) + "\n").encode())
+                    return
+                if self.path not in PROXY_PATHS:
+                    self._send(404, "text/plain", b"not found\n")
+                    return
+                trace = obs.trace_from_header(
+                    self.headers.get("traceparent"))
+                try:
+                    router.proxy(self, self.path, body, trace)
+                except (BrokenPipeError, ConnectionResetError,
+                        TimeoutError):
+                    pass
+
+            def _send(self, code: int, ctype: str,
+                      body: bytes) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                try:
+                    self.wfile.write(body)
+                except OSError:
+                    pass
+
+            def log_message(self, format: str,  # noqa: A002
+                            *args: Any) -> None:
+                log.debug("router-http: " + format, *args)
+
+        self._httpd = _PooledRouterHTTPServer(
+            (host, port), Handler, workers=self.max_connections,
+            shed=self._shed_conns)
+        threading.Thread(target=self._httpd.serve_forever,
+                         name="router-http", daemon=True).start()
+        self._poller = threading.Thread(
+            target=self._poll_loop, name="router-statz", daemon=True)
+        self._poller.start()
+        log.info("router on http://%s:%d", host, self.port)
+        return self
+
+    @property
+    def port(self) -> int:
+        if self._httpd is None:
+            return 0
+        return int(self._httpd.server_address[1])
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._poller is not None:
+            self._poller.join(timeout=2)
+            self._poller = None
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """CLI: run the router tier.  Replicas register themselves
+    (``workloads.server --register-with http://this-router``); static
+    fleets can be pre-seeded with --replica."""
+    p = argparse.ArgumentParser(prog="tpu-serve-router")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8100)
+    p.add_argument("--replica", action="append", default=None,
+                   metavar="HOST:PORT",
+                   help="pre-seed a replica (repeatable); replicas "
+                        "normally self-register via POST /register")
+    p.add_argument("--prefix-chunk", type=int,
+                   default=DEFAULT_PREFIX_CHUNK,
+                   help="affinity-hash alignment in tokens; match the "
+                        "replicas' --prefix-chunk (default 32 = the "
+                        "engine's auto grid)")
+    p.add_argument("--replica-ttl", type=float, default=10.0,
+                   help="seconds without a heartbeat or /statz answer "
+                        "before a replica is evicted")
+    p.add_argument("--statz-interval", type=float, default=0.5,
+                   help="seconds between /statz load-signal polls")
+    p.add_argument("--max-connections", type=int, default=64,
+                   help="router HTTP worker pool size (429 past 2x)")
+    p.add_argument("--failover-attempts", type=int, default=3,
+                   help="replicas tried per request before 503")
+    p.add_argument("--overload-factor", type=float, default=4.0,
+                   help="skip the affinity target when its queue+"
+                        "in-flight exceeds this many times its "
+                        "capacity (falls back to least-loaded)")
+    p.add_argument("--breaker-reset", type=float, default=2.0,
+                   help="per-replica circuit-breaker reset timeout")
+    p.add_argument("--seed", type=int, default=None,
+                   help="failover backoff jitter seed (chaos replay)")
+    p.add_argument("--fault-spec", default=None, metavar="SPEC",
+                   help="arm deterministic fault injection (chaos "
+                        "testing ONLY), e.g. 'router.proxy:error:0.1'")
+    p.add_argument("--flight-record-dir", default=None, metavar="DIR",
+                   help="dump the flight-recorder journal on "
+                        "exit/SIGTERM")
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s")
+    rt = RouterServer(
+        prefix_chunk=args.prefix_chunk,
+        replica_ttl_s=args.replica_ttl,
+        statz_interval_s=args.statz_interval,
+        max_connections=args.max_connections,
+        failover_attempts=args.failover_attempts,
+        overload_factor=args.overload_factor,
+        breaker_reset_s=args.breaker_reset,
+        seed=args.seed,
+        flight_record_dir=args.flight_record_dir)
+    if args.fault_spec:
+        faults.install(args.fault_spec, seed=args.seed or 0,
+                       recorder=rt.recorder)
+    for addr in args.replica or ():
+        rt.register({"address": addr})
+    rt.start(host=args.host, port=args.port)
+    print(f"router on http://{args.host}:{rt.port}  "
+          f"[POST /generate, /v1/completions, /register; "
+          f"GET /healthz, /replicas, /metrics]", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        rt.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -66,6 +66,10 @@ API (JSON over HTTP/1.1):
                    message/delta objects in the chat wire shape.
   GET  /healthz    liveness ("ok").
   GET  /stats      engine + server counters (JSON).
+  GET  /statz      one CHEAP load snapshot for the router tier
+                   (queue depth, in-flight, free/total KV pages, shed
+                   counts, scheduler health) — fixed small schema, no
+                   Prometheus text on the routing hot path.
   GET  /metrics    the same counters in Prometheus exposition format
                    (Accept: application/openmetrics-text adds trace-id
                    exemplars on the latency histograms).
@@ -1636,6 +1640,13 @@ class EngineServer:
                 elif url.path == "/stats":
                     body = json.dumps(server.stats(), indent=2)
                     self._send(200, "application/json", body + "\n")
+                elif url.path == "/statz":
+                    # the router's load-signal poll: small, flat, and
+                    # in lock-step with the /metrics families (see
+                    # statz()); kept off /stats so the router never
+                    # pays for the full engine dump
+                    self._send(200, "application/json",
+                               json.dumps(server.statz()) + "\n")
                 elif url.path == "/metrics":
                     # Prometheus exposition (vLLM's server exposes
                     # /metrics; scrape configs expect it from a
@@ -2578,6 +2589,129 @@ class EngineServer:
             st.update(self._httpd.pool_stats())
         return st
 
+    def statz(self) -> dict:
+        """The router tier's load signal: one SMALL fixed-schema JSON
+        snapshot (queue depth, in-flight copies, KV pool occupancy,
+        shed counts, scheduler health) assembled from the same host
+        ints /metrics bridges — so the router never parses Prometheus
+        text on the routing hot path, and the lock-step test can pin
+        this surface against the tpu_serving_* families."""
+        st = self.stats()
+        return {
+            "scheduler_alive": self.healthy(),
+            "queue_depth": st["pending_requests"],
+            "in_flight": (st["running_copies"]
+                          + st["admitting_copies"]),
+            "capacity": st["n_slots"],
+            "kv_pages": st.get("kv_pages", 0),
+            "kv_pages_free": st.get("kv_pages_free", 0),
+            "requests_served": st["requests_served"],
+            "shed": {
+                "connections": int(self._shed_conns.value),
+                "queue": int(self._shed_queue.value),
+                "quota": int(self._shed_quota.value),
+            },
+        }
+
+    # -- router registration (multi-replica serving) ------------------------
+
+    def start_registration(self, router: str,
+                           advertise: Optional[str] = None,
+                           replica_id: Optional[str] = None,
+                           model: str = "",
+                           interval_s: float = 2.0) -> None:
+        """Self-register with a router tier and keep heartbeating
+        (slice-coordinator-style membership for the serving data
+        plane).  *router* is ``http://host:port`` (or bare
+        ``host:port``); *advertise* is the address the ROUTER should
+        dial back (default ``127.0.0.1:<bound port>`` — wrong across
+        hosts, so deployments set it to the pod IP).  Heartbeats carry
+        an inline statz snapshot so the router's load signal freshens
+        without waiting for its next poll.  A down router never hurts
+        serving: failures are counted + logged and the loop just tries
+        again next interval (retried within a beat by the shared
+        RetryPolicy).  Call after :meth:`start`."""
+        target = router
+        if target.startswith("http://"):
+            target = target[len("http://"):]
+        target = target.rstrip("/")
+        host, _, port_s = target.rpartition(":")
+        if not host or not port_s.isdigit():
+            raise ValueError(
+                f"--register-with {router!r} must be http://host:port")
+        addr = advertise or f"127.0.0.1:{self.port}"
+        rid = replica_id or addr
+        self._replica_id = rid
+        from tpu_k8s_device_plugin import resilience
+
+        policy = resilience.RetryPolicy(
+            max_attempts=2, initial_backoff_s=0.1, max_backoff_s=0.5)
+        rmetrics = resilience.ResilienceMetrics(self.registry)
+
+        def beat_once() -> float:
+            """One registration POST; returns the router's interval
+            hint (seconds)."""
+            import http.client
+
+            conn = http.client.HTTPConnection(host, int(port_s),
+                                              timeout=5.0)
+            try:
+                conn.request(
+                    "POST", "/register",
+                    json.dumps({
+                        "replica_id": rid,
+                        "address": addr,
+                        "model": model,
+                        "capacity": self.engine.n_slots,
+                        "statz": self.statz(),
+                    }),
+                    {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                body = resp.read()
+                if resp.status != 200:
+                    raise OSError(
+                        f"router answered {resp.status}: "
+                        f"{body[:120]!r}")
+                out = json.loads(body)
+                return float(out.get("interval_s", interval_s))
+            finally:
+                conn.close()
+
+        def loop() -> None:
+            wait = interval_s
+            while not self._stop.wait(wait):
+                try:
+                    hint = policy.call(
+                        beat_once, op="serve.register",
+                        retry_on=(OSError, ValueError),
+                        stop=self._stop, metrics=rmetrics,
+                        recorder=self.recorder)
+                    wait = max(0.2, min(interval_s, hint))
+                except resilience.CircuitOpenError:
+                    return  # stop() aborted the retry sleep
+                except (OSError, ValueError) as e:
+                    # the router being down is ITS outage, not ours:
+                    # serving keeps serving, the loop keeps knocking
+                    resilience.suppressed(
+                        "serve.register", e, logger=log,
+                        metrics=rmetrics)
+            log.debug("registration loop stopped")
+
+        try:
+            policy.call(beat_once, op="serve.register",
+                        retry_on=(OSError, ValueError),
+                        stop=self._stop, metrics=rmetrics,
+                        recorder=self.recorder)
+            log.info("registered with router %s as %s (%s)",
+                     router, rid, addr)
+        except (OSError, ValueError, resilience.CircuitOpenError) as e:
+            log.warning("initial router registration failed (%s); "
+                        "will keep retrying every %.1fs", e,
+                        interval_s)
+        self._register_thread = threading.Thread(
+            target=loop, name="serve-register", daemon=True)
+        self._register_thread.start()
+
     def render_metrics(self, openmetrics: bool = False) -> str:
         """The serving /metrics body: the obs registry (request spans,
         TTFT / per-token / queue-wait / admit / stream-write
@@ -2754,6 +2888,25 @@ def main(argv=None) -> int:
                    help="transformers tokenizer enabling the text "
                         "surface: 'prompt' strings, stop STRINGS, "
                         "'text' in responses (ids-only without it)")
+    p.add_argument("--register-with", default=None, metavar="URL",
+                   help="router tier to self-register with "
+                        "(http://host:port, workloads.router): this "
+                        "replica heartbeats its address/model/"
+                        "capacity + statz snapshot so the router can "
+                        "load-balance and failover across the fleet")
+    p.add_argument("--advertise", default=None, metavar="HOST:PORT",
+                   help="address the ROUTER should dial back for this "
+                        "replica (default 127.0.0.1:<port> — set to "
+                        "the pod IP when router and replica are on "
+                        "different hosts)")
+    p.add_argument("--replica-id", default=None,
+                   help="stable replica identity for routing/metrics "
+                        "(default: the advertised address; keep it "
+                        "stable across restarts so the router's "
+                        "consistent-hash ring does not reshuffle)")
+    p.add_argument("--register-interval", type=float, default=2.0,
+                   help="seconds between router heartbeats (the "
+                        "router's interval hint lowers it)")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8000)
     args = p.parse_args(argv)
@@ -2787,6 +2940,10 @@ def main(argv=None) -> int:
         p.error("--kv-page-size/--kv-pages must be >= 0")
     if args.prefix_registry_max < 1:
         p.error("--prefix-registry-max must be >= 1")
+    if (args.advertise or args.replica_id) and not args.register_with:
+        p.error("--advertise/--replica-id need --register-with")
+    if args.register_interval <= 0:
+        p.error("--register-interval must be > 0")
     try:
         tenant_quotas = parse_tenant_quotas(args.tenant_quota)
     except ValueError as e:
@@ -2884,6 +3041,11 @@ def main(argv=None) -> int:
     # traffic (each length is its own XLA compile; see warm_scheduler)
     srv.warm_scheduler()
     srv.start(host=args.host, port=args.port)
+    if args.register_with:
+        srv.start_registration(
+            args.register_with, advertise=args.advertise,
+            replica_id=args.replica_id, model=args.config,
+            interval_s=args.register_interval)
     print(f"serving {args.config} (quantized={quantized}) on "
           f"http://{args.host}:{srv.port}  "
           f"[POST /generate, POST /v1/completions, GET /healthz, "
