@@ -2,13 +2,16 @@
 
 The scheduler's correctness bar is the house invariant: with a fixed
 request trace, token streams are BYTE-IDENTICAL with interleaving on
-vs. off — greedy, seeded sampled, grammar-constrained, APC hit and
-miss alike.  (Unseeded sampling depends on the global key stream by
-design; per-request seeds exist precisely to opt out — same posture as
-the engine fuzz.)  Plus the split-admission API itself: begin/step/
-finish must be the one-shot admit, and the exact-repeat fast paths
-(zero-extend full-prompt APC, prefix-affinity inplace placement,
-cached greedy first token) must change nothing but the work done.
+vs. off — AND with ragged packed prefill and dispatch-ahead overlap
+toggled in every combination — greedy, seeded sampled,
+grammar-constrained, APC hit and miss, paged KV alike.  (Unseeded
+sampling depends on the global key stream by design; per-request
+seeds exist precisely to opt out — same posture as the engine fuzz.)
+Plus the split-admission API itself: begin/step/finish must be the
+one-shot admit, packed admit_step_packed must be the serial chunks,
+and the exact-repeat fast paths (zero-extend full-prompt APC,
+prefix-affinity inplace placement, cached greedy first token) must
+change nothing but the work done.
 """
 
 from collections import deque
@@ -54,14 +57,16 @@ def _solo(model, params, prompt, n_steps):
 
 
 def _drive(model, params, dfa, trace, interleave, max_new=6,
-           n_slots=2, window=4, grammar=False):
+           n_slots=2, window=4, grammar=False, packed=False,
+           overlap=False, kv_paging=False):
     """Run *trace* — a list of ``(arrival_iteration, key, kwargs)`` —
     through an IterationScheduler and return {key: tokens}.  Fully
     deterministic: arrivals keyed to iteration indices, dwell off."""
     eng = ServingEngine(model, params, n_slots=n_slots, chunk=4,
                         eos_id=EOS if grammar else None,
                         max_new_tokens=max_new, auto_prefix_min=4,
-                        grammar=dfa if grammar else None)
+                        grammar=dfa if grammar else None,
+                        kv_paging=kv_paging)
     intake: deque = deque()
     tickets = {}
     live = {}
@@ -77,6 +82,7 @@ def _drive(model, params, dfa, trace, interleave, max_new=6,
 
     sched = IterationScheduler(eng, window=window, interleave=interleave,
                                prefill_budget=2, pull=pull,
+                               packed_prefill=packed, overlap=overlap,
                                sync_dwell_s=0.0)
     arrivals = sorted(trace, key=lambda a: a[0])
     ai = 0
@@ -102,6 +108,24 @@ def _assert_equivalent(model, params, dfa, trace, **kw):
     off = _drive(model, params, dfa, trace, interleave=False, **kw)
     assert on == off
     return on
+
+
+def _assert_packed_overlap_equivalent(model, params, dfa, trace,
+                                      **kw):
+    """The FULL toggle matrix: every (packed, overlap) combination —
+    with interleave on and off — must produce the serial baseline's
+    exact streams."""
+    base = _assert_equivalent(model, params, dfa, trace, **kw)
+    for packed in (False, True):
+        for overlap in (False, True):
+            for interleave in (True, False):
+                got = _drive(model, params, dfa, trace,
+                             interleave=interleave, packed=packed,
+                             overlap=overlap, **kw)
+                assert got == base, (
+                    f"streams diverged at packed={packed} "
+                    f"overlap={overlap} interleave={interleave}")
+    return base
 
 
 def test_equivalence_greedy_apc_hit_and_miss(setup):
@@ -315,6 +339,234 @@ def test_supersede_aborts_pending_tickets(setup):
     assert t.state.result is None
 
 
+def test_packed_overlap_equivalence_greedy_apc(setup):
+    # the full toggle matrix over the APC-heavy greedy trace: distinct
+    # prompts, an exact repeat (zero-extend full hit), a shared-chunk
+    # partial hit — slots recycling, admissions packing where they
+    # coincide.  Streams must be byte-identical in EVERY combination.
+    model, params, dfa = setup
+    pa = [3, 14, 15, 92, 65, 35, 89, 79]    # 2 chunks of 4
+    pb = [2, 71, 82, 81, 82]                # miss vs pa
+    pc = [44, 9, 1, 7, 60, 61]              # third concurrent stream
+    trace = [
+        (0, "a0", dict(prompt=pa)),
+        (0, "b0", dict(prompt=pb)),
+        (0, "c0", dict(prompt=pc)),
+        (1, "a1", dict(prompt=pa)),          # exact repeat -> full hit
+        (2, "ash", dict(prompt=pa[:4] + [9, 9])),   # shared chunk
+        (4, "b1", dict(prompt=pb)),
+        (5, "a2", dict(prompt=pa)),
+    ]
+    on = _assert_packed_overlap_equivalent(model, params, dfa, trace,
+                                           n_slots=3)
+    for key, prompt in (("a0", pa), ("b0", pb), ("c0", pc)):
+        assert on[key] == _solo(model, params, prompt, 6)
+
+
+def test_packed_overlap_equivalence_seeded(setup):
+    # seeded sampling is scheduling-invariant by design; packing must
+    # not bend the admission draw order (FIFO splices) and overlap
+    # must FALL BACK to the serial cadence while sampled knobs are
+    # live — either way the bytes cannot move
+    model, params, dfa = setup
+    pa = [3, 14, 15, 92, 65]
+    pb = [2, 71, 82]
+    pc = [44, 9, 1, 7]
+    trace = [
+        (0, "s1", dict(prompt=pa, temperature=1.0, seed=7)),
+        (0, "g0", dict(prompt=pb)),
+        (0, "s2", dict(prompt=pc, temperature=0.7, top_k=8, seed=41)),
+        (3, "s3", dict(prompt=pa, temperature=1.0, seed=7)),
+    ]
+    on = _assert_packed_overlap_equivalent(model, params, dfa, trace,
+                                           n_slots=3)
+    assert on["s1"] == on["s3"]
+
+
+def test_packed_overlap_equivalence_grammar(setup):
+    model, params, dfa = setup
+    trace = [
+        (0, "g1", dict(prompt=[65, 66], grammar=True)),
+        (0, "u1", dict(prompt=[2, 71, 82])),
+        (0, "g2", dict(prompt=[67, 68], grammar=True)),
+        (2, "g3", dict(prompt=[65, 66, 67, 68], grammar=True)),
+    ]
+    _assert_packed_overlap_equivalent(model, params, dfa, trace,
+                                      grammar=True, max_new=8,
+                                      n_slots=3)
+
+
+def test_packed_overlap_equivalence_kv_paging(setup):
+    # the paged pool under packing + overlap: packed prefill runs on
+    # B=1 minis and lands through _paged_land exactly as serial
+    # admission does, so paged streams must equal the contiguous
+    # serial baseline bit-for-bit
+    model, params, dfa = setup
+    pa = [3, 14, 15, 92, 65, 35, 89, 79]
+    pb = [2, 71, 82, 81, 82]
+    trace = [
+        (0, "a0", dict(prompt=pa)),
+        (0, "b0", dict(prompt=pb)),
+        (1, "a1", dict(prompt=pa)),          # paged zero-page repeat
+        (3, "ash", dict(prompt=pa[:4] + [9, 9])),   # CoW shared chunk
+    ]
+    base = _assert_equivalent(model, params, dfa, trace)
+    for packed in (False, True):
+        for overlap in (False, True):
+            got = _drive(model, params, dfa, trace, interleave=True,
+                         packed=packed, overlap=overlap,
+                         kv_paging=True)
+            assert got == base, (
+                f"paged streams diverged at packed={packed} "
+                f"overlap={overlap}")
+
+
+def test_admit_step_packed_equals_serial_chunks(setup):
+    # engine-level: K admissions advanced through batched extends must
+    # land byte-identical to chunk-serial admission, and the packed
+    # counters must account the work
+    model, params, dfa = setup
+    prompts = ([3, 14, 15, 92, 65, 35, 89, 79, 11],   # 3 chunks
+               [2, 71, 82, 81, 82],                   # 2 chunks
+               [44, 9, 1, 7, 60, 61, 2])              # 2 chunks
+    eng = ServingEngine(model, params, n_slots=3, chunk=4,
+                        max_new_tokens=6, auto_prefix=False)
+    sts = [eng.begin_admit(p) for p in prompts]
+    while any(st.gen is not None for st in sts):
+        group = [st for st in sts if st.gen is not None]
+        if len(group) >= 2:
+            eng.admit_step_packed(group)
+        else:
+            eng.admit_step(group[0])
+    slots = [eng.finish_admit(st) for st in sts]
+    eng.run(6)
+    for s, p in zip(slots, prompts):
+        assert eng.output(s) == _solo(model, params, p, 6)
+    st = eng.stats()
+    assert st["packed_prefill_extends"] >= 2
+    assert st["packed_prefill_requests"] == 3
+    assert st["packed_prefill_rows"] >= 2 * st["packed_prefill_extends"]
+    # tail-chunk grid padding DISPATCHED THROUGH PACKS: round 2 packs
+    # pb's tail (+3) and pc's tail (+1); pa's tail chunk runs serial
+    # (last job standing) so its padding is not packed waste
+    assert st["packed_prefill_pad_tokens"] == 4
+
+
+def test_abort_during_packed_prefill(setup):
+    # one admission of a packed pair is cancelled mid-pack: its slot
+    # frees, the survivor's stream is untouched, and the engine stays
+    # reusable (the chaos episode drives the same path over HTTP)
+    model, params, dfa = setup
+    pa = [3, 14, 15, 92, 65, 35, 89, 79, 11]   # 3 chunks
+    pb = [2, 71, 82, 81, 82, 44, 9]            # 2 chunks
+    eng = ServingEngine(model, params, n_slots=2, chunk=4,
+                        max_new_tokens=6, auto_prefix=False)
+    sa = eng.begin_admit(pa)
+    sb = eng.begin_admit(pb)
+    eng.admit_step_packed([sa, sb])            # one packed round
+    eng.abort_admit(sb)                        # client went away
+    assert eng.free_slots() == [sb.slot]
+    while eng.admit_step(sa):
+        pass
+    slot_a = eng.finish_admit(sa)
+    eng.run(6)
+    assert eng.output(slot_a) == _solo(model, params, pa, 6)
+    # the freed slot admits fresh work
+    slot_b = eng.admit(pb)
+    eng.run(6)
+    assert eng.output(slot_b) == _solo(model, params, pb, 6)
+
+
+def test_scheduler_cancel_during_packed_prefill(setup):
+    # the scheduler surface of the same story: two tickets packing,
+    # one cancelled between iterations — the other drains oracle-exact
+    model, params, dfa = setup
+    pa = [3, 14, 15, 92, 65, 35, 89, 79, 11]
+    pb = [2, 71, 82, 81, 82, 44, 9]
+    eng = ServingEngine(model, params, n_slots=2, chunk=4,
+                        max_new_tokens=6, auto_prefix=False)
+    sched = IterationScheduler(eng, window=4, packed_prefill=True,
+                               overlap=True, sync_dwell_s=0.0)
+    ta = sched.begin(prompt=pa)
+    tb = sched.begin(prompt=pb)
+    sched.cancel(tb)
+    assert tb.state.result is None
+    done = None
+    for _ in range(40):
+        res = sched.iterate()
+        for t in res.admitted:
+            assert t is ta
+        if eng.finished(ta.slot):
+            done = eng.output(ta.slot)
+            break
+    assert done == _solo(model, params, pa, 6)
+
+
+def test_overlap_dispatches_ahead_and_falls_back_when_sampled(setup):
+    # greedy steady state: after a harvested window the next one is
+    # already on the device (the double-buffer).  The moment a sampled
+    # request is live, dispatch-ahead must stand down (draw-chain
+    # safety) — and resume once it retires.
+    model, params, dfa = setup
+    eng = ServingEngine(model, params, n_slots=2, chunk=4,
+                        max_new_tokens=16, auto_prefix_min=4)
+    sched = IterationScheduler(eng, window=4, packed_prefill=True,
+                               overlap=True, sync_dwell_s=0.0)
+    sched.begin(prompt=[3, 14, 15, 92, 65])
+    sched.iterate()
+    assert sched._ahead is not None, "greedy window not dispatched ahead"
+    assert eng.scan_inflight
+    sched.iterate()                      # harvests + re-dispatches
+    assert sched._ahead is not None
+    # drain to idle: no window may be left hanging
+    for _ in range(30):
+        sched.iterate()
+        if not any(eng.active) and not sched.busy():
+            break
+    assert sched._ahead is None and not eng.scan_inflight
+    # sampled request -> serial cadence
+    sched.begin(prompt=[2, 71, 82], temperature=1.0, seed=3)
+    sched.iterate()
+    assert sched._ahead is None, "sampled window was dispatched ahead"
+
+
+def test_supersede_abandons_ahead_window(setup):
+    model, params, dfa = setup
+    eng = ServingEngine(model, params, n_slots=1, chunk=4,
+                        max_new_tokens=16)
+    sched = IterationScheduler(eng, window=4, overlap=True,
+                               sync_dwell_s=0.0)
+    sched.begin(prompt=[3, 14, 15, 92, 65])
+    sched.iterate()
+    assert sched._ahead is not None and eng.scan_inflight
+    sched.supersede()                    # crash-supervisor path
+    assert sched._ahead is None and not eng.scan_inflight
+    eng.release(0)
+    # the engine is reusable after the abandon
+    s = eng.admit([2, 71, 82])
+    eng.run_scan(4)
+    assert len(eng.output(s)) >= 4
+
+
+def test_packing_conflict_defers_shared_prefix(setup):
+    # the owner-side APC guard: while a prompt's leading chunk is
+    # mid-prefill, a sibling/repeat prompt reports a conflict (the
+    # server defers the pull so the repeat still hits the warm donor)
+    model, params, dfa = setup
+    pa = [3, 14, 15, 92, 65, 35, 89, 79]
+    eng = ServingEngine(model, params, n_slots=2, chunk=4,
+                        max_new_tokens=4, auto_prefix_min=4)
+    sched = IterationScheduler(eng, window=4, packed_prefill=True,
+                               sync_dwell_s=0.0)
+    t = sched.begin(prompt=pa)
+    assert sched.packing_conflict(pa)                 # exact repeat
+    assert sched.packing_conflict(pa[:4] + [9, 9])    # shared chunk
+    assert not sched.packing_conflict([2, 71, 82, 81])  # distinct
+    assert not sched.packing_conflict([3, 14])        # below the grid
+    sched.cancel(t)
+    assert not sched.packing_conflict(pa)             # nothing pending
+
+
 def test_scheduler_metrics_families_render(setup):
     # the new obs families land on the caller's registry and render
     # promlint-clean alongside everything else (the metrics-lint job
@@ -345,4 +597,6 @@ def test_scheduler_metrics_families_render(setup):
     assert "tpu_serve_prefill_chunk_seconds" in body
     assert "tpu_serve_admit_to_first_step_seconds" in body
     assert 'tpu_serve_scheduler_queue_depth{kind="decode"}' in body
+    assert "tpu_serve_overlap_idle_seconds" in body
+    assert "tpu_serve_overlap_windows_total" in body
     assert promlint.lint(body) == []

@@ -65,6 +65,7 @@ from __future__ import annotations
 import argparse
 import logging
 import os
+import random
 import shutil
 import sys
 import tempfile
@@ -852,6 +853,144 @@ def episode_router_replica_kill(seed):
             pass
 
 
+def episode_packed_prefill_kill(seed):
+    """Episode 11: the scheduler is killed (injected hang → schedule
+    watchdog → crash supervisor) while RAGGED PACKED PREFILL is in
+    flight — several concurrent multi-chunk admissions batching
+    through admit_step_packed behind an open decode window.  The
+    invariant: every packed request either COMPLETES (a {"done"}
+    terminal event) or gets a WELL-FORMED error frame (the
+    supervisor's 503 drain) — never a hang, never a truncated stream
+    — and after the supervised restart fresh traffic answers 200
+    through the re-warmed packed path."""
+    import http.client
+    import json
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_k8s_device_plugin.workloads.inference import make_decoder
+    from tpu_k8s_device_plugin.workloads.server import EngineServer
+    from tpu_k8s_device_plugin.workloads.serving import ServingEngine
+
+    model = make_decoder(vocab=128, d_model=64, n_heads=4, n_layers=2,
+                         d_ff=128, max_len=64, dtype=jnp.float32)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+    params = model.init(jax.random.PRNGKey(0), tokens, pos)["params"]
+    # chunk 4 so a 24-token prompt is 6 chunks; prefill_chunks=1
+    # spreads each admission's prefill over many windows — the packed
+    # sessions are still mid-flight when the hang lands
+    eng = ServingEngine(model, params, n_slots=4, chunk=4,
+                        auto_prefix=False)
+    srv = EngineServer(eng, max_new_tokens=24, window=4,
+                       prefill_chunks=1, schedule_watchdog_s=0.5)
+    # pre-compile scan windows + packed shapes like the CLI does: the
+    # 0.5s watchdog is sized for steady state, not first-compile
+    srv.warm_scheduler()
+    srv.start(host="127.0.0.1", port=0)
+
+    def post(payload, out=None, timeout=120):
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=timeout)
+        try:
+            conn.request("POST", "/generate", json.dumps(payload),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            events = []
+            for line in resp:
+                s = line.strip()
+                if s:
+                    events.append(json.loads(s))
+            result = (resp.status, events)
+        except (OSError, ValueError) as e:
+            result = (-1, [{"error": f"transport: {e}", "code": -1}])
+        finally:
+            conn.close()
+        if out is not None:
+            out.append(result)
+        return result
+
+    try:
+        status, _ = post({"tokens": [3, 14, 15], "max_new_tokens": 4,
+                          "stream": False})
+        check(status == 200, "serving baseline request answered 200")
+        # a long-running decode keeps the engine active, so the wave
+        # below rides the mid-window admission path; its 40-token
+        # (10-chunk) prompts at prefill_chunks=1 keep the packed
+        # sessions pending across MANY windows — the hang lands while
+        # they are still mid-flight
+        results: list = []
+        anchor = threading.Thread(target=post, args=(
+            {"tokens": [2, 71, 82], "max_new_tokens": 40}, results))
+        anchor.start()
+        time.sleep(0.02)
+        rng = random.Random(seed)
+        packed = []
+        for i in range(3):
+            prompt = [rng.randrange(1, 128) for _ in range(40)]
+            th = threading.Thread(target=post, args=(
+                {"tokens": prompt, "max_new_tokens": 4}, results))
+            th.start()
+            packed.append(th)
+        time.sleep(0.03)  # tickets pulled, packed rounds under way
+        faults.install("serve.schedule:hang:5", seed=seed,
+                       recorder=srv.recorder)
+        try:
+            anchor.join(timeout=60)
+            for th in packed:
+                th.join(timeout=60)
+            check(len(results) == 4,
+                  "every request terminated (no hung streams)")
+        finally:
+            faults.uninstall()
+        done = err = 0
+        for status, events in results:
+            terminal = events[-1] if events else {}
+            if status == 200 and terminal.get("done") is True:
+                done += 1
+            elif "error" in terminal and terminal.get("code") == 503:
+                err += 1    # the supervisor's well-formed drain frame
+            else:
+                check(False,
+                      f"request ended without a done/503 terminal "
+                      f"event: status={status} last={terminal}")
+        check(done + err == 4,
+              f"all packed-era requests completed or got well-formed "
+              f"503s (done={done} err={err})")
+        check(err >= 1, "the hang actually aborted in-flight work")
+        check(eng.stats()["packed_prefill_extends"] >= 1,
+              "packed prefill dispatches ran before the kill")
+        trips = [e for e in srv.recorder.events(name="tpu_watchdog_trip")
+                 if e["attrs"].get("op") == "serve.schedule"]
+        check(trips, "schedule-watchdog trip journaled")
+        deadline = time.time() + 10.0
+        while (time.time() < deadline
+               and srv._m_sched_restarts.value < 1):
+            time.sleep(0.05)
+        check(srv._m_sched_restarts.value >= 1,
+              "supervisor restarted the scheduler after the trip")
+        # reconvergence: the hang may have tripped the watchdog more
+        # than once before the uninstall landed (each trip drains
+        # 503s), so give the restarted loop a bounded window to serve
+        # clean again — the invariant is recovery, not trip count
+        status, events = -1, []
+        deadline = time.time() + 15.0
+        while time.time() < deadline:
+            status, events = post({"tokens": [9, 9, 8, 7, 1, 2, 3, 4],
+                                   "max_new_tokens": 4,
+                                   "stream": False})
+            if status == 200 and events and events[0].get("done"):
+                break
+            time.sleep(0.25)
+        check(status == 200 and events and events[0].get("done"),
+              f"traffic reconverged after the packed-prefill kill "
+              f"(got {status})")
+    finally:
+        srv.stop()
+
+
 def _reshape_slice(tmp, testdata, seed, suffix, grace, hb_timeout):
     """A dedicated 2-host slice with live staleness + reshape grace (the
     main soak coordinator drives heartbeats manually with no timeout, so
@@ -1101,6 +1240,9 @@ def main(argv=None) -> int:
             log.info("=== episode 10: replica kill under burst "
                      "through the router ===")
             episode_router_replica_kill(args.seed)
+            log.info("=== episode 11: scheduler killed mid-packed-"
+                     "prefill ===")
+            episode_packed_prefill_kill(args.seed)
         # -- final convergence sweep ----------------------------------
         for h in hosts:
             h.pulse()

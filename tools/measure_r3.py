@@ -108,6 +108,18 @@ PHASES = [
     # socket hop), and what the hop costs TTFT at real decode rates.
     # Compare tokens_per_sec_router_{1,n} + affinity_hit_rate.
     ("serving_router_2rep_b8", 2400),
+    # round-11 additions: (1) ragged packed prefill + dispatch-ahead
+    # overlap on real MXUs — CPU shows ~1.2x on the prefill-heavy
+    # shape, but the packed extend's whole thesis is hardware (K
+    # chunk-extends share one kernel's MXU pass instead of K dispatch
+    # round-trips over the tunnel), so the on-chip A/B vs
+    # serving_sched_interleave_b8 is the number that matters; (2)
+    # replica cold-start with a persistent compile cache — warm-boot
+    # first-completion vs cold is the constant that decides whether
+    # router-driven scale-up is real capacity or a warmup storm
+    # (CPU proxy: 14s cold -> 4s warm on tiny).
+    ("serving_ragged_prefill_b8", 1800),
+    ("replica_cold_start", 2400),
 ]
 
 
@@ -381,6 +393,39 @@ def phase_serving_router_2rep_b8():
                       n_requests=32, slots=8, steps=64,
                       prompt_len=128, max_len=512, kill=False,
                       seed=1)
+
+
+def phase_serving_ragged_prefill_b8():
+    """Ragged packed prefill + dispatch-ahead overlap on the 8B int8
+    target under the PREFILL-HEAVY shape (long distinct prompts,
+    short outputs): ON vs OFF in one phase (run_prefill_heavy runs
+    both arms).  Compare prefill_tokens_per_sec_{on,off} and
+    req_per_sec_speedup_x against the CPU proxy (~1.2x), and the ON
+    arm's http_over_engine_ratio against serving_sched_interleave_b8
+    — on hardware the packed extend shares one kernel's MXU pass
+    where CPU only saves host dispatches."""
+    from tpu_k8s_device_plugin.workloads.bench_serving import (
+        run_prefill_heavy,
+    )
+
+    return run_prefill_heavy("llama3-8b", True, clients=8,
+                             n_requests=32, slots=8, steps=8,
+                             prompt_len=384, max_len=512)
+
+
+def phase_replica_cold_start():
+    """Replica cold-start economics on real chips: the server CLI
+    booted twice against one --compile-cache-dir (cold fill, warm
+    load), spawn -> first-completion timed each way.  On TPU the
+    compile set is minutes, not seconds — warm_speedup_x here is the
+    constant that decides whether the router tier's scale-up story
+    (ROADMAP fleet-controller item) delivers capacity in seconds."""
+    from tpu_k8s_device_plugin.workloads.bench_serving import (
+        run_cold_start,
+    )
+
+    return run_cold_start("llama3-8b", True, slots=8, steps=16,
+                          prompt_len=64, max_len=512)
 
 
 def phase_grammar_overhead_b8():

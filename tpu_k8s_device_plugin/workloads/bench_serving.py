@@ -141,7 +141,8 @@ def run(config: str, quantized, batch: int, steps: int,
         spec: int = 0, http_clients: int = 0, http_requests: int = 0,
         cancel_every: int = 0, burst: int = 0,
         interleave: bool = True, kv_paging: bool = False,
-        tenants: int = 0):
+        tenants: int = 0, packed_prefill: bool = True,
+        overlap_dispatch: bool = True):
     # fail fast for library callers too, not just the CLI: engine mode
     # consumes (warmup + rounds) run_scan windows of cache headroom,
     # and a mid-benchmark ValueError from run_scan is a worse place to
@@ -181,7 +182,8 @@ def run(config: str, quantized, batch: int, steps: int,
             http_requests or 4 * http_clients, slots=batch,
             cancel_every=cancel_every, burst=burst,
             interleave=interleave, kv_paging=kv_paging,
-            tenants=tenants)
+            tenants=tenants, packed_prefill=packed_prefill,
+            overlap_dispatch=overlap_dispatch)
     elif engine:
         stats = _engine_throughput(model, params, prompt, steps)
     else:
@@ -428,7 +430,9 @@ def _print_slowest_traces(port, traced, k=3):
 def _http_throughput(model, params, prompt, steps, clients,
                      n_requests, slots, cancel_every: int = 0,
                      burst: int = 0, interleave: bool = True,
-                     kv_paging: bool = False, tenants: int = 0):
+                     kv_paging: bool = False, tenants: int = 0,
+                     packed_prefill: bool = True,
+                     overlap_dispatch: bool = True):
     """Front-door load test (VERDICT r4 #5): *clients* concurrent
     streaming HTTP clients drive *n_requests* total requests (mixed
     priorities; every *cancel_every*-th request disconnects after its
@@ -477,6 +481,8 @@ def _http_throughput(model, params, prompt, steps, clients,
                        max_queue=max(clients, slots, 4, n_requests
                                      if tenants else 0),
                        interleave=interleave,
+                       packed_prefill=packed_prefill,
+                       overlap_dispatch=overlap_dispatch,
                        tenant_quotas=tenant_quotas)
     # pre-compile the scheduler's adaptive-window scan variants: each
     # distinct window length is its own XLA compile, and it would
@@ -677,6 +683,19 @@ def _http_throughput(model, params, prompt, steps, clients,
         "prefix_reused_tokens": float(
             stats_load.get("prefix_reused_tokens", 0)
             - stats_warm.get("prefix_reused_tokens", 0)),
+        # ragged packed prefill + dispatch overlap telemetry (timed
+        # phase deltas; zeros when the toggles are off)
+        "packed_prefill": float(packed_prefill),
+        "overlap_dispatch": float(overlap_dispatch),
+        "packed_prefill_requests": float(
+            stats_load.get("packed_prefill_requests", 0)
+            - stats_warm.get("packed_prefill_requests", 0)),
+        "packed_prefill_extends": float(
+            stats_load.get("packed_prefill_extends", 0)
+            - stats_warm.get("packed_prefill_extends", 0)),
+        "packed_prefill_pad_tokens": float(
+            stats_load.get("packed_prefill_pad_tokens", 0)
+            - stats_warm.get("packed_prefill_pad_tokens", 0)),
     }
     if kv_paging:
         # KV pool economics straight off the production surfaces: the
@@ -1042,6 +1061,146 @@ def run_router(config, quantized, n_replicas, clients, n_requests,
     return out
 
 
+def run_prefill_heavy(config, quantized, clients, n_requests, slots,
+                      steps, prompt_len, max_len):
+    """Prefill-dominated A/B: long DISTINCT prompts (no APC dedupe)
+    with short outputs, once with ragged packing + dispatch overlap ON
+    and once OFF over the same model and load.  This is the residual
+    BASELINE §ROUND-6 regime — admission cost, not decode, is the
+    bill — so the delta is the packed-prefill/overlap win isolated
+    from everything the interleave already fixed.  Reports both arms'
+    prefill tok/s, HTTP/engine ratio, and the admit→first-token
+    breakdown, plus the ON/OFF speedup."""
+    budget = steps * (_ENGINE_WARMUP + _ENGINE_ROUNDS)
+    if prompt_len + budget > max_len:
+        raise ValueError(
+            f"prompt_len {prompt_len} + decode budget {budget} "
+            f"exceed max_len {max_len}")
+    cfg, model, params = build_model_and_params(
+        config, max_len, quantized)
+    # one DISTINCT prompt per request: prefill every time, pack when
+    # concurrent — the workload the packed path exists for
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(7), (max(n_requests, clients), prompt_len),
+        0, cfg.vocab)
+    out = {"prefill_heavy": True, "config": config,
+           "quantized": quantized, "prompt_len": float(prompt_len),
+           "steps": float(steps)}
+    for tag, on in (("off", False), ("on", True)):
+        arm = _http_throughput(
+            model, params, prompt, steps, clients, n_requests,
+            slots=slots, packed_prefill=on, overlap_dispatch=on)
+        for key in ("prefill_tokens_per_sec", "tokens_per_sec_http",
+                    "http_over_engine_ratio", "ttft_ms_p50",
+                    "ttft_ms_p99", "req_per_sec", "admit_ms_mean",
+                    "queue_wait_ms_mean", "ttft_ms_mean",
+                    "packed_prefill_requests",
+                    "packed_prefill_extends",
+                    "packed_prefill_pad_tokens"):
+            if key in arm:
+                out[f"{key}_{tag}"] = arm[key]
+    base = out.get("prefill_tokens_per_sec_off", 0.0)
+    if base > 0:
+        out["prefill_speedup_x"] = (
+            out.get("prefill_tokens_per_sec_on", 0.0) / base)
+    if out.get("req_per_sec_off", 0.0) > 0:
+        out["req_per_sec_speedup_x"] = (
+            out.get("req_per_sec_on", 0.0) / out["req_per_sec_off"])
+    return out
+
+
+def _spawn_server(config, quantized, port, slots, steps, max_len,
+                  extra):
+    """One serving subprocess through the REAL CLI (the path a pod
+    runs), no router — the cold-start phase's replica."""
+    import os
+    import subprocess
+    import sys
+
+    cmd = [
+        sys.executable, "-m",
+        "tpu_k8s_device_plugin.workloads.server",
+        "--config", config,
+        "--n-slots", str(slots),
+        "--max-len", str(max_len),
+        "--max-new-tokens", str(steps),
+        "--window", "16",
+        "--host", "127.0.0.1", "--port", str(port),
+    ] + list(extra)
+    if quantized == "int4":
+        cmd.append("--int4")
+    elif quantized:
+        cmd.append("--quantized")
+    return subprocess.Popen(
+        cmd, env=dict(os.environ),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def run_cold_start(config, quantized, slots, steps, prompt_len,
+                   max_len, cache_dir=None):
+    """Replica cold-start economics: boot the real server CLI twice
+    against ONE ``--compile-cache-dir`` — the first boot compiles and
+    fills the cache (cold), the second loads executables from it
+    (warm) — timing spawn → first successful completion each time.
+    The warm boot MUST be measurably faster (asserted by the CLI exit
+    code): that delta is what makes router-driven autoscaling real,
+    because a scale-up replica that pays the per-shape warmup storm
+    is not capacity for minutes."""
+    import http.client
+    import json as _json
+    import shutil
+    import subprocess
+    import tempfile
+    import time
+
+    cache = cache_dir or tempfile.mkdtemp(prefix="tpu-compile-cache-")
+    own_cache = cache_dir is None
+    prompt = list(range(1, prompt_len + 1))
+    out = {"cold_start": True, "config": config,
+           "quantized": quantized, "compile_cache_dir": cache}
+    try:
+        for phase in ("cold", "warm"):
+            port = _free_port()
+            t0 = time.perf_counter()
+            proc = _spawn_server(
+                config, quantized, port, slots, steps, max_len,
+                ["--compile-cache-dir", cache])
+            try:
+                _wait_http_ok(port, "/healthz", 900)
+                out[f"{phase}_ready_s"] = time.perf_counter() - t0
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=600)
+                conn.request(
+                    "POST", "/generate",
+                    _json.dumps({"tokens": prompt,
+                                 "max_new_tokens": steps,
+                                 "stream": False}),
+                    {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                body = resp.read()
+                conn.close()
+                if resp.status != 200:
+                    raise RuntimeError(
+                        f"{phase} start first request answered "
+                        f"{resp.status}: {body[:120]!r}")
+                out[f"{phase}_first_completion_s"] = (
+                    time.perf_counter() - t0)
+            finally:
+                proc.kill()
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    pass
+        out["warm_speedup_x"] = (out["cold_first_completion_s"]
+                                 / out["warm_first_completion_s"])
+        out["warm_faster"] = float(out["warm_first_completion_s"]
+                                   < out["cold_first_completion_s"])
+    finally:
+        if own_cache:
+            shutil.rmtree(cache, ignore_errors=True)
+    return out
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tpu-serving-bench")
     p.add_argument("--config", choices=sorted(CONFIGS), default="tiny")
@@ -1080,6 +1239,34 @@ def main(argv=None) -> int:
                    help="with --http: disable iteration-level "
                         "prefill/decode interleaving (A/B against the "
                         "scheduler; outputs identical either way)")
+    p.add_argument("--packed-prefill", default=True,
+                   action=argparse.BooleanOptionalAction,
+                   help="with --http: ragged packed prefill (batched "
+                        "admission extends; default on, outputs "
+                        "identical either way)")
+    p.add_argument("--overlap-dispatch", default=True,
+                   action=argparse.BooleanOptionalAction,
+                   help="with --http: double-buffered dispatch/"
+                        "harvest overlap (default on, outputs "
+                        "identical either way)")
+    p.add_argument("--prefill-heavy", action="store_true",
+                   help="with --http: the prefill-dominated phase — "
+                        "long DISTINCT prompts, short outputs, run "
+                        "with packing+overlap ON vs OFF; reports both "
+                        "arms' prefill tok/s, HTTP/engine ratio, and "
+                        "admit→first-token breakdown plus the ON/OFF "
+                        "speedup (--prompt-len/--steps shape it)")
+    p.add_argument("--cold-start", action="store_true",
+                   help="replica cold-start phase: boot the real "
+                        "server CLI twice against one "
+                        "--compile-cache-dir (cold fill, warm load) "
+                        "and time spawn → first completion; exits "
+                        "nonzero unless the warm boot is faster (the "
+                        "autoscaling gate)")
+    p.add_argument("--compile-cache-dir", default=None, metavar="DIR",
+                   help="with --cold-start: reuse DIR as the persistent "
+                        "compile cache instead of a throwaway tempdir "
+                        "(pass a pre-warmed dir to measure warm-only)")
     p.add_argument("--assert-ratio", type=float, default=0.0,
                    metavar="FLOOR",
                    help="with --http: exit nonzero unless "
@@ -1124,18 +1311,66 @@ def main(argv=None) -> int:
         p.error("--quantized and --int4 are mutually exclusive")
     modes = [f for f, on in (("--engine", args.engine),
                              ("--spec", args.spec),
-                             ("--http", args.http)) if on]
+                             ("--http", args.http),
+                             ("--cold-start", args.cold_start)) if on]
     if len(modes) > 1:
         # silently running a different experiment than the one asked
         # for is worse than an error
         p.error(f"{' and '.join(modes)} are mutually exclusive")
     if (args.requests or args.cancel_every or args.burst
             or args.assert_ratio or args.no_interleave
-            or args.kv_paging or args.tenants or args.router) \
+            or args.kv_paging or args.tenants or args.router
+            or args.prefill_heavy) \
             and not args.http:
         p.error("--requests/--cancel-every/--burst/--assert-ratio/"
-                "--no-interleave/--kv-paging/--tenants/--router only "
-                "apply with --http")
+                "--no-interleave/--kv-paging/--tenants/--router/"
+                "--prefill-heavy only apply with --http")
+    if args.compile_cache_dir and not args.cold_start:
+        p.error("--compile-cache-dir only applies with --cold-start")
+    if args.cold_start:
+        quantized = "int4" if args.int4 else args.quantized
+        try:
+            stats = run_cold_start(
+                args.config, quantized, slots=args.batch or 4,
+                steps=args.steps, prompt_len=args.prompt_len,
+                max_len=args.max_len,
+                cache_dir=args.compile_cache_dir)
+        except (ValueError, RuntimeError) as e:
+            p.error(str(e))
+        for k, v in stats.items():
+            print(f"{k}: {v}")
+        if not stats.get("warm_faster"):
+            print("FAIL: warm start "
+                  f"({stats['warm_first_completion_s']:.1f}s) not "
+                  "faster than cold start "
+                  f"({stats['cold_first_completion_s']:.1f}s)",
+                  flush=True)
+            return 1
+        print(f"OK: warm start {stats['warm_speedup_x']:.2f}x faster "
+              "than cold", flush=True)
+        return 0
+    if args.prefill_heavy:
+        quantized = "int4" if args.int4 else args.quantized
+        try:
+            stats = run_prefill_heavy(
+                args.config, quantized, clients=args.http,
+                n_requests=args.requests or 4 * args.http,
+                slots=args.batch, steps=args.steps,
+                prompt_len=args.prompt_len, max_len=args.max_len)
+        except (ValueError, RuntimeError) as e:
+            p.error(str(e))
+        for k, v in stats.items():
+            print(f"{k}: {v}")
+        if args.assert_ratio:
+            ratio = stats.get("http_over_engine_ratio_on", 0.0)
+            if ratio < args.assert_ratio:
+                print(f"FAIL: http_over_engine_ratio_on {ratio:.3f} "
+                      f"below the {args.assert_ratio:.2f} floor",
+                      flush=True)
+                return 1
+            print(f"OK: http_over_engine_ratio_on {ratio:.3f} >= "
+                  f"{args.assert_ratio:.2f}", flush=True)
+        return 0
     if args.tenants < 0:
         p.error("--tenants must be >= 0")
     if args.router < 0:
@@ -1183,7 +1418,9 @@ def main(argv=None) -> int:
                     http_requests=args.requests,
                     cancel_every=args.cancel_every, burst=args.burst,
                     interleave=not args.no_interleave,
-                    kv_paging=args.kv_paging, tenants=args.tenants)
+                    kv_paging=args.kv_paging, tenants=args.tenants,
+                    packed_prefill=args.packed_prefill,
+                    overlap_dispatch=args.overlap_dispatch)
     except ValueError as e:
         p.error(str(e))
     for k, v in stats.items():

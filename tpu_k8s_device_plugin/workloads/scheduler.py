@@ -25,40 +25,69 @@ primitives:
   runs again between the window's dispatch and harvest, so a request
   that arrives while a window is open starts prefilling BEFORE that
   window closes instead of queueing behind it.
+* **ragged packed prefill** — multiple pending admissions' next
+  chunks dispatch as ONE batched extend
+  (``engine.admit_step_packed``): K concurrent cold prompts cost one
+  host dispatch per chunk-round instead of K, and on parallel
+  hardware share one kernel's MXU pass.  Pack sizes form a small
+  fixed compile set (2..``max_pack``, see ``warm_packed``); the head
+  ticket still splices FIRST, so admission order — and with it the
+  APC-donor and draw-chain order — is exactly the serial path's.
+* **dispatch-ahead overlap** — after harvesting window N the
+  scheduler immediately dispatches window N+1 (double-buffered
+  dispatch/harvest), so the owner's host-side stream-write work
+  between ``iterate()`` calls overlaps device compute instead of
+  leaving the device idle.  GUARDED to the all-greedy knob regime: a
+  live sampled slot retiring behind an already-dispatched window
+  would shift the draw chain that seeded neighbors replay, so any
+  sampled slot live ⇒ serial cadence (outputs stay byte-identical
+  with overlap on or off — the equivalence suite pins it).
 
 Correctness bar (the house invariant): outputs are bit-identical with
-interleaving on or off.  Greedy and grammar-constrained slots are
-deterministic per slot; seeded sampled slots draw from their own
-fold_in chain indexed by a per-slot draw counter that advances only
-with picks the slot participates in — all scheduling-order invariant.
-(Unseeded sampled streams depend on the global key stream by design;
-per-request seeds exist precisely to opt out of that.)  The engine
-enforces the mechanics: mid-window splices land in the dispatched
-window's ``skip`` set so harvest never advances a lens or draw chain
-the finish_admit just set.
+interleaving on or off — and with packing or overlap on or off.
+Greedy and grammar-constrained slots are deterministic per slot;
+seeded sampled slots draw from their own fold_in chain indexed by a
+per-slot draw counter that advances only with picks the slot
+participates in — all scheduling-order invariant.  (Unseeded sampled
+streams depend on the global key stream by design; per-request seeds
+exist precisely to opt out of that.)  The engine enforces the
+mechanics: mid-window splices — and slots the owner releases while an
+overlap window is in flight — land in the dispatched window's
+``skip`` set so harvest never advances a lens or draw chain behind
+their back.
 
 Fault hook: ``serve.schedule`` fires at the top of every iteration
 (error/hang kinds), and :meth:`IterationScheduler.supersede` lets the
 crash supervisor invalidate an iteration a watchdog abandoned — the
 abandoned worker re-checks the generation right after the hook and
-bails before touching the engine.
+bails before touching the engine (an outstanding dispatch-ahead
+window is abandoned with it).
 """
 
 from __future__ import annotations
 
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
 
 from tpu_k8s_device_plugin import obs
 from tpu_k8s_device_plugin.resilience import faults
 
-from .serving import AdmitState, ServingEngine
+from .serving import AdmitState, ServingEngine, _knobs_live
 
-# interleave granularity: how many prefill chunks may be dispatched
-# into one open window.  Bounds how far a very long prompt can delay
-# the window's harvest (every chunk shares the device with the scan);
-# the remainder rides the next window(s).
+# interleave granularity: how many prefill chunk DISPATCHES may ride
+# one open window (a packed dispatch advances up to max_pack
+# admissions but spends ONE unit — that is the point of packing).
+# Bounds how far prefill can delay the window's harvest; the
+# remainder rides the next window(s).
 DEFAULT_PREFILL_BUDGET = 4
+
+# ragged packed prefill: most admissions packed into one batched
+# extend.  Each pack size in 2..max_pack is its own compiled extend
+# shape, so the cap bounds the compile set (warm_packed pre-compiles
+# it); 4 covers the common convoy widths without growing the set.
+DEFAULT_MAX_PACK = 4
 
 # batch-forming dwell at a fresh-batch boundary (the engine just went
 # idle and admissions are landing): wait this long for stragglers so
@@ -132,11 +161,16 @@ class IterationScheduler:
     waiting); it must create the ticket via :meth:`begin` and handle
     its own validation errors.
 
-    One ticket is in flight at a time: admission is serial on the
-    device anyway, and serializing tickets keeps sibling/repeat
-    prompts hitting the prefix cache exactly as one-shot admission
-    did (a prompt becomes a donor only once its splice lands).
-    """
+    With ``packed_prefill`` off (or an unpackable engine) one ticket
+    is in flight at a time: admission is serial on the device anyway,
+    and serializing tickets keeps sibling/repeat prompts hitting the
+    prefix cache exactly as one-shot admission did (a prompt becomes a
+    donor only once its splice lands).  With packing on, up to
+    ``max_pack`` tickets prefill CONCURRENTLY through batched extends;
+    splices stay strictly FIFO, and owners that care about sibling APC
+    reuse defer conflicting pulls via :meth:`packing_conflict` (the
+    HTTP server does), so the donor order a repeat prompt observes is
+    unchanged."""
 
     def __init__(self, engine: ServingEngine, window: int = 8,
                  interleave: bool = True,
@@ -146,15 +180,28 @@ class IterationScheduler:
                  budget_hint: Optional[
                      Callable[[int], Optional[int]]] = None,
                  sync_dwell_s: float = DEFAULT_SYNC_DWELL_S,
+                 packed_prefill: bool = True,
+                 max_pack: int = DEFAULT_MAX_PACK,
+                 overlap: bool = True,
                  registry=None, recorder=None):
         if window < 1:
             raise ValueError("window must be >= 1")
         if prefill_budget < 1:
             raise ValueError("prefill_budget must be >= 1")
+        if max_pack < 2:
+            raise ValueError("max_pack must be >= 2")
         self.engine = engine
         self.window = window
         self.interleave = bool(interleave)
         self.prefill_budget = prefill_budget
+        # packing needs a fixed chunk grid (the packed extend's shape)
+        # and per-row-independent FFN math — the engine's _PrefillJob
+        # re-checks per admission (plp jobs stay serial)
+        self._packing = (bool(packed_prefill)
+                         and engine.chunk is not None
+                         and engine.model.n_experts == 0)
+        self.max_pack = max_pack
+        self.overlap = bool(overlap)
         self._pull = pull
         # called the moment an admission goes live (scheduler thread,
         # possibly MID-WINDOW): the owner streams the first token right
@@ -170,22 +217,42 @@ class IterationScheduler:
         self._budget_hint = budget_hint
         self.sync_dwell_s = sync_dwell_s
         self.recorder = recorder
-        self._pending: List[Ticket] = []     # at most one, see begin()
+        self._pending: List[Ticket] = []   # FIFO; len <= pack limit
         self._await_first: List[Ticket] = []  # finalized, pre-1st-step
+        self._ahead: Optional[Tuple[object, int]] = None  # (handle, n)
         self._gen = 0                         # supersession counter
         self._m_chunk = self._m_first = None
+        self._m_overlap_idle = self._m_overlap_windows = None
         self._g_prefill = self._g_decode = None
         if registry is not None:
             self._m_chunk = registry.histogram(
                 "tpu_serve_prefill_chunk_seconds",
                 "One prefill-chunk dispatch on the scheduler thread "
-                "(async: device time overlaps the open decode window).",
+                "(async: device time overlaps the open decode window; "
+                "a packed dispatch advances several admissions and "
+                "observes once).",
                 buckets=obs.FAST_BUCKETS_S)
             self._m_first = registry.histogram(
                 "tpu_serve_admit_to_first_step_seconds",
                 "Admission handoff to the slot's first decode-window "
                 "dispatch (prefill + finalize, interleave included).",
                 buckets=obs.LATENCY_BUCKETS_S)
+            self._m_overlap_idle = registry.histogram(
+                "tpu_serve_overlap_idle_seconds",
+                "Device time a dispatch-ahead window still had left "
+                "when its harvest was reached — overlap the host work "
+                "did NOT cover (0-bucket harvests mean the window was "
+                "already done: full overlap).",
+                buckets=obs.FAST_BUCKETS_S)
+            self._m_overlap_windows = registry.counter(
+                "tpu_serve_overlap_windows_total",
+                "Decode windows dispatched AHEAD of their harvest "
+                "(double-buffered dispatch/harvest overlap).")
+            # materialize the default children so overlap-off (or
+            # not-yet-overlapped) servers still render the families
+            # as zeros — dashboards see ONE schema
+            self._m_overlap_idle._default()
+            self._m_overlap_windows.inc(0)
             g = registry.gauge(
                 "tpu_serve_scheduler_queue_depth",
                 "Iteration-scheduler work-queue depth by kind: "
@@ -214,19 +281,46 @@ class IterationScheduler:
             self.engine.abort_admit(ticket.state)
 
     def busy(self) -> bool:
-        """Admission work still queued?"""
-        return bool(self._pending or self._await_first)
+        """Admission work still queued, or a dispatch-ahead window
+        still awaiting its harvest?"""
+        return bool(self._pending or self._await_first
+                    or self._ahead is not None)
 
     def pending_tickets(self) -> List[Ticket]:
         return list(self._pending)
+
+    def packing_conflict(self, prompt) -> bool:
+        """Would beginning *prompt* NOW forfeit an APC match a serial
+        admission would have had?  True when packing is active and an
+        in-flight pending admission shares a >= chunk-grid prefix with
+        *prompt* (the donor it would match has not spliced yet).
+        Owners defer such pulls until the conflicting ticket lands —
+        tokens would be identical either way, but sibling copies and
+        repeat prompts would pay a full cold prefill the serial path
+        never paid."""
+        if not self._packing or not self._pending:
+            return False
+        c = self.engine.chunk
+        p = np.asarray(prompt, np.int32).ravel()
+        if len(p) < c:
+            return False
+        for t in self._pending:
+            q = t.state.prompt_np[0]
+            if len(q) >= c and np.array_equal(p[:c], q[:c]):
+                return True
+        return False
 
     def supersede(self) -> None:
         """Invalidate the current iteration (crash-supervisor restart
         path): a watchdog-abandoned worker re-checks the generation
         right after the fault hook and bails before touching the
         engine.  Pending admissions are aborted — their requests get
-        the supervisor's 503."""
+        the supervisor's 503 — and an outstanding dispatch-ahead
+        window is abandoned (its slots are about to be released)."""
         self._gen += 1
+        if self._ahead is not None:
+            self.engine.scan_abandon(self._ahead[0])
+            self._ahead = None
         for t in self._pending:
             try:
                 self.engine.abort_admit(t.state)
@@ -243,60 +337,52 @@ class IterationScheduler:
                 "scheduler restarted while this iteration was "
                 "abandoned by the watchdog")
 
+    def _pull_limit(self) -> int:
+        return self.max_pack if self._packing else 1
+
     def _pull_tickets(self) -> None:
-        """Take new work while there is a free slot and no ticket in
-        flight (serial tickets keep APC donor order identical to
-        one-shot admission)."""
+        """Take new work while there is a free slot and ticket room —
+        one in-flight ticket serially, up to ``max_pack`` when packing
+        (concurrent prefills are what the batched extend packs)."""
         if self._pull is None:
             return
-        while not self._pending and self.engine.free_slots():
+        limit = self._pull_limit()
+        while len(self._pending) < limit and self.engine.free_slots():
             if self._pull() is None:
                 return
 
-    def _advance(self, budget: Optional[int]) -> None:
-        """Dispatch up to *budget* prefill chunks (None = run the head
-        ticket to completion) — each an async extend the device
-        overlaps with whatever else is queued."""
-        if not self._pending:
-            return
-        st = self._pending[0].state
-        n = budget if budget is not None else (1 << 30)
-        eng = self.engine
-        while n > 0 and st.gen is not None:
-            t0 = time.perf_counter()
-            more = eng.admit_step(st)
-            if self._m_chunk is not None:
-                self._m_chunk.observe(time.perf_counter() - t0)
-            n -= 1
-            if not more:
-                break
+    def _pack_group(self) -> List[AdmitState]:
+        """The states the next prefill dispatch advances: the head
+        alone (serial, or an unpackable head — plp jobs), or every
+        packable in-flight state up to ``max_pack``."""
+        head = self._pending[0].state
+        if (not self._packing or head.gen is None
+                or not head.gen.packable):
+            return [head]
+        group = [t.state for t in self._pending
+                 if t.state.gen is not None and t.state.gen.packable]
+        return group[:self.max_pack]
 
     def _admit_work(self, budget: int) -> List[Ticket]:
-        """Mid-window admission work: spend up to *budget* prefill
-        chunks, finalize-dispatch every admission that completes, and
-        pull replacements as slots allow — multiple admissions can
-        land inside ONE open window (slot turnover refills the whole
-        batch without waiting a window per request).  Returns the
+        """Admission work: spend up to *budget* prefill DISPATCHES
+        (each serial or packed — a packed dispatch advances every
+        in-flight packable admission one chunk), finalize-dispatch
+        every admission that completes IN FIFO ORDER, and pull
+        replacements as slots allow — multiple admissions can land
+        inside ONE open window (slot turnover refills the whole batch
+        without waiting a window per request).  Returns the
         splice-dispatched tickets; the caller resolves them after the
         window's harvest."""
         fins: List[Ticket] = []
         eng = self.engine
         n = budget
         while True:
-            if not self._pending:
+            if len(self._pending) < self._pull_limit():
                 self._pull_tickets()
-                if not self._pending:
-                    return fins
-            st = self._pending[0].state
-            if st.gen is not None:
-                if n <= 0:
-                    return fins
-                t0 = time.perf_counter()
-                eng.admit_step(st)
-                if self._m_chunk is not None:
-                    self._m_chunk.observe(time.perf_counter() - t0)
-                n -= 1
-            if st.ready:
+            # splice strictly head-first: a later ticket may finish
+            # its chunks early, but it becomes live (and an APC donor)
+            # only in arrival order — the serial path's order
+            while self._pending and self._pending[0].state.ready:
                 t = self._finalize_dispatch()
                 if t is not None:
                     # resolve EAGERLY: the first-token pick depends
@@ -306,6 +392,23 @@ class IterationScheduler:
                     # before the window closes (worst case it waits
                     # for the window — where it used to wait anyway)
                     fins += self._finalize_resolve(t)
+                self._pull_tickets()
+            if not self._pending or n <= 0:
+                return fins
+            group = self._pack_group()
+            t0 = time.perf_counter()
+            if len(group) >= 2:
+                # one resident pack session: run until the shortest
+                # member's last chunk (or the budget) — pack/unpack
+                # copies amortize over the whole session
+                rounds = min(n, min(st.gen.remaining for st in group))
+                eng.admit_step_packed(group, rounds)
+                n -= rounds
+            else:
+                eng.admit_step(group[0])
+                n -= 1
+            if self._m_chunk is not None:
+                self._m_chunk.observe(time.perf_counter() - t0)
 
     def _finalize_dispatch(self) -> Optional[Ticket]:
         """Splice a fully-prefilled head ticket (device dispatch only;
@@ -328,16 +431,12 @@ class IterationScheduler:
 
     def _drain_admissions(self) -> List[Ticket]:
         """Admit everything waiting, one-shot style (interleave off /
-        spec & jump rounds): pull → full prefill → finalize, until no
-        capacity or no work — byte-for-byte the admission order the
-        pre-scheduler loop produced."""
-        done: List[Ticket] = []
-        while True:
-            self._pull_tickets()
-            if not self._pending:
-                return done
-            self._advance(None)
-            done += self._finalize_resolve(self._finalize_dispatch())
+        spec & jump rounds / fresh-batch boundaries): pull → prefill
+        to completion → finalize, until no capacity or no work.
+        Serially this is byte-for-byte the admission order the
+        pre-scheduler loop produced; with packing the prefills batch
+        but the finalize order is unchanged."""
+        return self._admit_work(1 << 30)
 
     def _note_first_step(self) -> None:
         """A decode dispatch is about to include every live slot:
@@ -357,12 +456,149 @@ class IterationScheduler:
                                 + len(self._await_first))
             self._g_decode.set(sum(self.engine.active))
 
+    def _choose_window(self, consumed: Optional[Dict[int, int]] = None
+                       ) -> int:
+        """Window length for the next scan: the configured floor,
+        grown in quantized floor-multiples toward the smallest
+        remaining per-request budget (full engine only), capped by
+        cache headroom.  < 1 means a slot ran out of cache (endgame
+        step territory — never dispatched ahead).  *consumed* adjusts
+        the owner's budget hints by tokens a just-harvested window
+        produced that the owner has not streamed yet (the
+        dispatch-ahead path runs BEFORE the owner's emit, so its raw
+        hints are stale by exactly one window)."""
+        eng = self.engine
+        headroom = min(eng.model.max_len - eng.lens[s]
+                       for s in range(eng.n_slots) if eng.active[s])
+        window = self.window
+        if self._budget_hint is not None and not eng.free_slots():
+            # adaptive window, gated on a FULL engine: grow toward the
+            # smallest remaining per-request budget (one harvest per
+            # synchronized generation instead of one per `window`
+            # steps, with no slot decoding garbage past its
+            # retirement).  With free or reserved slots the floor
+            # window stands — a request arriving moments after a long
+            # window opened would otherwise sit it out entirely, which
+            # costs far more than the extra harvests (measured: the
+            # ungated version oscillated between 1.3x and 0.5x of the
+            # gated throughput depending on client arrival phase)
+            need = None
+            for s in range(eng.n_slots):
+                if not eng.active[s]:
+                    continue
+                h = self._budget_hint(s)
+                if h is not None and consumed:
+                    h -= consumed.get(s, 0)
+                if h is None:
+                    need = None
+                    break
+                need = h if need is None or h < need else need
+            if need is not None and need > window:
+                # QUANTIZED to whole multiples of the floor: n_steps
+                # is a static scan argument, so every distinct window
+                # length is its own XLA compile — free-running growth
+                # turned staggered budgets into a compile storm
+                # (measured: 5x throughput collapse).  Multiples of
+                # the floor cap the compiled variants at
+                # ADAPTIVE_WINDOW_FACTOR.  Round UP when the overshoot
+                # is under half a floor (a 63-step batch runs one
+                # 64-window, not 48+16 — the single garbage step costs
+                # less than the extra harvest); otherwise down.
+                k, rem = divmod(need, self.window)
+                if rem and self.window - rem <= self.window // 2:
+                    k += 1
+                window = self.window * max(
+                    1, min(ADAPTIVE_WINDOW_FACTOR, k))
+        return min(window, headroom)
+
+    def _maybe_dispatch_ahead(
+            self, decoded: Optional[Dict[int, List[int]]] = None
+    ) -> None:
+        """Double-buffered dispatch: put the NEXT window on the device
+        before returning from iterate, so the owner's host-side
+        harvest/stream-write work between calls overlaps device
+        compute instead of leaving it idle.  Engaged ONLY when the
+        post-harvest state would choose a plain scan anyway AND no
+        sampled knob is live: a sampled slot retiring behind an
+        already-dispatched window would shift the draw accounting
+        seeded neighbors replay — greedy/grammar windows have no draw
+        stream, and a slot the owner releases mid-window lands in the
+        handle's skip set, so output bytes are unchanged (the
+        equivalence suite pins overlap on == off).
+
+        *decoded* — the harvest this iterate just returned, which the
+        owner has NOT streamed yet — adjusts the budget hints: if any
+        stream's remaining budget (net of the unstreamed tokens) is
+        exhausted, the owner is about to release its slot, and a
+        pre-dispatched window would decode a garbage column the whole
+        width; stand down and let the serial path re-evaluate after
+        the owner's emit (measured: skipping this check cost ~2x on
+        synchronized-batch retirement — every batch turnover burned
+        one to two full garbage windows)."""
+        if not (self.overlap and self.interleave):
+            return
+        eng = self.engine
+        if not any(eng.active):
+            return
+        if eng.spec_ready() or eng.forced_pending():
+            return
+        if _knobs_live(eng.temps, eng.topks, eng.topps, eng.minps,
+                       eng.pres, eng.freqs, eng.reps):
+            return
+        consumed = ({s: len(t) for s, t in decoded.items()}
+                    if decoded else None)
+        if self._budget_hint is not None:
+            for s in range(eng.n_slots):
+                if not eng.active[s]:
+                    continue
+                h = self._budget_hint(s)
+                if h is None:
+                    continue
+                if consumed:
+                    h -= consumed.get(s, 0)
+                if h < 1:
+                    return      # retirement imminent: serial cadence
+        window = self._choose_window(consumed)
+        if window < 1:
+            return
+        self._note_first_step()
+        handle = eng.scan_dispatch(window)
+        self._ahead = (handle, window)
+        if self._m_overlap_windows is not None:
+            self._m_overlap_windows.inc()
+
+    def _iterate_ahead(self, gen: int) -> IterationResult:
+        """One iteration against a window dispatched by the PREVIOUS
+        iterate: admission work overlaps it exactly as it would a
+        same-iteration window (same mid-window splice semantics, same
+        skip set), then the harvest's blocking sync covers whatever
+        device time the host work did not already hide."""
+        eng = self.engine
+        handle, window = self._ahead
+        self._ahead = None
+        if faults.ACTIVE is not None:
+            faults.ACTIVE.fire("serve.step")
+            faults.ACTIVE.fire("serve.schedule")
+        self._check(gen)
+        fins = self._admit_work(self.prefill_budget)
+        t0 = time.perf_counter()
+        decoded = eng.scan_harvest(handle)
+        if self._m_overlap_idle is not None:
+            self._m_overlap_idle.observe(time.perf_counter() - t0)
+        self._maybe_dispatch_ahead(decoded)
+        self._gauges()
+        return IterationResult(fins, decoded, window)
+
     def iterate(self) -> IterationResult:
         """One scheduler iteration: admission work + at most one
         decode round (scan window / spec round / jump round / endgame
         step), interleaved when enabled.  The owner loops this."""
         gen = self._gen
         eng = self.engine
+        if self._ahead is not None:
+            # overlap mode: window N+1 is already on the device —
+            # admission work rides it, then its harvest
+            return self._iterate_ahead(gen)
         admitted: List[Ticket] = []
         fresh_batch = self.interleave and not any(eng.active)
         if not self.interleave or fresh_batch:
@@ -422,46 +658,7 @@ class IterationScheduler:
             if not any(eng.active):
                 self._gauges()
                 return IterationResult(admitted, {}, 0)
-        headroom = min(eng.model.max_len - eng.lens[s]
-                       for s in range(eng.n_slots) if eng.active[s])
-        window = self.window
-        if self._budget_hint is not None and not eng.free_slots():
-            # adaptive window, gated on a FULL engine: grow toward the
-            # smallest remaining per-request budget (one harvest per
-            # synchronized generation instead of one per `window`
-            # steps, with no slot decoding garbage past its
-            # retirement).  With free or reserved slots the floor
-            # window stands — a request arriving moments after a long
-            # window opened would otherwise sit it out entirely, which
-            # costs far more than the extra harvests (measured: the
-            # ungated version oscillated between 1.3x and 0.5x of the
-            # gated throughput depending on client arrival phase)
-            need = None
-            for s in range(eng.n_slots):
-                if not eng.active[s]:
-                    continue
-                h = self._budget_hint(s)
-                if h is None:
-                    need = None
-                    break
-                need = h if need is None or h < need else need
-            if need is not None and need > window:
-                # QUANTIZED to whole multiples of the floor: n_steps
-                # is a static scan argument, so every distinct window
-                # length is its own XLA compile — free-running growth
-                # turned staggered budgets into a compile storm
-                # (measured: 5x throughput collapse).  Multiples of
-                # the floor cap the compiled variants at
-                # ADAPTIVE_WINDOW_FACTOR.  Round UP when the overshoot
-                # is under half a floor (a 63-step batch runs one
-                # 64-window, not 48+16 — the single garbage step costs
-                # less than the extra harvest); otherwise down.
-                k, rem = divmod(need, self.window)
-                if rem and self.window - rem <= self.window // 2:
-                    k += 1
-                window = self.window * max(
-                    1, min(ADAPTIVE_WINDOW_FACTOR, k))
-        window = min(window, headroom)
+        window = self._choose_window()
         if window < 1:
             # a slot ran out of cache: one step() retires it
             self._note_first_step()
@@ -473,13 +670,15 @@ class IterationScheduler:
         fins: List[Ticket] = []
         if self.interleave:
             # the window is on the device; everything below overlaps
-            # it: prefill chunks, NEW arrivals (mid-window admission),
-            # and completed admissions' splices + first-token picks —
-            # as many as the chunk budget lands, so turnover refills
-            # every free slot inside one window
+            # it: prefill chunks (serial or packed), NEW arrivals
+            # (mid-window admission), and completed admissions'
+            # splices + first-token picks — as many as the chunk
+            # budget lands, so turnover refills every free slot inside
+            # one window
             self._check(gen)
             fins = self._admit_work(self.prefill_budget)
         decoded = eng.scan_harvest(handle)
         admitted += fins
+        self._maybe_dispatch_ahead(decoded)
         self._gauges()
         return IterationResult(admitted, decoded, window)

@@ -130,6 +130,7 @@ from .grammar import (
 )
 from .scheduler import (
     ADAPTIVE_WINDOW_FACTOR,
+    DEFAULT_MAX_PACK,
     DEFAULT_PREFILL_BUDGET,
     IterationScheduler,
 )
@@ -680,7 +681,10 @@ class EngineServer:
                  interleave: bool = True,
                  prefill_chunks: int = DEFAULT_PREFILL_BUDGET,
                  schedule_watchdog_s: float = 0.0,
-                 tenant_quotas: Optional[dict] = None):
+                 tenant_quotas: Optional[dict] = None,
+                 packed_prefill: bool = True,
+                 overlap_dispatch: bool = True,
+                 max_pack: int = DEFAULT_MAX_PACK):
         """*tokenizer* (anything with ``encode(str) -> List[int]`` and
         ``decode(List[int]) -> str``, e.g. a transformers tokenizer)
         unlocks the text-level surface: ``"prompt"`` strings, STRING
@@ -820,6 +824,22 @@ class EngineServer:
             "tpu_serve_prefix_evictions_total",
             "Prefix-registry/parked-donor records evicted by the LRU "
             "cap or pool-pressure reclaim.")
+        # -- ragged packed prefill + warmup -------------------------------
+        self._m_packed_reqs = reg.counter(
+            "tpu_serve_packed_prefill_requests_total",
+            "Admissions whose prefill rode at least one ragged packed "
+            "(batched) extend dispatch.")
+        self._m_packed_pad = reg.counter(
+            "tpu_serve_packed_prefill_pad_tokens_total",
+            "Zero-pad token rows computed by packed prefill dispatches "
+            "(tail-chunk grid padding — the packing waste metric).")
+        self._m_warmup = reg.gauge(
+            "tpu_serve_warmup_seconds",
+            "Wall seconds warm_scheduler spent pre-compiling, by "
+            "phase (scan = adaptive-window variants, packed_prefill = "
+            "the packed shape set, total = everything).  With a warm "
+            "--compile-cache-dir these collapse to cache-hit loads.",
+            ("phase",))
         reg.on_collect(self._collect_kv)
         self.tenant_quotas = dict(tenant_quotas or {})
         self._qos = bool(self.tenant_quotas)
@@ -856,11 +876,20 @@ class EngineServer:
         # admit-fully-then-scan cadence (outputs are bit-identical
         # either way — the equivalence tests pin it).
         self.interleave = bool(interleave)
+        # ragged packed prefill + dispatch-ahead overlap (both default
+        # on; outputs are byte-identical either way — the packed/
+        # overlap equivalence suites pin it): packing batches
+        # concurrent admissions' chunks into one extend, overlap keeps
+        # window N+1 on the device while this thread streams window N
+        self.packed_prefill = bool(packed_prefill)
+        self.overlap_dispatch = bool(overlap_dispatch)
         self._sched = IterationScheduler(
             engine, window=window, interleave=interleave,
             prefill_budget=prefill_chunks, pull=self._pull_ticket,
             on_admit=self._bind_admitted,
-            budget_hint=self._budget_hint, registry=reg,
+            budget_hint=self._budget_hint,
+            packed_prefill=packed_prefill, max_pack=max_pack,
+            overlap=overlap_dispatch, registry=reg,
             recorder=self.recorder)
         self._tickets: dict = {}   # Ticket -> (_Request, copy idx)
         # optional hang containment for the scheduler loop: a watchdog
@@ -886,14 +915,17 @@ class EngineServer:
             engine.set_preempt_cb(self._preempt_for_pages)
 
     def _collect_kv(self) -> None:
-        """Scrape-time refresh of the KV-pool/QoS families from engine
-        stats (counters _set to the engine's monotonic values)."""
+        """Scrape-time refresh of the KV-pool/QoS/packed-prefill
+        families from engine stats (counters _set to the engine's
+        monotonic values)."""
         st = self.engine.stats()
         self._m_kv_pages_free.set(st.get("kv_pages_free", 0))
         self._m_kv_pages_shared.set(st.get("kv_pages_shared", 0))
         self._m_kv_preempt._set(st.get("kv_preemptions", 0))
         self._m_kv_cow._set(st.get("kv_cow_copies", 0))
         self._m_prefix_evict._set(st.get("prefix_evictions", 0))
+        self._m_packed_reqs._set(st.get("packed_prefill_requests", 0))
+        self._m_packed_pad._set(st.get("packed_prefill_pad_tokens", 0))
 
     def _resolve_quota(self, tenant: str) -> Optional["TenantQuota"]:
         """Per-tenant QoS state; the ``*`` spec is a TEMPLATE — each
@@ -1053,6 +1085,24 @@ class EngineServer:
                             (-req.priority, req._vft,
                              self._pending_seq, req))
                 continue
+            if self._sched.packing_conflict(req.tokens):
+                # an in-flight packed admission shares this prompt's
+                # leading chunk: beginning NOW would forfeit the APC
+                # match a serial admission gets (the donor has not
+                # spliced yet).  Defer — the pending ticket lands
+                # within a few iterations and the re-pull hits the
+                # warm donor.  Sibling copies of an n>1 request defer
+                # the same way (copy 0 is the in-flight conflict), so
+                # their tail-only prefill economics are unchanged by
+                # packing.
+                if req.admitted > 0:
+                    self._head = req    # partially-admitted n>1 head
+                else:
+                    with self._lock:
+                        heapq.heappush(
+                            self._pending,
+                            (-req.priority, req._vft, req._seq, req))
+                return None
             try:
                 if not req.budget_capped:
                     # cap the admission budget so prompt + generation
@@ -1491,14 +1541,21 @@ class EngineServer:
 
     def warm_scheduler(self) -> None:
         """Pre-compile the scheduler's quantized adaptive-window scan
-        variants.  Every distinct window length is its own XLA
-        compile; without this, the FIRST synchronized batch eats
-        seconds of compile mid-traffic (phase-dependent: whenever the
-        running requests first line up on a grown window).  The CLI
-        and the serving bench call it before taking traffic; tests
-        that never hit grown windows skip the cost.  Call BEFORE
-        start() or while idle — it drives the engine directly."""
+        variants AND the ragged packed-prefill shape set.  Every
+        distinct window length — and every pack size's [K, chunk]
+        extend — is its own XLA compile; without this, the FIRST
+        synchronized batch (or packed convoy) eats seconds of compile
+        mid-traffic.  The CLI and the serving bench call it before
+        taking traffic; tests that never hit grown windows skip the
+        cost.  Call BEFORE start() or while idle — it drives the
+        engine directly.
+
+        Observes ``tpu_serve_warmup_seconds{phase}`` so replica
+        cold-start cost is a dashboard number; with a warm
+        ``--compile-cache-dir`` the phases collapse to cache loads
+        (the cold-start bench asserts the delta)."""
         eng = self.engine
+        t_start = time.perf_counter()
         slot = eng.admit([0], ignore_eos=True)
         try:
             for k in range(1, ADAPTIVE_WINDOW_FACTOR + 1):
@@ -1508,6 +1565,18 @@ class EngineServer:
                 eng.run_scan(n)
         finally:
             eng.release(slot)
+        t_scan = time.perf_counter()
+        self._m_warmup.labels(phase="scan").set(t_scan - t_start)
+        if self._sched._packing:
+            # only when the scheduler can actually pack (chunked
+            # engine, no MoE): a shape the packed path never
+            # dispatches is compile time for nothing
+            eng.warm_packed(
+                range(2, self._sched.max_pack + 1))
+            self._m_warmup.labels(phase="packed_prefill").set(
+                time.perf_counter() - t_scan)
+        self._m_warmup.labels(phase="total").set(
+            time.perf_counter() - t_start)
 
     def _scheduler_supervisor(self) -> None:
         """Crash containment for the engine's sole owner.  A scheduler
@@ -2745,6 +2814,35 @@ class EngineServer:
         return reg.render(openmetrics=openmetrics)
 
 
+def enable_compile_cache(path: str) -> bool:
+    """Point jax's persistent compilation cache at *path* (cross-
+    process: every jit/pjit executable serializes there and later
+    processes LOAD instead of recompiling).  This is what makes a
+    fresh autoscaled replica serving in seconds instead of paying the
+    per-shape warmup storm — the scan-window variants, the packed
+    shape set, and the extend/prefill shapes all land in the cache on
+    the first boot and every subsequent boot (same binary, same
+    config) hits it.  Must run BEFORE any jit compiles (the CLI calls
+    it before building the model).  The entry-size/compile-time floors
+    drop to zero so small CPU executables cache too — the bench's
+    cold-start phase depends on that.  Returns False (logged, never
+    fatal) when the running jax predates the knobs: a missing cache
+    only costs warmup time."""
+    try:
+        import jax as _jax
+
+        _jax.config.update("jax_compilation_cache_dir", path)
+        _jax.config.update(
+            "jax_persistent_cache_min_entry_size_bytes", -1)
+        _jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0.0)
+        return True
+    except Exception as e:  # pragma: no cover - jax-version dependent
+        log.warning("persistent compile cache unavailable (%s); "
+                    "replica cold starts pay full compile time", e)
+        return False
+
+
 def main(argv=None) -> int:
     """CLI: build a Llama-family engine and serve it.  The k8s example
     (example/native-serve/deployment.yaml) runs exactly this."""
@@ -2784,6 +2882,37 @@ def main(argv=None) -> int:
                         "higher admits long prompts faster, lower "
                         "bounds how long a window's harvest can be "
                         "delayed behind prefill")
+    p.add_argument("--packed-prefill", default=True,
+                   action=argparse.BooleanOptionalAction,
+                   help="ragged packed prefill (default on): "
+                        "concurrent admissions' prefill chunks batch "
+                        "into ONE extend dispatch per chunk-round "
+                        "(pack sizes 2..--max-pack, a fixed compiled "
+                        "shape set); outputs byte-identical either "
+                        "way")
+    p.add_argument("--overlap-dispatch", default=True,
+                   action=argparse.BooleanOptionalAction,
+                   help="double-buffered dispatch/harvest (default "
+                        "on): dispatch decode window N+1 before "
+                        "streaming window N so host stream writes "
+                        "overlap device compute; auto-falls back to "
+                        "the serial cadence while any sampled request "
+                        "is live (outputs byte-identical either way)")
+    p.add_argument("--max-pack", type=int, default=DEFAULT_MAX_PACK,
+                   metavar="K",
+                   help="packed-prefill width cap: each pack size in "
+                        "2..K is one compiled extend shape "
+                        "(warm_scheduler pre-compiles the set)")
+    p.add_argument("--compile-cache-dir", default=None, metavar="DIR",
+                   help="persistent cross-process XLA compile cache "
+                        "(env: TPU_DP_COMPILE_CACHE_DIR): first boot "
+                        "fills it, every later boot of the same "
+                        "config loads executables instead of "
+                        "recompiling — a fresh autoscaled replica is "
+                        "serving in seconds instead of paying the "
+                        "per-shape warmup storm.  Mount it on shared "
+                        "or node-local storage that survives pod "
+                        "churn")
     p.add_argument("--schedule-watchdog", type=float, default=0.0,
                    metavar="SECONDS",
                    help="fail a scheduler iteration stuck past this "
@@ -2928,6 +3057,8 @@ def main(argv=None) -> int:
                 f"--max-len {args.max_len}")
     if args.prefill_chunks < 1:
         p.error("--prefill-chunks must be >= 1")
+    if args.max_pack < 2:
+        p.error("--max-pack must be >= 2")
     if args.schedule_watchdog < 0:
         p.error("--schedule-watchdog must be >= 0 (0 disables)")
     if args.checkpoint_step is not None and not args.checkpoint:
@@ -2948,6 +3079,14 @@ def main(argv=None) -> int:
         tenant_quotas = parse_tenant_quotas(args.tenant_quota)
     except ValueError as e:
         p.error(str(e))
+
+    # the persistent compile cache must be configured BEFORE the first
+    # jit (param build included) or early executables miss it
+    import os as _cc_os
+    cache_dir = (args.compile_cache_dir
+                 or _cc_os.environ.get("TPU_DP_COMPILE_CACHE_DIR"))
+    if cache_dir:
+        enable_compile_cache(cache_dir)
 
     quantized = "int4" if args.int4 else args.quantized
     mesh = None
@@ -3025,7 +3164,10 @@ def main(argv=None) -> int:
                        interleave=not args.no_interleave,
                        prefill_chunks=args.prefill_chunks,
                        schedule_watchdog_s=args.schedule_watchdog,
-                       tenant_quotas=tenant_quotas)
+                       tenant_quotas=tenant_quotas,
+                       packed_prefill=args.packed_prefill,
+                       overlap_dispatch=args.overlap_dispatch,
+                       max_pack=args.max_pack)
     if args.fault_spec is not None or args.fault_seed is not None:
         if args.fault_spec is None:
             p.error("--fault-seed needs --fault-spec")
@@ -3037,9 +3179,15 @@ def main(argv=None) -> int:
                        recorder=srv.recorder)
     else:
         faults.install_from_env(recorder=srv.recorder)
-    # pre-compile the adaptive-window scan variants before taking
-    # traffic (each length is its own XLA compile; see warm_scheduler)
+    # pre-compile the adaptive-window scan variants + packed-prefill
+    # shapes before taking traffic (each is its own XLA compile; see
+    # warm_scheduler) — with a warm --compile-cache-dir this is a
+    # cache load, and the printed number is the cold-start bench's
+    # warm-vs-cold evidence
+    t_warm = time.perf_counter()
     srv.warm_scheduler()
+    print(f"warmup {time.perf_counter() - t_warm:.2f}s "
+          f"(compile-cache: {cache_dir or 'off'})", flush=True)
     srv.start(host=args.host, port=args.port)
     if args.register_with:
         srv.start_registration(

@@ -277,6 +277,27 @@ def _copy_page(cache, src, dst):
     return out
 
 
+@jax.jit
+def _pack_minis(minis):
+    """Stack K B=1 admission caches into ONE B=K cache (the ragged
+    packed-prefill batch).  One compiled program per pack size K — the
+    bounded shape set warm_packed pre-compiles — and one host dispatch
+    where K per-leaf concatenations would each be their own.  No
+    donation: a concat's output can never alias its inputs."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.concatenate(xs, axis=0), *minis)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _unpack_minis(cache, k: int):
+    """Split a packed B=K cache back into K B=1 minis (one dispatch,
+    the inverse of :func:`_pack_minis`)."""
+    return tuple(
+        jax.tree_util.tree_map(
+            lambda x: lax.slice_in_dim(x, i, i + 1, axis=0), cache)
+        for i in range(k))
+
+
 def _lcp(a: np.ndarray, b: np.ndarray) -> int:
     """Longest common prefix of two int token arrays."""
     L = min(len(a), len(b))
@@ -542,6 +563,149 @@ def _scan_decode(model, n_steps, sampled, lp_k, pen, rep, seeded,
         jnp.arange(n_steps)
     )
     return ys, cache, counts, seen
+
+
+class _PrefillJob:
+    """One admission prefill, advanced one compiled extend at a time.
+    Host-side state machine shared by EVERY prefill driver — the
+    one-shot ``admit()``, the scheduler's serial ``admit_step``, and
+    the ragged packed path (``admit_step_packed``).  Sharing is what
+    lets packing guarantee byte-identical streams: a packed chunk runs
+    the same operand build (``chunk_np``/``pos_np``) and the same
+    post-extend bookkeeping (``absorb``) as a serial one; only the
+    extend itself is batched, and a batched extend computes each row
+    independently (per-row banded attention over the row's own cache),
+    which the packed equivalence suite pins bit-for-bit.
+
+    ``packable`` gates the batched path: fixed-chunk-grid jobs only (a
+    chunk-None job is one variable-length extend), no prompt-logprob
+    capture (plp rows ride the serial path), and no MoE FFN (expert
+    capacity couples batch rows, so a packed extend is not sworn
+    bit-equal to the B=1 one)."""
+
+    __slots__ = ("eng", "mini", "toks", "start", "aid", "aid_vec", "n",
+                 "c", "total", "i", "last", "plp_k", "plp_out",
+                 "packable", "packed_used", "counted")
+
+    def __init__(self, eng, mini, toks_np: np.ndarray, start: int,
+                 adapter: int, plp_k: int, plp_out: Optional[list]):
+        n = int(toks_np.shape[1])
+        self.eng = eng
+        self.mini = mini
+        self.start = start
+        self.aid = adapter
+        self.aid_vec = eng._adapter_vec(adapter)
+        self.n = n
+        self.plp_k = plp_k
+        self.plp_out = plp_out
+        self.last = None           # extends never prefilled anything
+        self.i = 0
+        self.packed_used = False
+        self.counted = False
+        c = eng.chunk
+        if c is None:
+            # one compiled extend per distinct prompt length — fine
+            # for benchmarks/tests; a chunked engine pins admission to
+            # a single compiled shape
+            self.c = n
+            self.total = 1
+            self.toks = toks_np
+            self.packable = False
+            return
+        padded = ((n + c - 1) // c) * c
+        if start + padded > eng.model.max_len:
+            raise ValueError(
+                f"padded prompt {start + padded} exceeds max_len "
+                f"{eng.model.max_len} (shrink chunk or prompt)")
+        # fixed-size chunks: every chunk reuses ONE compiled extend;
+        # the tail chunk pads with zeros whose K/V land beyond the
+        # true length (fixed by absorb's final cache_lens set) and
+        # whose outputs are discarded
+        self.toks = np.concatenate(
+            [toks_np, np.zeros((1, padded - n), np.int32)], axis=1)
+        self.c = c
+        self.total = padded // c
+        self.packable = (plp_k == 0 and eng.model.n_experts == 0)
+
+    @property
+    def remaining(self) -> int:
+        return self.total - self.i
+
+    def close(self) -> None:
+        """Abandon the job (abort_admit; API parity with the old
+        chunk generator)."""
+        self.i = self.total
+
+    # -- operand build + post-extend bookkeeping (shared verbatim by
+    # the serial and packed paths) ---------------------------------------
+
+    def chunk_np(self) -> np.ndarray:
+        """Host tokens [1, c] for the NEXT chunk."""
+        return self.toks[:, self.i * self.c:(self.i + 1) * self.c]
+
+    def pos_np(self) -> np.ndarray:
+        """Host positions [1, c] for the NEXT chunk."""
+        return (np.arange(self.c, dtype=np.int32)
+                + self.start + self.i * self.c)[None, :]
+
+    def pad_rows(self) -> int:
+        """Zero-pad rows in the NEXT chunk (tail-chunk grid padding —
+        the packed path's waste accounting)."""
+        lo, hi = self.i * self.c, (self.i + 1) * self.c
+        return max(0, hi - max(self.n, lo))
+
+    def charge(self) -> None:
+        """Prefill-token accounting, once per job, at FIRST dispatch
+        (a job aborted before any chunk never ran anything)."""
+        if not self.counted:
+            self.counted = True
+            self.eng._prefill_tokens += self.n
+
+    def absorb_logits(self, logits) -> None:
+        """Post-extend bookkeeping for the chunk just run: *logits* is
+        this job's [c, V] (or [n, V]) row block.  Tracks the last REAL
+        token's logits row and captures plp stats.  The cache side
+        lands separately via :meth:`attach_mini` — the packed path
+        keeps the B=K cache resident across rounds and unpacks once."""
+        i, c = self.i, self.c
+        if self.plp_k:
+            # row j of chunk i scores padded token i*c + j + 1; rows
+            # past the prompt score zeros whose stats are discarded
+            # host-side (prompt_logprobs assembly stops at t_p)
+            tgt = np.zeros(c, np.int32)
+            avail = self.toks.shape[1] - (i * c + 1)
+            if avail > 0:
+                m = min(c, avail)
+                tgt[:m] = self.toks[0, i * c + 1:i * c + 1 + m]
+            self.plp_out.append(
+                _top_logprobs(logits, jnp.asarray(tgt), self.plp_k))
+        off = self.n - 1 - i * c
+        if 0 <= off < c:
+            self.last = logits[off]
+        self.i = i + 1
+
+    def attach_mini(self, mini) -> None:
+        """Adopt the cache that now holds every absorbed chunk; when
+        the job just completed, pin cache_lens back to the true length
+        (chunk padding inflated it — the padded rows' K/V sit beyond
+        it and are overwritten by later appends)."""
+        if self.remaining == 0 and self.eng.chunk is not None:
+            mini = _set_len(mini, jnp.int32(0),
+                            jnp.int32(self.start + self.n))
+        self.mini = mini
+
+    def step(self) -> None:
+        """Advance ONE chunk, unpacked: a single B=1 compiled extend
+        (async dispatch — the host returns before the device
+        finishes)."""
+        eng = self.eng
+        self.charge()
+        logits, mini = extend_step(
+            eng.model, eng.params, self.mini,
+            jnp.asarray(self.chunk_np()), jnp.asarray(self.pos_np()),
+            self.aid_vec)
+        self.absorb_logits(logits[0])
+        self.attach_mini(mini)
 
 
 class AdmitState:
@@ -856,6 +1020,13 @@ class ServingEngine:
         self._prefill_tokens = 0
         self._prefix_hits = 0
         self._prefix_reused_tokens = 0
+        # ragged packed prefill accounting (admit_step_packed): batched
+        # dispatches, chunk-rows they carried, distinct admissions that
+        # rode them, and tail-chunk zero-pad rows they computed
+        self._packed_extends = 0
+        self._packed_rows = 0
+        self._packed_requests = 0
+        self._packed_pad_tokens = 0
         # sampling: per-slot temperature (0 = greedy) and top-k (0 =
         # unrestricted), set at admit; one key stream for the engine
         self._rng = jax.random.PRNGKey(0) if rng is None else rng
@@ -1355,68 +1526,18 @@ class ServingEngine:
         return [s for s in range(self.n_slots)
                 if not self.active[s] and not self._reserved[s]]
 
-    def _extend_prompt_steps(self, mini, toks, start: int,
-                             adapter: int = -1, plp_k: int = 0,
-                             plp_out: Optional[list] = None):
-        """Generator form of :meth:`_extend_prompt`: yields the
-        in-progress ``(mini, last)`` after each dispatched chunk, and
-        the FINAL yield is exactly what ``_extend_prompt`` returns.
-        One implementation serves both the one-shot admit and the
-        iteration scheduler's chunk-at-a-time interleave, so the two
-        cannot drift (chunk decomposition, padding, plp rows, and the
-        final cache_lens fix are byte-for-byte the same ops in the
-        same order)."""
-        n = int(toks.shape[1])
-        aid = self._adapter_vec(adapter)
-        if self.chunk is None:
-            self._prefill_tokens += n
-            # one compiled extend per distinct prompt length — fine for
-            # benchmarks/tests; set ``chunk`` to pin admission to a
-            # single compiled shape
-            pos = (jnp.arange(n, dtype=jnp.int32) + start)[None, :]
-            logits, mini = extend_step(
-                self.model, self.params, mini, toks, pos, aid)
-            if plp_k:
-                tgt = jnp.concatenate(
-                    [toks[0, 1:], jnp.zeros((1,), jnp.int32)])
-                plp_out.append(_top_logprobs(logits[0], tgt, plp_k))
-            yield mini, logits[0, n - 1]
-            return
-        # fixed-size chunks: every chunk reuses ONE compiled extend; the
-        # tail chunk pads with zeros whose K/V land beyond the true
-        # length (fixed below) and whose outputs are discarded
-        c = self.chunk
-        padded = ((n + c - 1) // c) * c
-        if start + padded > self.model.max_len:
-            raise ValueError(
-                f"padded prompt {start + padded} exceeds max_len "
-                f"{self.model.max_len} (shrink chunk or prompt)")
-        toks = jnp.concatenate(
-            [toks, jnp.zeros((1, padded - n), jnp.int32)], axis=1)
-        self._prefill_tokens += n  # after the overflow check: rejected
-        last = None                # extends never prefilled anything
-        if plp_k:
-            # row j of chunk i scores padded token i*c + j + 1: one
-            # extra zero column so the final row's slice exists (its
-            # stats are discarded host-side anyway)
-            toks_ext = jnp.concatenate(
-                [toks, jnp.zeros((1, 1), jnp.int32)], axis=1)
-        for i in range(padded // c):
-            chunk_toks = toks[:, i * c:(i + 1) * c]
-            pos = (
-                jnp.arange(c, dtype=jnp.int32) + start + i * c
-            )[None, :]
-            logits, mini = extend_step(
-                self.model, self.params, mini, chunk_toks, pos, aid)
-            if plp_k:
-                tgt = toks_ext[0, i * c + 1:i * c + c + 1]
-                plp_out.append(_top_logprobs(logits[0], tgt, plp_k))
-            off = n - 1 - i * c
-            if 0 <= off < c:
-                last = logits[0, off]
-            if i + 1 < padded // c:
-                yield mini, last
-        yield _set_len(mini, jnp.int32(0), jnp.int32(start + n)), last
+    def _prefill_job(self, mini, toks, start: int,
+                     adapter: int = -1, plp_k: int = 0,
+                     plp_out: Optional[list] = None) -> "_PrefillJob":
+        """Build the chunk-at-a-time prefill driver for *toks* [1, n]
+        into the B=1 *mini* cache at depth *start*.  ONE implementation
+        (:class:`_PrefillJob`) serves the one-shot admit, the iteration
+        scheduler's serial chunk interleave, AND the ragged packed path
+        (:meth:`admit_step_packed`), so the three cannot drift — chunk
+        decomposition, padding, plp rows, and the final cache_lens fix
+        are byte-for-byte the same ops in the same order."""
+        return _PrefillJob(self, mini, np.asarray(toks, np.int32),
+                           start, adapter, plp_k, plp_out)
 
     def _extend_prompt(self, mini, toks, start: int,
                        adapter: int = -1, plp_k: int = 0,
@@ -1426,12 +1547,11 @@ class ServingEngine:
         With *plp_k*, per-chunk prompt-logprob stats (row j scores the
         NEXT prompt token) are appended to *plp_out* as device arrays
         — same compiled shapes as the extends themselves."""
-        out = None
-        for out in self._extend_prompt_steps(
-                mini, toks, start, adapter=adapter, plp_k=plp_k,
-                plp_out=plp_out):
-            pass
-        return out
+        job = self._prefill_job(mini, toks, start, adapter=adapter,
+                                plp_k=plp_k, plp_out=plp_out)
+        while job.remaining:
+            job.step()
+        return job.mini, job.last
 
     def _draft_prefill(self, prompt):
         """Cold-prefill the draft with the FULL prompt on the engine's
@@ -1958,8 +2078,8 @@ class ServingEngine:
                 # copy before extending: extend_step DONATES its cache,
                 # and the registry entry must survive for the next admit
                 mini = jax.tree_util.tree_map(jnp.copy, pcache)
-                st.gen = self._extend_prompt_steps(
-                    mini, prompt[:, L:], start=L, adapter=aid)
+                st.gen = self._prefill_job(
+                    mini, prompt_np[:, L:], start=L, adapter=aid)
             else:
                 # exact-prefix prompt: no extend runs, and _splice_slot
                 # does not donate its mini argument, so the registry
@@ -2026,12 +2146,12 @@ class ServingEngine:
                 # cache_lens reset; the suffix extend overwrites
                 # [m, ...)
                 mini = _set_len(src, jnp.int32(0), jnp.int32(m))
-                st.gen = self._extend_prompt_steps(
-                    mini, prompt[:, m:], start=m, adapter=aid)
+                st.gen = self._prefill_job(
+                    mini, prompt_np[:, m:], start=m, adapter=aid)
         else:
             mini = self._place_cache(init_cache(self.model, 1))
-            st.gen = self._extend_prompt_steps(
-                mini, prompt, start=0, adapter=aid,
+            st.gen = self._prefill_job(
+                mini, prompt_np, start=0, adapter=aid,
                 plp_k=self.logprobs_k if plp_n else 0,
                 plp_out=st.plp_dev)
         # reservation is the LAST begin-side mutation: everything above
@@ -2049,17 +2169,106 @@ class ServingEngine:
         slide prefill chunks between decode slices."""
         if st.gen is None:
             return False
-        try:
-            st.result = next(st.gen)
-        except StopIteration:
-            st.gen = None
-            return False
+        job = st.gen
+        job.step()
         st.chunks_done += 1
-        if st.chunks_done >= st.chunks_total:
-            st.gen.close()
+        st.result = (job.mini, job.last)
+        if job.remaining == 0:
             st.gen = None
             return False
         return True
+
+    def admit_step_packed(self, states: List[AdmitState],
+                          rounds: int = 1) -> None:
+        """Advance EACH of *states* by *rounds* prefill chunks through
+        batched extends — the ragged packed prefill.  The K B=1
+        admission caches stack ONCE into one B=K cache
+        (``_pack_minis``), every round runs one ``extend_step`` with
+        all K chunks at their own depths (per-row positions, per-row
+        cache_lens — exactly the decode cache's per-slot machinery),
+        and the result splits back ONCE at the end.  Host dispatches
+        per chunk-round drop from K to ~1, the pack/unpack copies
+        amortize over the whole session, and on parallel hardware the
+        K extends share one kernel's MXU pass.
+
+        Byte-identity: each packed row's operands and bookkeeping come
+        from the same :class:`_PrefillJob` methods the serial path
+        uses, and a batched extend computes rows independently — the
+        packed equivalence suite pins streams bit-for-bit against the
+        serial path.  Callers guarantee every state is mid-prefill
+        (``st.gen`` set) and packable, len(states) >= 2, and *rounds*
+        <= every state's remaining chunks; pack sizes form a small
+        fixed compile set (see ``warm_packed``)."""
+        jobs = []
+        for st in states:
+            job = st.gen
+            if job is None or not job.packable or not job.remaining:
+                raise ValueError(
+                    "admit_step_packed needs in-flight packable "
+                    "admissions")
+            jobs.append(job)
+        k = len(jobs)
+        if k < 2:
+            raise ValueError("a pack needs >= 2 admissions")
+        if rounds < 1 or any(j.remaining < rounds for j in jobs):
+            raise ValueError(
+                "rounds must be >= 1 and <= every job's remaining "
+                "chunks")
+        aids = (None if self.model.n_adapters == 0 else
+                jnp.asarray([j.aid for j in jobs], jnp.int32))
+        for job in jobs:
+            job.charge()
+            if not job.packed_used:
+                job.packed_used = True
+                self._packed_requests += 1
+        packed = _pack_minis(tuple(j.mini for j in jobs))
+        for _ in range(rounds):
+            toks = np.concatenate([j.chunk_np() for j in jobs],
+                                  axis=0)
+            pos = np.concatenate([j.pos_np() for j in jobs], axis=0)
+            for job in jobs:
+                self._packed_pad_tokens += job.pad_rows()
+            logits, packed = extend_step(
+                self.model, self.params, packed, jnp.asarray(toks),
+                jnp.asarray(pos), aids)
+            self._packed_extends += 1
+            self._packed_rows += k
+            for i, job in enumerate(jobs):
+                job.absorb_logits(logits[i])
+        minis = _unpack_minis(packed, k)
+        for i, (st, job) in enumerate(zip(states, jobs)):
+            job.attach_mini(minis[i])
+            st.chunks_done += rounds
+            st.result = (job.mini, job.last)
+            if job.remaining == 0:
+                st.gen = None
+
+    def warm_packed(self, sizes) -> None:
+        """Pre-compile the packed-prefill shape set: one throwaway
+        packed extend per pack size in *sizes* (each [K, chunk] shape
+        is its own XLA compile — without this the first packed convoy
+        eats the compile mid-traffic).  No engine state is touched;
+        unchunked engines have no packed path and return immediately."""
+        if self.chunk is None:
+            return
+        c = self.chunk
+        out = None
+        for k in sorted(set(int(s) for s in sizes)):
+            if k < 2:
+                continue
+            minis = tuple(self._place_cache(init_cache(self.model, 1))
+                          for _ in range(k))
+            toks = jnp.zeros((k, c), jnp.int32)
+            pos = jnp.broadcast_to(
+                jnp.arange(c, dtype=jnp.int32), (k, c))
+            aids = (None if self.model.n_adapters == 0 else
+                    jnp.zeros((k,), jnp.int32))
+            packed = _pack_minis(minis)
+            out, packed = extend_step(
+                self.model, self.params, packed, toks, pos, aids)
+            _unpack_minis(packed, k)
+        if out is not None:
+            jax.block_until_ready(out)
 
     def abort_admit(self, st: AdmitState) -> None:
         """Abandon an in-flight admission (client went away before its
@@ -3016,6 +3225,16 @@ class ServingEngine:
         self._inflight_scan = handle
         return handle
 
+    def scan_abandon(self, handle: _ScanHandle) -> None:
+        """Drop a dispatched-but-unharvested window WITHOUT its host
+        bookkeeping (the crash-supervisor / supersede path when a
+        dispatch-ahead window is outstanding).  The device futures are
+        discarded; the affected slots' cache state is suspect — the
+        caller releases every slot, exactly as it does after any other
+        mid-iteration crash."""
+        if self._inflight_scan is handle:
+            self._inflight_scan = None
+
     def scan_harvest(self, handle: _ScanHandle) -> Dict[int, List[int]]:
         """Materialize a dispatched window's tokens (the window's ONE
         blocking sync) and run the host bookkeeping for every slot that
@@ -3027,10 +3246,15 @@ class ServingEngine:
         sampled, lp_k = handle.sampled, handle.lp_k
         grammared = handle.grammared
         skip = handle.skip
-        # "in the window AND not yet retired" — with no mid-window
-        # admissions this is exactly the dispatch-time active set, so
-        # run_scan behaves as it always did
-        live = [handle.active[s] and self.active[s]
+        # "in the window AND not yet retired AND not skip" — with no
+        # mid-window admissions this is exactly the dispatch-time
+        # active set, so run_scan behaves as it always did.  A skip
+        # slot sat the window out BY DEFINITION: under dispatch-ahead
+        # overlap a slot can be released and RE-admitted while the
+        # window runs (active at dispatch AND active now, but the
+        # column belongs to the old occupant), so membership in skip —
+        # not the active snapshots — is what excludes its tokens
+        live = [handle.active[s] and self.active[s] and s not in skip
                 for s in range(self.n_slots)]
         toks = np.asarray(ys[0], dtype=np.int32)  # [n_steps, S]
         if lp_k:
@@ -3110,12 +3334,15 @@ class ServingEngine:
                                 self.reps[m])):
                 draws_used += 1
             if lp_k:
-                self._harvest_logprobs(clps[i], tlps[i], tids[i],
-                                       eligible=handle.active)
+                self._harvest_logprobs(
+                    clps[i], tlps[i], tids[i],
+                    eligible=[handle.active[s] and s not in skip
+                              for s in range(self.n_slots)])
             for s in range(self.n_slots):
                 if s not in skip:
                     self.lens[s] += 1
-                if not (handle.active[s] and self.active[s]):
+                if s in skip or not (handle.active[s]
+                                     and self.active[s]):
                     continue
                 tok = int(toks[i, s])
                 if grammared and self.gstate[s] >= 0:
@@ -3192,6 +3419,10 @@ class ServingEngine:
             "jump_rounds": self._jump_rounds,
             "jump_forced_tokens": self._jump_forced,
             "prefix_evictions": self._prefix_evictions,
+            "packed_prefill_extends": self._packed_extends,
+            "packed_prefill_rows": self._packed_rows,
+            "packed_prefill_requests": self._packed_requests,
+            "packed_prefill_pad_tokens": self._packed_pad_tokens,
         }
         if self._paged:
             assert self._pool is not None
@@ -3201,6 +3432,13 @@ class ServingEngine:
 
     def release(self, slot: int) -> None:
         """Free a slot (abandons any in-flight generation)."""
+        if self._inflight_scan is not None:
+            # released while a dispatched window is open (possible only
+            # under the scheduler's dispatch-ahead overlap): harvest
+            # must not advance lens/chains release just reset — the
+            # slot sat the rest of the window out, same contract as a
+            # mid-window splice
+            self._inflight_scan.skip.add(slot)
         self.active[slot] = False
         self._finished.pop(slot, None)
         self._finish_reason.pop(slot, None)
