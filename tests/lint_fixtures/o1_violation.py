@@ -1,6 +1,8 @@
 """O1 seeded violations: a family constructed outside any Registry, a
-counter without its _total suffix, and an unbounded-cardinality
-label at the definition site."""
+counter without its _total suffix, an unbounded-cardinality label at
+the definition site, a request-supplied identity label, and a
+tpu_slo_* family defined outside obs.slo (the module whose
+SLOAccountant bounds class/tenant label values)."""
 
 from tpu_k8s_device_plugin import obs
 
@@ -13,4 +15,10 @@ def build(reg):
     leaky = reg.gauge("tpu_fixture_inflight",
                       "per-request label cardinality",
                       ("request_id",))
-    return direct, unsuffixed, leaky
+    identity = reg.counter("tpu_fixture_calls_total",
+                           "caller-chosen identity as a label",
+                           ("user",))
+    rogue_slo = reg.counter("tpu_slo_rogue_total",
+                            "tpu_slo_* family outside obs.slo",
+                            ("met",))
+    return direct, unsuffixed, leaky, identity, rogue_slo

@@ -632,8 +632,10 @@ def test_statz_lockstep_with_metrics(engine_stack):
     conn.close()
     assert set(statz) == {
         "scheduler_alive", "queue_depth", "in_flight", "capacity",
-        "kv_pages", "kv_pages_free", "requests_served", "shed"}
+        "kv_pages", "kv_pages_free", "requests_served", "shed",
+        "goodput"}
     assert set(statz["shed"]) == {"connections", "queue", "quota"}
+    assert set(statz["goodput"]) == {"window_s", "classes"}
     samples = obs.parse_exposition(srv.render_metrics())
 
     def metric(name):
@@ -683,3 +685,91 @@ def test_router_429_passthrough_not_failover(engine_stack):
         and lab.get("replica") == "r0"
         and lab.get("outcome") == "shed"])
     assert shed and shed[0] >= 1
+
+
+def _raw_get_json(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    out = json.loads(resp.read())
+    conn.close()
+    return resp.status, out
+
+
+def test_router_stitches_trace_across_processes(engine_stack):
+    """PR 12 acceptance: ONE traceparent driven through router ->
+    replica -> scheduler window must come back from the ROUTER's
+    /debug/traces as a single stitched span tree — the router's
+    route/proxy events as the parent span, the replica's admit/window
+    events as its child, in causal order."""
+    srv, rt = engine_stack
+    trace = obs.new_trace()
+    st, headers, _ = _raw_post(
+        rt.port, {"tokens": [11, 12, 13], "max_new_tokens": 4},
+        headers={"traceparent": trace.to_traceparent()})
+    assert st == 200
+    st, stitched = _raw_get_json(
+        rt.port, f"/debug/traces?trace_id={trace.trace_id}")
+    assert st == 200
+    assert stitched["trace_id"] == trace.trace_id
+    tree = stitched["tree"]
+    assert len(tree) == 1                    # ONE root: the router hop
+    root = tree[0]
+    assert root["source"] == "router"
+    root_names = [e["name"] for e in root["events"]]
+    assert "tpu_router_routed" in root_names
+    assert "tpu_router_proxy" in root_names
+    # the replica's span is a CHILD of the router's (the traceparent
+    # hop made it so), tagged with the replica id by the stitcher
+    assert len(root["children"]) == 1
+    kid = root["children"][0]
+    assert kid["source"] == "r0"
+    assert kid["parent_id"] == root["span_id"]
+    kid_names = [e["name"] for e in kid["events"]]
+    assert "tpu_serve_admit" in kid_names
+    assert "tpu_serve_window" in kid_names
+    # causal order in the depth-first flatten: route decision before
+    # the replica's admit, admit before its first decode window
+    flat = [e["name"] for e in obs.flatten(tree)]
+    assert flat.index("tpu_router_routed") \
+        < flat.index("tpu_serve_admit") \
+        < flat.index("tpu_serve_window")
+    # without ?trace_id= the router serves its own recent-trace index
+    st, index = _raw_get_json(rt.port, "/debug/traces")
+    assert st == 200
+    assert any(t["trace_id"] == trace.trace_id
+               for t in index["traces"])
+
+
+def test_fleet_statz_aggregates_replicas(engine_stack):
+    """/fleet/statz: per-replica statz plus fleet-level sums and
+    goodput re-derived from summed met/total counts."""
+    srv, rt = engine_stack
+    # traffic so the goodput block is non-trivial, then wait for the
+    # poller to refresh the cached statz past it
+    _raw_post(srv.port, {"tokens": [21, 22], "max_new_tokens": 2,
+                         "slo_class": "interactive"})
+    served = srv.statz()["goodput"]["classes"]["interactive"]["met"]
+    assert served >= 1
+    deadline = time.time() + 10
+    fleet = {}
+    while time.time() < deadline:
+        st, fleet = _raw_get_json(rt.port, "/fleet/statz")
+        assert st == 200
+        cls = fleet["fleet"]["goodput"].get("interactive", {})
+        if cls.get("met", 0) >= served:
+            break
+        time.sleep(0.1)
+    assert fleet["replicas"] == 1
+    assert fleet["healthy"] == 1
+    assert set(fleet["per_replica"]) == {"r0"}
+    assert fleet["per_replica"]["r0"]["healthy"] is True
+    # the aggregate re-states the one replica's statz
+    statz = srv.statz()
+    assert fleet["fleet"]["capacity"] == statz["capacity"]
+    assert fleet["fleet"]["requests_served"] <= \
+        statz["requests_served"]
+    cls = fleet["fleet"]["goodput"]["interactive"]
+    assert cls["met"] >= served
+    assert 0.0 <= cls["goodput_ratio"] <= 1.0
+    assert "burn_rate_max" in cls

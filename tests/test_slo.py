@@ -1,0 +1,508 @@
+"""SLO/goodput accounting, window-phase breakdown, trace stitching,
+profiling hook, and flight-dump GC (PR 12).
+
+Four layers:
+
+1. Pure units (no jax): the --slo grammar, policy verdicts, label
+   BOUNDING (free-form class/tenant names collapse to 'other'), the
+   rolling window, burn-rate math, the stitch re-linker, and the
+   flight-recorder dump GC.
+2. tools/obs_query.py against dump FILES: the same span tree the
+   router serves live must render from post-mortem dumps alone.
+3. Real-engine server e2e (jax, tiny decoder): every terminal request
+   lands in tpu_slo_requests_total, the /statz goodput block agrees
+   with a hand-computed goodput from the client's own TTFT
+   observations, the window-phase families and duty-cycle gauge are
+   live, /debug/profile captures a jax.profiler trace, and every new
+   family is promlint-clean in BOTH exposition modes.
+"""
+
+import http.client
+import json
+import os
+import time
+
+import pytest
+
+from tpu_k8s_device_plugin import obs
+from tpu_k8s_device_plugin.obs.slo import (
+    DEFAULT_TENANT_LABEL,
+    OTHER_LABEL,
+    SLOAccountant,
+    SLOPolicy,
+    default_slo_policies,
+    parse_slo_specs,
+)
+
+# ---------------------------------------------------------------------------
+# layer 1a: the --slo grammar + policy verdicts
+
+
+def test_parse_slo_specs_grammar():
+    out = parse_slo_specs(["interactive=250", "batch=0:60000",
+                           "both=100:5000"])
+    assert out["interactive"].ttft_ms == 250.0
+    assert out["interactive"].deadline_ms is None
+    assert out["batch"].ttft_ms is None
+    assert out["batch"].deadline_ms == 60000.0
+    assert out["both"].ttft_ms == 100.0
+    assert out["both"].deadline_ms == 5000.0
+    assert parse_slo_specs(None) == {}
+    for bad in ("noequals", "=250", "c=", "c=1:2:3", "c=abc",
+                "c=0:0"):
+        with pytest.raises(ValueError):
+            parse_slo_specs([bad])
+
+
+def test_policy_verdicts():
+    ttft = SLOPolicy("i", ttft_ms=100.0)
+    assert ttft.met(0.05, 99.0)          # ttft under, total ignored
+    assert not ttft.met(0.2, 0.2)        # ttft over
+    assert not ttft.met(None, 0.0)       # never streamed a token
+    dl = SLOPolicy("b", deadline_ms=1000.0)
+    assert dl.met(None, 0.5)             # no ttft target
+    assert not dl.met(0.001, 1.5)        # deadline blown
+    both = SLOPolicy("x", ttft_ms=100.0, deadline_ms=1000.0)
+    assert both.met(0.05, 0.5)
+    assert not both.met(0.05, 2.0)
+    with pytest.raises(ValueError):
+        SLOPolicy("empty")               # needs at least one target
+    with pytest.raises(ValueError):
+        SLOPolicy("bad", ttft_ms=1.0, objective=1.5)
+
+
+# ---------------------------------------------------------------------------
+# layer 1b: the accountant — bounding, window, burn rate
+
+
+def _accountant(**kw):
+    reg = obs.Registry()
+    kw.setdefault("policies", default_slo_policies())
+    return reg, SLOAccountant(reg, **kw)
+
+
+def test_class_and_tenant_label_values_are_bounded():
+    """Free-form request-supplied names must NEVER mint a label value:
+    unknown classes and tenants collapse to 'other' (the O1 contract
+    this module is the runtime half of)."""
+    reg, acc = _accountant(tenants=["paid", "free"])
+    acc.record("interactive", "paid", ttft_s=0.01, total_s=0.1,
+               ok=True)
+    acc.record("../../etc/passwd", "mallory-" + "x" * 100,
+               ttft_s=0.01, total_s=0.1, ok=True)
+    acc.record("", "", ttft_s=0.01, total_s=0.1, ok=True)
+    samples = obs.parse_exposition(reg.render())
+    seen_classes = {lab["class"] for n, lab, v in samples
+                    if n == "tpu_slo_requests_total"}
+    seen_tenants = {lab["tenant"] for n, lab, v in samples
+                    if n == "tpu_slo_requests_total"}
+    assert seen_classes == {"interactive", OTHER_LABEL}
+    assert seen_tenants == {"paid", OTHER_LABEL,
+                            DEFAULT_TENANT_LABEL}
+
+
+def test_classless_request_lands_under_fallback():
+    reg, acc = _accountant()
+    acc.record(None, None, ttft_s=None, total_s=0.1, ok=True,
+               fallback="batch")
+    samples = obs.parse_exposition(reg.render())
+    rows = [(lab["class"], lab["met"]) for n, lab, v in samples
+            if n == "tpu_slo_requests_total"]
+    assert rows == [("batch", "true")]
+
+
+def test_non_ok_outcome_never_meets():
+    reg, acc = _accountant()
+    met = acc.record("interactive", "", ttft_s=0.0001, total_s=0.001,
+                     ok=False)
+    assert met is False
+    assert acc.summary()["classes"]["interactive"]["met"] == 0
+
+
+def test_goodput_ratio_and_burn_rate():
+    reg, acc = _accountant(policies={
+        "i": SLOPolicy("i", ttft_ms=100.0, objective=0.9)})
+    for _ in range(8):
+        acc.record("i", "", ttft_s=0.01, total_s=0.1, ok=True)
+    for _ in range(2):
+        acc.record("i", "", ttft_s=9.9, total_s=10.0, ok=True)
+    row = acc.summary()["classes"]["i"]
+    assert row["total"] == 10 and row["met"] == 8
+    assert row["goodput_ratio"] == pytest.approx(0.8)
+    # miss rate 0.2 over the 0.1 budget = burning 2x
+    assert row["burn_rate"] == pytest.approx(2.0)
+    # the gauges tell the same story after a scrape
+    samples = obs.parse_exposition(reg.render())
+    by = {(n, lab.get("class")): v for n, lab, v in samples}
+    assert by[("tpu_slo_goodput_ratio", "i")] == pytest.approx(0.8)
+    assert by[("tpu_slo_error_budget_burn_rate", "i")] == \
+        pytest.approx(2.0)
+
+
+def test_rolling_window_expires_old_requests():
+    reg, acc = _accountant(window_s=0.05)
+    acc.record("interactive", "", ttft_s=0.01, total_s=0.1, ok=True)
+    assert acc.summary()["classes"]["interactive"]["window_total"] == 1
+    time.sleep(0.08)
+    row = acc.summary()["classes"]["interactive"]
+    assert row["window_total"] == 0
+    assert row["goodput_ratio"] == 1.0   # empty window: not burning
+    assert row["total"] == 1             # lifetime totals remain
+
+
+def test_slo_families_promlint_clean_both_modes():
+    import tools.promlint as promlint
+
+    reg, acc = _accountant()
+    acc.record("interactive", "", ttft_s=0.01, total_s=0.1, ok=True)
+    assert promlint.lint(reg.render()) == []
+    assert promlint.lint(reg.render(openmetrics=True)) == []
+
+
+# ---------------------------------------------------------------------------
+# layer 1c: stitch re-linker
+
+
+def _ev(name, trace, t, source=""):
+    d = {"name": name, "trace_id": trace.trace_id,
+         "span_id": trace.span_id,
+         "parent_id": trace.parent_id or "", "t_wall": t,
+         "t_mono": t, "attrs": {}}
+    if source:
+        d["source"] = source
+    return d
+
+
+def test_stitch_links_child_span_under_parent():
+    root = obs.new_trace()          # the router's context
+    child = root.child()            # the replica continued it
+    events = [
+        _ev("tpu_serve_admit", child, 2.0, source="r0"),
+        _ev("tpu_router_routed", root, 1.0, source="router"),
+        _ev("tpu_serve_window", child, 3.0, source="r0"),
+        _ev("tpu_router_proxy", root, 4.0, source="router"),
+    ]
+    tree = obs.stitch(events)
+    assert len(tree) == 1
+    node = tree[0]
+    assert node["source"] == "router"
+    assert [e["name"] for e in node["events"]] == [
+        "tpu_router_routed", "tpu_router_proxy"]
+    assert len(node["children"]) == 1
+    kid = node["children"][0]
+    assert kid["source"] == "r0"
+    assert kid["parent_id"] == root.span_id
+    assert [e["name"] for e in kid["events"]] == [
+        "tpu_serve_admit", "tpu_serve_window"]
+    # depth-first flatten = the causal read order
+    flat = [e["name"] for e in obs.flatten(tree)]
+    assert flat.index("tpu_router_routed") \
+        < flat.index("tpu_serve_admit") \
+        < flat.index("tpu_serve_window")
+
+
+def test_stitch_tolerates_parentless_legacy_events():
+    """Events from pre-parent_id dumps still stitch (as roots)."""
+    tree = obs.stitch([
+        {"name": "old", "trace_id": "t", "span_id": "s1",
+         "t_wall": 1.0, "attrs": {}},
+        {"name": "older", "trace_id": "t", "span_id": "s2",
+         "t_wall": 0.5, "attrs": {}},
+    ])
+    assert [n["events"][0]["name"] for n in tree] == ["older", "old"]
+    text = obs.render_tree(tree)
+    assert "old" in text and "older" in text
+
+
+# ---------------------------------------------------------------------------
+# layer 1d: flight-recorder dump GC
+
+
+def test_dump_gc_keeps_newest_k(tmp_path):
+    reg = obs.Registry()
+    rec = obs.FlightRecorder(capacity=16, registry=reg, dump_keep=3)
+    rec.record("something")
+    # 5 pre-existing dumps from prior crashed incarnations
+    for i in range(5):
+        p = tmp_path / f"flight-100-{1000 + i}.jsonl"
+        p.write_text("{}\n")
+        os.utime(p, (1000 + i, 1000 + i))
+    new_path = rec.dump_to_dir(str(tmp_path))
+    assert new_path is not None
+    left = sorted(f for f in os.listdir(tmp_path)
+                  if f.startswith("flight-"))
+    assert len(left) == 3
+    # the newest survive: the fresh dump + the two youngest old ones
+    assert os.path.basename(new_path) in left
+    assert "flight-100-1004.jsonl" in left
+    assert "flight-100-1003.jsonl" in left
+    assert rec.dump_gc_count == 3
+    samples = obs.parse_exposition(reg.render())
+    assert ("tpu_flight_dump_gc_total", {}, 3.0) in samples
+
+
+def test_dump_gc_spares_other_files(tmp_path):
+    rec = obs.FlightRecorder(capacity=16, dump_keep=1)
+    rec.record("x")
+    keepers = ["faulthandler-1.log", "notes.txt"]
+    for name in keepers:
+        (tmp_path / name).write_text("keep me\n")
+    for i in range(3):
+        p = tmp_path / f"flight-7-{i}.jsonl"
+        p.write_text("{}\n")
+        os.utime(p, (100 + i, 100 + i))
+    rec.dump_to_dir(str(tmp_path))
+    left = set(os.listdir(tmp_path))
+    for name in keepers:
+        assert name in left
+    assert sum(1 for f in left if f.startswith("flight-")) == 1
+
+
+# ---------------------------------------------------------------------------
+# layer 2: obs_query over dump files
+
+import sys  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tools import obs_query  # noqa: E402
+
+
+def test_obs_query_stitches_from_dumps(tmp_path, capsys):
+    """The acceptance path: after the processes die, their dump files
+    alone must reproduce the stitched tree — router events in one
+    dump, replica events in another, re-linked by parent_id."""
+    root = obs.new_trace()
+    child = root.child()
+    router_rec = obs.FlightRecorder(capacity=64)
+    router_rec.record("tpu_router_routed", trace=root, replica="r0")
+    router_rec.record("tpu_router_proxy", trace=root, outcome="ok")
+    replica_rec = obs.FlightRecorder(capacity=64)
+    replica_rec.record("tpu_serve_admit", trace=child, slot=0)
+    replica_rec.record("tpu_serve_window", trace=child, tokens=4)
+    rdir = tmp_path / "router"
+    pdir = tmp_path / "replica"
+    assert router_rec.dump_to_dir(str(rdir))
+    assert replica_rec.dump_to_dir(str(pdir))
+
+    rc = obs_query.main(["--trace-id", root.trace_id,
+                         "--dump", str(rdir), "--dump", str(pdir)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for name in ("tpu_router_routed", "tpu_router_proxy",
+                 "tpu_serve_admit", "tpu_serve_window"):
+        assert name in out
+    # the replica span renders NESTED under the router span
+    router_line = next(ln for ln in out.splitlines()
+                       if ln.lstrip().startswith(
+                           f"span {root.span_id[:16]}"))
+    child_line = next(ln for ln in out.splitlines()
+                      if ln.lstrip().startswith(
+                          f"span {child.span_id[:16]}"))
+    indent = len(child_line) - len(child_line.lstrip())
+    assert indent > len(router_line) - len(router_line.lstrip())
+    # JSON mode round-trips the same tree
+    rc = obs_query.main(["--trace-id", root.trace_id,
+                         "--dump", str(rdir), "--dump", str(pdir),
+                         "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["events"] == 4
+    assert payload["tree"][0]["children"][0]["span_id"] == \
+        child.span_id
+
+
+def test_obs_query_time_range_mode(tmp_path, capsys):
+    rec = obs.FlightRecorder(capacity=16)
+    rec.record("early", note="a")
+    rec.record("late", note="b")
+    events = rec.events()
+    cut = events[0]["t_wall"]
+    rec.dump_to_dir(str(tmp_path))
+    rc = obs_query.main(["--dump", str(tmp_path),
+                         "--since", str(cut)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "late" in out and "early" not in out
+    # an empty result exits nonzero (scripts can branch on it)
+    rc = obs_query.main(["--dump", str(tmp_path), "--name",
+                         "no-such-event"])
+    capsys.readouterr()
+    assert rc == 1
+
+
+# ---------------------------------------------------------------------------
+# layer 3: real-engine server e2e
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from tpu_k8s_device_plugin.workloads.inference import make_decoder  # noqa: E402
+from tpu_k8s_device_plugin.workloads.server import EngineServer  # noqa: E402
+from tpu_k8s_device_plugin.workloads.serving import ServingEngine  # noqa: E402
+
+CFG = dict(vocab=128, d_model=64, n_heads=4, n_layers=2, d_ff=128)
+
+
+@pytest.fixture(scope="module")
+def slo_server(tmp_path_factory):
+    model = make_decoder(**CFG, max_len=64, dtype=jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+    params = model.init(rng, tokens, pos)["params"]
+    eng = ServingEngine(model, params, n_slots=2)
+    profile_dir = str(tmp_path_factory.mktemp("profiles"))
+    # one generous class (everything meets) and one impossible class
+    # (nothing can): borderline-free, so the hand-computed goodput
+    # below must agree EXACTLY with the server's accounting
+    srv = EngineServer(
+        eng, max_new_tokens=8, window=4,
+        slo_policies=parse_slo_specs(
+            ["lenient=60000:600000", "impossible=0.0001"]),
+        profile_dir=profile_dir)
+    srv.start(host="127.0.0.1", port=0)
+    yield srv
+    srv.stop()
+
+
+def _post(port, payload):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    t0 = time.perf_counter()
+    conn.request("POST", "/generate", json.dumps(payload),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    first_line_at = None
+    for line in resp:
+        if line.strip() and first_line_at is None:
+            first_line_at = time.perf_counter() - t0
+    conn.close()
+    return resp.status, first_line_at
+
+
+def _get_json(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    out = json.loads(resp.read())
+    conn.close()
+    return resp.status, out
+
+
+def test_server_goodput_agrees_with_hand_computed(slo_server):
+    srv = slo_server
+    client_ttfts = []
+    for _ in range(3):
+        st, ttft = _post(srv.port, {
+            "tokens": [5, 6, 7], "max_new_tokens": 4,
+            "slo_class": "lenient"})
+        assert st == 200
+        client_ttfts.append(ttft)
+    for _ in range(2):
+        st, _ = _post(srv.port, {
+            "tokens": [9, 9], "max_new_tokens": 4,
+            "slo_class": "impossible"})
+        assert st == 200
+    # hand-computed goodput from the client's own recorded TTFTs:
+    # every lenient TTFT is under its 60s target, no impossible TTFT
+    # can beat 0.0001ms — the server's families must agree exactly
+    hand_met = {
+        "lenient": sum(1 for t in client_ttfts if t is not None
+                       and t * 1000.0 <= 60000.0),
+        "impossible": 0,
+    }
+    assert hand_met["lenient"] == 3
+    samples = obs.parse_exposition(srv.render_metrics())
+    counts = {}
+    for n, lab, v in samples:
+        if n == "tpu_slo_requests_total":
+            counts[(lab["class"], lab["met"])] = v
+    assert counts.get(("lenient", "true"), 0) == hand_met["lenient"]
+    assert ("lenient", "false") not in counts
+    assert counts.get(("impossible", "false"), 0) == 2
+    assert ("impossible", "true") not in counts
+    # /statz carries the same truth in its fixed goodput schema
+    _, statz = _get_json(srv.port, "/statz")
+    g = statz["goodput"]["classes"]
+    assert g["lenient"]["met"] == 3
+    assert g["lenient"]["goodput_ratio"] == 1.0
+    assert g["impossible"]["total"] == 2
+    assert g["impossible"]["met"] == 0
+    assert g["impossible"]["goodput_ratio"] == 0.0
+    assert g["impossible"]["burn_rate"] == pytest.approx(
+        1.0 / (1.0 - 0.99))
+    # the goodput gauges agree with the summary after a scrape
+    by = {(n, lab.get("class")): v
+          for n, lab, v in obs.parse_exposition(srv.render_metrics())}
+    assert by[("tpu_slo_goodput_ratio", "lenient")] == 1.0
+    assert by[("tpu_slo_goodput_ratio", "impossible")] == 0.0
+
+
+def test_server_bounds_request_supplied_names(slo_server):
+    srv = slo_server
+    st, _ = _post(srv.port, {
+        "tokens": [1, 2, 3], "max_new_tokens": 2,
+        "slo_class": "free-form-$$$", "tenant": "mallory"})
+    assert st == 200
+    samples = obs.parse_exposition(srv.render_metrics())
+    labels = [lab for n, lab, v in samples
+              if n == "tpu_slo_requests_total"]
+    assert all(lab["class"] in ("lenient", "impossible", OTHER_LABEL)
+               for lab in labels)
+    assert any(lab["class"] == OTHER_LABEL
+               and lab["tenant"] == OTHER_LABEL for lab in labels)
+
+
+def test_window_phase_families_and_duty_cycle(slo_server):
+    srv = slo_server
+    _post(srv.port, {"tokens": [4, 4, 4], "max_new_tokens": 6})
+    samples = obs.parse_exposition(srv.render_metrics())
+    phase_counts = {
+        lab["phase"]: v for n, lab, v in samples
+        if n == "tpu_serve_window_phase_seconds_count"}
+    assert set(phase_counts) == {"dispatch", "harvest", "stream",
+                                 "idle"}
+    for phase in ("dispatch", "harvest", "stream"):
+        assert phase_counts[phase] > 0, phase
+    duty = [v for n, lab, v in samples
+            if n == "tpu_serve_device_duty_cycle"]
+    assert len(duty) == 1 and 0.0 <= duty[0] <= 1.0
+
+
+def test_debug_profile_captures_trace(slo_server):
+    srv = slo_server
+    st, out = _get_json(srv.port, "/debug/profile?seconds=0.2")
+    assert st == 200 and out["ok"] is True
+    assert os.listdir(srv.profile_dir)  # the profiler wrote something
+    # bad inputs answer 400, not a stack trace
+    st, out = _get_json(srv.port, "/debug/profile?seconds=9999")
+    assert st == 400
+    st, out = _get_json(srv.port, "/debug/profile?seconds=abc")
+    assert st == 400
+    samples = obs.parse_exposition(srv.render_metrics())
+    assert ("tpu_serve_profile_captures_total", {}, 1.0) in samples
+
+
+def test_debug_profile_requires_profile_dir():
+    model = make_decoder(**CFG, max_len=32, dtype=jnp.float32)
+    rng = jax.random.PRNGKey(1)
+    tokens = jnp.zeros((1, 4), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(4, dtype=jnp.int32), (1, 4))
+    params = model.init(rng, tokens, pos)["params"]
+    srv = EngineServer(ServingEngine(model, params, n_slots=1),
+                       max_new_tokens=4, window=2)
+    srv.start(host="127.0.0.1", port=0)
+    try:
+        st, out = _get_json(srv.port, "/debug/profile?seconds=0.1")
+        assert st == 400
+        assert "--profile-dir" in out["error"]
+    finally:
+        srv.stop()
+
+
+def test_server_metrics_promlint_clean_both_modes(slo_server):
+    import tools.promlint as promlint
+
+    srv = slo_server
+    assert promlint.lint(srv.render_metrics()) == []
+    assert promlint.lint(srv.render_metrics(openmetrics=True)) == []

@@ -1,0 +1,219 @@
+"""obs_query: query live /debug endpoints AND flight-recorder dumps.
+
+The fleet's post-mortem companion: one trace-id (or time range) in,
+one merged view out — whether the processes that produced the events
+are still alive (live ``/debug/traces`` / ``/debug/events`` endpoints
+on routers, replicas, and device plugins) or already dead (their
+``--flight-record-dir`` JSON-lines dumps).  Events from every source
+are merged, deduplicated, and — in trace-id mode — re-linked into the
+same span tree the router's stitched ``/debug/traces`` serves, via the
+``parent_id`` each hop's traceparent stamped.
+
+Examples::
+
+    # a live fleet: router + 2 replicas
+    python tools/obs_query.py --trace-id 4bf9... \
+        --endpoint http://router:8100 \
+        --endpoint http://rep0:8000 --endpoint http://rep1:8000
+
+    # the same trace after a replica died: its dump has its half
+    python tools/obs_query.py --trace-id 4bf9... \
+        --endpoint http://router:8100 \
+        --dump /var/lib/tpu-flight-records/
+
+    # what happened in the last minute before the crash?
+    python tools/obs_query.py --dump flight-43-1754300612.jsonl \
+        --since 1754300550 --until 1754300612
+
+Dependency-free (stdlib + the stdlib-only ``obs`` package), like
+every tool in this repo.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+from urllib.parse import quote
+from urllib.request import urlopen
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:  # `python tools/obs_query.py` from anywhere
+    sys.path.insert(0, _REPO_ROOT)
+
+from tpu_k8s_device_plugin import obs  # noqa: E402
+
+
+def _fetch_json(url: str, timeout_s: float) -> Optional[dict]:
+    try:
+        with urlopen(url, timeout=timeout_s) as resp:
+            out = json.loads(resp.read())
+        return out if isinstance(out, dict) else None
+    except (OSError, ValueError) as e:
+        print(f"obs_query: {url}: {e}", file=sys.stderr)
+        return None
+
+
+def fetch_endpoint(base: str, trace_id: Optional[str],
+                   since: float, timeout_s: float
+                   ) -> List[Dict[str, object]]:
+    """One live endpoint's events: /debug/traces?trace_id= in trace
+    mode, /debug/events?since= in time-range mode."""
+    base = base.rstrip("/")
+    if trace_id:
+        url = (f"{base}/debug/traces"
+               f"?trace_id={quote(trace_id, safe='')}")
+    else:
+        url = f"{base}/debug/events?since={since}"
+    out = _fetch_json(url, timeout_s)
+    if out is None:
+        return []
+    events = out.get("events")
+    if not isinstance(events, list):
+        # the router's stitched shape: flatten its tree back to events
+        tree = out.get("tree")
+        if isinstance(tree, list):
+            return [dict(e, _origin=base)
+                    for e in obs.flatten(tree)]
+        return []
+    return [dict(e, _origin=base) for e in events
+            if isinstance(e, dict)]
+
+
+def read_dump(path: str) -> List[Dict[str, object]]:
+    """One flight-recorder dump file (JSON-lines; header line skipped),
+    or every flight-*.jsonl in a directory."""
+    if os.path.isdir(path):
+        out: List[Dict[str, object]] = []
+        for name in sorted(os.listdir(path)):
+            if name.startswith("flight-") and name.endswith(".jsonl"):
+                out.extend(read_dump(os.path.join(path, name)))
+        return out
+    events: List[Dict[str, object]] = []
+    origin = os.path.basename(path)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue  # truncated tail of a crash-time dump
+                if not isinstance(ev, dict) or "name" not in ev:
+                    continue  # the header line, or foreign JSON
+                ev["_origin"] = origin
+                events.append(ev)
+    except OSError as e:
+        print(f"obs_query: {path}: {e}", file=sys.stderr)
+    return events
+
+
+def _f(v: object) -> float:
+    return float(v) if isinstance(v, (int, float)) else 0.0
+
+
+def collect(trace_id: Optional[str], endpoints: List[str],
+            dumps: List[str], since: float, until: float,
+            name: Optional[str], timeout_s: float
+            ) -> List[Dict[str, object]]:
+    """Gather + filter + dedup events from every source, oldest
+    first.  Dedup key: (name, trace span, wall time) — a live
+    endpoint and that process's dump report the same event once."""
+    events: List[Dict[str, object]] = []
+    for ep in endpoints:
+        events.extend(fetch_endpoint(ep, trace_id, since, timeout_s))
+    for d in dumps:
+        events.extend(read_dump(d))
+    seen = set()
+    out: List[Dict[str, object]] = []
+    for ev in events:
+        if trace_id and ev.get("trace_id") != trace_id:
+            continue
+        t = _f(ev.get("t_wall"))
+        if since and t <= since:
+            continue
+        if until and t > until:
+            continue
+        if name and ev.get("name") != name:
+            continue
+        key = (ev.get("name"), ev.get("trace_id"), ev.get("span_id"),
+               round(t, 6))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(ev)
+    out.sort(key=lambda e: _f(e.get("t_wall")))
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="obs-query",
+        description="query live /debug endpoints and flight-recorder "
+                    "dumps by trace-id or time range")
+    p.add_argument("--trace-id", default=None,
+                   help="render this trace's stitched span tree")
+    p.add_argument("--endpoint", action="append", default=None,
+                   metavar="URL",
+                   help="live /debug base URL, e.g. "
+                        "http://router:8100 (repeatable)")
+    p.add_argument("--dump", action="append", default=None,
+                   metavar="PATH",
+                   help="flight-record dump file, or a directory of "
+                        "flight-*.jsonl dumps (repeatable)")
+    p.add_argument("--since", type=float, default=0.0,
+                   help="only events after this unix timestamp")
+    p.add_argument("--until", type=float, default=0.0,
+                   help="only events at or before this unix timestamp")
+    p.add_argument("--name", default=None,
+                   help="only events with this name")
+    p.add_argument("--timeout", type=float, default=3.0,
+                   help="per-endpoint fetch timeout (seconds)")
+    p.add_argument("--json", action="store_true",
+                   help="emit JSON instead of the text rendering")
+    args = p.parse_args(argv)
+    if not args.endpoint and not args.dump:
+        p.error("need at least one --endpoint or --dump")
+    events = collect(args.trace_id, args.endpoint or [],
+                     args.dump or [], args.since, args.until,
+                     args.name, args.timeout)
+    if args.trace_id:
+        # source label for the tree: a tagged source (the router's
+        # stitcher stamps replica ids) wins; else where we found it
+        for ev in events:
+            if not ev.get("source"):
+                ev["source"] = ev.get("_origin", "")
+        tree = obs.stitch(events)
+        if args.json:
+            print(json.dumps({"trace_id": args.trace_id,
+                              "events": len(events), "tree": tree},
+                             indent=2))
+        else:
+            print(f"trace {args.trace_id}: {len(events)} event(s)")
+            if events:
+                print(obs.render_tree(tree))
+        return 0 if events else 1
+    if args.json:
+        print(json.dumps({"events": events}, indent=2))
+        return 0 if events else 1
+    t0 = _f(events[0].get("t_wall")) if events else 0.0
+    for ev in events:
+        dt = _f(ev.get("t_wall")) - t0
+        tid = ev.get("trace_id") or "-"
+        src = ev.get("source") or ev.get("_origin") or ""
+        attrs = ev.get("attrs")
+        extra = ""
+        if isinstance(attrs, dict) and attrs:
+            extra = " " + " ".join(
+                f"{k}={v}" for k, v in sorted(attrs.items()))
+        print(f"+{dt:10.4f}s [{src}] {ev.get('name')} "
+              f"trace={str(tid)[:16]}{extra}")
+    return 0 if events else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

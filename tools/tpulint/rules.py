@@ -618,7 +618,18 @@ class MetricDefinitionRule(Rule):
         "request_id", "trace_id", "span_id", "rid", "uid", "url",
         "path", "id", "pod", "pod_name", "container_id", "timestamp",
         "le",
+        # request-supplied identities (PR 12 review): a caller-chosen
+        # value must be BOUNDED before it becomes a label — the SLO
+        # layer maps unknown class/tenant names to 'other' for exactly
+        # this reason; these raw forms never belong on a family
+        "user", "user_id", "session", "session_id", "prompt",
+        "tenant_id", "slo_class_raw",
     }
+    # tpu_slo_* label values (class/tenant) are only bounded because
+    # SLOAccountant maps unknown names to 'other' before they touch a
+    # child; defining one of these families anywhere else would let a
+    # request-supplied string mint series, so the module is the bound
+    _SLO_OWNER = "obs.slo"
     _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
     _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 
@@ -672,6 +683,15 @@ class MetricDefinitionRule(Rule):
                     self.id, ctx.relpath, node.lineno,
                     f"counter {name!r} must end in '_total' "
                     "(promlint C1 at the definition site)"))
+            if name.startswith("tpu_slo_") \
+                    and not ctx.module_name.endswith(self._SLO_OWNER):
+                findings.append(Finding(
+                    self.id, ctx.relpath, node.lineno,
+                    f"family {name!r} defined outside "
+                    f"{self._SLO_OWNER}: tpu_slo_* class/tenant "
+                    "label values are only bounded because "
+                    "SLOAccountant maps unknown names to 'other' — "
+                    "define SLO families through it"))
             for label, lineno in self._labelnames(node):
                 if not self._LABEL_RE.match(label):
                     findings.append(Finding(

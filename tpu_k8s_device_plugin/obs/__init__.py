@@ -27,7 +27,14 @@ from .core import (
     parse_exposition,
 )
 from .recorder import Event, FlightRecorder
+from .slo import (
+    SLOAccountant,
+    SLOPolicy,
+    default_slo_policies,
+    parse_slo_specs,
+)
 from .span import Span, span
+from .stitch import flatten, render_tree, stitch
 from .trace import (
     TraceContext,
     new_trace,
@@ -47,15 +54,22 @@ __all__ = [
     "Gauge",
     "Histogram",
     "Registry",
+    "SLOAccountant",
+    "SLOPolicy",
     "Span",
     "TraceContext",
+    "default_slo_policies",
     "escape_help",
     "escape_label_value",
+    "flatten",
     "histogram_quantile",
     "negotiate_openmetrics",
     "new_trace",
     "parse_exposition",
+    "parse_slo_specs",
     "parse_traceparent",
+    "render_tree",
     "span",
+    "stitch",
     "trace_from_header",
 ]

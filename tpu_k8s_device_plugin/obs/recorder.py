@@ -51,18 +51,26 @@ log = logging.getLogger(__name__)
 
 DEFAULT_CAPACITY = 4096
 
+# crash dumps accumulate across restarts (the file name embeds pid +
+# time precisely so restarts never clobber them); keep the newest K
+# and garbage-collect the rest at dump time so a crash-looping pod
+# cannot fill the node's disk with post-mortems
+DEFAULT_DUMP_KEEP = 20
+
 
 class Event:
     """One journal entry (see module docstring)."""
 
-    __slots__ = ("name", "trace_id", "span_id", "t_wall", "t_mono",
-                 "attrs")
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "t_wall",
+                 "t_mono", "attrs")
 
     def __init__(self, name: str, trace_id: str = "", span_id: str = "",
+                 parent_id: str = "",
                  attrs: Optional[Dict[str, object]] = None) -> None:
         self.name = name
         self.trace_id = trace_id
         self.span_id = span_id
+        self.parent_id = parent_id
         self.t_wall = time.time()
         self.t_mono = time.monotonic()
         self.attrs: Dict[str, object] = attrs if attrs is not None else {}
@@ -72,6 +80,11 @@ class Event:
             "name": self.name,
             "trace_id": self.trace_id,
             "span_id": self.span_id,
+            # the cross-PROCESS link: a hop continues the caller's
+            # traceparent as a child context, so parent_id points at
+            # the upstream process's span and obs.stitch can re-link
+            # events from several journals into one tree
+            "parent_id": self.parent_id,
             "t_wall": self.t_wall,
             "t_mono": self.t_mono,
             "attrs": self.attrs,
@@ -90,19 +103,25 @@ class FlightRecorder:
     """Thread-safe bounded ring journal (see module docstring)."""
 
     def __init__(self, capacity: int = DEFAULT_CAPACITY,
-                 registry: Optional["Registry"] = None) -> None:
+                 registry: Optional["Registry"] = None,
+                 dump_keep: int = DEFAULT_DUMP_KEEP) -> None:
         if capacity < 1:
             raise ValueError("recorder capacity must be >= 1")
+        if dump_keep < 1:
+            raise ValueError("dump_keep must be >= 1")
         self.capacity = capacity
+        self.dump_keep = dump_keep
         self._lock = threading.Lock()
         self._ring: Deque[Event] = deque(maxlen=capacity)
         self._recorded = 0
         self._dropped = 0
+        self._dump_gc = 0
         # loss is observable: the registry (when the owning surface has
         # one) carries the totals next to the latency histograms the
         # events annotate
         self._m_events: Optional["Counter"] = None
         self._m_dropped: Optional["Counter"] = None
+        self._m_dump_gc: Optional["Counter"] = None
         if registry is not None:
             self._m_events = registry.counter(
                 "tpu_flight_events_total",
@@ -111,6 +130,10 @@ class FlightRecorder:
                 "tpu_flight_dropped_events_total",
                 "Events evicted from the full flight-recorder ring "
                 "(drop-oldest).")
+            self._m_dump_gc = registry.counter(
+                "tpu_flight_dump_gc_total",
+                "Old flight-record dump files deleted to keep the "
+                "newest dump_keep in --flight-record-dir.")
         self._dump_paths: List[str] = []
         self._dump_installed = False
 
@@ -118,14 +141,18 @@ class FlightRecorder:
 
     def record(self, name: str, trace: Optional["TraceContext"] = None,
                trace_id: str = "", span_id: str = "",
-               **attrs: object) -> None:
+               parent_id: str = "", **attrs: object) -> None:
         """Append one event.  *trace* (a TraceContext) wins over the
-        explicit id strings; attrs are sanitized to JSON scalars now so
-        a SIGTERM-time dump can never fail on a live object."""
+        explicit id strings (its parent link rides along, so a
+        cross-process stitcher can re-link hops); attrs are sanitized
+        to JSON scalars now so a SIGTERM-time dump can never fail on a
+        live object."""
         if trace is not None:
             trace_id = trace.trace_id
             span_id = trace.span_id
+            parent_id = trace.parent_id or ""
         ev = Event(name, trace_id=trace_id, span_id=span_id,
+                   parent_id=parent_id,
                    attrs={k: _jsonable(v) for k, v in attrs.items()})
         with self._lock:
             if len(self._ring) == self.capacity:
@@ -208,17 +235,60 @@ class FlightRecorder:
 
     def dump_to_dir(self, dir_path: str) -> Optional[str]:
         """One dump file in *dir_path*, named by pid + wall time so
-        restarts never clobber the post-mortem they should explain."""
+        restarts never clobber the post-mortem they should explain.
+        After a successful dump, older dumps past ``dump_keep`` are
+        deleted (newest-first by mtime) so crash loops cannot grow the
+        directory without bound; deletions count in
+        ``tpu_flight_dump_gc_total``."""
         try:
             os.makedirs(dir_path, exist_ok=True)
             path = os.path.join(
                 dir_path,
                 f"flight-{os.getpid()}-{int(time.time())}.jsonl")
             self.dump(path)
-            return path
         except OSError as e:
             log.error("flight-record dump to %s failed: %s", dir_path, e)
             return None
+        self._gc_dumps(dir_path)
+        return path
+
+    @property
+    def dump_gc_count(self) -> int:
+        with self._lock:
+            return self._dump_gc
+
+    def _gc_dumps(self, dir_path: str) -> None:
+        """Keep the newest ``dump_keep`` flight-*.jsonl dumps in
+        *dir_path*.  Best-effort: a GC failure must never fail the
+        dump that just succeeded (this runs on SIGTERM/atexit)."""
+        try:
+            dumps = [
+                os.path.join(dir_path, f)
+                for f in os.listdir(dir_path)
+                if f.startswith("flight-") and f.endswith(".jsonl")
+            ]
+            dumps.sort(key=lambda p: (os.path.getmtime(p), p),
+                       reverse=True)
+            stale = dumps[self.dump_keep:]
+        except OSError as e:
+            log.warning("flight-record dump GC scan failed: %s", e)
+            return
+        removed = 0
+        for p in stale:
+            try:
+                os.remove(p)
+                removed += 1
+            except OSError as e:
+                log.warning("flight-record dump GC of %s failed: %s",
+                            p, e)
+        if removed:
+            with self._lock:
+                self._dump_gc += removed
+            if self._m_dump_gc is not None:
+                self._m_dump_gc.inc(removed)
+            log.info("flight-record dump GC removed %d old dump(s) "
+                     "from %s (keep %d)", removed, dir_path,
+                     self.dump_keep)
 
     def install_dump_handlers(self, dir_path: str,
                               signals: Iterable[int] = (signal.SIGTERM,)

@@ -142,7 +142,7 @@ def run(config: str, quantized, batch: int, steps: int,
         cancel_every: int = 0, burst: int = 0,
         interleave: bool = True, kv_paging: bool = False,
         tenants: int = 0, packed_prefill: bool = True,
-        overlap_dispatch: bool = True):
+        overlap_dispatch: bool = True, metrics_out=None):
     # fail fast for library callers too, not just the CLI: engine mode
     # consumes (warmup + rounds) run_scan windows of cache headroom,
     # and a mid-benchmark ValueError from run_scan is a worse place to
@@ -183,7 +183,8 @@ def run(config: str, quantized, batch: int, steps: int,
             cancel_every=cancel_every, burst=burst,
             interleave=interleave, kv_paging=kv_paging,
             tenants=tenants, packed_prefill=packed_prefill,
-            overlap_dispatch=overlap_dispatch)
+            overlap_dispatch=overlap_dispatch,
+            metrics_out=metrics_out)
     elif engine:
         stats = _engine_throughput(model, params, prompt, steps)
     else:
@@ -427,12 +428,40 @@ def _print_slowest_traces(port, traced, k=3):
         print(f"slow-trace {tid}: " + " ".join(parts), flush=True)
 
 
+def _scrape_metrics_body(port, accept=None):
+    """One /metrics scrape as text (plain, or OpenMetrics via
+    *accept*)."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    headers = {"Accept": accept} if accept else {}
+    conn.request("GET", "/metrics", headers=headers)
+    body = conn.getresponse().read().decode()
+    conn.close()
+    return body
+
+
+def _slo_counts(samples):
+    """tpu_slo_requests_total samples -> ({class: total},
+    {class: met})."""
+    tot, met = {}, {}
+    for name, lab, v in samples:
+        if name != "tpu_slo_requests_total":
+            continue
+        c = lab.get("class", "")
+        tot[c] = tot.get(c, 0.0) + v
+        if lab.get("met") == "true":
+            met[c] = met.get(c, 0.0) + v
+    return tot, met
+
+
 def _http_throughput(model, params, prompt, steps, clients,
                      n_requests, slots, cancel_every: int = 0,
                      burst: int = 0, interleave: bool = True,
                      kv_paging: bool = False, tenants: int = 0,
                      packed_prefill: bool = True,
-                     overlap_dispatch: bool = True):
+                     overlap_dispatch: bool = True,
+                     metrics_out=None):
     """Front-door load test (VERDICT r4 #5): *clients* concurrent
     streaming HTTP clients drive *n_requests* total requests (mixed
     priorities; every *cancel_every*-th request disconnects after its
@@ -506,6 +535,11 @@ def _http_throughput(model, params, prompt, steps, clients,
                 "max_new_tokens": steps,
                 # mixed priorities: odd requests jump the queue
                 "priority": i % 2,
+                # SLO classes ride the priorities: the queue-jumpers
+                # are the interactive (TTFT-target) lane, the rest the
+                # batch (deadline) lane — goodput per class comes back
+                # out of the server's tpu_slo_* families below
+                "slo_class": "interactive" if i % 2 else "batch",
             }
             if tenants:
                 # round-robin tenant identities: tenant-0 is the
@@ -606,8 +640,11 @@ def _http_throughput(model, params, prompt, steps, clients,
             t.join()
         # post-warmup snapshot: the timed phase's prefill/decode split
         # is reported as DELTAS against this (warmup prefills are
-        # compile fodder, not workload)
+        # compile fodder, not workload); same for the SLO counters —
+        # warmup requests must not inflate the goodput numbers
         stats_warm = srv.stats()
+        slo_base_tot, slo_base_met = _slo_counts(
+            obs.parse_exposition(_scrape_metrics_body(srv.port)))
 
         t_start = time.perf_counter()
         threads = [threading.Thread(target=client_loop, args=(c,))
@@ -619,7 +656,10 @@ def _http_throughput(model, params, prompt, steps, clients,
         wall = time.perf_counter() - t_start
         # timed-phase snapshot BEFORE the burst phase: the
         # prefill/decode split must not absorb burst-request prefills
+        # (nor the goodput accounting the burst's deliberate 429s)
         stats_load = srv.stats()
+        slo_load_tot, slo_load_met = _slo_counts(
+            obs.parse_exposition(_scrape_metrics_body(srv.port)))
         burst_statuses = []
         if burst:
             burst_statuses = _http_burst(
@@ -628,11 +668,16 @@ def _http_throughput(model, params, prompt, steps, clients,
         # scrape the PR 3 latency histograms over the wire: the
         # reported percentiles come from /metrics itself, so the bench
         # validates the series a production dashboard would read
-        mconn = http.client.HTTPConnection("127.0.0.1", srv.port,
-                                           timeout=30)
-        mconn.request("GET", "/metrics")
-        metrics_body = mconn.getresponse().read().decode()
-        mconn.close()
+        metrics_body = _scrape_metrics_body(srv.port)
+        if metrics_out:
+            # both exposition modes to disk so CI can promlint the
+            # exact bytes a production scrape would see (the smoke
+            # gate for the tpu_slo_* / window-phase families)
+            with open(metrics_out, "w") as f:
+                f.write(metrics_body)
+            with open(metrics_out + ".om", "w") as f:
+                f.write(_scrape_metrics_body(
+                    srv.port, accept=obs.OPENMETRICS_CONTENT_TYPE))
         # the tail explained: span breakdowns for the 3 slowest traced
         # requests, straight from the server's flight recorder — plus
         # the admit→first-token means over EVERY traced request
@@ -665,6 +710,13 @@ def _http_throughput(model, params, prompt, steps, clients,
         "tpot_ms_p99": _percentile(tpots, 0.99) * 1e3,
         "tokens_per_sec_http": http_tps,
         "tokens_per_sec_engine": eng_stats["tokens_per_sec"],
+        # goodput (ROADMAP: the headline NEXT TO tokens/sec):
+        # requests/sec meeting their class SLO over the timed phase,
+        # sourced from the server's tpu_slo_requests_total deltas —
+        # the same families the router's /fleet/statz aggregates
+        "goodput_req_per_sec": sum(
+            slo_load_met.get(c, 0.0) - slo_base_met.get(c, 0.0)
+            for c in slo_load_tot) / wall,
         "front_door_overhead_pct":
             100.0 * (1.0 - http_tps / eng_stats["tokens_per_sec"]),
         "http_over_engine_ratio":
@@ -697,6 +749,15 @@ def _http_throughput(model, params, prompt, steps, clients,
             stats_load.get("packed_prefill_pad_tokens", 0)
             - stats_warm.get("packed_prefill_pad_tokens", 0)),
     }
+    # per-class goodput next to the tokens/sec headline: met/sec and
+    # the met fraction for every class the timed phase touched
+    for c in sorted(slo_load_tot):
+        t = slo_load_tot[c] - slo_base_tot.get(c, 0.0)
+        if t <= 0:
+            continue
+        m = slo_load_met.get(c, 0.0) - slo_base_met.get(c, 0.0)
+        out[f"goodput_{c}_req_per_sec"] = m / wall
+        out[f"goodput_{c}_ratio"] = m / t
     if kv_paging:
         # KV pool economics straight off the production surfaces: the
         # /metrics families a dashboard reads plus /stats occupancy —
@@ -1023,6 +1084,33 @@ def run_router(config, quantized, n_replicas, clients, n_requests,
             aff = sum(v for n, lab, v in samples
                       if n == "tpu_router_affinity_hits_total")
             out["affinity_hit_rate"] = (aff - base_aff) / total_ok
+            # the fleet snapshot must aggregate EVERY replica: the
+            # router-smoke CI job gates on this (a replica missing
+            # from /fleet/statz is invisible to the autoscaler)
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", rt.port, timeout=10)
+            conn.request("GET", "/fleet/statz")
+            fleet = _json.loads(conn.getresponse().read())
+            conn.close()
+            out["fleet_statz_replicas"] = float(fleet["replicas"])
+            out["fleet_statz_healthy"] = float(fleet["healthy"])
+            out["fleet_capacity"] = float(
+                fleet["fleet"]["capacity"])
+            goodput = fleet["fleet"].get("goodput", {})
+            out["fleet_goodput_rps"] = float(sum(
+                row.get("goodput_rps", 0.0)
+                for row in goodput.values()))
+            if fleet["replicas"] != n_replicas or \
+                    len(fleet["per_replica"]) != n_replicas:
+                raise RuntimeError(
+                    f"/fleet/statz aggregates "
+                    f"{fleet['replicas']} replica(s), expected "
+                    f"{n_replicas}")
+            if fleet["fleet"]["capacity"] != n_replicas * slots:
+                raise RuntimeError(
+                    "/fleet/statz capacity "
+                    f"{fleet['fleet']['capacity']} != "
+                    f"{n_replicas} x {slots} slots")
         if kill:
             # -- kill phase: SIGKILL one replica, survivors absorb ----
             victim = procs[-1]
@@ -1273,6 +1361,16 @@ def main(argv=None) -> int:
                         "http_over_engine_ratio >= FLOOR (the CI "
                         "regression gate for the continuous-batching "
                         "target)")
+    p.add_argument("--assert-goodput", action="store_true",
+                   help="with --http: exit nonzero unless the timed "
+                        "phase's goodput (requests/sec meeting class "
+                        "SLOs, from the tpu_slo_* families) is "
+                        "nonzero (the SLO-wiring CI smoke gate)")
+    p.add_argument("--metrics-out", default=None, metavar="PATH",
+                   help="with --http: write the post-run /metrics "
+                        "scrape to PATH (plain) and PATH.om "
+                        "(OpenMetrics) so CI can promlint both "
+                        "exposition modes")
     p.add_argument("--kv-paging", action="store_true",
                    help="with --http: serve from the paged KV pool "
                         "(reports pool occupancy, shared-page ratio, "
@@ -1320,11 +1418,13 @@ def main(argv=None) -> int:
     if (args.requests or args.cancel_every or args.burst
             or args.assert_ratio or args.no_interleave
             or args.kv_paging or args.tenants or args.router
-            or args.prefill_heavy) \
+            or args.prefill_heavy or args.assert_goodput
+            or args.metrics_out) \
             and not args.http:
         p.error("--requests/--cancel-every/--burst/--assert-ratio/"
                 "--no-interleave/--kv-paging/--tenants/--router/"
-                "--prefill-heavy only apply with --http")
+                "--prefill-heavy/--assert-goodput/--metrics-out "
+                "only apply with --http")
     if args.compile_cache_dir and not args.cold_start:
         p.error("--compile-cache-dir only applies with --cold-start")
     if args.cold_start:
@@ -1420,7 +1520,8 @@ def main(argv=None) -> int:
                     interleave=not args.no_interleave,
                     kv_paging=args.kv_paging, tenants=args.tenants,
                     packed_prefill=args.packed_prefill,
-                    overlap_dispatch=args.overlap_dispatch)
+                    overlap_dispatch=args.overlap_dispatch,
+                    metrics_out=args.metrics_out)
     except ValueError as e:
         p.error(str(e))
     for k, v in stats.items():
@@ -1433,6 +1534,14 @@ def main(argv=None) -> int:
             return 1
         print(f"OK: http_over_engine_ratio {ratio:.3f} >= "
               f"{args.assert_ratio:.2f}", flush=True)
+    if args.assert_goodput:
+        goodput = stats.get("goodput_req_per_sec", 0.0)
+        if goodput <= 0:
+            print("FAIL: goodput_req_per_sec is zero — the SLO "
+                  "accounting saw no met request", flush=True)
+            return 1
+        print(f"OK: goodput_req_per_sec {goodput:.2f} > 0",
+              flush=True)
     return 0
 
 

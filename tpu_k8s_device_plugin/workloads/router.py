@@ -71,6 +71,14 @@ API:
   GET  /metrics      tpu_router_* families (Prometheus exposition;
                      OpenMetrics content negotiation like every other
                      surface)
+  GET  /fleet/statz  one fleet snapshot: per-replica statz plus
+                     aggregated queue/shed/goodput signals (built from
+                     the cached statz — no fan-out on the read path)
+  GET  /debug/traces[?trace_id=…]  the CROSS-REPLICA stitched span
+                     tree: the router's route/proxy events merged with
+                     every replica's timeline for the trace-id and
+                     re-linked via the traceparent parent chain
+                     (index of recent router traces without the param)
   GET  /debug/events the router's flight-recorder journal
 
 Metric families::
@@ -102,6 +110,7 @@ import time
 from bisect import bisect_left
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, HTTPServer
+from urllib.parse import parse_qs, quote, urlparse
 from typing import (
     Any,
     Dict,
@@ -571,6 +580,149 @@ class RouterServer:
             })
         return out
 
+    def fleet_statz(self) -> Dict[str, Any]:
+        """One fleet snapshot (GET /fleet/statz): per-replica statz
+        plus aggregated load + goodput — the signal the autoscaler
+        (ROADMAP fleet control plane) and dashboards read without
+        touching N replicas themselves.  Built entirely from the
+        CACHED statz the poller/heartbeats keep fresh: serving this is
+        O(replicas), no fan-out on the read path."""
+        with self._lock:
+            reps = list(self._replicas.values())
+        now = _now()
+        agg = {"queue_depth": 0, "in_flight": 0, "capacity": 0,
+               "kv_pages": 0, "kv_pages_free": 0,
+               "requests_served": 0}
+        shed_agg: Dict[str, int] = {}
+        # per-class goodput aggregation: sums of window met/total
+        # re-derive the fleet ratio (a mean of ratios would let an
+        # idle replica mask a drowning one)
+        classes: Dict[str, Dict[str, float]] = {}
+        per_replica: Dict[str, Any] = {}
+        healthy = 0
+        for rep in sorted(reps, key=lambda r: r.rid):
+            ok = self._routable(rep)
+            healthy += 1 if ok else 0
+            statz = rep.statz if isinstance(rep.statz, dict) else {}
+            per_replica[rep.rid] = {
+                "healthy": ok,
+                "age_s": round(now - rep.last_seen, 3),
+                "statz": statz,
+            }
+            for k in agg:
+                v = statz.get(k)
+                if isinstance(v, (int, float)):
+                    agg[k] += int(v)
+            shed = statz.get("shed")
+            if isinstance(shed, dict):
+                for k, v in shed.items():
+                    if isinstance(v, (int, float)):
+                        shed_agg[k] = shed_agg.get(k, 0) + int(v)
+            goodput = statz.get("goodput")
+            if not isinstance(goodput, dict):
+                continue
+            gclasses = goodput.get("classes")
+            if not isinstance(gclasses, dict):
+                continue
+            for name, row in gclasses.items():
+                if not isinstance(row, dict):
+                    continue
+                acc = classes.setdefault(name, {
+                    "total": 0.0, "met": 0.0, "window_total": 0.0,
+                    "window_met": 0.0, "goodput_rps": 0.0,
+                    "burn_rate_max": 0.0})
+                for src, dst in (("total", "total"), ("met", "met"),
+                                 ("window_total", "window_total"),
+                                 ("window_met", "window_met"),
+                                 ("goodput_rps", "goodput_rps")):
+                    v = row.get(src)
+                    if isinstance(v, (int, float)):
+                        acc[dst] += float(v)
+                burn = row.get("burn_rate")
+                if isinstance(burn, (int, float)):
+                    acc["burn_rate_max"] = max(acc["burn_rate_max"],
+                                               float(burn))
+        goodput_out: Dict[str, Any] = {}
+        for name, acc in sorted(classes.items()):
+            wt, wm = acc["window_total"], acc["window_met"]
+            goodput_out[name] = {
+                "total": int(acc["total"]),
+                "met": int(acc["met"]),
+                "window_total": int(wt),
+                "window_met": int(wm),
+                "goodput_ratio": (wm / wt) if wt else 1.0,
+                "goodput_rps": acc["goodput_rps"],
+                "burn_rate_max": acc["burn_rate_max"],
+            }
+        return {
+            "replicas": len(reps),
+            "healthy": healthy,
+            "fleet": {**agg, "shed": shed_agg,
+                      "goodput": goodput_out},
+            "per_replica": per_replica,
+        }
+
+    # -- cross-replica trace stitching --------------------------------------
+
+    def _fetch_replica_trace(self, rep: Replica, trace_id: str
+                             ) -> List[Dict[str, object]]:
+        """One replica's /debug/traces timeline for *trace_id* (the
+        stitch fan-out; failures degrade the stitch, never fail it —
+        the statz breaker gates obviously-dead replicas out)."""
+        assert rep.breaker is not None
+        if rep.breaker.state == resilience.BREAKER_OPEN:
+            return []
+        host, port = rep.host_port()
+        conn = http.client.HTTPConnection(
+            host, port, timeout=self.connect_timeout_s)
+        try:
+            conn.request(
+                "GET",
+                f"/debug/traces?trace_id={quote(trace_id, safe='')}")
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status != 200:
+                return []
+            out = json.loads(body)
+        finally:
+            conn.close()
+        events = out.get("events") if isinstance(out, dict) else None
+        if not isinstance(events, list):
+            return []
+        return [e for e in events if isinstance(e, dict)]
+
+    def stitched_trace(self, trace_id: str) -> Dict[str, Any]:
+        """GET /debug/traces?trace_id= — the fleet view: the router's
+        own route/proxy events merged with every registered replica's
+        timeline for the same trace-id, re-linked into ONE span tree
+        via the traceparent parent links (obs.stitch).  A replica that
+        cannot answer (dead, evicting) just contributes nothing — its
+        flight-recorder DUMP still holds its half for
+        tools/obs_query.py."""
+        events: List[Dict[str, object]] = []
+        for ev in self.recorder.events(trace_id=trace_id):
+            ev["source"] = "router"
+            events.append(ev)
+        with self._lock:
+            reps = list(self._replicas.values())
+        for rep in sorted(reps, key=lambda r: r.rid):
+            try:
+                fetched = self._fetch_replica_trace(rep, trace_id)
+            except (OSError, ValueError,
+                    http.client.HTTPException) as e:
+                resilience.suppressed("router.trace_fanout", e,
+                                      logger=log,
+                                      metrics=self._rmetrics)
+                continue
+            for ev in fetched:
+                ev.setdefault("source", rep.rid)
+                events.append(ev)
+        return {
+            "trace_id": trace_id,
+            "events": len(events),
+            "tree": obs.stitch(events),
+        }
+
     def _collect_health(self) -> None:
         """Scrape-time refresh of tpu_router_replica_healthy."""
         with self._lock:
@@ -775,6 +927,10 @@ class RouterServer:
                 handler.wfile.write(body_out)
             except OSError:
                 pass
+            self.recorder.record(
+                "tpu_router_proxy", trace=trace, replica="none",
+                outcome="unroutable",
+                duration_s=time.perf_counter() - t_arrival)
             return
         # -- stream the response back, byte-identical -------------------
         outcome = "ok" if resp.status < 400 else (
@@ -805,6 +961,10 @@ class RouterServer:
                     outcome = streamed
                 self._m_requests.labels(replica=rep.rid,
                                         outcome=outcome).inc()
+                self.recorder.record(
+                    "tpu_router_proxy", trace=trace, replica=rep.rid,
+                    outcome=outcome,
+                    duration_s=time.perf_counter() - t_arrival)
                 return
             payload = resp.read()
             handler.send_header("Content-Length", str(len(payload)))
@@ -824,6 +984,10 @@ class RouterServer:
             conn.close()
         self._m_requests.labels(replica=rep.rid,
                                 outcome=outcome).inc()
+        self.recorder.record(
+            "tpu_router_proxy", trace=trace, replica=rep.rid,
+            outcome=outcome,
+            duration_s=time.perf_counter() - t_arrival)
 
     def _stream_through(self, handler: "BaseHTTPRequestHandler",
                         conn: http.client.HTTPConnection,
@@ -920,6 +1084,27 @@ class RouterServer:
                         return
                     self._send(200, obs.OPENMETRICS_CONTENT_TYPE
                                if om else obs.TEXT_CONTENT_TYPE, body)
+                elif self.path == "/fleet/statz":
+                    body = json.dumps(
+                        router.fleet_statz(),
+                        indent=2).encode() + b"\n"
+                    self._send(200, "application/json", body)
+                elif self.path.startswith("/debug/traces"):
+                    # ?trace_id=… -> the CROSS-REPLICA stitched tree
+                    # (router + every replica's timeline re-linked via
+                    # traceparent parents); without it, the router's
+                    # own recent-trace index
+                    q = parse_qs(urlparse(self.path).query)
+                    tid = q.get("trace_id", [""])[0]
+                    if tid:
+                        payload: Dict[str, Any] = \
+                            router.stitched_trace(tid)
+                    else:
+                        payload = {
+                            "traces": router.recorder.trace_ids()}
+                    body = json.dumps(
+                        payload, indent=2).encode() + b"\n"
+                    self._send(200, "application/json", body)
                 elif self.path.startswith("/debug/events"):
                     body = json.dumps({
                         "dropped": router.recorder.dropped,

@@ -224,6 +224,19 @@ class IterationScheduler:
         self._m_chunk = self._m_first = None
         self._m_overlap_idle = self._m_overlap_windows = None
         self._g_prefill = self._g_decode = None
+        # per-window phase breakdown + device duty cycle: cumulative
+        # wall seconds by phase (dispatch = host-side scan_dispatch
+        # work, harvest = blocking device sync incl. spec/jump/step
+        # rounds, stream = the owner's emit work between iterations,
+        # idle = the owner waiting for work).  Single writer (the
+        # scheduler thread); the scrape-time duty collector only reads
+        self._phase_acc: Dict[str, float] = {
+            "dispatch": 0.0, "harvest": 0.0, "stream": 0.0,
+            "idle": 0.0}
+        self._phase_hist: Dict[str, object] = {}
+        self._m_phase = None
+        self._g_duty = None
+        self._duty_snap = dict(self._phase_acc)
         if registry is not None:
             self._m_chunk = registry.histogram(
                 "tpu_serve_prefill_chunk_seconds",
@@ -260,6 +273,27 @@ class IterationScheduler:
                 "slots).", ("kind",))
             self._g_prefill = g.labels(kind="prefill")
             self._g_decode = g.labels(kind="decode")
+            self._m_phase = registry.histogram(
+                "tpu_serve_window_phase_seconds",
+                "Scheduler-loop time by phase: dispatch (host-side "
+                "window dispatch), harvest (blocking device sync — "
+                "scan harvest, spec/jump rounds, endgame steps), "
+                "stream (the owner's emit/stream-write work between "
+                "iterations), idle (waiting for work).",
+                ("phase",), buckets=obs.FAST_BUCKETS_S)
+            # one schema from boot: every phase child renders (zeros)
+            # whether or not the loop has reached it yet
+            self._phase_hist = {
+                p: self._m_phase.labels(phase=p)
+                for p in self._phase_acc}
+            self._g_duty = registry.gauge(
+                "tpu_serve_device_duty_cycle",
+                "Fraction of scheduler-loop wall time the device was "
+                "kept busy (dispatch+harvest over all phases) since "
+                "the previous scrape — the direct measurement of the "
+                "prefill-gap estimates.")
+            self._g_duty.set(0.0)
+            registry.on_collect(self._collect_duty)
 
     # -- intake -------------------------------------------------------------
 
@@ -450,6 +484,43 @@ class IterationScheduler:
                     self._m_first.observe(now - t.t_begin)
         self._await_first.clear()
 
+    def note_phase(self, phase: str, dt: float) -> None:
+        """Account *dt* wall seconds of scheduler-loop time under
+        *phase* (dispatch | harvest | stream | idle).  dispatch and
+        harvest are accounted internally; the loop's OWNER reports its
+        stream-write and idle-wait time through this hook (the
+        scheduler cannot see between its own iterations)."""
+        if phase not in self._phase_acc:
+            raise ValueError(f"unknown scheduler phase {phase!r}")
+        if dt < 0:
+            return
+        self._phase_acc[phase] += dt
+        child = self._phase_hist.get(phase)
+        if child is not None:
+            child.observe(dt)  # type: ignore[attr-defined]
+
+    def _collect_duty(self) -> None:
+        """Scrape-time device duty cycle: dispatch+harvest seconds
+        over all phase seconds since the PREVIOUS scrape, falling back
+        to the lifetime ratio on the first (delta-free) scrape."""
+        cur = dict(self._phase_acc)
+        prev = self._duty_snap
+        busy = (cur["dispatch"] - prev["dispatch"]
+                + cur["harvest"] - prev["harvest"])
+        total = sum(cur.values()) - sum(prev.values())
+        if total <= 0.0:
+            busy = cur["dispatch"] + cur["harvest"]
+            total = sum(cur.values())
+        self._duty_snap = cur
+        if self._g_duty is not None and total > 0.0:
+            self._g_duty.set(max(0.0, min(1.0, busy / total)))
+
+    def _timed_dispatch(self, window: int) -> object:
+        t0 = time.perf_counter()
+        handle = self.engine.scan_dispatch(window)
+        self.note_phase("dispatch", time.perf_counter() - t0)
+        return handle
+
     def _gauges(self) -> None:
         if self._g_prefill is not None:
             self._g_prefill.set(len(self._pending)
@@ -562,7 +633,7 @@ class IterationScheduler:
         if window < 1:
             return
         self._note_first_step()
-        handle = eng.scan_dispatch(window)
+        handle = self._timed_dispatch(window)
         self._ahead = (handle, window)
         if self._m_overlap_windows is not None:
             self._m_overlap_windows.inc()
@@ -583,8 +654,10 @@ class IterationScheduler:
         fins = self._admit_work(self.prefill_budget)
         t0 = time.perf_counter()
         decoded = eng.scan_harvest(handle)
+        dt = time.perf_counter() - t0
+        self.note_phase("harvest", dt)
         if self._m_overlap_idle is not None:
-            self._m_overlap_idle.observe(time.perf_counter() - t0)
+            self._m_overlap_idle.observe(dt)
         self._maybe_dispatch_ahead(decoded)
         self._gauges()
         return IterationResult(fins, decoded, window)
@@ -647,11 +720,15 @@ class IterationScheduler:
             admitted += self._drain_admissions()
             self._note_first_step()
             if eng.spec_ready():
+                t0 = time.perf_counter()
                 decoded = eng.spec_round()
+                self.note_phase("harvest", time.perf_counter() - t0)
                 self._gauges()
                 return IterationResult(admitted, decoded, 1)
             if eng.forced_pending():
+                t0 = time.perf_counter()
                 decoded = eng.jump_round()
+                self.note_phase("harvest", time.perf_counter() - t0)
                 if decoded is not None:
                     self._gauges()
                     return IterationResult(admitted, decoded, 1)
@@ -662,11 +739,13 @@ class IterationScheduler:
         if window < 1:
             # a slot ran out of cache: one step() retires it
             self._note_first_step()
+            t0 = time.perf_counter()
             decoded = {s: [t] for s, t in eng.step().items()}
+            self.note_phase("harvest", time.perf_counter() - t0)
             self._gauges()
             return IterationResult(admitted, decoded, 1)
         self._note_first_step()
-        handle = eng.scan_dispatch(window)
+        handle = self._timed_dispatch(window)
         fins: List[Ticket] = []
         if self.interleave:
             # the window is on the device; everything below overlaps
@@ -677,7 +756,9 @@ class IterationScheduler:
             # one window
             self._check(gen)
             fins = self._admit_work(self.prefill_budget)
+        t0 = time.perf_counter()
         decoded = eng.scan_harvest(handle)
+        self.note_phase("harvest", time.perf_counter() - t0)
         admitted += fins
         self._maybe_dispatch_ahead(decoded)
         self._gauges()

@@ -488,6 +488,12 @@ class _Request:
     span: object = None
     ttft_observed: bool = False
     trace: object = None
+    # SLO/goodput accounting (PR 12): the request-supplied class name
+    # (bounded to the declared policy set at record time — unknown
+    # names land under the "other" label) and the observed TTFT the
+    # terminal record is judged against
+    slo_class: str = ""
+    ttft_s: float = -1.0
 
 
 class TenantQuota:
@@ -684,7 +690,11 @@ class EngineServer:
                  tenant_quotas: Optional[dict] = None,
                  packed_prefill: bool = True,
                  overlap_dispatch: bool = True,
-                 max_pack: int = DEFAULT_MAX_PACK):
+                 max_pack: int = DEFAULT_MAX_PACK,
+                 slo_policies: Optional[dict] = None,
+                 slo_window_s: float = 60.0,
+                 profile_dir: Optional[str] = None,
+                 flight_dump_keep: int = 20):
         """*tokenizer* (anything with ``encode(str) -> List[int]`` and
         ``decode(List[int]) -> str``, e.g. a transformers tokenizer)
         unlocks the text-level surface: ``"prompt"`` strings, STRING
@@ -844,6 +854,28 @@ class EngineServer:
         self.tenant_quotas = dict(tenant_quotas or {})
         self._qos = bool(self.tenant_quotas)
         self._vtime = 0.0              # WFQ virtual clock (under _lock)
+        # -- SLO / goodput accounting (PR 12) -----------------------------
+        # every terminal request lands in tpu_slo_requests_total{class,
+        # tenant,met}; the rolling-window goodput/burn-rate gauges and
+        # the /statz goodput block come from the same accountant, so
+        # the router tier and the dashboards read one truth.  Always
+        # on: without --slo the default interactive/batch policies
+        # classify (generously) rather than nothing being measured
+        self._slo = obs.SLOAccountant(
+            reg, policies=slo_policies,
+            tenants=self.tenant_quotas.keys(),
+            window_s=slo_window_s)
+        # -- continuous profiling hook (PR 12) ----------------------------
+        # GET /debug/profile?seconds=N dumps a jax.profiler trace to
+        # --profile-dir; single-flight guarded (a second request while
+        # one is capturing answers 409 instead of corrupting the trace)
+        self.profile_dir = profile_dir
+        self._profile_lock = threading.Lock()
+        self._m_profile = reg.counter(
+            "tpu_serve_profile_captures_total",
+            "Profiler traces captured via /debug/profile (dumped to "
+            "--profile-dir).")
+        self._m_profile.inc(0)  # render from boot: one schema
         # crash containment (PR 5): a scheduler-thread death is
         # counted, journaled, and survived (supervised restart) —
         # never a silent hang with clients blocked on event queues
@@ -861,7 +893,8 @@ class EngineServer:
         # request's trace-id; /debug/traces and /debug/events read it,
         # and --flight-record-dir dumps it on exit/SIGTERM
         self.recorder = obs.FlightRecorder(
-            capacity=flight_record_capacity, registry=reg)
+            capacity=flight_record_capacity, registry=reg,
+            dump_keep=flight_dump_keep)
         self.flight_record_dir = flight_record_dir
         if flight_record_dir:
             self.recorder.install_dump_handlers(flight_record_dir)
@@ -1004,12 +1037,22 @@ class EngineServer:
     def _finish_request(self, req: _Request, outcome: str) -> None:
         """Terminal accounting: end the request span exactly once
         (observes tpu_serve_request_seconds{outcome} and logs the
-        request-id line).  Safe to race — Span.end is idempotent, and
+        request-id line) and record the SLO verdict — goodput counts
+        every terminal request, and a shed/dropped/crashed one never
+        meets its SLO.  Safe to race — Span.end is idempotent, and
         handler threads (cancel paths) may race the scheduler."""
         sp = req.span
         if sp is not None:
             req.span = None
-            sp.end(outcome=outcome)
+            total_s = sp.end(outcome=outcome)
+            # requests that never declared a class derive one from
+            # their shape: streaming callers care about TTFT
+            # (interactive), unary callers about the deadline (batch)
+            self._slo.record(
+                req.slo_class or None, req.tenant,
+                ttft_s=req.ttft_s if req.ttft_s >= 0 else None,
+                total_s=total_s, ok=outcome == "ok",
+                fallback="interactive" if req.stream else "batch")
 
     # -- scheduler (sole owner of the engine) -------------------------------
 
@@ -1260,6 +1303,7 @@ class EngineServer:
             # trace-id rides along as the bucket's OpenMetrics exemplar
             req.ttft_observed = True
             ttft_dt = time.perf_counter() - req.t_arrival
+            req.ttft_s = ttft_dt  # the SLO verdict reads this back
             self._m_ttft.observe(
                 ttft_dt,
                 trace_id=(req.trace.trace_id if req.trace else None))
@@ -1450,9 +1494,14 @@ class EngineServer:
             if (not self._running and not sched.busy()
                     and not self._intake_waiting()):
                 # idle: wait for work without spinning (admission is
-                # priority-then-FIFO; requests stay in the heap)
+                # priority-then-FIFO; requests stay in the heap).  The
+                # wait is the loop's "idle" phase — the denominator of
+                # the device duty-cycle gauge
+                t_idle = time.perf_counter()
                 self._work.wait(timeout=_IDLE_POLL_S)
                 self._work.clear()
+                sched.note_phase("idle",
+                                 time.perf_counter() - t_idle)
                 continue
             # chaos hooks (serve.step / serve.schedule) fire INSIDE
             # iterate, after admission work and before the decode
@@ -1474,6 +1523,7 @@ class EngineServer:
             # fires mid-window); only decode output is left to stream
             if not res.steps:
                 continue
+            t_stream = time.perf_counter()
             for slot, (req, idx) in list(self._running.items()):
                 before = req.emitted.get(idx, 0)
                 self._emit(slot, req, idx, eng.output(slot))
@@ -1485,6 +1535,11 @@ class EngineServer:
                     self._m_token.observe_n(win_dt / k, k)
                     self._mark(req, "tpu_serve_window", win_dt,
                                tokens=k, slot=slot)
+            # the post-harvest emit work is the loop's "stream" phase:
+            # with --overlap-dispatch the next window is already on
+            # the device underneath it (that is the overlap's win)
+            sched.note_phase("stream",
+                             time.perf_counter() - t_stream)
         # the scheduler owns _running/_head: it performs the shutdown
         # drain itself so stop() never mutates them while a device step
         # is still in flight (a stuck 5s join used to race here)
@@ -1768,6 +1823,36 @@ class EngineServer:
                                 since=since)}
                     self._send(200, "application/json",
                                json.dumps(body, indent=2) + "\n")
+                elif url.path == "/debug/profile":
+                    # continuous-profiling hook: capture ?seconds=N of
+                    # jax.profiler trace into --profile-dir.  Blocking
+                    # (the worker sleeps through the capture), single-
+                    # flight (concurrent capture answers 409)
+                    q = parse_qs(url.query)
+                    try:
+                        seconds = float(q.get("seconds", ["1"])[0])
+                    except ValueError:
+                        self._send(400, "application/json", json.dumps(
+                            {"error": "'seconds' must be a number"})
+                            + "\n")
+                        return
+                    try:
+                        out = server.profile(seconds)
+                    except ValueError as e:
+                        self._send(400, "application/json",
+                                   json.dumps({"error": str(e)}) + "\n")
+                        return
+                    except RuntimeError as e:
+                        self._send(409, "application/json",
+                                   json.dumps({"error": str(e)}) + "\n")
+                        return
+                    except Exception as e:
+                        log.exception("/debug/profile capture failed")
+                        self._send(500, "application/json", json.dumps(
+                            {"error": f"profiler failed: {e}"}) + "\n")
+                        return
+                    self._send(200, "application/json",
+                               json.dumps(out) + "\n")
                 else:
                     self._send(404, "text/plain", "not found\n")
 
@@ -2366,6 +2451,12 @@ class EngineServer:
         if opt("user") is not None:
             # OpenAI's end-user identity doubles as the QoS tenant
             native["tenant"] = str(opt("user"))
+        if opt("slo_class") is not None or \
+                opt("service_tier") is not None:
+            # SLO class: the vLLM-style extension key, or OpenAI's
+            # service_tier as the nearest native concept
+            native["slo_class"] = str(
+                opt("slo_class", opt("service_tier")))
         # OpenAI defaults temperature to 1.0 (sampled); clients wanting
         # greedy pass 0 explicitly, exactly as with OpenAI/vLLM
         native["temperature"] = float(opt("temperature", 1.0))
@@ -2605,6 +2696,10 @@ class EngineServer:
                   else int(body["seed"])),
             priority=int(body.get("priority", 0)),
             tenant=str(body.get("tenant", "") or ""),
+            # free-form on the wire, BOUNDED at record time: an
+            # unknown class lands under the "other" label, never a
+            # new series (the O1/slo contract)
+            slo_class=str(body.get("slo_class", "") or ""),
             logprobs=None if logprobs is None else int(logprobs),
             prompt_logprobs=(None if prompt_logprobs is None
                              else int(prompt_logprobs)),
@@ -2658,6 +2753,41 @@ class EngineServer:
             st.update(self._httpd.pool_stats())
         return st
 
+    def profile(self, seconds: float) -> dict:
+        """Capture one jax.profiler trace of *seconds* into
+        ``--profile-dir`` (the /debug/profile handler).  Single-flight:
+        a second capture while one is running raises RuntimeError
+        (jax's profiler is process-global — two overlapping traces
+        corrupt each other).  Blocking by design: the handler's worker
+        sleeps through the capture and answers with the dump dir, so
+        callers (and tests) need no polling protocol.  CPU-safe — the
+        profiler records host traces without an accelerator."""
+        if not self.profile_dir:
+            raise ValueError(
+                "profiling is not configured: start the server with "
+                "--profile-dir")
+        if not 0 < seconds <= 60:
+            raise ValueError("seconds must be in (0, 60]")
+        if not self._profile_lock.acquire(blocking=False):
+            raise RuntimeError("a profile capture is already running")
+        try:
+            import jax
+
+            t0 = time.perf_counter()
+            jax.profiler.start_trace(self.profile_dir)
+            try:
+                time.sleep(seconds)
+            finally:
+                jax.profiler.stop_trace()
+            dt = time.perf_counter() - t0
+        finally:
+            self._profile_lock.release()
+        self._m_profile.inc()
+        self.recorder.record("tpu_serve_profile", seconds=seconds,
+                             duration_s=dt, dir=self.profile_dir)
+        return {"ok": True, "seconds": seconds,
+                "profile_dir": self.profile_dir}
+
     def statz(self) -> dict:
         """The router tier's load signal: one SMALL fixed-schema JSON
         snapshot (queue depth, in-flight copies, KV pool occupancy,
@@ -2680,6 +2810,9 @@ class EngineServer:
                 "queue": int(self._shed_queue.value),
                 "quota": int(self._shed_quota.value),
             },
+            # the fixed-schema goodput block the router's /fleet/statz
+            # aggregates and the autoscaler will key scaling on
+            "goodput": self._slo.summary(),
         }
 
     # -- router registration (multi-replica serving) ------------------------
@@ -2957,6 +3090,29 @@ def main(argv=None) -> int:
                         "lines) to DIR on exit/SIGTERM — the black-box "
                         "post-mortem; unset disables the dump (the "
                         "in-memory ring and /debug/traces stay on)")
+    p.add_argument("--flight-dump-keep", type=int, default=20,
+                   metavar="K",
+                   help="keep only the newest K flight-record dump "
+                        "files in --flight-record-dir (older ones are "
+                        "deleted at dump time; deletions count in "
+                        "tpu_flight_dump_gc_total)")
+    p.add_argument("--slo", action="append", default=None,
+                   metavar="CLASS=TTFT_MS[:DEADLINE_MS]",
+                   help="declare an SLO class (repeatable), e.g. "
+                        "'interactive=250' (TTFT target) or "
+                        "'batch=0:60000' (completion deadline); "
+                        "default: interactive=2500 + batch=0:60000. "
+                        "Requests pick a class with \"slo_class\"; "
+                        "unknown names land under the bounded 'other' "
+                        "label")
+    p.add_argument("--slo-window", type=float, default=60.0,
+                   metavar="S",
+                   help="rolling window (seconds) for the goodput and "
+                        "error-budget burn-rate gauges")
+    p.add_argument("--profile-dir", default=None, metavar="DIR",
+                   help="enable GET /debug/profile?seconds=N: dump "
+                        "jax.profiler traces there (single-flight; "
+                        "env TPU_DP_PROFILE_DIR)")
     p.add_argument("--flight-record-capacity", type=int, default=4096,
                    help="flight-recorder ring size in events "
                         "(drop-oldest past it)")
@@ -3079,6 +3235,19 @@ def main(argv=None) -> int:
         tenant_quotas = parse_tenant_quotas(args.tenant_quota)
     except ValueError as e:
         p.error(str(e))
+    slo_policies = None
+    if args.slo:
+        try:
+            slo_policies = obs.parse_slo_specs(args.slo)
+        except ValueError as e:
+            p.error(str(e))
+    if args.slo_window <= 0:
+        p.error("--slo-window must be > 0")
+    if args.flight_dump_keep < 1:
+        p.error("--flight-dump-keep must be >= 1")
+    import os as _pd_os
+    profile_dir = (args.profile_dir
+                   or _pd_os.environ.get("TPU_DP_PROFILE_DIR"))
 
     # the persistent compile cache must be configured BEFORE the first
     # jit (param build included) or early executables miss it
@@ -3167,7 +3336,11 @@ def main(argv=None) -> int:
                        tenant_quotas=tenant_quotas,
                        packed_prefill=args.packed_prefill,
                        overlap_dispatch=args.overlap_dispatch,
-                       max_pack=args.max_pack)
+                       max_pack=args.max_pack,
+                       slo_policies=slo_policies,
+                       slo_window_s=args.slo_window,
+                       profile_dir=profile_dir,
+                       flight_dump_keep=args.flight_dump_keep)
     if args.fault_spec is not None or args.fault_seed is not None:
         if args.fault_spec is None:
             p.error("--fault-seed needs --fault-spec")
