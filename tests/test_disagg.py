@@ -1,0 +1,504 @@
+"""Disaggregated prefill/decode serving: migration equivalence.
+
+The acceptance bar for the router-v2 disagg path: a request routed
+prefill-replica -> KV migration -> decode-replica must produce output
+BYTE-IDENTICAL to the same request served by one mixed replica —
+unary bodies compared raw, streams compared as their token sequence
+plus the authoritative terminal event (window framing may legally
+coalesce differently across the hop).  The matrix covers greedy,
+seeded sampling, grammar-constrained decoding (including grammar-state
+re-homing onto a decode engine whose combined table has DIFFERENT
+offsets), and APC-hit admissions, plus every router fallback that must
+complete the request before any client byte."""
+
+import http.client
+import json
+import re
+import time
+
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from tpu_k8s_device_plugin import obs  # noqa: E402
+from tpu_k8s_device_plugin.workloads.inference import make_decoder  # noqa: E402
+from tpu_k8s_device_plugin.workloads.router import RouterServer  # noqa: E402
+from tpu_k8s_device_plugin.workloads.server import EngineServer  # noqa: E402
+from tpu_k8s_device_plugin.workloads.serving import ServingEngine  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+from tpu_k8s_device_plugin.workloads.migrate import (  # noqa: E402
+    MigrateError,
+    dump_payload,
+    load_payload,
+)
+
+CFG = dict(vocab=128, d_model=64, n_heads=4, n_layers=2, d_ff=128)
+MAX_LEN = 128
+EOS = 0
+# long enough to clear the router's prefill threshold (16 below) and
+# span multiple admission chunks on the paged engine
+LONG = [(i * 7) % 126 + 1 for i in range(40)]
+
+
+class _ByteTok:
+    def encode(self, s):
+        return list(s.encode("latin-1"))
+
+    def decode(self, ids, **kw):
+        return bytes(int(t) % 256 for t in ids).decode("latin-1")
+
+
+def _build():
+    model = make_decoder(**CFG, max_len=MAX_LEN, dtype=jnp.float32)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+    params = model.init(jax.random.PRNGKey(0), tokens, pos)["params"]
+    return model, params
+
+
+def _server(model, params, role):
+    eng = ServingEngine(model, params, n_slots=2, eos_id=EOS,
+                        kv_paging=True)
+    tb = [bytes([i]) if i else b"" for i in range(CFG["vocab"])]
+    srv = EngineServer(eng, max_new_tokens=16, window=4,
+                       token_bytes=tb, tokenizer=_ByteTok(),
+                       replica_role=role)
+    srv.start(host="127.0.0.1", port=0)
+    return srv
+
+
+@pytest.fixture(scope="module")
+def stack():
+    """One mixed baseline replica + a prefill/decode pair behind a
+    phase-aware router (threshold 16 so LONG migrates)."""
+    model, params = _build()
+    mixed = _server(model, params, "mixed")
+    pre = _server(model, params, "prefill")
+    dec = _server(model, params, "decode")
+    rt = RouterServer(statz_interval_s=0.2, replica_ttl_s=30.0,
+                      seed=5, prefill_threshold=16)
+    rt.start(host="127.0.0.1", port=0)
+    pre.start_registration(f"http://127.0.0.1:{rt.port}",
+                           replica_id="pre-0", model="t",
+                           interval_s=0.3)
+    dec.start_registration(f"http://127.0.0.1:{rt.port}",
+                           replica_id="dec-0", model="t",
+                           interval_s=0.3)
+    deadline = time.time() + 30
+    while time.time() < deadline and sum(
+            r["healthy"] for r in rt.replicas()) < 2:
+        time.sleep(0.05)
+    assert sum(r["healthy"] for r in rt.replicas()) == 2
+    yield mixed, pre, dec, rt
+    rt.stop()
+    mixed.stop()
+    pre.stop()
+    dec.stop()
+
+
+def _post(port, payload, path="/generate"):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    try:
+        conn.request("POST", path, json.dumps(payload),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        body = resp.read()
+        return resp.status, dict(resp.headers), body
+    finally:
+        conn.close()
+
+
+def _stream_view(body):
+    """(concatenated streamed tokens, terminal event) — the stream
+    surfaces that must be identical across the hop (frame coalescing
+    is timing-dependent and may differ legally)."""
+    toks, done = [], None
+    for line in body.strip().split(b"\n"):
+        ev = json.loads(line)
+        if "done" in ev:
+            done = ev
+        elif "tokens" in ev:
+            toks += ev["tokens"]
+        elif "token" in ev:
+            toks.append(ev["token"])
+    return toks, done
+
+
+def test_codec_roundtrips_checkpoint_shapes_exactly():
+    """The wire codec must round-trip every type a preempt checkpoint
+    carries, bit-exactly: nested dicts with int keys, numpy arrays of
+    every pool dtype (bfloat16 included — ml_dtypes stringifies as
+    opaque void, the bug chaos episode 12 caught), tuples, frozensets,
+    non-finite floats."""
+    ml_dtypes = pytest.importorskip("ml_dtypes")
+
+    bf16 = np.arange(12, dtype=np.float32).astype(
+        ml_dtypes.bfloat16).reshape(3, 4)
+    state = {
+        "kv": {0: {"k": bf16, "v": np.ones((2, 2), np.int8)},
+               "scales": np.linspace(0, 1, 5).astype(np.float32)},
+        "record": (np.array([1, 2, 3], np.int32), -1, 3,
+                   np.float32(0.5), None),
+        "stops": frozenset({5, 9}),
+        "outputs": [4, 5, 6],
+        "inf": float("inf"),
+        "nan": float("nan"),
+        "blob": b"\x00\xff",
+        "gstate": -1,
+    }
+    out = load_payload(dump_payload(state))
+    assert out["kv"][0]["k"].dtype == ml_dtypes.bfloat16
+    assert np.array_equal(out["kv"][0]["k"].view(np.uint16),
+                          bf16.view(np.uint16))
+    assert out["kv"][0]["v"].dtype == np.int8
+    assert out["kv"]["scales"].dtype == np.float32
+    assert isinstance(out["record"], tuple)
+    assert np.array_equal(out["record"][0], state["record"][0])
+    assert out["record"][0].dtype == np.int32
+    assert out["stops"] == frozenset({5, 9})
+    assert out["outputs"] == [4, 5, 6]
+    assert out["inf"] == float("inf")
+    assert out["nan"] != out["nan"]
+    assert out["blob"] == b"\x00\xff"
+    assert out["gstate"] == -1
+
+
+def test_codec_rejects_malformed_payloads():
+    with pytest.raises(MigrateError):
+        load_payload(b"not a payload")
+    with pytest.raises(MigrateError):
+        load_payload(b"TPUMIG1\n\x00\x00")       # truncated header
+    good = dump_payload({"a": np.arange(4)})
+    with pytest.raises(MigrateError):
+        load_payload(good[:-3])                   # truncated blob
+
+
+# the equivalence matrix: greedy / seeded sampling / penalties /
+# grammar — each long enough to migrate
+MATRIX = [
+    pytest.param({"tokens": LONG, "max_new_tokens": 10}, id="greedy"),
+    pytest.param({"tokens": LONG, "max_new_tokens": 10,
+                  "temperature": 0.8, "top_p": 0.9, "seed": 7},
+                 id="seeded"),
+    pytest.param({"tokens": LONG, "max_new_tokens": 10,
+                  "presence_penalty": 0.5, "frequency_penalty": 0.2,
+                  "repetition_penalty": 1.1, "temperature": 0.6,
+                  "seed": 3}, id="penalties"),
+    pytest.param({"tokens": LONG, "max_new_tokens": 10,
+                  "guided_regex": r"\d+"}, id="grammar"),
+]
+
+
+@pytest.mark.parametrize("payload", MATRIX)
+def test_unary_byte_identical(stack, payload):
+    mixed, pre, dec, rt = stack
+    body = dict(payload)
+    body["stream"] = False
+    st_m, _, out_m = _post(mixed.port, body)
+    st_r, hd_r, out_r = _post(rt.port, body)
+    assert st_m == st_r == 200, (out_m, out_r)
+    assert hd_r.get("X-Replica") == "dec-0"   # decode served it
+    assert out_r == out_m                      # BYTE-identical
+
+
+@pytest.mark.parametrize("payload", MATRIX)
+def test_stream_identical(stack, payload):
+    mixed, pre, dec, rt = stack
+    st_m, _, out_m = _post(mixed.port, dict(payload))
+    st_r, hd_r, out_r = _post(rt.port, dict(payload))
+    assert st_m == st_r == 200
+    assert hd_r.get("X-Replica") == "dec-0"
+    assert _stream_view(out_r) == _stream_view(out_m)
+
+
+def test_grammar_state_rehomed_across_offset_skew(stack):
+    """The decode engine's combined grammar table has DIFFERENT row
+    offsets than the prefill engine's (a decoy pattern registered
+    first): the migrated gstate must still continue the constraint
+    bit-identically — the rel/abs translation, not luck."""
+    mixed, pre, dec, rt = stack
+    # decoy grammar registered on the DECODE engine only
+    st, _, _ = _post(dec.port, {"tokens": LONG[:8],
+                                "guided_regex": "[ab]+",
+                                "max_new_tokens": 4, "stream": False})
+    assert st == 200
+    assert dec.engine.n_grammars >= 1
+    payload = {"tokens": list(reversed(LONG)), "max_new_tokens": 8,
+               "guided_regex": r"[0-9]+\.[0-9]+", "stream": False}
+    st_m, _, out_m = _post(mixed.port, payload)
+    st_r, hd_r, out_r = _post(rt.port, payload)
+    assert st_m == st_r == 200
+    assert hd_r.get("X-Replica") == "dec-0"
+    assert out_r == out_m
+    # the constraint really was re-homed: both engines know the
+    # pattern, at (potentially) different offsets
+    gid_p = pre._grammar_gids[r"[0-9]+\.[0-9]+"]
+    gid_d = dec._grammar_gids[r"[0-9]+\.[0-9]+"]
+    assert pre.engine._growbounds[gid_p][0] \
+        != dec.engine._growbounds[gid_d][0]
+
+
+def test_apc_hit_paths_migrate_identically(stack):
+    """Admissions that hit the prefill replica's automatic prefix
+    cache — a chunk-aligned shared prefix AND a full-prompt exact
+    repeat — must migrate byte-identically too (the donor splice and
+    the zero-extend repeat both checkpoint exactly)."""
+    mixed, pre, dec, rt = stack
+    base = [(i * 11) % 126 + 1 for i in range(64)]
+    warm = {"tokens": base, "max_new_tokens": 6, "stream": False}
+    # donor: a NORMAL completion on the prefill replica (migrated
+    # admissions free their pages at export, so the donor must come
+    # from a directly-served request) and the same on the baseline
+    st, _, _ = _post(pre.port, warm)
+    assert st == 200
+    st, _, _ = _post(mixed.port, warm)
+    assert st == 200
+    hits_before = pre.engine.stats()["prefix_cache_hits"]
+    # exact repeat -> the zero-extend donor path, then migration
+    st_m, _, out_m = _post(mixed.port, warm)
+    st_r, hd_r, out_r = _post(rt.port, warm)
+    assert st_m == st_r == 200
+    assert hd_r.get("X-Replica") == "dec-0"
+    assert out_r == out_m
+    # shared chunk-aligned prefix with a fresh tail -> partial match
+    tail = {"tokens": base[:32] + [99, 98, 97, 96],
+            "max_new_tokens": 6, "stream": False}
+    st_m, _, out_m = _post(mixed.port, tail)
+    st_r, hd_r, out_r = _post(rt.port, tail)
+    assert st_m == st_r == 200
+    assert out_r == out_m
+    assert pre.engine.stats()["prefix_cache_hits"] > hits_before
+
+
+def test_openai_unary_identical_modulo_ids(stack):
+    """OpenAI completions migrate too: byte-identical after
+    normalizing the per-request id/created fields (same contract as
+    the router's SSE equivalence test)."""
+    mixed, pre, dec, rt = stack
+    payload = {"prompt": "x" * 80, "max_tokens": 6,
+               "temperature": 0.0}
+
+    def norm(b):
+        b = re.sub(rb"cmpl-[0-9a-f]+", b"cmpl-X", b)
+        return re.sub(rb'"created": \d+', b'"created": 0', b)
+
+    st_m, _, out_m = _post(mixed.port, payload,
+                           path="/v1/completions")
+    st_r, hd_r, out_r = _post(rt.port, payload,
+                              path="/v1/completions")
+    assert st_m == st_r == 200, (out_m, out_r)
+    assert hd_r.get("X-Replica") == "dec-0"
+    assert norm(out_r) == norm(out_m)
+
+
+def test_short_and_multicopy_requests_skip_disagg(stack):
+    mixed, pre, dec, rt = stack
+
+    def migrations(outcome):
+        samples = obs.parse_exposition(rt.registry.render())
+        vals = [v for n, lab, v in samples
+                if n == "tpu_router_migrations_total"
+                and lab.get("outcome") == outcome]
+        return vals[0] if vals else 0.0
+
+    before = migrations("ok")
+    st, _, _ = _post(rt.port, {"tokens": [1, 2, 3],
+                               "max_new_tokens": 4})
+    assert st == 200
+    st, _, body = _post(rt.port, {"tokens": LONG, "n": 2,
+                                  "max_new_tokens": 4,
+                                  "stream": False})
+    assert st == 200
+    assert len(json.loads(body)["choices"]) == 2
+    assert migrations("ok") == before
+
+
+def test_finished_at_first_token_declines_and_serves(stack):
+    """A 1-token budget has nothing to migrate: the prefill replica
+    serves the complete response itself and the router relays it
+    (outcome=declined), byte-identical to the baseline."""
+    mixed, pre, dec, rt = stack
+    payload = {"tokens": LONG, "max_new_tokens": 1, "stream": False}
+    st_m, _, out_m = _post(mixed.port, payload)
+    st_r, hd_r, out_r = _post(rt.port, payload)
+    assert st_m == st_r == 200
+    assert hd_r.get("X-Replica") == "pre-0"    # prefill served whole
+    assert out_r == out_m
+    samples = obs.parse_exposition(rt.registry.render())
+    declined = [v for n, lab, v in samples
+                if n == "tpu_router_migrations_total"
+                and lab.get("outcome") == "declined"]
+    assert declined and declined[0] >= 1
+
+
+def test_migration_metrics_journal_and_statz(stack):
+    """Metric/journal proof across all three surfaces: the router's
+    migration counters + ship histogram + stitched journal, and both
+    replicas' /statz migrations ledgers in role lock-step."""
+    mixed, pre, dec, rt = stack
+    st, _, _ = _post(rt.port, {"tokens": LONG, "max_new_tokens": 6,
+                               "stream": False})
+    assert st == 200
+    samples = obs.parse_exposition(rt.registry.render())
+    ok = [v for n, lab, v in samples
+          if n == "tpu_router_migrations_total"
+          and lab.get("outcome") == "ok"]
+    assert ok and ok[0] >= 1
+    ships = [v for n, lab, v in samples
+             if n == "tpu_router_migrate_seconds_count"]
+    assert ships and ships[0] >= 1
+    roles = {lab.get("role"): v for n, lab, v in samples
+             if n == "tpu_router_role_requests_total"}
+    assert roles.get("prefill", 0) >= 1
+    assert roles.get("decode", 0) >= 1
+    names = [e["name"] for e in rt.recorder.events()]
+    assert "tpu_router_migrated" in names
+    statz_p = pre.statz()
+    statz_d = dec.statz()
+    assert statz_p["role"] == "prefill"
+    assert statz_d["role"] == "decode"
+    assert statz_p["migrations"]["out"] >= 1
+    assert statz_d["migrations"]["in"] >= 1
+    p_names = [e["name"] for e in pre.recorder.events()]
+    d_names = [e["name"] for e in dec.recorder.events()]
+    assert "tpu_serve_migrate_out" in p_names
+    assert "tpu_serve_migrate_in" in d_names
+
+
+def test_decode_unreachable_falls_back_before_any_byte(stack):
+    """Kill-mid-migration containment, in-process form: the decode
+    class looks routable but refuses connections — the request must
+    complete through normal routing (no client byte was sent when
+    the migration failed), with the fallback journaled."""
+    import socket
+
+    mixed, pre, dec, rt = stack
+    rt2 = RouterServer(statz_interval_s=60.0, replica_ttl_s=60.0,
+                       seed=9, prefill_threshold=16,
+                       breaker_threshold=10)
+    rt2.start(host="127.0.0.1", port=0)
+    try:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+        s.close()
+        rt2.register({"address": f"127.0.0.1:{pre.port}",
+                      "replica_id": "pre-0", "role": "prefill"})
+        rt2.register({"address": f"127.0.0.1:{dead_port}",
+                      "replica_id": "dec-dead", "role": "decode"})
+        payload = {"tokens": LONG, "max_new_tokens": 6,
+                   "stream": False}
+        st_m, _, out_m = _post(mixed.port, payload)
+        st_r, hd_r, out_r = _post(rt2.port, payload)
+        assert st_r == 200
+        assert hd_r.get("X-Replica") == "pre-0"
+        assert out_r == out_m          # recomputed whole, still exact
+        samples = obs.parse_exposition(rt2.registry.render())
+        fb = [v for n, lab, v in samples
+              if n == "tpu_router_migrations_total"
+              and lab.get("outcome") == "fallback"]
+        assert fb and fb[0] >= 1
+        names = [e["name"] for e in rt2.recorder.events()]
+        assert "tpu_router_migrate_fallback" in names
+    finally:
+        rt2.stop()
+
+
+def test_tenant_quota_global_not_rate_times_replicas(stack):
+    """The acceptance bar for globally-correct quotas: a tenant quota
+    of RATE on a 2-replica fleet sheds at RATE — not 2 x RATE — under
+    evenly-spread load, metric/journal-proven.  Pinning is OFF so the
+    spread is real; the router-level bucket is the global arbiter."""
+    from tpu_k8s_device_plugin.workloads.qos import (
+        parse_tenant_quotas,
+    )
+
+    mixed, pre, dec, rt = stack
+    # burst 60 tokens, cost per request = 8 prompt + 4 budget = 12:
+    # exactly 5 requests fit the burst whatever replica they land on
+    rt2 = RouterServer(statz_interval_s=60.0, replica_ttl_s=60.0,
+                       seed=13, disagg=False, tenant_pinning=False,
+                       tenant_quotas=parse_tenant_quotas(
+                           ["acme=0.001:60"]))
+    rt2.start(host="127.0.0.1", port=0)
+    try:
+        rt2.register({"address": f"127.0.0.1:{pre.port}",
+                      "replica_id": "pre-0", "role": "prefill"})
+        rt2.register({"address": f"127.0.0.1:{dec.port}",
+                      "replica_id": "dec-0", "role": "decode"})
+        # prompts alternating affinity targets (the ring is
+        # id-derived, so this is deterministic): even requests land
+        # on pre-0, odd on dec-0 — a genuinely even spread
+        from tpu_k8s_device_plugin.workloads.router import (
+            affinity_key,
+        )
+
+        def prompt_for(rid, start):
+            for i in range(start, start + 500):
+                cand = [(i + j) % 126 + 1 for j in range(8)]
+                if rt2.affinity_target(affinity_key(
+                        {"tokens": cand},
+                        rt2.prefix_chunk)) == rid:
+                    return cand
+            raise AssertionError(f"no prompt hashed to {rid}")
+
+        statuses, served_by = [], set()
+        for i in range(10):
+            rid = "pre-0" if i % 2 == 0 else "dec-0"
+            st, hd, _ = _post(rt2.port, {
+                "tokens": prompt_for(rid, i * 37 + 1),
+                "max_new_tokens": 4, "stream": False,
+                "tenant": "acme"})
+            statuses.append(st)
+            if st == 200:
+                served_by.add(hd.get("X-Replica"))
+        ok = sum(s == 200 for s in statuses)
+        shed = sum(s == 429 for s in statuses)
+        # RATE-enforced globally: the 60-token burst admits 5, NOT 10
+        # (a per-replica bucket of the same size would admit 10)
+        assert ok == 5, statuses
+        assert shed == 5, statuses
+        # the load really spread over both replicas (pinning off)
+        assert served_by == {"pre-0", "dec-0"}, served_by
+        samples = obs.parse_exposition(rt2.registry.render())
+        qshed = [v for n, lab, v in samples
+                 if n == "tpu_router_shed_total"
+                 and lab.get("reason") == "tenant_quota"]
+        assert qshed and qshed[0] == 5
+        names = [e["name"] for e in rt2.recorder.events()]
+        assert "tpu_router_tenant_quota_shed" in names
+    finally:
+        rt2.stop()
+
+
+def test_prefill_unreachable_falls_back(stack):
+    """The prefill class down entirely: the router skips disagg and
+    the decode replica serves the request whole."""
+    import socket
+
+    mixed, pre, dec, rt = stack
+    rt2 = RouterServer(statz_interval_s=60.0, replica_ttl_s=60.0,
+                       seed=11, prefill_threshold=16,
+                       breaker_threshold=10)
+    rt2.start(host="127.0.0.1", port=0)
+    try:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+        s.close()
+        rt2.register({"address": f"127.0.0.1:{dead_port}",
+                      "replica_id": "pre-dead", "role": "prefill"})
+        rt2.register({"address": f"127.0.0.1:{dec.port}",
+                      "replica_id": "dec-0", "role": "decode"})
+        payload = {"tokens": LONG, "max_new_tokens": 6,
+                   "stream": False}
+        st_m, _, out_m = _post(mixed.port, payload)
+        st_r, hd_r, out_r = _post(rt2.port, payload)
+        assert st_r == 200
+        assert hd_r.get("X-Replica") == "dec-0"
+        assert out_r == out_m
+    finally:
+        rt2.stop()

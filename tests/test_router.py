@@ -157,6 +157,163 @@ def test_register_validation():
     assert out["ok"] and out["replica_id"] == "10.0.0.1:8000"
 
 
+def test_register_role_and_replicas_view():
+    rt = _mk_router()
+    rt.register({"address": "127.0.0.1:9001", "replica_id": "p",
+                 "role": "prefill"})
+    rt.register({"address": "127.0.0.1:9002", "replica_id": "d",
+                 "role": "decode"})
+    rt.register({"address": "127.0.0.1:9003", "replica_id": "m"})
+    roles = {r["replica_id"]: r["role"] for r in rt.replicas()}
+    assert roles == {"p": "prefill", "d": "decode", "m": "mixed"}
+    # re-registration may change the role (a pod restarted with a
+    # different flag keeps its identity)
+    rt.register({"address": "127.0.0.1:9003", "replica_id": "m",
+                 "role": "decode"})
+    assert {r["replica_id"]: r["role"] for r in rt.replicas()}["m"] \
+        == "decode"
+    with pytest.raises(ValueError):
+        rt.register({"address": "127.0.0.1:9004", "role": "gpu"})
+
+
+def test_affinity_target_role_filtered_walk():
+    """Role-filtered affinity stays deterministic (same id+role set
+    -> same target) and always lands on the requested class."""
+    rt = _mk_router()
+    for i in range(3):
+        rt.register({"address": f"127.0.0.1:{9000 + i}",
+                     "replica_id": f"p-{i}", "role": "prefill"})
+        rt.register({"address": f"127.0.0.1:{9100 + i}",
+                     "replica_id": f"d-{i}", "role": "decode"})
+    keys = [affinity_key({"tokens": [i * 3 + j for j in range(64)]},
+                         DEFAULT_PREFIX_CHUNK) for i in range(20)]
+    pre = [rt.affinity_target(k, role="prefill") for k in keys]
+    dec = [rt.affinity_target(k, role="decode") for k in keys]
+    assert all(t is not None and t.startswith("p-") for t in pre)
+    assert all(t is not None and t.startswith("d-") for t in dec)
+    # a restarted router with the same set agrees
+    rt2 = _mk_router()
+    for i in reversed(range(3)):
+        rt2.register({"address": f"127.0.0.1:{9100 + i}",
+                      "replica_id": f"d-{i}", "role": "decode"})
+        rt2.register({"address": f"127.0.0.1:{9000 + i}",
+                      "replica_id": f"p-{i}", "role": "prefill"})
+    assert pre == [rt2.affinity_target(k, role="prefill")
+                   for k in keys]
+    assert dec == [rt2.affinity_target(k, role="decode")
+                   for k in keys]
+    # unfiltered walk is unchanged by the role machinery
+    assert rt.affinity_target(keys[0]) == rt2.affinity_target(keys[0])
+
+
+def test_pick_role_filter_and_no_cross_class_fallback():
+    rt = _mk_router()
+    rt.register({"address": "127.0.0.1:9001", "replica_id": "p",
+                 "role": "prefill"})
+    rt.register({"address": "127.0.0.1:9002", "replica_id": "d",
+                 "role": "decode"})
+    rep, _ = rt.pick(None, role="prefill")
+    assert rep is not None and rep.rid == "p"
+    rep, _ = rt.pick(None, role="decode")
+    assert rep is not None and rep.rid == "d"
+    # the one decode replica excluded -> nothing of that class
+    rep, _ = rt.pick(None, role="decode", exclude={"d"})
+    assert rep is None
+
+
+def test_tenant_ring_deterministic_and_pick_pin():
+    reps = [{"address": f"127.0.0.1:{9000 + i}",
+             "replica_id": f"replica-{i}"} for i in range(4)]
+    rt1 = _mk_router()
+    for r in reps:
+        rt1.register(dict(r))
+    rt2 = _mk_router()
+    for r in reversed(reps):
+        rt2.register(dict(r))
+    tenants = [f"tenant-{i}" for i in range(24)]
+    t1 = [rt1.tenant_target(t) for t in tenants]
+    assert t1 == [rt2.tenant_target(t) for t in tenants]
+    assert len(set(t1)) > 1            # the hash actually spreads
+    assert rt1.tenant_target("") is None
+    # the pin takes precedence over prefix affinity
+    pinned = rt1.tenant_target("tenant-0")
+    other = next(r for r in t1 if r != pinned)
+    key = next(
+        affinity_key({"tokens": [i] * 64}, DEFAULT_PREFIX_CHUNK)
+        for i in range(1, 200)
+        if rt1.affinity_target(
+            affinity_key({"tokens": [i] * 64},
+                         DEFAULT_PREFIX_CHUNK)) == other)
+    rep, hit = rt1.pick(key, pin=pinned)
+    assert rep is not None and rep.rid == pinned and not hit
+    # without the pin the same key goes to its affinity target
+    rep, hit = rt1.pick(key)
+    assert rep is not None and rep.rid == other and hit
+
+
+def test_router_tenant_quota_charges_and_sheds():
+    from tpu_k8s_device_plugin.workloads.qos import (
+        parse_tenant_quotas,
+    )
+
+    rt = _mk_router(
+        tenant_quotas=parse_tenant_quotas(["acme=1:100"]))
+    rt.register({"address": "127.0.0.1:9001", "replica_id": "a"})
+    # cost = (8 prompt + 42 budget) * 1 = 50: two admits drain the
+    # 100-token burst, the third sheds
+    body = {"tokens": [1] * 8, "max_new_tokens": 42,
+            "tenant": "acme"}
+    assert rt._charge_tenant("acme", rt._est_cost(body))
+    assert rt._charge_tenant("acme", rt._est_cost(body))
+    assert not rt._charge_tenant("acme", rt._est_cost(body))
+    # unknown tenants clone the '*' template; absent both, admit
+    assert rt._charge_tenant("other", 1e9)
+    rt2 = _mk_router(
+        tenant_quotas=parse_tenant_quotas(["*=1:10"]))
+    assert rt2._charge_tenant("x", 10.0)
+    assert not rt2._charge_tenant("x", 1.0)
+    assert rt2._charge_tenant("y", 10.0)   # y has its OWN bucket
+
+
+def test_est_cost_mirrors_server_estimate():
+    rt = _mk_router()
+    assert rt._est_cost({"tokens": [1] * 10,
+                         "max_new_tokens": 5}) == 15.0
+    assert rt._est_cost({"tokens": [1] * 10, "max_new_tokens": 5,
+                         "n": 3}) == 45.0
+    # OpenAI spelling + the string-prompt 4-chars/token heuristic
+    assert rt._est_cost({"prompt": "x" * 40, "max_tokens": 6}) == 16.0
+    # absent budget falls back to the configured default
+    assert rt._est_cost({"tokens": [1] * 4}) \
+        == 4.0 + rt.default_budget
+
+
+def test_prefill_heavy_heuristic():
+    rt = _mk_router(prefill_threshold=32)
+    assert rt._prefill_heavy({"tokens": [1] * 32})
+    assert not rt._prefill_heavy({"tokens": [1] * 31})
+    # unary qualifies regardless of length; only an EXPLICIT flag
+    assert rt._prefill_heavy({"tokens": [1] * 4, "stream": False})
+    assert not rt._prefill_heavy({"tokens": [1] * 4})
+    # multi-copy requests never migrate
+    assert not rt._prefill_heavy({"tokens": [1] * 64, "n": 2})
+    # string prompts use the 4-chars/token heuristic
+    assert rt._prefill_heavy({"prompt": "x" * 128})
+    assert not rt._prefill_heavy({"prompt": "x" * 64})
+
+
+def test_disagg_ready_requires_both_classes():
+    rt = _mk_router()
+    rt.register({"address": "127.0.0.1:9001", "replica_id": "p",
+                 "role": "prefill"})
+    assert not rt._disagg_ready()
+    rt.register({"address": "127.0.0.1:9002", "replica_id": "d",
+                 "role": "decode"})
+    assert rt._disagg_ready()
+    rt.disagg = False
+    assert not rt._disagg_ready()
+
+
 def test_router_metric_families_promlint_clean():
     import sys
     sys.path.insert(0, "tools")
@@ -169,6 +326,11 @@ def test_router_metric_families_promlint_clean():
     rt._m_failovers.inc()
     rt._m_affinity.inc()
     rt._m_shed.labels(reason="no_replicas").inc()
+    rt._m_shed.labels(reason="tenant_quota").inc()
+    rt._m_migrations.labels(outcome="ok").inc()
+    rt._m_migrate_s.observe(0.01)
+    rt._m_role_requests.labels(role="prefill").inc()
+    rt._m_tenant_pins.inc()
     errors = promlint.lint(rt.registry.render())
     assert errors == [], errors
 
@@ -632,10 +794,12 @@ def test_statz_lockstep_with_metrics(engine_stack):
     conn.close()
     assert set(statz) == {
         "scheduler_alive", "queue_depth", "in_flight", "capacity",
-        "kv_pages", "kv_pages_free", "requests_served", "shed",
-        "goodput"}
+        "kv_pages", "kv_pages_free", "requests_served", "role",
+        "migrations", "shed", "goodput"}
     assert set(statz["shed"]) == {"connections", "queue", "quota"}
     assert set(statz["goodput"]) == {"window_s", "classes"}
+    assert statz["role"] == "mixed"
+    assert set(statz["migrations"]) == {"out", "in"}
     samples = obs.parse_exposition(srv.render_metrics())
 
     def metric(name):
@@ -660,6 +824,13 @@ def test_statz_lockstep_with_metrics(engine_stack):
             if n == "tpu_serve_shed_total"}
     for reason in ("connections", "queue", "quota"):
         assert statz["shed"][reason] == shed.get(reason, 0)
+    # disagg migration ledger in lock-step with the metric family
+    # (both children render from boot — role notwithstanding)
+    mig = {lab.get("direction"): v for n, lab, v in samples
+           if n == "tpu_serve_migrations_total"}
+    assert set(mig) == {"out", "in"}
+    for direction in ("out", "in"):
+        assert statz["migrations"][direction] == mig[direction]
 
 
 def test_router_429_passthrough_not_failover(engine_stack):

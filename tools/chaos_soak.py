@@ -991,6 +991,220 @@ def episode_packed_prefill_kill(seed):
         srv.stop()
 
 
+def episode_prefill_kill_mid_migration(seed):
+    """Episode 12: the PREFILL-class replica is SIGKILLed while
+    disagg-routed requests are mid-prefill/mid-migration behind the
+    phase-aware router.  Every in-flight request must either complete
+    on a surviving replica (the router's disagg fallbacks all fire
+    BEFORE any client byte, so the request re-routes whole — the
+    decode-class survivor serves it normally) or end in a WELL-FORMED
+    502/503 frame; post-kill traffic lands 200 on the survivor, the
+    victim's breaker opens, and the router's migration counters +
+    journal carry the proof."""
+    import http.client
+    import json
+    import subprocess
+    import threading
+
+    from tpu_k8s_device_plugin.workloads.bench_serving import (
+        _free_port,
+        _wait_http_ok,
+        build_model_and_params,
+    )
+    from tpu_k8s_device_plugin.workloads.router import RouterServer
+    from tpu_k8s_device_plugin.workloads.server import EngineServer
+    from tpu_k8s_device_plugin.workloads.serving import ServingEngine
+
+    rt = RouterServer(statz_interval_s=0.5, replica_ttl_s=5.0,
+                      breaker_reset_s=0.5, seed=seed,
+                      prefill_threshold=64)
+    rt.start(host="127.0.0.1", port=0)
+
+    # survivor: in-process DECODE-class replica with the SAME model
+    # the victim CLI builds (checkpoints only resume onto matching
+    # shapes/dtypes — the builder's deterministic seed makes the two
+    # processes' weights identical, so migrated decode is exact)
+    _cfg, model, params = build_model_and_params("tiny", 512, False)
+    eng = ServingEngine(model, params, n_slots=4,
+                        eos_id=getattr(_cfg, "eos_id", None),
+                        kv_paging=True)
+    survivor = EngineServer(eng, max_new_tokens=64, window=4,
+                            replica_role="decode")
+    survivor.start(host="127.0.0.1", port=0)
+    survivor.start_registration(
+        f"http://127.0.0.1:{rt.port}", replica_id="disagg-decode",
+        model="chaos-tiny", interval_s=0.3)
+
+    # victim: a REAL prefill-class replica subprocess — SIGKILL means
+    # sockets die mid-prefill/mid-export, no drain
+    victim_port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    victim = subprocess.Popen(
+        [sys.executable, "-m",
+         "tpu_k8s_device_plugin.workloads.server",
+         "--config", "tiny", "--n-slots", "4", "--max-len", "512",
+         "--max-new-tokens", "64", "--window", "4", "--kv-paging",
+         "--replica-role", "prefill",
+         "--host", "127.0.0.1", "--port", str(victim_port),
+         "--register-with", f"http://127.0.0.1:{rt.port}",
+         "--replica-id", "disagg-prefill",
+         "--register-interval", "0.3"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    try:
+        _wait_http_ok(victim_port, "/healthz", 600)
+        _wait_http_ok(
+            rt.port, "/replicas", 30,
+            lambda b: sum(r["healthy"] for r in b["replicas"]) >= 2)
+        check(True, "router sees prefill + decode replicas healthy")
+
+        rng = random.Random(seed)
+
+        def long_prompt():
+            return [rng.randrange(1, 128) for _ in range(320)]
+
+        def unary(prompt, budget=24):
+            """One long-prefill unary request through the router;
+            returns (status, X-Replica, parsed body or None, exc)."""
+            conn = http.client.HTTPConnection("127.0.0.1", rt.port,
+                                              timeout=120)
+            try:
+                conn.request("POST", "/generate", json.dumps(
+                    {"tokens": prompt, "max_new_tokens": budget,
+                     "stream": False, "ignore_eos": True}),
+                    {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                body = resp.read()
+                return (resp.status,
+                        resp.headers.get("X-Replica"),
+                        json.loads(body), None)
+            # tpulint: disable=R2 -- not a swallow: the exception is captured into the result tuple and asserted on by the episode (a torn response must FAIL it)
+            except Exception as e:
+                return (-1, None, None, e)
+            finally:
+                conn.close()
+
+        # steady state: migration actually engages
+        st, rep, body, exc = unary(long_prompt())
+        check(exc is None and st == 200 and "done" in (body or {}),
+              f"disagg-routed request completed ({st} via {rep})")
+        check(rep == "disagg-decode",
+              "pre-kill request streamed from the decode replica")
+        samples = obs.parse_exposition(rt.registry.render())
+        ok_migs = [v for n, lab, v in samples
+                   if n == "tpu_router_migrations_total"
+                   and lab.get("outcome") == "ok"]
+        check(ok_migs and ok_migs[0] >= 1,
+              "tpu_router_migrations_total{outcome=ok} counted "
+              "before the kill")
+
+        # -- burst + kill mid-migration --------------------------------
+        results = {}
+        started = threading.Event()
+
+        def burst_one(key):
+            started.wait(timeout=30)
+            results[key] = unary(long_prompt())
+
+        burst = [threading.Thread(target=burst_one, args=(f"r{i}",))
+                 for i in range(6)]
+        for t in burst:
+            t.start()
+        started.set()
+        time.sleep(0.2)     # let prefills land on the victim
+        victim.kill()
+        victim.wait(timeout=30)
+        t_kill = time.monotonic()
+        for t in burst:
+            t.join(timeout=180)
+
+        completed = well_formed_errors = 0
+        for key, (st, rep, body, exc) in sorted(results.items()):
+            check(exc is None,
+                  f"burst request {key} got a parseable response, "
+                  f"not a transport error ({exc})")
+            if st == 200 and body is not None and "done" in body:
+                completed += 1
+            else:
+                # the acceptance contract: a request that could not
+                # complete must end in a STRUCTURED 502/503, never a
+                # torn body
+                check(st in (502, 503) and body is not None
+                      and "error" in body,
+                      f"burst request {key} ended in a well-formed "
+                      f"502/503 frame (got {st}: {body})")
+                well_formed_errors += 1
+        check(completed >= 1,
+              f"requests completed on the surviving replica "
+              f"({completed} of {len(results)} did, "
+              f"{well_formed_errors} well-formed errors)")
+
+        # post-kill: disagg stands down (one class left) and every
+        # new request lands whole on the decode-class survivor
+        for i in range(3):
+            st, rep, body, exc = unary(long_prompt(), budget=8)
+            check(exc is None and st == 200
+                  and rep == "disagg-decode",
+                  f"post-kill request {i} served by the survivor "
+                  f"(got {st} via {rep})")
+        reconverge_s = time.monotonic() - t_kill
+        check(reconverge_s < 60.0,
+              f"post-kill traffic reconverged in {reconverge_s:.1f}s")
+
+        # journal + metric proof
+        samples = obs.parse_exposition(rt.registry.render())
+        fallbacks = sum(
+            v for n, lab, v in samples
+            if n == "tpu_router_migrations_total"
+            and lab.get("outcome") in ("fallback",
+                                       "prefill_unavailable",
+                                       "prefill_error"))
+        migrated_post = [
+            v for n, lab, v in samples
+            if n == "tpu_router_migrations_total"
+            and lab.get("outcome") == "ok"]
+        names = [e["name"] for e in rt.recorder.events()]
+        check(fallbacks >= 1 or "tpu_router_migrate_fallback" in names
+              or completed == len(results),
+              "migration fallback counted or every burst request "
+              "completed through a surviving path")
+        check("tpu_router_migrated" in names,
+              "successful migration journaled")
+        opened = [e for e in rt.recorder.events(
+            name="tpu_breaker_transition")
+            if e["attrs"].get("op")
+            == "router.replica.disagg-prefill"
+            and e["attrs"].get("to") == "open"]
+        stale = [e for e in rt.recorder.events(
+            name="tpu_router_replica_evicted")
+            if e["attrs"].get("replica") == "disagg-prefill"]
+        check(bool(opened or stale),
+              "victim breaker opened (or the stale replica was "
+              "evicted) in the journal")
+        healthy = {lab.get("replica"): v for n, lab, v in samples
+                   if n == "tpu_router_replica_healthy"}
+        check(healthy.get("disagg-decode") == 1,
+              "tpu_router_replica_healthy{disagg-decode} = 1")
+        check(healthy.get("disagg-prefill", 0) == 0,
+              "tpu_router_replica_healthy{disagg-prefill} = 0 "
+              "after the kill")
+        check(migrated_post and migrated_post[0] >= 1,
+              "migration ledger intact after the kill")
+        statz = survivor.statz()
+        check(statz["role"] == "decode",
+              "survivor /statz advertises role=decode")
+    finally:
+        survivor.stop()
+        rt.stop()
+        victim.kill()
+        try:
+            victim.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            pass
+
+
 def _reshape_slice(tmp, testdata, seed, suffix, grace, hb_timeout):
     """A dedicated 2-host slice with live staleness + reshape grace (the
     main soak coordinator drives heartbeats manually with no timeout, so
@@ -1243,6 +1457,9 @@ def main(argv=None) -> int:
             log.info("=== episode 11: scheduler killed mid-packed-"
                      "prefill ===")
             episode_packed_prefill_kill(args.seed)
+            log.info("=== episode 12: prefill replica killed "
+                     "mid-migration ===")
+            episode_prefill_kill_mid_migration(args.seed)
         # -- final convergence sweep ----------------------------------
         for h in hosts:
             h.pulse()

@@ -120,6 +120,15 @@ PHASES = [
     # (CPU proxy: 14s cold -> 4s warm on tiny).
     ("serving_ragged_prefill_b8", 1800),
     ("replica_cold_start", 2400),
+    # round-13 addition: disaggregated prefill/decode on real chips.
+    # CPU router-smoke proves the mechanism (byte-identical migration,
+    # the decode-tail-latency gate); what only hardware can answer is
+    # the real economics — checkpoint ship time vs prefill time at 8B
+    # KV sizes (the payload is MBs per request on TPU, bytes on tiny),
+    # and whether the decode replica's TPOT p99 win survives when
+    # prefill is MXU-bound instead of host-bound.  Compare
+    # decode_tpot_p99_ms_{homog,disagg} + migrate_mean_ms.
+    ("serving_disagg_2rep_b8", 2400),
 ]
 
 
@@ -411,6 +420,25 @@ def phase_serving_ragged_prefill_b8():
     return run_prefill_heavy("llama3-8b", True, clients=8,
                              n_requests=32, slots=8, steps=8,
                              prompt_len=384, max_len=512)
+
+
+def phase_serving_disagg_2rep_b8():
+    """Disaggregated prefill/decode A/B on the 8B int8 target: mixed
+    long-prefill-unary + short-streaming-decode traffic against 2
+    mixed replicas vs a prefill+decode pair (phase routing + KV
+    migration over /migrate), each replica pinned to its own chip.
+    The CPU gate shows decode TPOT p99 improving when long prefills
+    leave the decode replica; on hardware the question is whether
+    that survives MXU-bound prefill AND what the checkpoint ship
+    costs at real KV sizes (migrate_mean_ms vs the prefill it
+    saves)."""
+    from tpu_k8s_device_plugin.workloads.bench_serving import (
+        run_disagg,
+    )
+
+    return run_disagg("llama3-8b", True, clients=8, n_requests=32,
+                      slots=8, steps=64, prompt_len=96, max_len=512,
+                      seed=1)
 
 
 def phase_replica_cold_start():

@@ -854,9 +854,12 @@ def _wait_http_ok(port, path, timeout_s, predicate=None):
 
 
 def _spawn_replica(config, quantized, idx, port, router_port, slots,
-                   steps, prompt_len, max_len):
+                   steps, prompt_len, max_len, role=None,
+                   kv_paging=False):
     """One serving replica subprocess through the REAL CLI (the same
-    path a pod runs), self-registering with the router."""
+    path a pod runs), self-registering with the router.  *role* +
+    *kv_paging* spawn a disaggregated-class replica (prefill/decode
+    roles require the paged pool — migration is preempt/resume)."""
     import os
     import subprocess
     import sys
@@ -874,6 +877,10 @@ def _spawn_replica(config, quantized, idx, port, router_port, slots,
         "--replica-id", f"replica-{idx}",
         "--register-interval", "0.5",
     ]
+    if kv_paging or role not in (None, "mixed"):
+        cmd.append("--kv-paging")
+    if role is not None:
+        cmd += ["--replica-role", role]
     if quantized == "int4":
         cmd.append("--int4")
     elif quantized:
@@ -1149,6 +1156,238 @@ def run_router(config, quantized, n_replicas, clients, n_requests,
     return out
 
 
+def _disagg_load(router_port, long_prompts, short_prompts, steps,
+                 clients, n_requests, lock):
+    """Mixed-phase load through the router: even request ids are
+    long-prefill UNARY completions (the interference source), odd ids
+    short-prompt STREAMING decodes (the interference victim).
+    Returns (wall, unary_lat_s, ttft_s, tpot_s, statuses, errors) —
+    TTFT is request-start to the first streamed line, TPOT the
+    per-token gap over the rest of the stream."""
+    import http.client
+    import json as _json
+    import threading
+    import time
+
+    unary_lat, ttfts, tpots = [], [], []
+    statuses, errors = [], []
+    seq = iter(range(n_requests))
+
+    def client_loop():
+        while True:
+            with lock:
+                i = next(seq, None)
+            if i is None:
+                return
+            try:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", router_port, timeout=600)
+                if i % 2 == 0:
+                    body = _json.dumps({
+                        "tokens": long_prompts[
+                            (i // 2) % len(long_prompts)],
+                        "max_new_tokens": max(4, steps // 4),
+                        "stream": False})
+                    t0 = time.perf_counter()
+                    conn.request("POST", "/generate", body,
+                                 {"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    payload = resp.read()
+                    dt = time.perf_counter() - t0
+                    bad = None
+                    try:
+                        ev = _json.loads(payload)
+                        if "error" in ev:
+                            bad = ev["error"]
+                    except ValueError:
+                        bad = f"unparseable body: {payload[:80]!r}"
+                    with lock:
+                        statuses.append(resp.status)
+                        if resp.status == 200 and bad is None:
+                            unary_lat.append(dt)
+                        elif bad is not None and resp.status == 200:
+                            errors.append(bad)
+                else:
+                    body = _json.dumps({
+                        "tokens": short_prompts[
+                            (i // 2) % len(short_prompts)],
+                        "max_new_tokens": steps,
+                        "ignore_eos": True})
+                    t0 = time.perf_counter()
+                    conn.request("POST", "/generate", body,
+                                 {"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    t_first = t_last = None
+                    n_toks = 0
+                    bad = None
+                    for line in resp:
+                        s = line.strip()
+                        if not s:
+                            continue
+                        now = time.perf_counter()
+                        if t_first is None:
+                            t_first = now
+                        if s.startswith(b'{"tokens":[') \
+                                and s[-2:] == b"]}":
+                            n_toks += s.count(b",") + 1
+                            t_last = now
+                            continue
+                        ev = _json.loads(s)
+                        if "error" in ev:
+                            bad = ev["error"]
+                    with lock:
+                        statuses.append(resp.status)
+                        if bad is not None:
+                            errors.append(bad)
+                        elif t_first is not None:
+                            ttfts.append(t_first - t0)
+                            if n_toks > 1 and t_last is not None \
+                                    and t_last > t_first:
+                                tpots.append((t_last - t_first)
+                                             / (n_toks - 1))
+                conn.close()
+            except OSError as e:
+                with lock:
+                    errors.append(str(e))
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client_loop)
+               for _ in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=900)
+    return (time.perf_counter() - t0, unary_lat, ttfts, tpots,
+            statuses, errors)
+
+
+def run_disagg(config, quantized, clients, n_requests, slots, steps,
+               prompt_len, max_len, seed=0):
+    """Disaggregated prefill/decode A/B (the ROADMAP router-v2 gate):
+    the SAME mixed traffic — long-prefill unary completions
+    interleaved with short-prompt streaming decodes — once against 2
+    homogeneous mixed replicas and once against a prefill+decode pair
+    with phase-aware routing + KV migration.  Reports decode TTFT p99
+    and decode TPOT p99 per arm: on the homogeneous arm long prefills
+    contend with decode windows on whichever replica the ring picks;
+    on the disagg arm decode streams run on a replica that never
+    prefills a long prompt."""
+    import http.client
+    import random
+    import threading
+    import time
+
+    from tpu_k8s_device_plugin import obs
+
+    from .router import RouterServer
+
+    cfg = CONFIGS[config]
+    long_len = min(max_len - steps - 8, max(64, prompt_len * 4))
+    if long_len < 32:
+        raise ValueError(
+            f"--max-len {max_len} leaves no room for a long-prefill "
+            "phase (need >= 32 prompt tokens + the decode budget)")
+    short_len = max(4, prompt_len // 4)
+    rng = random.Random(seed)
+    # DISTINCT long prompts: every one pays a full prefill (no APC
+    # dedupe) — that cost is exactly what the phase split relocates
+    long_prompts = [
+        [rng.randrange(1, cfg.vocab) for _ in range(long_len)]
+        for _ in range(max(2, (n_requests + 1) // 2))]
+    short_prompts = [
+        [rng.randrange(1, cfg.vocab) for _ in range(short_len)]
+        for _ in range(4)]
+    lock = threading.Lock()
+    out = {"disagg": True, "long_prompt_len": float(long_len),
+           "short_prompt_len": float(short_len),
+           "config": config, "quantized": quantized}
+
+    def run_arm(arm):
+        rt = RouterServer(statz_interval_s=0.25, replica_ttl_s=5.0,
+                          breaker_reset_s=1.0, seed=seed,
+                          prefill_threshold=long_len)
+        rt.start(host="127.0.0.1", port=0)
+        roles = (("prefill", "decode") if arm == "disagg"
+                 else ("mixed", "mixed"))
+        procs = []
+        try:
+            for i, role in enumerate(roles):
+                procs.append(_spawn_replica(
+                    config, quantized, i, _free_port(), rt.port,
+                    slots, steps, prompt_len, max_len, role=role,
+                    kv_paging=True))
+            _wait_http_ok(
+                rt.port, "/replicas", 600,
+                lambda b: sum(r["healthy"]
+                              for r in b["replicas"]) >= 2)
+            # warm both request classes (window compiles, packed
+            # shapes, the migration path itself)
+            _disagg_load(rt.port, long_prompts[:2], short_prompts,
+                         steps, min(clients, 4), 8, lock)
+            wall, unary, ttfts, tpots, statuses, errors = \
+                _disagg_load(rt.port, long_prompts, short_prompts,
+                             steps, clients, n_requests, lock)
+            if errors:
+                raise RuntimeError(f"{arm} arm errored: {errors[0]}")
+            if not ttfts or not tpots or not unary:
+                raise RuntimeError(
+                    f"{arm} arm produced no complete samples "
+                    f"(statuses: {statuses[:8]})")
+            res = {
+                f"requests_ok_{arm}": float(
+                    sum(s == 200 for s in statuses)),
+                f"wall_s_{arm}": wall,
+                f"long_unary_p99_ms_{arm}":
+                    _percentile(unary, 0.99) * 1000.0,
+                f"decode_ttft_p99_ms_{arm}":
+                    _percentile(ttfts, 0.99) * 1000.0,
+                f"decode_tpot_p99_ms_{arm}":
+                    _percentile(tpots, 0.99) * 1000.0,
+            }
+            if arm == "disagg":
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", rt.port, timeout=10)
+                conn.request("GET", "/metrics")
+                samples = obs.parse_exposition(
+                    conn.getresponse().read().decode())
+                conn.close()
+                res["migrations_ok"] = sum(
+                    v for n, lab, v in samples
+                    if n == "tpu_router_migrations_total"
+                    and lab.get("outcome") == "ok")
+                ships = [v for n, lab, v in samples
+                         if n == "tpu_router_migrate_seconds_sum"]
+                counts = [v for n, lab, v in samples
+                          if n == "tpu_router_migrate_seconds_count"]
+                if counts and counts[0]:
+                    res["migrate_mean_ms"] = (
+                        ships[0] / counts[0] * 1000.0)
+            return res
+        finally:
+            rt.stop()
+            import subprocess
+
+            for proc in procs:
+                proc.kill()
+            for proc in procs:
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    pass
+
+    out.update(run_arm("homog"))
+    out.update(run_arm("disagg"))
+    if out.get("migrations_ok", 0) < 1:
+        raise RuntimeError(
+            "disagg arm routed no migration — the phase split never "
+            "engaged (check roles/threshold)")
+    out["ttft_p99_ratio"] = (out["decode_ttft_p99_ms_disagg"]
+                             / out["decode_ttft_p99_ms_homog"])
+    out["tpot_p99_ratio"] = (out["decode_tpot_p99_ms_disagg"]
+                             / out["decode_tpot_p99_ms_homog"])
+    return out
+
+
 def run_prefill_heavy(config, quantized, clients, n_requests, slots,
                       steps, prompt_len, max_len):
     """Prefill-dominated A/B: long DISTINCT prompts (no APC dedupe)
@@ -1388,6 +1627,18 @@ def main(argv=None) -> int:
                         "router tier; reports aggregate tokens/sec, "
                         "per-replica share, affinity hit rate, and "
                         "scaling vs 1 replica through the same hop")
+    p.add_argument("--disagg", action="store_true",
+                   help="disaggregated prefill/decode A/B: mixed "
+                        "long-prefill-unary + short-streaming-decode "
+                        "traffic against 2 homogeneous replicas vs a "
+                        "prefill+decode pair with phase routing + KV "
+                        "migration; reports decode TTFT/TPOT p99 per "
+                        "arm (clients from --http, counts from "
+                        "--requests)")
+    p.add_argument("--assert-disagg", action="store_true",
+                   help="with --disagg: exit nonzero unless the "
+                        "disagg arm beats the homogeneous arm on "
+                        "decode TTFT p99 or decode TPOT p99")
     p.add_argument("--assert-scaling", type=float, default=0.0,
                    metavar="FLOOR",
                    help="with --router: exit nonzero unless the "
@@ -1419,12 +1670,12 @@ def main(argv=None) -> int:
             or args.assert_ratio or args.no_interleave
             or args.kv_paging or args.tenants or args.router
             or args.prefill_heavy or args.assert_goodput
-            or args.metrics_out) \
+            or args.metrics_out or args.disagg) \
             and not args.http:
         p.error("--requests/--cancel-every/--burst/--assert-ratio/"
                 "--no-interleave/--kv-paging/--tenants/--router/"
-                "--prefill-heavy/--assert-goodput/--metrics-out "
-                "only apply with --http")
+                "--prefill-heavy/--assert-goodput/--metrics-out/"
+                "--disagg only apply with --http")
     if args.compile_cache_dir and not args.cold_start:
         p.error("--compile-cache-dir only applies with --cold-start")
     if args.cold_start:
@@ -1482,7 +1733,39 @@ def main(argv=None) -> int:
                         or args.tenants or args.no_interleave):
         p.error("--router is its own mode: the single-replica phase "
                 "flags do not apply")
+    if args.assert_disagg and not args.disagg:
+        p.error("--assert-disagg needs --disagg")
+    if args.disagg and (args.router or args.cancel_every
+                        or args.burst or args.assert_ratio
+                        or args.kv_paging or args.tenants
+                        or args.no_interleave):
+        p.error("--disagg is its own mode: the single-replica and "
+                "--router phase flags do not apply")
     quantized = "int4" if args.int4 else args.quantized
+    if args.disagg:
+        try:
+            stats = run_disagg(
+                args.config, quantized, clients=args.http,
+                n_requests=args.requests or 8 * args.http,
+                slots=args.batch, steps=args.steps,
+                prompt_len=args.prompt_len, max_len=args.max_len,
+                seed=args.seed)
+        except (ValueError, RuntimeError) as e:
+            p.error(str(e))
+        for k, v in stats.items():
+            print(f"{k}: {v}")
+        if args.assert_disagg:
+            ttft_r = stats["ttft_p99_ratio"]
+            tpot_r = stats["tpot_p99_ratio"]
+            if min(ttft_r, tpot_r) >= 1.0:
+                print(f"FAIL: disagg beat the homogeneous arm on "
+                      f"neither decode TTFT p99 (x{ttft_r:.3f}) nor "
+                      f"decode TPOT p99 (x{tpot_r:.3f})", flush=True)
+                return 1
+            print(f"OK: disagg decode TTFT p99 x{ttft_r:.3f} / "
+                  f"TPOT p99 x{tpot_r:.3f} vs homogeneous "
+                  "(< 1.0 = better)", flush=True)
+        return 0
     if args.router:
         try:
             stats = run_router(

@@ -132,6 +132,11 @@ class PagePool:
         return range(start // self.page_size,
                      (end - 1) // self.page_size + 1)
 
+    def pages_needed(self, tokens: int) -> int:
+        """Physical pages a *tokens*-long sequence occupies — the
+        capacity arithmetic resume and /migrate admission share."""
+        return (tokens + self.page_size - 1) // self.page_size
+
     # -- allocation ---------------------------------------------------------
 
     def alloc(self) -> int:

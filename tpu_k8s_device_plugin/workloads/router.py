@@ -41,6 +41,29 @@ Routing is two-tier:
    cached — the hot path never parses Prometheus text or blocks on a
    health probe.
 
+**Disaggregated prefill/decode (router v2).**  Replicas register a
+role (``mixed`` | ``prefill`` | ``decode``); when both specialized
+classes are routable, prefill-heavy requests (prompt length >=
+``prefill_threshold``, or explicit unary completions) route
+phase-aware: the prefill replica runs packed prefill only and answers
+with its bit-exact KV checkpoint (``prefill_only`` marker), the
+router ships that payload to a decode replica's internal
+``POST /migrate``, and the decode replica resumes the slot and takes
+over the client stream — outputs byte-identical to single-replica
+serving (the DistServe/Splitwise phase split).  Every failure mode
+falls back BEFORE any client byte: the request re-routes whole
+through the normal path.  Role-filtered ring walks keep phase
+affinity deterministic over the id+role set.
+
+**Globally-correct tenant quotas.**  A SECOND hash ring (distinct
+salt) pins each tenant to one replica (``tenant_pinning``), making
+replica-local buckets/WFQ chains globally coherent per tenant; and
+router-level token buckets (``tenant_quotas``, same grammar and
+semantics as the serving flag) charge the same prompt+budget
+estimate at route time — the arbiter when role routing overrides
+pinning.  Either way a tenant's fleet-wide rate is RATE, not
+RATE x replicas.
+
 Failover rides the resilience layer: a per-replica
 :class:`~tpu_k8s_device_plugin.resilience.CircuitBreaker` plus a
 seeded :class:`~tpu_k8s_device_plugin.resilience.RetryPolicy`.  A
@@ -94,7 +117,19 @@ Metric families::
     tpu_router_affinity_hits_total   requests served by their
                                      prefix-affinity target
     tpu_router_shed_total{reason}    router-side 429/503 sheds
+                                     (connections | no_replicas |
+                                      tenant_quota)
     tpu_router_replica_evictions_total   stale replicas dropped
+    tpu_router_migrations_total{outcome}   disagg KV migrations
+                                     (ok | declined | fallback |
+                                      prefill_unavailable |
+                                      prefill_error)
+    tpu_router_migrate_seconds       checkpoint ship: payload read ->
+                                     /migrate response headers
+    tpu_router_role_requests_total{role}   phases forwarded per
+                                     replica role
+    tpu_router_tenant_pins_total     requests served by their
+                                     tenant-ring pinned replica
 """
 
 from __future__ import annotations
@@ -125,7 +160,26 @@ from typing import (
 from tpu_k8s_device_plugin import obs, resilience
 from tpu_k8s_device_plugin.resilience import faults
 
+from .migrate import MIGRATE_CONTENT_TYPE
+from .qos import TenantQuota, parse_tenant_quotas, resolve_quota
+
 log = logging.getLogger(__name__)
+
+# replica classes for disaggregated prefill/decode serving (the
+# DistServe/Splitwise-style phase split): replicas advertise one via
+# /register, the router routes phase-aware when both specialized
+# classes are present
+REPLICA_ROLES = ("mixed", "prefill", "decode")
+
+# default prompt length (tokens) above which a request counts as
+# prefill-heavy and rides the disagg path; unary requests qualify
+# regardless (their whole latency IS prefill + one batch of decode)
+DEFAULT_PREFILL_THRESHOLD = 128
+
+# budget estimate for router-side tenant accounting when the request
+# does not carry max_new_tokens/max_tokens (mirrors the serving CLI's
+# --max-new-tokens default)
+DEFAULT_BUDGET_ESTIMATE = 256
 
 # the engine's default APC admission grid (ServingEngine
 # prefix_chunk="auto" lowers to 32 when max_len allows): hashing on
@@ -197,6 +251,7 @@ class Replica:
     address: str                      # "host:port"
     model: str = ""
     capacity: int = 0
+    role: str = "mixed"               # mixed | prefill | decode
     registered_at: float = 0.0        # wall clock, for /replicas
     last_seen: float = 0.0            # monotonic: heartbeat OR statz
     statz: Dict[str, Any] = field(default_factory=dict)
@@ -351,13 +406,24 @@ class RouterServer:
                  seed: Optional[int] = None,
                  registry: Optional[obs.Registry] = None,
                  flight_record_dir: Optional[str] = None,
-                 flight_record_capacity: int = 4096) -> None:
+                 flight_record_capacity: int = 4096,
+                 disagg: bool = True,
+                 prefill_threshold: int = DEFAULT_PREFILL_THRESHOLD,
+                 tenant_quotas: Optional[
+                     Dict[str, TenantQuota]] = None,
+                 tenant_pinning: bool = True,
+                 default_budget: int = DEFAULT_BUDGET_ESTIMATE
+                 ) -> None:
         if prefix_chunk < 1:
             raise ValueError("prefix_chunk must be >= 1")
         if failover_attempts < 1:
             raise ValueError("failover_attempts must be >= 1")
         if vnodes < 1:
             raise ValueError("vnodes must be >= 1")
+        if prefill_threshold < 1:
+            raise ValueError("prefill_threshold must be >= 1")
+        if default_budget < 1:
+            raise ValueError("default_budget must be >= 1")
         self.prefix_chunk = prefix_chunk
         self.replica_ttl_s = replica_ttl_s
         self.statz_interval_s = statz_interval_s
@@ -369,11 +435,29 @@ class RouterServer:
         self.breaker_reset_s = breaker_reset_s
         self.connect_timeout_s = connect_timeout_s
         self.client_timeout_s = client_timeout_s
+        # disaggregated prefill/decode (router v2): phase-aware
+        # routing + KV migration are engaged per request, only when
+        # both specialized classes are registered and routable
+        self.disagg = bool(disagg)
+        self.prefill_threshold = prefill_threshold
+        # router-level tenant accounting: the GLOBAL token buckets a
+        # replica-local quota cannot be (an evenly-routed tenant got
+        # RATE x N before), plus sticky tenant->replica pinning on a
+        # SECOND hash ring so replica-local WFQ/quota state stays
+        # coherent per tenant even without router buckets configured
+        self.tenant_quotas: Dict[str, TenantQuota] = dict(
+            tenant_quotas or {})
+        self.tenant_pinning = bool(tenant_pinning)
+        self.default_budget = default_budget
         self._lock = threading.Lock()
         self._replicas: Dict[str, Replica] = {}
         # the ring caches (point -> rid) sorted by point; rebuilt only
-        # when the replica-ID SET changes, so lookups are O(log n)
+        # when the replica-ID SET changes, so lookups are O(log n).
+        # _tring is the tenant-pinning ring: same ids, different salt,
+        # so one replica's share of tenants is independent of its
+        # share of prefix keys
         self._ring: List[Tuple[int, str]] = []
+        self._tring: List[Tuple[int, str]] = []
         self._stop = threading.Event()
         self._httpd: Optional[_PooledRouterHTTPServer] = None
         self._poller: Optional[threading.Thread] = None
@@ -422,6 +506,35 @@ class RouterServer:
             "tpu_router_replica_evictions_total",
             "Replicas evicted for staleness (no heartbeat and no "
             "/statz answer within the TTL).")
+        # -- disaggregated prefill/decode -------------------------------
+        self._m_migrations = reg.counter(
+            "tpu_router_migrations_total",
+            "KV-state migrations attempted by outcome: ok (prefill "
+            "checkpoint resumed on a decode replica), declined (the "
+            "prefill replica served the request whole), "
+            "prefill_unavailable / prefill_error (fell back to "
+            "normal routing before / after prefill), fallback (no "
+            "decode replica accepted the checkpoint; the request "
+            "re-ran normally).", ("outcome",))
+        for oc in ("ok", "declined", "fallback"):
+            self._m_migrations.labels(outcome=oc).inc(0)
+        self._m_migrate_s = reg.histogram(
+            "tpu_router_migrate_seconds",
+            "Checkpoint ship time: prefill payload fully read to the "
+            "decode replica's /migrate response headers (serialize + "
+            "hop + resume admission).", buckets=obs.FAST_BUCKETS_S)
+        self._m_role_requests = reg.counter(
+            "tpu_router_role_requests_total",
+            "Request phases forwarded, by serving-replica role "
+            "(mixed = the homogeneous path; prefill + decode = the "
+            "two halves of one disagg-routed request).", ("role",))
+        for role in REPLICA_ROLES:
+            self._m_role_requests.labels(role=role).inc(0)
+        self._m_tenant_pins = reg.counter(
+            "tpu_router_tenant_pins_total",
+            "Requests served by their tenant-ring pinned replica "
+            "(sticky tenant->replica placement).")
+        self._m_tenant_pins.inc(0)
         reg.on_collect(self._collect_health)
 
     # -- replica table ------------------------------------------------------
@@ -439,13 +552,18 @@ class RouterServer:
         rid = str(payload.get("replica_id") or address)
         model = str(payload.get("model") or "")
         capacity = int(payload.get("capacity") or 0)
+        role = str(payload.get("role") or "mixed")
+        if role not in REPLICA_ROLES:
+            raise ValueError(
+                f"'role' must be one of {'/'.join(REPLICA_ROLES)}")
         with self._lock:
             rep = self._replicas.get(rid)
             fresh = rep is None
             if rep is None:
                 rep = Replica(
                     rid=rid, address=address, model=model,
-                    capacity=capacity, registered_at=time.time(),
+                    capacity=capacity, role=role,
+                    registered_at=time.time(),
                     breaker=resilience.CircuitBreaker(
                         op=f"router.replica.{rid}",
                         failure_threshold=self.breaker_threshold,
@@ -457,6 +575,7 @@ class RouterServer:
             rep.address = address
             rep.model = model or rep.model
             rep.capacity = capacity or rep.capacity
+            rep.role = role
             rep.last_seen = _now()
             # an inline statz piggybacked on the heartbeat freshens the
             # load signal without waiting for the next poll round
@@ -465,11 +584,12 @@ class RouterServer:
                 rep.statz = inline
                 rep.statz_at = rep.last_seen
         if fresh:
-            log.info("replica registered: %s at %s (model=%s cap=%d)",
-                     rid, address, model, capacity)
+            log.info("replica registered: %s at %s (model=%s cap=%d "
+                     "role=%s)", rid, address, model, capacity, role)
             self.recorder.record("tpu_router_replica_registered",
                                  replica=rid, address=address,
-                                 model=model, capacity=capacity)
+                                 model=model, capacity=capacity,
+                                 role=role)
         return {"ok": True, "replica_id": rid,
                 "interval_s": max(self.replica_ttl_s / 3.0, 0.2)}
 
@@ -479,11 +599,19 @@ class RouterServer:
         registration order or wall time — the property the
         same-prompt-same-replica-across-restarts test pins."""
         ring: List[Tuple[int, str]] = []
+        tring: List[Tuple[int, str]] = []
         for rid in self._replicas:
             for v in range(self.vnodes):
                 ring.append((_sha1_int(f"{rid}#{v}".encode()), rid))
+                # distinct salt: a replica's share of TENANTS is
+                # independent of its share of prefix keys (one
+                # unlucky id should not concentrate both)
+                tring.append(
+                    (_sha1_int(f"tenant|{rid}#{v}".encode()), rid))
         ring.sort()
+        tring.sort()
         self._ring = ring
+        self._tring = tring
 
     def _evict_stale_locked(self) -> List[str]:
         now = _now()
@@ -509,22 +637,52 @@ class RouterServer:
         assert rep.breaker is not None
         return rep.breaker.state == resilience.BREAKER_CLOSED
 
-    def affinity_target(self, key: Optional[bytes]) -> Optional[str]:
+    def affinity_target(self, key: Optional[bytes],
+                        role: Optional[str] = None) -> Optional[str]:
         """The ring's verdict for *key* over ALL registered replicas
         (health is the pick's business, not the hash's — a temporarily
         sick target must get its traffic back when it recovers, not
-        have it re-hashed away forever)."""
+        have it re-hashed away forever).  With *role* the walk skips
+        replicas of other classes: the first matching id clockwise
+        from the hash point — still deterministic over the id+role
+        set, so phase-aware affinity keeps the same restart/order
+        stability the plain ring has."""
         if key is None:
             return None
         with self._lock:
             ring = self._ring
+            roles = ({rid: r.role
+                      for rid, r in self._replicas.items()}
+                     if role is not None else None)
+        return self._ring_walk(ring, _sha1_int(key), roles, role)
+
+    @staticmethod
+    def _ring_walk(ring: List[Tuple[int, str]], h: int,
+                   roles: Optional[Dict[str, str]],
+                   role: Optional[str]) -> Optional[str]:
         if not ring:
             return None
-        h = _sha1_int(key)
         i = bisect_left(ring, (h, ""))
-        if i == len(ring):
-            i = 0
-        return ring[i][1]
+        n = len(ring)
+        for step in range(n):
+            rid = ring[(i + step) % n][1]
+            if role is None or (roles is not None
+                                and roles.get(rid) == role):
+                return rid
+        return None
+
+    def tenant_target(self, tenant: str) -> Optional[str]:
+        """Sticky tenant->replica pinning: the tenant ring's verdict
+        (same determinism contract as prefix affinity).  Pinning one
+        tenant's traffic to one replica is what makes the replica's
+        LOCAL WFQ/quota state globally coherent for that tenant."""
+        if not tenant:
+            return None
+        with self._lock:
+            tring = self._tring
+        return self._ring_walk(
+            tring, _sha1_int(tenant.encode("utf-8", "surrogatepass")),
+            None, None)
 
     def _note_evictions(self, dead: List[str]) -> None:
         for rid in dead:
@@ -535,24 +693,35 @@ class RouterServer:
                         rid, self.replica_ttl_s)
 
     def pick(self, key: Optional[bytes],
-             exclude: Optional[Set[str]] = None
+             exclude: Optional[Set[str]] = None,
+             role: Optional[str] = None,
+             pin: Optional[str] = None
              ) -> Tuple[Optional[Replica], bool]:
-        """Choose the replica for one attempt: the prefix-affinity
-        target when it is routable and not overloaded, else the
-        least-loaded routable replica.  Returns (replica,
-        affinity_hit); (None, False) when nothing is routable."""
+        """Choose the replica for one attempt, in precedence order:
+        the *pin* target (sticky tenant placement), the
+        prefix-affinity target, then the least-loaded routable
+        replica — each gated on routable + not overloaded.  *role*
+        restricts every tier to one replica class (the disagg path
+        picks prefill- and decode-class replicas separately).
+        Returns (replica, affinity_hit); (None, False) when nothing
+        is routable."""
         exclude = exclude or set()
-        target = self.affinity_target(key)
+        target = self.affinity_target(key, role=role)
         with self._lock:
             dead = self._evict_stale_locked()
             candidates = [r for rid, r in self._replicas.items()
-                          if rid not in exclude]
+                          if rid not in exclude
+                          and (role is None or r.role == role)]
         self._note_evictions(dead)
-        if target is not None and target not in exclude:
+        for want, is_affinity in ((pin, False), (target, True)):
+            if want is None or want in exclude:
+                continue
             for rep in candidates:
-                if rep.rid == target and self._routable(rep) \
+                if rep.rid == want and self._routable(rep) \
                         and not rep.overloaded(self.overload_factor):
-                    return rep, True
+                    if not is_affinity:
+                        self._m_tenant_pins.inc()
+                    return rep, is_affinity and want == target
         routable = [r for r in candidates if self._routable(r)]
         if not routable:
             return None, False
@@ -572,6 +741,7 @@ class RouterServer:
                 "address": rep.address,
                 "model": rep.model,
                 "capacity": rep.capacity,
+                "role": rep.role,
                 "healthy": self._routable(rep),
                 "breaker_state": rep.breaker.state,
                 "age_s": round(now - rep.last_seen, 3),
@@ -850,22 +1020,141 @@ class RouterServer:
             return ("data: " + json.dumps(wire) + "\n\n").encode()
         return (json.dumps(payload) + "\n").encode()
 
+    @staticmethod
+    def _tenant_of(parsed: Dict[str, Any]) -> str:
+        """The request's QoS identity: 'tenant' (native) or 'user'
+        (OpenAI), exactly the mapping the replicas apply."""
+        tenant = parsed.get("tenant")
+        if tenant is None:
+            tenant = parsed.get("user")
+        return str(tenant) if tenant else ""
+
+    def _est_cost(self, parsed: Dict[str, Any]) -> float:
+        """The same prompt+budget token estimate the replicas charge
+        their local buckets (string prompts approximate at 4 chars
+        per token — the router cannot tokenize)."""
+        tokens = parsed.get("tokens")
+        if isinstance(tokens, list):
+            prompt_toks = len(tokens)
+        else:
+            prompt = parsed.get("prompt")
+            if isinstance(prompt, str):
+                prompt_toks = max(1, len(prompt) // 4)
+            elif isinstance(prompt, list):
+                prompt_toks = len(prompt)
+            else:
+                prompt_toks = 1
+        budget = parsed.get("max_new_tokens",
+                            parsed.get("max_tokens",
+                                       self.default_budget))
+        try:
+            budget_i = int(budget)
+        except (TypeError, ValueError):
+            budget_i = self.default_budget
+        try:
+            n = max(1, int(parsed.get("n", 1)))
+        except (TypeError, ValueError):
+            n = 1
+        return float((prompt_toks + budget_i) * n)
+
+    def _charge_tenant(self, tenant: str, cost: float) -> bool:
+        """Fleet-level token bucket: True = admitted.  Only engaged
+        when router quotas are configured; the '*' template clones
+        per-tenant state exactly like the replica-local buckets."""
+        if not tenant or not self.tenant_quotas:
+            return True
+        with self._lock:
+            quota = resolve_quota(self.tenant_quotas, tenant)
+            return quota is None or quota.try_charge(cost)
+
+    def _prefill_heavy(self, parsed: Dict[str, Any]) -> bool:
+        """Does this request belong on a prefill-class replica?
+        Prompt length above the threshold, or a unary completion
+        (its whole latency is prefill + batched decode — exactly the
+        work that interferes with latency-sensitive decode streams)."""
+        if int(parsed.get("n", 1) or 1) != 1:
+            return False    # multi-copy requests never migrate
+        tokens = parsed.get("tokens")
+        prompt = parsed.get("prompt")
+        if isinstance(tokens, list):
+            prompt_toks = len(tokens)
+        elif isinstance(prompt, list):
+            prompt_toks = len(prompt)
+        elif isinstance(prompt, str):
+            prompt_toks = max(1, len(prompt) // 4)
+        else:
+            return False    # chat messages etc.: length unknowable
+        if prompt_toks >= self.prefill_threshold:
+            return True
+        # default stream semantics differ per wire: native /generate
+        # defaults to streaming, OpenAI completions to unary — the
+        # router only trusts an EXPLICIT stream flag either way
+        return parsed.get("stream") is False
+
+    def _disagg_ready(self) -> bool:
+        """Both specialized classes registered and routable?"""
+        if not self.disagg:
+            return False
+        with self._lock:
+            reps = list(self._replicas.values())
+        has = {"prefill": False, "decode": False}
+        for rep in reps:
+            if rep.role in has and self._routable(rep):
+                has[rep.role] = True
+        return has["prefill"] and has["decode"]
+
     def proxy(self, handler: "BaseHTTPRequestHandler", path: str,
               body: bytes, trace: "obs.TraceContext") -> None:
         """Route one request: pick -> forward -> stream back.  All the
         failover semantics live here; see the module docstring."""
         t_arrival = time.perf_counter()
+        parsed: Dict[str, Any] = {}
         try:
-            parsed = json.loads(body) if body else {}
+            decoded = json.loads(body) if body else {}
+            if isinstance(decoded, dict):
+                parsed = decoded
             key = affinity_key(parsed, self.prefix_chunk) \
-                if isinstance(parsed, dict) else None
+                if parsed else None
         except (ValueError, TypeError):
             key = None
+        tenant = self._tenant_of(parsed)
+        if not self._charge_tenant(tenant, self._est_cost(parsed)):
+            # fleet-level 429: the tenant's GLOBAL rate is spent —
+            # same wire shape as a replica quota shed, so clients
+            # cannot tell (and need not care) which tier said no
+            self._m_shed.labels(reason="tenant_quota").inc()
+            self._m_requests.labels(replica="none",
+                                    outcome="shed").inc()
+            self.recorder.record("tpu_router_tenant_quota_shed",
+                                 trace=trace, tenant=tenant)
+            out = (json.dumps({
+                "error": f"tenant {tenant} over fleet token-rate "
+                         "quota; retry later", "code": 429})
+                + "\n").encode()
+            handler.send_response(429)
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Content-Length", str(len(out)))
+            handler.send_header("Retry-After", "1")
+            handler.end_headers()
+            try:
+                handler.wfile.write(out)
+            except OSError:
+                pass
+            return
+        if parsed and self._prefill_heavy(parsed) \
+                and self._disagg_ready():
+            if self._proxy_disagg(handler, path, parsed, key, trace,
+                                  t_arrival):
+                return
+            # every disagg fallback happens BEFORE any client byte:
+            # the request re-runs whole through the normal path
         headers = {
             "Content-Type": handler.headers.get(
                 "Content-Type", "application/json"),
             "traceparent": trace.to_traceparent(),
         }
+        pin = (self.tenant_target(tenant)
+               if tenant and self.tenant_pinning else None)
         tried: Set[str] = set()
         conn: Optional[http.client.HTTPConnection] = None
         resp: Optional[http.client.HTTPResponse] = None
@@ -873,7 +1162,7 @@ class RouterServer:
         hit = False
         last_err: Optional[_UpstreamError] = None
         for attempt in range(1, self.failover_attempts + 1):
-            rep, hit = self.pick(key, exclude=tried)
+            rep, hit = self.pick(key, exclude=tried, pin=pin)
             if rep is None:
                 break
             if attempt > 1:
@@ -932,14 +1221,150 @@ class RouterServer:
                 outcome="unroutable",
                 duration_s=time.perf_counter() - t_arrival)
             return
-        # -- stream the response back, byte-identical -------------------
+        self._relay(handler, conn, resp, rep, hit, len(tried), trace,
+                    t_arrival)
+
+    def _proxy_disagg(self, handler: "BaseHTTPRequestHandler",
+                      path: str, parsed: Dict[str, Any],
+                      key: Optional[bytes],
+                      trace: "obs.TraceContext",
+                      t_arrival: float) -> bool:
+        """The phase-disaggregated route for one prefill-heavy
+        request: forward it to a prefill-class replica with the
+        ``prefill_only`` marker (it runs packed prefill, then
+        preempts the fresh slot and answers with the bit-exact
+        serialized checkpoint), ship that checkpoint to a
+        decode-class replica's ``POST /migrate`` (it resumes the slot
+        and takes over the stream), and pass the decode replica's
+        response through to the client byte-identically.
+
+        Every failure mode falls back BEFORE any client byte: returns
+        False and the caller re-routes the ORIGINAL request through
+        the normal path (prefill already freed its pages at export,
+        so a re-run recomputes from scratch — slower, never wrong).
+        True means the response was fully handled here."""
+        body2 = dict(parsed)
+        body2["prefill_only"] = True
+        raw2 = json.dumps(body2).encode()
+        headers = {"Content-Type": "application/json",
+                   "traceparent": trace.to_traceparent()}
+        tried: Set[str] = set()
+        prep: Optional[Replica] = None
+        conn: Optional[http.client.HTTPConnection] = None
+        resp: Optional[http.client.HTTPResponse] = None
+        hit = False
+        for attempt in range(1, self.failover_attempts + 1):
+            prep, hit = self.pick(key, exclude=tried, role="prefill")
+            if prep is None:
+                break
+            tried.add(prep.rid)
+            t0 = time.perf_counter()
+            try:
+                conn, resp = self._open_upstream(
+                    prep, path, raw2, headers)
+            except _UpstreamError as e:
+                self._m_route.observe(time.perf_counter() - t0)
+                self.recorder.record(
+                    "tpu_router_attempt_failed", trace=trace,
+                    replica=prep.rid, attempt=attempt, error=str(e),
+                    phase="prefill")
+                if attempt < self.failover_attempts:
+                    time.sleep(self.retry.backoff_s(attempt))
+                continue
+            self._m_route.observe(time.perf_counter() - t0)
+            break
+        if resp is None or conn is None or prep is None:
+            self._m_migrations.labels(
+                outcome="prefill_unavailable").inc()
+            self.recorder.record("tpu_router_migrate_fallback",
+                                 trace=trace, stage="prefill_pick",
+                                 tried=",".join(sorted(tried)))
+            return False
+        ctype = resp.headers.get("Content-Type", "")
+        if resp.status != 200 \
+                or not ctype.startswith(MIGRATE_CONTENT_TYPE):
+            # the prefill replica declined (request finished at its
+            # first token, or eligibility said no) or answered a
+            # client error: its response IS the response — relay it
+            self._m_migrations.labels(outcome="declined").inc()
+            self.recorder.record("tpu_router_migrate_declined",
+                                 trace=trace, replica=prep.rid,
+                                 status=resp.status)
+            self._relay(handler, conn, resp, prep, hit, len(tried),
+                        trace, t_arrival)
+            return True
+        try:
+            payload = resp.read()
+        except (OSError, http.client.HTTPException) as e:
+            conn.close()
+            assert prep.breaker is not None
+            prep.breaker.record_failure()
+            self._m_migrations.labels(outcome="prefill_error").inc()
+            self.recorder.record("tpu_router_migrate_fallback",
+                                 trace=trace, stage="payload_read",
+                                 replica=prep.rid, error=str(e))
+            return False
+        conn.close()
+        self._m_role_requests.labels(role="prefill").inc()
+        t_ship = time.perf_counter()
+        mheaders = {"Content-Type": MIGRATE_CONTENT_TYPE,
+                    "traceparent": trace.to_traceparent()}
+        dtried: Set[str] = set()
+        for attempt in range(1, self.failover_attempts + 1):
+            drep, dhit = self.pick(key, exclude=dtried, role="decode")
+            if drep is None:
+                break
+            dtried.add(drep.rid)
+            try:
+                dconn, dresp = self._open_upstream(
+                    drep, "/migrate", payload, mheaders)
+            except _UpstreamError as e:
+                self.recorder.record(
+                    "tpu_router_attempt_failed", trace=trace,
+                    replica=drep.rid, attempt=attempt, error=str(e),
+                    phase="migrate")
+                if attempt < self.failover_attempts:
+                    time.sleep(self.retry.backoff_s(attempt))
+                continue
+            if dresp.status != 200:
+                # a 4xx from /migrate is a malformed/unresumable
+                # payload, not replica pressure (pressure answers
+                # 503 and was retried above): re-running the request
+                # whole beats poking other replicas with bad bytes
+                dconn.close()
+                break
+            ship_dt = time.perf_counter() - t_ship
+            self._m_migrate_s.observe(ship_dt)
+            self._m_migrations.labels(outcome="ok").inc()
+            self.recorder.record(
+                "tpu_router_migrated", trace=trace,
+                prefill=prep.rid, decode=drep.rid,
+                bytes=len(payload), ship_s=ship_dt)
+            self._relay(handler, dconn, dresp, drep, dhit,
+                        len(tried) + len(dtried), trace, t_arrival)
+            return True
+        self._m_migrations.labels(outcome="fallback").inc()
+        self.recorder.record("tpu_router_migrate_fallback",
+                             trace=trace, stage="decode_pick",
+                             prefill=prep.rid,
+                             tried=",".join(sorted(dtried)))
+        return False
+
+    def _relay(self, handler: "BaseHTTPRequestHandler",
+               conn: http.client.HTTPConnection,
+               resp: http.client.HTTPResponse, rep: Replica,
+               hit: bool, attempts: int, trace: "obs.TraceContext",
+               t_arrival: float) -> None:
+        """Stream one upstream response back, byte-identical (the
+        shared tail of the normal and disagg proxy paths)."""
         outcome = "ok" if resp.status < 400 else (
             "shed" if resp.status == 429 else "client_error")
         if hit:
             self._m_affinity.inc()
+        self._m_role_requests.labels(role=rep.role).inc()
         self.recorder.record(
             "tpu_router_routed", trace=trace, replica=rep.rid,
-            status=resp.status, affinity=hit, attempts=len(tried),
+            status=resp.status, affinity=hit, attempts=attempts,
             duration_s=time.perf_counter() - t_arrival)
         content_type = resp.headers.get("Content-Type",
                                         "application/json")
@@ -1214,6 +1639,41 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "capacity (falls back to least-loaded)")
     p.add_argument("--breaker-reset", type=float, default=2.0,
                    help="per-replica circuit-breaker reset timeout")
+    p.add_argument("--disagg", default=True,
+                   action=argparse.BooleanOptionalAction,
+                   help="phase-aware routing (default on, engaged "
+                        "only when prefill- AND decode-class "
+                        "replicas are registered): prefill-heavy "
+                        "requests prefill on a prefill replica, the "
+                        "finished KV state migrates to a decode "
+                        "replica over POST /migrate, and decode "
+                        "streams from there undisturbed")
+    p.add_argument("--prefill-threshold", type=int,
+                   default=DEFAULT_PREFILL_THRESHOLD, metavar="N",
+                   help="prompt length (tokens) at or above which a "
+                        "request counts as prefill-heavy; unary "
+                        "requests qualify regardless")
+    p.add_argument("--tenant-quota", action="append", default=None,
+                   metavar="NAME=RATE[:BURST[:WEIGHT]]",
+                   help="FLEET-level per-tenant token-rate quota "
+                        "(same grammar as the serving flag; '*' is "
+                        "the template for unknown tenants): the "
+                        "router charges prompt+budget estimates at "
+                        "route time and sheds 429 past the rate — "
+                        "the globally-correct bucket replica-local "
+                        "quotas cannot be")
+    p.add_argument("--tenant-pinning", default=True,
+                   action=argparse.BooleanOptionalAction,
+                   help="sticky tenant->replica placement on a "
+                        "second hash ring (default on): one tenant's "
+                        "traffic lands on one replica, so the "
+                        "replica-local WFQ/quota state is coherent "
+                        "per tenant even without router quotas")
+    p.add_argument("--default-budget", type=int,
+                   default=DEFAULT_BUDGET_ESTIMATE, metavar="N",
+                   help="max-new-tokens estimate for tenant "
+                        "accounting when a request does not carry "
+                        "one (match the replicas' --max-new-tokens)")
     p.add_argument("--seed", type=int, default=None,
                    help="failover backoff jitter seed (chaos replay)")
     p.add_argument("--fault-spec", default=None, metavar="SPEC",
@@ -1226,6 +1686,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname).1s %(name)s: %(message)s")
+    try:
+        tenant_quotas = parse_tenant_quotas(args.tenant_quota)
+    except ValueError as e:
+        p.error(str(e))
     rt = RouterServer(
         prefix_chunk=args.prefix_chunk,
         replica_ttl_s=args.replica_ttl,
@@ -1235,7 +1699,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         overload_factor=args.overload_factor,
         breaker_reset_s=args.breaker_reset,
         seed=args.seed,
-        flight_record_dir=args.flight_record_dir)
+        flight_record_dir=args.flight_record_dir,
+        disagg=args.disagg,
+        prefill_threshold=args.prefill_threshold,
+        tenant_quotas=tenant_quotas,
+        tenant_pinning=args.tenant_pinning,
+        default_budget=args.default_budget)
     if args.fault_spec:
         faults.install(args.fault_spec, seed=args.seed or 0,
                        recorder=rt.recorder)

@@ -64,12 +64,21 @@ API (JSON over HTTP/1.1):
   POST /v1/chat/completions   chat variant: "messages" rendered by
                    the tokenizer's chat template; responses carry
                    message/delta objects in the chat wire shape.
+  POST /migrate    INTERNAL (replica-to-replica via the router tier):
+                   resume a prefill-class replica's bit-exact KV
+                   checkpoint into a slot here and serve the
+                   request's stream from where prefill left off —
+                   the decode half of disaggregated serving.  The
+                   body is the migrate codec's binary payload; a
+                   ``prefill_only`` marker on the generate/OpenAI
+                   endpoints produces it (see --replica-role).
   GET  /healthz    liveness ("ok").
   GET  /stats      engine + server counters (JSON).
   GET  /statz      one CHEAP load snapshot for the router tier
                    (queue depth, in-flight, free/total KV pages, shed
-                   counts, scheduler health) — fixed small schema, no
-                   Prometheus text on the routing hot path.
+                   counts, scheduler health, replica role, migration
+                   ledger) — fixed small schema, no Prometheus text
+                   on the routing hot path.
   GET  /metrics    the same counters in Prometheus exposition format
                    (Accept: application/openmetrics-text adds trace-id
                    exemplars on the latency histograms).
@@ -135,6 +144,16 @@ from .scheduler import (
     IterationScheduler,
 )
 from .kv_pool import PagePoolExhausted
+from .migrate import (
+    MIGRATE_CONTENT_TYPE,
+    MigrateError,
+    dump_payload,
+    load_payload,
+)
+# TenantQuota moved to the jax-free qos module (the router enforces
+# the same bucket semantics fleet-wide); re-exported here because
+# embedders and the QoS suite import it from server
+from .qos import TenantQuota, parse_tenant_quotas, resolve_quota
 from .serving import ServingEngine
 
 log = logging.getLogger(__name__)
@@ -494,62 +513,14 @@ class _Request:
     # terminal record is judged against
     slo_class: str = ""
     ttft_s: float = -1.0
-
-
-class TenantQuota:
-    """Per-tenant QoS config: a token-rate budget (token bucket over
-    ESTIMATED tokens — prompt + requested budget — charged at
-    admission) and a WFQ weight.  ``rate <= 0`` disables the bucket
-    (weight-only tenants); ``weight`` scales the tenant's share of
-    the admission heap under contention."""
-
-    __slots__ = ("rate", "burst", "weight", "tokens", "stamp",
-                 "_last_vft")
-
-    def __init__(self, rate: float, burst: Optional[float] = None,
-                 weight: float = 1.0):
-        if weight <= 0:
-            raise ValueError("tenant weight must be > 0")
-        self.rate = float(rate)
-        self.burst = float(burst if burst is not None
-                           else max(rate, 1.0))
-        self.weight = float(weight)
-        self.tokens = self.burst       # bucket starts full
-        self.stamp = time.monotonic()
-        self._last_vft = 0.0           # WFQ backlog marker
-
-    def try_charge(self, cost: float) -> bool:
-        """Refill-then-charge; False = over quota (shed with 429)."""
-        if self.rate <= 0:
-            return True
-        now = time.monotonic()
-        self.tokens = min(self.burst,
-                          self.tokens + (now - self.stamp) * self.rate)
-        self.stamp = now
-        if self.tokens < cost:
-            return False
-        self.tokens -= cost
-        return True
-
-
-def parse_tenant_quotas(specs) -> dict:
-    """``name=rate[:burst[:weight]]`` (repeatable; name ``*`` is the
-    default for unknown tenants) -> {name: TenantQuota}."""
-    out: dict = {}
-    for spec in specs or ():
-        name, _, rest = spec.partition("=")
-        if not name or not rest:
-            raise ValueError(
-                f"bad --tenant-quota {spec!r} (want "
-                "name=rate[:burst[:weight]])")
-        parts = rest.split(":")
-        if len(parts) > 3:
-            raise ValueError(f"bad --tenant-quota {spec!r}")
-        rate = float(parts[0])
-        burst = float(parts[1]) if len(parts) > 1 else None
-        weight = float(parts[2]) if len(parts) > 2 else 1.0
-        out[name] = TenantQuota(rate, burst, weight)
-    return out
+    # disaggregated prefill/decode (router v2): prefill_only requests
+    # run packed prefill, then the scheduler preempts the fresh slot
+    # and the handler answers with the serialized checkpoint instead
+    # of a token stream (the router ships it to a decode replica);
+    # migrated marks a /migrate-resumed request on the decode side
+    # (its quota was charged at the prefill replica — never twice)
+    prefill_only: bool = False
+    migrated: bool = False
 
 
 class _PooledHTTPServer(HTTPServer):
@@ -694,7 +665,8 @@ class EngineServer:
                  slo_policies: Optional[dict] = None,
                  slo_window_s: float = 60.0,
                  profile_dir: Optional[str] = None,
-                 flight_dump_keep: int = 20):
+                 flight_dump_keep: int = 20,
+                 replica_role: str = "mixed"):
         """*tokenizer* (anything with ``encode(str) -> List[int]`` and
         ``decode(List[int]) -> str``, e.g. a transformers tokenizer)
         unlocks the text-level surface: ``"prompt"`` strings, STRING
@@ -741,6 +713,21 @@ class EngineServer:
         self.max_events = max_events
         self.max_grammar_states = max_grammar_states
         self.client_timeout = client_timeout
+        # disaggregated serving role (router v2): advertised through
+        # /register and /statz so the router routes phase-aware.
+        # prefill/decode classes need the paged pool — migration IS
+        # preempt-on-A/resume-on-B, and only paged slots checkpoint
+        if replica_role not in ("mixed", "prefill", "decode"):
+            raise ValueError(
+                f"replica_role {replica_role!r} must be mixed, "
+                "prefill, or decode")
+        if replica_role != "mixed" and not getattr(
+                engine, "kv_paging", False):
+            raise ValueError(
+                f"replica_role={replica_role!r} needs a paged engine "
+                "(kv_paging=True): KV migration is preempt/resume, "
+                "which only the paged pool checkpoints")
+        self.replica_role = replica_role
         self._grammar_tdfas: dict = {}    # pattern -> TokenDfa
         self._grammar_gids: dict = {}     # pattern -> engine gid
         self._glock = threading.Lock()
@@ -834,6 +821,22 @@ class EngineServer:
             "tpu_serve_prefix_evictions_total",
             "Prefix-registry/parked-donor records evicted by the LRU "
             "cap or pool-pressure reclaim.")
+        # -- disaggregated prefill/decode migration -----------------------
+        # out = prefill-only requests exported as a checkpoint (this
+        # replica ran packed prefill, the router shipped the KV state
+        # on); in = /migrate checkpoints resumed here.  Both children
+        # materialize at boot so /statz and the family stay lock-step
+        # from the first scrape, role notwithstanding
+        self._m_migrations = reg.counter(
+            "tpu_serve_migrations_total",
+            "KV-state migrations by direction: out = prefill-only "
+            "admissions preempted and exported to the router, in = "
+            "/migrate checkpoints resumed on this replica.",
+            ("direction",))
+        self._mig_out = self._m_migrations.labels(direction="out")
+        self._mig_in = self._m_migrations.labels(direction="in")
+        self._mig_out.inc(0)
+        self._mig_in.inc(0)
         # -- ragged packed prefill + warmup -------------------------------
         self._m_packed_reqs = reg.counter(
             "tpu_serve_packed_prefill_requests_total",
@@ -965,14 +968,7 @@ class EngineServer:
         unknown tenant gets its own bucket and WFQ chain cloned from
         it (shared state would let one tenant drain another's
         budget).  Caller holds ``_lock``."""
-        q = self.tenant_quotas.get(tenant)
-        if q is None:
-            d = self.tenant_quotas.get("*")
-            if d is None:
-                return None
-            q = TenantQuota(d.rate, d.burst, d.weight)
-            self.tenant_quotas[tenant] = q
-        return q
+        return resolve_quota(self.tenant_quotas, tenant)
 
     def _preempt_for_pages(self, exclude_slot: int = -1) -> bool:
         """The engine's page-pressure escalation (scheduler thread):
@@ -1045,6 +1041,11 @@ class EngineServer:
         if sp is not None:
             req.span = None
             total_s = sp.end(outcome=outcome)
+            if outcome == "migrated":
+                # the request is still IN FLIGHT fleet-wise: the
+                # decode replica that resumed the checkpoint records
+                # the one true SLO verdict when the stream terminates
+                return
             # requests that never declared a class derive one from
             # their shape: streaming callers care about TTFT
             # (interactive), unary callers about the deadline (batch)
@@ -1102,9 +1103,32 @@ class EngineServer:
                 # its tokens — re-queueing it behind fresh work would
                 # strand a half-finished stream
                 idx = next(iter(req.preempted))
+                state = req.preempted[idx]
+                if state.get("gstate_rel", False):
+                    # a MIGRATED checkpoint carries grammar state in
+                    # grammar-local form (absolute table offsets are
+                    # per-engine): register the pattern here (cached)
+                    # and re-home the state onto our combined table
+                    try:
+                        rel = int(state["gstate"])
+                        if rel >= 0:
+                            if req.grammar_key is None:
+                                raise ValueError(
+                                    "migrated checkpoint carries "
+                                    "grammar state but the request "
+                                    "declares no grammar")
+                            state["gstate"] = eng.grammar_abs(
+                                int(self._ensure_grammar(req)), rel)
+                        state.pop("gstate_rel", None)
+                    except ValueError as e:
+                        req.preempted.clear()
+                        self._requests_rejected += 1
+                        self._push(req, {"error": str(e), "code": 400})
+                        self._finish_request(req, "rejected")
+                        continue
                 try:
-                    slot = eng.resume(req.preempted[idx])
-                except (RuntimeError, PagePoolExhausted):
+                    slot = eng.resume(state)
+                except PagePoolExhausted:
                     # still no capacity: back on the heap, stop
                     # pulling this round (decode progress frees pages)
                     with self._lock:
@@ -1114,6 +1138,27 @@ class EngineServer:
                             (-req.priority, req._vft,
                              self._pending_seq, req))
                     return None
+                except RuntimeError:
+                    # no free slot this round: requeue, stop pulling
+                    with self._lock:
+                        self._pending_seq += 1
+                        heapq.heappush(
+                            self._pending,
+                            (-req.priority, req._vft,
+                             self._pending_seq, req))
+                    return None
+                except (ValueError, TypeError, KeyError) as e:
+                    # cross-process payloads can be arbitrarily wrong
+                    # (shape/dtype skew between replica builds): a
+                    # bad one must 400 its own request, not take the
+                    # scheduler thread down with it
+                    req.preempted.clear()
+                    self._requests_rejected += 1
+                    self._push(req, {
+                        "error": "migrated checkpoint failed to "
+                                 f"resume: {e}", "code": 400})
+                    self._finish_request(req, "rejected")
+                    continue
                 del req.preempted[idx]
                 self._running[slot] = (req, idx)
                 self.recorder.record(
@@ -1166,24 +1211,7 @@ class EngineServer:
                     # scheduler is the engine's sole owner; the pattern
                     # cache makes it once-per-pattern, so the steady
                     # state is a dict lookup
-                    with self._glock:
-                        gid = self._grammar_gids.get(req.grammar_key)
-                    if gid is None:
-                        gid = eng.register_grammar(req.grammar_tdfa)
-                        with self._glock:
-                            # one critical section for the registered/
-                            # pending handoff: handler threads read
-                            # BOTH maps for the max_grammars bound and
-                            # the compile-skip check, so the insert and
-                            # the pop must land atomically (ADVICE r5).
-                            # Dropping the standalone TokenDfa matters
-                            # too: keeping it would pin a second full
-                            # [N, V] host copy per pattern for the
-                            # server's lifetime
-                            self._grammar_gids[req.grammar_key] = gid
-                            self._grammar_tdfas.pop(req.grammar_key,
-                                                    None)
-                    req.grammar_tdfa = None  # registered; drop the ref
+                    gid = self._ensure_grammar(req)
                 if req.admitted == 0 and req.t_arrival:
                     wait_dt = time.perf_counter() - req.t_arrival
                     self._m_queue_wait.observe(wait_dt)
@@ -1247,6 +1275,29 @@ class EngineServer:
             if req.admitted < req.n:
                 self._head = req  # the next pull continues this req
             return ticket
+
+    def _ensure_grammar(self, req: _Request) -> int:
+        """Engine-side grammar registration for *req*'s pattern
+        (scheduler thread — the engine's sole owner); the gid cache
+        makes it once-per-pattern, so the steady state is a dict
+        lookup."""
+        with self._glock:
+            gid = self._grammar_gids.get(req.grammar_key)
+        if gid is None:
+            gid = self.engine.register_grammar(req.grammar_tdfa)
+            with self._glock:
+                # one critical section for the registered/pending
+                # handoff: handler threads read BOTH maps for the
+                # max_grammars bound and the compile-skip check, so
+                # the insert and the pop must land atomically
+                # (ADVICE r5).  Dropping the standalone TokenDfa
+                # matters too: keeping it would pin a second full
+                # [N, V] host copy per pattern for the server's
+                # lifetime
+                self._grammar_gids[req.grammar_key] = gid
+                self._grammar_tdfas.pop(req.grammar_key, None)
+        req.grammar_tdfa = None  # registered; drop the ref
+        return gid
 
     def _push(self, req: _Request, ev) -> bool:
         """Queue *ev* for *req*'s connection without ever blocking the
@@ -1582,8 +1633,55 @@ class EngineServer:
                    slot=ticket.slot, copy=idx,
                    chunks=ticket.chunks_total,
                    mid_window=ticket.mid_window)
+        if (req.prefill_only and not req.cancelled
+                and not eng.finished(ticket.slot)
+                and req.max_new_tokens > 1):
+            # disaggregated prefill: the admission (packed prefill +
+            # first token) is exactly the work this replica class
+            # exists for — checkpoint the fresh slot bit-exactly to
+            # host, free its pages, and hand the state to the handler
+            # thread, which answers the router with the serialized
+            # payload instead of a token stream.  A request that
+            # already FINISHED at its first token (eos/stop, or a
+            # 1-token budget) has nothing left to migrate: it falls
+            # through and this replica serves the complete response
+            # itself (the router passes it straight through).
+            self._export_migration(req, ticket.slot)
+            return
         self._running[ticket.slot] = (req, idx)
         self._emit(ticket.slot, req, idx, eng.output(ticket.slot))
+
+    def _export_migration(self, req: _Request, slot: int) -> None:
+        """Checkpoint a freshly-admitted prefill-only slot and hand
+        the state to the request's handler thread (scheduler thread —
+        preempt is an engine call).  Grammar state is re-based to
+        grammar-LOCAL form so the decode replica can re-home it onto
+        its own combined table regardless of registration order."""
+        eng = self.engine
+        try:
+            state = eng.preempt(slot)
+        except (RuntimeError, ValueError) as e:
+            # cannot checkpoint (should not happen on a paged engine
+            # with an active slot): serve the request here instead of
+            # failing it — correctness over topology
+            log.warning("prefill-only export failed (%s); serving "
+                        "locally", e)
+            self.recorder.record("tpu_serve_migrate_declined",
+                                 trace=req.trace, rid=req.rid,
+                                 error=str(e))
+            self._running[slot] = (req, 0)
+            self._emit(slot, req, 0, eng.output(slot))
+            return
+        if req.grammar_key is not None:
+            state["gstate"] = eng.grammar_rel(int(state["gstate"]))
+            state["gstate_rel"] = True
+        self._mig_out.inc()
+        self.recorder.record("tpu_serve_migrate_out",
+                             trace=req.trace, rid=req.rid, slot=slot,
+                             tokens=len(req.tokens),
+                             outputs=len(state["outputs"]))
+        self._push(req, {"__migrate__": state})
+        self._finish_request(req, "migrated")
 
     def _admit_pending(self) -> None:
         """Synchronously admit every queued request copy that fits —
@@ -1869,21 +1967,41 @@ class EngineServer:
                 if self.path == "/v1/chat/completions":
                     self._openai_completions(chat=True)
                     return
+                if self.path == "/migrate":
+                    self._migrate()
+                    return
                 if self.path != "/generate":
                     self._send(404, "text/plain", "not found\n")
                     return
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(length))
+                except (ValueError, TypeError) as e:
+                    self._send(400, "application/json",
+                               json.dumps({"error": str(e)}) + "\n")
+                    return
+                self._generate(body)
+
+            def _generate(self, body, migrate_state=None,
+                          migrate_budget=None):
+                """The native /generate path; also the resume half of
+                /migrate (a checkpoint rides in as *migrate_state*
+                with the prefill replica's capped *migrate_budget*)."""
+                try:
                     req = server._parse_request(body,
                                                 trace=self._trace)
+                    if migrate_state is not None:
+                        server._attach_migration(req, migrate_state,
+                                                 migrate_budget)
                 except (ValueError, TypeError, KeyError) as e:
                     self._send(400, "application/json",
                                json.dumps({"error": str(e)}) + "\n")
                     return
                 server._enqueue(req)
                 try:
-                    if req.stream:
+                    if req.prefill_only:
+                        self._migrate_reply(req, body, "/generate")
+                    elif req.stream:
                         self._stream(req)
                     else:
                         self._collect(req)
@@ -1892,19 +2010,134 @@ class EngineServer:
                     req.cancelled = True
                     server._finish_request(req, "cancelled")
 
-            def _openai_completions(self, chat: bool = False):
+            def _migrate(self):
+                """POST /migrate (internal, replica-to-replica via the
+                router): resume a prefill replica's checkpoint into a
+                slot here and serve the request's stream from where
+                prefill left off."""
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length) if length else b""
+                try:
+                    payload = load_payload(raw)
+                    path = payload["path"]
+                    body = payload["body"]
+                    state = payload["state"]
+                    budget = int(payload["budget"])
+                    if path not in ("/generate", "/v1/completions",
+                                    "/v1/chat/completions"):
+                        raise MigrateError(f"bad path {path!r}")
+                    if not isinstance(body, dict) \
+                            or not isinstance(state, dict):
+                        raise MigrateError(
+                            "body and state must be objects")
+                except (MigrateError, KeyError, TypeError,
+                        ValueError) as e:
+                    self._send(400, "application/json", json.dumps(
+                        {"error": f"bad migration payload: {e}"})
+                        + "\n")
+                    return
+                pool = getattr(server.engine, "_pool", None)
+                if not getattr(server.engine, "kv_paging", False) \
+                        or pool is None:
+                    # a replica without a paged pool cannot resume a
+                    # checkpoint: 503 so the router retries elsewhere
+                    self._send(503, "application/json", json.dumps(
+                        {"error": "replica cannot resume migrated KV "
+                                  "state (no paged pool)",
+                         "code": 503}) + "\n")
+                    return
+                lens = int(state.get("lens", 0))
+                if lens < 1 or lens > server.engine.model.max_len \
+                        or pool.pages_needed(lens) > pool.n_pages:
+                    self._send(503, "application/json", json.dumps(
+                        {"error": f"checkpoint of {lens} tokens does "
+                                  "not fit this replica's pool",
+                         "code": 503}) + "\n")
+                    return
+                if path == "/generate":
+                    self._generate(body, migrate_state=state,
+                                   migrate_budget=budget)
+                else:
+                    self._openai_completions(
+                        chat=path.endswith("/chat/completions"),
+                        body=body, migrate_state=state,
+                        migrate_budget=budget)
+
+            def _migrate_reply(self, req: _Request, body, path,
+                               openai=False, model_name=None,
+                               chat=False):
+                """Answer a prefill_only request: the serialized
+                checkpoint payload (the router ships it to a decode
+                replica) — or, when the scheduler declined (the
+                request FINISHED at its first token), the normal
+                response the client expects anyway."""
+                first = req.events.get()
+                if isinstance(first, dict) and "error" in first:
+                    if openai:
+                        self._openai_error(first.get("code", 400),
+                                           first["error"])
+                    else:
+                        self._send(first.get("code", 400),
+                                   "application/json",
+                                   json.dumps(first) + "\n")
+                    return
+                if isinstance(first, dict) and "__migrate__" in first:
+                    payload = dump_payload({
+                        "path": path,
+                        "body": {k: v for k, v in body.items()
+                                 if k != "prefill_only"},
+                        "state": first["__migrate__"],
+                        # the budget as THIS replica capped it (prompt
+                        # + budget must fit max_len) — the decode
+                        # replica adopts it instead of re-deriving
+                        "budget": req.max_new_tokens,
+                    })
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     MIGRATE_CONTENT_TYPE)
+                    self.send_header("Content-Length",
+                                     str(len(payload)))
+                    self._trace_headers()
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
+                # declined at bind (finished at the first token):
+                # serve the normal response, starting from the event
+                # already in hand
+                if openai:
+                    if req.stream:
+                        self._openai_stream(req, model_name, chat,
+                                            first=first)
+                    else:
+                        self._openai_collect(req, model_name, chat,
+                                             first=first)
+                elif req.stream:
+                    self._stream(req, first=first)
+                else:
+                    self._collect(req, first=first)
+
+            def _openai_completions(self, chat: bool = False,
+                                    body=None, migrate_state=None,
+                                    migrate_budget=None):
                 """OpenAI-compatible text completions (the interface
                 vLLM serves first): translate the body onto the native
                 request, answer in the OpenAI wire shape — streamed as
-                SSE `data:` chunks or one JSON object."""
+                SSE `data:` chunks or one JSON object.  /migrate
+                resumption rides in via *body* + *migrate_state*."""
                 stream = False
                 try:
-                    length = int(self.headers.get("Content-Length", 0))
-                    body = json.loads(self.rfile.read(length))
+                    if body is None:
+                        length = int(
+                            self.headers.get("Content-Length", 0))
+                        body = json.loads(self.rfile.read(length))
                     stream = bool(body.get("stream", False))
                     native, model_name = (
                         server._openai_chat_to_native(body) if chat
                         else server._openai_to_native(body))
+                    if body.get("prefill_only"):
+                        # the router's disagg marker rides through the
+                        # OpenAI translation like any native field
+                        native["prefill_only"] = True
                     if stream and native.get("logprobs") is not None:
                         # explicit 400 beats silently dropping the
                         # data: the SSE chunks carry text deltas that
@@ -1914,6 +2147,9 @@ class EngineServer:
                             "supported; request them unstreamed")
                     req = server._parse_request(native,
                                                 trace=self._trace)
+                    if migrate_state is not None:
+                        server._attach_migration(req, migrate_state,
+                                                 migrate_budget)
                     if native.get("_lp_count") is not None:
                         # the client-requested count (may be 0): the
                         # response trims the engine's top list to it
@@ -1944,7 +2180,14 @@ class EngineServer:
                 req.stream = stream
                 server._enqueue(req)
                 try:
-                    if stream:
+                    if req.prefill_only:
+                        self._migrate_reply(
+                            req, body,
+                            "/v1/chat/completions" if chat
+                            else "/v1/completions",
+                            openai=True, model_name=model_name,
+                            chat=chat)
+                    elif stream:
                         self._openai_stream(req, model_name, chat)
                     else:
                         self._openai_collect(req, model_name, chat)
@@ -1966,8 +2209,9 @@ class EngineServer:
                                "type": kind}}) + "\n")
 
             def _openai_stream(self, req: _Request, model_name,
-                   chat: bool = False):
-                first = req.events.get()
+                   chat: bool = False, first=None):
+                if first is None:
+                    first = req.events.get()
                 if "error" in first:
                     self._openai_error(first.get("code", 400),
                                        first["error"])
@@ -2045,9 +2289,11 @@ class EngineServer:
                 self._chunk("")
 
             def _openai_collect(self, req: _Request, model_name,
-                    chat: bool = False):
+                    chat: bool = False, first=None):
                 while True:
-                    ev = req.events.get()
+                    ev = first if first is not None \
+                        else req.events.get()
+                    first = None
                     if "error" in ev:
                         self._openai_error(ev.get("code", 400),
                                            ev["error"])
@@ -2063,12 +2309,13 @@ class EngineServer:
                                 echo_text=echo_text)) + "\n")
                         return
 
-            def _stream(self, req: _Request):
+            def _stream(self, req: _Request, first=None):
                 # wait for the FIRST event before sending headers: an
                 # admission-time rejection must surface as a real 4xx,
                 # not an in-band error line on a 200 (status-checking
                 # clients — curl -f, k8s probes — would see success)
-                first = req.events.get()
+                if first is None:
+                    first = req.events.get()
                 if isinstance(first, dict) and "error" in first:
                     self._send(first.get("code", 400),
                                "application/json",
@@ -2115,9 +2362,11 @@ class EngineServer:
                         ev = req.events.get()
                 self.wfile.write(b"0\r\n\r\n")
 
-            def _collect(self, req: _Request):
+            def _collect(self, req: _Request, first=None):
                 while True:
-                    ev = req.events.get()
+                    ev = first if first is not None \
+                        else req.events.get()
+                    first = None
                     if isinstance(ev, bytes):
                         continue  # window frames: stream-only payload
                     if "error" in ev:
@@ -2239,10 +2488,13 @@ class EngineServer:
                          "restart", "code": 503})
             self._finish_request(req, "shutdown")
             return
-        if self._qos:
+        if self._qos and not req.migrated:
             # per-tenant token-rate quota: charge the ESTIMATE (prompt
             # + requested budget, all n copies) at admission — over
-            # quota is a 429 the tenant earned, not a global verdict
+            # quota is a 429 the tenant earned, not a global verdict.
+            # Migrated-in requests are exempt: the prefill replica
+            # already charged this request once, and the router's
+            # fleet-level bucket is the global arbiter
             cost = float(
                 (len(req.tokens) + req.max_new_tokens) * req.n)
             with self._lock:
@@ -2296,6 +2548,34 @@ class EngineServer:
             self._finish_request(req, "throttled")
             return
         self._work.set()
+
+    def _attach_migration(self, req: _Request, state: dict,
+                          budget) -> None:
+        """Bind a migrated-in checkpoint to *req* (the /migrate
+        resume half): the existing preempted-resume machinery does
+        the actual engine work — ``_pull_ticket`` resumes preempted
+        checkpoints before admitting anything new."""
+        if req.n != 1:
+            raise ValueError("migrated requests must have n=1")
+        if not getattr(self.engine, "kv_paging", False):
+            raise ValueError(
+                "this replica cannot resume migrated KV state "
+                "(kv_paging is off)")
+        req.migrated = True
+        req.prefill_only = False
+        if budget is not None:
+            # adopt the prefill replica's capped budget (prompt +
+            # budget fits max_len there; configs match by contract)
+            req.max_new_tokens = int(budget)
+        req.budget_capped = True
+        req.admitted = 1
+        req.emitted[0] = 0
+        req.preempted[0] = state
+        self._mig_in.inc()
+        self.recorder.record(
+            "tpu_serve_migrate_in", trace=req.trace, rid=req.rid,
+            tokens=len(req.tokens),
+            outputs=len(state.get("outputs") or ()))
 
     # -- request plumbing ---------------------------------------------------
 
@@ -2727,6 +3007,23 @@ class EngineServer:
             request_id=req.rid, logger=log, trace=req.trace,
             recorder=getattr(self, "recorder", None),
         ).annotate(prompt_tokens=len(tokens), n=n)
+        if body.get("prefill_only"):
+            # internal router marker (disagg path): run prefill, then
+            # export the checkpoint instead of decoding.  Eligibility
+            # is decided HERE — an ineligible request silently serves
+            # normally and the router passes the stream through
+            # (graceful degradation beats a hard 4xx mid-topology)
+            if (getattr(self.engine, "kv_paging", False) and n == 1
+                    and self.replica_role != "decode"):
+                req.prefill_only = True
+            else:
+                self.recorder.record(
+                    "tpu_serve_migrate_declined", trace=req.trace,
+                    rid=req.rid,
+                    reason=("role" if self.replica_role == "decode"
+                            else "paging" if not getattr(
+                                self.engine, "kv_paging", False)
+                            else "multi_copy"))
         return req
 
     def stats(self) -> dict:
@@ -2805,6 +3102,14 @@ class EngineServer:
             "kv_pages": st.get("kv_pages", 0),
             "kv_pages_free": st.get("kv_pages_free", 0),
             "requests_served": st["requests_served"],
+            # disaggregated serving (router v2): the role this replica
+            # registered as, and the migration ledger in lock-step
+            # with tpu_serve_migrations_total{direction}
+            "role": self.replica_role,
+            "migrations": {
+                "out": int(self._mig_out.value),
+                "in": int(self._mig_in.value),
+            },
             "shed": {
                 "connections": int(self._shed_conns.value),
                 "queue": int(self._shed_queue.value),
@@ -2865,6 +3170,7 @@ class EngineServer:
                         "address": addr,
                         "model": model,
                         "capacity": self.engine.n_slots,
+                        "role": self.replica_role,
                         "statz": self.statz(),
                     }),
                     {"Content-Type": "application/json"})
@@ -3192,6 +3498,17 @@ def main(argv=None) -> int:
     p.add_argument("--register-interval", type=float, default=2.0,
                    help="seconds between router heartbeats (the "
                         "router's interval hint lowers it)")
+    p.add_argument("--replica-role",
+                   choices=["mixed", "prefill", "decode"],
+                   default="mixed",
+                   help="disaggregated-serving role, advertised via "
+                        "/register and /statz: the router sends "
+                        "prefill-heavy admissions to prefill-class "
+                        "replicas and migrates the finished KV state "
+                        "to decode-class ones (POST /migrate); "
+                        "prefill/decode need --kv-paging (migration "
+                        "is the paged pool's preempt/resume).  mixed "
+                        "(default) serves everything locally")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8000)
     args = p.parse_args(argv)
@@ -3229,6 +3546,10 @@ def main(argv=None) -> int:
         p.error("--prefix-registry-max must be >= 1")
     if (args.advertise or args.replica_id) and not args.register_with:
         p.error("--advertise/--replica-id need --register-with")
+    if args.replica_role != "mixed" and not args.kv_paging:
+        p.error(f"--replica-role {args.replica_role} needs "
+                "--kv-paging (KV migration is the paged pool's "
+                "preempt/resume)")
     if args.register_interval <= 0:
         p.error("--register-interval must be > 0")
     try:
@@ -3340,7 +3661,8 @@ def main(argv=None) -> int:
                        slo_policies=slo_policies,
                        slo_window_s=args.slo_window,
                        profile_dir=profile_dir,
-                       flight_dump_keep=args.flight_dump_keep)
+                       flight_dump_keep=args.flight_dump_keep,
+                       replica_role=args.replica_role)
     if args.fault_spec is not None or args.fault_seed is not None:
         if args.fault_spec is None:
             p.error("--fault-seed needs --fault-spec")

@@ -1095,6 +1095,11 @@ class ServingEngine:
             raise ValueError("jump_len must be >= 1")
         self.jump_len = jump_len
         self._goffsets: List[int] = []
+        # per-gid [row_start, row_end) in the combined table: the
+        # translation KV-migration needs to re-home a checkpoint's
+        # gstate onto another engine's table (registration order — and
+        # with it the absolute offsets — differs across replicas)
+        self._growbounds: List[Tuple[int, int]] = []
         self._gstates_used = 0
         self._gtable_np = None
         self._gtable = None
@@ -1199,6 +1204,7 @@ class ServingEngine:
             np.int32(-1)).astype(self._gtable_np.dtype)
         self._gstates_used = need
         self._goffsets.append(off + int(grammar.start))
+        self._growbounds.append((off, need))
         # device mirror rebuilds on every registration (one [N, V]
         # host-to-device copy; same shape unless capacity grew)
         self._gtable = jnp.asarray(self._gtable_np)
@@ -1209,6 +1215,31 @@ class ServingEngine:
         """How many grammars are registered (admit gids are
         ``range(n_grammars)``)."""
         return len(self._goffsets)
+
+    def grammar_rel(self, gstate: int) -> int:
+        """A combined-table state id -> the GRAMMAR-LOCAL row index
+        (-1 stays -1).  This is the engine-portable form a migrated
+        checkpoint carries: absolute offsets depend on THIS engine's
+        registration order, local ids only on the grammar itself."""
+        if gstate < 0:
+            return -1
+        for off, end in self._growbounds:
+            if off <= gstate < end:
+                return gstate - off
+        raise ValueError(
+            f"gstate {gstate} is in no registered grammar's rows")
+
+    def grammar_abs(self, gid: int, rel: int) -> int:
+        """Inverse of :meth:`grammar_rel` against THIS engine's table:
+        grammar *gid*'s local state *rel* -> combined-table id."""
+        if rel < 0:
+            return -1
+        off, end = self._growbounds[gid]
+        if off + rel >= end:
+            raise ValueError(
+                f"local state {rel} outside grammar {gid}'s "
+                f"{end - off} rows")
+        return off + rel
 
     def _place_cache(self, cache):
         """Apply the TP shardings to a cache pytree (no-op meshless)."""
@@ -1424,7 +1455,7 @@ class ServingEngine:
         if self._slot_prompts[slot] is not None:
             self._drop_donor(slot)
         pool.clear_slot(slot)
-        n_pages = (lens + pool.page_size - 1) // pool.page_size
+        n_pages = pool.pages_needed(lens)
         got: List[int] = []
         try:
             for _ in range(n_pages):
