@@ -1,0 +1,214 @@
+"""Trace generator invariants: byte-identical determinism, schema
+round-trip, and the reader's rejection of damaged traces.
+
+The generator is the CI goodput gate's foundation: if two runs of the
+same seed can differ by one byte, "replay the same trace twice" proves
+nothing.  So the first tests compare WHOLE FILE BYTES, not summaries.
+"""
+
+import json
+
+import pytest
+
+from tpu_k8s_device_plugin.workloads.trafficgen import (
+    SCHEMA,
+    TraceConfig,
+    TraceError,
+    TraceRequest,
+    _prefix_block,
+    dumps_trace,
+    generate,
+    load_trace,
+    loads_trace,
+    main,
+    summarize,
+    write_trace,
+)
+
+# small but non-trivial: both classes, slow readers, abandoners
+CFG = TraceConfig(n_requests=80, base_rate_rps=20.0,
+                  burst_rate_rps=120.0, p_enter_burst=0.1,
+                  p_exit_burst=0.2, prefix_chunk=8, n_prefixes=4,
+                  max_prefix_chunks=2, prompt_median=10.0,
+                  prompt_max=24, output_median=6.0, output_max=8,
+                  vocab=128, unary_frac=0.3, slow_reader_frac=0.2,
+                  abandon_frac=0.2)
+
+
+# -- determinism -----------------------------------------------------------
+
+
+def test_same_seed_is_byte_identical(tmp_path):
+    a = dumps_trace(CFG, 7, generate(CFG, 7))
+    b = dumps_trace(CFG, 7, generate(CFG, 7))
+    assert a == b
+    pa, pb = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    write_trace(str(pa), CFG, 7, generate(CFG, 7))
+    write_trace(str(pb), CFG, 7, generate(CFG, 7))
+    assert pa.read_bytes() == pb.read_bytes()
+
+
+def test_different_seed_differs():
+    assert dumps_trace(CFG, 1, generate(CFG, 1)) \
+        != dumps_trace(CFG, 2, generate(CFG, 2))
+
+
+def test_timestamps_monotonic_and_virtual():
+    reqs = generate(CFG, 3)
+    ts = [r.t_ms for r in reqs]
+    assert ts == sorted(ts)
+    assert ts[0] > 0.0
+
+
+# -- schema round-trip -----------------------------------------------------
+
+
+def test_round_trip_preserves_every_record(tmp_path):
+    reqs = generate(CFG, 11)
+    path = tmp_path / "t.jsonl"
+    write_trace(str(path), CFG, 11, reqs)
+    header, loaded = load_trace(str(path))
+    assert header["schema"] == SCHEMA
+    assert header["seed"] == 11
+    assert header["requests"] == len(reqs) == len(loaded)
+    assert [r.to_record() for r in loaded] \
+        == [r.to_record() for r in reqs]
+    # behaviors survive the round trip typed, not as dicts
+    assert all(type(r.behavior) is type(reqs[0].behavior)
+               for r in loaded)
+
+
+def test_reload_of_dumped_trace_redumps_identically():
+    reqs = generate(CFG, 5)
+    text = dumps_trace(CFG, 5, reqs)
+    header, loaded = loads_trace(text)
+    assert dumps_trace(CFG, 5, loaded) == text
+
+
+# -- reader rejection ------------------------------------------------------
+
+
+def _trace_lines(seed=9):
+    return dumps_trace(CFG, seed, generate(CFG, seed)).splitlines()
+
+
+def test_truncated_trace_rejected():
+    lines = _trace_lines()
+    with pytest.raises(TraceError, match="truncated or padded"):
+        loads_trace("\n".join(lines[:-3]) + "\n")
+
+
+def test_padded_trace_rejected():
+    lines = _trace_lines()
+    with pytest.raises(TraceError, match="truncated or padded"):
+        loads_trace("\n".join(lines + [lines[-1]]) + "\n")
+
+
+def test_unknown_schema_version_rejected():
+    lines = _trace_lines()
+    header = json.loads(lines[0])
+    header["schema"] = "tpu-trace/v999"
+    bad = "\n".join([json.dumps(header)] + lines[1:])
+    with pytest.raises(TraceError, match="unsupported trace schema"):
+        loads_trace(bad)
+
+
+def test_malformed_record_line_rejected():
+    lines = _trace_lines()
+    lines[3] = lines[3][: len(lines[3]) // 2]  # chopped mid-JSON
+    with pytest.raises(TraceError, match="malformed record"):
+        loads_trace("\n".join(lines) + "\n")
+
+
+def test_wrong_field_type_rejected():
+    lines = _trace_lines()
+    rec = json.loads(lines[2])
+    rec["tokens"] = "not-a-list"
+    lines[2] = json.dumps(rec)
+    with pytest.raises(TraceError):
+        loads_trace("\n".join(lines) + "\n")
+
+
+def test_backwards_time_rejected():
+    lines = _trace_lines()
+    a, b = json.loads(lines[1]), json.loads(lines[2])
+    a["t_ms"], b["t_ms"] = b["t_ms"], a["t_ms"]
+    a["rid"], b["rid"] = b["rid"], a["rid"]
+    lines[1], lines[2] = json.dumps(a), json.dumps(b)
+    with pytest.raises(TraceError, match="goes backwards"):
+        loads_trace("\n".join(lines) + "\n")
+
+
+def test_empty_and_non_object_header_rejected():
+    with pytest.raises(TraceError):
+        loads_trace("")
+    with pytest.raises(TraceError):
+        loads_trace("[1,2,3]\n")
+
+
+# -- shape invariants ------------------------------------------------------
+
+
+def test_shared_prefixes_chunk_aligned_and_exact():
+    reqs = generate(CFG, 21)
+    blocks = {pid: _prefix_block(21, CFG, pid)
+              for pid in range(CFG.n_prefixes)}
+    for r in reqs:
+        block = blocks[r.prefix_id]
+        assert len(block) % CFG.prefix_chunk == 0
+        # the request's prompt STARTS with its prefix block exactly —
+        # what the APC cache and the router's affinity key hash over
+        assert r.tokens[: len(block)] == block
+        assert len(r.tokens) > len(block)  # always a unique suffix
+        assert all(0 < t < CFG.vocab for t in r.tokens)
+        assert CFG.output_min <= r.max_new_tokens <= CFG.output_max
+
+
+def test_zipf_head_dominates():
+    counts = {}
+    for r in generate(CFG, 13):
+        counts[r.prefix_id] = counts.get(r.prefix_id, 0) + 1
+    assert counts.get(0, 0) == max(counts.values())
+
+
+def test_mix_covers_both_classes_and_behaviors():
+    reqs = generate(CFG, 17)
+    s = summarize(reqs)
+    assert set(s["classes"]) == {"interactive", "batch"}
+    assert s["unary"] > 0 and s["slow_readers"] > 0 \
+        and s["abandoners"] > 0
+    # behavior coupling: unary requests are batch-class, never
+    # slow-read or abandoned (those are streaming-client behaviors)
+    for r in reqs:
+        if not r.behavior.stream:
+            assert r.slo_class == "batch" and r.priority == 1
+            assert r.behavior.read_bytes_per_s == 0
+            assert r.behavior.abandon_after_ms == 0.0
+        else:
+            assert r.slo_class == "interactive" and r.priority == 0
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        TraceConfig(n_requests=0)
+    with pytest.raises(ValueError):
+        TraceConfig(vocab=2)
+    with pytest.raises(ValueError):
+        TraceConfig(unary_frac=1.5)
+    with pytest.raises(ValueError):
+        TraceConfig(tenants=())
+
+
+def test_cli_writes_loadable_trace(tmp_path, capsys):
+    out = tmp_path / "cli.jsonl"
+    rc = main(["--out", str(out), "--seed", "4", "--requests", "30",
+               "--prefix-chunk", "8", "--n-prefixes", "4",
+               "--prompt-max", "32", "--output-max", "8",
+               "--vocab", "128", "--tenant", "acme",
+               "--tenant", "globex"])
+    assert rc == 0
+    header, reqs = load_trace(str(out))
+    assert len(reqs) == 30
+    assert {r.tenant for r in reqs} <= {"acme", "globex"}
+    printed = json.loads(capsys.readouterr().out)
+    assert printed["summary"]["requests"] == 30

@@ -1205,6 +1205,75 @@ def episode_prefill_kill_mid_migration(seed):
             pass
 
 
+def episode_trace_replay_kill(seed):
+    """Episode 13: the goodput gate's exact shape, library-driven — a
+    seeded production trace (bursty MMPP arrivals, Zipf prefixes, both
+    SLO classes) replayed open-loop through the router against two
+    real replica subprocesses, with one replica SIGKILLed mid-burst.
+    The replay report must carry the whole chaos story on the router's
+    own journal/metric surfaces (breaker opened OR failover counted,
+    TTL eviction journaled, recovery probes green) AND the client-side
+    join: per-class attainment stays above the floor and recovers in
+    the post-kill window."""
+    import argparse
+
+    from tpu_k8s_device_plugin import obs
+    from tpu_k8s_device_plugin.workloads import replay
+    from tpu_k8s_device_plugin.workloads.trafficgen import (
+        TraceConfig,
+        generate,
+    )
+
+    cfg = TraceConfig(
+        n_requests=48, base_rate_rps=8.0, burst_rate_rps=40.0,
+        p_enter_burst=0.05, p_exit_burst=0.1, prefix_chunk=16,
+        n_prefixes=4, max_prefix_chunks=2, prompt_median=24.0,
+        prompt_max=48, output_median=24.0, output_max=64,
+        vocab=256, unary_frac=0.25, slow_reader_frac=0.0,
+        abandon_frac=0.0)
+    requests = generate(cfg, seed)
+    # kill mid-trace: a third of the arrivals in, burst or not — the
+    # tail must outlive the settle window so recovery is measurable
+    kill_ms = requests[len(requests) // 3].t_ms
+    policies = obs.default_slo_policies()
+    metrics = replay.ReplayMetrics(obs.Registry(), policies)
+    args = argparse.Namespace(
+        replicas=2, config="tiny", slots=2, max_len=512,
+        max_new_tokens=128, prefix_chunk=16, seed=seed,
+        kill_replica_at_ms=kill_ms, slo=None, time_scale=1.0,
+        late_ms=100.0, timeout_s=120.0, top_missed=3)
+    report = replay.run_fleet(args, requests, policies, metrics,
+                              trace_header={"seed": seed})
+
+    chaos = report["chaos"]
+    check(chaos["killed_replica"] == "replay-1",
+          "report names the SIGKILLed replica")
+    check(chaos["breaker_opened"] or chaos["failovers"] > 0,
+          "router journaled the death: breaker opened or a request "
+          "failed over off the corpse")
+    check(chaos["replica_evicted"],
+          "statz sweep evicted the silent replica "
+          "(tpu_router_replica_evicted journaled)")
+    check(chaos["recovery_probes_ok"] == chaos["recovery_probes"],
+          "post-trace probes all served by the survivor")
+    for cls in ("interactive", "batch"):
+        info = report["classes"][cls]
+        check(info["eligible"] > 0,
+              f"{cls}: trace landed eligible requests")
+        check(info["attainment"] >= 0.5,
+              f"{cls}: goodput floor held through the kill "
+              f"(attainment {info['attainment']})")
+        post = chaos["attainment_windows"][cls]["post_kill"]
+        check(post is None or post >= 0.5,
+              f"{cls}: post-kill attainment recovered ({post})")
+    # the replay's own obs families carried the joined accounting
+    samples = obs.parse_exposition(metrics.registry.render())
+    total = sum(v for name, _, v in samples
+                if name == "tpu_replay_requests_total")
+    check(total == len(requests),
+          "tpu_replay_requests_total accounts every trace request")
+
+
 def _reshape_slice(tmp, testdata, seed, suffix, grace, hb_timeout):
     """A dedicated 2-host slice with live staleness + reshape grace (the
     main soak coordinator drives heartbeats manually with no timeout, so
@@ -1460,6 +1529,9 @@ def main(argv=None) -> int:
             log.info("=== episode 12: prefill replica killed "
                      "mid-migration ===")
             episode_prefill_kill_mid_migration(args.seed)
+            log.info("=== episode 13: seeded trace replayed through "
+                     "a kill ===")
+            episode_trace_replay_kill(args.seed)
         # -- final convergence sweep ----------------------------------
         for h in hosts:
             h.pulse()

@@ -25,6 +25,10 @@ Examples::
     python tools/obs_query.py --dump flight-43-1754300612.jsonl \
         --since 1754300550 --until 1754300612
 
+    # replay -> post-mortem in one command: the slowest SLO-missed
+    # requests of a workloads.replay report, span trees and all
+    python tools/obs_query.py --replay-report replay-report.json --top 3
+
 Dependency-free (stdlib + the stdlib-only ``obs`` package), like
 every tool in this repo.
 """
@@ -150,6 +154,74 @@ def collect(trace_id: Optional[str], endpoints: List[str],
     return out
 
 
+def render_replay_report(path: str, top: int,
+                         as_json: bool) -> int:
+    """The slowest *top* SLO-missed requests of a
+    ``tpu-replay-report/v1`` file (workloads.replay --report),
+    attribution plus — where the report embedded the raw spans — the
+    stitched tree, re-stitched right here so the post-mortem needs no
+    live endpoint.  Exit 0 when the report has no misses at all."""
+    with open(path, "r", encoding="utf-8") as f:
+        report = json.loads(f.read())
+    if not isinstance(report, dict) \
+            or report.get("schema") != "tpu-replay-report/v1":
+        print(f"obs_query: {path} is not a tpu-replay-report/v1 "
+              f"file (schema={report.get('schema')!r})"
+              if isinstance(report, dict)
+              else f"obs_query: {path}: not a JSON object",
+              file=sys.stderr)
+        return 2
+    missed = report.get("slo_missed")
+    rows = [r for r in missed if isinstance(r, dict)] \
+        if isinstance(missed, list) else []
+    rows = rows[:max(0, top)]
+    if as_json:
+        out = []
+        for row in rows:
+            events = row.get("events")
+            tree = obs.stitch([e for e in events
+                               if isinstance(e, dict)]) \
+                if isinstance(events, list) else []
+            out.append(dict(row, tree=tree))
+        print(json.dumps({"report": path, "slo_missed": out},
+                         indent=2))
+        return 0
+    classes = report.get("classes")
+    if isinstance(classes, dict):
+        for name in sorted(classes):
+            info = classes[name]
+            if isinstance(info, dict):
+                print(f"class {name}: attainment "
+                      f"{info.get('attainment')} "
+                      f"({info.get('met')}/{info.get('eligible')} "
+                      f"eligible, {info.get('total')} total)")
+    if not rows:
+        print("no SLO-missed requests in the report")
+        return 0
+    for row in rows:
+        print(f"\n-- {row.get('rid')} class={row.get('class')} "
+              f"outcome={row.get('outcome')} "
+              f"total={row.get('total_ms')}ms "
+              f"ttft={row.get('ttft_ms')}ms "
+              f"replica={row.get('replica')} "
+              f"trace={str(row.get('trace_id'))[:16]}")
+        attribution = row.get("attribution")
+        if isinstance(attribution, dict):
+            print("   where it went: " + "  ".join(
+                f"{k.removesuffix('_ms')}={v:.1f}ms"
+                for k, v in attribution.items()
+                if isinstance(v, (int, float)) and v > 0))
+        events = row.get("events")
+        if isinstance(events, list) and events:
+            tree = obs.stitch([e for e in events
+                               if isinstance(e, dict)])
+            print(obs.render_tree(tree))
+        else:
+            print("   (no spans embedded for this request — raise "
+                  "--top-missed on the replay run)")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="obs-query",
@@ -175,7 +247,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="per-endpoint fetch timeout (seconds)")
     p.add_argument("--json", action="store_true",
                    help="emit JSON instead of the text rendering")
+    p.add_argument("--replay-report", default=None, metavar="FILE",
+                   help="render the slowest SLO-missed requests of a "
+                        "workloads.replay report (tpu-replay-report/"
+                        "v1) instead of querying endpoints")
+    p.add_argument("--top", type=int, default=5,
+                   help="how many SLO-missed requests to render in "
+                        "--replay-report mode")
     args = p.parse_args(argv)
+    if args.replay_report:
+        return render_replay_report(args.replay_report, args.top,
+                                    args.json)
     if not args.endpoint and not args.dump:
         p.error("need at least one --endpoint or --dump")
     events = collect(args.trace_id, args.endpoint or [],

@@ -479,6 +479,7 @@ def _http_throughput(model, params, prompt, steps, clients,
 
     from tpu_k8s_device_plugin import obs
 
+    from . import loadclient
     from .server import EngineServer
     from .serving import ServingEngine
 
@@ -545,69 +546,32 @@ def _http_throughput(model, params, prompt, steps, clients,
                 # round-robin tenant identities: tenant-0 is the
                 # heavy batch lane, the others the interactive lanes
                 req_body["tenant"] = f"tenant-{i % tenants}"
-            body = _json.dumps(req_body)
-            # a fresh traceparent per benched request: the server-side
-            # trace (queue wait, admit, windows, stream writes) becomes
-            # queryable by the id THIS client chose
-            trace = obs.new_trace()
-            conn = http.client.HTTPConnection(
-                "127.0.0.1", srv.port, timeout=600)
-            t0 = time.perf_counter()
-            try:
-                conn.request("POST", "/generate", body,
-                             {"Content-Type": "application/json",
-                              "traceparent": trace.to_traceparent()})
-                resp = conn.getresponse()
-                first = last = None
-                n_toks = 0
-                for line in resp:
-                    s = line.strip()
-                    if not s:
-                        continue
-                    now = time.perf_counter()
-                    # the hot wire shape is the coalesced n=1 window
-                    # frame {"tokens":[a,b,...]}: count its ids by
-                    # comma instead of a full json parse — on shared
-                    # CPU the load generator must not steal cycles
-                    # from the engine it is measuring (terminal events
-                    # still parse fully below)
-                    if s.startswith(b'{"tokens":[') and s[-2:] == b']}':
-                        k = s.count(b",") + 1
-                        ev = None
-                    else:
-                        ev = _json.loads(s)
-                        # legacy per-token events ({"token": t}) still
-                        # count one each
-                        k = (len(ev["tokens"])
-                             if "tokens" in ev and "done" not in ev
-                             else 1 if "token" in ev else 0)
-                    if k:
-                        n_toks += k
-                        last = now
-                        if first is None:
-                            first = now
-                            if cancel_every and i % cancel_every == \
-                                    cancel_every - 1:
-                                with lock:
-                                    cancelled[0] += 1
-                                break  # disconnect mid-stream
-                    elif "error" in ev:
-                        # errored requests must not vanish from the
-                        # stats (clean-looking numbers over a broken
-                        # run would be worse than no numbers)
-                        with lock:
-                            errors.append(ev["error"])
-                        break
-                    elif "done" in ev and first is not None:
-                        with lock:
-                            ttfts.append(first - t0)
-                            if n_toks > 1:
-                                tpots.append(
-                                    (last - first) / (n_toks - 1))
-                            done_tokens.append(len(ev["tokens"]))
-                            traced.append((now - t0, trace.trace_id))
-            finally:
-                conn.close()
+            # the shared load client stamps a fresh traceparent per
+            # benched request (the server-side trace becomes queryable
+            # by an id THIS client chose) and executes the abandoner
+            # behavior: every cancel_every-th request disconnects
+            # after its first streamed frame, mid-stream
+            beh = loadclient.ClientBehavior(
+                abandon_after_tokens=1 if cancel_every
+                and i % cancel_every == cancel_every - 1 else 0)
+            res = loadclient.stream_request(
+                "127.0.0.1", srv.port, req_body, behavior=beh,
+                timeout_s=600)
+            with lock:
+                if res.outcome == loadclient.OUTCOME_ABANDONED:
+                    cancelled[0] += 1
+                elif res.outcome == loadclient.OUTCOME_OK:
+                    if res.ttft_s is not None:
+                        ttfts.append(res.ttft_s)
+                    if res.tpot_s is not None:
+                        tpots.append(res.tpot_s)
+                    done_tokens.append(res.done_tokens)
+                    traced.append((res.total_s, res.trace_id))
+                else:
+                    # errored requests must not vanish from the stats
+                    # (clean-looking numbers over a broken run would
+                    # be worse than no numbers)
+                    errors.append(res.error or res.outcome)
 
     try:
         # warm the compiled paths outside the timed region (first
@@ -702,6 +666,13 @@ def _http_throughput(model, params, prompt, steps, clients,
         "slots": float(slots),
         "requests_completed": float(len(done_tokens)),
         "requests_cancelled": float(cancelled[0]),
+        # the abandonment is now visible on BOTH sides of the wire:
+        # the client reports its deliberate disconnects as a terminal
+        # outcome, and the server's journal/counter must agree
+        # (tpu_serve_client_abandons_total, read back off /stats)
+        "requests_abandoned": float(cancelled[0]),
+        "server_client_abandons": float(
+            server_stats.get("client_abandons", 0)),
         "requests_errored": float(len(errors)),
         "req_per_sec": len(done_tokens) / wall,
         "ttft_ms_p50": _percentile(ttfts, 0.5) * 1e3,
@@ -815,42 +786,18 @@ def _http_throughput(model, params, prompt, steps, clients,
 
 
 def _free_port() -> int:
-    import socket
+    # kept as a name (chaos_soak and older callers import it); the
+    # implementation lives with the shared load client now
+    from .loadclient import free_port
 
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    return free_port()
 
 
 def _wait_http_ok(port, path, timeout_s, predicate=None):
     """Poll GET path until 200 (and *predicate*(json) when given)."""
-    import http.client
-    import json as _json
-    import time
+    from .loadclient import wait_http_ok
 
-    deadline = time.time() + timeout_s
-    last = None
-    while time.time() < deadline:
-        try:
-            conn = http.client.HTTPConnection("127.0.0.1", port,
-                                              timeout=5)
-            conn.request("GET", path)
-            resp = conn.getresponse()
-            body = resp.read()
-            conn.close()
-            last = (resp.status, body[:120])
-            if resp.status == 200:
-                if predicate is None:
-                    return True
-                if predicate(_json.loads(body)):
-                    return True
-        except (OSError, ValueError):
-            pass
-        time.sleep(0.25)
-    raise RuntimeError(f"{path} on :{port} not ready within "
-                       f"{timeout_s}s (last: {last})")
+    return wait_http_ok(port, path, timeout_s, predicate)
 
 
 def _spawn_replica(config, quantized, idx, port, router_port, slots,
@@ -896,10 +843,10 @@ def _router_load(router_port, prompts, steps, clients, n_requests,
     *prompts* — repeats are the affinity workload) through the router
     with *clients* concurrent clients.  Returns (wall, done_tokens,
     statuses, errors)."""
-    import http.client
-    import json as _json
     import threading
     import time
+
+    from . import loadclient
 
     done_tokens, statuses, errors = [], [], []
     seq = iter(range(n_requests))
@@ -910,42 +857,19 @@ def _router_load(router_port, prompts, steps, clients, n_requests,
                 i = next(seq, None)
             if i is None:
                 return
-            body = _json.dumps({
-                "tokens": prompts[i % len(prompts)],
-                "max_new_tokens": steps,
-            })
-            status = -1
-            try:
-                conn = http.client.HTTPConnection(
-                    "127.0.0.1", router_port, timeout=600)
-                conn.request("POST", "/generate", body,
-                             {"Content-Type": "application/json"})
-                resp = conn.getresponse()
-                status = resp.status
-                n_toks = 0
-                bad = None
-                for line in resp:
-                    s = line.strip()
-                    if not s:
-                        continue
-                    if s.startswith(b'{"tokens":[') and s[-2:] == b']}':
-                        n_toks += s.count(b",") + 1
-                        continue
-                    ev = _json.loads(s)
-                    if "error" in ev:
-                        bad = ev["error"]
-                    elif "done" in ev:
-                        with lock:
-                            done_tokens.append(len(ev["tokens"]))
-                conn.close()
-                if bad is not None:
-                    with lock:
-                        errors.append(bad)
-            except OSError as e:
-                with lock:
-                    errors.append(str(e))
+            res = loadclient.stream_request(
+                "127.0.0.1", router_port,
+                {"tokens": prompts[i % len(prompts)],
+                 "max_new_tokens": steps},
+                timeout_s=600)
             with lock:
-                statuses.append(status)
+                if res.outcome == loadclient.OUTCOME_OK:
+                    done_tokens.append(res.done_tokens)
+                elif res.error is not None:
+                    # in-band error frames, sheds, and transport
+                    # failures all land here — the phases gate on it
+                    errors.append(res.error)
+                statuses.append(res.status)
 
     t0 = time.perf_counter()
     threads = [threading.Thread(target=client_loop)
@@ -1164,10 +1088,10 @@ def _disagg_load(router_port, long_prompts, short_prompts, steps,
     Returns (wall, unary_lat_s, ttft_s, tpot_s, statuses, errors) —
     TTFT is request-start to the first streamed line, TPOT the
     per-token gap over the rest of the stream."""
-    import http.client
-    import json as _json
     import threading
     import time
+
+    from . import loadclient
 
     unary_lat, ttfts, tpots = [], [], []
     statuses, errors = [], []
@@ -1179,76 +1103,43 @@ def _disagg_load(router_port, long_prompts, short_prompts, steps,
                 i = next(seq, None)
             if i is None:
                 return
-            try:
-                conn = http.client.HTTPConnection(
-                    "127.0.0.1", router_port, timeout=600)
-                if i % 2 == 0:
-                    body = _json.dumps({
-                        "tokens": long_prompts[
-                            (i // 2) % len(long_prompts)],
-                        "max_new_tokens": max(4, steps // 4),
-                        "stream": False})
-                    t0 = time.perf_counter()
-                    conn.request("POST", "/generate", body,
-                                 {"Content-Type": "application/json"})
-                    resp = conn.getresponse()
-                    payload = resp.read()
-                    dt = time.perf_counter() - t0
-                    bad = None
-                    try:
-                        ev = _json.loads(payload)
-                        if "error" in ev:
-                            bad = ev["error"]
-                    except ValueError:
-                        bad = f"unparseable body: {payload[:80]!r}"
-                    with lock:
-                        statuses.append(resp.status)
-                        if resp.status == 200 and bad is None:
-                            unary_lat.append(dt)
-                        elif bad is not None and resp.status == 200:
-                            errors.append(bad)
-                else:
-                    body = _json.dumps({
-                        "tokens": short_prompts[
-                            (i // 2) % len(short_prompts)],
-                        "max_new_tokens": steps,
-                        "ignore_eos": True})
-                    t0 = time.perf_counter()
-                    conn.request("POST", "/generate", body,
-                                 {"Content-Type": "application/json"})
-                    resp = conn.getresponse()
-                    t_first = t_last = None
-                    n_toks = 0
-                    bad = None
-                    for line in resp:
-                        s = line.strip()
-                        if not s:
-                            continue
-                        now = time.perf_counter()
-                        if t_first is None:
-                            t_first = now
-                        if s.startswith(b'{"tokens":[') \
-                                and s[-2:] == b"]}":
-                            n_toks += s.count(b",") + 1
-                            t_last = now
-                            continue
-                        ev = _json.loads(s)
-                        if "error" in ev:
-                            bad = ev["error"]
-                    with lock:
-                        statuses.append(resp.status)
-                        if bad is not None:
-                            errors.append(bad)
-                        elif t_first is not None:
-                            ttfts.append(t_first - t0)
-                            if n_toks > 1 and t_last is not None \
-                                    and t_last > t_first:
-                                tpots.append((t_last - t_first)
-                                             / (n_toks - 1))
-                conn.close()
-            except OSError as e:
+            if i % 2 == 0:
+                res = loadclient.unary_request(
+                    "127.0.0.1", router_port,
+                    {"tokens": long_prompts[
+                        (i // 2) % len(long_prompts)],
+                     "max_new_tokens": max(4, steps // 4),
+                     "stream": False},
+                    timeout_s=600)
                 with lock:
-                    errors.append(str(e))
+                    if res.outcome == loadclient.OUTCOME_TRANSPORT:
+                        errors.append(res.error)
+                        continue
+                    statuses.append(res.status)
+                    if res.outcome == loadclient.OUTCOME_OK:
+                        unary_lat.append(res.total_s)
+                    elif res.error is not None and res.status == 200:
+                        errors.append(res.error)
+            else:
+                res = loadclient.stream_request(
+                    "127.0.0.1", router_port,
+                    {"tokens": short_prompts[
+                        (i // 2) % len(short_prompts)],
+                     "max_new_tokens": steps,
+                     "ignore_eos": True},
+                    timeout_s=600)
+                with lock:
+                    if res.outcome == loadclient.OUTCOME_TRANSPORT:
+                        errors.append(res.error)
+                        continue
+                    statuses.append(res.status)
+                    if res.outcome != loadclient.OUTCOME_OK \
+                            and res.error is not None:
+                        errors.append(res.error)
+                    elif res.ttft_s is not None:
+                        ttfts.append(res.ttft_s)
+                        if res.tpot_s is not None:
+                            tpots.append(res.tpot_s)
 
     t0 = time.perf_counter()
     threads = [threading.Thread(target=client_loop)

@@ -792,6 +792,11 @@ class EngineServer:
             "tpu_serve_slow_client_drops_total",
             "Clients disconnected for not draining their stream "
             "(bounded event queue overflowed).")
+        self._m_abandons = reg.counter(
+            "tpu_serve_client_abandons_total",
+            "Requests whose CLIENT disconnected mid-request (reset "
+            "or broken pipe seen by the handler) — the client-side "
+            "mirror of the slow-client drops the server initiates.")
         # -- paged KV pool + multi-tenant QoS -----------------------------
         # Pool occupancy/sharing gauges and the preemption/CoW/eviction
         # counters refresh from engine stats at scrape time; they render
@@ -1054,6 +1059,18 @@ class EngineServer:
                 ttft_s=req.ttft_s if req.ttft_s >= 0 else None,
                 total_s=total_s, ok=outcome == "ok",
                 fallback="interactive" if req.stream else "batch")
+
+    def _note_client_abandon(self, req: _Request) -> None:
+        """The CLIENT vanished mid-request (reset / broken pipe on
+        its connection).  Count + journal it so a bench/replay
+        ``abandoned`` outcome has a server-side record to join
+        against — distinct from the slow-client drop, which is the
+        SERVER's decision (this path was previously invisible: the
+        request finished as a bare ``cancelled`` with no way to tell
+        a user Ctrl-C from an operator cancel)."""
+        self._m_abandons.inc()
+        self.recorder.record("tpu_serve_client_abandon",
+                             trace=req.trace, rid=req.rid)
 
     # -- scheduler (sole owner of the engine) -------------------------------
 
@@ -2008,6 +2025,7 @@ class EngineServer:
                 except (BrokenPipeError, ConnectionResetError,
                         TimeoutError):
                     req.cancelled = True
+                    server._note_client_abandon(req)
                     server._finish_request(req, "cancelled")
 
             def _migrate(self):
@@ -2194,6 +2212,7 @@ class EngineServer:
                 except (BrokenPipeError, ConnectionResetError,
                         TimeoutError):
                     req.cancelled = True
+                    server._note_client_abandon(req)
                     server._finish_request(req, "cancelled")
 
             def _openai_error(self, code: int, message: str):
@@ -3042,6 +3061,7 @@ class EngineServer:
             # promoted counters read back so /stats and /metrics agree
             "requests_throttled": self._requests_throttled,
             "requests_dropped": self._requests_dropped,
+            "client_abandons": int(self._m_abandons.value),
             "grammar_patterns": grammar_patterns,
             "window": self.window,
             "max_queue": self.max_queue,
