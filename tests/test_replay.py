@@ -65,9 +65,10 @@ def test_sse_data_extraction():
     assert loadclient.sse_data(b"") is None
 
 
-def _req(slo_class="interactive", stream=True, rid="r0", t_ms=0.0):
+def _req(slo_class="interactive", stream=True, rid="r0", t_ms=0.0,
+         tenant="default"):
     return TraceRequest(
-        rid=rid, t_ms=t_ms, tenant="default", slo_class=slo_class,
+        rid=rid, t_ms=t_ms, tenant=tenant, slo_class=slo_class,
         priority=0 if stream else 1, prefix_id=0, tokens=[1, 2, 3],
         max_new_tokens=4,
         behavior=loadclient.ClientBehavior(stream=stream))
@@ -193,6 +194,41 @@ def test_build_report_shape_and_missed_ranking():
     assert rep["abandoned"] == 1
     assert all(k in rep["slo_missed"][0]["attribution"]
                for k in replay.ATTRIBUTION_KEYS)
+
+
+def test_report_per_tenant_attainment():
+    pol = obs.default_slo_policies()
+    results = [
+        replay.RequestResult(req=_req(rid="p0", tenant="prio"),
+                             outcome=_out(), lag_s=0.0, late=False,
+                             slo_met=True),
+        replay.RequestResult(req=_req(rid="p1", tenant="prio"),
+                             outcome=_out(), lag_s=0.0, late=False,
+                             slo_met=True),
+        replay.RequestResult(
+            req=_req(rid="b0", tenant="batchfarm"),
+            outcome=_out(ttft_s=9.0, total_s=9.5),
+            lag_s=0.0, late=False, slo_met=False),
+        replay.RequestResult(
+            req=_req(rid="b1", tenant="batchfarm"),
+            outcome=_out(outcome=loadclient.OUTCOME_ABANDONED),
+            lag_s=0.0, late=False, slo_met=None),
+    ]
+    rep = replay.build_report(
+        results, pol, trace_header={"seed": 1}, target="x:1",
+        time_scale=1.0, late_ms=100.0)
+    t = rep["tenants"]
+    assert set(t) == {"prio", "batchfarm"}
+    assert t["prio"]["attainment"] == pytest.approx(1.0)
+    assert t["prio"]["eligible"] == 2
+    # abandonment excluded per-tenant exactly like per-class
+    assert t["batchfarm"]["total"] == 2
+    assert t["batchfarm"]["eligible"] == 1
+    assert t["batchfarm"]["attainment"] == pytest.approx(0.0)
+    # the gate's spec grammar reaches the tenant rows
+    specs = replay._parse_goodput_specs(
+        ["tenant:prio=0.7", "interactive=0.5"])
+    assert specs == {"tenant:prio": 0.7, "interactive": 0.5}
 
 
 def test_goodput_spec_parsing():

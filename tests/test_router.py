@@ -516,6 +516,53 @@ def test_pre_stream_failover_onto_live_replica(live_router):
         ok.stop()
 
 
+def test_drain_endpoint_takes_replica_out_of_rotation(live_router):
+    """POST /drain over HTTP (the fleet reconciler's lever): the
+    drained replica stops taking NEW streams but stays registered;
+    {"draining": false} puts it back; ghosts 404, junk 400."""
+    rt = live_router
+    frames = ['{"tokens":[1,2]}\n', '{"done": true, "tokens": [1, 2]}\n']
+    a, b = _FakeReplica(frames), _FakeReplica(frames)
+    try:
+        rt.register({"address": a.address, "replica_id": "a"})
+        rt.register({"address": b.address, "replica_id": "b"})
+        prompt = _key_for(rt, "a")
+        status, _, _ = _post_router(
+            rt.port, {"replica_id": "a"}, path="/drain")
+        assert status == 200
+        st, rows = _raw_get_json(rt.port, "/replicas")
+        assert st == 200
+        by_rid = {r["replica_id"]: r for r in rows["replicas"]}
+        assert by_rid["a"]["draining"] is True
+        # draining means not routable: the view says so ...
+        assert by_rid["a"]["healthy"] is False
+        assert by_rid["b"]["draining"] is False
+        # ... and an a-affine request lands on b, no failover needed
+        status, headers, _ = _post_router(
+            rt.port, {"tokens": prompt, "max_new_tokens": 2})
+        assert status == 200
+        assert headers.get("X-Replica") == "b"
+        # undrain restores the affinity route
+        status, _, _ = _post_router(
+            rt.port, {"replica_id": "a", "draining": False},
+            path="/drain")
+        assert status == 200
+        status, headers, _ = _post_router(
+            rt.port, {"tokens": prompt, "max_new_tokens": 2})
+        assert status == 200
+        assert headers.get("X-Replica") == "a"
+        # caller bugs are loud: unknown replica 404, malformed body 400
+        status, _, _ = _post_router(
+            rt.port, {"replica_id": "ghost"}, path="/drain")
+        assert status == 404
+        status, _, _ = _post_router(
+            rt.port, {"replica_id": ""}, path="/drain")
+        assert status == 400
+    finally:
+        a.stop()
+        b.stop()
+
+
 def test_unroutable_when_everything_down(live_router):
     rt = live_router
     s = socket.socket()

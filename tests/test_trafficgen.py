@@ -6,6 +6,7 @@ same seed can differ by one byte, "replay the same trace twice" proves
 nothing.  So the first tests compare WHOLE FILE BYTES, not summaries.
 """
 
+import dataclasses
 import json
 
 import pytest
@@ -21,6 +22,7 @@ from tpu_k8s_device_plugin.workloads.trafficgen import (
     load_trace,
     loads_trace,
     main,
+    parse_tenant_mix,
     summarize,
     write_trace,
 )
@@ -188,6 +190,48 @@ def test_mix_covers_both_classes_and_behaviors():
             assert r.slo_class == "interactive" and r.priority == 0
 
 
+def test_weighted_tenants_skew_and_determinism():
+    cfg = dataclasses.replace(CFG, tenants=("prio", "batchfarm"),
+                              tenant_weights=(9.0, 1.0))
+    reqs = generate(cfg, 23)
+    counts = summarize(reqs)["tenants"]
+    # 9:1 over 80 draws: the heavy tenant must dominate, the light one
+    # must still appear (weights partition, they don't exclude)
+    assert counts["prio"] > counts.get("batchfarm", 0) * 3
+    assert counts.get("batchfarm", 0) > 0
+    # weighted draws are part of the same determinism contract
+    assert dumps_trace(cfg, 23, generate(cfg, 23)) \
+        == dumps_trace(cfg, 23, reqs)
+
+
+def test_unweighted_tenants_unchanged_by_weights_field():
+    # tenant_weights=None must take the historical randrange arm:
+    # a pre-existing trace config regenerates byte-identically
+    base = dataclasses.replace(CFG, tenants=("a", "b", "c"))
+    explicit = dataclasses.replace(CFG, tenants=("a", "b", "c"),
+                                   tenant_weights=None)
+    assert [r.tenant for r in generate(base, 7)] \
+        == [r.tenant for r in generate(explicit, 7)]
+
+
+def test_parse_tenant_mix():
+    names, weights = parse_tenant_mix("prio:3,batchfarm:1")
+    assert names == ("prio", "batchfarm")
+    assert weights == (3.0, 1.0)
+    # weightless mix keeps the unweighted (historical) draw arm
+    names, weights = parse_tenant_mix("a,b")
+    assert names == ("a", "b") and weights is None
+    # partial weights: unannotated entries default to 1.0
+    names, weights = parse_tenant_mix("a:2,b")
+    assert weights == (2.0, 1.0)
+    assert parse_tenant_mix(None) == (("default",), None)
+    assert parse_tenant_mix("", ("x",)) == (("x",), None)
+    with pytest.raises(ValueError):
+        parse_tenant_mix("a:nope")
+    with pytest.raises(ValueError):
+        parse_tenant_mix(":3")
+
+
 def test_config_validation():
     with pytest.raises(ValueError):
         TraceConfig(n_requests=0)
@@ -197,6 +241,10 @@ def test_config_validation():
         TraceConfig(unary_frac=1.5)
     with pytest.raises(ValueError):
         TraceConfig(tenants=())
+    with pytest.raises(ValueError):
+        TraceConfig(tenants=("a", "b"), tenant_weights=(1.0,))
+    with pytest.raises(ValueError):
+        TraceConfig(tenants=("a",), tenant_weights=(0.0,))
 
 
 def test_cli_writes_loadable_trace(tmp_path, capsys):

@@ -1274,6 +1274,68 @@ def episode_trace_replay_kill(seed):
           "tpu_replay_requests_total accounts every trace request")
 
 
+def episode_fleet_degraded_drain(tmp, seed):
+    """Episode 14 (PR 16): degraded-slice drain under load.  The fleet
+    reconciler (workloads/fleet.py) runs its full gate episode with the
+    SIGKILL arm disabled and the degraded-reshape arm live: mid-peak,
+    the capacity file's slice generation bumps with ``degraded: true``
+    while the open-loop ramp is still streaming.  The controller must
+    execute a rolling drain (router ``POST /drain`` first — no new
+    streams, in-flight finishes), stop the stale replica, and respawn
+    on the NEW generation, all without a single malformed client
+    frame.  Evidence is the episode report (journal + tpu_fleet_*
+    metrics), not logs."""
+    import argparse
+
+    from tpu_k8s_device_plugin.workloads import fleet
+
+    workdir = os.path.join(tmp, f"fleet-ep14-{seed}")
+    os.makedirs(workdir, exist_ok=True)
+    args = argparse.Namespace(
+        mode="episode", seed=seed, max_replicas=2,
+        # a shorter ramp than the CI fleet-gate: this episode proves
+        # the drain choreography under load, not the scaling curve
+        calm_requests=8, peak_requests=28, tail_requests=6,
+        calm_rate=2.0, peak_rate=8.0,
+        high_watermark=1.0, low_watermark=0.25,
+        up_stable_s=0.5, down_stable_s=2.0, cooldown_s=2.0,
+        drain_timeout_s=20.0, kill_at_ms=None, degrade_at_ms=None,
+        no_kill=True, no_degrade=False, capacity_spec="",
+        workdir=workdir, time_scale=1.0, late_ms=100.0,
+        timeout_s=120.0, settle_s=20.0, top_missed=3,
+        report=None, metrics_out=None, assert_goodput=None,
+        assert_fleet=False, fault_spec=None,
+        config="tiny", slots=2, max_len=512, max_new_tokens=128,
+        prefix_chunk=16, slo=None,
+        compile_cache_dir=os.environ.get(
+            "TPU_DP_COMPILE_CACHE_DIR",
+            os.path.join("tests", ".jax_cache")))
+    report, _ = fleet.run_episode(args)
+
+    f, c = report["fleet"], report["chaos"]
+    check(f["degraded_drained"],
+          "generation bump drained the stale replica "
+          "(tpu_fleet_scale_events_total{direction=down,"
+          "reason=degraded})")
+    check(f["respawned_on_new_generation"],
+          "drain was followed by a respawn placed on generation 2")
+    check(f["replicas_stopped"] >= 1,
+          "the drained replica was actually stopped "
+          "(tpu_fleet_replica_stopped journaled)")
+    check(c["frame_errors"] == 0,
+          f"zero malformed client frames through the drain "
+          f"(got {c['frame_errors']})")
+    check(f["final_replicas"] >= 1,
+          "fleet settled at/above the floor after the reshape")
+    for cls in ("interactive", "batch"):
+        info = report["classes"][cls]
+        check(info["eligible"] > 0,
+              f"{cls}: ramp landed eligible requests")
+        check(info["attainment"] >= 0.5,
+              f"{cls}: goodput floor held through the rolling drain "
+              f"(attainment {info['attainment']})")
+
+
 def _reshape_slice(tmp, testdata, seed, suffix, grace, hb_timeout):
     """A dedicated 2-host slice with live staleness + reshape grace (the
     main soak coordinator drives heartbeats manually with no timeout, so
@@ -1532,6 +1594,9 @@ def main(argv=None) -> int:
             log.info("=== episode 13: seeded trace replayed through "
                      "a kill ===")
             episode_trace_replay_kill(args.seed)
+            log.info("=== episode 14: degraded-slice drain under "
+                     "load ===")
+            episode_fleet_degraded_drain(tmp, args.seed)
         # -- final convergence sweep ----------------------------------
         for h in hosts:
             h.pulse()

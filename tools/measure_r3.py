@@ -129,6 +129,15 @@ PHASES = [
     # prefill is MXU-bound instead of host-bound.  Compare
     # decode_tpot_p99_ms_{homog,disagg} + migrate_mean_ms.
     ("serving_disagg_2rep_b8", 2400),
+    # round-16 addition: the fleet reconciler's scale-out delivery
+    # time on real chips.  The CPU fleet gate proves the control loop
+    # (ramp -> 1..N -> idle); what only hardware can answer is how
+    # fast 2 extra warmed replicas become routable capacity — spawn
+    # through the persistent compile cache, register, first healthy
+    # statz — i.e. whether scale-out is seconds (real elasticity) or
+    # a compile storm.  Reports time-to-2 and 2->4 separately: the
+    # second pair boots entirely warm.
+    ("fleet_scale_out_2to4", 2400),
 ]
 
 
@@ -647,6 +656,73 @@ def phase_reshape_under_load():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def phase_fleet_scale_out_2to4():
+    """The reconciler's capacity-delivery constant on real chips: a
+    floor of 4 llama3-8b-int8 replicas brought up by the fleet
+    controller through one persistent compile cache, timing router-
+    confirmed healthy counts at 2 and at 4.  scale_2to4_s is the
+    number the ROADMAP's elasticity story rests on — the second pair
+    boots entirely warm, so it is the marginal cost of a scale-out
+    decision, not of a cold fleet."""
+    import shutil
+    import tempfile
+
+    from tpu_k8s_device_plugin.workloads import fleet, loadclient
+    from tpu_k8s_device_plugin.workloads.router import RouterServer
+
+    tmp = tempfile.mkdtemp(prefix="fleet-r3-")
+    rt = RouterServer(statz_interval_s=0.5, replica_ttl_s=10.0,
+                      seed=0)
+    rt.start(host="127.0.0.1", port=0)
+    cap = os.path.join(tmp, "capacity.json")
+    with open(cap, "w") as f:
+        json.dump({"slices": [{"slice_id": "r3", "generation": 1,
+                               "workers": 4}]}, f)
+    controller = fleet.FleetController(
+        f"http://127.0.0.1:{rt.port}",
+        config=fleet.PlannerConfig(min_replicas=4, max_replicas=4,
+                                   start_grace_s=3600.0),
+        server=fleet.ServerSpec(
+            config="llama3-8b", slots=8, max_len=512,
+            max_new_tokens=64,
+            compile_cache_dir=os.path.join(tmp, "compile-cache")),
+        capacity_spec=cap, interval_s=1.0, seed=0)
+    import threading as _th
+
+    loop = _th.Thread(target=controller.run, daemon=True)
+    t0 = time.time()
+    t2 = t4 = None
+    try:
+        loop.start()
+        deadline = t0 + 2100
+        while time.time() < deadline and t4 is None:
+            try:
+                body = loadclient.fetch_json(rt.port, "/replicas",
+                                             timeout_s=10.0)
+            except (OSError, ValueError):
+                time.sleep(1.0)
+                continue
+            healthy = sum(1 for r in body.get("replicas", [])
+                          if isinstance(r, dict) and r.get("healthy"))
+            if t2 is None and healthy >= 2:
+                t2 = time.time() - t0
+            if healthy >= 4:
+                t4 = time.time() - t0
+            time.sleep(1.0)
+        if t4 is None:
+            raise RuntimeError(
+                f"never reached 4 healthy replicas (t2={t2})")
+        return {
+            "time_to_2_healthy_s": round(t2, 1),
+            "time_to_4_healthy_s": round(t4, 1),
+            "scale_2to4_s": round(t4 - t2, 1),
+        }
+    finally:
+        controller.shutdown()
+        rt.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 # -- orchestration ------------------------------------------------------------
 
 def run_phase_subprocess(name: str, timeout: int) -> dict:
@@ -687,8 +763,24 @@ def main() -> int:
         with open(OUT, "w") as f:
             json.dump(results, f, indent=1)
         if name == "probe" and "error" in results[name]:
-            print("no chip reachable — aborting", flush=True)
-            return 1
+            # a dead tunnel is an environment outage, not a failed
+            # measurement: record a structured skip that names the
+            # queued phases and exit 0, so the round's artifact reads
+            # "run me when the tunnel returns" instead of "broken"
+            # (rounds 2-5 recorded the same outage as failures)
+            results[name] = {
+                "skipped": "tunnel_down",
+                "detail": results[name].get("error"),
+                "seconds": results[name].get("seconds"),
+            }
+            results["queued_phases"] = [n for n, _ in PHASES[1:]]
+            with open(OUT, "w") as f:
+                json.dump(results, f, indent=1)
+            print(json.dumps(
+                {"skipped": "tunnel_down",
+                 "queued_phases": results["queued_phases"]}),
+                flush=True)
+            return 0
     print(f"wrote {OUT}", flush=True)
     return 0
 

@@ -378,6 +378,28 @@ def build_report(results: Sequence[RequestResult],
                          "p95": _pct(totals, 0.95),
                          "p99": _pct(totals, 0.99)},
         }
+    # per-tenant attainment: the quota buckets' report surface, so a
+    # multi-tenant gate can assert tenant:NAME=RATIO floors straight
+    # from the document
+    tenants: Dict[str, Dict[str, object]] = {}
+    by_tenant: Dict[str, List[RequestResult]] = {}
+    for r in results:
+        by_tenant.setdefault(r.req.tenant, []).append(r)
+    for tname in sorted(by_tenant):
+        rs = by_tenant[tname]
+        eligible = [r for r in rs if r.slo_met is not None]
+        met = [r for r in eligible if r.slo_met]
+        t_outcomes: Dict[str, int] = {}
+        for r in rs:
+            t_outcomes[r.outcome.outcome] = t_outcomes.get(
+                r.outcome.outcome, 0) + 1
+        tenants[tname] = {
+            "total": len(rs), "eligible": len(eligible),
+            "met": len(met),
+            "attainment": round(
+                len(met) / len(eligible), 4) if eligible else 1.0,
+            "outcomes": t_outcomes,
+        }
     missed = sorted(
         (r for r in results if r.slo_met is False),
         key=lambda r: -r.outcome.total_s)
@@ -415,6 +437,7 @@ def build_report(results: Sequence[RequestResult],
                           (r.lag_s for r in results),
                           default=0.0) * 1000.0, 3)},
         "classes": classes,
+        "tenants": tenants,
         "outcomes": outcome_totals,
         "abandoned": outcome_totals.get(
             loadclient.OUTCOME_ABANDONED, 0),
@@ -485,10 +508,13 @@ def run_fleet(args: argparse.Namespace,
     replay the trace through the router, optionally SIGKILL the last
     replica at ``--kill-replica-at-ms`` (trace time), and build the
     report with a journal/metric-proven ``chaos`` section."""
+    from .qos import parse_tenant_quotas
     from .router import RouterServer
 
     rt = RouterServer(statz_interval_s=0.5, replica_ttl_s=5.0,
-                      breaker_reset_s=0.5, seed=args.seed)
+                      breaker_reset_s=0.5, seed=args.seed,
+                      tenant_quotas=parse_tenant_quotas(
+                          getattr(args, "tenant_quota", None)))
     rt.start(host="127.0.0.1", port=0)
     procs: List["subprocess.Popen[bytes]"] = []
     victim_idx = args.replicas - 1
@@ -686,9 +712,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--report", default=None, metavar="FILE")
     p.add_argument("--metrics-out", default=None, metavar="FILE",
                    help="write the tpu_replay_* exposition here")
+    p.add_argument("--tenant-quota", action="append", default=None,
+                   metavar="NAME=RATE[:BURST[:WEIGHT]]",
+                   help="fleet mode: router-level per-tenant token "
+                        "quota (same grammar as the router flag) so "
+                        "replayed multi-tenant traffic exercises the "
+                        "quota buckets")
     p.add_argument("--assert-goodput", action="append", default=None,
-                   metavar="CLASS=RATIO",
-                   help="fail (exit 1) if a class's attainment is "
+                   metavar="CLASS=RATIO|tenant:NAME=RATIO",
+                   help="fail (exit 1) if a class's — or, with the "
+                        "tenant: prefix, a tenant's — attainment is "
                         "below RATIO (repeatable)")
     p.add_argument("--top-missed", type=int, default=5,
                    help="embed stitched spans for the slowest K "
@@ -751,10 +784,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         "chaos": report.get("chaos"),
     }, indent=2, sort_keys=True))
 
+    tenants = report.get("tenants")
+    tenants = tenants if isinstance(tenants, dict) else {}
     rc = 0
     for name, floor in _parse_goodput_specs(
             args.assert_goodput or []).items():
-        got = attain.get(name)
+        if name.startswith("tenant:"):
+            row = tenants.get(name.partition(":")[2])
+            got = row.get("attainment") \
+                if isinstance(row, dict) else None
+        else:
+            got = attain.get(name)
         if got is None or float(got) < floor:
             print(f"GOODPUT GATE FAIL: class {name} attainment "
                   f"{got} < {floor}", file=sys.stderr)

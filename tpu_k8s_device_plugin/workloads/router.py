@@ -257,6 +257,10 @@ class Replica:
     statz: Dict[str, Any] = field(default_factory=dict)
     statz_at: float = 0.0             # monotonic stamp of the snapshot
     breaker: Optional[resilience.CircuitBreaker] = None
+    # administratively out of rotation (POST /drain): heartbeats keep
+    # flowing and in-flight streams finish, but pick() skips it — the
+    # fleet reconciler's graceful-stop lever
+    draining: bool = False
 
     def host_port(self) -> Tuple[str, int]:
         host, _, port = self.address.rpartition(":")
@@ -535,6 +539,10 @@ class RouterServer:
             "Requests served by their tenant-ring pinned replica "
             "(sticky tenant->replica placement).")
         self._m_tenant_pins.inc(0)
+        # plain int twin of shed{no_replicas}: fleet_statz surfaces it
+        # so the reconciler can see demand arriving at an empty fleet
+        # (replica statz cannot carry that signal when there are none)
+        self._no_replica_total = 0
         reg.on_collect(self._collect_health)
 
     # -- replica table ------------------------------------------------------
@@ -593,6 +601,33 @@ class RouterServer:
         return {"ok": True, "replica_id": rid,
                 "interval_s": max(self.replica_ttl_s / 3.0, 0.2)}
 
+    def drain(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """POST /drain — take a replica out of rotation without killing
+        it: pick() skips a draining replica, its heartbeats keep the
+        row fresh, and in-flight streams run to completion on their
+        already-open connections.  ``{"draining": false}`` puts it
+        back.  Raises ValueError (400) on a malformed body and KeyError
+        (404) for an unknown replica — draining a ghost is a caller
+        bug, not a no-op."""
+        rid = payload.get("replica_id")
+        if not isinstance(rid, str) or not rid:
+            raise ValueError("'replica_id' must be a non-empty string")
+        draining = bool(payload.get("draining", True))
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is None:
+                raise KeyError(rid)
+            rep.draining = draining
+            statz = rep.statz if isinstance(rep.statz, dict) else {}
+            queue_depth = int(statz.get("queue_depth", 0) or 0)
+            in_flight = int(statz.get("in_flight", 0) or 0)
+        self.recorder.record("tpu_router_replica_draining",
+                             replica=rid, draining=draining)
+        log.info("replica %s %s rotation", rid,
+                 "leaving" if draining else "rejoining")
+        return {"ok": True, "replica_id": rid, "draining": draining,
+                "queue_depth": queue_depth, "in_flight": in_flight}
+
     def _rebuild_ring_locked(self) -> None:
         """The consistent-hash ring over the CURRENT replica-id set.
         Points depend only on the ids (``sha1(rid#v)``), never on
@@ -630,6 +665,8 @@ class RouterServer:
         probe's outcome), so a health CHECK must never consume it —
         recovery is detected by the poll loop and the breaker closes
         within about one poll interval of the replica coming back."""
+        if rep.draining:
+            return False
         if _now() - rep.last_seen > self.replica_ttl_s:
             return False
         if not rep.scheduler_alive():
@@ -743,6 +780,7 @@ class RouterServer:
                 "capacity": rep.capacity,
                 "role": rep.role,
                 "healthy": self._routable(rep),
+                "draining": rep.draining,
                 "breaker_state": rep.breaker.state,
                 "age_s": round(now - rep.last_seen, 3),
                 "load_score": round(rep.load_score(), 4),
@@ -776,6 +814,8 @@ class RouterServer:
             statz = rep.statz if isinstance(rep.statz, dict) else {}
             per_replica[rep.rid] = {
                 "healthy": ok,
+                "role": rep.role,
+                "draining": rep.draining,
                 "age_s": round(now - rep.last_seen, 3),
                 "statz": statz,
             }
@@ -824,11 +864,14 @@ class RouterServer:
                 "goodput_rps": acc["goodput_rps"],
                 "burn_rate_max": acc["burn_rate_max"],
             }
+        with self._lock:
+            no_replica_total = self._no_replica_total
         return {
             "replicas": len(reps),
             "healthy": healthy,
             "fleet": {**agg, "shed": shed_agg,
                       "goodput": goodput_out},
+            "router": {"no_replica_total": no_replica_total},
             "per_replica": per_replica,
         }
 
@@ -1196,6 +1239,8 @@ class RouterServer:
                       f"all {len(tried)} replica(s) failed: "
                       f"{last_err}")
             self._m_shed.labels(reason="no_replicas").inc()
+            with self._lock:
+                self._no_replica_total += 1
             self._m_requests.labels(
                 replica="none",
                 outcome="unroutable" if not tried
@@ -1549,6 +1594,24 @@ class RouterServer:
                     except (ValueError, TypeError) as e:
                         self._send(400, "application/json",
                                    (json.dumps({"error": str(e)})
+                                    + "\n").encode())
+                        return
+                    self._send(200, "application/json",
+                               (json.dumps(out) + "\n").encode())
+                    return
+                if self.path == "/drain":
+                    try:
+                        out = router.drain(
+                            json.loads(body) if body else {})
+                    except (ValueError, TypeError) as e:
+                        self._send(400, "application/json",
+                                   (json.dumps({"error": str(e)})
+                                    + "\n").encode())
+                        return
+                    except KeyError as e:
+                        self._send(404, "application/json",
+                                   (json.dumps({"error": "unknown "
+                                    f"replica {e.args[0]!r}"})
                                     + "\n").encode())
                         return
                     self._send(200, "application/json",

@@ -84,6 +84,11 @@ class TraceConfig:
     # must never emit ids the replayed model rejects as 400s)
     vocab: int = 256
     tenants: Tuple[str, ...] = ("default",)
+    # optional per-tenant traffic weights (parallel to `tenants`).
+    # None keeps the historical uniform randrange draw — and the
+    # historical byte stream, so every existing seed+config pair
+    # still produces an identical trace file.
+    tenant_weights: Optional[Tuple[float, ...]] = None
     unary_frac: float = 0.25
     slow_reader_frac: float = 0.05
     slow_reader_bytes_per_s: int = 512
@@ -105,6 +110,12 @@ class TraceConfig:
             raise ValueError("vocab too small")
         if not self.tenants:
             raise ValueError("need at least one tenant")
+        if self.tenant_weights is not None:
+            if len(self.tenant_weights) != len(self.tenants):
+                raise ValueError(
+                    "tenant_weights must parallel tenants")
+            if any(w <= 0 for w in self.tenant_weights):
+                raise ValueError("tenant weights must be > 0")
         for frac in (self.unary_frac, self.slow_reader_frac,
                      self.abandon_frac):
             if not 0.0 <= frac <= 1.0:
@@ -181,6 +192,13 @@ def generate(config: TraceConfig, seed: int) -> List[TraceRequest]:
     keep new draws at the END of the per-request block."""
     rng = random.Random(seed)
     cdf = _zipf_cdf(config.n_prefixes, config.zipf_alpha)
+    tenant_cdf: List[float] = []
+    if config.tenant_weights is not None:
+        total_w = sum(config.tenant_weights)
+        acc = 0.0
+        for w in config.tenant_weights:
+            acc += w / total_w
+            tenant_cdf.append(acc)
     prefixes = [_prefix_block(seed, config, pid)
                 for pid in range(config.n_prefixes)]
     rates = {False: config.base_rate_rps, True: config.burst_rate_rps}
@@ -207,7 +225,14 @@ def generate(config: TraceConfig, seed: int) -> List[TraceRequest]:
         suffix_len = max(1, prompt_len)
         suffix = [rng.randrange(1, config.vocab)
                   for _ in range(suffix_len)]
-        tenant = config.tenants[rng.randrange(len(config.tenants))]
+        # both arms consume exactly one draw, and the unweighted arm
+        # keeps the historical randrange call — same seed + same old
+        # config still yields a byte-identical trace
+        if tenant_cdf:
+            ti = bisect.bisect_left(tenant_cdf, rng.random())
+            tenant = config.tenants[min(ti, len(config.tenants) - 1)]
+        else:
+            tenant = config.tenants[rng.randrange(len(config.tenants))]
         stream = rng.random() >= config.unary_frac
         slo_class = "interactive" if stream else "batch"
         priority = 0 if stream else 1
@@ -408,6 +433,41 @@ def summarize(requests: List[TraceRequest]) -> Dict[str, object]:
     }
 
 
+def parse_tenant_mix(
+        spec: Optional[str],
+        fallback: Tuple[str, ...] = ("default",),
+) -> Tuple[Tuple[str, ...], Optional[Tuple[float, ...]]]:
+    """Parse a ``--tenants NAME[:WEIGHT],...`` mix.  Weights are
+    optional per-entry (absent means 1.0); an all-default mix returns
+    ``None`` weights so the unweighted draw — and byte-determinism of
+    old traces — is preserved."""
+    if not spec:
+        return fallback, None
+    names: List[str] = []
+    weights: List[float] = []
+    weighted = False
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, w_s = part.partition(":")
+        if not name:
+            raise ValueError(f"--tenants: empty name in {spec!r}")
+        w = 1.0
+        if sep:
+            try:
+                w = float(w_s)
+            except ValueError:
+                raise ValueError(
+                    f"--tenants: bad weight {w_s!r} for {name!r}")
+            weighted = True
+        names.append(name)
+        weights.append(w)
+    if not names:
+        raise ValueError(f"--tenants: no tenants in {spec!r}")
+    return tuple(names), tuple(weights) if weighted else None
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         description="Generate a seeded production-shaped trace "
@@ -433,12 +493,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "vocab or every request 400s")
     p.add_argument("--tenant", action="append", default=None,
                    help="tenant name (repeatable; default: default)")
+    p.add_argument("--tenants", default=None,
+                   metavar="NAME[:WEIGHT],...",
+                   help="tenant mix in one flag, optionally "
+                        "weighted (e.g. 'team-a:3,team-b:1' sends "
+                        "75%% of traffic as team-a); supersedes "
+                        "--tenant")
     p.add_argument("--unary-frac", type=float, default=0.25)
     p.add_argument("--slow-reader-frac", type=float, default=0.05)
     p.add_argument("--slow-reader-bytes-per-s", type=int, default=512)
     p.add_argument("--abandon-frac", type=float, default=0.05)
     p.add_argument("--abandon-after-ms", type=float, default=400.0)
     args = p.parse_args(argv)
+    tenants, tenant_weights = parse_tenant_mix(
+        args.tenants, tuple(args.tenant) if args.tenant
+        else ("default",))
     config = TraceConfig(
         n_requests=args.requests, base_rate_rps=args.base_rate,
         burst_rate_rps=args.burst_rate,
@@ -447,7 +516,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         prompt_max=args.prompt_max,
         output_median=args.output_median,
         output_max=args.output_max, vocab=args.vocab,
-        tenants=tuple(args.tenant) if args.tenant else ("default",),
+        tenants=tenants, tenant_weights=tenant_weights,
         unary_frac=args.unary_frac,
         slow_reader_frac=args.slow_reader_frac,
         slow_reader_bytes_per_s=args.slow_reader_bytes_per_s,
