@@ -58,15 +58,19 @@ def _solo(model, params, prompt, n_steps):
 
 def _drive(model, params, dfa, trace, interleave, max_new=6,
            n_slots=2, window=4, grammar=False, packed=False,
-           overlap=False, kv_paging=False):
+           overlap=False, kv_paging=False, fused=False, lp_out=None,
+           logprobs_k=0):
     """Run *trace* — a list of ``(arrival_iteration, key, kwargs)`` —
     through an IterationScheduler and return {key: tokens}.  Fully
-    deterministic: arrivals keyed to iteration indices, dwell off."""
+    deterministic: arrivals keyed to iteration indices, dwell off.
+    *lp_out* (optional dict) collects each key's logprob records at
+    retirement, for the fused logprob-harvest equivalence check."""
     eng = ServingEngine(model, params, n_slots=n_slots, chunk=4,
                         eos_id=EOS if grammar else None,
                         max_new_tokens=max_new, auto_prefix_min=4,
                         grammar=dfa if grammar else None,
-                        kv_paging=kv_paging)
+                        kv_paging=kv_paging, fused_decode=fused,
+                        logprobs_k=logprobs_k)
     intake: deque = deque()
     tickets = {}
     live = {}
@@ -95,7 +99,10 @@ def _drive(model, params, dfa, trace, interleave, max_new=6,
             live[t.slot] = tickets.pop(t)
         for slot in list(live):
             if eng.finished(slot):
-                results[live.pop(slot)] = eng.output(slot)
+                key = live.pop(slot)
+                results[key] = eng.output(slot)
+                if lp_out is not None:
+                    lp_out[key] = eng.token_logprobs(slot)
         if (ai == len(arrivals) and not intake and not live
                 and not sched.busy()):
             break
@@ -565,6 +572,154 @@ def test_packing_conflict_defers_shared_prefix(setup):
     assert not sched.packing_conflict([3, 14])        # below the grid
     sched.cancel(t)
     assert not sched.packing_conflict(pa)             # nothing pending
+
+
+def _assert_fused_equivalent(model, params, dfa, trace, **kw):
+    """The fused-decode axis of the toggle matrix: every (packed,
+    overlap, interleave) combination WITH the fused loop must produce
+    the serial UNFUSED baseline's exact streams — on-device boundary
+    detection and the columnar harvest may change the work, never the
+    bytes."""
+    base = _drive(model, params, dfa, trace, interleave=False, **kw)
+    for packed in (False, True):
+        for overlap in (False, True):
+            for interleave in (True, False):
+                got = _drive(model, params, dfa, trace, fused=True,
+                             interleave=interleave, packed=packed,
+                             overlap=overlap, **kw)
+                assert got == base, (
+                    f"fused streams diverged at packed={packed} "
+                    f"overlap={overlap} interleave={interleave}")
+    return base
+
+
+def test_fused_equivalence_greedy_apc_and_stops(setup):
+    # greedy + APC hit/miss + a stop-set request: the device boundary
+    # carry must cut exactly where the host column re-scan did, with
+    # slots recycling through the zero-extend repeat paths
+    model, params, dfa = setup
+    pa = [3, 14, 15, 92, 65, 35, 89, 79]    # 2 chunks of 4
+    pb = [2, 71, 82, 81, 82]                # miss vs pa
+    trace = [
+        (0, "a0", dict(prompt=pa)),
+        (0, "b0", dict(prompt=pb, stop=[22])),
+        (1, "a1", dict(prompt=pa)),          # exact repeat -> full hit
+        (2, "ash", dict(prompt=pa[:4] + [9, 9])),   # shared chunk
+        (4, "b1", dict(prompt=pb)),
+        (5, "a2", dict(prompt=pa)),
+    ]
+    on = _assert_fused_equivalent(model, params, dfa, trace,
+                                  n_slots=3)
+    for key, prompt in (("a0", pa), ("a1", pa), ("a2", pa)):
+        assert on[key] == _solo(model, params, prompt, 6)
+
+
+def test_fused_equivalence_seeded_sampled(setup):
+    # the fused loop LIFTS the sampled dispatch-ahead stand-down, so
+    # this is the combination PR 11 could not overlap: seeded sampled
+    # windows dispatched ahead must still replay each seed's own
+    # chain bit-for-bit, admissions and retirements notwithstanding
+    model, params, dfa = setup
+    pa = [3, 14, 15, 92, 65]
+    pb = [2, 71, 82]
+    pc = [44, 9, 1, 7]
+    trace = [
+        (0, "s1", dict(prompt=pa, temperature=1.0, seed=7)),
+        (0, "g0", dict(prompt=pb)),
+        (0, "s2", dict(prompt=pc, temperature=0.7, top_k=8, seed=41)),
+        (3, "s3", dict(prompt=pa, temperature=1.0, seed=7)),
+    ]
+    on = _assert_fused_equivalent(model, params, dfa, trace,
+                                  n_slots=3)
+    assert on["s1"] == on["s3"]
+
+
+def test_fused_equivalence_grammar(setup):
+    # the columnar DFA walk vs the per-token host walk, mid-trace
+    # admissions included
+    model, params, dfa = setup
+    trace = [
+        (0, "g1", dict(prompt=[65, 66], grammar=True)),
+        (0, "u1", dict(prompt=[2, 71, 82])),
+        (0, "g2", dict(prompt=[67, 68], grammar=True)),
+        (2, "g3", dict(prompt=[65, 66, 67, 68], grammar=True)),
+    ]
+    _assert_fused_equivalent(model, params, dfa, trace, grammar=True,
+                             max_new=8, n_slots=3)
+
+
+def test_fused_equivalence_logprobs(setup):
+    # the bulk logprob harvest must reproduce the per-step records
+    # exactly — values AND count (records stop at the finish boundary)
+    model, params, dfa = setup
+    pa = [3, 14, 15, 92, 65]
+    pb = [2, 71, 82, 81]
+    trace = [
+        (0, "l1", dict(prompt=pa, logprobs=3)),
+        (0, "g0", dict(prompt=pb)),
+        (2, "l2", dict(prompt=pb, logprobs=2, temperature=0.9,
+                       seed=13)),
+    ]
+    lp_base: dict = {}
+    base = _drive(model, params, dfa, trace, interleave=False,
+                  n_slots=3, lp_out=lp_base, logprobs_k=4)
+    for interleave in (True, False):
+        lp_got: dict = {}
+        got = _drive(model, params, dfa, trace, fused=True,
+                     interleave=interleave, packed=True, overlap=True,
+                     n_slots=3, lp_out=lp_got, logprobs_k=4)
+        assert got == base
+        assert lp_got == lp_base
+    assert all(len(lp_base[k]) == len(base[k]) for k in ("l1", "l2"))
+
+
+def test_fused_equivalence_kv_paging(setup):
+    # the paged pool under the fused loop: boundary cuts and the
+    # columnar harvest ride block-tabled caches identically
+    model, params, dfa = setup
+    pa = [3, 14, 15, 92, 65, 35, 89, 79]
+    pb = [2, 71, 82, 81, 82]
+    trace = [
+        (0, "a0", dict(prompt=pa)),
+        (0, "b0", dict(prompt=pb, stop=[22])),
+        (1, "a1", dict(prompt=pa)),          # paged zero-page repeat
+        (3, "ash", dict(prompt=pa[:4] + [9, 9])),   # CoW shared chunk
+    ]
+    base = _drive(model, params, dfa, trace, interleave=False)
+    for packed in (False, True):
+        for overlap in (False, True):
+            got = _drive(model, params, dfa, trace, interleave=True,
+                         packed=packed, overlap=overlap,
+                         kv_paging=True, fused=True)
+            assert got == base, (
+                f"fused paged streams diverged at packed={packed} "
+                f"overlap={overlap}")
+
+
+def test_fused_overlap_dispatches_ahead_when_sampled(setup):
+    # the tentpole's scheduling payoff: with fused_decode the sampled
+    # stand-down lifts — a live seeded request no longer forces the
+    # serial cadence, and the double-buffered window is on the device
+    # between iterations (PR 11 never got this; see the non-fused
+    # fallback test above)
+    model, params, dfa = setup
+    eng = ServingEngine(model, params, n_slots=2, chunk=4,
+                        max_new_tokens=16, auto_prefix_min=4,
+                        fused_decode=True)
+    sched = IterationScheduler(eng, window=4, packed_prefill=True,
+                               overlap=True, sync_dwell_s=0.0)
+    sched.begin(prompt=[2, 71, 82], temperature=1.0, seed=3)
+    sched.iterate()
+    assert sched._ahead is not None, (
+        "fused sampled window was not dispatched ahead")
+    assert eng.scan_inflight
+    # drain clean: the overlapped sampled stream must still finish
+    for _ in range(30):
+        sched.iterate()
+        if not any(eng.active) and not sched.busy():
+            break
+    assert sched._ahead is None and not eng.scan_inflight
+    assert eng.stats()["fused_windows"] > 0
 
 
 def test_scheduler_metrics_families_render(setup):

@@ -1137,3 +1137,66 @@ def test_draw_stream_mode_independent_after_retirement(setup):
         a.step()
     b.run_scan(2)
     assert a.output(sa) == b.output(sb)
+
+
+def test_run_scan_fused_matches_unfused(setup):
+    # the fused window (on-device eos/stop/budget carry + columnar
+    # harvest) against the per-step host harvest, over a window mixing
+    # greedy, stop-set, and seeded-sampled slots with budget cuts
+    model, params = setup
+
+    def mk(fused):
+        e = ServingEngine(model, params, n_slots=3, eos_id=0,
+                          max_new_tokens=5, fused_decode=fused,
+                          rng=jax.random.PRNGKey(5))
+        sl = {}
+        sl["g"] = e.admit([3, 14, 15, 92, 65])
+        sl["t"] = e.admit([2, 71, 82], stop=[94, 22])
+        sl["s"] = e.admit([9, 9, 8], temperature=1.0, top_k=8,
+                          seed=17)
+        return e, sl
+
+    a, sa = mk(False)
+    b, sb = mk(True)
+    oa = a.run_scan(7)
+    ob = b.run_scan(7)
+    assert oa == ob                      # per-window returns
+    for k in sa:
+        assert a.output(sa[k]) == b.output(sb[k]), k
+        assert (a.finish_reason(sa[k]) if a.finished(sa[k]) else None) \
+            == (b.finish_reason(sb[k]) if b.finished(sb[k]) else None)
+    assert a.stats()["tokens_emitted"] == b.stats()["tokens_emitted"]
+    assert b.stats()["fused_windows"] == 1
+    # the unfused engine never counts fused windows
+    assert a.stats()["fused_windows"] == 0
+
+
+def test_draw_stream_pinned_across_fused_and_per_step(setup):
+    # the sampled-window draw-accounting contract survives fusion: a
+    # LATER admission must see the identical key stream whether the
+    # earlier window ran fused, per-step harvested, or step-by-step —
+    # _draws and the per-slot chains land in the same place
+    model, params = setup
+
+    def mk(fused):
+        return ServingEngine(model, params, n_slots=2,
+                             max_new_tokens=3, fused_decode=fused,
+                             rng=jax.random.PRNGKey(5))
+
+    a, b, c = mk(False), mk(True), mk(False)
+    for e in (a, b, c):
+        e.admit([3, 14, 15])                              # greedy
+        e.admit([9, 9, 8], temperature=1.0, top_k=8)      # sampled
+    a.run_scan(6)   # per-step harvest (both retire mid-window)
+    b.run_scan(6)   # fused harvest of the same window
+    for _ in range(6):
+        c.step()    # step-by-step baseline
+    assert a._draws == b._draws == c._draws
+    assert a._slot_draws == b._slot_draws == c._slot_draws
+    sa = a.admit([5, 17, 3], temperature=1.0, top_k=8)
+    sb = b.admit([5, 17, 3], temperature=1.0, top_k=8)
+    sc = c.admit([5, 17, 3], temperature=1.0, top_k=8)
+    a.run_scan(2)
+    b.run_scan(2)
+    c.run_scan(2)
+    assert a.output(sa) == b.output(sb) == c.output(sc)

@@ -138,6 +138,15 @@ PHASES = [
     # a compile storm.  Reports time-to-2 and 2->4 separately: the
     # second pair boots entirely warm.
     ("fleet_scale_out_2to4", 2400),
+    # round-17 addition: the fused decode loop on real chips.  The CPU
+    # proxy proves byte-identity and shows the harvest-path win
+    # (~1.9x per window) but is host-forward-bound, so the end-to-end
+    # claim — sampled windows overlapping dispatch + the boundary
+    # carry staying on-device instead of a host re-scan per column —
+    # only means something where the forward pass runs on MXUs.
+    # Compare tokens_per_sec_http_{off,on}, tpot_ms_p99_{off,on},
+    # and harvest_ms_per_window_{off,on}.
+    ("serving_fused_decode_b8", 2400),
 ]
 
 
@@ -448,6 +457,25 @@ def phase_serving_disagg_2rep_b8():
     return run_disagg("llama3-8b", True, clients=8, n_requests=32,
                       slots=8, steps=64, prompt_len=96, max_len=512,
                       seed=1)
+
+
+def phase_serving_fused_decode_b8():
+    """Fused decode loop A/B on the 8B int8 target under the DECODE-
+    HEAVY shape (short distinct prompts, long seeded-sampled outputs
+    with top-4 logprobs): OFF vs ON in one phase (run_decode_heavy
+    runs both arms best-of-2).  The CPU proxy gates the harvest-path
+    win (>= 1.10x per window); on hardware the headline is
+    tokens_per_sec_http_on/off — sampled windows dispatch ahead and
+    the boundary carry never round-trips to host — plus what the
+    vectorized harvest does to tpot_ms_p99."""
+    from tpu_k8s_device_plugin.workloads.bench_serving import (
+        run_decode_heavy,
+    )
+
+    # budget: 64 * (1 + 3) = 256 decode rows + 32 prompt <= 512
+    return run_decode_heavy("llama3-8b", True, clients=8,
+                            n_requests=32, slots=8, steps=64,
+                            prompt_len=32, max_len=512)
 
 
 def phase_replica_cold_start():

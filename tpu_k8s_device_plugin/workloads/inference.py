@@ -804,6 +804,38 @@ def _greedy_pick(logits, key, top_k, temperature):
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
+def scan_boundary_update(fin, frs, nxt, i, eos_vec, stop_mat,
+                         emitted0, budget):
+    """One decode step's on-device finish detection, for a ``lax.scan``
+    carry: given the step's picked tokens ``nxt`` [S] and the carried
+    first-boundary state (``fin`` [S] int32 step index, -1 = none yet;
+    ``frs`` [S] int32 reason code), record which slots just hit a
+    finish boundary.  Reason codes mirror the engine's finish_reason
+    taxonomy: 1 = eos, 2 = stop token, 3 = length (budget).
+
+    Detection is data, not shapes: ``eos_vec`` [S] is the effective
+    per-slot eos id (-1 disables — no token id is negative), ``stop_mat``
+    [S, K] is the padded per-slot stop-id matrix (pad -1), ``emitted0``
+    [S] the tokens already emitted before the window, and ``budget`` a
+    scalar cap (pass a huge value for "no budget").  Precedence matches
+    the host walk exactly: the earliest flagged token wins (first write
+    into ``fin``), and on one token eos beats stop beats length — the
+    budget cut therefore only applies strictly before any eos/stop.
+    Pure carry bookkeeping: the token math of the surrounding scan is
+    untouched, which is what keeps a fused window byte-identical to the
+    per-step path by construction."""
+    eos_hit = nxt == eos_vec
+    stop_hit = (stop_mat == nxt[:, None]).any(axis=1)
+    len_hit = (emitted0 + i + 1) >= budget
+    reason = jnp.where(
+        eos_hit, 1,
+        jnp.where(stop_hit, 2, jnp.where(len_hit, 3, 0))
+    ).astype(jnp.int32)
+    first = (fin < 0) & (reason > 0)
+    return (jnp.where(first, i, fin).astype(jnp.int32),
+            jnp.where(first, reason, frs).astype(jnp.int32))
+
+
 def _sample_pick(logits, key, top_k, temperature):
     """Temperature-scaled, optionally top-k truncated sampling.
     ``lax.top_k`` (the TPU-lowered primitive — no full vocab sort) gives

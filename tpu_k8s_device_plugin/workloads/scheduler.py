@@ -589,13 +589,22 @@ class IterationScheduler:
         before returning from iterate, so the owner's host-side
         harvest/stream-write work between calls overlaps device
         compute instead of leaving it idle.  Engaged ONLY when the
-        post-harvest state would choose a plain scan anyway AND no
-        sampled knob is live: a sampled slot retiring behind an
-        already-dispatched window would shift the draw accounting
-        seeded neighbors replay — greedy/grammar windows have no draw
-        stream, and a slot the owner releases mid-window lands in the
-        handle's skip set, so output bytes are unchanged (the
-        equivalence suite pins overlap on == off).
+        post-harvest state would choose a plain scan anyway AND —
+        without the fused decode loop — no sampled knob is live: a
+        sampled slot retiring behind an already-dispatched window
+        would shift the draw accounting seeded neighbors replay —
+        greedy/grammar windows have no draw stream, and a slot the
+        owner releases mid-window lands in the handle's skip set, so
+        output bytes are unchanged (the equivalence suite pins overlap
+        on == off).  With ``fused_decode`` the sampled stand-down
+        lifts: dispatch-ahead runs AFTER the previous harvest applied
+        all draw/retirement accounting, the picked rows are
+        independent per slot (a retired neighbor's key-stream rows
+        produce only discarded tokens, same masking contract as
+        run_scan), and boundaries the carry detects truncate at
+        harvest — so sampled windows overlap byte-identically too, and
+        only the budget-imminent check below still stands windows
+        down.
 
         *decoded* — the harvest this iterate just returned, which the
         owner has NOT streamed yet — adjusts the budget hints: if any
@@ -613,8 +622,9 @@ class IterationScheduler:
             return
         if eng.spec_ready() or eng.forced_pending():
             return
-        if _knobs_live(eng.temps, eng.topks, eng.topps, eng.minps,
-                       eng.pres, eng.freqs, eng.reps):
+        if not getattr(eng, "fused_decode", False) and \
+                _knobs_live(eng.temps, eng.topks, eng.topps,
+                            eng.minps, eng.pres, eng.freqs, eng.reps):
             return
         consumed = ({s: len(t) for s, t in decoded.items()}
                     if decoded else None)

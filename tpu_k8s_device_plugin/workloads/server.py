@@ -851,6 +851,20 @@ class EngineServer:
             "tpu_serve_packed_prefill_pad_tokens_total",
             "Zero-pad token rows computed by packed prefill dispatches "
             "(tail-chunk grid padding — the packing waste metric).")
+        # -- fused decode loop (PR 17) ------------------------------------
+        # harvest-side visibility for the fused window path: how many
+        # windows ran with the on-device boundary carry, and how many
+        # post-finish steps those windows burned (the adaptive-window
+        # headroom signal).  Rendered from boot on unfused engines too
+        # (zeros), so the dashboard schema is mode-independent.
+        self._m_fused_windows = reg.counter(
+            "tpu_serve_fused_windows_total",
+            "Decode windows dispatched with the fused on-device "
+            "boundary carry (eos/stop/budget detected in-scan).")
+        self._m_fused_trunc = reg.counter(
+            "tpu_serve_fused_truncated_tokens_total",
+            "Tokens computed after a slot's on-device finish boundary "
+            "and discarded at harvest (post-finish window burn).")
         self._m_warmup = reg.gauge(
             "tpu_serve_warmup_seconds",
             "Wall seconds warm_scheduler spent pre-compiling, by "
@@ -967,6 +981,8 @@ class EngineServer:
         self._m_prefix_evict._set(st.get("prefix_evictions", 0))
         self._m_packed_reqs._set(st.get("packed_prefill_requests", 0))
         self._m_packed_pad._set(st.get("packed_prefill_pad_tokens", 0))
+        self._m_fused_windows._set(st.get("fused_windows", 0))
+        self._m_fused_trunc._set(st.get("fused_truncated_tokens", 0))
 
     def _resolve_quota(self, tenant: str) -> Optional["TenantQuota"]:
         """Per-tenant QoS state; the ``*`` spec is a TEMPLATE — each
@@ -3357,6 +3373,16 @@ def main(argv=None) -> int:
                         "overlap device compute; auto-falls back to "
                         "the serial cadence while any sampled request "
                         "is live (outputs byte-identical either way)")
+    p.add_argument("--fused-decode", default=False,
+                   action=argparse.BooleanOptionalAction,
+                   help="fused decode loop (default off): decode "
+                        "windows carry per-slot eos/stop/budget finish "
+                        "flags on-device, harvest slices kept prefixes "
+                        "columnar-side instead of re-scanning tokens "
+                        "on host, and dispatch-ahead overlap extends "
+                        "to SAMPLED windows (outputs byte-identical "
+                        "either way — the fused equivalence suite "
+                        "pins it)")
     p.add_argument("--max-pack", type=int, default=DEFAULT_MAX_PACK,
                    metavar="K",
                    help="packed-prefill width cap: each pack size in "
@@ -3653,7 +3679,8 @@ def main(argv=None) -> int:
                            kv_pages=args.kv_pages or None,
                            kv_page_size=args.kv_page_size,
                            kv_dtype=args.kv_dtype,
-                           prefix_registry_max=args.prefix_registry_max)
+                           prefix_registry_max=args.prefix_registry_max,
+                           fused_decode=args.fused_decode)
     tokenizer = None
     if args.tokenizer:
         try:

@@ -53,6 +53,7 @@ from .inference import (
     init_cache,
     init_pool_cache,
     quantize_kv_rows,
+    scan_boundary_update,
     validate_top_k,
 )
 from .kv_pool import PagePool, PagePoolExhausted
@@ -73,6 +74,18 @@ DEFAULT_CHUNK = 128
 # serving bench measured the front-door win with (BASELINE §ROUND-6),
 # now the engine default instead of a harness-side trick.
 PREFIX_CHUNK = 32
+
+# Fused decode loop: the per-slot stop-id matrix rides the scan as a
+# [S, K] operand, so K is part of the jit cache key — quantizing it to
+# multiples of 4 bounds the compiled-variant count at a handful (most
+# requests carry 0-4 stop ids) instead of one variant per distinct
+# widest-stop-set size.
+_STOP_PAD = 4
+
+# Budget sentinel for the fused boundary carry when the engine has no
+# max_new_tokens: far above any emitted0 + n_steps reachable within
+# max_len, so the length comparison never fires.
+_NO_BUDGET = 1 << 30
 
 
 def _resolve_chunk(max_len: int,
@@ -333,6 +346,21 @@ def _ngram_propose(seq: np.ndarray, n: int, g: int) -> np.ndarray:
     return out
 
 
+def _knobs_live_vec(temps, topks, topps, minps, pres, freqs,
+                    reps) -> np.ndarray:
+    """[S] bool: which slots' sampling knobs are armed.  One snapshot
+    of this at harvest entry replaces the per-step full-vector
+    recomputation scan_harvest used to pay (O(n_steps × n_slots) of
+    pure waste: between two harvest steps the only knob mutator is
+    _finish, which zeroes exactly the finishing slot's knobs — so
+    dropping that slot from the snapshot's armed set is equivalent to
+    re-reading all seven vectors)."""
+    return ((np.asarray(temps) != 0) | (np.asarray(topks) != 0)
+            | (np.asarray(topps) < 1.0) | (np.asarray(minps) != 0)
+            | (np.asarray(pres) != 0) | (np.asarray(freqs) != 0)
+            | (np.asarray(reps) != 1.0))
+
+
 def _knobs_live(temps, topks, topps, minps, pres, freqs, reps) -> bool:
     """True when any slot's sampling knobs are armed.  THE predicate
     the engine's key-stream accounting hangs on: _sample's greedy fast
@@ -341,10 +369,8 @@ def _knobs_live(temps, topks, topps, minps, pres, freqs, reps) -> bool:
     behind (the streams would diverge after a retirement).  Penalties
     arm it too: a penalized temp-0 request still needs the full pick
     (penalized argmax != plain argmax)."""
-    return bool(temps.any() or topks.any()
-                or (np.asarray(topps) < 1.0).any() or minps.any()
-                or pres.any() or freqs.any()
-                or (np.asarray(reps) != 1.0).any())
+    return bool(_knobs_live_vec(temps, topks, topps, minps, pres,
+                                freqs, reps).any())
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -478,26 +504,39 @@ def _top_logprobs(logits, chosen, k):
 
 
 @functools.partial(
-    jax.jit, static_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8, 9),
-    donate_argnums=(11,)
+    jax.jit, static_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10),
+    donate_argnums=(12,)
 )
 def _scan_decode(model, n_steps, sampled, lp_k, pen, rep, seeded,
-                 biased, minned, grammared, params, cache, last, lens,
-                 temps, topks, topps, minps, pres, freqs, reps, counts,
-                 seen, bias, min_mask, min_toks, emitted0,
+                 biased, minned, grammared, fused, params, cache, last,
+                 lens, temps, topks, topps, minps, pres, freqs, reps,
+                 counts, seen, bias, min_mask, min_toks, emitted0,
                  gtable, gstate0,
                  seeds, seed_streams, seed_on, seed_base, adapter_ids,
-                 rng, draws0, btables=None):
+                 rng, draws0, btables=None, stop_mat=None,
+                 eos_vec=None, budget=None):
     """n_steps decode steps in one lax.scan.  The per-step sampling key
     is fold_in(rng, draws0 + i) — the same chain ``step`` consumes one
     link of per call, so scan and step-by-step emit identical streams.
     Greedy mode (sampled=False) skips the pick entirely.  With lp_k,
     per-step logprob stats ride the scan outputs; with pen, the
     penalty histogram rides the carry (compiled variants scale with
-    the STATIC flags — a handful engine-wide, never per request)."""
+    the STATIC flags — a handful engine-wide, never per request).
+
+    With *fused*, per-slot finish flags ride the carry too
+    (inference.scan_boundary_update): the step index and reason of the
+    first eos/stop/budget boundary each slot hits, detected on-device
+    against *eos_vec*/*stop_mat*/*budget* — harvest then truncates from
+    the returned arrays instead of re-scanning columns on the host.
+    The token math is identical either way (the detector only watches
+    the picked tokens), which is what makes fused windows byte-equal
+    to unfused ones by construction."""
 
     def step_fn(carry, i):
-        cache, tok, pos, cnt, sn, gs = carry
+        if fused:
+            cache, tok, pos, cnt, sn, gs, fin, frs = carry
+        else:
+            cache, tok, pos, cnt, sn, gs = carry
         logits, mut = model.apply(
             {"params": params, "cache": cache},
             tok[:, None], pos[:, None], decode=True,
@@ -556,13 +595,28 @@ def _scan_decode(model, n_steps, sampled, lp_k, pen, rep, seeded,
             stepped = jnp.take_along_axis(
                 grow, nxt[:, None], axis=1)[:, 0]
             gs = jnp.where(gs >= 0, stepped, gs)
+        if fused:
+            fin, frs = scan_boundary_update(
+                fin, frs, nxt, i, eos_vec, stop_mat, emitted0, budget)
+            return (mut["cache"], nxt, pos + 1, cnt, sn, gs,
+                    fin, frs), out
         return (mut["cache"], nxt, pos + 1, cnt, sn, gs), out
 
+    if fused:
+        S = last.shape[0]
+        fin0 = jnp.full((S,), -1, jnp.int32)
+        frs0 = jnp.zeros((S,), jnp.int32)
+        (cache, _, _, counts, seen, _, fin, frs), ys = lax.scan(
+            step_fn,
+            (cache, last, lens, counts, seen, gstate0, fin0, frs0),
+            jnp.arange(n_steps)
+        )
+        return ys, cache, counts, seen, fin, frs
     (cache, _, _, counts, seen, _), ys = lax.scan(
         step_fn, (cache, last, lens, counts, seen, gstate0),
         jnp.arange(n_steps)
     )
-    return ys, cache, counts, seen
+    return ys, cache, counts, seen, None, None
 
 
 class _PrefillJob:
@@ -772,9 +826,10 @@ class _ScanHandle:
     window they sat out)."""
 
     __slots__ = ("ys", "n_steps", "sampled", "lp_k", "grammared",
-                 "active", "skip")
+                 "active", "skip", "fused", "fin", "frs")
 
-    def __init__(self, ys, n_steps, sampled, lp_k, grammared, active):
+    def __init__(self, ys, n_steps, sampled, lp_k, grammared, active,
+                 fused=False, fin=None, frs=None):
         self.ys = ys
         self.n_steps = n_steps
         self.sampled = sampled
@@ -782,6 +837,11 @@ class _ScanHandle:
         self.grammared = grammared
         self.active = active
         self.skip = set()
+        # fused boundary carry (device futures until harvest): per-slot
+        # first-finish step index (-1 = none) and reason code
+        self.fused = fused
+        self.fin = fin
+        self.frs = frs
 
 
 class ServingEngine:
@@ -817,6 +877,7 @@ class ServingEngine:
         kv_page_size: int = 0,
         kv_dtype: Optional[str] = None,
         prefix_registry_max: int = 256,
+        fused_decode: bool = False,
     ):
         if n_slots < 1:
             raise ValueError("n_slots must be >= 1")
@@ -1047,6 +1108,24 @@ class ServingEngine:
         # which at short windows was a measurable slice of the serving
         # hot path (None = stale, rebuilt on next scan)
         self._knob_cache = None
+        # fused decode loop (opt-in): scan windows carry per-slot
+        # finish flags on-device (eos / stop-set / remaining budget,
+        # see _scan_decode), harvest truncates from the returned
+        # flag/step-index arrays with columnar numpy instead of the
+        # per-step per-slot Python walk, and the scheduler may
+        # dispatch SAMPLED windows ahead (the boundary carry makes the
+        # harvest's draw accounting independent of host knob churn
+        # behind the dispatch).  Outputs are byte-identical to the
+        # unfused paths by construction — the fused toggle matrix in
+        # tests/test_scheduler.py pins it across every feature.
+        self.fused_decode = bool(fused_decode)
+        # device mirrors for the boundary detector (stop-id matrix +
+        # effective per-slot eos vector), same rebuild-on-stale
+        # lifecycle as _knob_cache but invalidated by stop/ignore_eos
+        # churn, which knob-identical admissions can cause
+        self._fused_cache = None
+        self._fused_windows = 0
+        self._fused_truncated = 0
         # output-token histogram for the penalties: [S, V] on device,
         # bumped per decode step only while some penalized request is
         # live, reset per slot at each PENALIZED admit (unpenalized
@@ -1541,6 +1620,7 @@ class ServingEngine:
                 self._min_mask, jnp.int32(slot), jnp.asarray(mask_np))
         self.active[slot] = True
         self._knob_cache = None
+        self._fused_cache = None  # restored stops/ignore_eos rows
         if self._inflight_scan is not None:
             self._inflight_scan.skip.add(slot)
         return slot
@@ -2390,6 +2470,12 @@ class ServingEngine:
         self.freqs[slot] = st.frequency_penalty
         self.reps[slot] = st.repetition_penalty
         self.adapters[slot] = st.aid
+        if (self._stops[slot] != st.stops
+                or self._ignore_eos[slot] != bool(st.ignore_eos)):
+            # the fused boundary mirrors key on stops/ignore_eos, which
+            # a knob-identical admission can still change — they get
+            # their own staleness check, independent of knobs_same
+            self._fused_cache = None
         self._stops[slot] = st.stops
         self._ignore_eos[slot] = bool(st.ignore_eos)
         if st.logit_bias:
@@ -3232,10 +3318,18 @@ class ServingEngine:
             # unused placeholder (the static flag gates its use); a
             # tiny fixed shape keeps the jit cache key stable
             gtable = jnp.zeros((1, 1), jnp.int32)
-        ys, self.cache, self._counts, self._seen = _scan_decode(
+        fused = self.fused_decode
+        if fused:
+            stop_mat, eos_vec = self._build_fused_vectors()
+            budget = jnp.int32(
+                self.max_new_tokens if self.max_new_tokens is not None
+                else _NO_BUDGET)
+        else:
+            stop_mat = eos_vec = budget = None
+        ys, self.cache, self._counts, self._seen, fin, frs = _scan_decode(
             self._pmodel if self._paged else self.model,
             n_steps, sampled, lp_k, pen, rep, seeded,
-            biased, minned, grammared, self.params, self.cache,
+            biased, minned, grammared, fused, self.params, self.cache,
             jnp.asarray(self.last_token), jnp.asarray(self.lens, jnp.int32),
             temps_d, topks_d,
             topps_d, minps_d,
@@ -3250,11 +3344,40 @@ class ServingEngine:
             jnp.asarray(self._slot_draws, jnp.int32), aids,
             self._rng, jnp.int32(self._draws),
             self._bt() if self._paged else None,
+            stop_mat=stop_mat, eos_vec=eos_vec, budget=budget,
         )
         handle = _ScanHandle(ys, n_steps, sampled, lp_k, grammared,
-                             list(self.active))
+                             list(self.active), fused=fused,
+                             fin=fin, frs=frs)
         self._inflight_scan = handle
         return handle
+
+    def _build_fused_vectors(self):
+        """Device mirrors for the fused boundary detector: a padded
+        per-slot stop-id matrix [S, K] (-1 padding never matches a
+        real token) and the effective eos vector [S] (-1 where eos is
+        None or the slot opted out via ignore_eos).  K is the max stop
+        set size rounded up to a multiple of ``_STOP_PAD`` so stop-set
+        churn re-specializes the jit at coarse width steps, not per
+        admission.  Cached like ``_knob_cache`` but with its own
+        invalidation: a knob-identical admission can still change
+        stops / ignore_eos (see _finish_admit_dispatch)."""
+        if self._fused_cache is None:
+            widest = max(
+                (len(self._stops[s]) for s in range(self.n_slots)),
+                default=0)
+            K = max(_STOP_PAD, -(-widest // _STOP_PAD) * _STOP_PAD)
+            mat = np.full((self.n_slots, K), -1, np.int32)
+            for s in range(self.n_slots):
+                for j, t in enumerate(sorted(self._stops[s])):
+                    mat[s, j] = t
+            eos = -1 if self.eos_id is None else int(self.eos_id)
+            eos_vec = np.asarray(
+                [-1 if self._ignore_eos[s] else eos
+                 for s in range(self.n_slots)], np.int32)
+            self._fused_cache = (jnp.asarray(mat),
+                                 jnp.asarray(eos_vec))
+        return self._fused_cache
 
     def scan_abandon(self, handle: _ScanHandle) -> None:
         """Drop a dispatched-but-unharvested window WITHOUT its host
@@ -3296,6 +3419,11 @@ class ServingEngine:
         out: Dict[int, List[int]] = {
             s: [] for s in range(self.n_slots) if live[s]
         }
+        if handle.fused:
+            return self._harvest_fused(
+                handle, live, toks,
+                clps if lp_k else None, tlps if lp_k else None,
+                tids if lp_k else None, out)
         if not sampled and not lp_k and not grammared:
             # greedy/unconstrained harvest fast path (the serving hot
             # path): nothing sampled means no draw accounting, no
@@ -3339,30 +3467,29 @@ class ServingEngine:
                 if fin is not None:
                     self._finish(s, fin[1])
             return out
-        if skip:
-            # mid-window admissions' knobs must not leak into the
-            # window's draw accounting: mask them out of the liveness
-            # checks (their vectors were armed AFTER the dispatch)
-            m = np.ones(self.n_slots, bool)
-            m[list(skip)] = False
-        else:
-            m = None
+        # mirror step()'s draw accounting: a draw is consumed only
+        # while some sampled slot is still live (retirement resets
+        # its knobs, re-arming the greedy fast path), so the key
+        # stream a later admission sees is identical whichever
+        # scheduling API ran this window — the scan's keys for
+        # post-retirement steps produced only discarded tokens.  The
+        # liveness check is an ARMED SET snapshotted once at harvest
+        # entry, not a per-step full-vector recompute: between harvest
+        # steps the only knob mutator is _finish -> _reset_slot_params,
+        # so the set can only shrink, and exactly when a slot finishes.
+        # Mid-window admissions' knobs must not leak into the window's
+        # draw accounting (their vectors were armed AFTER the
+        # dispatch), so skip slots never enter the set.
+        armed: set = set()
+        if sampled:
+            lv = _knobs_live_vec(self.temps, self.topks, self.topps,
+                                 self.minps, self.pres, self.freqs,
+                                 self.reps)
+            armed = {s for s in range(self.n_slots)
+                     if lv[s] and s not in skip}
         draws_used = 0
         for i in range(n_steps):
-            # mirror step()'s draw accounting: a draw is consumed only
-            # while some sampled slot is still live (retirement resets
-            # its knobs, re-arming the greedy fast path), so the key
-            # stream a later admission sees is identical whichever
-            # scheduling API ran this window — the scan's keys for
-            # post-retirement steps produced only discarded tokens
-            if sampled and (
-                    _knobs_live(self.temps, self.topks, self.topps,
-                                self.minps, self.pres, self.freqs,
-                                self.reps) if m is None else
-                    _knobs_live(self.temps[m], self.topks[m],
-                                self.topps[m], self.minps[m],
-                                self.pres[m], self.freqs[m],
-                                self.reps[m])):
+            if sampled and armed:
                 draws_used += 1
             if lp_k:
                 self._harvest_logprobs(
@@ -3386,6 +3513,8 @@ class ServingEngine:
                 self._tokens += 1
                 out[s].append(tok)
                 self._maybe_finish(s, tok)
+                if not self.active[s]:
+                    armed.discard(s)
         self._draws += draws_used
         # per-slot chains advance in lockstep with the global counter
         # (step() does the same once per sampled call); mid-window
@@ -3395,6 +3524,101 @@ class ServingEngine:
             for s, d in enumerate(self._slot_draws)]
         # lens advanced n_steps per slot in-device; the loop above
         # advanced the host mirror the same amount
+        return out
+
+    def _harvest_fused(self, handle: _ScanHandle, live, toks,
+                       clps, tlps, tids,
+                       out: Dict[int, List[int]]) -> Dict[int, List[int]]:
+        """Columnar harvest for a fused window: the device already
+        found each slot's first eos/stop/budget boundary (the scan's
+        fin/frs carry), so the host slices kept prefixes instead of
+        re-scanning columns token by token.  Every bookkeeping effect
+        — outputs, lens, grammar-state mirror, logprob records, draw
+        accounting, finish order — reproduces what the unfused path
+        (greedy fast path or general loop) would have done for the
+        same window, which is what the fused toggle matrix pins."""
+        n_steps, skip = handle.n_steps, handle.skip
+        sampled, lp_k = handle.sampled, handle.lp_k
+        grammared = handle.grammared
+        fin = np.asarray(handle.fin, np.int32)  # [S] first boundary
+        frs = np.asarray(handle.frs, np.int32)  # [S] reason code
+        self._fused_windows += 1
+        # lens advance by the full window for every non-skip slot (the
+        # device columns DID run n_steps; truncation is output-side,
+        # exactly like the unfused paths)
+        for s in range(self.n_slots):
+            if s not in skip:
+                self.lens[s] += n_steps
+        live_idx = [s for s in range(self.n_slots) if live[s]]
+        # kept-prefix length per live column (fin == -1: no boundary)
+        keep = {s: (int(fin[s]) + 1 if fin[s] >= 0 else n_steps)
+                for s in live_idx}
+        self._fused_truncated += sum(
+            n_steps - keep[s] for s in live_idx)
+        # draw accounting BEFORE any finish resets knobs: the unfused
+        # loop consumes one draw per step while any armed (knob-live,
+        # non-skip) slot is still live, and an armed slot leaves the
+        # set right after its finish step — so the step count is the
+        # max kept-prefix length over the armed set
+        draws_used = 0
+        if sampled:
+            lv = _knobs_live_vec(self.temps, self.topks, self.topps,
+                                 self.minps, self.pres, self.freqs,
+                                 self.reps)
+            draws_used = max(
+                (keep[s] for s in live_idx
+                 if lv[s] and s not in skip), default=0)
+        if grammared:
+            # batched DFA walk: one fancy-indexed gather per step over
+            # the columns still emitting, instead of a Python branch
+            # per (step, slot).  gs can go negative mid-walk (an
+            # in-grammar eos pick), which drops the column like the
+            # per-token ``gstate >= 0`` guard does.
+            gs = self.gstate
+            for i in range(n_steps):
+                cols = np.asarray(
+                    [s for s in live_idx
+                     if keep[s] > i and gs[s] >= 0], np.int64)
+                if cols.size == 0:
+                    break
+                gs[cols] = self._gtable_np[gs[cols], toks[i, cols]]
+        if lp_k:
+            # bulk column materialization (tolist converts the whole
+            # kept prefix at C speed) feeding the same per-token record
+            # shape _record_logprobs appends
+            for s in live_idx:
+                n = self._lp_want[s]
+                if not n:
+                    continue
+                k = keep[s]
+                cl = clps[:k, s].tolist()
+                tl = tlps[:k, s, :n].tolist()
+                ti = tids[:k, s, :n].tolist()
+                self._lp_records[s].extend(
+                    (cl[i], list(zip(ti[i], tl[i])))
+                    for i in range(k))
+        for s in live_idx:
+            kept = toks[:keep[s], s].tolist()
+            self.outputs[s].extend(kept)
+            out[s] = kept
+            self._tokens += len(kept)
+            if kept:
+                self.last_token[s] = kept[-1]
+        # finish order matters: _reset_slot_params stamps the parked-
+        # donor LRU counter, so fused must retire slots in the same
+        # order the unfused path would have — slot order on the greedy
+        # fast path, (finish step, slot) order in the general loop
+        finishing = [s for s in live_idx if fin[s] >= 0]
+        if sampled or lp_k or grammared:
+            finishing.sort(key=lambda s: (int(fin[s]), s))
+        reasons = {1: "eos", 2: "stop", 3: "length"}
+        for s in finishing:
+            self._finish(s, reasons[int(frs[s])])
+        if sampled:
+            self._draws += draws_used
+            self._slot_draws = [
+                d if s in skip else d + draws_used
+                for s, d in enumerate(self._slot_draws)]
         return out
 
     # -- completion --------------------------------------------------------
@@ -3454,6 +3678,8 @@ class ServingEngine:
             "packed_prefill_rows": self._packed_rows,
             "packed_prefill_requests": self._packed_requests,
             "packed_prefill_pad_tokens": self._packed_pad_tokens,
+            "fused_windows": self._fused_windows,
+            "fused_truncated_tokens": self._fused_truncated,
         }
         if self._paged:
             assert self._pool is not None
@@ -3505,6 +3731,7 @@ class ServingEngine:
         self._seed_on[slot] = 0
         self._lp_want[slot] = 0  # records stay readable post-finish
         self._knob_cache = None  # device mirrors are stale now
+        self._fused_cache = None  # stop/eos rows changed with them
         # parked-donor LRU stamp: under pool pressure the OLDEST
         # parked record's pages are reclaimed first
         self._park_counter += 1
