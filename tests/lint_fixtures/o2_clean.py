@@ -1,0 +1,29 @@
+"""O2 clean twin: every literal alert-rule expression references a
+family the same project's Registry defines (including a histogram's
+derived _bucket series), plus a justified pragma on an intentionally
+external family."""
+
+from tpu_k8s_device_plugin import obs
+
+
+def build(reg: obs.Registry):
+    depth = reg.gauge("tpu_fixture_queue_depth", "bounded gauge")
+    errors = reg.counter("tpu_fixture_errors_total", "error counter")
+    latency = reg.histogram("tpu_fixture_wait_seconds", "wait time",
+                            buckets=obs.FAST_BUCKETS_S)
+    rules = [
+        obs.threshold_rule(
+            "queue_deep", "tpu_fixture_queue_depth", ">", 100.0),
+        obs.threshold_rule(
+            "errors_hot", "rate(tpu_fixture_errors_total[5m])",
+            ">", 0.5, severity="page"),
+        obs.threshold_rule(
+            "slow_waits",
+            "histogram_quantile(0.99, tpu_fixture_wait_seconds[5m])",
+            ">", 1.0),
+        obs.threshold_rule(
+            "peer_down",
+            # tpulint: disable=O2 -- a peer process defines tpu_peer_up
+            "tpu_peer_up", "<", 1.0),
+    ]
+    return depth, errors, latency, rules

@@ -1,0 +1,19 @@
+"""O2 seeded violations: alert-rule expressions over a misspelled
+family, a family nothing defines, and an expression outside the tsdb
+grammar — each one an alert that would sit at 'no data' forever."""
+
+from tpu_k8s_device_plugin import obs
+from tpu_k8s_device_plugin.obs.alerts import AlertCondition, AlertRule
+
+
+def build(reg: obs.Registry):
+    reg.gauge("tpu_fixture_queue_depth", "the real family")
+    typo = obs.threshold_rule(
+        "queue_deep", "tpu_fixture_queue_depht", ">", 100.0)
+    phantom = AlertRule(
+        "phantom", (AlertCondition(
+            "rate(tpu_fixture_never_defined_total[5m])", ">", 0.5),),
+        severity="page")
+    malformed = AlertCondition(expr="not a selector (", op=">",
+                               threshold=1.0)
+    return typo, phantom, malformed

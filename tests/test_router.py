@@ -842,7 +842,8 @@ def test_statz_lockstep_with_metrics(engine_stack):
     assert set(statz) == {
         "scheduler_alive", "queue_depth", "in_flight", "capacity",
         "kv_pages", "kv_pages_free", "requests_served", "role",
-        "migrations", "shed", "goodput"}
+        "migrations", "shed", "goodput", "alerts"}
+    assert set(statz["alerts"]) == {"firing", "pending", "firing_page"}
     assert set(statz["shed"]) == {"connections", "queue", "quota"}
     assert set(statz["goodput"]) == {"window_s", "classes"}
     assert statz["role"] == "mixed"

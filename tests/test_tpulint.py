@@ -38,9 +38,10 @@ def rules_of(findings):
 
 
 class RuleCatalogTest(unittest.TestCase):
-    def test_all_seven_rules_registered(self):
+    def test_all_eight_rules_registered(self):
         self.assertEqual(
-            sorted(RULES), ["C1", "C2", "C3", "D1", "O1", "R1", "R2"])
+            sorted(RULES),
+            ["C1", "C2", "C3", "D1", "O1", "O2", "R1", "R2"])
 
     def test_every_rule_documents_itself(self):
         for rule in RULES.values():
@@ -59,6 +60,7 @@ class FixtureCorpusTest(unittest.TestCase):
         "R1": ("r1_violation.py", "r1_clean.py"),
         "R2": ("r2_violation.py", "r2_clean.py"),
         "O1": ("o1_violation.py", "o1_clean.py"),
+        "O2": ("o2_violation.py", "o2_clean.py"),
         "D1": ("d1_violation.py", "d1_clean.py"),
     }
 

@@ -1,0 +1,855 @@
+"""In-process TSDB + burn-rate alerting suite (PR 18).
+
+Three pillars hold the retention layer to its contract:
+
+- **Determinism**: under a fake clock, identical sample streams must
+  produce byte-identical ``/debug/query`` JSON — the seeded fuzz runs
+  twin TSDBs over random workloads and diffs the bytes.
+- **Boundedness**: memory never grows with uptime — the fuzz also
+  checks the series cap, the raw ring, and every tier ring stay
+  within their computed budgets after arbitrarily many ticks.
+- **Monotonicity**: counters must stay non-decreasing across the
+  raw -> tier handoff (downsampling keeps the *last* sample per
+  aligned bucket precisely so rate()/increase() never see a phantom
+  reset at a tier boundary).
+
+Plus the burn-rate math suite (hand-computed windows vs rule
+thresholds) and the alert state machine
+(inactive -> pending -> firing -> resolved, ``for:`` dwell, journal
+evidence), and the obs_query watch renderer against a real server.
+"""
+
+import json
+import math
+import random
+import threading
+
+import pytest
+
+from tools import obs_query
+from tools.promlint import lint
+from tpu_k8s_device_plugin import obs
+from tpu_k8s_device_plugin.obs import alerts as alerts_mod
+from tpu_k8s_device_plugin.obs import tsdb as tsdb_mod
+from tpu_k8s_device_plugin.obs.tsdb import (
+    RangeExpr,
+    Selector,
+    parse_duration,
+    parse_expr,
+)
+
+pytestmark = pytest.mark.filterwarnings("ignore")
+
+T0 = 1_700_000_000.0  # fixed epoch base: every fake clock starts here
+
+
+class FakeClock:
+    def __init__(self, t=T0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+# -- grammar ----------------------------------------------------------------
+
+def test_parse_duration_units():
+    assert parse_duration("30s") == 30.0
+    assert parse_duration("5m") == 300.0
+    assert parse_duration("1h") == 3600.0
+    assert parse_duration("250ms") == 0.25
+    assert parse_duration("2d") == 172800.0
+    assert parse_duration("45") == 45.0  # bare seconds
+    for bad in ("", "5x", "m5", "-3s"):
+        with pytest.raises(ValueError):
+            parse_duration(bad)
+
+
+def test_format_duration_round_trips():
+    for s in (0.25, 1.0, 30.0, 90.0, 300.0, 3600.0, 21600.0, 86400.0):
+        assert parse_duration(tsdb_mod.format_duration(s)) == s
+
+
+def test_parse_expr_selector():
+    e = parse_expr("tpu_serve_queue_depth")
+    assert isinstance(e, Selector)
+    assert e.name == "tpu_serve_queue_depth" and e.matchers == ()
+    e = parse_expr('tpu_slo_goodput_ratio{class="interactive"}')
+    assert e.matchers == (("class", "interactive"),)
+    assert e.matches({"class": "interactive", "extra": "x"})
+    assert not e.matches({"class": "batch"})
+
+
+def test_parse_expr_range_functions():
+    e = parse_expr("rate(tpu_serve_errors_total[5m])")
+    assert isinstance(e, RangeExpr)
+    assert e.fn == "rate" and e.window_s == 300.0
+    assert e.selector.name == "tpu_serve_errors_total"
+    e = parse_expr('avg_over_time(x{a="b"}[30s])')
+    assert e.fn == "avg_over_time" and e.window_s == 30.0
+    e = parse_expr("histogram_quantile(0.95, tpu_serve_ttft_seconds[1m])")
+    assert e.fn == "histogram_quantile" and e.quantile == 0.95
+    # round-trippable display form
+    assert parse_expr(str(e)) == e
+
+
+def test_parse_expr_rejects_malformed():
+    for bad in ("", "rate(x)", "rate(x[5m]", "foo(x[5m])",
+                "histogram_quantile(1.5, x[1m])", 'x{a=b}',
+                'x{a="b" c="d"}'):
+        with pytest.raises(ValueError):
+            parse_expr(bad)
+
+
+def test_expr_metric_names():
+    assert obs.expr_metric_names("tpu_serve_queue_depth") == \
+        ["tpu_serve_queue_depth"]
+    assert obs.expr_metric_names(
+        'rate(tpu_serve_errors_total{code="500"}[1m])') == \
+        ["tpu_serve_errors_total"]
+    assert obs.expr_metric_names(
+        "histogram_quantile(0.5, tpu_serve_ttft_seconds[1m])") == \
+        ["tpu_serve_ttft_seconds"]
+    with pytest.raises(ValueError):
+        obs.expr_metric_names("not a selector")
+
+
+# -- storage ----------------------------------------------------------------
+
+def _tsdb(reg, clock, **kw):
+    kw.setdefault("self_metrics", False)
+    return obs.TSDB(reg, now_fn=clock, **kw)
+
+
+def test_raw_window_prunes_by_time():
+    reg = obs.Registry()
+    g = reg.gauge("g", "h")
+    clock = FakeClock()
+    db = _tsdb(reg, clock, raw_window_s=10.0, tiers=())
+    for i in range(30):
+        g.set(float(i))
+        db.tick(clock.advance(1.0))
+    pts = db.points(Selector("g"), 0, clock.t)[0][1]
+    assert len(pts) <= 11  # 10s window at 1s ticks
+    assert pts[0][0] >= clock.t - 10.0
+
+
+def test_raw_ring_prunes_by_count():
+    reg = obs.Registry()
+    g = reg.gauge("g", "h")
+    clock = FakeClock()
+    db = _tsdb(reg, clock, raw_window_s=1e6, raw_points=8, tiers=())
+    for i in range(100):
+        g.set(float(i))
+        db.tick(clock.advance(1.0))
+    pts = db.points(Selector("g"), 0, clock.t)[0][1]
+    assert len(pts) == 8
+    assert pts[-1][1] == 99.0
+
+
+def test_same_instant_retick_latest_wins():
+    reg = obs.Registry()
+    g = reg.gauge("g", "h")
+    clock = FakeClock()
+    db = _tsdb(reg, clock)
+    g.set(1.0)
+    db.tick(clock.t)
+    g.set(2.0)
+    db.tick(clock.t)  # same fake instant: overwrite, not append
+    pts = db.points(Selector("g"), 0, clock.t)[0][1]
+    assert pts == [(clock.t, 2.0)]
+
+
+def test_clock_backwards_clamps():
+    reg = obs.Registry()
+    g = reg.gauge("g", "h")
+    clock = FakeClock()
+    db = _tsdb(reg, clock)
+    g.set(1.0)
+    db.tick(T0 + 100.0)
+    g.set(2.0)
+    db.tick(T0 + 50.0)  # clock jumped back: clamp to last tick
+    pts = db.points(Selector("g"), 0, T0 + 200.0)[0][1]
+    assert [t for t, _ in pts] == [T0 + 100.0]
+    assert pts[-1][1] == 2.0
+
+
+def test_series_cap_drops_and_counts():
+    reg = obs.Registry()
+    g = reg.gauge("g", "h", ("i",))
+    clock = FakeClock()
+    db = obs.TSDB(reg, now_fn=clock, max_series=4, self_metrics=True)
+    for i in range(10):
+        g.labels(i=str(i)).set(float(i))
+    db.tick(clock.advance(1.0))
+    # 4 slots: the tsdb self-metrics are part of the same registry but
+    # self-gauges are set AFTER the sample pass, so the first tick's
+    # slots go to whatever parsed first; the cap itself must hold
+    assert db.series_count() == 4
+    body = reg.render()
+    samples = dict(((n, tuple(sorted(ls.items()))), v)
+                   for n, ls, v in obs.parse_exposition(body))
+    assert samples[("tpu_tsdb_dropped_samples_total", ())] > 0
+
+
+def test_counter_monotone_across_tier_boundary():
+    """The raw window is short; the tiers keep the tail.  A counter
+    sampled across the raw -> tier handoff must stay non-decreasing
+    in the merged read — the property rate()/increase() depend on."""
+    reg = obs.Registry()
+    c = reg.counter("c_total", "h")
+    clock = FakeClock()
+    db = _tsdb(reg, clock, raw_window_s=20.0,
+               tiers=((10.0, 120.0), (30.0, 600.0)))
+    for _ in range(300):
+        c.inc(2.0)
+        db.tick(clock.advance(1.0))
+    pts = db.points(Selector("c_total"), 0, clock.t)[0][1]
+    assert len(pts) >= 10
+    values = [v for _, v in pts]
+    assert values == sorted(values)
+    # tail of the merged view is raw-resolution, head is tiered
+    times = [t for t, _ in pts]
+    assert times == sorted(times)
+    assert times[0] < clock.t - 20.0  # tiers extended past raw window
+
+
+def test_tier_keeps_last_sample_per_bucket():
+    reg = obs.Registry()
+    g = reg.gauge("g", "h")
+    clock = FakeClock()
+    db = _tsdb(reg, clock, raw_window_s=5.0, tiers=((10.0, 100.0),))
+    for i in range(40):
+        g.set(float(i))
+        db.tick(clock.advance(1.0))
+    pts = db.points(Selector("g"), 0, clock.t - 5.0)[0][1]
+    # tier region only: one point per 10s bucket, each the bucket's
+    # last sample (value == index of that tick)
+    buckets = [math.floor(t / 10.0) for t, _ in pts]
+    assert len(buckets) == len(set(buckets))
+    for t, v in pts:
+        assert v == t - T0 - 1.0  # last tick within the bucket
+
+
+# -- evaluation -------------------------------------------------------------
+
+def test_instant_selector_staleness():
+    reg = obs.Registry()
+    g = reg.gauge("g", "h")
+    clock = FakeClock()
+    db = _tsdb(reg, clock, lookback_s=30.0)
+    g.set(7.0)
+    db.tick(clock.t)
+    assert db.evaluate("g", at=clock.t + 29.0) == [({}, 7.0)]
+    assert db.evaluate("g", at=clock.t + 31.0) == []  # stale
+
+
+def test_rate_and_increase_reset_aware():
+    reg = obs.Registry()
+    clock = FakeClock()
+    db = _tsdb(reg, clock)
+    # hand-fed stream with a counter reset in the middle
+    stream = [(0.0, 0.0), (10.0, 40.0), (20.0, 80.0),
+              (30.0, 5.0),  # reset
+              (40.0, 25.0)]
+    g = reg.gauge("c_total", "h")
+    for dt, v in stream:
+        g.set(v)
+        db.tick(T0 + dt)
+    # increase = positive deltas only: 40 + 40 + 20 = 100
+    (_, inc), = db.evaluate("increase(c_total[40s])", at=T0 + 40.0)
+    assert inc == 100.0
+    (_, r), = db.evaluate("rate(c_total[40s])", at=T0 + 40.0)
+    assert r == pytest.approx(100.0 / 40.0)
+
+
+def test_avg_min_max_over_time():
+    reg = obs.Registry()
+    g = reg.gauge("g", "h")
+    clock = FakeClock()
+    db = _tsdb(reg, clock)
+    for i, v in enumerate([4.0, 8.0, 6.0]):
+        g.set(v)
+        db.tick(T0 + i * 10.0)
+    at = T0 + 20.0
+    (_, avg), = db.evaluate("avg_over_time(g[30s])", at=at)
+    assert avg == pytest.approx(6.0)
+    (_, lo), = db.evaluate("min_over_time(g[30s])", at=at)
+    assert lo == 4.0
+    (_, hi), = db.evaluate("max_over_time(g[30s])", at=at)
+    assert hi == 8.0
+
+
+def test_histogram_quantile_over_window():
+    reg = obs.Registry()
+    h = reg.histogram("lat_seconds", "h", buckets=(0.1, 1.0, 10.0))
+    clock = FakeClock()
+    db = _tsdb(reg, clock)
+    for v in [0.05] * 50:
+        h.observe(v)
+    db.tick(T0)  # baseline: 50 fast samples already counted
+    for v in [0.5] * 50:
+        h.observe(v)
+    db.tick(T0 + 10.0)
+    # the quantile is over the window's *increase* (the 50 slow
+    # samples), not lifetime counts: all 50 land in (0.1, 1.0], so
+    # p50 interpolates to the bucket midpoint 0.1 + 0.5*(1.0-0.1)
+    (_, p50), = db.evaluate(
+        "histogram_quantile(0.5, lat_seconds[30s])", at=T0 + 10.0)
+    assert p50 == pytest.approx(0.55)
+    (_, p99), = db.evaluate(
+        "histogram_quantile(0.99, lat_seconds[30s])", at=T0 + 10.0)
+    assert p99 == pytest.approx(0.1 + 0.99 * 0.9)
+    # windows with zero increase yield no output, not NaN
+    assert db.evaluate(
+        "histogram_quantile(0.5, lat_seconds[30s])", at=T0 + 500.0) == []
+
+
+def test_label_matcher_filters_series():
+    reg = obs.Registry()
+    g = reg.gauge("g", "h", ("cls",))
+    clock = FakeClock()
+    db = _tsdb(reg, clock)
+    g.labels(cls="a").set(1.0)
+    g.labels(cls="b").set(2.0)
+    db.tick(clock.t)
+    assert db.evaluate('g{cls="b"}', at=clock.t) == [({"cls": "b"}, 2.0)]
+    both = db.evaluate("g", at=clock.t)
+    assert sorted(v for _, v in both) == [1.0, 2.0]
+
+
+# -- HTTP query handler -----------------------------------------------------
+
+def test_handle_query_selector_and_range_fn():
+    reg = obs.Registry()
+    g = reg.gauge("g", "h")
+    clock = FakeClock()
+    db = _tsdb(reg, clock)
+    for i in range(5):
+        g.set(float(i))
+        db.tick(clock.advance(10.0))
+    out = db.handle_query({"expr": "g", "range": "60s",
+                           "at": str(clock.t)})
+    assert out["range_s"] == 60.0
+    (s,) = out["series"]
+    assert s["name"] == "g" and len(s["points"]) == 5
+    out = db.handle_query({"expr": "avg_over_time(g[30s])",
+                           "range": "30s", "step": "10s",
+                           "at": str(clock.t)})
+    (s,) = out["series"]
+    assert s["name"] == "avg_over_time(g[30s])"
+    assert len(s["points"]) == 4  # inclusive step grid
+
+
+def test_handle_query_rejects_malformed():
+    db = _tsdb(obs.Registry(), FakeClock())
+    for params in ({}, {"expr": ""}, {"expr": "bad expr("},
+                   {"expr": "g", "range": "0"},
+                   {"expr": "g", "range": "-5s"},
+                   {"expr": "g", "range": "60s", "step": "nope"}):
+        with pytest.raises(ValueError):
+            db.handle_query(params)
+
+
+# -- determinism + boundedness (seeded fuzz) --------------------------------
+
+def _fuzz_workload(seed, db, reg_handles, clock, n_ticks):
+    """One deterministic random workload: same seed -> same stream."""
+    rng = random.Random(seed)
+    g, c, h = reg_handles
+    for _ in range(n_ticks):
+        for cls in ("a", "b", "c"):
+            if rng.random() < 0.8:
+                g.labels(cls=cls).set(rng.uniform(0, 100))
+        c.inc(rng.uniform(0, 5))
+        if rng.random() < 0.5:
+            h.observe(rng.uniform(0, 2))
+        db.tick(clock.advance(rng.choice([0.5, 1.0, 2.0, 5.0])))
+
+
+def _make_fuzz_db(seed):
+    reg = obs.Registry()
+    handles = (
+        reg.gauge("fz_gauge", "h", ("cls",)),
+        reg.counter("fz_total", "h"),
+        reg.histogram("fz_seconds", "h", buckets=(0.1, 0.5, 1.0)),
+    )
+    clock = FakeClock()
+    db = _tsdb(reg, clock, raw_window_s=30.0, raw_points=64,
+               tiers=((15.0, 120.0), (60.0, 600.0)), max_series=64)
+    _fuzz_workload(seed, db, handles, clock, n_ticks=400)
+    return db, clock
+
+
+FUZZ_QUERIES = (
+    "fz_total",
+    'fz_gauge{cls="b"}',
+    "rate(fz_total[2m])",
+    "increase(fz_total[10m])",
+    "avg_over_time(fz_gauge[1m])",
+    "max_over_time(fz_gauge[5m])",
+    "histogram_quantile(0.9, fz_seconds[5m])",
+)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 7, 42])
+def test_fuzz_byte_identical_queries(seed):
+    """Twin TSDBs fed the identical seeded stream answer every query
+    byte-identically — retention and evaluation are deterministic."""
+    db1, clock1 = _make_fuzz_db(seed)
+    db2, clock2 = _make_fuzz_db(seed)
+    assert clock1.t == clock2.t
+    for q in FUZZ_QUERIES:
+        params = {"expr": q, "range": "10m", "at": str(clock1.t)}
+        assert db1.handle_query_json(params) == \
+            db2.handle_query_json(params), q
+
+
+@pytest.mark.parametrize("seed", [0, 3, 99])
+def test_fuzz_bounded_memory(seed):
+    """After arbitrarily many ticks every ring stays within its
+    computed budget: series cap, raw ring, per-tier ring."""
+    db, clock = _make_fuzz_db(seed)
+    assert db.series_count() <= 64
+    # per-series bound: raw_points + sum(window/step + 2) per tier
+    per_series = 64 + (120 // 15 + 2) + (600 // 60 + 2)
+    assert db.point_count() <= db.series_count() * per_series
+    # keep running: the budget must not creep
+    for _ in range(100):
+        db.tick(clock.advance(1.0))
+    assert db.point_count() <= db.series_count() * per_series
+    assert db.series_count() <= 64
+
+
+@pytest.mark.parametrize("seed", [0, 5, 11])
+def test_fuzz_counter_monotone_everywhere(seed):
+    """Counters stay non-decreasing through every tier handoff, for
+    any random tick cadence."""
+    db, clock = _make_fuzz_db(seed)
+    for labels, pts in db.points(Selector("fz_total"), 0, clock.t):
+        values = [v for _, v in pts]
+        assert values == sorted(values), labels
+        times = [t for t, _ in pts]
+        assert times == sorted(times)
+    # histogram bucket series are counters too
+    for labels, pts in db.points(
+            Selector("fz_seconds_bucket"), 0, clock.t):
+        values = [v for _, v in pts]
+        assert values == sorted(values), labels
+
+
+# -- burn-rate math ---------------------------------------------------------
+
+def test_burn_rate_hand_computed():
+    # 99% objective -> 1% budget; 5% observed miss rate = 5x burn
+    assert obs.burn_rate(100, 5, 0.99) == pytest.approx(5.0)
+    # exactly at budget
+    assert obs.burn_rate(1000, 10, 0.99) == pytest.approx(1.0)
+    # the page threshold: 14.4% misses against a 1% budget
+    assert obs.burn_rate(1000, 144, 0.99) == pytest.approx(14.4)
+    # 99.9% objective: same miss count burns 10x harder
+    assert obs.burn_rate(1000, 144, 0.999) == pytest.approx(144.0)
+    assert obs.burn_rate(0, 0, 0.99) == 0.0
+    for bad in (0.0, 1.0, -1.0, 2.0):
+        with pytest.raises(ValueError):
+            obs.burn_rate(10, 1, bad)
+
+
+def test_burn_rate_rules_derivation():
+    policies = {"interactive": obs.SLOPolicy(
+        name="interactive", ttft_ms=250, objective=0.99)}
+    rules = obs.burn_rate_rules(policies)
+    by_name = {r.name: r for r in rules}
+    page = by_name["slo_burn_page_interactive"]
+    ticket = by_name["slo_burn_ticket_interactive"]
+    assert page.severity == "page" and ticket.severity == "ticket"
+    # multi-window AND: 14.4x over 5m AND 1h
+    assert [c.threshold for c in page.conditions] == [14.4, 14.4]
+    assert page.conditions[0].expr == (
+        'avg_over_time(tpu_slo_error_budget_burn_rate'
+        '{class="interactive"}[5m])')
+    assert page.conditions[1].expr.endswith("[1h])")
+    # ticket: 1x over 6h
+    (tc,) = ticket.conditions
+    assert tc.threshold == 1.0 and tc.expr.endswith("[6h])")
+
+
+def test_burn_rate_rules_window_scale():
+    policies = {"x": obs.SLOPolicy(name="x", deadline_ms=100,
+                                   objective=0.95)}
+    rules = obs.burn_rate_rules(policies, window_scale=0.01)
+    page = next(r for r in rules if r.severity == "page")
+    wins = sorted(parse_expr(c.expr).window_s for c in page.conditions)
+    assert wins == [3.0, 36.0]  # 5m/1h scaled by 0.01
+    with pytest.raises(ValueError):
+        obs.burn_rate_rules(policies, window_scale=0.0)
+
+
+def test_burn_rate_rules_custom_metric():
+    policies = {"x": obs.SLOPolicy(name="x", ttft_ms=10,
+                                   objective=0.9)}
+    (page, _) = obs.burn_rate_rules(
+        policies, metric="tpu_router_fleet_burn_rate")
+    assert "tpu_router_fleet_burn_rate" in page.conditions[0].expr
+
+
+def test_parse_alert_rules_round_trip():
+    doc = {"rules": [
+        {"name": "queue_deep", "expr": "tpu_serve_queue_depth",
+         "op": ">", "threshold": 100, "for_s": 60,
+         "severity": "ticket", "description": "queue too deep"},
+        {"name": "multi", "severity": "page", "conditions": [
+            {"expr": "rate(tpu_serve_errors_total[1m])",
+             "op": ">", "threshold": 0.5},
+            {"expr": "rate(tpu_serve_errors_total[10m])",
+             "op": ">", "threshold": 0.5}]},
+    ]}
+    rules = obs.parse_alert_rules(json.dumps(doc))
+    assert [r.name for r in rules] == ["queue_deep", "multi"]
+    assert rules[0].for_s == 60.0 and rules[0].severity == "ticket"
+    assert len(rules[1].conditions) == 2
+    assert rules[1].severity == "page"
+
+
+def test_parse_alert_rules_rejects_malformed():
+    bads = (
+        "not json",
+        '{"no_rules": []}',
+        '{"rules": [{"expr": "x"}]}',           # missing name
+        '{"rules": [{"name": "a"}]}',           # no expr/conditions
+        '{"rules": [{"name": "a", "expr": "bad("}]}',
+        '{"rules": [{"name": "a", "expr": "x", "op": "!="}]}',
+        '{"rules": [{"name": "a", "expr": "x", "severity": "loud"}]}',
+        '{"rules": [{"name": "a", "expr": "x"},'
+        ' {"name": "a", "expr": "y"}]}',        # duplicate names
+    )
+    for text in bads:
+        with pytest.raises(ValueError):
+            obs.parse_alert_rules(text)
+
+
+# -- alert state machine ----------------------------------------------------
+
+def _alert_rig(rules, *, resolved_hold_s=20.0):
+    reg = obs.Registry()
+    g = reg.gauge("sig", "h")
+    clock = FakeClock()
+    db = obs.TSDB(reg, now_fn=clock, self_metrics=False)
+    rec = obs.FlightRecorder(registry=None)
+    ev = obs.AlertEvaluator(db, rules, recorder=rec,
+                            resolved_hold_s=resolved_hold_s)
+    return reg, g, clock, db, rec, ev
+
+
+def _state_of(ev, name):
+    for a in ev.status()["alerts"]:
+        if a["name"] == name:
+            return a["state"]
+    raise KeyError(name)
+
+
+def test_alert_full_traversal_with_dwell():
+    rule = obs.threshold_rule("hot", "sig", ">", 10.0, for_s=5.0,
+                              severity="page")
+    reg, g, clock, db, rec, ev = _alert_rig([rule])
+    g.set(1.0)
+    db.tick(clock.advance(1.0))
+    assert _state_of(ev, "hot") == "inactive"
+    # breach: pending, then dwell for_s before firing
+    g.set(50.0)
+    db.tick(clock.advance(1.0))
+    assert _state_of(ev, "hot") == "pending"
+    db.tick(clock.advance(2.0))
+    assert _state_of(ev, "hot") == "pending"  # dwell not met
+    db.tick(clock.advance(4.0))
+    assert _state_of(ev, "hot") == "firing"
+    assert ev.firing() == ["hot"] and ev.firing("page") == ["hot"]
+    assert ev.firing("ticket") == []
+    # recovery: resolved, held visible, then inactive
+    g.set(1.0)
+    db.tick(clock.advance(1.0))
+    assert _state_of(ev, "hot") == "resolved"
+    db.tick(clock.advance(5.0))
+    assert _state_of(ev, "hot") == "resolved"  # inside the hold
+    db.tick(clock.advance(30.0))
+    assert _state_of(ev, "hot") == "inactive"
+    # journal: every transition recorded, in order, with severity
+    evs = rec.events(name=obs.ALERT_TRANSITION_EVENT)
+    path = [(e["attrs"]["state_from"], e["attrs"]["state_to"])
+            for e in evs]
+    assert path == [("inactive", "pending"), ("pending", "firing"),
+                    ("firing", "resolved"), ("resolved", "inactive")]
+    assert all(e["attrs"]["severity"] == "page" for e in evs)
+    # exported families reflect the machine
+    body = reg.render()
+    by = {(n, tuple(sorted(ls.items()))): v
+          for n, ls, v in obs.parse_exposition(body)}
+    key = (("alert", "hot"), ("severity", "page"))
+    assert by[("tpu_alert_state", key)] == 0.0
+    assert by[("tpu_alert_transitions_total", key)] == 4.0
+    assert by[("tpu_alert_evaluations_total", ())] >= 7.0
+
+
+def test_alert_for_zero_fires_within_one_tick():
+    rule = obs.threshold_rule("fast", "sig", ">", 0.5)
+    _, g, clock, db, rec, ev = _alert_rig([rule])
+    g.set(1.0)
+    db.tick(clock.advance(1.0))
+    assert _state_of(ev, "fast") == "firing"  # pending+firing same tick
+    evs = rec.events(name=obs.ALERT_TRANSITION_EVENT)
+    assert [e["attrs"]["state_to"] for e in evs] == \
+        ["pending", "firing"]
+
+
+def test_alert_pending_cancels_without_firing():
+    rule = obs.threshold_rule("flap", "sig", ">", 10.0, for_s=30.0)
+    _, g, clock, db, rec, ev = _alert_rig([rule])
+    g.set(50.0)
+    db.tick(clock.advance(1.0))
+    assert _state_of(ev, "flap") == "pending"
+    g.set(1.0)
+    db.tick(clock.advance(1.0))
+    assert _state_of(ev, "flap") == "inactive"
+    evs = rec.events(name=obs.ALERT_TRANSITION_EVENT)
+    assert [e["attrs"]["state_to"] for e in evs] == \
+        ["pending", "inactive"]
+    assert ev.firing() == []
+
+
+def test_alert_multi_window_and_semantics():
+    """The page pair is an AND: a short spike trips the 5m window but
+    not the 1h window, so no page — the SRE anti-flap property."""
+    policies = {"c": obs.SLOPolicy(name="c", ttft_ms=10,
+                                   objective=0.99)}
+    rules = obs.burn_rate_rules(policies, metric="sig_burn",
+                                label="cls", window_scale=0.01)
+    reg = obs.Registry()
+    g = reg.gauge("sig_burn", "h", ("cls",))
+    clock = FakeClock()
+    db = obs.TSDB(reg, now_fn=clock, self_metrics=False)
+    ev = obs.AlertEvaluator(db, rules)
+    # long calm period fills the 36s long window with burn 0
+    g.labels(cls="c").set(0.0)
+    for _ in range(40):
+        db.tick(clock.advance(1.0))
+    # short spike: 3s of high burn trips the 3s window only
+    g.labels(cls="c").set(100.0)
+    for _ in range(3):
+        db.tick(clock.advance(1.0))
+    assert ev.firing("page") == []  # long window still healthy
+    # sustained: the long window catches up -> page
+    for _ in range(40):
+        db.tick(clock.advance(1.0))
+    assert ev.firing("page") == ["slo_burn_page_c"]
+
+
+def test_alert_brief_shape():
+    rules = [obs.threshold_rule("p", "sig", ">", 0.0, severity="page"),
+             obs.threshold_rule("t", "sig", ">", 0.0,
+                                severity="ticket"),
+             obs.threshold_rule("later", "sig", ">", 0.0,
+                                for_s=100.0)]
+    _, g, clock, db, _, ev = _alert_rig(rules)
+    g.set(1.0)
+    db.tick(clock.advance(1.0))
+    brief = ev.brief()
+    assert {f["name"] for f in brief["firing"]} == {"p", "t"}
+    assert brief["pending"] == 1
+    assert brief["firing_page"] == 1
+    # status_json is valid, sorted JSON
+    doc = json.loads(ev.status_json())
+    assert set(doc["firing"]) == {"p", "t"}
+
+
+def test_evaluator_rejects_duplicate_rules():
+    db = _tsdb(obs.Registry(), FakeClock())
+    r = obs.threshold_rule("dup", "sig", ">", 0.0)
+    with pytest.raises(ValueError):
+        obs.AlertEvaluator(db, [r, r])
+
+
+def test_alert_condition_ops():
+    c = alerts_mod.AlertCondition("sig", ">=", 5.0)
+    assert c.holds(5.0) and not c.holds(4.9)
+    c = alerts_mod.AlertCondition("sig", "<", 1.0)
+    assert c.holds(0.5) and not c.holds(1.0)
+    with pytest.raises(ValueError):
+        alerts_mod.AlertCondition("sig", "!=", 1.0)
+    with pytest.raises(ValueError):
+        alerts_mod.AlertCondition("bad expr (", ">", 1.0)
+
+
+# -- scrape self-metrics (satellite 1) --------------------------------------
+
+def test_scrape_meta_present_from_first_scrape_both_modes():
+    reg = obs.Registry()
+    reg.counter("app_things_total", "h").inc()
+    meta = obs.ScrapeMeta(reg)
+    text = meta.render(openmetrics=False)
+    om = meta.render(openmetrics=True)
+    for body in (text, om):
+        assert 'tpu_scrape_duration_seconds_bucket' in body
+        assert 'tpu_scrape_series{' in body
+        assert 'tpu_scrape_size_bytes{' in body
+        # both mode children visible regardless of which mode scraped
+        assert 'mode="text"' in body and 'mode="openmetrics"' in body
+        assert not lint(body), f"scrape meta fails promlint"
+    assert om.rstrip().endswith("# EOF")
+    # the second scrape carries the FIRST scrape's measured numbers
+    body2 = meta.render(openmetrics=False)
+    by = {(n, tuple(sorted(ls.items()))): v
+          for n, ls, v in obs.parse_exposition(body2)}
+    assert by[("tpu_scrape_series", (("mode", "text"),))] > 0
+    assert by[("tpu_scrape_size_bytes", (("mode", "text"),))] > 0
+
+
+def test_tsdb_and_alert_families_lint_clean():
+    reg = obs.Registry()
+    g = reg.gauge("sig", "h")
+    clock = FakeClock()
+    db = obs.TSDB(reg, now_fn=clock, self_metrics=True)
+    obs.AlertEvaluator(db, [obs.threshold_rule(
+        "hot", "sig", ">", 1.0, severity="page")])
+    g.set(5.0)
+    db.tick(clock.advance(1.0))
+    meta = obs.ScrapeMeta(reg)
+    for om in (False, True):
+        body = meta.render(openmetrics=om)
+        assert not lint(body)
+        assert "tpu_alert_state{" in body
+        assert "tpu_tsdb_ticks_total" in body
+
+
+# -- severity threading (satellite 2) ---------------------------------------
+
+def _alert_event(sev, name="tpu_alert_transition", span="s1"):
+    return {"name": name, "t_wall": 10.0, "span_id": span,
+            "trace_id": "t1", "parent_id": "",
+            "attrs": {"severity": sev, "alert": "hot",
+                      "state_from": "pending", "state_to": "firing"}}
+
+
+def test_event_severity_precedence():
+    assert obs.event_severity(_alert_event("page")) == "page"
+    assert obs.event_severity(
+        {"severity": "info", "attrs": {"severity": "page"}}) == "info"
+    assert obs.event_severity({"name": "x"}) == ""
+    assert obs.event_severity({"attrs": {}}) == ""
+
+
+def test_flatten_promotes_severity():
+    tree = obs.stitch([
+        _alert_event("page"),
+        {"name": "plain", "t_wall": 5.0, "span_id": "s1",
+         "trace_id": "t1", "parent_id": "", "attrs": {}},
+    ])
+    flat = obs.flatten(tree)
+    by_name = {e["name"]: e for e in flat}
+    assert by_name["tpu_alert_transition"]["severity"] == "page"
+    assert "severity" not in by_name["plain"]
+
+
+def test_render_tree_tags_severity():
+    out = obs.render_tree(obs.stitch([_alert_event("ticket")]))
+    assert "severity=ticket" in out
+
+
+# -- obs_query watch --------------------------------------------------------
+
+def test_sparkline_rendering():
+    assert obs_query.sparkline([]) == "(no data)"
+    s = obs_query.sparkline([1.0, 2.0, 3.0])
+    assert s.startswith(obs_query.SPARK_BLOCKS[0])
+    assert obs_query.SPARK_BLOCKS[-1] in s
+    assert "min=1 last=3 max=3" in s
+    flat = obs_query.sparkline([5.0, 5.0])
+    assert flat.startswith(obs_query.SPARK_BLOCKS[0] * 2)
+    # NaNs dropped, not rendered
+    assert "nan" not in obs_query.sparkline([float("nan"), 2.0])
+
+
+def test_render_watch_frame_pure():
+    queries = [
+        {"expr": "tpu_slo_goodput_ratio",
+         "series": [{"name": "tpu_slo_goodput_ratio",
+                     "labels": {"class": "interactive"},
+                     "points": [[1.0, 0.9], [2.0, 0.4]]}]},
+        {"expr": "tpu_serving_kv_pages_free", "series": []},
+    ]
+    alerts = {"alerts": [
+        {"name": "slo_burn_page_interactive", "severity": "page",
+         "state": "firing", "value": 90.0, "since": 100.0},
+        {"name": "quiet", "severity": "info", "state": "inactive"},
+        {"name": "slow_ticket", "severity": "ticket",
+         "state": "pending", "value": 2.0, "since": 100.0},
+    ]}
+    out = obs_query.render_watch_frame(queries, alerts)
+    assert "{class=interactive}" in out
+    assert "(no data)" in out
+    # severity-ranked table: page row above ticket row, inactive hidden
+    lines = out.splitlines()
+    page_i = next(i for i, l in enumerate(lines)
+                  if "slo_burn_page_interactive" in l)
+    ticket_i = next(i for i, l in enumerate(lines)
+                    if "slow_ticket" in l)
+    assert page_i < ticket_i
+    assert "quiet" not in out
+    empty = obs_query.render_watch_frame(queries, {"alerts": []})
+    assert "no pending or firing alerts" in empty
+
+
+def test_watch_against_real_server():
+    """Acceptance: obs_query watch renders live sparklines against a
+    real serving surface (the health exporter, cheapest to boot)."""
+    from tpu_k8s_device_plugin.health.metrics import MetricsHTTPServer
+
+    srv = MetricsHTTPServer(port=0, host="127.0.0.1",
+                            sysfs_root="/nonexistent",
+                            dev_root="/nonexistent",
+                            tick_interval_s=0.05).start()
+    try:
+        import time
+        deadline = time.time() + 10.0
+        while srv.tsdb.series_count() == 0 and time.time() < deadline:
+            time.sleep(0.05)
+        frames = []
+        rc = obs_query.watch(
+            f"http://127.0.0.1:{srv.port}",
+            ["tpu_exporter_chips", "rate(tpu_tsdb_ticks_total[30s])"],
+            range_s=60.0, interval_s=0.05, iterations=2,
+            out=frames.append)
+        assert rc == 0
+        text = "\n".join(frames)
+        assert "tpu_exporter_chips" in text
+        assert any(ch in text for ch in obs_query.SPARK_BLOCKS)
+        assert "alert" in text  # alert table rendered
+    finally:
+        srv.stop()
+
+
+def test_watch_cli_flag_wiring(capsys):
+    """--watch requires exactly one endpoint and exits cleanly."""
+    from tpu_k8s_device_plugin.health.metrics import MetricsHTTPServer
+
+    srv = MetricsHTTPServer(port=0, host="127.0.0.1",
+                            sysfs_root="/nonexistent",
+                            dev_root="/nonexistent",
+                            tick_interval_s=0.05).start()
+    try:
+        import time
+        time.sleep(0.3)
+        rc = obs_query.main([
+            "--watch", "--endpoint", f"http://127.0.0.1:{srv.port}",
+            "--watch-expr", "tpu_exporter_scrapes_total",
+            "--interval", "0.05", "--iterations", "1"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "tpu_exporter_scrapes_total" in out
+    finally:
+        srv.stop()
+    with pytest.raises(SystemExit):
+        obs_query.main(["--watch"])  # no endpoint

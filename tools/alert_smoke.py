@@ -1,0 +1,215 @@
+#!/usr/bin/env python3
+"""alert-smoke: the end-to-end acceptance check for burn-rate alerting.
+
+A REAL serving subprocess gets a synthetic goodput collapse (an SLO
+class whose 1ms completion deadline no request can meet), and the
+multi-window multi-burn-rate machinery must prove, from its own
+surfaces:
+
+  1. the page alert reaches ``firing`` — and the journal + retained
+     burn-rate series show it fired within two evaluation ticks of
+     the collapse reaching the burn gauge,
+  2. ``/metrics`` stays promlint-clean in BOTH exposition modes with
+     the ``tpu_alert_*`` and ``tpu_scrape_*`` families present,
+  3. after the collapse stops, the alert traverses to ``resolved``,
+  4. the flight-recorder journal carries the full state traversal
+     (inactive -> pending -> firing -> resolved) as
+     ``tpu_alert_transition`` events.
+
+Windows are shrunk with ``alert_window_scale`` so the canonical
+5m/1h/6h SRE windows run in seconds — the same knob the chaos soak and
+the fleet controller use.  CI runs this in the ``metrics-lint`` job;
+also runnable by hand:
+
+    JAX_PLATFORMS=cpu python tools/alert_smoke.py
+"""
+# tpulint: disable-file=R1 -- smoke DRIVER: single-shot requests against a subprocess it just started; a failure IS the test failing, retries would only blur which layer lost the alert
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.parse
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.promlint import lint                      # noqa: E402
+
+ALERT_INTERVAL_S = 0.5
+WINDOW_SCALE = 0.0005  # 5m/1h/6h -> 0.15s / 1.8s / 10.8s
+PAGE_ALERT = "slo_burn_page_bad"
+
+_SERVER_PROG = """
+import json, sys
+sys.path.insert(0, {repo!r})
+import jax, jax.numpy as jnp
+from tpu_k8s_device_plugin import obs
+from tpu_k8s_device_plugin.workloads.inference import make_decoder
+from tpu_k8s_device_plugin.workloads.server import EngineServer
+from tpu_k8s_device_plugin.workloads.serving import ServingEngine
+
+model = make_decoder(vocab=128, d_model=64, n_heads=4, n_layers=2,
+                     d_ff=128, max_len=64, dtype=jnp.float32)
+tokens = jnp.zeros((1, 8), jnp.int32)
+pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+params = model.init(jax.random.PRNGKey(0), tokens, pos)["params"]
+eng = ServingEngine(model, params, n_slots=2)
+# class 'bad' can never meet its 1ms deadline: every request misses,
+# burn = 1/(1-0.99) = 100x the moment traffic lands on it
+policies = {{
+    "bad": obs.SLOPolicy("bad", deadline_ms=1.0),
+    "good": obs.SLOPolicy("good", deadline_ms=60000.0),
+}}
+srv = EngineServer(eng, max_new_tokens=4, window=2,
+                   slo_policies=policies, slo_window_s=3.0,
+                   alert_interval_s={interval!r},
+                   alert_window_scale={scale!r})
+srv.start(host="127.0.0.1", port=0)
+print(json.dumps({{"port": srv.port}}), flush=True)
+import threading
+threading.Event().wait()
+"""
+
+
+def _get_json(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _get(port, path, headers=None):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 headers=headers or {})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return dict(resp.headers), resp.read().decode()
+
+
+def _alert(status, name):
+    for a in status["alerts"]:
+        if a["name"] == name:
+            return a
+    raise AssertionError(f"{name} missing from /alerts: "
+                         f"{[a['name'] for a in status['alerts']]}")
+
+
+def _wait_for_state(port, name, want, timeout_s):
+    deadline = time.time() + timeout_s
+    state = None
+    while time.time() < deadline:
+        state = _alert(_get_json(port, "/alerts"), name)["state"]
+        if state == want:
+            return
+        time.sleep(0.1)
+    raise AssertionError(
+        f"{name} never reached {want!r} (last state {state!r})")
+
+
+def main() -> int:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         _SERVER_PROG.format(repo=REPO, interval=ALERT_INTERVAL_S,
+                             scale=WINDOW_SCALE)],
+        stdout=subprocess.PIPE, text=True, env=env)
+    try:
+        port = json.loads(proc.stdout.readline())["port"]
+        print(f"server up on :{port}")
+
+        # boot state: every derived rule present, all inactive
+        status = _get_json(port, "/alerts")
+        assert _alert(status, PAGE_ALERT)["state"] == "inactive"
+        assert _alert(status, "slo_burn_ticket_bad")["severity"] \
+            == "ticket"
+
+        # synthetic goodput collapse: every 'bad' request misses its
+        # 1ms deadline, so the class burns at 100x from request one
+        for i in range(4):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=json.dumps({"tokens": [1, 2, 3],
+                                 "slo_class": "bad"}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                assert resp.status == 200
+                resp.read()
+        print("1. collapse traffic sent (4 guaranteed SLO misses)")
+
+        _wait_for_state(port, PAGE_ALERT, "firing", timeout_s=20.0)
+        firing = _alert(_get_json(port, "/alerts"), PAGE_ALERT)
+        assert firing["severity"] == "page"
+        # the roll-up every statz consumer (the fleet planner) reads
+        statz = _get_json(port, "/statz")
+        assert any(f["name"] == PAGE_ALERT
+                   for f in statz["alerts"]["firing"])
+        print(f"2. page alert firing (value {firing['value']:.1f})")
+
+        # two-evaluation-tick bound, proven from the server's OWN
+        # clock domain: the retained burn series says when the breach
+        # first became visible to a tick; the journal says when the
+        # rule fired.  No client clock involved.
+        expr = urllib.parse.quote(
+            'tpu_slo_error_budget_burn_rate{class="bad"}', safe="")
+        q = _get_json(port, f"/debug/query?expr={expr}&range=60s")
+        breach_ts = [t for t, v in q["series"][0]["points"]
+                     if v >= 14.4]
+        assert breach_ts, f"no breach sample retained: {q}"
+        events = _get_json(port, "/debug/events")["events"]
+        journal = [e for e in events
+                   if e["name"] == "tpu_alert_transition"
+                   and e["attrs"].get("alert") == PAGE_ALERT]
+        fired_at = next(e["attrs"]["at"] for e in journal
+                        if e["attrs"]["state_to"] == "firing")
+        lag = fired_at - breach_ts[0]
+        assert lag <= 2 * ALERT_INTERVAL_S + 0.25, (
+            f"firing lagged first visible breach by {lag:.2f}s "
+            f"(> 2 ticks of {ALERT_INTERVAL_S}s)")
+        print(f"3. fired {lag:.2f}s after first retained breach "
+              f"(<= 2 ticks) OK")
+
+        # promlint-clean in both modes, alert + scrape families present
+        _, plain = _get(port, "/metrics")
+        _, om = _get(port, "/metrics", headers={
+            "Accept": "application/openmetrics-text"})
+        for mode, body in (("text", plain), ("openmetrics", om)):
+            errs = lint(body)
+            assert not errs, f"{mode} fails promlint: {errs[:5]}"
+            for fam in ("tpu_alert_state{", "tpu_alert_transitions_total{",
+                        "tpu_alert_evaluations_total",
+                        "tpu_scrape_duration_seconds_bucket",
+                        "tpu_scrape_series{", "tpu_scrape_size_bytes{"):
+                assert fam in body, f"{fam} absent from {mode} scrape"
+        print("4. both exposition modes promlint-clean with "
+              "tpu_alert_*/tpu_scrape_* OK")
+
+        # recovery: the SLO window drains (3s), burn returns to 0, the
+        # page windows (0.15s/1.8s) clear, the alert must resolve
+        _wait_for_state(port, PAGE_ALERT, "resolved", timeout_s=30.0)
+        print("5. page alert resolved after recovery")
+
+        # the journal proves the FULL traversal, in order
+        events = _get_json(port, "/debug/events")["events"]
+        path = [(e["attrs"]["state_from"], e["attrs"]["state_to"])
+                for e in events
+                if e["name"] == "tpu_alert_transition"
+                and e["attrs"].get("alert") == PAGE_ALERT]
+        assert path[:3] == [("inactive", "pending"),
+                            ("pending", "firing"),
+                            ("firing", "resolved")], path
+        assert all(e["attrs"]["severity"] == "page" for e in events
+                   if e["name"] == "tpu_alert_transition"
+                   and e["attrs"].get("alert") == PAGE_ALERT)
+        print(f"6. journal traversal OK ({path})")
+        print("alert-smoke: PASS")
+        return 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1336,6 +1336,89 @@ def episode_fleet_degraded_drain(tmp, seed):
               f"(attainment {info['attainment']})")
 
 
+def episode_fleet_burn_alert(tmp, seed):
+    """Episode 15 (PR 18): burn-rate page alert through a replica
+    SIGKILL.  The fleet episode runs with the kill arm live: mid-burst
+    a replica dies, the survivor drowns, the per-class SLO burn gauges
+    the replicas publish through /statz roll up into the router's
+    ``tpu_router_fleet_burn_rate`` — and the router's multi-window
+    multi-burn-rate evaluator must page.  The reconciler replaces the
+    dead replica (reason=failure, or reason=alert if the pre-chewed
+    page verdict lands first), and once the fleet recovers and the
+    shrunk windows drain, the page alert must traverse to
+    ``resolved``.  Every asserted fact comes from the episode report:
+    the journaled ``tpu_alert_transition`` state machine and the
+    ``tpu_fleet_*`` spawn evidence — never logs."""
+    import argparse
+
+    from tpu_k8s_device_plugin.workloads import fleet
+
+    workdir = os.path.join(tmp, f"fleet-ep15-{seed}")
+    os.makedirs(workdir, exist_ok=True)
+    args = argparse.Namespace(
+        mode="episode", seed=seed, max_replicas=2,
+        # the CI fleet-gate ramp: big enough that pressure reliably
+        # scales the fleet to 2 BEFORE the kill hook's routable-fleet
+        # gate releases the SIGKILL (ep14's short drain ramp never
+        # crosses the watermark, so the kill would time out unarmed)
+        calm_requests=16, peak_requests=72, tail_requests=20,
+        calm_rate=2.0, peak_rate=10.0,
+        high_watermark=1.0, low_watermark=0.25,
+        up_stable_s=0.5, down_stable_s=2.0, cooldown_s=2.0,
+        drain_timeout_s=20.0, kill_at_ms=None, degrade_at_ms=None,
+        no_kill=False, no_degrade=True, capacity_spec="",
+        workdir=workdir, time_scale=1.0, late_ms=100.0,
+        timeout_s=120.0, settle_s=30.0, top_missed=3,
+        report=None, metrics_out=None, assert_goodput=None,
+        assert_fleet=False, fault_spec=None,
+        config="tiny", slots=2, max_len=512, max_new_tokens=128,
+        prefix_chunk=16,
+        # a TTFT budget the fleet meets at calm but cannot meet while
+        # a kill leaves the survivor queueing the whole burst — the
+        # collapse that must drive the burn gauge past 14.4x
+        slo=["interactive=1200", "batch=0:20000"],
+        # canonical 5m/1h/6h burn windows scaled to 0.6s/7.2s/43.2s
+        # and a 0.25s evaluation tick, so the page rule can observe
+        # the distress AND resolve inside the episode's wall clock
+        alert_interval=0.25, alert_window_scale=0.002,
+        # replicas keep only a 3s rolling SLO window: once the burst
+        # drains, their burn gauges fall back to zero fast enough for
+        # the firing -> resolved transition to land before harvest
+        server_extra_args=("--slo-window", "3"),
+        settle_on_alerts=True,
+        compile_cache_dir=os.environ.get(
+            "TPU_DP_COMPILE_CACHE_DIR",
+            os.path.join("tests", ".jax_cache")))
+    report, _ = fleet.run_episode(args)
+
+    f, c = report["fleet"], report["chaos"]
+    check(c["killed_replica"],
+          "chaos arm SIGKILLed a managed replica mid-burst")
+    transitions = f["alert_transitions"]
+    paged = sorted({t["alert"] for t in transitions
+                    if t["severity"] == "page"
+                    and t["to"] == "firing"})
+    check(paged,
+          "a page-severity burn-rate alert reached firing "
+          "(tpu_alert_transition journal)")
+    # the full state machine for one paging rule, in order: the dwell
+    # (inactive->pending), the page (pending->firing), the recovery
+    # (firing->resolved) — all journaled by the router's evaluator
+    path = [(t["from"], t["to"]) for t in transitions
+            if t["alert"] == paged[0]]
+    want = [("inactive", "pending"), ("pending", "firing"),
+            ("firing", "resolved")]
+    it = iter(path)
+    check(all(step in it for step in want),
+          f"{paged[0]} traversed "
+          f"inactive->pending->firing->resolved (got {path})")
+    check(f["replaced_after_kill"] or f["alert_scale_up_events"] >= 1,
+          "reconciler replaced the dead replica (reason=failure) or "
+          "scaled on the page verdict (reason=alert)")
+    check(f["final_replicas"] >= 1,
+          "fleet settled at/above the floor after recovery")
+
+
 def _reshape_slice(tmp, testdata, seed, suffix, grace, hb_timeout):
     """A dedicated 2-host slice with live staleness + reshape grace (the
     main soak coordinator drives heartbeats manually with no timeout, so
@@ -1597,6 +1680,9 @@ def main(argv=None) -> int:
             log.info("=== episode 14: degraded-slice drain under "
                      "load ===")
             episode_fleet_degraded_drain(tmp, args.seed)
+            log.info("=== episode 15: burn-rate page alert through "
+                     "a replica kill ===")
+            episode_fleet_burn_alert(tmp, args.seed)
         # -- final convergence sweep ----------------------------------
         for h in hosts:
             h.pulse()

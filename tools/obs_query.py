@@ -29,6 +29,10 @@ Examples::
     # requests of a workloads.replay report, span trees and all
     python tools/obs_query.py --replay-report replay-report.json --top 3
 
+    # live dashboard: sparklines from the server's in-process TSDB
+    # (GET /debug/query) + the firing-alert table (GET /alerts)
+    python tools/obs_query.py --watch --endpoint http://rep0:8000
+
 Dependency-free (stdlib + the stdlib-only ``obs`` package), like
 every tool in this repo.
 """
@@ -39,7 +43,8 @@ import argparse
 import json
 import os
 import sys
-from typing import Dict, List, Optional
+import time
+from typing import Callable, Dict, List, Optional
 from urllib.parse import quote
 from urllib.request import urlopen
 
@@ -222,6 +227,138 @@ def render_replay_report(path: str, top: int,
     return 0
 
 
+# -- watch mode (PR 18): live TSDB sparklines + firing alerts ---------------
+
+SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+# the serving surface's vital signs; families a surface lacks just
+# render "(no data)", so the same default set works against the
+# router and the exporter too
+WATCH_EXPRS = (
+    "tpu_slo_goodput_ratio",
+    "tpu_slo_error_budget_burn_rate",
+    "tpu_serving_pending_requests",
+    "tpu_serving_kv_pages_free",
+)
+
+
+def sparkline(values: List[float], width: int = 48) -> str:
+    """Unicode block sparkline of the last *width* values, annotated
+    with the min/last/max.  NaNs are dropped; empty -> '(no data)'."""
+    vals = [float(v) for v in values
+            if isinstance(v, (int, float)) and v == v]
+    if not vals:
+        return "(no data)"
+    vals = vals[-width:]
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        bar = SPARK_BLOCKS[0] * len(vals)
+    else:
+        top = len(SPARK_BLOCKS) - 1
+        bar = "".join(
+            SPARK_BLOCKS[int(round((v - lo) / span * top))]
+            for v in vals)
+    return f"{bar}  min={lo:g} last={vals[-1]:g} max={hi:g}"
+
+
+def _series_label(labels: Dict[str, object]) -> str:
+    if not labels:
+        return ""
+    return "{" + ",".join(f"{k}={v}" for k, v
+                          in sorted(labels.items())) + "}"
+
+
+def render_watch_frame(queries: List[Dict[str, object]],
+                       alerts: Optional[dict]) -> str:
+    """One watch frame as text: per-expr sparklines over the
+    /debug/query payloads, then the alert table (every rule NOT
+    inactive, severity first).  Pure — the watch test feeds it
+    captured payloads and pins the rendering."""
+    lines: List[str] = []
+    for q in queries:
+        expr = str(q.get("expr", ""))
+        series = q.get("series")
+        series = series if isinstance(series, list) else []
+        lines.append(expr)
+        if not series:
+            lines.append("  (no data)")
+        for s in series:
+            if not isinstance(s, dict):
+                continue
+            pts = s.get("points")
+            pts = pts if isinstance(pts, list) else []
+            values = [p[1] for p in pts
+                      if isinstance(p, (list, tuple)) and len(p) == 2
+                      and isinstance(p[1], (int, float))]
+            labels = s.get("labels")
+            labels = labels if isinstance(labels, dict) else {}
+            lines.append(f"  {_series_label(labels) or '(all)':24s} "
+                         f"{sparkline(values)}")
+    rows = []
+    if isinstance(alerts, dict):
+        for a in alerts.get("alerts") or []:
+            if isinstance(a, dict) and a.get("state") != "inactive":
+                rows.append(a)
+    lines.append("")
+    if rows:
+        sev_rank = {"page": 0, "ticket": 1, "info": 2}
+        rows.sort(key=lambda a: (
+            sev_rank.get(str(a.get("severity")), 9),
+            str(a.get("name"))))
+        lines.append(f"{'ALERT':32s} {'SEVERITY':8s} {'STATE':8s} "
+                     f"{'VALUE':>10s}  SINCE")
+        now = time.time()
+        for a in rows:
+            since = a.get("since")
+            age = f"{now - float(since):.0f}s ago" \
+                if isinstance(since, (int, float)) and since else "-"
+            value = a.get("value")
+            vtxt = f"{value:.4g}" \
+                if isinstance(value, (int, float)) else "-"
+            lines.append(
+                f"{str(a.get('name', '')):32s} "
+                f"{str(a.get('severity', '')):8s} "
+                f"{str(a.get('state', '')):8s} {vtxt:>10s}  {age}")
+    else:
+        lines.append("no pending or firing alerts")
+    return "\n".join(lines)
+
+
+def watch(endpoint: str, exprs: List[str], range_s: float,
+          interval_s: float, iterations: int,
+          timeout_s: float = 3.0,
+          out: Callable[[str], None] = print) -> int:
+    """Poll one endpoint's /debug/query + /alerts and render frames
+    until *iterations* run out (0 = forever).  Exit 0 once at least
+    one frame rendered real data (a series or an alert payload)."""
+    base = endpoint.rstrip("/")
+    saw_data = False
+    i = 0
+    while True:
+        queries: List[Dict[str, object]] = []
+        for expr in exprs:
+            url = (f"{base}/debug/query?expr={quote(expr, safe='')}"
+                   f"&range={range_s:g}s")
+            payload = _fetch_json(url, timeout_s)
+            if payload is None:
+                payload = {"expr": expr, "series": []}
+            if payload.get("series"):
+                saw_data = True
+            queries.append(payload)
+        alerts = _fetch_json(f"{base}/alerts", timeout_s)
+        if alerts is not None:
+            saw_data = True
+        stamp = time.strftime("%H:%M:%S")
+        out(f"-- {base} @ {stamp} "
+            f"(range {range_s:g}s, every {interval_s:g}s)")
+        out(render_watch_frame(queries, alerts))
+        i += 1
+        if iterations and i >= iterations:
+            return 0 if saw_data else 1
+        time.sleep(interval_s)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="obs-query",
@@ -243,6 +380,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="only events at or before this unix timestamp")
     p.add_argument("--name", default=None,
                    help="only events with this name")
+    p.add_argument("--severity", default=None,
+                   choices=["page", "ticket", "info"],
+                   help="only events carrying this severity tag "
+                        "(alert transitions)")
     p.add_argument("--timeout", type=float, default=3.0,
                    help="per-endpoint fetch timeout (seconds)")
     p.add_argument("--json", action="store_true",
@@ -254,15 +395,41 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--top", type=int, default=5,
                    help="how many SLO-missed requests to render in "
                         "--replay-report mode")
+    p.add_argument("--watch", action="store_true",
+                   help="live mode: poll ONE --endpoint's "
+                        "/debug/query + /alerts and render sparklines "
+                        "plus the firing-alert table")
+    p.add_argument("--watch-expr", action="append", default=None,
+                   metavar="EXPR",
+                   help="expression to sparkline in --watch mode "
+                        "(repeatable; default: goodput, burn rate, "
+                        "queue depth, free KV pages)")
+    p.add_argument("--range", type=float, default=300.0,
+                   help="--watch query window in seconds")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="--watch refresh interval in seconds")
+    p.add_argument("--iterations", type=int, default=0,
+                   help="--watch frames to render before exiting "
+                        "(0 = forever; tests use 1)")
     args = p.parse_args(argv)
     if args.replay_report:
         return render_replay_report(args.replay_report, args.top,
                                     args.json)
+    if args.watch:
+        if len(args.endpoint or []) != 1:
+            p.error("--watch needs exactly one --endpoint")
+        return watch(args.endpoint[0],
+                     list(args.watch_expr or WATCH_EXPRS),
+                     args.range, args.interval, args.iterations,
+                     timeout_s=args.timeout)
     if not args.endpoint and not args.dump:
         p.error("need at least one --endpoint or --dump")
     events = collect(args.trace_id, args.endpoint or [],
                      args.dump or [], args.since, args.until,
                      args.name, args.timeout)
+    if args.severity:
+        events = [e for e in events
+                  if obs.event_severity(e) == args.severity]
     if args.trace_id:
         # source label for the tree: a tagged source (the router's
         # stitcher stamps replica ids) wins; else where we found it
@@ -292,7 +459,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         if isinstance(attrs, dict) and attrs:
             extra = " " + " ".join(
                 f"{k}={v}" for k, v in sorted(attrs.items()))
-        print(f"+{dt:10.4f}s [{src}] {ev.get('name')} "
+        # severity tag up front so alert transitions stand out (and
+        # grep/sort on the second column just works)
+        sev = obs.event_severity(ev)
+        sev_tag = f" <{sev}>" if sev else ""
+        print(f"+{dt:10.4f}s{sev_tag} [{src}] {ev.get('name')} "
               f"trace={str(tid)[:16]}{extra}")
     return 0 if events else 1
 

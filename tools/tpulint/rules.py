@@ -14,6 +14,8 @@ R2 silent-swallow    no ``except Exception`` without a log line, a
                      re-raise, or resilience.suppressed() accounting
 O1 metric-definition metric families are built through a Registry with
                      promlint-compatible names and bounded labels
+O2 alert-rule-expr   literal alert-rule expressions reference metric
+                     families some Registry in the project defines
 D1 unseeded-nondeterminism  no bare ``random.*`` / ``time.time()``
                      inside the declared deterministic paths
 """
@@ -735,6 +737,101 @@ class MetricDefinitionRule(Rule):
                             and isinstance(elt.value, str):
                         out.append((elt.value, elt.lineno))
         return out
+
+
+# -- O2: alert-rule-expr ------------------------------------------------------
+
+
+@register
+class AlertRuleExprRule(Rule):
+    """Every literal alert-rule expression must reference a metric
+    family some Registry in the project defines — an alert over a
+    misspelled family evaluates to "no data" forever and the page it
+    was supposed to send never comes.  Expressions built at runtime
+    (the burn-rate f-strings) validate at load instead; this rule
+    covers the hand-written literals, where a typo survives review."""
+
+    id = "O2"
+    name = "alert-rule-expr"
+    doc = "literal alert-rule exprs reference Registry-defined families"
+
+    _DEFINERS = {"counter": (), "gauge": (),
+                 "histogram": ("_bucket", "_sum", "_count")}
+    # the tsdb grammar, statically: fn(name[w]) | hq(q, name[w]) | name
+    _EXPR_RES = (
+        re.compile(r"^\s*(?:rate|increase|avg_over_time|min_over_time"
+                   r"|max_over_time)\s*\(\s*([a-zA-Z_:][a-zA-Z0-9_:]*)"),
+        re.compile(r"^\s*histogram_quantile\s*\(\s*[0-9.]+\s*,"
+                   r"\s*([a-zA-Z_:][a-zA-Z0-9_:]*)"),
+        re.compile(r"^\s*([a-zA-Z_:][a-zA-Z0-9_:]*)\s*(?:\{|$)"),
+    )
+    _RULE_CTORS = ("AlertCondition", "threshold_rule")
+
+    def finalize(self, project: Project) -> List[Finding]:
+        defined: Set[str] = set()
+        for ctx in project.contexts:
+            for node in ast.walk(ctx.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in self._DEFINERS):
+                    continue
+                if not (node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    continue
+                name = node.args[0].value
+                defined.add(name)
+                for suffix in self._DEFINERS[node.func.attr]:
+                    defined.add(name + suffix)
+        findings: List[Finding] = []
+        for ctx in project.contexts:
+            for call, expr, lineno in self._literal_exprs(ctx):
+                metric = self._referenced(expr)
+                if metric is None:
+                    findings.append(Finding(
+                        self.id, ctx.relpath, lineno,
+                        f"alert expr {expr!r} is not in the tsdb "
+                        "grammar (selector | fn(selector[window]))"))
+                    continue
+                if metric in defined:
+                    continue
+                # histogram_quantile may select the base family
+                if metric + "_bucket" in defined:
+                    continue
+                findings.append(Finding(
+                    self.id, ctx.relpath, lineno,
+                    f"alert expr references {metric!r}, which no "
+                    "Registry in the project defines: the rule would "
+                    "evaluate to 'no data' forever and never fire"))
+        return findings
+
+    def _literal_exprs(self, ctx: FileContext
+                       ) -> Iterator[Tuple[ast.Call, str, int]]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else "")
+            if name not in self._RULE_CTORS:
+                continue
+            expr_pos = 0 if name == "AlertCondition" else 1
+            cand: Optional[ast.AST] = None
+            if len(node.args) > expr_pos:
+                cand = node.args[expr_pos]
+            for kw in node.keywords:
+                if kw.arg == "expr":
+                    cand = kw.value
+            if isinstance(cand, ast.Constant) \
+                    and isinstance(cand.value, str):
+                yield node, cand.value, cand.lineno
+
+    def _referenced(self, expr: str) -> Optional[str]:
+        for pat in self._EXPR_RES:
+            m = pat.match(expr)
+            if m:
+                return m.group(1)
+        return None
 
 
 # -- D1: unseeded-nondeterminism ---------------------------------------------

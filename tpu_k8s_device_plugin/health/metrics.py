@@ -60,20 +60,15 @@ def read_ue_count(sysfs_root: str, pci_address: str) -> Optional[int]:
         return None
 
 
-def render_metrics(sysfs_root: str = "/sys", dev_root: str = "/dev",
+def update_metrics(sysfs_root: str = "/sys", dev_root: str = "/dev",
                    scrapes: int = 0,
-                   registry: Optional[obs.Registry] = None,
-                   openmetrics: bool = False) -> str:
-    """One scrape: probe every chip and render the exposition text
-    through the shared :class:`obs.Registry` renderer.
-
-    *registry* keeps instruments alive across scrapes (the HTTP server
-    passes its own, so the probe-duration histogram accumulates); bare
-    calls get a fresh one — no state leaks between tests.
-
-    Rename (PR 3, promlint): ``tpu_device_uncorrectable_errors`` is now
-    ``tpu_device_uncorrectable_errors_total`` (counters must end in
-    ``_total``)."""
+                   registry: Optional[obs.Registry] = None
+                   ) -> obs.Registry:
+    """One probe pass: walk every chip and refresh the health
+    instruments on *registry* (a fresh one when None).  Split from
+    :func:`render_metrics` so the HTTP server can run it as a
+    render-time collect hook — the in-process TSDB's sampling tick
+    then sees fresh probes, not the last scrape's leftovers."""
     reg = registry if registry is not None else obs.Registry()
     t0 = time.perf_counter()
     chips, _ = discovery.get_tpu_chips(sysfs_root, dev_root, "/nonexistent")
@@ -117,16 +112,49 @@ def render_metrics(sysfs_root: str = "/sys", dev_root: str = "/dev",
         "tpu_exporter_probe_seconds",
         "One full probe walk (discovery + per-chip sysfs state).",
         buckets=obs.FAST_BUCKETS_S).observe(probe_dt)
-    return reg.render(openmetrics=openmetrics)
+    return reg
+
+
+def render_metrics(sysfs_root: str = "/sys", dev_root: str = "/dev",
+                   scrapes: int = 0,
+                   registry: Optional[obs.Registry] = None,
+                   openmetrics: bool = False) -> str:
+    """One scrape: probe every chip and render the exposition text
+    through the shared :class:`obs.Registry` renderer.
+
+    *registry* keeps instruments alive across scrapes (the HTTP server
+    passes its own, so the probe-duration histogram accumulates); bare
+    calls get a fresh one — no state leaks between tests.
+
+    Rename (PR 3, promlint): ``tpu_device_uncorrectable_errors`` is now
+    ``tpu_device_uncorrectable_errors_total`` (counters must end in
+    ``_total``).  The render itself is accounted via
+    :class:`obs.ScrapeMeta` (``tpu_scrape_*`` — PR 18)."""
+    reg = update_metrics(sysfs_root, dev_root, scrapes=scrapes,
+                         registry=registry)
+    return obs.ScrapeMeta(reg).render(openmetrics=openmetrics)
+
+
+def default_exporter_alert_rules() -> "list[obs.AlertRule]":
+    """The exporter's built-in rule: unhealthy chips are a ticket
+    after a minute of dwell (one flapping probe must not page)."""
+    return [obs.threshold_rule(
+        "tpu_unhealthy_chips", "tpu_exporter_unhealthy_chips",
+        ">", 0, for_s=60.0, severity="ticket",
+        description="One or more TPU chips on this node have probed "
+                    "unhealthy for over a minute.")]
 
 
 class MetricsHTTPServer:
-    """``/metrics`` (Prometheus) + ``/healthz`` on a TCP port, probing the
+    """``/metrics`` (Prometheus) + ``/healthz`` + the PR-18 retention
+    surface (``/debug/query``, ``/alerts``) on a TCP port, probing the
     same fixture-injectable sysfs/dev roots as the gRPC service."""
 
     def __init__(self, port: int = constants.METRICS_HTTP_PORT,
                  sysfs_root: str = "/sys", dev_root: str = "/dev",
-                 host: str = "0.0.0.0"):
+                 host: str = "0.0.0.0",
+                 alert_rules: Optional[list] = None,
+                 tick_interval_s: float = 15.0):
         self._port = port
         self._host = host
         self._sysfs_root = sysfs_root
@@ -134,9 +162,28 @@ class MetricsHTTPServer:
         self._scrapes = 0
         self._lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
+        self._tick_interval_s = tick_interval_s
         # persistent across scrapes so the probe-duration histogram
         # accumulates a real distribution
         self.registry = obs.Registry()
+        # probe refresh rides the registry's collect hook: every
+        # render — an HTTP scrape OR a TSDB sampling tick — sees a
+        # fresh probe walk, so retained series never go stale between
+        # scrapes
+        self.registry.on_collect(self._refresh)
+        self.scrape_meta = obs.ScrapeMeta(self.registry)
+        self.recorder = obs.FlightRecorder(registry=self.registry)
+        self.tsdb = obs.TSDB(self.registry)
+        rules = (list(alert_rules) if alert_rules is not None
+                 else default_exporter_alert_rules())
+        self.alerts = obs.AlertEvaluator(
+            self.tsdb, rules, recorder=self.recorder)
+
+    def _refresh(self) -> None:
+        with self._lock:
+            n = self._scrapes
+        update_metrics(self._sysfs_root, self._dev_root, scrapes=n,
+                       registry=self.registry)
 
     @property
     def port(self) -> int:
@@ -147,15 +194,30 @@ class MetricsHTTPServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
-                if self.path == "/healthz":
+                from urllib.parse import parse_qsl, urlsplit
+
+                parts = urlsplit(self.path)
+                if parts.path == "/healthz":
                     self._send(200, "text/plain", "ok\n")
                     return
-                if self.path != "/metrics":
+                if parts.path == "/alerts":
+                    self._send(200, "application/json",
+                               outer.alerts.status_json() + "\n")
+                    return
+                if parts.path == "/debug/query":
+                    params = dict(parse_qsl(parts.query))
+                    try:
+                        body = outer.tsdb.handle_query_json(params)
+                    except ValueError as e:
+                        self._send(400, "text/plain", f"{e}\n")
+                        return
+                    self._send(200, "application/json", body + "\n")
+                    return
+                if parts.path != "/metrics":
                     self._send(404, "text/plain", "not found\n")
                     return
                 with outer._lock:
                     outer._scrapes += 1
-                    n = outer._scrapes
                 # OpenMetrics negotiation for parity with the other
                 # surfaces (the exporter records no exemplars today,
                 # but a scraper asking for the format must get a
@@ -163,9 +225,10 @@ class MetricsHTTPServer:
                 om = obs.negotiate_openmetrics(
                     self.headers.get("Accept"))
                 try:
-                    body = render_metrics(
-                        outer._sysfs_root, outer._dev_root, scrapes=n,
-                        registry=outer.registry, openmetrics=om)
+                    # probe refresh runs inside render via the
+                    # registry collect hook; ScrapeMeta accounts the
+                    # exposition itself (tpu_scrape_*)
+                    body = outer.scrape_meta.render(openmetrics=om)
                 except Exception:  # scrape must not kill the daemon
                     log.exception("metrics scrape failed")
                     self._send(500, "text/plain",
@@ -190,11 +253,13 @@ class MetricsHTTPServer:
         self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
         threading.Thread(target=self._httpd.serve_forever,
                          name="metrics-http", daemon=True).start()
+        self.tsdb.start(self._tick_interval_s)
         log.info("prometheus metrics on http://%s:%d/metrics",
                  self._host, self.port)
         return self
 
     def stop(self) -> None:
+        self.tsdb.stop()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

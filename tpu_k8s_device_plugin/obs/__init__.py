@@ -10,6 +10,20 @@ exemplars, and :class:`FlightRecorder` events.  See :mod:`.core` /
 ``docs/user-guide/observability.md`` for the full reference.
 """
 
+from .alerts import (
+    ALERT_TRANSITION_EVENT,
+    SEVERITY_INFO,
+    SEVERITY_PAGE,
+    SEVERITY_TICKET,
+    AlertCondition,
+    AlertEvaluator,
+    AlertRule,
+    burn_rate,
+    burn_rate_rules,
+    load_alert_rules,
+    parse_alert_rules,
+    threshold_rule,
+)
 from .core import (
     FAST_BUCKETS_S,
     LATENCY_BUCKETS_S,
@@ -20,6 +34,7 @@ from .core import (
     Gauge,
     Histogram,
     Registry,
+    ScrapeMeta,
     escape_help,
     escape_label_value,
     histogram_quantile,
@@ -34,20 +49,35 @@ from .slo import (
     parse_slo_specs,
 )
 from .span import Span, span
-from .stitch import flatten, render_tree, stitch
+from .stitch import event_severity, flatten, render_tree, stitch
 from .trace import (
     TraceContext,
     new_trace,
     parse_traceparent,
     trace_from_header,
 )
+from .tsdb import (
+    TSDB,
+    expr_metric_names,
+    format_duration,
+    parse_duration,
+    parse_expr,
+)
 
 __all__ = [
+    "ALERT_TRANSITION_EVENT",
     "FAST_BUCKETS_S",
     "LATENCY_BUCKETS_S",
     "OPENMETRICS_CONTENT_TYPE",
+    "SEVERITY_INFO",
+    "SEVERITY_PAGE",
+    "SEVERITY_TICKET",
     "SLOW_BUCKETS_S",
     "TEXT_CONTENT_TYPE",
+    "TSDB",
+    "AlertCondition",
+    "AlertEvaluator",
+    "AlertRule",
     "Counter",
     "Event",
     "FlightRecorder",
@@ -56,20 +86,31 @@ __all__ = [
     "Registry",
     "SLOAccountant",
     "SLOPolicy",
+    "ScrapeMeta",
     "Span",
     "TraceContext",
+    "burn_rate",
+    "burn_rate_rules",
     "default_slo_policies",
     "escape_help",
     "escape_label_value",
+    "event_severity",
+    "expr_metric_names",
     "flatten",
+    "format_duration",
     "histogram_quantile",
+    "load_alert_rules",
     "negotiate_openmetrics",
     "new_trace",
+    "parse_alert_rules",
+    "parse_duration",
+    "parse_expr",
     "parse_exposition",
     "parse_slo_specs",
     "parse_traceparent",
     "render_tree",
     "span",
     "stitch",
+    "threshold_rule",
     "trace_from_header",
 ]

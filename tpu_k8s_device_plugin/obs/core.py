@@ -455,8 +455,6 @@ class Registry:
         an exemplar."""
         with self._lock:
             collectors = list(self._collectors)
-            families = sorted(self._families.values(),
-                              key=lambda f: f.name)
         for fn in collectors:
             try:
                 fn()
@@ -464,6 +462,12 @@ class Registry:
                 # a broken collector degrades one scrape's freshness,
                 # never the scrape itself
                 log.exception("metrics collector failed")
+        # snapshot the family list only AFTER the collectors ran: a
+        # hook that lazily registers its instruments on first call must
+        # still see them rendered in that same (first) scrape
+        with self._lock:
+            families = sorted(self._families.values(),
+                              key=lambda f: f.name)
         out: List[str] = []
         for fam in families:
             samples: List[str] = []
@@ -476,6 +480,59 @@ class Registry:
         if openmetrics:
             out.append("# EOF")
         return "\n".join(out) + "\n"
+
+
+class ScrapeMeta:
+    """Scrape self-observability for one ``/metrics`` surface.
+
+    Wraps :meth:`Registry.render` and records, about the exposition it
+    just produced: wall time (``tpu_scrape_duration_seconds``), sample
+    lines (``tpu_scrape_series``) and body bytes
+    (``tpu_scrape_size_bytes``), each by exposition ``mode``
+    (``text``/``openmetrics``).  Values land in the *next* scrape —
+    the standard self-scrape convention (a scrape cannot contain its
+    own duration).  One instance per surface, created next to the
+    surface's Registry.
+    """
+
+    def __init__(self, registry: "Registry") -> None:
+        self._registry = registry
+        self._h_duration = registry.histogram(
+            "tpu_scrape_duration_seconds",
+            "Wall time spent rendering this surface's own /metrics "
+            "exposition, by exposition mode.",
+            ("mode",), buckets=FAST_BUCKETS_S)
+        self._g_series = registry.gauge(
+            "tpu_scrape_series",
+            "Sample lines in this surface's most recent /metrics "
+            "exposition, by exposition mode.",
+            ("mode",))
+        self._g_size = registry.gauge(
+            "tpu_scrape_size_bytes",
+            "Byte size of this surface's most recent /metrics "
+            "exposition body, by exposition mode.",
+            ("mode",))
+        # render from boot: the very FIRST scrape already carries both
+        # mode children (zeroed), so the schema never shifts between
+        # scrape 1 and scrape 2
+        for mode in ("text", "openmetrics"):
+            self._h_duration.labels(mode=mode)
+            self._g_series.labels(mode=mode).set(0.0)
+            self._g_size.labels(mode=mode).set(0.0)
+
+    def render(self, openmetrics: bool = False) -> str:
+        """Render the registry and account the render itself."""
+        t0 = time.perf_counter()
+        body = self._registry.render(openmetrics=openmetrics)
+        duration = time.perf_counter() - t0
+        mode = "openmetrics" if openmetrics else "text"
+        series = sum(1 for line in body.splitlines()
+                     if line and not line.startswith("#"))
+        self._h_duration.labels(mode=mode).observe(duration)
+        self._g_series.labels(mode=mode).set(float(series))
+        self._g_size.labels(mode=mode).set(
+            float(len(body.encode("utf-8"))))
+        return body
 
 
 # -- reading expositions back (benchmarks, lint, tests) ---------------------

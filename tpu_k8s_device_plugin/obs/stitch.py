@@ -89,16 +89,37 @@ def stitch(events: List[Dict[str, object]]
     return [r.to_dict() for r in roots]
 
 
+def event_severity(ev: Dict[str, object]) -> str:
+    """An event's severity tag: top-level ``severity`` when present,
+    else the ``severity`` attr alert-transition journal entries carry
+    (PR 18).  Empty string for everything unsevere."""
+    sev = ev.get("severity")
+    if isinstance(sev, str) and sev:
+        return sev
+    attrs = ev.get("attrs")
+    if isinstance(attrs, dict):
+        sev = attrs.get("severity")
+        if isinstance(sev, str):
+            return sev
+    return ""
+
+
 def flatten(tree: List[Dict[str, object]]) -> List[Dict[str, object]]:
     """Depth-first event list of a stitched tree — the causal order a
     test (or a grep) walks: a parent span's events come before its
-    children's."""
+    children's.  Events carrying a severity (alert transitions) gain
+    a top-level ``severity`` key so downstream renderers and filters
+    never dig through attrs."""
     out: List[Dict[str, object]] = []
 
     def walk(node: Dict[str, object]) -> None:
         evs = node.get("events")
         if isinstance(evs, list):
-            out.extend(e for e in evs if isinstance(e, dict))
+            for e in evs:
+                if not isinstance(e, dict):
+                    continue
+                sev = event_severity(e)
+                out.append({**e, "severity": sev} if sev else e)
         children = node.get("children")
         if isinstance(children, list):
             for c in children:
@@ -144,6 +165,9 @@ def render_tree(tree: List[Dict[str, object]],
                     out = attrs.get("outcome")
                     if isinstance(out, str):
                         extra += f" outcome={out}"
+                sev = event_severity(ev)
+                if sev:
+                    extra += f" severity={sev}"
                 lines.append(f"{pad}  +{dt:9.4f}s {name}{extra}")
         children = node.get("children")
         if isinstance(children, list):

@@ -154,11 +154,39 @@ class DebugServer:
     """Loopback HTTP server for the debug surface."""
 
     def __init__(self, manager: "PluginManager", port: int,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1",
+                 alert_rules: Optional[list] = None,
+                 tick_interval_s: float = 15.0):
         self._manager = manager
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._host = host
         self._port = port
+        self._tick_interval_s = tick_interval_s
+        # the manager's registry when it has one (shared with the
+        # Allocate/pulse instruments), a private one otherwise — the
+        # PR-18 retention layer needs a stable registry either way
+        registry = getattr(manager, "registry", None)
+        self.registry: obs.Registry = (
+            registry if registry is not None else obs.Registry())
+        # bridged snapshot families refresh at render time, so the
+        # TSDB's sampling tick sees fresh RPC counts — same collect
+        # hook discipline as the health exporter
+        self.registry.on_collect(self._refresh)
+        self.scrape_meta = obs.ScrapeMeta(self.registry)
+        self.tsdb = obs.TSDB(self.registry)
+        self.alerts = obs.AlertEvaluator(
+            self.tsdb, list(alert_rules or ()),
+            recorder=getattr(manager, "recorder", None))
+
+    def _refresh(self) -> None:
+        try:
+            update_plugin_metrics(self._manager, self.registry)
+        except Exception as e:
+            # a broken status snapshot degrades one render's
+            # freshness, never the render (or the TSDB tick) itself
+            suppressed("debug.metrics_refresh", e, logger=log,
+                       metrics=getattr(self._manager, "resilience",
+                                       None))
 
     @property
     def port(self) -> int:
@@ -167,12 +195,26 @@ class DebugServer:
 
     def start(self) -> "DebugServer":
         manager = self._manager
+        outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
                 url = urlparse(self.path)
                 if url.path == "/healthz":
                     self._send(200, "text/plain", "ok\n")
+                elif url.path == "/alerts":
+                    self._send(200, "application/json",
+                               outer.alerts.status_json() + "\n")
+                elif url.path == "/debug/query":
+                    params = {k: v[0] for k, v
+                              in parse_qs(url.query).items()}
+                    try:
+                        body = outer.tsdb.handle_query_json(params)
+                    except ValueError as e:
+                        self._send(400, "application/json", json.dumps(
+                            {"error": str(e)}) + "\n")
+                        return
+                    self._send(200, "application/json", body + "\n")
                 elif url.path == "/debug/status":
                     try:
                         body = json.dumps(manager_status(manager), indent=2)
@@ -226,12 +268,14 @@ class DebugServer:
                     om = obs.negotiate_openmetrics(
                         self.headers.get("Accept"))
                     try:
+                        # bridged families refresh via the registry
+                        # collect hook; ScrapeMeta accounts the
+                        # exposition itself (tpu_scrape_*)
                         self._send(
                             200,
                             obs.OPENMETRICS_CONTENT_TYPE if om
                             else obs.TEXT_CONTENT_TYPE,
-                            render_plugin_metrics(manager,
-                                                  openmetrics=om),
+                            outer.scrape_meta.render(openmetrics=om),
                         )
                     except Exception as e:
                         log.exception("/metrics render failed")
@@ -260,10 +304,12 @@ class DebugServer:
             target=self._httpd.serve_forever, name="debug-http", daemon=True
         )
         t.start()
+        self.tsdb.start(self._tick_interval_s)
         log.info("debug endpoint on http://%s:%d", self._host, self.port)
         return self
 
     def stop(self) -> None:
+        self.tsdb.stop()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -86,8 +86,13 @@ REASON_IDLE = "idle"
 REASON_DEGRADED = "degraded"
 REASON_FAILURE = "failure"
 REASON_FLOOR = "floor"
+# a firing page-severity burn-rate alert (the router's fleet-level
+# evaluator or any replica's local one, via the /fleet/statz
+# firing_alerts roll-up) — the alerting loop closed back into scaling
+REASON_ALERT = "alert"
 REASONS = (REASON_PRESSURE, REASON_GOODPUT, REASON_IDLE,
-           REASON_DEGRADED, REASON_FAILURE, REASON_FLOOR)
+           REASON_DEGRADED, REASON_FAILURE, REASON_FLOOR,
+           REASON_ALERT)
 
 DIRECTIONS = ("up", "down")
 
@@ -214,6 +219,10 @@ class FleetObservation:
     #           "window_total": n}
     goodput: Mapping[str, Mapping[str, float]] = \
         field(default_factory=dict)
+    # the /fleet/statz firing_alerts roll-up: each entry carries at
+    # least {"source", "name", "severity"} — page severity is a
+    # scale-up signal (reason=alert)
+    firing_alerts: Tuple[Mapping[str, str], ...] = ()
 
 
 @dataclass(frozen=True)
@@ -527,11 +536,20 @@ class FleetPlanner:
                     >= cfg.burn_rate_high:
                 goodput_bad = True
                 break
+        # a firing page-severity alert (PR 18) is the alert engine's
+        # pre-chewed verdict — multi-window burn already confirmed it,
+        # so it drives scale-up even when the raw-threshold signals
+        # above haven't tripped (and keeps working as a fallback when
+        # the fleet runs without the evaluator)
+        alert_hot = any(
+            str(f.get("severity", "")) == "page"
+            for f in o.firing_alerts if isinstance(f, Mapping))
         high = (n > 0 and pressure >= cfg.high_watermark) \
             or (n > 0 and goodput_bad) \
+            or (n > 0 and alert_hot) \
             or (n == 0 and norep_delta > 0)
         low = n > 0 and pressure <= cfg.low_watermark \
-            and not goodput_bad
+            and not goodput_bad and not alert_hot
         idle = n > 0 and o.queue_depth == 0 and o.in_flight == 0 \
             and served_delta == 0
 
@@ -577,7 +595,9 @@ class FleetPlanner:
                 sid, gen = placed
                 actions.append(Action(
                     ACTION_SPAWN,
-                    REASON_GOODPUT if goodput_bad else REASON_PRESSURE,
+                    REASON_ALERT if alert_hot
+                    else REASON_GOODPUT if goodput_bad
+                    else REASON_PRESSURE,
                     role=self._choose_role(active),
                     slice_id=sid, generation=gen))
                 spawns += 1
@@ -665,6 +685,11 @@ class ServerSpec:
     slo: Tuple[str, ...] = ()
     compile_cache_dir: str = ""
     kv_paging: bool = False
+    # replica-local alert engine (PR 18): 0 keeps the replica's CLI
+    # defaults; set both to shrink the burn-rate windows and tighten
+    # the evaluation tick so soak episodes see alerts fire in seconds
+    alert_interval_s: float = 0.0
+    alert_window_scale: float = 0.0
     extra_args: Tuple[str, ...] = ()
 
 
@@ -841,6 +866,15 @@ class FleetController:
             int(v) for v in shed.values()
             if isinstance(v, (int, float))) \
             if isinstance(shed, dict) else 0
+        firing_raw = fleet.get("firing_alerts")
+        firing: List[Dict[str, str]] = []
+        if isinstance(firing_raw, list):
+            for f in firing_raw:
+                if isinstance(f, dict) and f.get("name"):
+                    firing.append({
+                        "source": str(f.get("source", "")),
+                        "name": str(f["name"]),
+                        "severity": str(f.get("severity", ""))})
         return FleetObservation(
             now_s=now, replicas=tuple(views),
             slices=self.capacity(),
@@ -853,7 +887,8 @@ class FleetController:
                 router_row.get("no_replica_total", 0) or 0),
             kv_pages=int(fleet.get("kv_pages", 0) or 0),
             kv_pages_free=int(fleet.get("kv_pages_free", 0) or 0),
-            shed_total=shed_total, goodput=goodput)
+            shed_total=shed_total, goodput=goodput,
+            firing_alerts=tuple(firing))
 
     # -- act ----------------------------------------------------------------
 
@@ -877,6 +912,11 @@ class FleetController:
             cmd += ["--slo", spec]
         if s.compile_cache_dir:
             cmd += ["--compile-cache-dir", s.compile_cache_dir]
+        if s.alert_interval_s > 0:
+            cmd += ["--alert-interval", str(s.alert_interval_s)]
+        if s.alert_window_scale > 0:
+            cmd += ["--alert-window-scale",
+                    str(s.alert_window_scale)]
         if role != ROLE_MIXED:
             cmd += ["--replica-role", role]
             if not s.kv_paging:
@@ -1197,9 +1237,17 @@ def run_episode(args: argparse.Namespace) -> Tuple[
                 "slice_id": "episode-slice", "generation": 1,
                 "workers": args.max_replicas}]}, fh)
 
+    # the router's fleet-level alert engine runs with shrunk burn-rate
+    # windows so a mid-episode collapse traverses
+    # inactive->pending->firing->resolved within the episode's wall
+    # time (old Namespaces without the flags keep the CLI defaults)
+    alert_interval = float(getattr(args, "alert_interval", 0.5))
+    alert_scale = float(getattr(args, "alert_window_scale", 0.01))
     rt = RouterServer(statz_interval_s=0.3, replica_ttl_s=5.0,
                       breaker_reset_s=0.5, seed=args.seed,
-                      registry=registry)
+                      registry=registry, slo_policies=policies,
+                      alert_interval_s=alert_interval,
+                      alert_window_scale=alert_scale)
     rt.start(host="127.0.0.1", port=0)
     cache_dir = args.compile_cache_dir or os.path.join(
         args.workdir, "fleet-compile-cache")
@@ -1220,7 +1268,11 @@ def run_episode(args: argparse.Namespace) -> Tuple[
             max_new_tokens=args.max_new_tokens,
             prefix_chunk=args.prefix_chunk,
             slo=tuple(args.slo or ()),
-            compile_cache_dir=cache_dir),
+            compile_cache_dir=cache_dir,
+            alert_interval_s=alert_interval,
+            alert_window_scale=alert_scale,
+            extra_args=tuple(
+                getattr(args, "server_extra_args", ()) or ())),
         capacity_spec=capacity_path, interval_s=0.25,
         seed=args.seed, registry=registry, recorder=recorder)
     if args.fault_spec:
@@ -1309,6 +1361,11 @@ def run_episode(args: argparse.Namespace) -> Tuple[
         # for it (and extends its deadline once it lands, giving the
         # rolling drain a full window to finish).
         settle_deadline = time.monotonic() + args.settle_s
+        # alert-centric episodes (chaos soak ep. 15) additionally hold
+        # the settle open until the router's evaluator reports no
+        # firing alerts, so the firing -> resolved transition lands in
+        # the journal BEFORE the harvest below reads it
+        wait_alerts = bool(getattr(args, "settle_on_alerts", False))
         while time.monotonic() < settle_deadline:
             pending = not args.no_degrade \
                 and "t" not in degrade_fired
@@ -1317,7 +1374,9 @@ def run_episode(args: argparse.Namespace) -> Tuple[
                     settle_deadline,
                     float(degrade_fired["t"]) + args.settle_s)
                 degrade_fired["t"] = None
-            if controller.replica_count() <= 1 and not pending:
+            if controller.replica_count() <= 1 and not pending \
+                    and not (wait_alerts
+                             and rt.alerts.brief()["firing"]):
                 break
             time.sleep(0.25)
         scaled_back = controller.replica_count() <= max(
@@ -1370,7 +1429,20 @@ def run_episode(args: argparse.Namespace) -> Tuple[
         # spawns prove that
         demand_spawns = sum(
             1 for e in spawned
-            if _attr(e, "reason") in (REASON_PRESSURE, REASON_GOODPUT))
+            if _attr(e, "reason") in (REASON_PRESSURE, REASON_GOODPUT,
+                                      REASON_ALERT))
+        # alert evidence (PR 18): the state-machine transitions the
+        # router's evaluator journaled, and any spawn the pre-chewed
+        # alert verdict (rather than the raw thresholds) drove
+        alert_transitions = [
+            {"alert": _attr(e, "alert"),
+             "severity": _attr(e, "severity"),
+             "from": _attr(e, "state_from"),
+             "to": _attr(e, "state_to")}
+            for e in rt.recorder.events(
+                name=obs.ALERT_TRANSITION_EVENT)]
+        alert_spawns = sum(1 for e in spawned
+                           if _attr(e, "reason") == REASON_ALERT)
         report["fleet"] = {
             "max_replicas_observed": controller.max_observed,
             "final_replicas": controller.replica_count(),
@@ -1384,6 +1456,8 @@ def run_episode(args: argparse.Namespace) -> Tuple[
             "replaced_after_kill": replaced,
             "degraded_drained": degraded_drained,
             "respawned_on_new_generation": regen_spawn,
+            "alert_scale_up_events": alert_spawns,
+            "alert_transitions": alert_transitions,
             "metrics": fleet_metrics,
             "journal": [
                 {"name": str(e.get("name")), "attrs": e.get("attrs")}

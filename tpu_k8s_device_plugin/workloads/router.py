@@ -416,7 +416,11 @@ class RouterServer:
                  tenant_quotas: Optional[
                      Dict[str, TenantQuota]] = None,
                  tenant_pinning: bool = True,
-                 default_budget: int = DEFAULT_BUDGET_ESTIMATE
+                 default_budget: int = DEFAULT_BUDGET_ESTIMATE,
+                 slo_policies: Optional[Dict[str, Any]] = None,
+                 alert_rules: Optional[List[Any]] = None,
+                 alert_interval_s: float = 5.0,
+                 alert_window_scale: float = 1.0
                  ) -> None:
         if prefix_chunk < 1:
             raise ValueError("prefix_chunk must be >= 1")
@@ -544,6 +548,34 @@ class RouterServer:
         # (replica statz cannot carry that signal when there are none)
         self._no_replica_total = 0
         reg.on_collect(self._collect_health)
+        # -- fleet-level retention + alerting (PR 18) --------------------
+        # the cached per-replica goodput blocks aggregate into bridge
+        # gauges at collect time (HTTP scrape or TSDB tick), and the
+        # router's OWN burn-rate rule pairs evaluate over the fleet
+        # aggregate — so one drowning replica masked by an idle one
+        # still pages here even when no single replica's local rules
+        # fire.  Firing state rides /alerts and the /fleet/statz
+        # firing_alerts roll-up the autoscaler reads.
+        self._m_fleet_burn = reg.gauge(
+            "tpu_router_fleet_burn_rate",
+            "Fleet-aggregate error-budget burn rate per SLO class "
+            "(max across replicas, from cached statz).", ("class",))
+        self._m_fleet_goodput = reg.gauge(
+            "tpu_router_fleet_goodput_ratio",
+            "Fleet-aggregate goodput ratio per SLO class (window met "
+            "over window total, summed across replicas).", ("class",))
+        reg.on_collect(self._collect_fleet_goodput)
+        self.scrape_meta = obs.ScrapeMeta(reg)
+        self.tsdb = obs.TSDB(reg)
+        self.alert_interval_s = float(alert_interval_s)
+        policies = (dict(slo_policies) if slo_policies
+                    else obs.default_slo_policies())
+        rules = obs.burn_rate_rules(
+            policies, metric="tpu_router_fleet_burn_rate",
+            window_scale=alert_window_scale)
+        rules.extend(alert_rules or ())
+        self.alerts = obs.AlertEvaluator(
+            self.tsdb, rules, recorder=self.recorder)
 
     # -- replica table ------------------------------------------------------
 
@@ -807,6 +839,10 @@ class RouterServer:
         # idle replica mask a drowning one)
         classes: Dict[str, Dict[str, float]] = {}
         per_replica: Dict[str, Any] = {}
+        # firing-alert roll-up (PR 18): every replica's statz alert
+        # brief plus the router's own fleet-level evaluator, tagged
+        # by source so the autoscaler can key on page severity
+        firing_alerts: List[Dict[str, Any]] = []
         healthy = 0
         for rep in sorted(reps, key=lambda r: r.rid):
             ok = self._routable(rep)
@@ -828,6 +864,12 @@ class RouterServer:
                 for k, v in shed.items():
                     if isinstance(v, (int, float)):
                         shed_agg[k] = shed_agg.get(k, 0) + int(v)
+            alerts = statz.get("alerts")
+            if isinstance(alerts, dict):
+                for f in alerts.get("firing") or []:
+                    if isinstance(f, dict):
+                        firing_alerts.append(
+                            {"source": rep.rid, **f})
             goodput = statz.get("goodput")
             if not isinstance(goodput, dict):
                 continue
@@ -866,12 +908,17 @@ class RouterServer:
             }
         with self._lock:
             no_replica_total = self._no_replica_total
+        own = self.alerts.brief()
+        for f in own["firing"]:
+            firing_alerts.append({"source": "router", **f})
         return {
             "replicas": len(reps),
             "healthy": healthy,
             "fleet": {**agg, "shed": shed_agg,
-                      "goodput": goodput_out},
-            "router": {"no_replica_total": no_replica_total},
+                      "goodput": goodput_out,
+                      "firing_alerts": firing_alerts},
+            "router": {"no_replica_total": no_replica_total,
+                       "alerts": own},
             "per_replica": per_replica,
         }
 
@@ -943,6 +990,21 @@ class RouterServer:
         for rep in reps:
             self._m_healthy.labels(replica=rep.rid).set(
                 1 if self._routable(rep) else 0)
+
+    def _collect_fleet_goodput(self) -> None:
+        """Scrape-time refresh of the fleet-aggregate goodput bridge
+        gauges the router's burn-rate alert rules evaluate over.
+        Built from the same cached statz rows fleet_statz() reads —
+        O(replicas), no fan-out.  Classes rebuild from scratch so a
+        class that left the fleet leaves no stale burning series."""
+        goodput = self.fleet_statz()["fleet"]["goodput"]
+        self._m_fleet_burn.clear()
+        self._m_fleet_goodput.clear()
+        for name, row in goodput.items():
+            self._m_fleet_burn.labels(**{"class": name}).set(
+                row["burn_rate_max"])
+            self._m_fleet_goodput.labels(**{"class": name}).set(
+                row["goodput_ratio"])
 
     # -- statz poller -------------------------------------------------------
 
@@ -1545,7 +1607,10 @@ class RouterServer:
                     om = obs.negotiate_openmetrics(
                         self.headers.get("Accept"))
                     try:
-                        body = router.registry.render(
+                        # ScrapeMeta accounts the exposition itself
+                        # (tpu_scrape_*); the fleet bridge gauges
+                        # refresh via the registry collect hook
+                        body = router.scrape_meta.render(
                             openmetrics=om).encode()
                     except Exception:
                         log.exception("/metrics render failed")
@@ -1554,6 +1619,22 @@ class RouterServer:
                         return
                     self._send(200, obs.OPENMETRICS_CONTENT_TYPE
                                if om else obs.TEXT_CONTENT_TYPE, body)
+                elif self.path == "/alerts":
+                    self._send(200, "application/json",
+                               (router.alerts.status_json()
+                                + "\n").encode())
+                elif self.path.startswith("/debug/query"):
+                    params = {k: v[0] for k, v in parse_qs(
+                        urlparse(self.path).query).items()}
+                    try:
+                        qbody = router.tsdb.handle_query_json(params)
+                    except ValueError as e:
+                        self._send(400, "application/json",
+                                   (json.dumps({"error": str(e)})
+                                    + "\n").encode())
+                        return
+                    self._send(200, "application/json",
+                               (qbody + "\n").encode())
                 elif self.path == "/fleet/statz":
                     body = json.dumps(
                         router.fleet_statz(),
@@ -1651,6 +1732,7 @@ class RouterServer:
         self._poller = threading.Thread(
             target=self._poll_loop, name="router-statz", daemon=True)
         self._poller.start()
+        self.tsdb.start(self.alert_interval_s)
         log.info("router on http://%s:%d", host, self.port)
         return self
 
@@ -1661,6 +1743,7 @@ class RouterServer:
         return int(self._httpd.server_address[1])
 
     def stop(self) -> None:
+        self.tsdb.stop()
         self._stop.set()
         if self._poller is not None:
             self._poller.join(timeout=2)
@@ -1745,6 +1828,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--flight-record-dir", default=None, metavar="DIR",
                    help="dump the flight-recorder journal on "
                         "exit/SIGTERM")
+    p.add_argument("--slo", action="append", default=None,
+                   metavar="CLASS=TTFT_MS[:DEADLINE_MS]",
+                   help="SLO classes the fleet-level burn-rate alert "
+                        "rules derive from (same grammar as the "
+                        "serving flag; default interactive + batch) — "
+                        "evaluated over the fleet-aggregate "
+                        "tpu_router_fleet_burn_rate bridge gauge")
+    p.add_argument("--alert-rules", default=None, metavar="FILE",
+                   help="extra JSON alert rules ({\"rules\": [...]}) "
+                        "for the router's in-process evaluator")
+    p.add_argument("--alert-interval", type=float, default=5.0,
+                   metavar="S",
+                   help="TSDB sampling / alert evaluation tick "
+                        "(seconds)")
+    p.add_argument("--alert-window-scale", type=float, default=1.0,
+                   metavar="X",
+                   help="scale factor on the derived burn-rate rule "
+                        "windows (5m/1h/6h * X)")
     args = p.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
@@ -1753,6 +1854,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         tenant_quotas = parse_tenant_quotas(args.tenant_quota)
     except ValueError as e:
         p.error(str(e))
+    slo_policies = None
+    if args.slo:
+        try:
+            slo_policies = obs.parse_slo_specs(args.slo)
+        except ValueError as e:
+            p.error(str(e))
+    alert_rules = None
+    if args.alert_rules:
+        try:
+            alert_rules = obs.load_alert_rules(args.alert_rules)
+        except (OSError, ValueError) as e:
+            p.error(f"--alert-rules: {e}")
+    if args.alert_interval <= 0:
+        p.error("--alert-interval must be > 0")
+    if args.alert_window_scale <= 0:
+        p.error("--alert-window-scale must be > 0")
     rt = RouterServer(
         prefix_chunk=args.prefix_chunk,
         replica_ttl_s=args.replica_ttl,
@@ -1767,7 +1884,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         prefill_threshold=args.prefill_threshold,
         tenant_quotas=tenant_quotas,
         tenant_pinning=args.tenant_pinning,
-        default_budget=args.default_budget)
+        default_budget=args.default_budget,
+        slo_policies=slo_policies,
+        alert_rules=alert_rules,
+        alert_interval_s=args.alert_interval,
+        alert_window_scale=args.alert_window_scale)
     if args.fault_spec:
         faults.install(args.fault_spec, seed=args.seed or 0,
                        recorder=rt.recorder)

@@ -666,7 +666,10 @@ class EngineServer:
                  slo_window_s: float = 60.0,
                  profile_dir: Optional[str] = None,
                  flight_dump_keep: int = 20,
-                 replica_role: str = "mixed"):
+                 replica_role: str = "mixed",
+                 alert_rules: Optional[list] = None,
+                 alert_interval_s: float = 5.0,
+                 alert_window_scale: float = 1.0):
         """*tokenizer* (anything with ``encode(str) -> List[int]`` and
         ``decode(List[int]) -> str``, e.g. a transformers tokenizer)
         unlocks the text-level surface: ``"prompt"`` strings, STRING
@@ -920,6 +923,23 @@ class EngineServer:
         self.flight_record_dir = flight_record_dir
         if flight_record_dir:
             self.recorder.install_dump_handlers(flight_record_dir)
+        # -- in-process retention + alerting (PR 18) ----------------------
+        # a bounded TSDB samples this registry on a background tick
+        # (GET /debug/query reads it back), and the evaluator derives
+        # the SRE multi-window multi-burn-rate rules from every SLO
+        # class above — page at 14.4x over the short+long window pair,
+        # ticket at 1x over six hours — plus whatever --alert-rules
+        # hand-writes.  Firing pages surface on /alerts and in statz(),
+        # which is how the fleet autoscaler learns reason=alert.
+        reg.on_collect(self._bridge_stats)
+        self.scrape_meta = obs.ScrapeMeta(reg)
+        self.tsdb = obs.TSDB(reg)
+        self.alert_interval_s = float(alert_interval_s)
+        _rules = obs.burn_rate_rules(
+            self._slo.policies, window_scale=alert_window_scale)
+        _rules.extend(alert_rules or ())
+        self.alerts = obs.AlertEvaluator(
+            self.tsdb, _rules, recorder=self.recorder)
         # -- iteration scheduler (continuous batching) --------------------
         # the engine's sole driver: a unified work queue of decode
         # windows and prefill chunks.  With interleave on (default),
@@ -1925,6 +1945,26 @@ class EngineServer:
                         obs.OPENMETRICS_CONTENT_TYPE if om
                         else obs.TEXT_CONTENT_TYPE,
                         body)
+                elif url.path == "/alerts":
+                    # alert-evaluator surface (PR 18): every rule's
+                    # state machine + the firing roll-up, same schema
+                    # on all four HTTP surfaces
+                    self._send(200, "application/json",
+                               server.alerts.status_json() + "\n")
+                elif url.path == "/debug/query":
+                    # retained-series readback: ?expr=&range= against
+                    # the in-process TSDB (rate()/increase()/
+                    # avg_over_time()/histogram_quantile over the ring
+                    # buffers the background tick fills)
+                    params = {k: v[0] for k, v
+                              in parse_qs(url.query).items()}
+                    try:
+                        body_s = server.tsdb.handle_query_json(params)
+                    except ValueError as e:
+                        self._send(400, "application/json", json.dumps(
+                            {"error": str(e)}) + "\n")
+                        return
+                    self._send(200, "application/json", body_s + "\n")
                 elif url.path == "/debug/traces":
                     # ?trace_id=… -> that trace's event timeline;
                     # without it, the recent-trace index
@@ -2455,6 +2495,7 @@ class EngineServer:
             target=self._scheduler_supervisor, name="engine-scheduler",
             daemon=True)
         self._scheduler.start()
+        self.tsdb.start(self.alert_interval_s)
         log.info("serving engine on http://%s:%d", host, self.port)
         return self
 
@@ -2475,6 +2516,7 @@ class EngineServer:
         return t.is_alive() or self._stop.is_set()
 
     def stop(self) -> None:
+        self.tsdb.stop()
         self._stop.set()
         self._work.set()  # wake an idle scheduler so it can exit
         sched = self._scheduler
@@ -3154,6 +3196,11 @@ class EngineServer:
             # the fixed-schema goodput block the router's /fleet/statz
             # aggregates and the autoscaler will key scaling on
             "goodput": self._slo.summary(),
+            # firing/pending alert roll-up (PR 18): rides the same
+            # heartbeat the goodput block does, so the router's
+            # /fleet/statz can aggregate firing_alerts without an
+            # extra fan-out poll
+            "alerts": self.alerts.brief(),
         }
 
     # -- router registration (multi-replica serving) ------------------------
@@ -3268,7 +3315,18 @@ class EngineServer:
         ``_total`` suffix counters require —
         ``tpu_serving_requests_served`` is
         ``tpu_serving_requests_served_total`` and so on; gauges keep
-        their old names."""
+        their old names.
+
+        The stats bridge itself runs as a registry collect hook (PR
+        18) so the TSDB's background sampling tick retains fresh
+        ``tpu_serving_*`` values too, not just HTTP scrapes; the
+        render is accounted via :class:`obs.ScrapeMeta`
+        (``tpu_scrape_*``)."""
+        return self.scrape_meta.render(openmetrics=openmetrics)
+
+    def _bridge_stats(self) -> None:
+        """Registry collect hook: mirror every numeric stats() entry
+        as a ``tpu_serving_*`` family (gauge or ``_total`` counter)."""
         st = self.stats()
         reg = self.registry
         for k, v in st.items():
@@ -3286,7 +3344,6 @@ class EngineServer:
                     name,
                     f"Server/engine counter '{k}' (see /stats)."
                 )._set(v)
-        return reg.render(openmetrics=openmetrics)
 
 
 def enable_compile_cache(path: str) -> bool:
@@ -3461,6 +3518,20 @@ def main(argv=None) -> int:
                    metavar="S",
                    help="rolling window (seconds) for the goodput and "
                         "error-budget burn-rate gauges")
+    p.add_argument("--alert-rules", default=None, metavar="FILE",
+                   help="JSON alert-rule file ({\"rules\": [...]}) "
+                        "evaluated by the in-process alert engine on "
+                        "top of the burn-rate rules derived from every "
+                        "--slo class; firing state serves on /alerts")
+    p.add_argument("--alert-interval", type=float, default=5.0,
+                   metavar="S",
+                   help="TSDB sampling / alert evaluation tick "
+                        "(seconds)")
+    p.add_argument("--alert-window-scale", type=float, default=1.0,
+                   metavar="X",
+                   help="scale factor on the derived burn-rate rule "
+                        "windows (5m/1h/6h * X) — CI and soak tests "
+                        "shrink them to fire within seconds")
     p.add_argument("--profile-dir", default=None, metavar="DIR",
                    help="enable GET /debug/profile?seconds=N: dump "
                         "jax.profiler traces there (single-flight; "
@@ -3610,6 +3681,16 @@ def main(argv=None) -> int:
             p.error(str(e))
     if args.slo_window <= 0:
         p.error("--slo-window must be > 0")
+    alert_rules = None
+    if args.alert_rules:
+        try:
+            alert_rules = obs.load_alert_rules(args.alert_rules)
+        except (OSError, ValueError) as e:
+            p.error(f"--alert-rules: {e}")
+    if args.alert_interval <= 0:
+        p.error("--alert-interval must be > 0")
+    if args.alert_window_scale <= 0:
+        p.error("--alert-window-scale must be > 0")
     if args.flight_dump_keep < 1:
         p.error("--flight-dump-keep must be >= 1")
     import os as _pd_os
@@ -3709,7 +3790,10 @@ def main(argv=None) -> int:
                        slo_window_s=args.slo_window,
                        profile_dir=profile_dir,
                        flight_dump_keep=args.flight_dump_keep,
-                       replica_role=args.replica_role)
+                       replica_role=args.replica_role,
+                       alert_rules=alert_rules,
+                       alert_interval_s=args.alert_interval,
+                       alert_window_scale=args.alert_window_scale)
     if args.fault_spec is not None or args.fault_seed is not None:
         if args.fault_spec is None:
             p.error("--fault-seed needs --fault-spec")
