@@ -1,0 +1,287 @@
+"""Alert-triggered incident bundles (PR 19).
+
+The guardrails under test, per the IncidentManager docstring: atomic
+writes (a reader listing ``incident-*`` never sees a partial bundle),
+one bundle per alert per ``min_interval_s``, newest-``keep`` GC that
+spares foreign files, collector failures degrading to per-file error
+markers instead of lost bundles, and the schema round-trip through
+``obs_query --incident``.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from tpu_k8s_device_plugin import obs
+
+pytestmark = pytest.mark.filterwarnings("ignore")
+
+T0 = 1_700_000_000.0
+
+
+class FakeClock:
+    def __init__(self, t=T0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+def _stack(clock):
+    """One page rule over a collapsing gauge, with the full obs stack
+    behind it — what a serving surface wires up for real."""
+    reg = obs.Registry()
+    rec = obs.FlightRecorder(registry=reg)
+    goodput = reg.gauge("tpu_serve_goodput", "Goodput ratio.")
+    goodput.set(1.0)
+    tsdb = obs.TSDB(reg, now_fn=clock)
+    rule = obs.threshold_rule(
+        "goodput_page", "tpu_serve_goodput", "<", 0.5,
+        for_s=0.0, severity="page",
+        description="goodput collapsed")
+    ev = obs.AlertEvaluator(tsdb, [rule], recorder=rec)
+    prof = obs.SamplingProfiler(reg, hz=19.0, now_fn=clock,
+                                phase_fn=lambda: "dispatch")
+    return reg, rec, goodput, tsdb, rule, ev, prof
+
+
+def _manager(tmp_path, clock, **kw):
+    reg, rec, goodput, tsdb, rule, ev, prof = _stack(clock)
+    prof.sample_once()
+    mgr = obs.IncidentManager(
+        str(tmp_path), ev, registry=reg, recorder=rec, tsdb=tsdb,
+        profiler=prof,
+        collectors={"statz.json": lambda: {"pending": 3}},
+        now_fn=clock, **kw)
+    return mgr, reg, rec, goodput, tsdb, rule, ev
+
+
+def _bundles(tmp_path):
+    return sorted(p for p in os.listdir(tmp_path)
+                  if p.startswith(obs.BUNDLE_PREFIX))
+
+
+# -- atomic write + round trip ----------------------------------------------
+
+def test_bundle_write_is_atomic_and_complete(tmp_path):
+    clock = FakeClock()
+    mgr, reg, rec, goodput, tsdb, rule, ev = _manager(tmp_path, clock)
+    tsdb.tick()
+    path = mgr.write_bundle(rule, clock(), 0.1)
+    # no tmp litter, and every listed incident-* dir is complete
+    assert not [p for p in os.listdir(tmp_path)
+                if p.startswith(".incident-tmp-")]
+    for name in _bundles(tmp_path):
+        assert os.path.isfile(
+            os.path.join(tmp_path, name, "meta.json"))
+    bundle = obs.read_bundle(path)
+    meta = bundle["meta"]
+    assert meta["schema"] == obs.BUNDLE_SCHEMA
+    assert meta["alert"] == "goodput_page"
+    assert meta["errors"] == {}
+    for rel in ("alert.json", "journal.jsonl", "tsdb.json",
+                "profile.folded", "profile.json", "statz.json"):
+        assert rel in meta["files"], rel
+        assert rel in bundle
+    assert bundle["tsdb.json"]["schema"] == obs.TSDB_SNAPSHOT_SCHEMA
+    assert bundle["profile.json"]["schema"] == obs.PROFILE_SCHEMA
+    assert bundle["statz.json"] == {"pending": 3}
+    # the tpu_serve_* core set made it into the snapshot
+    assert any(s["name"] == "tpu_serve_goodput"
+               for s in bundle["tsdb.json"]["series"])
+    # accounting: counter child + journal event
+    assert 'tpu_incident_bundles_total{alert="goodput_page"} 1' \
+        in reg.render()
+    events = rec.events(name=obs.INCIDENT_EVENT)
+    assert len(events) == 1
+    assert events[0]["attrs"]["alert"] == "goodput_page"
+
+
+def test_read_bundle_rejects_partial_and_foreign(tmp_path):
+    incomplete = tmp_path / "incident-x-1"
+    incomplete.mkdir()
+    with pytest.raises(ValueError, match="no meta.json"):
+        obs.read_bundle(str(incomplete))
+    (incomplete / "meta.json").write_text(json.dumps({"schema": "nope"}))
+    with pytest.raises(ValueError, match="unknown bundle schema"):
+        obs.read_bundle(str(incomplete))
+
+
+def test_schema_round_trips_through_obs_query(tmp_path, capsys):
+    from tools import obs_query
+
+    clock = FakeClock()
+    mgr, reg, rec, goodput, tsdb, rule, ev = _manager(tmp_path, clock)
+    tsdb.tick()
+    goodput.set(0.1)
+    clock.advance(5.0)
+    tsdb.tick()
+    ev.evaluate()  # journal the real inactive->pending->firing history
+    path = mgr.write_bundle(rule, clock(), 0.1)
+    assert obs_query.main(["--incident", path]) == 0
+    out = capsys.readouterr().out
+    assert "alert=goodput_page severity=page" in out
+    assert "pending -> firing" in out
+    assert "phase dispatch" in out
+    # JSON mode round-trips the whole bundle
+    assert obs_query.main(["--incident", path, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["meta"]["alert"] == "goodput_page"
+    # a non-bundle dir is a clean failure, not a traceback
+    assert obs_query.main(["--incident", str(tmp_path)]) == 2
+
+
+# -- trigger path -----------------------------------------------------------
+
+def test_firing_transition_triggers_one_bundle(tmp_path):
+    """The full chain, no worker thread: collapse the gauge, evaluate,
+    drain the queue synchronously, find exactly one bundle."""
+    clock = FakeClock()
+    mgr, reg, rec, goodput, tsdb, rule, ev = _manager(tmp_path, clock)
+    ev.evaluate()  # healthy: nothing enqueued
+    assert mgr._queue.empty()
+    goodput.set(0.1)
+    clock.advance(5.0)
+    tsdb.tick()
+    ev.evaluate()
+    item = mgr._queue.get_nowait()
+    assert item is not None and item[0].name == "goodput_page"
+    mgr.write_bundle(*item)
+    assert len(_bundles(tmp_path)) == 1
+
+
+def test_worker_thread_writes_bundle(tmp_path):
+    clock = FakeClock()
+    mgr, reg, rec, goodput, tsdb, rule, ev = _manager(tmp_path, clock)
+    mgr.start()
+    try:
+        goodput.set(0.1)
+        clock.advance(5.0)
+        tsdb.tick()
+        ev.evaluate()
+        deadline = time.time() + 5.0
+        while time.time() < deadline and not _bundles(tmp_path):
+            time.sleep(0.01)
+        assert len(_bundles(tmp_path)) == 1
+    finally:
+        mgr.stop()
+
+
+def test_rate_limit_is_per_alert(tmp_path):
+    clock = FakeClock()
+    mgr, reg, rec, goodput, tsdb, rule, ev = _manager(
+        tmp_path, clock, min_interval_s=300.0)
+    mgr._on_transition(rule, "pending", "firing", clock(), 0.1)
+    clock.advance(10.0)  # inside the interval: suppressed
+    mgr._on_transition(rule, "pending", "firing", clock(), 0.1)
+    assert mgr._queue.qsize() == 1
+    clock.advance(300.0)  # past the interval: allowed again
+    mgr._on_transition(rule, "pending", "firing", clock(), 0.1)
+    assert mgr._queue.qsize() == 2
+    # a DIFFERENT page alert is not throttled by this one's window
+    other = obs.threshold_rule(
+        "other_page", "tpu_serve_goodput", "<", 0.5,
+        for_s=0.0, severity="page")
+    mgr._on_transition(other, "pending", "firing", clock(), 0.1)
+    assert mgr._queue.qsize() == 3
+
+
+def test_non_page_and_non_firing_transitions_ignored(tmp_path):
+    clock = FakeClock()
+    mgr, reg, rec, goodput, tsdb, rule, ev = _manager(tmp_path, clock)
+    ticket = obs.threshold_rule(
+        "just_a_ticket", "tpu_serve_goodput", "<", 0.5,
+        for_s=0.0, severity="ticket")
+    mgr._on_transition(ticket, "pending", "firing", clock(), 0.1)
+    mgr._on_transition(rule, "firing", "resolved", clock(), 0.9)
+    assert mgr._queue.empty()
+
+
+# -- degradation ------------------------------------------------------------
+
+def test_broken_collector_degrades_to_error_marker(tmp_path):
+    clock = FakeClock()
+    reg, rec, goodput, tsdb, rule, ev, prof = _stack(clock)
+
+    def broken():
+        raise RuntimeError("replica unreachable")
+
+    mgr = obs.IncidentManager(
+        str(tmp_path), ev, registry=reg, recorder=rec, tsdb=tsdb,
+        profiler=prof,
+        collectors={"statz.json": lambda: {"ok": 1},
+                    "traces.json": broken},
+        now_fn=clock)
+    path = mgr.write_bundle(rule, clock(), 0.1)
+    meta = obs.read_bundle(path)["meta"]
+    assert "statz.json" in meta["files"]
+    assert "traces.json" not in meta["files"]
+    assert "RuntimeError" in meta["errors"]["traces.json"]
+
+
+def test_extra_files_nest_and_failures_are_contained(tmp_path):
+    clock = FakeClock()
+    reg, rec, goodput, tsdb, rule, ev, prof = _stack(clock)
+    mgr = obs.IncidentManager(
+        str(tmp_path), ev, registry=reg, recorder=rec,
+        extra_files_fn=lambda: {
+            "replicas/rep-0/statz.json": {"pending": 1},
+            "replicas/rep-1/statz.json": {"unreachable": True,
+                                          "error": "connection refused"},
+        },
+        now_fn=clock)
+    bundle = obs.read_bundle(mgr.write_bundle(rule, clock(), 0.1))
+    assert bundle["replicas/rep-0/statz.json"] == {"pending": 1}
+    assert bundle["replicas/rep-1/statz.json"]["unreachable"] is True
+
+
+# -- GC ---------------------------------------------------------------------
+
+def test_gc_keeps_newest_and_spares_foreign_files(tmp_path):
+    clock = FakeClock()
+    mgr, reg, rec, goodput, tsdb, rule, ev = _manager(
+        tmp_path, clock, keep=2, min_interval_s=0.0)
+    (tmp_path / "oncall-notes.md").write_text("it was DNS\n")
+    foreign = tmp_path / "some-other-dir"
+    foreign.mkdir()
+    paths = []
+    for _ in range(4):
+        clock.advance(1.0)
+        p = mgr.write_bundle(rule, clock(), 0.1)
+        paths.append(p)
+        # mtime granularity: make ordering unambiguous for the GC
+        stamp = clock()
+        os.utime(p, (stamp, stamp))
+    kept = _bundles(tmp_path)
+    assert len(kept) == 2
+    assert os.path.basename(paths[-1]) in kept
+    assert os.path.basename(paths[-2]) in kept
+    assert (tmp_path / "oncall-notes.md").exists()
+    assert foreign.exists()
+
+
+def test_keep_validation():
+    with pytest.raises(ValueError):
+        obs.IncidentManager(
+            "/tmp/x", obs.AlertEvaluator(
+                obs.TSDB(obs.Registry()), []),
+            registry=obs.Registry(), keep=0)
+
+
+# -- metrics ----------------------------------------------------------------
+
+def test_incident_metrics_are_promlint_clean(tmp_path):
+    from tools.promlint import lint
+
+    clock = FakeClock()
+    mgr, reg, rec, goodput, tsdb, rule, ev = _manager(tmp_path, clock)
+    mgr.write_bundle(rule, clock(), 0.1)
+    for om in (False, True):
+        problems = lint(reg.render(openmetrics=om), openmetrics=om)
+        assert problems == [], problems

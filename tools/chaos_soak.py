@@ -1419,6 +1419,200 @@ def episode_fleet_burn_alert(tmp, seed):
           "fleet settled at/above the floor after recovery")
 
 
+def episode_fleet_incident_bundle(seed):
+    """Episode 16 (PR 19): SIGKILL a replica mid-burst; the page that
+    follows must make the router's flight data recorder write ONE
+    fleet incident bundle — with the DEAD replica's fragment degraded
+    to its ``{"unreachable": true}`` marker (the bundle fan-out must
+    not wedge on a corpse), the survivor's fragment real, and the
+    incident subscriber still alive afterwards (``/alerts`` answers,
+    traffic still proxies 200)."""
+    import http.client
+    import json
+    import os
+    import subprocess
+    import sys
+    import threading
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_k8s_device_plugin.workloads.bench_serving import (
+        _free_port,
+        _wait_http_ok,
+    )
+    from tpu_k8s_device_plugin.workloads.inference import make_decoder
+    from tpu_k8s_device_plugin.workloads.router import (
+        RouterServer,
+        affinity_key,
+    )
+    from tpu_k8s_device_plugin.workloads.server import EngineServer
+    from tpu_k8s_device_plugin.workloads.serving import ServingEngine
+
+    incident_dir = tempfile.mkdtemp(prefix=f"tpu-chaos-ep16-{seed}-")
+    # class 'bad' can never meet its 1ms deadline: once the post-kill
+    # traffic lands on the survivor the fleet burn gauge pages
+    policies = {
+        "bad": obs.SLOPolicy("bad", deadline_ms=1.0),
+        "good": obs.SLOPolicy("good", deadline_ms=60000.0),
+    }
+    # replica_ttl 30s: the victim's row must STILL be in the table
+    # when the bundle fans out, so the fragment fetch proves the
+    # unreachable-marker path rather than skipping the dead replica
+    rt = RouterServer(statz_interval_s=0.25, replica_ttl_s=30.0,
+                      breaker_reset_s=0.5, seed=seed,
+                      slo_policies=policies,
+                      alert_interval_s=0.25,
+                      alert_window_scale=0.0005,
+                      incident_dir=incident_dir)
+    rt.start(host="127.0.0.1", port=0)
+
+    # survivor: in-process tiny engine registered as replica-a, with
+    # the SLO accountant live so its /statz publishes the burn the
+    # router rolls up into tpu_router_fleet_burn_rate
+    model = make_decoder(vocab=128, d_model=64, n_heads=4, n_layers=2,
+                         d_ff=128, max_len=256, dtype=jnp.float32)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+    params = model.init(jax.random.PRNGKey(0), tokens, pos)["params"]
+    eng = ServingEngine(model, params, n_slots=2)
+    survivor = EngineServer(eng, max_new_tokens=200, window=4,
+                            slo_policies=policies, slo_window_s=30.0)
+    survivor.start(host="127.0.0.1", port=0)
+    survivor.start_registration(
+        f"http://127.0.0.1:{rt.port}", replica_id="replica-a",
+        model="chaos-tiny", interval_s=0.3)
+
+    # victim: a REAL replica subprocess; max_len 2048 so the burst
+    # stream still has seconds of decode left when the SIGKILL lands
+    victim_port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    victim = subprocess.Popen(
+        [sys.executable, "-m",
+         "tpu_k8s_device_plugin.workloads.server",
+         "--config", "tiny", "--n-slots", "2", "--max-len", "2048",
+         "--max-new-tokens", "2000", "--window", "4",
+         "--host", "127.0.0.1", "--port", str(victim_port),
+         "--register-with", f"http://127.0.0.1:{rt.port}",
+         "--replica-id", "replica-b", "--register-interval", "0.3"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    try:
+        _wait_http_ok(victim_port, "/healthz", 600)
+        _wait_http_ok(
+            rt.port, "/replicas", 30,
+            lambda b: sum(r["healthy"] for r in b["replicas"]) >= 2)
+        check(True, "router sees both replicas healthy")
+
+        def post(body):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{rt.port}/generate",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return resp.status, resp.read()
+
+        # one long stream pinned to the victim via the affinity ring,
+        # so the SIGKILL lands mid-burst, not on an idle replica
+        rng = random.Random(seed)
+        p_victim = None
+        while p_victim is None:
+            cand = [rng.randrange(1, 128) for _ in range(32)]
+            if rt.affinity_target(
+                    affinity_key({"tokens": cand}, 32)) == "replica-b":
+                p_victim = cand
+
+        streaming = threading.Event()
+
+        def burst():
+            conn = http.client.HTTPConnection("127.0.0.1", rt.port,
+                                              timeout=120)
+            try:
+                conn.request("POST", "/generate", json.dumps(
+                    {"tokens": p_victim, "max_new_tokens": 1500,
+                     "ignore_eos": True, "slo_class": "good"}),
+                    {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                for line in resp:
+                    if line.strip():
+                        streaming.set()
+            # tpulint: disable=R2 -- the SIGKILL is SUPPOSED to abort this stream mid-chunk; the episode's assertions live in the incident bundle, not in this thread's outcome
+            except Exception:
+                pass
+            finally:
+                conn.close()
+
+        t = threading.Thread(target=burst, daemon=True)
+        t.start()
+        check(streaming.wait(60.0),
+              "victim-pinned stream is live before the kill")
+        victim.kill()
+        victim.wait()
+        t.join(timeout=30.0)
+        check(not t.is_alive(), "aborted stream drained, not hung")
+
+        # goodput collapse on the survivor: every 'bad' request fails
+        # over to replica-a and misses its 1ms deadline
+        for _ in range(4):
+            st, _ = post({"tokens": [1, 2, 3], "max_new_tokens": 4,
+                          "slo_class": "bad"})
+            check(st == 200,
+                  f"post-kill 'bad' request failed over 200 (got {st})")
+
+        # the fleet bundle materializes (page -> subscriber -> write)
+        deadline = time.time() + 45.0
+        bundles = []
+        while time.time() < deadline and not bundles:
+            bundles = [p for p in os.listdir(incident_dir)
+                       if p.startswith(obs.BUNDLE_PREFIX)]
+            time.sleep(0.2)
+        check(len(bundles) == 1,
+              f"exactly one fleet incident bundle (got {bundles}, "
+              f"dir {os.listdir(incident_dir)})")
+        bundle = obs.read_bundle(os.path.join(incident_dir, bundles[0]))
+        meta = bundle["meta"]
+        check(meta["severity"] == "page"
+              and meta["alert"].startswith("slo_burn_page"),
+              f"bundle is for the page ({meta['alert']}, "
+              f"{meta['severity']})")
+        dead = bundle.get("replicas/replica-b/statz.json")
+        check(isinstance(dead, dict) and dead.get("unreachable") is True,
+              f"dead replica's fragment degraded to the unreachable "
+              f"marker (got {dead!r})")
+        live = bundle.get("replicas/replica-a/statz.json")
+        check(isinstance(live, dict) and "unreachable" not in live,
+              "survivor's statz fragment is real")
+        check(any("burn_rate" in s["name"] and s["points"]
+                  for s in bundle["tsdb.json"]["series"]),
+              "bundle's TSDB snapshot retained the burn series")
+
+        # the subscriber is NOT wedged: the evaluator still serves
+        # /alerts, the worker thread survives, traffic still proxies
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{rt.port}/alerts",
+                timeout=30) as resp:
+            status = json.loads(resp.read().decode())
+        check(any(a["name"] == meta["alert"] and a["state"] == "firing"
+                  for a in status["alerts"]),
+              "router /alerts still answering after the bundle")
+        assert rt._incidents is not None
+        check(rt._incidents._worker is not None
+              and rt._incidents._worker.is_alive(),
+              "incident worker thread alive after the bundle")
+        st, _ = post({"tokens": [5, 6, 7], "max_new_tokens": 4,
+                      "slo_class": "good"})
+        check(st == 200, f"router still proxying 200 (got {st})")
+    finally:
+        if victim.poll() is None:
+            victim.kill()
+        survivor.stop()
+        rt.stop()
+        shutil.rmtree(incident_dir, ignore_errors=True)
+
+
 def _reshape_slice(tmp, testdata, seed, suffix, grace, hb_timeout):
     """A dedicated 2-host slice with live staleness + reshape grace (the
     main soak coordinator drives heartbeats manually with no timeout, so
@@ -1683,6 +1877,9 @@ def main(argv=None) -> int:
             log.info("=== episode 15: burn-rate page alert through "
                      "a replica kill ===")
             episode_fleet_burn_alert(tmp, args.seed)
+            log.info("=== episode 16: SIGKILL mid-burst writes the "
+                     "fleet incident bundle ===")
+            episode_fleet_incident_bundle(args.seed)
         # -- final convergence sweep ----------------------------------
         for h in hosts:
             h.pulse()

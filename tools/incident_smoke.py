@@ -1,0 +1,215 @@
+#!/usr/bin/env python3
+"""incident-smoke: end-to-end acceptance check for incident bundles.
+
+The alert-smoke scenario (a REAL serving subprocess, an SLO class
+whose 1ms deadline no request can meet, shrunken burn-rate windows)
+extended through the PR-19 flight data recorder: when the page fires,
+the server must write exactly ONE schema-complete incident bundle to
+``--incident-dir``, with no human in the loop —
+
+  1. the page alert reaches ``firing`` and exactly one
+     ``incident-<alert>-*`` directory materializes (atomically: no
+     ``.incident-tmp-*`` litter, meta.json present),
+  2. the bundle is self-contained: alert transition history, full
+     flight-recorder journal, TSDB snapshot with the burn-rate series,
+     a continuous-profile slice, and stitched spans for at least one
+     SLO-missed request,
+  3. the profile proves the recorder was ALREADY running when the
+     incident started: at least one profile sample is timestamped
+     before the firing transition,
+  4. ``tools/obs_query.py --incident DIR`` renders the bundle offline
+     and exits 0.
+
+CI runs this in the ``metrics-lint`` job; also runnable by hand:
+
+    JAX_PLATFORMS=cpu python tools/incident_smoke.py
+"""
+# tpulint: disable-file=R1 -- smoke DRIVER: single-shot requests against a subprocess it just started; a failure IS the test failing, retries would only blur which layer lost the bundle
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tpu_k8s_device_plugin import obs                # noqa: E402
+
+ALERT_INTERVAL_S = 0.5
+WINDOW_SCALE = 0.0005  # 5m/1h/6h -> 0.15s / 1.8s / 10.8s
+PAGE_ALERT = "slo_burn_page_bad"
+
+_SERVER_PROG = """
+import json, sys
+sys.path.insert(0, {repo!r})
+import jax, jax.numpy as jnp
+from tpu_k8s_device_plugin import obs
+from tpu_k8s_device_plugin.workloads.inference import make_decoder
+from tpu_k8s_device_plugin.workloads.server import EngineServer
+from tpu_k8s_device_plugin.workloads.serving import ServingEngine
+
+model = make_decoder(vocab=128, d_model=64, n_heads=4, n_layers=2,
+                     d_ff=128, max_len=64, dtype=jnp.float32)
+tokens = jnp.zeros((1, 8), jnp.int32)
+pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+params = model.init(jax.random.PRNGKey(0), tokens, pos)["params"]
+eng = ServingEngine(model, params, n_slots=2)
+# class 'bad' can never meet its 1ms deadline: every request misses,
+# burn = 1/(1-0.99) = 100x the moment traffic lands on it
+policies = {{
+    "bad": obs.SLOPolicy("bad", deadline_ms=1.0),
+    "good": obs.SLOPolicy("good", deadline_ms=60000.0),
+}}
+srv = EngineServer(eng, max_new_tokens=4, window=2,
+                   slo_policies=policies, slo_window_s=3.0,
+                   alert_interval_s={interval!r},
+                   alert_window_scale={scale!r},
+                   incident_dir={incident_dir!r})
+srv.start(host="127.0.0.1", port=0)
+print(json.dumps({{"port": srv.port}}), flush=True)
+import threading
+threading.Event().wait()
+"""
+
+
+def _get_json(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _alert(status, name):
+    for a in status["alerts"]:
+        if a["name"] == name:
+            return a
+    raise AssertionError(f"{name} missing from /alerts: "
+                         f"{[a['name'] for a in status['alerts']]}")
+
+
+def _wait_for_state(port, name, want, timeout_s):
+    deadline = time.time() + timeout_s
+    state = None
+    while time.time() < deadline:
+        state = _alert(_get_json(port, "/alerts"), name)["state"]
+        if state == want:
+            return
+        time.sleep(0.1)
+    raise AssertionError(
+        f"{name} never reached {want!r} (last state {state!r})")
+
+
+def _wait_for_bundle(incident_dir, timeout_s):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        bundles = [p for p in os.listdir(incident_dir)
+                   if p.startswith(obs.BUNDLE_PREFIX)]
+        if bundles:
+            return bundles
+        time.sleep(0.1)
+    raise AssertionError(
+        f"no incident bundle materialized in {incident_dir} "
+        f"({os.listdir(incident_dir)})")
+
+
+def main() -> int:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    incident_dir = tempfile.mkdtemp(prefix="tpu-incident-smoke-")
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         _SERVER_PROG.format(repo=REPO, interval=ALERT_INTERVAL_S,
+                             scale=WINDOW_SCALE,
+                             incident_dir=incident_dir)],
+        stdout=subprocess.PIPE, text=True, env=env)
+    try:
+        port = json.loads(proc.stdout.readline())["port"]
+        print(f"server up on :{port}, incident dir {incident_dir}")
+
+        # the continuous profiler is live BEFORE any trouble: its
+        # /debug/pprof surface already serves the schema
+        prof = _get_json(port, "/debug/pprof?format=json")
+        assert prof["schema"] == "tpu-profile/v1", prof["schema"]
+
+        # synthetic goodput collapse: every 'bad' request misses its
+        # 1ms deadline, so the class burns at 100x from request one
+        for _ in range(4):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=json.dumps({"tokens": [1, 2, 3],
+                                 "slo_class": "bad"}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                assert resp.status == 200
+                resp.read()
+        print("1. collapse traffic sent (4 guaranteed SLO misses)")
+
+        _wait_for_state(port, PAGE_ALERT, "firing", timeout_s=20.0)
+        print("2. page alert firing")
+
+        # exactly ONE bundle, atomically placed (no tmp litter, and
+        # read_bundle validates meta.json + schema below)
+        bundles = _wait_for_bundle(incident_dir, timeout_s=15.0)
+        assert len(bundles) == 1, bundles
+        assert not [p for p in os.listdir(incident_dir)
+                    if p.startswith(".incident-tmp-")]
+        bundle_dir = os.path.join(incident_dir, bundles[0])
+        bundle = obs.read_bundle(bundle_dir)
+        meta = bundle["meta"]
+        assert meta["alert"] == PAGE_ALERT
+        assert meta["severity"] == "page"
+        assert meta["errors"] == {}, meta["errors"]
+        for rel in ("alert.json", "journal.jsonl", "tsdb.json",
+                    "profile.folded", "profile.json", "statz.json",
+                    "traces.json"):
+            assert rel in meta["files"], (rel, meta["files"])
+        print(f"3. one schema-complete bundle: {bundles[0]}")
+
+        # the bundle carries the firing transition in its own history
+        firing = [t for t in bundle["alert.json"]["transitions"]
+                  if t["attrs"].get("alert") == PAGE_ALERT
+                  and t["attrs"].get("state_to") == "firing"]
+        assert firing, bundle["alert.json"]["transitions"]
+        fired_at = firing[0]["attrs"]["at"]
+
+        # TSDB snapshot retained the burn series that paged
+        burn = [s for s in bundle["tsdb.json"]["series"]
+                if "burn_rate" in s["name"] and s["points"]]
+        assert burn, [s["name"] for s in bundle["tsdb.json"]["series"]]
+
+        # the flight data recorder was already running: at least one
+        # profile sample predates the firing transition
+        prof = bundle["profile.json"]
+        assert prof["samples"] > 0, prof
+        early = [sec for sec, n in prof["timeline"]
+                 if n > 0 and sec < fired_at]
+        assert early, (prof["timeline"], fired_at)
+        print(f"4. profile has samples from {fired_at - early[0]:.1f}s "
+              f"before the firing transition")
+
+        # stitched spans for at least one SLO-missed request
+        misses = bundle["traces.json"]["misses"]
+        assert misses and misses[0]["events"], misses
+        tree = obs.stitch(misses[0]["events"])
+        assert tree, misses[0]
+        print(f"5. {len(misses)} SLO-missed trace(s) with spans")
+
+        # offline render: the on-call's first command must just work
+        rc = subprocess.call(
+            [sys.executable, os.path.join(REPO, "tools/obs_query.py"),
+             "--incident", bundle_dir])
+        assert rc == 0, f"obs_query --incident exited {rc}"
+        print("6. obs_query --incident rendered the bundle, exit 0")
+        print("incident-smoke: PASS")
+        return 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,12 @@ Examples::
     # (GET /debug/query) + the firing-alert table (GET /alerts)
     python tools/obs_query.py --watch --endpoint http://rep0:8000
 
+    # render an alert-triggered incident bundle offline: alert
+    # timeline, burn sparkline, top profile stacks by phase, and the
+    # stitched span trees of the slowest SLO-missed requests
+    python tools/obs_query.py --incident \
+        /var/lib/tpu-incidents/incident-slo_burn_page_chat-1754300612000
+
 Dependency-free (stdlib + the stdlib-only ``obs`` package), like
 every tool in this repo.
 """
@@ -42,6 +48,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shutil
 import sys
 import time
 from typing import Callable, Dict, List, Optional
@@ -231,6 +238,16 @@ def render_replay_report(path: str, top: int,
 
 SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
 
+# severity colors for TTY watch frames (PR 19): page red, ticket
+# yellow — everything else stays uncolored; NO_COLOR opts out
+_SEV_COLOR = {"page": "\x1b[31m", "ticket": "\x1b[33m"}
+_RESET = "\x1b[0m"
+
+
+def _colorize(text: str, severity: str, color: bool) -> str:
+    code = _SEV_COLOR.get(severity) if color else None
+    return f"{code}{text}{_RESET}" if code else text
+
 # the serving surface's vital signs; families a surface lacks just
 # render "(no data)", so the same default set works against the
 # router and the exporter too
@@ -270,11 +287,17 @@ def _series_label(labels: Dict[str, object]) -> str:
 
 
 def render_watch_frame(queries: List[Dict[str, object]],
-                       alerts: Optional[dict]) -> str:
+                       alerts: Optional[dict],
+                       width: Optional[int] = None,
+                       color: bool = False) -> str:
     """One watch frame as text: per-expr sparklines over the
     /debug/query payloads, then the alert table (every rule NOT
     inactive, severity first).  Pure — the watch test feeds it
-    captured payloads and pins the rendering."""
+    captured payloads and pins the rendering; *width* sizes the
+    sparklines (None keeps the historical 48) and *color* wraps
+    page/ticket alert rows in ANSI red/yellow (both default off so
+    the pinned rendering is unchanged)."""
+    spark_w = 48 if width is None else max(8, width - 54)
     lines: List[str] = []
     for q in queries:
         expr = str(q.get("expr", ""))
@@ -294,7 +317,7 @@ def render_watch_frame(queries: List[Dict[str, object]],
             labels = s.get("labels")
             labels = labels if isinstance(labels, dict) else {}
             lines.append(f"  {_series_label(labels) or '(all)':24s} "
-                         f"{sparkline(values)}")
+                         f"{sparkline(values, width=spark_w)}")
     rows = []
     if isinstance(alerts, dict):
         for a in alerts.get("alerts") or []:
@@ -316,10 +339,11 @@ def render_watch_frame(queries: List[Dict[str, object]],
             value = a.get("value")
             vtxt = f"{value:.4g}" \
                 if isinstance(value, (int, float)) else "-"
-            lines.append(
-                f"{str(a.get('name', '')):32s} "
-                f"{str(a.get('severity', '')):8s} "
-                f"{str(a.get('state', '')):8s} {vtxt:>10s}  {age}")
+            row = (f"{str(a.get('name', '')):32s} "
+                   f"{str(a.get('severity', '')):8s} "
+                   f"{str(a.get('state', '')):8s} {vtxt:>10s}  {age}")
+            lines.append(_colorize(row, str(a.get("severity", "")),
+                                   color))
     else:
         lines.append("no pending or firing alerts")
     return "\n".join(lines)
@@ -331,8 +355,16 @@ def watch(endpoint: str, exprs: List[str], range_s: float,
           out: Callable[[str], None] = print) -> int:
     """Poll one endpoint's /debug/query + /alerts and render frames
     until *iterations* run out (0 = forever).  Exit 0 once at least
-    one frame rendered real data (a series or an alert payload)."""
+    one frame rendered real data (a series or an alert payload).
+
+    On a TTY the sparklines stretch to the terminal width and
+    page/ticket alert rows go red/yellow; piped output keeps the
+    fixed-width, colorless rendering (and NO_COLOR disables color
+    even on a TTY, per the convention)."""
     base = endpoint.rstrip("/")
+    tty = sys.stdout.isatty()
+    width = shutil.get_terminal_size().columns if tty else None
+    color = tty and not os.environ.get("NO_COLOR")
     saw_data = False
     i = 0
     while True:
@@ -352,11 +384,167 @@ def watch(endpoint: str, exprs: List[str], range_s: float,
         stamp = time.strftime("%H:%M:%S")
         out(f"-- {base} @ {stamp} "
             f"(range {range_s:g}s, every {interval_s:g}s)")
-        out(render_watch_frame(queries, alerts))
+        out(render_watch_frame(queries, alerts, width=width,
+                               color=color))
         i += 1
         if iterations and i >= iterations:
             return 0 if saw_data else 1
         time.sleep(interval_s)
+
+
+# -- incident bundles (PR 19): offline bundle rendering ---------------------
+
+
+def _incident_timeline(bundle: Dict[str, object]) -> List[str]:
+    """The alert's transition history, oldest first: when it went
+    pending, when it started firing, what the value was each time."""
+    lines: List[str] = []
+    alert_doc = bundle.get("alert.json")
+    trans = alert_doc.get("transitions") \
+        if isinstance(alert_doc, dict) else None
+    rows = [t for t in trans if isinstance(t, dict)] \
+        if isinstance(trans, list) else []
+    rows.sort(key=lambda t: _f((t.get("attrs") or {}).get("at"))
+              if isinstance(t.get("attrs"), dict) else 0.0)
+    for t in rows:
+        a = t.get("attrs")
+        a = a if isinstance(a, dict) else {}
+        value = a.get("value")
+        vtxt = f" value={value:.4g}" \
+            if isinstance(value, (int, float)) else ""
+        lines.append(f"  {_f(a.get('at')):.3f}  "
+                     f"{a.get('alert')}: {a.get('state_from')} -> "
+                     f"{a.get('state_to')}{vtxt}")
+    return lines or ["  (no transitions recorded)"]
+
+
+def _incident_burn(bundle: Dict[str, object]) -> List[str]:
+    """Sparkline every burn-rate series the TSDB snapshot retained —
+    the shape of the burn curve is the first thing the page runbook
+    asks for."""
+    doc = bundle.get("tsdb.json")
+    series = doc.get("series") if isinstance(doc, dict) else None
+    lines: List[str] = []
+    for s in series if isinstance(series, list) else []:
+        if not isinstance(s, dict):
+            continue
+        name = str(s.get("name", ""))
+        if "burn_rate" not in name:
+            continue
+        pts = s.get("points")
+        pts = pts if isinstance(pts, list) else []
+        values = [p[1] for p in pts
+                  if isinstance(p, (list, tuple)) and len(p) == 2
+                  and isinstance(p[1], (int, float))]
+        labels = s.get("labels")
+        labels = labels if isinstance(labels, dict) else {}
+        lines.append(f"  {name}{_series_label(labels)}")
+        lines.append(f"    {sparkline(values)}")
+    return lines or ["  (no burn-rate series in the snapshot)"]
+
+
+def _incident_stacks(bundle: Dict[str, object],
+                     per_phase: int = 5) -> List[str]:
+    """Top continuous-profile stacks grouped by scheduler phase:
+    where the process actually spent its time in the minutes before
+    the page."""
+    doc = bundle.get("profile.json")
+    stacks = doc.get("stacks") if isinstance(doc, dict) else None
+    by_phase: Dict[str, List[dict]] = {}
+    for s in stacks if isinstance(stacks, list) else []:
+        if isinstance(s, dict):
+            by_phase.setdefault(str(s.get("phase", "")), []).append(s)
+    lines: List[str] = []
+    for phase in sorted(by_phase):
+        rows = sorted(by_phase[phase],
+                      key=lambda s: -_f(s.get("count")))
+        total = sum(_f(s.get("count")) for s in rows)
+        lines.append(f"  phase {phase} ({total:g} samples):")
+        for s in rows[:per_phase]:
+            stack = str(s.get("stack", ""))
+            leaf = stack.rsplit(";", 2)[-2:]
+            lines.append(f"    {_f(s.get('count')):6g}  "
+                         f"{';'.join(leaf)}")
+    if isinstance(doc, dict):
+        lines.append(f"  ({doc.get('samples')} samples over "
+                     f"{doc.get('seconds')}s at {doc.get('hz')}hz, "
+                     f"overhead {_f(doc.get('overhead_ratio')):.2%})")
+    return lines or ["  (no profile in the bundle)"]
+
+
+def _incident_misses(bundle: Dict[str, object]) -> List[str]:
+    """Stitched span trees of the slowest SLO-missed requests the
+    bundle captured — per-miss latency attribution without a live
+    endpoint."""
+    doc = bundle.get("traces.json")
+    misses = doc.get("misses") if isinstance(doc, dict) else None
+    lines: List[str] = []
+    for m in misses if isinstance(misses, list) else []:
+        if not isinstance(m, dict):
+            continue
+        lines.append(f"  -- {m.get('rid')} "
+                     f"class={m.get('slo_class')} "
+                     f"outcome={m.get('outcome')} "
+                     f"total={_f(m.get('duration_s')) * 1000:.1f}ms "
+                     f"trace={str(m.get('trace_id'))[:16]}")
+        events = m.get("events")
+        if isinstance(events, list) and events:
+            tree = obs.stitch([e for e in events
+                               if isinstance(e, dict)])
+            lines.extend("  " + ln for ln in
+                         obs.render_tree(tree).splitlines())
+    return lines or ["  (no SLO-missed traces in the bundle)"]
+
+
+def render_incident(dir_path: str, as_json: bool) -> int:
+    """Render one incident bundle directory offline: meta header,
+    alert timeline, burn sparkline, top profile stacks by phase, then
+    the stitched trees of the slowest SLO-missed requests.  Exit 0 on
+    a schema-valid bundle, 2 otherwise."""
+    try:
+        bundle = obs.read_bundle(dir_path)
+    except (OSError, ValueError) as e:
+        print(f"obs_query: {e}", file=sys.stderr)
+        return 2
+    if as_json:
+        print(json.dumps(bundle, indent=2, default=str))
+        return 0
+    meta = bundle["meta"]
+    print(f"incident {os.path.basename(dir_path.rstrip(os.sep))}")
+    print(f"  alert={meta.get('alert')} "
+          f"severity={meta.get('severity')} "
+          f"state={meta.get('state_to')} at={_f(meta.get('at')):.3f}")
+    value = meta.get("value")
+    if isinstance(value, (int, float)):
+        print(f"  value at transition: {value:.6g}")
+    print(f"  files: {', '.join(meta.get('files', []))}")
+    errors = meta.get("errors")
+    if isinstance(errors, dict) and errors:
+        for rel in sorted(errors):
+            print(f"  COLLECT ERROR {rel}: {errors[rel]}")
+    desc = meta.get("description")
+    if desc:
+        print(f"  {desc}")
+    print("\nalert timeline:")
+    print("\n".join(_incident_timeline(bundle)))
+    print("\nerror-budget burn:")
+    print("\n".join(_incident_burn(bundle)))
+    print("\ntop profile stacks by phase:")
+    print("\n".join(_incident_stacks(bundle)))
+    print("\nslowest SLO-missed requests:")
+    print("\n".join(_incident_misses(bundle)))
+    replicas = sorted({rel.split("/", 2)[1] for rel in bundle
+                       if rel.startswith("replicas/")
+                       and rel.count("/") >= 2})
+    if replicas:
+        print("\nfleet fragments:")
+        for rid in replicas:
+            statz = bundle.get(f"replicas/{rid}/statz.json")
+            mark = " UNREACHABLE" \
+                if isinstance(statz, dict) and statz.get("unreachable") \
+                else ""
+            print(f"  {rid}{mark}")
+    return 0
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -388,6 +576,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="per-endpoint fetch timeout (seconds)")
     p.add_argument("--json", action="store_true",
                    help="emit JSON instead of the text rendering")
+    p.add_argument("--incident", default=None, metavar="DIR",
+                   help="render an incident bundle directory "
+                        "(written by a firing page alert under "
+                        "--incident-dir) instead of querying "
+                        "endpoints")
     p.add_argument("--replay-report", default=None, metavar="FILE",
                    help="render the slowest SLO-missed requests of a "
                         "workloads.replay report (tpu-replay-report/"
@@ -412,6 +605,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="--watch frames to render before exiting "
                         "(0 = forever; tests use 1)")
     args = p.parse_args(argv)
+    if args.incident:
+        return render_incident(args.incident, args.json)
     if args.replay_report:
         return render_replay_report(args.replay_report, args.top,
                                     args.json)

@@ -626,6 +626,12 @@ class MetricDefinitionRule(Rule):
         # this reason; these raw forms never belong on a family
         "user", "user_id", "session", "session_id", "prompt",
         "tenant_id", "slo_class_raw",
+        # continuous profiler / incident bundles (PR 19): stacks and
+        # bundle identities are unbounded by construction — they live
+        # in the profiler ring and on disk, NEVER as label values (the
+        # profiler exports only bounded meta-metrics for this reason)
+        "stack", "frame", "func", "function", "thread", "thread_name",
+        "bundle", "bundle_id", "incident", "incident_id",
     }
     # tpu_slo_* label values (class/tenant) are only bounded because
     # SLOAccountant maps unknown names to 'other' before they touch a

@@ -120,6 +120,17 @@ def build_parser() -> argparse.ArgumentParser:
              f"stay on).  Env override: {constants.ENV_FLIGHT_RECORD_DIR}",
     )
     p.add_argument(
+        "--incident-dir", dest="incident_dir",
+        default=os.environ.get(constants.ENV_INCIDENT_DIR, ""),
+        metavar="DIR",
+        help="write alert-triggered incident bundles (alert history, "
+             "event journal, TSDB snapshot, continuous-profile slice) "
+             "under DIR when a page-severity alert starts firing on "
+             "the debug surface — mount a hostPath next to the "
+             "flight-record dir.  Empty disables (default).  Requires "
+             f"--debug-port.  Env override: {constants.ENV_INCIDENT_DIR}",
+    )
+    p.add_argument(
         "--fault-spec", dest="fault_spec",
         default=os.environ.get("TPU_DP_FAULTS", ""), metavar="SPEC",
         help="arm deterministic fault injection (chaos testing ONLY): "
@@ -324,8 +335,9 @@ def main(argv=None) -> int:
     debug_server = None
     if args.debug_port:
         from tpu_k8s_device_plugin.observability import DebugServer
-        debug_server = DebugServer(manager, args.debug_port,
-                                   host=args.debug_host).start()
+        debug_server = DebugServer(
+            manager, args.debug_port, host=args.debug_host,
+            incident_dir=args.incident_dir or None).start()
     # k8s sends SIGTERM on pod shutdown; route it through the same cleanup
     # path as Ctrl-C so streams get the stop signal and the endpoint socket
     # is unlinked (≈ main.go signal handling)

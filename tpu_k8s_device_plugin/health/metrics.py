@@ -154,7 +154,8 @@ class MetricsHTTPServer:
                  sysfs_root: str = "/sys", dev_root: str = "/dev",
                  host: str = "0.0.0.0",
                  alert_rules: Optional[list] = None,
-                 tick_interval_s: float = 15.0):
+                 tick_interval_s: float = 15.0,
+                 profiler_hz: float = 19.0):
         self._port = port
         self._host = host
         self._sysfs_root = sysfs_root
@@ -178,6 +179,10 @@ class MetricsHTTPServer:
                  else default_exporter_alert_rules())
         self.alerts = obs.AlertEvaluator(
             self.tsdb, rules, recorder=self.recorder)
+        # continuous sampling profiler (PR 19): the exporter is mostly
+        # idle, but a probe walk wedged on sysfs shows up here
+        self.profiler = obs.SamplingProfiler(
+            self.registry, hz=profiler_hz)
 
     def _refresh(self) -> None:
         with self._lock:
@@ -212,6 +217,16 @@ class MetricsHTTPServer:
                         self._send(400, "text/plain", f"{e}\n")
                         return
                     self._send(200, "application/json", body + "\n")
+                    return
+                if parts.path == "/debug/pprof":
+                    from urllib.parse import parse_qs
+                    try:
+                        ctype, body = outer.profiler.handle_pprof(
+                            parse_qs(parts.query))
+                    except ValueError as e:
+                        self._send(400, "text/plain", f"{e}\n")
+                        return
+                    self._send(200, ctype, body)
                     return
                 if parts.path != "/metrics":
                     self._send(404, "text/plain", "not found\n")
@@ -254,12 +269,14 @@ class MetricsHTTPServer:
         threading.Thread(target=self._httpd.serve_forever,
                          name="metrics-http", daemon=True).start()
         self.tsdb.start(self._tick_interval_s)
+        self.profiler.start()
         log.info("prometheus metrics on http://%s:%d/metrics",
                  self._host, self.port)
         return self
 
     def stop(self) -> None:
         self.tsdb.stop()
+        self.profiler.stop()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -33,13 +33,28 @@ from .core import (
     Counter,
     Gauge,
     Histogram,
+    ProcessCollector,
     Registry,
     ScrapeMeta,
+    attach_process_collector,
     escape_help,
     escape_label_value,
     histogram_quantile,
     negotiate_openmetrics,
     parse_exposition,
+)
+from .incident import (
+    BUNDLE_PREFIX,
+    BUNDLE_SCHEMA,
+    INCIDENT_EVENT,
+    TSDB_SNAPSHOT_SCHEMA,
+    IncidentManager,
+    read_bundle,
+)
+from .profiler import (
+    PROFILE_SCHEMA,
+    SamplingProfiler,
+    fold_stack,
 )
 from .recorder import Event, FlightRecorder
 from .slo import (
@@ -66,15 +81,20 @@ from .tsdb import (
 
 __all__ = [
     "ALERT_TRANSITION_EVENT",
+    "BUNDLE_PREFIX",
+    "BUNDLE_SCHEMA",
     "FAST_BUCKETS_S",
+    "INCIDENT_EVENT",
     "LATENCY_BUCKETS_S",
     "OPENMETRICS_CONTENT_TYPE",
+    "PROFILE_SCHEMA",
     "SEVERITY_INFO",
     "SEVERITY_PAGE",
     "SEVERITY_TICKET",
     "SLOW_BUCKETS_S",
     "TEXT_CONTENT_TYPE",
     "TSDB",
+    "TSDB_SNAPSHOT_SCHEMA",
     "AlertCondition",
     "AlertEvaluator",
     "AlertRule",
@@ -83,12 +103,16 @@ __all__ = [
     "FlightRecorder",
     "Gauge",
     "Histogram",
+    "IncidentManager",
+    "ProcessCollector",
     "Registry",
     "SLOAccountant",
     "SLOPolicy",
+    "SamplingProfiler",
     "ScrapeMeta",
     "Span",
     "TraceContext",
+    "attach_process_collector",
     "burn_rate",
     "burn_rate_rules",
     "default_slo_policies",
@@ -97,6 +121,7 @@ __all__ = [
     "event_severity",
     "expr_metric_names",
     "flatten",
+    "fold_stack",
     "format_duration",
     "histogram_quantile",
     "load_alert_rules",
@@ -108,6 +133,7 @@ __all__ = [
     "parse_exposition",
     "parse_slo_specs",
     "parse_traceparent",
+    "read_bundle",
     "render_tree",
     "span",
     "stitch",

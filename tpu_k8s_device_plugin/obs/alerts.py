@@ -29,15 +29,26 @@ Alert *names* become label values, so they are bounded by the rule set
 from __future__ import annotations
 
 import json
+import logging
 import re
 import threading
 from dataclasses import dataclass, field
-from typing import Dict, Iterable, List, Mapping, Optional, Tuple
+from typing import (
+    Callable,
+    Dict,
+    Iterable,
+    List,
+    Mapping,
+    Optional,
+    Tuple,
+)
 
 from .core import Registry
 from .recorder import FlightRecorder
 from .slo import SLOPolicy
 from .tsdb import TSDB, Expr, format_duration, parse_expr
+
+log = logging.getLogger(__name__)
 
 # state machine positions (and their tpu_alert_state gauge coding)
 STATE_INACTIVE = "inactive"
@@ -297,6 +308,15 @@ class AlertEvaluator:
         self._recorder = recorder
         self._resolved_hold_s = float(resolved_hold_s)
         self._lock = threading.Lock()
+        # transition hooks (PR 19: incident bundles subscribe here).
+        # Transitions are queued under the lock and hooks fire AFTER
+        # it releases — a subscriber may call back into status()/
+        # firing() (which take the lock) without deadlocking, and a
+        # slow subscriber can never stall rule evaluation itself.
+        self._hooks: List[Callable[
+            [AlertRule, str, str, float, Optional[float]], None]] = []
+        self._pending_hooks: List[
+            Tuple[AlertRule, str, str, float, Optional[float]]] = []
         self._rules: List[_CompiledRule] = []
         seen: Dict[str, bool] = {}
         for rule in rules:
@@ -333,6 +353,17 @@ class AlertEvaluator:
     def rules(self) -> List[AlertRule]:
         return [c.rule for c in self._rules]
 
+    def add_transition_hook(
+            self, fn: Callable[
+                [AlertRule, str, str, float, Optional[float]],
+                None]) -> None:
+        """Subscribe to state-machine transitions.  *fn* is called as
+        ``fn(rule, state_from, state_to, at, value)`` after every
+        transition, outside the evaluator lock; exceptions are logged
+        and never reach rule evaluation."""
+        with self._lock:
+            self._hooks.append(fn)
+
     # -- evaluation ----------------------------------------------------------
 
     def _condition_value(self, expr: Expr, cond: AlertCondition,
@@ -351,6 +382,19 @@ class AlertEvaluator:
         with self._lock:
             for c in self._rules:
                 self._evaluate_rule_locked(c, at)
+            fired = self._pending_hooks
+            self._pending_hooks = []
+            hooks = list(self._hooks)
+        # hooks run outside the lock (see __init__) — a subscriber may
+        # read evaluator state and must not be able to wedge the tick
+        for rule, old, new, t, value in fired:
+            for fn in hooks:
+                try:
+                    fn(rule, old, new, t, value)
+                except Exception:
+                    log.exception(
+                        "alert transition hook failed for %s",
+                        rule.name)
 
     def _evaluate_rule_locked(self, c: _CompiledRule,
                               at: float) -> None:
@@ -405,6 +449,7 @@ class AlertEvaluator:
                 alert=rule.name, severity=rule.severity,
                 state_from=old, state_to=new, at=at,
                 value=(st.value if st.value is not None else ""))
+        self._pending_hooks.append((rule, old, new, at, st.value))
 
     # -- read paths ----------------------------------------------------------
 

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import logging
 import math
+import os
 import re
 import threading
 import time
@@ -402,6 +403,11 @@ class Registry:
         self._lock = threading.Lock()
         self._families: Dict[str, _Family[Any]] = {}
         self._collectors: List[Callable[[], None]] = []
+        # the one ProcessCollector this registry carries (see
+        # attach_process_collector): tracked here so repeated attaches
+        # — e.g. a fresh ScrapeMeta per render — can't stack duplicate
+        # on_collect hooks (the collector list has no dedup by design)
+        self._process_collector: Optional["ProcessCollector"] = None
 
     def _get_or_create(self, cls: type, name: str, help: str,
                        labelnames: Iterable[str],
@@ -482,6 +488,108 @@ class Registry:
         return "\n".join(out) + "\n"
 
 
+class ProcessCollector:
+    """Standard process self-metrics, read at scrape time (dep-free).
+
+    Every ``/metrics`` surface answers the same first incident
+    questions — is the process leaking memory, burning CPU, or
+    exhausting file descriptors — through four conventional families:
+
+    - ``tpu_process_cpu_seconds_total``  user+system CPU (os.times)
+    - ``tpu_process_rss_bytes``          resident set (/proc/self/statm)
+    - ``tpu_process_open_fds``           open descriptors (/proc/self/fd)
+    - ``tpu_process_start_time_seconds`` epoch start (/proc/self/stat)
+
+    Values refresh lazily via :meth:`Registry.on_collect` — no
+    background thread, no cost between scrapes.  Where ``/proc`` is
+    missing (macOS dev boxes, odd containers) the affected family
+    degrades to its last value instead of breaking the scrape.
+
+    Use :func:`attach_process_collector` (idempotent per registry)
+    rather than constructing directly: ``Registry.on_collect`` appends
+    without dedup, so a second construction would double-register.
+    """
+
+    def __init__(self, registry: "Registry") -> None:
+        self._c_cpu = registry.counter(
+            "tpu_process_cpu_seconds_total",
+            "Total user and system CPU time this process has "
+            "consumed, in seconds.")
+        self._g_rss = registry.gauge(
+            "tpu_process_rss_bytes",
+            "Resident set size of this process in bytes.")
+        self._g_fds = registry.gauge(
+            "tpu_process_open_fds",
+            "File descriptors currently open in this process.")
+        self._g_start = registry.gauge(
+            "tpu_process_start_time_seconds",
+            "Start time of this process, seconds since the unix "
+            "epoch.")
+        self._page_size = 4096
+        try:
+            self._page_size = os.sysconf("SC_PAGE_SIZE")
+        except (ValueError, OSError, AttributeError):
+            pass
+        self._g_start.set(self._read_start_time())
+        registry.on_collect(self._collect)
+
+    @staticmethod
+    def _read_start_time() -> float:
+        """Process start epoch: kernel boot time (/proc/stat btime)
+        plus the process start offset (/proc/self/stat field 22, in
+        clock ticks).  Falls back to 'now' at attach time — surfaces
+        attach at boot, so the error is bounded by startup cost."""
+        try:
+            btime = None
+            with open("/proc/stat", encoding="ascii") as f:
+                for line in f:
+                    if line.startswith("btime "):
+                        btime = float(line.split()[1])
+                        break
+            with open("/proc/self/stat", encoding="ascii") as f:
+                stat = f.read()
+            # field 2 (comm) may contain spaces; split after its ')'
+            ticks = float(stat.rsplit(")", 1)[1].split()[19])
+            hz = os.sysconf("SC_CLK_TCK")
+            if btime is not None and hz > 0:
+                return btime + ticks / hz
+        except (OSError, ValueError, IndexError, AttributeError):
+            pass
+        return time.time()
+
+    def _collect(self) -> None:
+        t = os.times()
+        self._c_cpu._set(float(t.user + t.system))
+        try:
+            with open("/proc/self/statm", encoding="ascii") as f:
+                self._g_rss.set(
+                    float(f.read().split()[1]) * self._page_size)
+        except (OSError, ValueError, IndexError):
+            pass
+        try:
+            self._g_fds.set(float(len(os.listdir("/proc/self/fd"))))
+        except OSError:
+            pass
+
+
+# attach serialization: construction registers an on_collect hook, so
+# two racing attaches must not both construct (the hook list does not
+# dedup).  A module lock is the simplest correct gate — construction
+# itself takes registry._lock via counter()/gauge()/on_collect().
+_PROCESS_ATTACH_LOCK = threading.Lock()
+
+
+def attach_process_collector(registry: "Registry") -> ProcessCollector:
+    """Get-or-create the registry's :class:`ProcessCollector`.
+
+    Idempotent — safe to call from every ScrapeMeta construction even
+    on surfaces that build a fresh ScrapeMeta per render."""
+    with _PROCESS_ATTACH_LOCK:
+        if registry._process_collector is None:
+            registry._process_collector = ProcessCollector(registry)
+        return registry._process_collector
+
+
 class ScrapeMeta:
     """Scrape self-observability for one ``/metrics`` surface.
 
@@ -497,6 +605,11 @@ class ScrapeMeta:
 
     def __init__(self, registry: "Registry") -> None:
         self._registry = registry
+        # every /metrics surface carries the standard process
+        # self-metrics: ScrapeMeta construction is the one chokepoint
+        # all four surfaces already pass through, and the attach is
+        # idempotent per registry
+        attach_process_collector(registry)
         self._h_duration = registry.histogram(
             "tpu_scrape_duration_seconds",
             "Wall time spent rendering this surface's own /metrics "

@@ -434,6 +434,14 @@ class TSDB:
         with self._lock:
             return len(self._series)
 
+    def series_names(self) -> List[str]:
+        """Sorted distinct metric names currently held — the incident
+        bundler enumerates these to snapshot whole family sets
+        (``tpu_serve_*`` and the firing rule's referenced families)
+        without knowing every name up front."""
+        with self._lock:
+            return sorted({name for name, _ in self._series})
+
     def point_count(self) -> int:
         with self._lock:
             return sum(s.n_points() for s in self._series.values())

@@ -156,7 +156,9 @@ class DebugServer:
     def __init__(self, manager: "PluginManager", port: int,
                  host: str = "127.0.0.1",
                  alert_rules: Optional[list] = None,
-                 tick_interval_s: float = 15.0):
+                 tick_interval_s: float = 15.0,
+                 incident_dir: Optional[str] = None,
+                 profiler_hz: float = 19.0):
         self._manager = manager
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._host = host
@@ -177,6 +179,22 @@ class DebugServer:
         self.alerts = obs.AlertEvaluator(
             self.tsdb, list(alert_rules or ()),
             recorder=getattr(manager, "recorder", None))
+        # continuous sampling profiler + alert-triggered incident
+        # bundles (PR 19) — the plugin's flight data recorder
+        self.profiler = obs.SamplingProfiler(
+            self.registry, hz=profiler_hz)
+        self._incidents: Optional[obs.IncidentManager] = None
+        if incident_dir:
+            self._incidents = obs.IncidentManager(
+                incident_dir, self.alerts,
+                registry=self.registry,
+                recorder=getattr(manager, "recorder", None),
+                tsdb=self.tsdb,
+                profiler=self.profiler,
+                metric_prefixes=("tpu_plugin_", "tpu_slice_"),
+                collectors={
+                    "statz.json": lambda: manager_status(self._manager),
+                })
 
     def _refresh(self) -> None:
         try:
@@ -234,6 +252,15 @@ class DebugServer:
                                    "internal error; see plugin logs\n")
                 elif url.path == "/debug/threads":
                     self._send(200, "text/plain", thread_dump())
+                elif url.path == "/debug/pprof":
+                    try:
+                        ctype, body = outer.profiler.handle_pprof(
+                            parse_qs(url.query))
+                    except ValueError as e:
+                        self._send(400, "application/json", json.dumps(
+                            {"error": str(e)}) + "\n")
+                        return
+                    self._send(200, ctype, body)
                 elif url.path in ("/debug/traces", "/debug/events"):
                     recorder = getattr(manager, "recorder", None)
                     if recorder is None:
@@ -305,11 +332,17 @@ class DebugServer:
         )
         t.start()
         self.tsdb.start(self._tick_interval_s)
+        self.profiler.start()
+        if self._incidents is not None:
+            self._incidents.start()
         log.info("debug endpoint on http://%s:%d", self._host, self.port)
         return self
 
     def stop(self) -> None:
         self.tsdb.stop()
+        self.profiler.stop()
+        if self._incidents is not None:
+            self._incidents.stop()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

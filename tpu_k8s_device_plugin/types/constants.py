@@ -222,3 +222,10 @@ ENV_SLICE_RESHAPE_GRACE = "TPU_DP_SLICE_RESHAPE_GRACE_S"
 # post-mortem survives the pod; empty disables the dump.
 FLIGHT_RECORD_DIR = "/var/lib/tpu-flight-records"
 ENV_FLIGHT_RECORD_DIR = "TPU_DP_FLIGHT_RECORD_DIR"
+
+# Incident bundles (PR 19): where alert-triggered incident bundles land
+# (alert history + journal + TSDB snapshot + continuous-profile slice).
+# Mounted as a hostPath next to the flight records; empty disables the
+# incident subscriber entirely.
+INCIDENT_DIR = "/var/lib/tpu-incidents"
+ENV_INCIDENT_DIR = "TPU_DP_INCIDENT_DIR"

@@ -1247,7 +1247,11 @@ def run_episode(args: argparse.Namespace) -> Tuple[
                       breaker_reset_s=0.5, seed=args.seed,
                       registry=registry, slo_policies=policies,
                       alert_interval_s=alert_interval,
-                      alert_window_scale=alert_scale)
+                      alert_window_scale=alert_scale,
+                      # a mid-episode page then writes the fleet-level
+                      # incident bundle (hand-built Namespaces without
+                      # the flag keep the subscriber disarmed)
+                      incident_dir=getattr(args, "incident_dir", None))
     rt.start(host="127.0.0.1", port=0)
     cache_dir = args.compile_cache_dir or os.path.join(
         args.workdir, "fleet-compile-cache")
@@ -1693,6 +1697,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                           "failure replacement, degraded drain, and "
                           "idle scale-in")
     epp.add_argument("--fault-spec", default=None, metavar="SPEC")
+    epp.add_argument("--incident-dir", default=None, metavar="DIR",
+                     help="arm the episode router's incident "
+                          "subscriber: a firing page writes one "
+                          "fleet-level bundle (with per-replica "
+                          "fragments) under DIR")
     _add_server_flags(epp)
 
     args = p.parse_args(argv)

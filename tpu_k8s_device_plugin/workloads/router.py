@@ -139,6 +139,7 @@ import hashlib
 import http.client
 import json
 import logging
+import os
 import queue
 import threading
 import time
@@ -420,7 +421,9 @@ class RouterServer:
                  slo_policies: Optional[Dict[str, Any]] = None,
                  alert_rules: Optional[List[Any]] = None,
                  alert_interval_s: float = 5.0,
-                 alert_window_scale: float = 1.0
+                 alert_window_scale: float = 1.0,
+                 incident_dir: Optional[str] = None,
+                 profiler_hz: float = 19.0
                  ) -> None:
         if prefix_chunk < 1:
             raise ValueError("prefix_chunk must be >= 1")
@@ -576,6 +579,26 @@ class RouterServer:
         rules.extend(alert_rules or ())
         self.alerts = obs.AlertEvaluator(
             self.tsdb, rules, recorder=self.recorder)
+        # -- continuous profiling + fleet incident bundles (PR 19) -------
+        # the router samples its OWN stacks (proxy workers, poller) and
+        # on a fleet-level page additionally pulls every registered
+        # replica's bundle fragments (statz / alerts / profile slice)
+        # into replicas/<id>/ of ONE fleet bundle — an unreachable
+        # replica degrades to an {unreachable: true} marker instead of
+        # wedging the subscriber (chaos episode 16 SIGKILLs one to
+        # prove it)
+        self.profiler = obs.SamplingProfiler(
+            reg, hz=profiler_hz,
+            active_fn=lambda: len(self._replicas))
+        self.incident_dir = incident_dir
+        self._incidents: Optional[obs.IncidentManager] = None
+        if incident_dir:
+            self._incidents = obs.IncidentManager(
+                incident_dir, self.alerts, registry=reg,
+                recorder=self.recorder, tsdb=self.tsdb,
+                profiler=self.profiler,
+                collectors={"statz.json": self.fleet_statz},
+                extra_files_fn=self._incident_replica_fragments)
 
     # -- replica table ------------------------------------------------------
 
@@ -921,6 +944,60 @@ class RouterServer:
                        "alerts": own},
             "per_replica": per_replica,
         }
+
+    # -- fleet incident bundles (PR 19) -------------------------------------
+
+    def _fetch_replica_json(self, rep: Replica, path: str,
+                            timeout_s: float = 2.0) -> Dict[str, Any]:
+        """One replica's JSON debug surface for the incident bundle
+        fan-out.  Short timeout by design — a dead replica must cost
+        the bundle seconds, not minutes — and EVERY failure mode
+        returns an ``{"unreachable": true}`` marker instead of
+        raising (the bundle records the death, it does not share it)."""
+        host, port = rep.host_port()
+        conn = http.client.HTTPConnection(host, port,
+                                          timeout=timeout_s)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status != 200:
+                return {"unreachable": True,
+                        "error": f"HTTP {resp.status}"}
+            out = json.loads(body)
+            return out if isinstance(out, dict) else {"body": out}
+        # tpulint: disable=R2 -- not a swallow: the failure IS the payload — the bundle records the replica as unreachable with the error text (chaos episode 16 asserts exactly this marker)
+        except Exception as e:
+            return {"unreachable": True,
+                    "error": f"{type(e).__name__}: {e}"}
+        finally:
+            try:
+                conn.close()
+            # tpulint: disable=R2 -- close() on an already-broken connection during bundle fan-out; nothing to account, the fetch outcome was recorded above
+            except Exception:
+                pass
+
+    def _incident_replica_fragments(self) -> Dict[str, Any]:
+        """The fleet-level bundle's per-replica half: pull each
+        registered replica's statz / alerts / continuous-profile slice
+        into ``replicas/<id>/``.  Replicas whose breaker is open are
+        still ATTEMPTED (the page may be ABOUT them) — unreachable
+        ones degrade to their marker file."""
+        with self._lock:
+            reps = list(self._replicas.values())
+        out: Dict[str, Any] = {}
+        for rep in reps:
+            # replica ids default to "host:port" — keep the path one
+            # directory level deep whatever the operator chose
+            safe = rep.rid.replace("/", "_").replace("..", "_")
+            base = f"replicas/{safe}"
+            out[f"{base}/statz.json"] = self._fetch_replica_json(
+                rep, "/statz")
+            out[f"{base}/alerts.json"] = self._fetch_replica_json(
+                rep, "/alerts")
+            out[f"{base}/profile.json"] = self._fetch_replica_json(
+                rep, "/debug/pprof?seconds=60&format=json")
+        return out
 
     # -- cross-replica trace stitching --------------------------------------
 
@@ -1662,6 +1739,17 @@ class RouterServer:
                         "events": router.recorder.events(),
                     }, indent=2).encode() + b"\n"
                     self._send(200, "application/json", body)
+                elif self.path.startswith("/debug/pprof"):
+                    # the router's own continuous-profile ring (PR 19)
+                    try:
+                        ctype, text = router.profiler.handle_pprof(
+                            parse_qs(urlparse(self.path).query))
+                    except ValueError as e:
+                        self._send(400, "application/json",
+                                   (json.dumps({"error": str(e)})
+                                    + "\n").encode())
+                        return
+                    self._send(200, ctype, text.encode())
                 else:
                     self._send(404, "text/plain", b"not found\n")
 
@@ -1733,6 +1821,9 @@ class RouterServer:
             target=self._poll_loop, name="router-statz", daemon=True)
         self._poller.start()
         self.tsdb.start(self.alert_interval_s)
+        self.profiler.start()
+        if self._incidents is not None:
+            self._incidents.start()
         log.info("router on http://%s:%d", host, self.port)
         return self
 
@@ -1744,6 +1835,9 @@ class RouterServer:
 
     def stop(self) -> None:
         self.tsdb.stop()
+        self.profiler.stop()
+        if self._incidents is not None:
+            self._incidents.stop()
         self._stop.set()
         if self._poller is not None:
             self._poller.join(timeout=2)
@@ -1846,6 +1940,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                    metavar="X",
                    help="scale factor on the derived burn-rate rule "
                         "windows (5m/1h/6h * X)")
+    p.add_argument("--incident-dir", default=None, metavar="DIR",
+                   help="write alert-triggered fleet incident bundles "
+                        "under this directory (env TPU_DP_INCIDENT_DIR)")
+    p.add_argument("--profiler-hz", type=float, default=19.0,
+                   metavar="HZ",
+                   help="continuous sampling-profiler tick rate "
+                        "(default 19)")
     args = p.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
@@ -1870,6 +1971,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         p.error("--alert-interval must be > 0")
     if args.alert_window_scale <= 0:
         p.error("--alert-window-scale must be > 0")
+    if args.profiler_hz <= 0:
+        p.error("--profiler-hz must be > 0")
+    incident_dir = (args.incident_dir
+                    or os.environ.get("TPU_DP_INCIDENT_DIR") or None)
     rt = RouterServer(
         prefix_chunk=args.prefix_chunk,
         replica_ttl_s=args.replica_ttl,
@@ -1888,7 +1993,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         slo_policies=slo_policies,
         alert_rules=alert_rules,
         alert_interval_s=args.alert_interval,
-        alert_window_scale=args.alert_window_scale)
+        alert_window_scale=args.alert_window_scale,
+        incident_dir=incident_dir,
+        profiler_hz=args.profiler_hz)
     if args.fault_spec:
         faults.install(args.fault_spec, seed=args.seed or 0,
                        recorder=rt.recorder)

@@ -233,6 +233,13 @@ class IterationScheduler:
         self._phase_acc: Dict[str, float] = {
             "dispatch": 0.0, "harvest": 0.0, "stream": 0.0,
             "idle": 0.0}
+        # the phase executing RIGHT NOW (begin_phase sets it at each
+        # section start) — the continuous profiler's phase_fn reads
+        # this to tag stack samples, so a profile slice can split
+        # "time under dispatch" from "time blocked in harvest".
+        # Single writer (the loop's thread); racy reads are fine, a
+        # sample tagged one phase late is still an honest sample.
+        self.phase: str = "idle"
         self._phase_hist: Dict[str, object] = {}
         self._m_phase = None
         self._g_duty = None
@@ -484,6 +491,14 @@ class IterationScheduler:
                     self._m_first.observe(now - t.t_begin)
         self._await_first.clear()
 
+    def begin_phase(self, phase: str) -> None:
+        """Mark *phase* as the section executing NOW (profiler tag —
+        see ``self.phase``).  Time accounting still happens at section
+        end via :meth:`note_phase`; callers pair the two."""
+        if phase not in self._phase_acc:
+            raise ValueError(f"unknown scheduler phase {phase!r}")
+        self.phase = phase
+
     def note_phase(self, phase: str, dt: float) -> None:
         """Account *dt* wall seconds of scheduler-loop time under
         *phase* (dispatch | harvest | stream | idle).  dispatch and
@@ -517,6 +532,7 @@ class IterationScheduler:
 
     def _timed_dispatch(self, window: int) -> object:
         t0 = time.perf_counter()
+        self.begin_phase("dispatch")
         handle = self.engine.scan_dispatch(window)
         self.note_phase("dispatch", time.perf_counter() - t0)
         return handle
@@ -663,6 +679,7 @@ class IterationScheduler:
         self._check(gen)
         fins = self._admit_work(self.prefill_budget)
         t0 = time.perf_counter()
+        self.begin_phase("harvest")
         decoded = eng.scan_harvest(handle)
         dt = time.perf_counter() - t0
         self.note_phase("harvest", dt)
@@ -731,12 +748,14 @@ class IterationScheduler:
             self._note_first_step()
             if eng.spec_ready():
                 t0 = time.perf_counter()
+                self.begin_phase("harvest")
                 decoded = eng.spec_round()
                 self.note_phase("harvest", time.perf_counter() - t0)
                 self._gauges()
                 return IterationResult(admitted, decoded, 1)
             if eng.forced_pending():
                 t0 = time.perf_counter()
+                self.begin_phase("harvest")
                 decoded = eng.jump_round()
                 self.note_phase("harvest", time.perf_counter() - t0)
                 if decoded is not None:
@@ -750,6 +769,7 @@ class IterationScheduler:
             # a slot ran out of cache: one step() retires it
             self._note_first_step()
             t0 = time.perf_counter()
+            self.begin_phase("harvest")
             decoded = {s: [t] for s, t in eng.step().items()}
             self.note_phase("harvest", time.perf_counter() - t0)
             self._gauges()
@@ -767,6 +787,7 @@ class IterationScheduler:
             self._check(gen)
             fins = self._admit_work(self.prefill_budget)
         t0 = time.perf_counter()
+        self.begin_phase("harvest")
         decoded = eng.scan_harvest(handle)
         self.note_phase("harvest", time.perf_counter() - t0)
         admitted += fins

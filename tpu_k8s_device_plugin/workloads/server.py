@@ -669,7 +669,9 @@ class EngineServer:
                  replica_role: str = "mixed",
                  alert_rules: Optional[list] = None,
                  alert_interval_s: float = 5.0,
-                 alert_window_scale: float = 1.0):
+                 alert_window_scale: float = 1.0,
+                 incident_dir: Optional[str] = None,
+                 profiler_hz: float = 19.0):
         """*tokenizer* (anything with ``encode(str) -> List[int]`` and
         ``decode(List[int]) -> str``, e.g. a transformers tokenizer)
         unlocks the text-level surface: ``"prompt"`` strings, STRING
@@ -940,6 +942,26 @@ class EngineServer:
         _rules.extend(alert_rules or ())
         self.alerts = obs.AlertEvaluator(
             self.tsdb, _rules, recorder=self.recorder)
+        # -- continuous profiling + incident bundles (PR 19) --------------
+        # the always-on sampler (GET /debug/pprof) tags every stack
+        # sample with the scheduler's live phase and the in-flight
+        # count; when a page-severity alert fires, the incident
+        # manager snapshots everything (journal, TSDB, profile ring,
+        # statz, slowest SLO-missed traces) into one atomic directory
+        # under --incident-dir — the post-mortem writes itself
+        self.profiler = obs.SamplingProfiler(
+            reg, hz=profiler_hz,
+            phase_fn=lambda: self._sched.phase,
+            active_fn=lambda: len(self._running))
+        self.incident_dir = incident_dir
+        self._incidents: Optional[obs.IncidentManager] = None
+        if incident_dir:
+            self._incidents = obs.IncidentManager(
+                incident_dir, self.alerts, registry=reg,
+                recorder=self.recorder, tsdb=self.tsdb,
+                profiler=self.profiler,
+                collectors={"statz.json": self.statz,
+                            "traces.json": self.slo_miss_traces})
         # -- iteration scheduler (continuous batching) --------------------
         # the engine's sole driver: a unified work queue of decode
         # windows and prefill chunks.  With interleave on (default),
@@ -1090,11 +1112,20 @@ class EngineServer:
             # requests that never declared a class derive one from
             # their shape: streaming callers care about TTFT
             # (interactive), unary callers about the deadline (batch)
-            self._slo.record(
+            met = self._slo.record(
                 req.slo_class or None, req.tenant,
                 ttft_s=req.ttft_s if req.ttft_s >= 0 else None,
                 total_s=total_s, ok=outcome == "ok",
                 fallback="interactive" if req.stream else "batch")
+            if not met:
+                # per-miss journal marker (PR 19): the incident
+                # bundler joins these against the trace ring to stitch
+                # "the slowest requests that missed their SLO" without
+                # re-deriving policy verdicts offline
+                self.recorder.record(
+                    "tpu_serve_slo_miss", trace=req.trace,
+                    rid=req.rid, duration_s=total_s, outcome=outcome,
+                    slo_class=req.slo_class or "")
 
     def _note_client_abandon(self, req: _Request) -> None:
         """The CLIENT vanished mid-request (reset / broken pipe on
@@ -1602,6 +1633,7 @@ class EngineServer:
                 # wait is the loop's "idle" phase — the denominator of
                 # the device duty-cycle gauge
                 t_idle = time.perf_counter()
+                sched.begin_phase("idle")
                 self._work.wait(timeout=_IDLE_POLL_S)
                 self._work.clear()
                 sched.note_phase("idle",
@@ -1628,6 +1660,7 @@ class EngineServer:
             if not res.steps:
                 continue
             t_stream = time.perf_counter()
+            sched.begin_phase("stream")
             for slot, (req, idx) in list(self._running.items()):
                 before = req.emitted.get(idx, 0)
                 self._emit(slot, req, idx, eng.output(slot))
@@ -2024,6 +2057,18 @@ class EngineServer:
                         return
                     self._send(200, "application/json",
                                json.dumps(out) + "\n")
+                elif url.path == "/debug/pprof":
+                    # the always-on sampling profiler's ring (PR 19):
+                    # ?seconds=N&format=folded|json — folded stacks
+                    # pipe straight into flamegraph.pl / speedscope
+                    try:
+                        ctype, body = server.profiler.handle_pprof(
+                            parse_qs(url.query))
+                    except ValueError as e:
+                        self._send(400, "application/json",
+                                   json.dumps({"error": str(e)}) + "\n")
+                        return
+                    self._send(200, ctype, body)
                 else:
                     self._send(404, "text/plain", "not found\n")
 
@@ -2496,6 +2541,9 @@ class EngineServer:
             daemon=True)
         self._scheduler.start()
         self.tsdb.start(self.alert_interval_s)
+        self.profiler.start()
+        if self._incidents is not None:
+            self._incidents.start()
         log.info("serving engine on http://%s:%d", host, self.port)
         return self
 
@@ -2517,6 +2565,9 @@ class EngineServer:
 
     def stop(self) -> None:
         self.tsdb.stop()
+        self.profiler.stop()
+        if self._incidents is not None:
+            self._incidents.stop()
         self._stop.set()
         self._work.set()  # wake an idle scheduler so it can exit
         sched = self._scheduler
@@ -3148,12 +3199,17 @@ class EngineServer:
         try:
             import jax
 
+            # compose with the continuous sampler (PR 19): the ring
+            # sampler parks for the capture window — suspended ticks
+            # are still counted, so the profile timeline shows an
+            # honest gap instead of samples of the capture machinery
             t0 = time.perf_counter()
-            jax.profiler.start_trace(self.profile_dir)
-            try:
-                time.sleep(seconds)
-            finally:
-                jax.profiler.stop_trace()
+            with self.profiler.suspend(reason="jax_profiler"):
+                jax.profiler.start_trace(self.profile_dir)
+                try:
+                    time.sleep(seconds)
+                finally:
+                    jax.profiler.stop_trace()
             dt = time.perf_counter() - t0
         finally:
             self._profile_lock.release()
@@ -3202,6 +3258,41 @@ class EngineServer:
             # extra fan-out poll
             "alerts": self.alerts.brief(),
         }
+
+    def slo_miss_traces(self, top: int = 5) -> dict:
+        """The incident bundle's span-attribution payload: the slowest
+        *top* requests that missed their SLO (per the journal's
+        ``tpu_serve_slo_miss`` markers), each with every ring event of
+        its trace — ``obs_query --incident`` stitches these back into
+        span trees offline."""
+        misses = self.recorder.events(name="tpu_serve_slo_miss")
+
+        def _dur(ev: dict) -> float:
+            attrs = ev.get("attrs")
+            if isinstance(attrs, dict):
+                try:
+                    return float(attrs.get("duration_s", 0.0))
+                except (TypeError, ValueError):
+                    return 0.0
+            return 0.0
+
+        misses.sort(key=_dur, reverse=True)
+        out = []
+        for ev in misses[:top]:
+            attrs = ev.get("attrs")
+            attrs = attrs if isinstance(attrs, dict) else {}
+            tid = ev.get("trace_id") or ""
+            events = (self.recorder.events(trace_id=str(tid))
+                      if tid else [ev])
+            out.append({
+                "rid": attrs.get("rid", ""),
+                "trace_id": tid,
+                "duration_s": _dur(ev),
+                "slo_class": attrs.get("slo_class", ""),
+                "outcome": attrs.get("outcome", ""),
+                "events": events,
+            })
+        return {"schema": "tpu-incident-traces/v1", "misses": out}
 
     # -- router registration (multi-replica serving) ------------------------
 
@@ -3536,6 +3627,19 @@ def main(argv=None) -> int:
                    help="enable GET /debug/profile?seconds=N: dump "
                         "jax.profiler traces there (single-flight; "
                         "env TPU_DP_PROFILE_DIR)")
+    p.add_argument("--incident-dir", default=None, metavar="DIR",
+                   help="alert-triggered incident bundles: when a "
+                        "page-severity alert fires, write one atomic "
+                        "directory there (alert history, journal "
+                        "dump, TSDB snapshot, continuous-profile "
+                        "slice, statz, slowest SLO-missed traces); "
+                        "rate-limited per alert, GC'd newest-K "
+                        "(env TPU_DP_INCIDENT_DIR)")
+    p.add_argument("--profiler-hz", type=float, default=19.0,
+                   metavar="HZ",
+                   help="continuous sampling profiler rate for "
+                        "GET /debug/pprof (default 19 — prime, so the "
+                        "sampler cannot phase-lock a periodic loop)")
     p.add_argument("--flight-record-capacity", type=int, default=4096,
                    help="flight-recorder ring size in events "
                         "(drop-oldest past it)")
@@ -3696,6 +3800,10 @@ def main(argv=None) -> int:
     import os as _pd_os
     profile_dir = (args.profile_dir
                    or _pd_os.environ.get("TPU_DP_PROFILE_DIR"))
+    incident_dir = (args.incident_dir
+                    or _pd_os.environ.get("TPU_DP_INCIDENT_DIR"))
+    if args.profiler_hz <= 0:
+        p.error("--profiler-hz must be > 0")
 
     # the persistent compile cache must be configured BEFORE the first
     # jit (param build included) or early executables miss it
@@ -3793,7 +3901,9 @@ def main(argv=None) -> int:
                        replica_role=args.replica_role,
                        alert_rules=alert_rules,
                        alert_interval_s=args.alert_interval,
-                       alert_window_scale=args.alert_window_scale)
+                       alert_window_scale=args.alert_window_scale,
+                       incident_dir=incident_dir,
+                       profiler_hz=args.profiler_hz)
     if args.fault_spec is not None or args.fault_seed is not None:
         if args.fault_spec is None:
             p.error("--fault-seed needs --fault-spec")
