@@ -176,6 +176,74 @@ def test_codec_rejects_malformed_payloads():
         load_payload(good[:-3])                   # truncated blob
 
 
+def _int8_engine(model, params):
+    return ServingEngine(model, params, n_slots=2, chunk=8,
+                         max_new_tokens=12, auto_prefix_min=4,
+                         kv_paging=True, kv_dtype="int8")
+
+
+def test_codec_roundtrips_int8_pool_preemption():
+    """preempt -> encode -> decode -> resume under --kv-dtype int8:
+    quantized pools checkpoint raw int8 bytes + scales, so a stream
+    that crossed the codec continues bit-identically to an int8 ref
+    that was never preempted — int8's lossiness lives at write time,
+    never in the checkpoint."""
+    model, params = _build()
+    eng, ref = _int8_engine(model, params), _int8_engine(model, params)
+    a, b = list(range(1, 10)), list(range(30, 40))
+    sa = eng.admit(a)
+    sb = eng.admit(b, temperature=0.7, seed=13)
+    ra = ref.admit(a)
+    rb = ref.admit(b, temperature=0.7, seed=13)
+    for _ in range(3):
+        eng.step()
+        ref.step()
+    state = load_payload(dump_payload(eng.preempt(sb)))
+    for _ in range(2):
+        eng.step()
+        ref.step()
+    sb2 = eng.resume(state)
+    while any(eng.active):
+        eng.step()
+    while any(ref.active):
+        ref.step()
+    assert eng.output(sa) == ref.output(ra)
+    assert eng.output(sb2) == ref.output(rb)
+    eng._pool.check()
+
+
+def test_codec_roundtrips_int8_session_checkpoints():
+    """Session-tier checkpoints ride the same codec: an int8 parked
+    conversation demoted, codec-round-tripped, and resumed on a SECOND
+    engine serves turn 2 byte-identically to the first engine's warm
+    device hit — both read the same quantized storage."""
+    model, params = _build()
+    p1, p2 = list(range(1, 13)), [40, 41, 42]
+
+    def turn(eng, prompt, **kw):
+        s = eng.admit(list(prompt), **kw)
+        while not eng.finished(s):
+            eng.step()
+        return s, eng.output(s)
+
+    eng1 = _int8_engine(model, params)
+    s, out1 = turn(eng1, p1, session="c8")
+    eng1.park_session(s, "c8", len(out1))
+    chain = p1 + out1 + p2
+    _, warm = turn(eng1, chain, session="c8")
+
+    eng2 = _int8_engine(model, params)
+    s, out1b = turn(eng2, p1, session="c8")
+    assert out1b == out1
+    eng2.park_session(s, "c8", len(out1b))
+    raw = dump_payload(eng2.demote_session(eng2.session_slots()["c8"]))
+    eng3 = _int8_engine(model, params)
+    eng3.resume_session(load_payload(raw))
+    _, moved = turn(eng3, chain, session="c8")
+    assert moved == warm
+    eng3._pool.check()
+
+
 # the equivalence matrix: greedy / seeded sampling / penalties /
 # grammar — each long enough to migrate
 MATRIX = [

@@ -1,0 +1,596 @@
+"""Session KV tiering: store policy + byte-identity + degradation.
+
+Two layers of proof.  The SessionStore policy tests run against a fake
+engine (pure-Python state dicts) and pin the tiering mechanics: idle
+demotion device -> host -> disk, promotion on return, crash-safe disk
+files that a fresh store generation inherits, truncation quarantine,
+newest-K GC, bounded host RAM, single-owner export/import, and the
+kv.promote fault degrading to a cold miss instead of an error.
+
+The byte-identity suite runs the REAL engine and extends the house
+invariant to session tiers: a conversation's turn-2 output is
+BYTE-IDENTICAL whether its KV record returns from the device tier,
+from a host checkpoint, from the migrate codec (the disk / wire
+format), or from a second engine (replica crash + respawn) — versus a
+cold full re-prefill of the chained prompt on a fresh engine — for
+greedy, seeded-sampled, and grammar-constrained turns alike.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_k8s_device_plugin.resilience import faults
+from tpu_k8s_device_plugin.workloads import kv_tier
+from tpu_k8s_device_plugin.workloads.kv_tier import (
+    SessionStore,
+    empty_tier_stats,
+    sid_hash,
+)
+from tpu_k8s_device_plugin.workloads.migrate import (
+    MigrateError,
+    dump_payload,
+    load_payload,
+)
+
+
+# -- fake engine -----------------------------------------------------------
+
+
+class FakeEngine:
+    """Slot bookkeeping without a model: parked sessions are state
+    dicts keyed by slot, matching the four engine methods the store
+    drives."""
+
+    def __init__(self, n_slots=4):
+        self.n_slots = n_slots
+        self.parked = {}
+        self.discarded = []
+
+    def demote_session(self, slot):
+        return self.parked.pop(slot)
+
+    def resume_session(self, state):
+        free = self.free_slots()
+        if not free:
+            raise RuntimeError("no free slots")
+        self.parked[free[0]] = state
+        return free[0]
+
+    def discard_session(self, slot):
+        self.parked.pop(slot)
+        self.discarded.append(slot)
+
+    def free_slots(self):
+        return [s for s in range(self.n_slots) if s not in self.parked]
+
+
+def _state(sid, n=64):
+    return {
+        "v": 1, "kind": "session", "session_id": sid,
+        "tokens": np.arange(8, dtype=np.int32), "canon": 8,
+        "adapter": 0, "kv": np.zeros(n, np.float32),
+    }
+
+
+def _park(store, eng, sid, slot, now_s=0.0):
+    eng.parked[slot] = _state(sid)
+    store.note_parked(sid, slot, now_s)
+
+
+# -- store policy (fake engine) --------------------------------------------
+
+
+def test_idle_demotion_chain_and_stats(tmp_path):
+    eng = FakeEngine()
+    store = SessionStore(eng, spill_dir=str(tmp_path),
+                         device_idle_s=1.0, host_idle_s=1.0)
+    _park(store, eng, "a", 0)
+    assert store.stats()["device"] == 1
+    store.tick(2.0)  # > 1.1x device_idle: device -> host
+    st = store.stats()
+    assert st["device"] == 0 and st["host"] == 1
+    assert eng.parked == {}  # slot freed
+    assert st["host_bytes"] > 0
+    store.tick(5.0)  # > host deadline: host -> disk
+    st = store.stats()
+    assert st["host"] == 0 and st["disk"] == 1
+    assert st["host_bytes"] == 0 and st["disk_bytes"] > 0
+    files = os.listdir(tmp_path)
+    assert len(files) == 1
+    assert files[0].startswith(sid_hash("a") + "-")
+    assert files[0].endswith(".kvs")
+    assert st["demotions"] == 2
+
+
+def test_prepare_hits_every_tier(tmp_path):
+    eng = FakeEngine()
+    store = SessionStore(eng, spill_dir=str(tmp_path),
+                         device_idle_s=1.0, host_idle_s=1.0)
+    _park(store, eng, "a", 0)
+    assert store.prepare("a", 0.5) == "device"
+    store.tick(2.0)
+    assert store.prepare("a", 2.5) == "host"
+    assert 0 in eng.parked  # promoted back onto a device slot
+    store.tick(4.5)  # device -> host again
+    store.tick(7.0)  # host -> disk
+    assert os.listdir(tmp_path)
+    assert store.prepare("a", 8.0) == "disk"
+    assert not os.listdir(tmp_path)  # delete-on-promote
+    assert store.prepare("nope", 9.0) == ""  # cold miss
+    hits = store.stats()["hits"]
+    assert hits == {"device": 1, "host": 1, "disk": 1}
+    assert store.stats()["promotions"] == 2
+
+
+def test_prepare_can_restore_false_gates_restores(tmp_path):
+    eng = FakeEngine()
+    store = SessionStore(eng, spill_dir=str(tmp_path),
+                         device_idle_s=1.0)
+    _park(store, eng, "a", 0)
+    store.tick(2.0)
+    assert store.prepare("a", 2.5, can_restore=False) == ""
+    assert store.stats()["host"] == 1  # untouched, promotable later
+    assert store.prepare("a", 2.5) == "host"
+
+
+def test_disk_survives_process_death(tmp_path):
+    eng1 = FakeEngine()
+    store1 = SessionStore(eng1, spill_dir=str(tmp_path))
+    _park(store1, eng1, "conv", 0)
+    store1.spill_all(0.0)
+    assert store1.stats()["disk"] == 1
+    # a new generation on the same dir (fresh engine = respawn after
+    # SIGKILL) lazily rehydrates from filenames alone
+    eng2 = FakeEngine()
+    store2 = SessionStore(eng2, spill_dir=str(tmp_path))
+    assert store2.stats()["disk"] == 1
+    assert store2.prepare("conv", 0.0) == "disk"
+    got = eng2.parked[0]
+    assert got["session_id"] == "conv"
+    np.testing.assert_array_equal(got["tokens"], _state("conv")["tokens"])
+    np.testing.assert_array_equal(got["kv"], _state("conv")["kv"])
+
+
+def test_truncated_spill_quarantined(tmp_path):
+    eng = FakeEngine()
+    store = SessionStore(eng, spill_dir=str(tmp_path))
+    _park(store, eng, "a", 0)
+    store.spill_all(0.0)
+    (name,) = os.listdir(tmp_path)
+    path = os.path.join(tmp_path, name)
+    raw = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(raw[:len(raw) - 5])
+    eng2 = FakeEngine()
+    store2 = SessionStore(eng2, spill_dir=str(tmp_path))
+    assert store2.prepare("a", 0.0) == ""  # degraded, not raised
+    assert eng2.parked == {}
+    assert not os.listdir(tmp_path)  # poisoned file quarantined
+    assert store2.stats()["evictions"] == 1
+    assert store2.prepare("a", 1.0) == ""  # never retried
+
+
+def test_disk_gc_keeps_newest_k(tmp_path):
+    eng = FakeEngine()
+    store = SessionStore(eng, spill_dir=str(tmp_path), disk_keep=2)
+    for i, sid in enumerate(["a", "b", "c", "d"]):
+        _park(store, eng, sid, 0)
+        store.spill_all(float(i))
+    st = store.stats()
+    assert st["disk"] == 2 and st["evictions"] == 2
+    assert len(os.listdir(tmp_path)) == 2
+    # the two newest survive
+    assert store.prepare("d", 9.0) == "disk"
+    eng.parked.clear()
+    assert store.prepare("c", 9.0) == "disk"
+    assert store.prepare("a", 9.0) == ""
+
+
+def test_host_cap_drops_without_spill_dir():
+    eng = FakeEngine()
+    # each state is ~ (8*4 + 64*4) bytes; cap admits one, not two
+    store = SessionStore(eng, spill_dir=None, host_cap_bytes=400,
+                         device_idle_s=1.0)
+    _park(store, eng, "a", 0)
+    _park(store, eng, "b", 1)
+    store.tick(2.0)  # both demote; cap evicts the older host entry
+    st = store.stats()
+    assert st["host"] == 1
+    assert st["host_bytes"] <= 400
+    assert st["evictions"] == 1
+    assert st["disk"] == 0
+
+
+def test_promote_fault_degrades_to_cold(tmp_path):
+    eng = FakeEngine()
+    store = SessionStore(eng, spill_dir=str(tmp_path),
+                         device_idle_s=1.0)
+    _park(store, eng, "a", 0)
+    store.tick(2.0)
+    faults.install("kv.promote:error:1", seed=0)
+    try:
+        assert store.prepare("a", 2.5) == ""  # degraded, no raise
+    finally:
+        faults.uninstall()
+    assert store.stats()["host"] == 1  # still parked in host RAM
+    assert store.prepare("a", 3.0) == "host"  # recovers after the fault
+
+
+def test_export_host_and_disk_single_owner(tmp_path):
+    eng = FakeEngine()
+    store = SessionStore(eng, spill_dir=str(tmp_path),
+                         device_idle_s=1.0, host_idle_s=1.0)
+    _park(store, eng, "a", 0)
+    store.tick(2.0)  # -> host
+    raw = store.export_session("a")
+    assert load_payload(raw)["session_id"] == "a"
+    assert store.stats()["host"] == 0  # single owner: local copy gone
+    with pytest.raises(KeyError):
+        store.export_session("a")
+    _park(store, eng, "b", 0)
+    store.spill_all(0.0)  # -> disk
+    raw = store.export_session("b")
+    assert load_payload(raw)["session_id"] == "b"
+    assert not os.listdir(tmp_path)
+    with pytest.raises(KeyError):
+        store.export_session("b")
+
+
+def test_export_device_via_scheduler_tick(tmp_path):
+    eng = FakeEngine()
+    store = SessionStore(eng, spill_dir=str(tmp_path))
+    _park(store, eng, "a", 0)
+    box = {}
+
+    def exporter():
+        box["raw"] = store.export_session("a", timeout_s=10.0)
+
+    t = threading.Thread(target=exporter)
+    t.start()
+    deadline = time.monotonic() + 10.0
+    while t.is_alive() and time.monotonic() < deadline:
+        store.tick(0.0)  # scheduler services the queued export
+        time.sleep(0.01)
+    t.join(timeout=1.0)
+    assert load_payload(box["raw"])["session_id"] == "a"
+    assert eng.parked == {}  # device copy handed off
+    assert store.stats()["device"] == 0
+
+
+def test_import_payload_installs_host_entry(tmp_path):
+    eng = FakeEngine()
+    store = SessionStore(eng, spill_dir=str(tmp_path))
+    sid = store.import_payload(dump_payload(_state("moved")), 0.0)
+    assert sid == "moved"
+    assert store.stats()["host"] == 1
+    assert store.prepare("moved", 0.5) == "host"
+    with pytest.raises(MigrateError):
+        store.import_payload(dump_payload({"kind": "kv"}), 0.0)
+
+
+def test_import_supersedes_device_copy_on_next_tick(tmp_path):
+    eng = FakeEngine()
+    store = SessionStore(eng, spill_dir=str(tmp_path))
+    _park(store, eng, "a", 0)
+    store.import_payload(dump_payload(_state("a")), 0.0)
+    store.tick(0.1)  # stale device slot discarded by the scheduler
+    assert eng.discarded == [0]
+    assert store.stats()["host"] == 1 and store.stats()["device"] == 0
+
+
+def test_note_parked_supersedes_older_tiers(tmp_path):
+    eng = FakeEngine()
+    store = SessionStore(eng, spill_dir=str(tmp_path))
+    _park(store, eng, "a", 0)
+    _park(store, eng, "a", 1)  # newer turn parked on another slot
+    assert eng.discarded == [0]
+    assert store.stats()["device"] == 1
+
+
+def test_demote_for_pages_frees_closest_to_idle():
+    eng = FakeEngine()
+    store = SessionStore(eng, device_idle_s=1.0)
+    assert store.demote_for_pages(0.0) is False  # nothing to give
+    _park(store, eng, "a", 0, now_s=0.0)
+    _park(store, eng, "b", 1, now_s=5.0)
+    assert store.demote_for_pages(6.0) is True
+    st = store.stats()
+    assert st["host"] == 1 and st["device"] == 1
+    assert 1 in eng.parked and 0 not in eng.parked  # oldest went
+
+
+def test_slot_pressure_tick_demotes():
+    eng = FakeEngine(n_slots=1)
+    store = SessionStore(eng, device_idle_s=1000.0)
+    _park(store, eng, "a", 0)
+    store.tick(1.0)  # not idle: stays
+    assert store.stats()["device"] == 1
+    store.tick(1.0, slot_pressure=True)
+    assert store.stats()["device"] == 0 and store.stats()["host"] == 1
+    assert eng.free_slots() == [0]
+
+
+def test_stats_schema_matches_empty():
+    store = SessionStore(FakeEngine())
+    assert set(store.stats()) == set(empty_tier_stats())
+    assert store.stats() == empty_tier_stats()
+
+
+def test_spill_filenames_newest_seq_wins(tmp_path):
+    # two generations of the same session on disk: the rescan keeps
+    # the newest seq and deletes the stale prefix file
+    h = sid_hash("s")
+    state = _state("s")
+    for seq in (3, 7):
+        with open(os.path.join(tmp_path,
+                               f"{h}-{seq:08d}{kv_tier._SPILL_SUFFIX}"),
+                  "wb") as f:
+            f.write(dump_payload(state))
+    store = SessionStore(FakeEngine(), spill_dir=str(tmp_path))
+    assert store.stats()["disk"] == 1
+    (name,) = os.listdir(tmp_path)
+    assert name == f"{h}-{7:08d}{kv_tier._SPILL_SUFFIX}"
+
+
+# -- byte-identity on the real engine --------------------------------------
+
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from tpu_k8s_device_plugin.workloads.grammar import (  # noqa: E402
+    regex_to_dfa,
+    token_dfa,
+)
+from tpu_k8s_device_plugin.workloads.inference import make_decoder  # noqa: E402
+from tpu_k8s_device_plugin.workloads.serving import ServingEngine  # noqa: E402
+
+CFG = dict(vocab=96, d_model=64, n_heads=4, n_layers=2, d_ff=128)
+MAX_LEN = 64
+EOS = 0
+PATTERN = "(AB|CD)+E"
+SID = "conv-1"
+P1 = list(range(1, 13))
+P2 = [33, 34, 35]
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model = make_decoder(**CFG, max_len=MAX_LEN, dtype=jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (1, 8))
+    params = model.init(rng, tokens, pos)["params"]
+    tb = [bytes([i]) if i else b"" for i in range(CFG["vocab"])]
+    dfa = token_dfa(regex_to_dfa(PATTERN), tb, eos_id=EOS)
+    return model, params, dfa
+
+
+def _mk(model, params, dfa):
+    return ServingEngine(model, params, n_slots=3, chunk=8,
+                         max_new_tokens=6, auto_prefix_min=4,
+                         grammar=dfa, kv_paging=True)
+
+
+def _turn(eng, prompt, **kw):
+    s = eng.admit(list(prompt), **kw)
+    while not eng.finished(s):
+        eng.step()
+    return s, eng.output(s)
+
+
+def _turn1_parked(eng):
+    """Run turn 1 of the conversation and park it as SID's device
+    tier; returns the chained turn-2 prompt."""
+    s, out1 = _turn(eng, P1, session=SID)
+    eng.park_session(s, SID, len(out1))
+    return P1 + out1 + P2
+
+
+TURN2 = [
+    ("greedy", {}),
+    ("sampled", dict(temperature=0.8, seed=7)),
+    ("grammar", dict(grammar=True)),
+]
+
+
+@pytest.mark.parametrize("name,kw", TURN2, ids=[t[0] for t in TURN2])
+def test_resume_byte_identity_all_tiers(setup, name, kw):
+    model, params, dfa = setup
+    # oracle: cold full re-prefill of the chained prompt, no session
+    cold = _mk(model, params, dfa)
+    chain = P1 + _turn(cold, P1)[1] + P2
+    _, want = _turn(_mk(model, params, dfa), chain, **kw)
+
+    # device tier: parked record answers the next turn in place
+    eng = _mk(model, params, dfa)
+    chain_d = _turn1_parked(eng)
+    assert chain_d == chain
+    _, got = _turn(eng, chain, session=SID, **kw)
+    assert got == want, f"device tier diverged ({name})"
+
+    # host tier: demote -> resume round-trip through the checkpoint
+    eng = _mk(model, params, dfa)
+    _turn1_parked(eng)
+    slot = eng.session_slots()[SID]
+    eng.resume_session(eng.demote_session(slot))
+    _, got = _turn(eng, chain, session=SID, **kw)
+    assert got == want, f"host tier diverged ({name})"
+
+    # disk tier: the migrate codec is the on-disk / wire format
+    eng = _mk(model, params, dfa)
+    _turn1_parked(eng)
+    slot = eng.session_slots()[SID]
+    raw = dump_payload(eng.demote_session(slot))
+    eng.resume_session(load_payload(raw))
+    _, got = _turn(eng, chain, session=SID, **kw)
+    assert got == want, f"disk tier diverged ({name})"
+
+    # replica loss: the checkpoint resumes on a SECOND engine (fresh
+    # process after a crash, or the cross-replica move target)
+    eng2 = _mk(model, params, dfa)
+    eng2.resume_session(load_payload(raw))
+    _, got = _turn(eng2, chain, session=SID, **kw)
+    assert got == want, f"respawned replica diverged ({name})"
+
+
+def test_session_record_is_conversation_private(setup):
+    model, params, dfa = setup
+    eng = _mk(model, params, dfa)
+    chain = _turn1_parked(eng)
+    # a foreign session sharing the prefix must NOT take the parked
+    # record (its rows belong to SID's conversation)...
+    _, other = _turn(eng, chain, session="intruder")
+    # ...and anonymous traffic must not either
+    eng3 = _mk(model, params, dfa)
+    _turn1_parked(eng3)
+    _, anon = _turn(eng3, chain)
+    _, want = _turn(_mk(model, params, dfa), chain)
+    assert other == want and anon == want
+    assert SID in eng.session_slots()  # record survived the foreigner
+
+
+def test_store_with_real_engine_full_cycle(setup, tmp_path):
+    """SessionStore driving the real engine end to end: park ->
+    idle-demote -> spill -> store death -> rehydrate on a fresh
+    engine+store -> byte-identical turn 2."""
+    model, params, dfa = setup
+    cold = _mk(model, params, dfa)
+    chain = P1 + _turn(cold, P1)[1] + P2
+    _, want = _turn(_mk(model, params, dfa), chain)
+
+    eng = _mk(model, params, dfa)
+    store = SessionStore(eng, spill_dir=str(tmp_path),
+                         device_idle_s=1.0, host_idle_s=1.0)
+    s, out1 = _turn(eng, P1, session=SID)
+    eng.park_session(s, SID, len(out1))
+    store.note_parked(SID, s, 0.0)
+    store.tick(2.0)
+    store.tick(5.0)
+    assert store.stats()["disk"] == 1
+    del store, eng
+
+    eng2 = _mk(model, params, dfa)
+    store2 = SessionStore(eng2, spill_dir=str(tmp_path))
+    assert store2.prepare(SID, 0.0) == "disk"
+    _, got = _turn(eng2, chain, session=SID)
+    assert got == want
+
+
+# -- server surface --------------------------------------------------------
+
+
+import http.client  # noqa: E402
+import json  # noqa: E402
+
+from tpu_k8s_device_plugin.workloads.migrate import (  # noqa: E402
+    MIGRATE_CONTENT_TYPE,
+)
+from tpu_k8s_device_plugin.workloads.server import EngineServer  # noqa: E402
+
+
+def _post_raw(port, path, body, ctype):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    try:
+        conn.request("POST", path, body, {"Content-Type": ctype})
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _gen(port, tokens, sid=None):
+    payload = {"tokens": list(tokens), "max_new_tokens": 6,
+               "stream": False}
+    if sid is not None:
+        payload["session_id"] = sid
+    status, body = _post_raw(port, "/generate",
+                             json.dumps(payload), "application/json")
+    if status != 200:
+        return status, None
+    return status, json.loads(body.decode().strip())["tokens"]
+
+
+def _statz(port):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("GET", "/statz")
+        return json.loads(conn.getresponse().read())
+    finally:
+        conn.close()
+
+
+@pytest.fixture()
+def session_servers(setup, tmp_path):
+    model, params, dfa = setup
+    srvs = []
+    for i in range(2):
+        eng = ServingEngine(model, params, n_slots=3, chunk=8,
+                            auto_prefix_min=4, kv_paging=True)
+        srv = EngineServer(eng, max_new_tokens=6, window=4,
+                           session_tier=True,
+                           session_dir=str(tmp_path / f"r{i}"))
+        srv.start(host="127.0.0.1", port=0)
+        srvs.append(srv)
+    yield srvs
+    for s in srvs:
+        s.stop()
+
+
+def test_server_warm_hit_and_promote_fault_stays_200(session_servers):
+    a, _ = session_servers
+    st, out1 = _gen(a.port, P1, "s1")
+    assert st == 200
+    chain = P1 + out1 + P2
+    st, warm = _gen(a.port, chain, "s1")
+    assert st == 200
+    tiers = _statz(a.port)["kv_tiers"]
+    assert tiers["hits"]["device"] >= 1
+    assert tiers["device"] >= 1
+    # forced promotion fault: the request must still answer 200 with
+    # the same bytes — tiering failure degrades to transparent
+    # re-prefill, never a 5xx
+    faults.install("kv.promote:error:1", seed=0)
+    try:
+        st, again = _gen(a.port, chain, "s1")
+    finally:
+        faults.uninstall()
+    assert st == 200
+    assert again == warm
+
+
+def test_server_session_moves_across_replicas(session_servers):
+    a, b = session_servers
+    st, out1 = _gen(a.port, P1, "mv")
+    assert st == 200
+    chain = P1 + out1 + P2
+    # oracle from the untouched replica before any session lands there
+    st, want = _gen(b.port, chain)
+    assert st == 200
+    # single-owner move: export from a, import into b
+    st, payload = _post_raw(a.port, "/session/export",
+                            json.dumps({"session_id": "mv"}),
+                            "application/json")
+    assert st == 200
+    st, _ = _post_raw(a.port, "/session/export",
+                      json.dumps({"session_id": "mv"}),
+                      "application/json")
+    assert st == 404  # the local copy moved out
+    st, body = _post_raw(b.port, "/session/import", payload,
+                         MIGRATE_CONTENT_TYPE)
+    assert st == 200
+    assert json.loads(body)["session"] == sid_hash("mv")
+    assert _statz(b.port)["kv_tiers"]["host"] == 1
+    # the moved conversation warm-resumes on b, byte-identically
+    st, got = _gen(b.port, chain, "mv")
+    assert st == 200
+    assert got == want
+    assert _statz(b.port)["kv_tiers"]["hits"]["host"] >= 1
+    # garbage payload is a 400, not a crash
+    st, _ = _post_raw(b.port, "/session/import", b"junk",
+                      MIGRATE_CONTENT_TYPE)
+    assert st == 400

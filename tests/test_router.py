@@ -251,6 +251,67 @@ def test_tenant_ring_deterministic_and_pick_pin():
     assert rep is not None and rep.rid == other and hit
 
 
+def test_session_ring_deterministic_and_home_wins():
+    reps = [{"address": f"127.0.0.1:{9000 + i}",
+             "replica_id": f"replica-{i}"} for i in range(4)]
+    rt1 = _mk_router()
+    for r in reps:
+        rt1.register(dict(r))
+    rt2 = _mk_router()
+    for r in reversed(reps):
+        rt2.register(dict(r))
+    sids = [f"user-{i}/chat-{i}" for i in range(24)]
+    t1 = [rt1.session_target(s) for s in sids]
+    assert t1 == [rt2.session_target(s) for s in sids]
+    assert len(set(t1)) > 1            # the hash actually spreads
+    assert rt1.session_target("") is None
+    # once served somewhere, the recorded home beats the ring
+    ring_pick = rt1.session_target("user-0/chat-0")
+    other = next(r["replica_id"] for r in reps
+                 if r["replica_id"] != ring_pick)
+    rt1._note_session_home("user-0/chat-0", other)
+    assert rt1.session_target("user-0/chat-0") == other
+    # other sessions stay on their ring verdicts
+    assert [rt1.session_target(s) for s in sids[1:]] == t1[1:]
+
+
+def test_session_affinity_off_switch():
+    rt = _mk_router(session_affinity=False)
+    rt.register({"address": "127.0.0.1:9000", "replica_id": "r0"})
+    assert rt.session_target("user-1/c") is None
+    rt._note_session_home("user-1/c", "r0")
+    assert rt.session_target("user-1/c") is None
+
+
+def test_session_home_lru_bounded():
+    rt = _mk_router(session_home_max=3)
+    rt.register({"address": "127.0.0.1:9000", "replica_id": "r0"})
+    for i in range(5):
+        rt._note_session_home(f"s{i}", "r0")
+    assert len(rt._session_home) == 3
+    assert set(rt._session_home) == {"s2", "s3", "s4"}
+    # re-noting refreshes recency
+    rt._note_session_home("s2", "r0")
+    rt._note_session_home("s5", "r0")
+    assert "s2" in rt._session_home and "s3" not in rt._session_home
+
+
+def test_session_of_matches_replica_scoping():
+    f = RouterServer._session_of
+    assert f({"session_id": "abc"}) == "abc"
+    assert f({"session": "abc"}) == "abc"
+    # native session_id is already fully qualified: tenant present or
+    # not, it hashes as-is
+    assert f({"session_id": "abc", "tenant": "t"}) == "abc"
+    # OpenAI bodies scope session under user — the same string the
+    # replica's _openai_to_native builds
+    assert f({"session": "chat1", "user": "alice"}) == "alice/chat1"
+    assert f({"session_id": "x", "user": "alice"}) == "x"
+    assert f({}) == ""
+    assert f({"session": ""}) == ""
+    assert f({"tokens": [1, 2]}) == ""
+
+
 def test_router_tenant_quota_charges_and_sheds():
     from tpu_k8s_device_plugin.workloads.qos import (
         parse_tenant_quotas,
@@ -842,8 +903,14 @@ def test_statz_lockstep_with_metrics(engine_stack):
     assert set(statz) == {
         "scheduler_alive", "queue_depth", "in_flight", "capacity",
         "kv_pages", "kv_pages_free", "requests_served", "role",
-        "migrations", "shed", "goodput", "alerts"}
+        "migrations", "shed", "kv_tiers", "goodput", "alerts"}
     assert set(statz["alerts"]) == {"firing", "pending", "firing_page"}
+    # session tiering off on this server: the block is the fixed
+    # empty schema, never absent (fleet aggregation must not branch)
+    assert set(statz["kv_tiers"]) == {
+        "device", "host", "host_bytes", "disk", "disk_bytes",
+        "hits", "demotions", "promotions", "evictions"}
+    assert statz["kv_tiers"]["device"] == 0
     assert set(statz["shed"]) == {"connections", "queue", "quota"}
     assert set(statz["goodput"]) == {"window_s", "classes"}
     assert statz["role"] == "mixed"

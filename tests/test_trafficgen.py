@@ -22,6 +22,7 @@ from tpu_k8s_device_plugin.workloads.trafficgen import (
     load_trace,
     loads_trace,
     main,
+    parse_session_revisit,
     parse_tenant_mix,
     summarize,
     write_trace,
@@ -232,6 +233,61 @@ def test_parse_tenant_mix():
         parse_tenant_mix(":3")
 
 
+def test_session_revisit_deterministic_and_consistent():
+    cfg = dataclasses.replace(CFG, session_revisit=(0.5, 1000.0))
+    reqs = generate(cfg, 11)
+    assert all(r.session for r in reqs)
+    seen = set()
+    for r in reqs:
+        if r.cont:
+            assert r.session in seen  # revisits target earlier sessions
+        seen.add(r.session)
+    s = summarize(reqs)
+    assert s["revisits"] > 0
+    assert s["sessions"] == len(seen)
+    assert s["sessions"] + s["revisits"] == len(reqs)
+    # revisit gaps advance the clock, never rewind it
+    ts = [r.t_ms for r in reqs]
+    assert ts == sorted(ts)
+    # the session dimension is part of the determinism contract
+    assert dumps_trace(cfg, 11, generate(cfg, 11)) \
+        == dumps_trace(cfg, 11, reqs)
+
+
+def test_unsessioned_trace_unchanged_by_revisit_field():
+    # session_revisit=None must add ZERO rng draws and ZERO record
+    # keys: a pre-existing trace config regenerates byte-identically
+    explicit = dataclasses.replace(CFG, session_revisit=None)
+    a = [json.dumps(r.to_record()) for r in generate(CFG, 7)]
+    b = [json.dumps(r.to_record()) for r in generate(explicit, 7)]
+    assert a == b
+    assert all('"session"' not in line for line in a)
+
+
+def test_session_fields_round_trip(tmp_path):
+    cfg = dataclasses.replace(CFG, session_revisit=(0.4, 500.0))
+    path = tmp_path / "sess.jsonl"
+    write_trace(str(path), cfg, 9, generate(cfg, 9))
+    _, back = load_trace(str(path))
+    orig = generate(cfg, 9)
+    assert [(r.session, r.cont) for r in back] \
+        == [(r.session, r.cont) for r in orig]
+
+
+def test_parse_session_revisit():
+    assert parse_session_revisit(None) is None
+    assert parse_session_revisit("") is None
+    assert parse_session_revisit("0.3") == (0.3, 1000.0)
+    assert parse_session_revisit("0.3:500") == (0.3, 500.0)
+    assert parse_session_revisit("0:0") == (0.0, 0.0)
+    with pytest.raises(ValueError):
+        parse_session_revisit("1.5")
+    with pytest.raises(ValueError):
+        parse_session_revisit("0.3:-1")
+    with pytest.raises(ValueError):
+        parse_session_revisit("nope")
+
+
 def test_config_validation():
     with pytest.raises(ValueError):
         TraceConfig(n_requests=0)
@@ -245,6 +301,10 @@ def test_config_validation():
         TraceConfig(tenants=("a", "b"), tenant_weights=(1.0,))
     with pytest.raises(ValueError):
         TraceConfig(tenants=("a",), tenant_weights=(0.0,))
+    with pytest.raises(ValueError):
+        TraceConfig(session_revisit=(1.5, 0.0))
+    with pytest.raises(ValueError):
+        TraceConfig(session_revisit=(0.5, -1.0))
 
 
 def test_cli_writes_loadable_trace(tmp_path, capsys):

@@ -1650,6 +1650,211 @@ def _reshape_slice(tmp, testdata, seed, suffix, grace, hb_timeout):
     return coordinator, recorder, registry, hosts
 
 
+def episode_session_spill_crash_resume(seed):
+    """Episode 17: session KV tiering through a replica SIGKILL.  A
+    conversation idles down the full tier chain (device park -> host
+    checkpoint -> crash-safe .kvs spill file) on a REAL replica
+    subprocess, the replica is SIGKILLed with the session spilled, and
+    the respawned generation — same CLI, same --session-dir — serves
+    the returning session's next turn BYTE-IDENTICALLY to an
+    uninterrupted control replica that kept the conversation
+    device-parked the whole time.  An in-process probe replica runs
+    the same chain with its flight recorder visible, proving every
+    transition journaled (tpu_kv_park / demote / spill / promote);
+    the subprocess legs are proven on their /statz + /metrics
+    surfaces (tpu_kv_tier_demotions_total{tier=disk} before the kill,
+    tpu_kv_tier_{hits,promotions}_total{tier=disk} after respawn)."""
+    import http.client
+    import json
+    import shutil as ep_shutil
+    import subprocess
+    import tempfile
+
+    from tpu_k8s_device_plugin.workloads.bench_serving import (
+        _free_port,
+        _wait_http_ok,
+        build_model_and_params,
+    )
+    from tpu_k8s_device_plugin.workloads.server import EngineServer
+    from tpu_k8s_device_plugin.workloads.serving import ServingEngine
+
+    tmp = tempfile.mkdtemp(prefix="chaos-kv-tier-")
+    cfg, model, params = build_model_and_params("tiny", 256, False)
+    eos = getattr(cfg, "eos_id", None)
+
+    def mk_server(sub, idle_s, host_idle_s):
+        eng = ServingEngine(model, params, n_slots=4, eos_id=eos,
+                            kv_paging=True)
+        return EngineServer(eng, max_new_tokens=64, window=4,
+                            session_tier=True,
+                            session_dir=os.path.join(tmp, sub),
+                            session_idle_s=idle_s,
+                            session_host_idle_s=host_idle_s,
+                            session_seed=seed)
+
+    # control: generous timers — the conversation never leaves the
+    # device tier, so its turn 2 is the uninterrupted oracle
+    control = mk_server("ctrl", 3600.0, 3600.0)
+    control.start(host="127.0.0.1", port=0)
+    # probe: soak-speed timers + a visible flight recorder
+    probe = mk_server("probe", 0.3, 0.3)
+    probe.start(host="127.0.0.1", port=0)
+
+    # victim: a REAL replica subprocess (the CLI a pod runs) — the
+    # SIGKILL is a kill, and only the .kvs files survive it
+    victim_port = _free_port()
+    victim_dir = os.path.join(tmp, "victim")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+
+    def spawn_victim():
+        return subprocess.Popen(
+            [sys.executable, "-m",
+             "tpu_k8s_device_plugin.workloads.server",
+             "--config", "tiny", "--n-slots", "4", "--max-len", "256",
+             "--max-new-tokens", "64", "--window", "4", "--kv-paging",
+             "--session-tier", "--session-dir", victim_dir,
+             "--session-idle", "0.3", "--session-host-idle", "0.3",
+             "--session-seed", str(seed),
+             "--host", "127.0.0.1", "--port", str(victim_port)],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+
+    victim = spawn_victim()
+    respawn = None
+
+    p1 = [(i * 7) % 255 + 1 for i in range(24)]
+    p2 = [9, 8, 7]
+    sid = "soak-conv"
+
+    def gen(port, tokens, session=None):
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=120)
+        try:
+            payload = {"tokens": list(tokens), "max_new_tokens": 12,
+                       "stream": False, "ignore_eos": True}
+            if session is not None:
+                payload["session_id"] = session
+            conn.request("POST", "/generate", json.dumps(payload),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = json.loads(resp.read())
+            return resp.status, body.get("tokens")
+        finally:
+            conn.close()
+
+    def statz(port):
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=30)
+        try:
+            conn.request("GET", "/statz")
+            return json.loads(conn.getresponse().read())
+        finally:
+            conn.close()
+
+    def tier_metrics(port):
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=30)
+        try:
+            conn.request("GET", "/metrics")
+            text = conn.getresponse().read().decode()
+            return obs.parse_exposition(text)
+        finally:
+            conn.close()
+
+    try:
+        # -- uninterrupted control conversation ------------------------
+        st, out1 = gen(control.port, p1, sid)
+        check(st == 200 and out1, "control turn 1 answered 200")
+        chain = p1 + out1 + p2
+        st, want = gen(control.port, chain, sid)
+        check(st == 200 and want, "control turn 2 answered 200")
+        check(statz(control.port)["kv_tiers"]["hits"]["device"] >= 1,
+              "control turn 2 was a device-tier warm hit")
+        check(bool(control.recorder.events(name="tpu_kv_park")),
+              "session park journaled on the control replica")
+
+        # -- journal probe: the full tier chain in one process ---------
+        st, out1p = gen(probe.port, p1, sid)
+        check(st == 200 and out1p == out1,
+              "probe turn 1 matches control bit-for-bit")
+        deadline = time.monotonic() + 30.0
+        while (time.monotonic() < deadline
+               and statz(probe.port)["kv_tiers"]["disk"] < 1):
+            time.sleep(0.1)
+        check(statz(probe.port)["kv_tiers"]["disk"] >= 1,
+              "probe session idled down to the disk tier")
+        for name in ("tpu_kv_park", "tpu_kv_demote", "tpu_kv_spill"):
+            check(bool(probe.recorder.events(name=name)),
+                  f"{name} journaled on the probe replica")
+        st, got = gen(probe.port, chain, sid)
+        check(st == 200 and got == want,
+              "probe disk-tier resume byte-identical to the "
+              "uninterrupted control")
+        promoted = [e for e in probe.recorder.events(
+            name="tpu_kv_promote")
+            if e["attrs"].get("tier") == "disk"
+            and e["attrs"].get("outcome") == "ok"]
+        check(bool(promoted), "disk promotion journaled on the probe")
+
+        # -- victim: spill, SIGKILL, respawn from the same dir ---------
+        _wait_http_ok(victim_port, "/healthz", 600)
+        st, out1v = gen(victim_port, p1, sid)
+        check(st == 200 and out1v == out1,
+              "victim turn 1 matches control (deterministic params "
+              "across processes)")
+        _wait_http_ok(victim_port, "/statz", 60,
+                      lambda b: b["kv_tiers"]["disk"] >= 1)
+        demoted = [v for n, lab, v in tier_metrics(victim_port)
+                   if n == "tpu_kv_tier_demotions_total"
+                   and lab.get("tier") == "disk"]
+        check(bool(demoted) and sum(demoted) >= 1,
+              "tpu_kv_tier_demotions_total{tier=disk} counted on the "
+              "victim before the kill")
+        victim.kill()          # SIGKILL: no drain, no spill_all
+        victim.wait(timeout=30)
+        spills = [f for f in os.listdir(victim_dir)
+                  if f.endswith(".kvs")]
+        check(bool(spills), "spill file survived the SIGKILL")
+
+        respawn = spawn_victim()
+        _wait_http_ok(victim_port, "/healthz", 600)
+        check(statz(victim_port)["kv_tiers"]["disk"] >= 1,
+              "respawned generation inherited the spilled session "
+              "from the filenames alone")
+        st, got = gen(victim_port, chain, sid)
+        check(st == 200, "post-crash turn 2 answered 200")
+        check(got == want,
+              "post-crash resume byte-identical to uninterrupted "
+              "serving")
+        samples = tier_metrics(victim_port)
+        hits = [v for n, lab, v in samples
+                if n == "tpu_kv_tier_hits_total"
+                and lab.get("tier") == "disk"]
+        check(bool(hits) and sum(hits) >= 1,
+              "tpu_kv_tier_hits_total{tier=disk} counted after "
+              "respawn")
+        promos = [v for n, lab, v in samples
+                  if n == "tpu_kv_tier_promotions_total"
+                  and lab.get("tier") == "disk"
+                  and lab.get("outcome") == "ok"]
+        check(bool(promos) and sum(promos) >= 1,
+              "tpu_kv_tier_promotions_total{tier=disk,outcome=ok} "
+              "counted after respawn")
+    finally:
+        control.stop()
+        probe.stop()
+        for proc in (victim, respawn):
+            if proc is not None:
+                proc.kill()
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    pass
+        ep_shutil.rmtree(tmp, ignore_errors=True)
+
+
 def episode_member_loss_reshape(testdata, tmp, seed):
     """(7) Member loss mid-traffic: staleness demotes the slice
     (demote-all while the member might return), the grace window
@@ -1880,6 +2085,9 @@ def main(argv=None) -> int:
             log.info("=== episode 16: SIGKILL mid-burst writes the "
                      "fleet incident bundle ===")
             episode_fleet_incident_bundle(args.seed)
+            log.info("=== episode 17: session spill survives a "
+                     "replica SIGKILL ===")
+            episode_session_spill_crash_resume(args.seed)
         # -- final convergence sweep ----------------------------------
         for h in hosts:
             h.pulse()

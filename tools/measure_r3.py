@@ -147,6 +147,16 @@ PHASES = [
     # Compare tokens_per_sec_http_{off,on}, tpot_ms_p99_{off,on},
     # and harvest_ms_per_window_{off,on}.
     ("serving_fused_decode_b8", 2400),
+    # round-18 addition: session KV tiering's resume economics on real
+    # chips.  The CPU gate proves byte-identity across all three tiers
+    # and warm-beats-cold on the proxy; what only hardware can answer
+    # is the tier ladder's actual latency shape at 8B KV sizes — a
+    # device-parked resume is a splice (~0 prefill), a host hit pays a
+    # HBM upload, a disk hit pays codec decode + upload — vs the
+    # re-prefill each one replaces (the payload is MBs per session on
+    # TPU, KBs on tiny).  Compare ttft_warm_{device,host,disk}_ms vs
+    # ttft_cold_ms.
+    ("serving_session_resume_b8", 2400),
 ]
 
 
@@ -476,6 +486,136 @@ def phase_serving_fused_decode_b8():
     return run_decode_heavy("llama3-8b", True, clients=8,
                             n_requests=32, slots=8, steps=64,
                             prompt_len=32, max_len=512)
+
+
+def phase_serving_session_resume_b8():
+    """Session-tier resume ladder on the 8B int8 target: TTFT of a
+    returning conversation's turn 2 when its KV comes back from each
+    tier (device splice / host upload / disk codec-load) vs the cold
+    re-prefill of a chain-shaped prompt — plus the replica's own
+    tier accounting.  One conversation per tier; the tier is staged
+    by letting the park age past the seeded-jitter idle deadlines
+    (0.5s -> host, +2s -> disk) and PROVEN from /statz before the
+    timed turn, so each number is labelled by where the bytes
+    actually came from."""
+    import http.client
+    import json as _json
+    import shutil
+    import tempfile
+    import time
+
+    import numpy as np
+
+    from tpu_k8s_device_plugin.workloads import loadclient
+    from tpu_k8s_device_plugin.workloads.bench_serving import (
+        build_model_and_params,
+    )
+    from tpu_k8s_device_plugin.workloads.server import EngineServer
+    from tpu_k8s_device_plugin.workloads.serving import ServingEngine
+
+    cfg, model, params = build_model_and_params("llama3-8b", 512, True)
+    tmp = tempfile.mkdtemp(prefix="measure-kvs-")
+    eng = ServingEngine(model, params, n_slots=8,
+                        eos_id=getattr(cfg, "eos_id", None),
+                        kv_paging=True)
+    srv = EngineServer(eng, max_new_tokens=64, window=4,
+                       session_tier=True, session_dir=tmp,
+                       session_idle_s=0.5, session_host_idle_s=2.0,
+                       session_seed=0)
+    srv.start(host="127.0.0.1", port=0)
+    rng = np.random.default_rng(0)
+    prompt_len, turn2_len, gen = 96, 8, 16
+
+    def unary(body):
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=600)
+        try:
+            conn.request("POST", "/generate", _json.dumps(body),
+                         {"Content-Type": "application/json"})
+            return _json.loads(conn.getresponse().read())
+        finally:
+            conn.close()
+
+    def statz():
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=60)
+        try:
+            conn.request("GET", "/statz")
+            return _json.loads(conn.getresponse().read())
+        finally:
+            conn.close()
+
+    def ttft_ms(tokens, sid):
+        body = {"tokens": tokens, "max_new_tokens": gen,
+                "ignore_eos": True}
+        if sid is not None:
+            body["session_id"] = sid
+        out = loadclient.stream_request(
+            "127.0.0.1", srv.port, body, timeout_s=600.0)
+        assert out.outcome == loadclient.OUTCOME_OK, out
+        return round(out.ttft_s * 1000.0, 2)
+
+    def wait_tiers(pred, deadline_s=60.0):
+        end = time.time() + deadline_s
+        while time.time() < end:
+            tiers = statz()["kv_tiers"]
+            if pred(tiers):
+                return
+            time.sleep(0.1)
+        raise RuntimeError(f"tier staging stalled: {tiers}")
+
+    try:
+        # cold control FIRST, UNsessioned (nothing parks, nothing to
+        # match): a chain-shaped random prompt pays the full prefill
+        # a tier miss would
+        chain_len = prompt_len + gen + turn2_len
+        cold = [ttft_ms(list(map(int, rng.integers(
+            1, model.vocab, chain_len))), None) for _ in range(3)]
+
+        res = {}
+        # one conversation per tier, staged and MEASURED in an order
+        # whose statz predicates attribute the tier unambiguously:
+        # conv-disk is the only session when disk goes nonzero;
+        # conv-host is in host once NO session remains device-parked
+        # (its own spill deadline is 2s further out); conv-device is
+        # asked back well inside the 0.5s idle window
+        stage_pred = {
+            "disk": lambda t: t["disk"] >= 1,
+            "host": lambda t: t["device"] == 0 and t["host"] >= 1,
+            "device": None,
+        }
+        for tier in ("disk", "host", "device"):
+            p1 = list(map(int, rng.integers(1, model.vocab,
+                                            prompt_len)))
+            out1 = unary({"tokens": p1, "max_new_tokens": gen,
+                          "ignore_eos": True, "stream": False,
+                          "session_id": f"conv-{tier}"})["tokens"]
+            if stage_pred[tier] is not None:
+                wait_tiers(stage_pred[tier])
+            p2 = list(map(int, rng.integers(1, model.vocab,
+                                            turn2_len)))
+            res[f"ttft_warm_{tier}_ms"] = ttft_ms(
+                p1 + out1 + p2, f"conv-{tier}")
+        tiers = statz()["kv_tiers"]
+        for tier in ("device", "host", "disk"):
+            assert tiers["hits"][tier] >= 1, tiers
+        cold_ms = round(float(np.median(cold)), 2)
+        res.update(
+            ttft_cold_ms=cold_ms,
+            ttft_cold_all_ms=cold,
+            speedup_device_x=round(
+                cold_ms / res["ttft_warm_device_ms"], 2),
+            speedup_host_x=round(
+                cold_ms / res["ttft_warm_host_ms"], 2),
+            speedup_disk_x=round(
+                cold_ms / res["ttft_warm_disk_ms"], 2),
+            tier_hits=tiers["hits"], promotions=tiers["promotions"],
+            spill_bytes_disk=tiers["disk_bytes"],
+        )
+        return res
+    finally:
+        srv.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def phase_replica_cold_start():

@@ -45,8 +45,10 @@ import argparse
 import json
 import logging
 import os
+import shutil
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 from dataclasses import dataclass
@@ -200,13 +202,42 @@ def replay_trace(requests: Sequence[TraceRequest], host: str,
     results: List[Optional[RequestResult]] = [None] * len(requests)
     lock = threading.Lock()
 
-    def one(i: int, req: TraceRequest, lag_s: float) -> None:
+    # session continuation (PR 20): a revisit's prompt is the
+    # conversation so far (prior visits' prompts) + the new turn, and
+    # the conversation is CLOSED-loop within itself — a user cannot
+    # send the follow-up before the reply arrives — while the trace
+    # stays open-loop across sessions.  Both are precomputed /
+    # coordinated here so replays are deterministic functions of the
+    # trace, not of runtime interleaving.
+    session_hist: Dict[str, List[int]] = {}
+    chained: List[List[int]] = []
+    for req in requests:
+        if req.session:
+            hist = session_hist.setdefault(req.session, [])
+            chained.append(hist + req.tokens if req.cont
+                           else list(req.tokens))
+            hist.extend(req.tokens)
+        else:
+            chained.append(req.tokens)
+    session_prev: Dict[str, threading.Event] = {}
+
+    def one(i: int, req: TraceRequest, lag_s: float,
+            tokens: List[int], prev_evt: Optional[threading.Event],
+            done_evt: Optional[threading.Event]) -> None:
+        if prev_evt is not None:
+            # think time already paced the dispatch; this only guards
+            # the pathological case where the previous turn is STILL
+            # streaming (bounded — a wedged turn must not wedge the
+            # whole conversation's accounting)
+            prev_evt.wait(timeout_s)
         body: Dict[str, object] = {
-            "tokens": req.tokens,
+            "tokens": tokens,
             "max_new_tokens": req.max_new_tokens,
             "priority": req.priority, "slo_class": req.slo_class,
             "ignore_eos": True,
         }
+        if req.session:
+            body["session_id"] = req.session
         if req.tenant and req.tenant != "default":
             body["tenant"] = req.tenant
         if req.behavior.stream:
@@ -223,6 +254,8 @@ def replay_trace(requests: Sequence[TraceRequest], host: str,
         metrics.observe(res)
         with lock:
             results[i] = res
+        if done_evt is not None:
+            done_evt.set()
 
     threads: List[threading.Thread] = []
     t0 = time.monotonic()
@@ -243,8 +276,15 @@ def replay_trace(requests: Sequence[TraceRequest], host: str,
             if target > now:
                 time.sleep(target - now)
                 now = time.monotonic()
+            prev_evt = done_evt = None
+            if req.session:
+                prev_evt = session_prev.get(req.session)
+                done_evt = threading.Event()
+                session_prev[req.session] = done_evt
             t = threading.Thread(target=one,
-                                 args=(i, req, now - target),
+                                 args=(i, req, now - target,
+                                       chained[i], prev_evt,
+                                       done_evt),
                                  daemon=True)
             t.start()
             threads.append(t)
@@ -400,6 +440,27 @@ def build_report(results: Sequence[RequestResult],
                 len(met) / len(eligible), 4) if eligible else 1.0,
             "outcomes": t_outcomes,
         }
+    # session warm-vs-cold split (PR 20): revisits (cont=True) should
+    # warm-resume their parked KV; first visits pay the full prefill.
+    # The goodput gate asserts warm p95 TTFT beats cold p95 — the
+    # tiering layer's end-to-end latency evidence.
+    sessions_block: Optional[Dict[str, object]] = None
+    sessioned = [r for r in results if r.req.session]
+    if sessioned:
+        def _ttft_stats(rs: List[RequestResult]) -> Dict[str, object]:
+            ttfts = [r.outcome.ttft_s * 1000.0 for r in rs
+                     if r.outcome.ttft_s is not None
+                     and r.outcome.outcome == loadclient.OUTCOME_OK]
+            return {"total": len(rs), "measured": len(ttfts),
+                    "ttft_ms": {"p50": _pct(ttfts, 0.5),
+                                "p95": _pct(ttfts, 0.95)}}
+        sessions_block = {
+            "sessions": len({r.req.session for r in sessioned}),
+            "warm": _ttft_stats(
+                [r for r in sessioned if r.req.cont]),
+            "cold": _ttft_stats(
+                [r for r in sessioned if not r.req.cont]),
+        }
     missed = sorted(
         (r for r in results if r.slo_met is False),
         key=lambda r: -r.outcome.total_s)
@@ -438,6 +499,7 @@ def build_report(results: Sequence[RequestResult],
                           default=0.0) * 1000.0, 3)},
         "classes": classes,
         "tenants": tenants,
+        "sessions": sessions_block,
         "outcomes": outcome_totals,
         "abandoned": outcome_totals.get(
             loadclient.OUTCOME_ABANDONED, 0),
@@ -472,7 +534,8 @@ def _repo_root() -> str:
 
 
 def _spawn_replica(idx: int, port: int, router_port: int,
-                   args: argparse.Namespace
+                   args: argparse.Namespace,
+                   session_dir: Optional[str] = None
                    ) -> "subprocess.Popen[bytes]":
     """One REAL replica subprocess — the CLI a pod runs — so a chaos
     kill is a kill (no graceful drain, sockets die mid-chunk)."""
@@ -492,6 +555,10 @@ def _spawn_replica(idx: int, port: int, router_port: int,
            "--register-interval", "0.3"]
     if args.prefix_chunk > 0:
         cmd += ["--prefix-chunk", str(args.prefix_chunk)]
+    if session_dir is not None:
+        cmd += ["--kv-paging", "--session-tier",
+                "--session-dir", session_dir,
+                "--session-seed", str(args.seed)]
     for spec in args.slo or []:
         cmd += ["--slo", spec]
     return subprocess.Popen(cmd, env=env,
@@ -527,10 +594,18 @@ def run_fleet(args: argparse.Namespace,
                       if isinstance(r, dict) and r.get("healthy"))
         return healthy >= args.replicas
 
+    tier_root: Optional[str] = None
+    if getattr(args, "session_tier", False):
+        # one crash-safe spill dir per replica: exactly what a pod's
+        # emptyDir/PVC mount gives the tiering layer in production
+        tier_root = tempfile.mkdtemp(prefix="replay-kvs-")
     try:
         ports = [loadclient.free_port() for _ in range(args.replicas)]
         for idx, port in enumerate(ports):
-            procs.append(_spawn_replica(idx, port, rt.port, args))
+            sdir = None if tier_root is None \
+                else os.path.join(tier_root, f"r{idx}")
+            procs.append(_spawn_replica(idx, port, rt.port, args,
+                                        session_dir=sdir))
         for port in ports:
             loadclient.wait_http_ok(port, "/healthz", 600.0)
         loadclient.wait_http_ok(rt.port, "/replicas", 60.0,
@@ -656,6 +731,8 @@ def run_fleet(args: argparse.Namespace,
             except subprocess.TimeoutExpired:
                 log.warning("replica pid %d did not exit", proc.pid)
         rt.stop()
+        if tier_root is not None:
+            shutil.rmtree(tier_root, ignore_errors=True)
 
 
 # -- CLI -------------------------------------------------------------------
@@ -698,6 +775,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="replica APC chunk (match the trace's)")
     p.add_argument("--seed", type=int, default=0,
                    help="router seed in fleet mode")
+    p.add_argument("--session-tier", action="store_true",
+                   help="spawn replicas with --kv-paging "
+                        "--session-tier and a per-replica spill dir "
+                        "so sessioned traces warm-resume parked KV "
+                        "(the report's sessions block splits warm vs "
+                        "cold TTFT)")
     p.add_argument("--kill-replica-at-ms", type=float, default=None,
                    help="SIGKILL the last spawned replica at this "
                         "TRACE time (fleet mode only)")
@@ -723,6 +806,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="fail (exit 1) if a class's — or, with the "
                         "tenant: prefix, a tenant's — attainment is "
                         "below RATIO (repeatable)")
+    p.add_argument("--assert-warm-resume", nargs="?", const="",
+                   default=None, metavar="BASELINE_REPORT",
+                   help="gate: revisit (warm) TTFT p95 must come in "
+                        "strictly below cold re-prefill p95.  With a "
+                        "BASELINE_REPORT (the same trace replayed "
+                        "WITHOUT --session-tier) the cold side is "
+                        "that report's revisit p95 — the same chains "
+                        "re-prefilled from scratch, the honest "
+                        "baseline.  Bare, the cold side is this "
+                        "run's first-visit p95 (only meaningful when "
+                        "chains stay near prompt length)")
     p.add_argument("--top-missed", type=int, default=5,
                    help="embed stitched spans for the slowest K "
                         "SLO-missed requests in the report")
@@ -734,6 +828,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         p.error("exactly one of --target / --replicas is required")
     if args.kill_replica_at_ms is not None and not args.replicas:
         p.error("--kill-replica-at-ms needs --replicas (fleet mode)")
+    if args.session_tier and not args.replicas:
+        p.error("--session-tier needs --replicas (fleet mode): it "
+                "configures the spawned replica subprocesses")
+    if args.assert_warm_resume is not None and not args.session_tier:
+        p.error("--assert-warm-resume needs --session-tier (without "
+                "tiering every revisit re-prefills cold)")
 
     header, requests = load_trace(args.trace)
     policies = obs.parse_slo_specs(args.slo) if args.slo \
@@ -802,7 +902,55 @@ def main(argv: Optional[List[str]] = None) -> int:
         else:
             print(f"goodput gate ok: class {name} attainment "
                   f"{got} >= {floor}")
+    if args.assert_warm_resume is not None:
+        rc = max(rc, _warm_resume_gate(report,
+                                       args.assert_warm_resume))
     return rc
+
+
+def _revisit_p95(report: Dict[str, object],
+                 bucket: str) -> Optional[float]:
+    sessions = report.get("sessions")
+    if not isinstance(sessions, dict):
+        return None
+    stats = sessions.get(bucket)
+    if not isinstance(stats, dict):
+        return None
+    ttft = stats.get("ttft_ms")
+    if not isinstance(ttft, dict):
+        return None
+    p95 = ttft.get("p95")
+    return float(p95) if isinstance(p95, (int, float)) else None
+
+
+def _warm_resume_gate(report: Dict[str, object],
+                      baseline_path: str) -> int:
+    """Warm revisits (tier hits) must beat cold re-prefill on TTFT
+    p95.  With a baseline report the cold side is the SAME revisit
+    chains replayed without tiering — the honest like-for-like;
+    without one it is this run's first-visit p95."""
+    w_p95 = _revisit_p95(report, "warm")
+    if baseline_path:
+        with open(baseline_path, encoding="utf-8") as fh:
+            baseline = json.load(fh)
+        c_p95 = _revisit_p95(baseline, "warm")
+        cold_name = f"re-prefill p95 ({baseline_path})"
+    else:
+        c_p95 = _revisit_p95(report, "cold")
+        cold_name = "first-visit p95"
+    if w_p95 is None or c_p95 is None:
+        print(f"WARM-RESUME GATE FAIL: missing revisit TTFT stats "
+              f"(warm={w_p95}, cold={c_p95}) — did the trace carry "
+              f"sessioned requests?", file=sys.stderr)
+        return 1
+    if w_p95 >= c_p95:
+        print(f"WARM-RESUME GATE FAIL: warm revisit TTFT p95 "
+              f"{w_p95:.1f}ms not below {cold_name} {c_p95:.1f}ms",
+              file=sys.stderr)
+        return 1
+    print(f"warm-resume gate ok: warm revisit TTFT p95 "
+          f"{w_p95:.1f}ms < {cold_name} {c_p95:.1f}ms")
+    return 0
 
 
 if __name__ == "__main__":

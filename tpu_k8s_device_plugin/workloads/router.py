@@ -144,6 +144,7 @@ import queue
 import threading
 import time
 from bisect import bisect_left
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, HTTPServer
 from urllib.parse import parse_qs, quote, urlparse
@@ -417,6 +418,8 @@ class RouterServer:
                  tenant_quotas: Optional[
                      Dict[str, TenantQuota]] = None,
                  tenant_pinning: bool = True,
+                 session_affinity: bool = True,
+                 session_home_max: int = 4096,
                  default_budget: int = DEFAULT_BUDGET_ESTIMATE,
                  slo_policies: Optional[Dict[str, Any]] = None,
                  alert_rules: Optional[List[Any]] = None,
@@ -459,6 +462,20 @@ class RouterServer:
         self.tenant_quotas: Dict[str, TenantQuota] = dict(
             tenant_quotas or {})
         self.tenant_pinning = bool(tenant_pinning)
+        # session KV tiering (PR 20): a THIRD hash ring plus a bounded
+        # last-served map route a returning conversation back to the
+        # replica holding its warm KV; when the pick still lands
+        # elsewhere (home sick/overloaded), the router MOVES the
+        # parked checkpoint (/session/export -> /session/import)
+        # before forwarding, so the session resumes instead of
+        # re-prefilling.  Every move failure degrades to plain
+        # forwarding — affinity is a latency optimization, never a
+        # correctness dependency
+        self.session_affinity = bool(session_affinity)
+        if session_home_max < 1:
+            raise ValueError("session_home_max must be >= 1")
+        self.session_home_max = session_home_max
+        self._session_home: "OrderedDict[str, str]" = OrderedDict()
         self.default_budget = default_budget
         self._lock = threading.Lock()
         self._replicas: Dict[str, Replica] = {}
@@ -469,6 +486,7 @@ class RouterServer:
         # share of prefix keys
         self._ring: List[Tuple[int, str]] = []
         self._tring: List[Tuple[int, str]] = []
+        self._sring: List[Tuple[int, str]] = []
         self._stop = threading.Event()
         self._httpd: Optional[_PooledRouterHTTPServer] = None
         self._poller: Optional[threading.Thread] = None
@@ -546,6 +564,15 @@ class RouterServer:
             "Requests served by their tenant-ring pinned replica "
             "(sticky tenant->replica placement).")
         self._m_tenant_pins.inc(0)
+        self._m_session_moves = reg.counter(
+            "tpu_router_session_moves_total",
+            "Cross-replica session KV moves attempted when a "
+            "returning session routed away from its home replica: "
+            "ok (checkpoint exported + imported, warm resume), miss "
+            "(home had nothing parked; plain re-prefill), error "
+            "(export/import failed; plain re-prefill).", ("outcome",))
+        for oc in ("ok", "miss", "error"):
+            self._m_session_moves.labels(outcome=oc).inc(0)
         # plain int twin of shed{no_replicas}: fleet_statz surfaces it
         # so the reconciler can see demand arriving at an empty fleet
         # (replica statz cannot carry that signal when there are none)
@@ -690,6 +717,7 @@ class RouterServer:
         same-prompt-same-replica-across-restarts test pins."""
         ring: List[Tuple[int, str]] = []
         tring: List[Tuple[int, str]] = []
+        sring: List[Tuple[int, str]] = []
         for rid in self._replicas:
             for v in range(self.vnodes):
                 ring.append((_sha1_int(f"{rid}#{v}".encode()), rid))
@@ -698,10 +726,17 @@ class RouterServer:
                 # unlucky id should not concentrate both)
                 tring.append(
                     (_sha1_int(f"tenant|{rid}#{v}".encode()), rid))
+                # third salt: SESSION placement independent of both
+                # (a session's home should not follow its tenant's
+                # pin, or one hot tenant concentrates every tier)
+                sring.append(
+                    (_sha1_int(f"session|{rid}#{v}".encode()), rid))
         ring.sort()
         tring.sort()
+        sring.sort()
         self._ring = ring
         self._tring = tring
+        self._sring = sring
 
     def _evict_stale_locked(self) -> List[str]:
         now = _now()
@@ -775,6 +810,132 @@ class RouterServer:
         return self._ring_walk(
             tring, _sha1_int(tenant.encode("utf-8", "surrogatepass")),
             None, None)
+
+    # -- session affinity (PR 20) -------------------------------------------
+
+    @staticmethod
+    def _session_of(parsed: Dict[str, Any]) -> str:
+        """The request's conversation key, exactly as the replicas
+        resolve it: native ``session_id``/``session``, or the OpenAI
+        extension ``session`` scoped under ``user`` (the replica's
+        _openai_to_native mapping) — the router must hash the SAME
+        string the replica keys its tier store on."""
+        sid = parsed.get("session_id")
+        if sid is None:
+            sid = parsed.get("session")
+        if not sid:
+            return ""
+        sid = str(sid)
+        # OpenAI bodies have no session_id key; their session scopes
+        # under user.  Native bodies may carry both session_id and
+        # tenant — session_id is already fully qualified there.
+        if parsed.get("session_id") is None \
+                and parsed.get("user") is not None:
+            return f"{parsed['user']}/{sid}"
+        return sid
+
+    def session_target(self, sid: str) -> Optional[str]:
+        """Where a returning session PREFERS to land: its recorded
+        home (the replica that last served it, and so holds its
+        parked/spilled KV), else the session ring's verdict (same
+        determinism contract as the other two rings)."""
+        if not sid or not self.session_affinity:
+            return None
+        with self._lock:
+            home = self._session_home.get(sid)
+            sring = self._sring
+        if home is not None:
+            return home
+        return self._ring_walk(
+            sring, _sha1_int(sid.encode("utf-8", "surrogatepass")),
+            None, None)
+
+    def _note_session_home(self, sid: str, rid: str) -> None:
+        """Record where *sid* was just served (bounded LRU: an
+        abandoned session's row ages out; its DISK state still
+        survives on the old home for the ring to find)."""
+        if not sid or not self.session_affinity:
+            return
+        with self._lock:
+            self._session_home.pop(sid, None)
+            self._session_home[sid] = rid
+            while len(self._session_home) > self.session_home_max:
+                self._session_home.popitem(last=False)
+
+    def _maybe_move_session(self, sid: str, chosen: Replica,
+                            trace: "obs.TraceContext") -> None:
+        """A returning session is about to be served by a replica
+        that is NOT its home: move the parked checkpoint first
+        (POST /session/export on the home -> /session/import on the
+        chosen replica) so the request warm-resumes there.  Strictly
+        best-effort — any failure (home gone, nothing parked, sick
+        disk, import refused) just forwards the request for a plain
+        re-prefill.  A tiering failure must never fail the request."""
+        if not sid or not self.session_affinity:
+            return
+        with self._lock:
+            home_rid = self._session_home.get(sid)
+            home = (self._replicas.get(home_rid)
+                    if home_rid is not None else None)
+        if home is None or home.rid == chosen.rid \
+                or not self._routable(home):
+            return
+        outcome = "error"
+        try:
+            payload = self._session_export(home, sid)
+            if payload is None:
+                outcome = "miss"
+                return
+            self._session_import(chosen, payload)
+            outcome = "ok"
+        except Exception as e:
+            log.warning("session move %s -> %s failed: %s",
+                        home.rid, chosen.rid, e)
+        finally:
+            self._m_session_moves.labels(outcome=outcome).inc()
+            self.recorder.record(
+                "tpu_router_session_move", trace=trace,
+                session=hashlib.sha1(
+                    sid.encode("utf-8", "surrogatepass")
+                ).hexdigest()[:20],
+                src=home.rid, dst=chosen.rid, outcome=outcome)
+
+    def _session_export(self, rep: Replica,
+                        sid: str) -> Optional[bytes]:
+        """One export attempt against the session's home replica.
+        None = the home has nothing parked under *sid* (404 — a
+        plain miss, not an error); raises on transport/5xx."""
+        host, port = rep.host_port()
+        body = json.dumps({"session_id": sid}).encode()
+        conn = http.client.HTTPConnection(
+            host, port, timeout=self.connect_timeout_s)
+        try:
+            conn.request("POST", "/session/export", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status == 404:
+                return None
+            if resp.status != 200:
+                raise OSError(f"export HTTP {resp.status}")
+            return data
+        finally:
+            conn.close()
+
+    def _session_import(self, rep: Replica, payload: bytes) -> None:
+        host, port = rep.host_port()
+        conn = http.client.HTTPConnection(
+            host, port, timeout=self.connect_timeout_s)
+        try:
+            conn.request("POST", "/session/import", body=payload,
+                         headers={
+                             "Content-Type": MIGRATE_CONTENT_TYPE})
+            resp = conn.getresponse()
+            resp.read()
+            if resp.status != 200:
+                raise OSError(f"import HTTP {resp.status}")
+        finally:
+            conn.close()
 
     def _note_evictions(self, dead: List[str]) -> None:
         for rid in dead:
@@ -857,6 +1018,11 @@ class RouterServer:
                "kv_pages": 0, "kv_pages_free": 0,
                "requests_served": 0}
         shed_agg: Dict[str, int] = {}
+        # session-tier occupancy roll-up (PR 20): parked-conversation
+        # pressure per tier, the signal alert rules and the
+        # autoscaler read for "the fleet is full of idle sessions"
+        tier_agg = {"device": 0, "host": 0, "disk": 0,
+                    "host_bytes": 0, "disk_bytes": 0}
         # per-class goodput aggregation: sums of window met/total
         # re-derive the fleet ratio (a mean of ratios would let an
         # idle replica mask a drowning one)
@@ -887,6 +1053,12 @@ class RouterServer:
                 for k, v in shed.items():
                     if isinstance(v, (int, float)):
                         shed_agg[k] = shed_agg.get(k, 0) + int(v)
+            tiers = statz.get("kv_tiers")
+            if isinstance(tiers, dict):
+                for k in tier_agg:
+                    v = tiers.get(k)
+                    if isinstance(v, (int, float)):
+                        tier_agg[k] += int(v)
             alerts = statz.get("alerts")
             if isinstance(alerts, dict):
                 for f in alerts.get("firing") or []:
@@ -938,6 +1110,7 @@ class RouterServer:
             "replicas": len(reps),
             "healthy": healthy,
             "fleet": {**agg, "shed": shed_agg,
+                      "kv_tiers": tier_agg,
                       "goodput": goodput_out,
                       "firing_alerts": firing_alerts},
             "router": {"no_replica_total": no_replica_total,
@@ -1337,6 +1510,13 @@ class RouterServer:
         }
         pin = (self.tenant_target(tenant)
                if tenant and self.tenant_pinning else None)
+        # session affinity: a returning conversation prefers the
+        # replica holding its warm KV.  Tenant pinning still wins
+        # (quota coherence beats resume latency) — the session moves
+        # its checkpoint to the pinned replica instead.
+        sid = self._session_of(parsed) if parsed else ""
+        if pin is None and sid:
+            pin = self.session_target(sid)
         tried: Set[str] = set()
         conn: Optional[http.client.HTTPConnection] = None
         resp: Optional[http.client.HTTPResponse] = None
@@ -1355,6 +1535,11 @@ class RouterServer:
                     "tpu_router_failover", trace=trace,
                     replica=rep.rid, attempt=attempt)
             tried.add(rep.rid)
+            if sid:
+                # landing away from the session's home: ship its
+                # parked KV over BEFORE the request, so admission
+                # finds a warm checkpoint (best-effort; see helper)
+                self._maybe_move_session(sid, rep, trace)
             t0 = time.perf_counter()
             try:
                 conn, resp = self._open_upstream(
@@ -1405,6 +1590,8 @@ class RouterServer:
                 outcome="unroutable",
                 duration_s=time.perf_counter() - t_arrival)
             return
+        if sid:
+            self._note_session_home(sid, rep.rid)
         self._relay(handler, conn, resp, rep, hit, len(tried), trace,
                     t_arrival)
 
@@ -1909,6 +2096,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "traffic lands on one replica, so the "
                         "replica-local WFQ/quota state is coherent "
                         "per tenant even without router quotas")
+    p.add_argument("--session-affinity", default=True,
+                   action=argparse.BooleanOptionalAction,
+                   help="session KV affinity on a third hash ring "
+                        "(default on): requests carrying session_id "
+                        "prefer the replica holding their parked KV, "
+                        "and when the pick lands elsewhere the router "
+                        "moves the checkpoint over /session/export + "
+                        "/session/import first (best-effort; any "
+                        "failure degrades to plain re-prefill)")
     p.add_argument("--default-budget", type=int,
                    default=DEFAULT_BUDGET_ESTIMATE, metavar="N",
                    help="max-new-tokens estimate for tenant "
@@ -1989,6 +2185,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         prefill_threshold=args.prefill_threshold,
         tenant_quotas=tenant_quotas,
         tenant_pinning=args.tenant_pinning,
+        session_affinity=args.session_affinity,
         default_budget=args.default_budget,
         slo_policies=slo_policies,
         alert_rules=alert_rules,

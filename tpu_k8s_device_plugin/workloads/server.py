@@ -129,6 +129,10 @@ from urllib.parse import parse_qs, urlparse
 
 from tpu_k8s_device_plugin import obs
 from tpu_k8s_device_plugin.resilience import faults
+from tpu_k8s_device_plugin.resilience.policy import (
+    ResilienceMetrics,
+    suppressed,
+)
 
 from .grammar import (
     json_value_regex,
@@ -144,6 +148,7 @@ from .scheduler import (
     IterationScheduler,
 )
 from .kv_pool import PagePoolExhausted
+from .kv_tier import SessionStore, empty_tier_stats, sid_hash
 from .migrate import (
     MIGRATE_CONTENT_TYPE,
     MigrateError,
@@ -521,6 +526,13 @@ class _Request:
     # (its quota was charged at the prefill replica — never twice)
     prefill_only: bool = False
     migrated: bool = False
+    # session KV tiering (PR 20): the conversation key.  The scheduler
+    # warm-promotes the session's parked KV before admission and parks
+    # the finished slot back under it; session_tier records which tier
+    # (if any) served the warm hit, so admission only trusts the
+    # session donor when the store vouched for it
+    session: str = ""
+    session_tier: str = ""
 
 
 class _PooledHTTPServer(HTTPServer):
@@ -671,7 +683,14 @@ class EngineServer:
                  alert_interval_s: float = 5.0,
                  alert_window_scale: float = 1.0,
                  incident_dir: Optional[str] = None,
-                 profiler_hz: float = 19.0):
+                 profiler_hz: float = 19.0,
+                 session_tier: bool = False,
+                 session_dir: Optional[str] = None,
+                 session_host_mb: int = 256,
+                 session_disk_keep: int = 512,
+                 session_idle_s: float = 30.0,
+                 session_host_idle_s: float = 120.0,
+                 session_seed: int = 0):
         """*tokenizer* (anything with ``encode(str) -> List[int]`` and
         ``decode(List[int]) -> str``, e.g. a transformers tokenizer)
         unlocks the text-level surface: ``"prompt"`` strings, STRING
@@ -1004,12 +1023,47 @@ class EngineServer:
                 op="serve.schedule", timeout_s=schedule_watchdog_s,
                 metrics=resilience.ResilienceMetrics(reg),
                 recorder=self.recorder)
+        # -- session KV tiering (PR 20) -----------------------------------
+        # device-parked conversations demote to host RAM and a
+        # crash-safe spill dir on idleness and pressure, and promote
+        # back when the session returns; every transition degrades to
+        # re-prefill, never a failed request
+        self._session_store: Optional[SessionStore] = None
+        if session_tier:
+            if not getattr(engine, "kv_paging", False):
+                raise ValueError(
+                    "session tiering needs a paged engine "
+                    "(kv_paging=True): tier transitions are the paged "
+                    "checkpoint/restore path")
+            if not getattr(engine, "auto_prefix", False):
+                raise ValueError(
+                    "session tiering needs auto_prefix=True: warm "
+                    "resume rides the automatic prefix match")
+            self._session_store = SessionStore(
+                engine, spill_dir=session_dir,
+                host_cap_bytes=session_host_mb * 1024 * 1024,
+                disk_keep=session_disk_keep,
+                device_idle_s=session_idle_s,
+                host_idle_s=session_host_idle_s,
+                seed=session_seed, registry=reg,
+                recorder=self.recorder,
+                rmetrics=ResilienceMetrics(reg))
         # preemption-by-page-eviction: the paged engine escalates a
         # failed page allocation to this policy (scheduler thread) —
-        # checkpoint the lowest-priority running slot to host, free its
-        # pages, re-queue its request for later resume
+        # demote an idle parked session first (its pages are the
+        # cheapest to reclaim), then checkpoint the lowest-priority
+        # running slot to host, free its pages, re-queue its request
+        # for later resume
         if getattr(engine, "kv_paging", False):
-            engine.set_preempt_cb(self._preempt_for_pages)
+            engine.set_preempt_cb(self._page_pressure)
+
+    def _page_pressure(self, exclude_slot: int = -1) -> bool:
+        """Page-pressure escalation order: parked sessions yield
+        before running requests are preempted."""
+        if self._session_store is not None and \
+                self._session_store.demote_for_pages(time.monotonic()):
+            return True
+        return self._preempt_for_pages(exclude_slot)
 
     def _collect_kv(self) -> None:
         """Scrape-time refresh of the KV-pool/QoS/packed-prefill
@@ -1300,6 +1354,18 @@ class EngineServer:
                     wait_dt = time.perf_counter() - req.t_arrival
                     self._m_queue_wait.observe(wait_dt)
                     self._mark(req, "tpu_serve_queue_wait", wait_dt)
+                if (req.session and req.admitted == 0 and req.n == 1
+                        and not req.migrated and not req.prefill_only
+                        and self._session_store is not None):
+                    # warm-promote the conversation's parked KV ahead
+                    # of admission; a host/disk restore lands in its
+                    # own parked slot, so one must stay free for THIS
+                    # admission.  Any failure leaves session_tier
+                    # empty and the request re-prefills — tiering
+                    # never fails a request.
+                    req.session_tier = self._session_store.prepare(
+                        req.session, time.monotonic(),
+                        can_restore=len(eng.free_slots()) >= 2)
                 ticket = self._sched.begin(
                     req.tokens, temperature=req.temperature,
                     top_k=req.top_k, top_p=req.top_p,
@@ -1324,13 +1390,25 @@ class EngineServer:
                                      if req.admitted == 0 else None),
                     logit_bias=req.logit_bias,
                     min_tokens=req.min_tokens,
-                    grammar=gid)
+                    grammar=gid,
+                    # the store vouched for the donor: only a
+                    # warm-promoted session may match its own parked
+                    # record (a cold pass must re-prefill, not half-
+                    # trust whatever is resident)
+                    session=(req.session if req.session_tier
+                             else None))
             except PagePoolExhausted:
-                # page pressure, not a bad request: preempt a
-                # STRICTLY lower-priority running copy and retry this
-                # one (re-entering via _head keeps its heap position);
-                # nothing preemptible means the pool is honestly full
-                # — the request waits its turn
+                # page pressure, not a bad request: demote an idle
+                # parked session first (cheapest pages in the pool),
+                # then preempt a STRICTLY lower-priority running copy
+                # and retry this one (re-entering via _head keeps its
+                # heap position); nothing yieldable means the pool is
+                # honestly full — the request waits its turn
+                if (self._session_store is not None
+                        and self._session_store.demote_for_pages(
+                            time.monotonic())):
+                    self._head = req
+                    continue
                 if (min((r.priority for r, _ in
                          self._running.values()), default=req.priority)
                         < req.priority and self._preempt_for_pages()):
@@ -1548,8 +1626,6 @@ class EngineServer:
             if stop_text is not None:
                 out = tokens[:stop_keep]
                 reason = "stop"
-                if not finished:
-                    eng.release(slot)
             else:
                 full = eng.output(slot)
                 out = full[:req.max_new_tokens]
@@ -1560,8 +1636,16 @@ class EngineServer:
                     # budget cut the stream before (or at) the
                     # engine's retirement point
                     reason = "length"
-                    if not finished:
-                        eng.release(slot)
+            # session tiering: a conversation's retiring slot parks as
+            # its device tier (KV pages + record stay, slot reserved)
+            # instead of releasing — the next turn warm-resumes.
+            # Parking reads the slot's live lens/outputs, so it must
+            # happen HERE, before any release resets them; logprob
+            # records survive the park exactly as they survive a
+            # release.
+            if not self._park_session(req, slot, len(out)) \
+                    and not finished:
+                eng.release(slot)
             choice = {
                 "index": idx,
                 "tokens": [int(t) for t in out],
@@ -1612,6 +1696,28 @@ class EngineServer:
                 self._push(req, done)
                 self._finish_request(req, "ok")
 
+    def _park_session(self, req: "_Request", slot: int,
+                      kept: int) -> bool:
+        """Park the retiring slot as *req*'s session device tier.
+        Returns False — caller releases as before — whenever tiering
+        is off, inapplicable (multi-copy, dropped client, prefill
+        side), or the park fails; parking is strictly best-effort."""
+        store = self._session_store
+        if (store is None or not req.session or req.n != 1
+                or req.dropped or req.prefill_only or req.cancelled):
+            return False
+        try:
+            canon = self.engine.park_session(slot, req.session, kept)
+        except Exception as e:
+            suppressed("server.park_session", e, log)
+            return False
+        now_s = time.monotonic()
+        store.note_parked(req.session, slot, now_s)
+        self.recorder.record(
+            "tpu_kv_park", trace=req.trace, rid=req.rid, slot=slot,
+            session=sid_hash(req.session), canon=canon)
+        return True
+
     def _scheduler_loop(self) -> None:
         eng = self.engine
         sched = self._sched
@@ -1626,6 +1732,14 @@ class EngineServer:
                 if req.cancelled:
                     sched.cancel(ticket)
                     del self._tickets[ticket]
+            if self._session_store is not None:
+                # tiering policy tick (engine ops are scheduler-thread
+                # only): idle demotions, host-cap/disk GC, handler
+                # export requests, and — when admissions are waiting —
+                # slot-pressure demotion of parked sessions
+                self._session_store.tick(
+                    time.monotonic(),
+                    slot_pressure=self._intake_waiting())
             if (not self._running and not sched.busy()
                     and not self._intake_waiting()):
                 # idle: wait for work without spinning (admission is
@@ -1680,6 +1794,11 @@ class EngineServer:
         # the scheduler owns _running/_head: it performs the shutdown
         # drain itself so stop() never mutates them while a device step
         # is still in flight (a stuck 5s join used to race here)
+        if self._session_store is not None:
+            # a clean shutdown pushes every parked conversation to the
+            # disk tier: the respawned generation rehydrates them
+            # lazily on first touch
+            self._session_store.spill_all(time.monotonic())
         self._drain_on_stop()
 
     def _intake_waiting(self) -> bool:
@@ -2088,6 +2207,12 @@ class EngineServer:
                 if self.path == "/migrate":
                     self._migrate()
                     return
+                if self.path == "/session/export":
+                    self._session_export()
+                    return
+                if self.path == "/session/import":
+                    self._session_import()
+                    return
                 if self.path != "/generate":
                     self._send(404, "text/plain", "not found\n")
                     return
@@ -2181,6 +2306,63 @@ class EngineServer:
                         chat=path.endswith("/chat/completions"),
                         body=body, migrate_state=state,
                         migrate_budget=budget)
+
+            def _session_export(self):
+                """POST /session/export (internal, router-driven):
+                hand a parked session's checkpoint to the replica the
+                router now routes the session to (single-owner move —
+                the local copy is dropped on success)."""
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(length))
+                    sid = str(body.get("session_id") or "")
+                    if not sid:
+                        raise ValueError("session_id required")
+                except (ValueError, TypeError) as e:
+                    self._send(400, "application/json",
+                               json.dumps({"error": str(e)}) + "\n")
+                    return
+                store = server._session_store
+                if store is None:
+                    self._send(503, "application/json", json.dumps(
+                        {"error": "session tiering disabled",
+                         "code": 503}) + "\n")
+                    return
+                try:
+                    payload = store.export_session(sid)
+                except KeyError:
+                    self._send(404, "application/json", json.dumps(
+                        {"error": "unknown session"}) + "\n")
+                    return
+                except Exception as e:
+                    log.warning("session export %s failed: %s", sid, e)
+                    self._send(503, "application/json", json.dumps(
+                        {"error": f"session export failed: {e}",
+                         "code": 503}) + "\n")
+                    return
+                self._send_bytes(200, MIGRATE_CONTENT_TYPE, payload)
+
+            def _session_import(self):
+                """POST /session/import (internal, router-driven):
+                accept another replica's session checkpoint into the
+                host tier; the session's first request here promotes
+                it to device."""
+                store = server._session_store
+                if store is None:
+                    self._send(503, "application/json", json.dumps(
+                        {"error": "session tiering disabled",
+                         "code": 503}) + "\n")
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length) if length else b""
+                try:
+                    sid = store.import_payload(raw, time.monotonic())
+                except (MigrateError, ValueError, TypeError) as e:
+                    self._send(400, "application/json", json.dumps(
+                        {"error": f"bad session payload: {e}"}) + "\n")
+                    return
+                self._send(200, "application/json", json.dumps(
+                    {"ok": True, "session": sid_hash(sid)}) + "\n")
 
             def _migrate_reply(self, req: _Request, body, path,
                                openai=False, model_name=None,
@@ -2513,6 +2695,14 @@ class EngineServer:
                     self.send_header("X-Trace-Id", ctx.trace_id)
                     self.send_header("traceparent",
                                      ctx.to_traceparent())
+
+            def _send_bytes(self, code, ctype, data: bytes):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self._trace_headers()
+                self.end_headers()
+                self.wfile.write(data)
 
             def _send(self, code, ctype, body: str):
                 data = body.encode()
@@ -2859,6 +3049,13 @@ class EngineServer:
         if opt("user") is not None:
             # OpenAI's end-user identity doubles as the QoS tenant
             native["tenant"] = str(opt("user"))
+        if opt("session") is not None:
+            # session KV tiering: the extension key `session` names the
+            # conversation; scoped under `user` when both are present
+            # so two users' identically-named sessions never collide
+            sid = str(opt("session"))
+            native["session_id"] = (f"{opt('user')}/{sid}"
+                                    if opt("user") is not None else sid)
         if opt("slo_class") is not None or \
                 opt("service_tier") is not None:
             # SLO class: the vLLM-style extension key, or OpenAI's
@@ -3104,6 +3301,10 @@ class EngineServer:
                   else int(body["seed"])),
             priority=int(body.get("priority", 0)),
             tenant=str(body.get("tenant", "") or ""),
+            # conversation key for the session KV tier: purely
+            # opt-in, absent/empty means the request is anonymous
+            session=str(
+                body.get("session_id", body.get("session", "")) or ""),
             # free-form on the wire, BOUNDED at record time: an
             # unknown class lands under the "other" label, never a
             # new series (the O1/slo contract)
@@ -3249,6 +3450,11 @@ class EngineServer:
                 "queue": int(self._shed_queue.value),
                 "quota": int(self._shed_quota.value),
             },
+            # session KV tier occupancy (fixed schema even when the
+            # tier is off, so /fleet/statz aggregation never branches)
+            "kv_tiers": (self._session_store.stats()
+                         if self._session_store is not None
+                         else empty_tier_stats()),
             # the fixed-schema goodput block the router's /fleet/statz
             # aggregates and the autoscaler will key scaling on
             "goodput": self._slo.summary(),
@@ -3673,6 +3879,33 @@ def main(argv=None) -> int:
                    help="quantize paged KV pool storage (int8 values "
                         "+ per-row f32 scales; ~47%% of the bf16 "
                         "bytes, NOT bit-identical to contiguous)")
+    p.add_argument("--session-tier", action="store_true",
+                   help="three-tier session KV store keyed by the "
+                        "optional session_id request field: parked "
+                        "device pages -> bounded host-RAM pool -> "
+                        "crash-safe --session-dir spill files; a "
+                        "returning session resumes its KV instead of "
+                        "re-prefilling (needs --kv-paging)")
+    p.add_argument("--session-dir", default=None, metavar="DIR",
+                   help="disk spill directory for --session-tier "
+                        "(atomic tmp->rename .kvs files that survive "
+                        "process death; unset disables the disk tier)")
+    p.add_argument("--session-host-mb", type=int, default=256,
+                   help="host-RAM tier cap in MiB — over it the "
+                        "oldest sessions spill to disk or evict")
+    p.add_argument("--session-disk-keep", type=int, default=512,
+                   help="newest-K GC bound on spilled .kvs files")
+    p.add_argument("--session-idle", type=float, default=30.0,
+                   metavar="SECONDS",
+                   help="idle seconds (seeded +/-10%% jitter) before "
+                        "a parked device session demotes to host RAM")
+    p.add_argument("--session-host-idle", type=float, default=120.0,
+                   metavar="SECONDS",
+                   help="idle seconds (seeded jitter) before a "
+                        "host-tier session spills to --session-dir")
+    p.add_argument("--session-seed", type=int, default=0,
+                   help="RNG seed for the tier timers' jitter (keeps "
+                        "demotion schedules reproducible in tests)")
     p.add_argument("--tenant-quota", action="append", default=None,
                    metavar="NAME=RATE[:BURST[:WEIGHT]]",
                    help="per-tenant QoS (repeatable; NAME '*' is the "
@@ -3765,6 +3998,25 @@ def main(argv=None) -> int:
         p.error("--kv-page-size/--kv-pages must be >= 0")
     if args.prefix_registry_max < 1:
         p.error("--prefix-registry-max must be >= 1")
+    if args.session_tier and not args.kv_paging:
+        p.error("--session-tier needs --kv-paging (tier transitions "
+                "are the paged pool's preempt/resume checkpoints)")
+    if not args.session_tier and (
+            args.session_dir or args.session_host_mb != 256
+            or args.session_disk_keep != 512
+            or args.session_idle != 30.0
+            or args.session_host_idle != 120.0
+            or args.session_seed != 0):
+        p.error("--session-dir/--session-host-mb/--session-disk-keep/"
+                "--session-idle/--session-host-idle/--session-seed "
+                "need --session-tier")
+    if args.session_tier:
+        if args.session_host_mb < 1:
+            p.error("--session-host-mb must be >= 1")
+        if args.session_disk_keep < 1:
+            p.error("--session-disk-keep must be >= 1")
+        if args.session_idle <= 0 or args.session_host_idle <= 0:
+            p.error("--session-idle/--session-host-idle must be > 0")
     if (args.advertise or args.replica_id) and not args.register_with:
         p.error("--advertise/--replica-id need --register-with")
     if args.replica_role != "mixed" and not args.kv_paging:
@@ -3903,7 +4155,14 @@ def main(argv=None) -> int:
                        alert_interval_s=args.alert_interval,
                        alert_window_scale=args.alert_window_scale,
                        incident_dir=incident_dir,
-                       profiler_hz=args.profiler_hz)
+                       profiler_hz=args.profiler_hz,
+                       session_tier=args.session_tier,
+                       session_dir=args.session_dir,
+                       session_host_mb=args.session_host_mb,
+                       session_disk_keep=args.session_disk_keep,
+                       session_idle_s=args.session_idle,
+                       session_host_idle_s=args.session_host_idle,
+                       session_seed=args.session_seed)
     if args.fault_spec is not None or args.fault_seed is not None:
         if args.fault_spec is None:
             p.error("--fault-seed needs --fault-spec")

@@ -1625,6 +1625,174 @@ class ServingEngine:
             self._inflight_scan.skip.add(slot)
         return slot
 
+    # -- session tiering (device tier of the three-tier KV store) ----------
+
+    def park_session(self, slot: int, session_id: str,
+                     kept: int) -> int:
+        """Park a retired request's slot as the DEVICE tier of its
+        conversation: pages stay mapped, the resident-prompt record is
+        rewritten to cover the whole conversation (prompt + the *kept*
+        output tokens), and the slot turns RESERVED — free_slots()
+        skips it and :meth:`_reclaim_parked` cannot take its pages, so
+        the only exits are the owning session's next turn (admission
+        with the same ``session``) or an explicit
+        :meth:`demote_session` / :meth:`discard_session`.
+
+        Rows are reusable up to ``canon`` = rows actually written
+        (decode writes a token's K/V when it is FED, one step after
+        sampling, so the last kept token's row may be unwritten) and,
+        under a speculative proposer, strictly below the clamped
+        verify band — the same invariant admit() enforces for
+        prompts.  Returns canon."""
+        if not self._paged:
+            raise RuntimeError("session parking needs kv_paging=True")
+        assert self._pool is not None
+        rec = self._slot_prompts[slot]
+        if rec is None:
+            raise ValueError(f"slot {slot} has no resident record")
+        if not session_id:
+            raise ValueError("empty session_id")
+        prompt_np = np.asarray(rec[0], np.int32)
+        outs = np.asarray(self.outputs[slot][:kept], np.int32)
+        tokens = (np.concatenate([prompt_np, outs])
+                  if outs.size else prompt_np)
+        canon = min(int(self.lens[slot]), int(tokens.shape[0]))
+        if self._draft_model is not None or self._ngram:
+            # parked rows must sit strictly below the clamped verify
+            # write band [max_len-gamma-1, max_len-1] (see begin_admit)
+            canon = min(canon, self.model.max_len - self.gamma - 1)
+        canon = max(canon, 0)
+        self.active[slot] = False
+        self._finished.pop(slot, None)
+        self._finish_reason.pop(slot, None)
+        self.lens[slot] = 0
+        self._slot_prompts[slot] = (tokens, int(rec[1]), canon,
+                                    None, None, session_id)
+        self._reserved[slot] = True
+        self._reset_slot_params(slot)
+        if self._inflight_scan is not None:
+            self._inflight_scan.skip.add(slot)
+        return canon
+
+    def demote_session(self, slot: int) -> Dict[str, object]:
+        """Checkpoint a session-PARKED slot (see :meth:`park_session`)
+        to host and free its pages + slot — the device → host tier
+        transition.  Storage-exact like :meth:`preempt` (int8 pools
+        round-trip raw bytes + scales) and codec-clean: the returned
+        state is exactly what :meth:`resume_session` — or the migrate
+        codec, for the disk tier and cross-replica moves — re-parks
+        from."""
+        if not self._paged:
+            raise RuntimeError("session tiering needs kv_paging=True")
+        assert self._pool is not None
+        rec = self._slot_prompts[slot]
+        if not self._reserved[slot] or rec is None or len(rec) < 6:
+            raise ValueError(f"slot {slot} holds no parked session")
+        row = jnp.asarray(self._pool.tables[slot])
+        raw = jax.device_get(_paged_gather_raw(self.cache, row))
+        state: Dict[str, object] = {
+            "v": 1,
+            "kind": "session",
+            "session_id": rec[5],
+            "tokens": np.asarray(rec[0], np.int32),
+            "canon": int(rec[2]),
+            "adapter": int(rec[1]),
+            "kv": raw,
+        }
+        self._pool.clear_slot(slot)
+        self._slot_prompts[slot] = None
+        self._reserved[slot] = False
+        self.lens[slot] = 0
+        if self._inflight_scan is not None:
+            self._inflight_scan.skip.add(slot)
+        return state
+
+    def resume_session(self, state: Dict[str, object]) -> int:
+        """Re-park a :meth:`demote_session` checkpoint into a free
+        slot: pages re-allocate (reclaiming anonymous parked donors
+        under pressure, never preempting), the raw KV scatters back,
+        and the slot comes back RESERVED + inactive — exactly the
+        state :meth:`park_session` leaves, so the owning session's
+        next request takes the same donor match whichever tier the
+        record returned from.  Raises RuntimeError (no free slot),
+        PagePoolExhausted, or ValueError (malformed state)."""
+        if not self._paged:
+            raise RuntimeError("session tiering needs kv_paging=True")
+        pool = self._pool
+        assert pool is not None
+        sid = state.get("session_id")
+        if not isinstance(sid, str) or not sid:
+            raise ValueError("session state carries no session_id")
+        if state.get("kind") != "session":
+            raise ValueError(
+                f"not a session checkpoint: kind={state.get('kind')!r}")
+        tokens = np.asarray(state["tokens"], np.int32).reshape(-1)
+        canon = int(state["canon"])  # type: ignore[arg-type]
+        if not 0 <= canon <= min(int(tokens.shape[0]),
+                                 self.model.max_len):
+            raise ValueError(f"bad session canon {canon}")
+        free = self.free_slots()
+        if not free:
+            raise RuntimeError("no free slots")
+        slot = free[0]
+        if self._slot_prompts[slot] is not None:
+            self._drop_donor(slot)
+        pool.clear_slot(slot)
+        n_pages = pool.pages_needed(canon)
+        got: List[int] = []
+        try:
+            for _ in range(n_pages):
+                while True:
+                    try:
+                        got.append(pool.alloc())
+                        break
+                    except PagePoolExhausted:
+                        if not self._reclaim_parked():
+                            raise
+        except PagePoolExhausted:
+            for p in got:
+                pool.give_back(p)
+            raise
+        targets = np.full(pool.n_tables, pool.scratch, np.int32)
+        for idx, p in enumerate(got):
+            pool.map(slot, idx, p)
+            targets[idx] = p
+        self.cache = _paged_restore_raw(
+            self.cache, state["kv"], jnp.asarray(targets),
+            jnp.int32(slot), jnp.int32(canon))
+        self.lens[slot] = 0
+        self._slot_prompts[slot] = (tokens, int(state["adapter"]),  # type: ignore[arg-type]
+                                    canon, None, None, sid)
+        self._reserved[slot] = True
+        self._finished.pop(slot, None)
+        self._finish_reason.pop(slot, None)
+        self._reset_slot_params(slot)
+        if self._inflight_scan is not None:
+            self._inflight_scan.skip.add(slot)
+        return slot
+
+    def discard_session(self, slot: int) -> None:
+        """Drop a parked session outright (tier eviction, or its
+        record was superseded by a newer turn): pages freed, record
+        gone, slot unreserved."""
+        assert self._pool is not None
+        rec = self._slot_prompts[slot]
+        if not self._reserved[slot] or rec is None or len(rec) < 6:
+            raise ValueError(f"slot {slot} holds no parked session")
+        self._pool.clear_slot(slot)
+        self._slot_prompts[slot] = None
+        self._reserved[slot] = False
+        self.lens[slot] = 0
+
+    def session_slots(self) -> Dict[str, int]:
+        """Map of session_id -> slot for every device-parked
+        session."""
+        out: Dict[str, int] = {}
+        for s, rec in enumerate(self._slot_prompts):
+            if rec is not None and len(rec) > 5 and self._reserved[s]:
+                out[rec[5]] = s
+        return out
+
     # -- admission ---------------------------------------------------------
 
     @property
@@ -1706,7 +1874,8 @@ class ServingEngine:
                 f"{self.model.n_adapters})")
         return adapter
 
-    def _auto_match(self, pnp: np.ndarray, t_p: int, aid: int):
+    def _auto_match(self, pnp: np.ndarray, t_p: int, aid: int,
+                    session: Optional[str] = None):
         """Find the best automatic prefix donor for *prompt*: the
         registry entry or resident slot prompt sharing the longest
         common prefix, measured in whole chunks (reuse stays on the
@@ -1723,7 +1892,17 @@ class ServingEngine:
         admission is pure data movement — splice + the stored row —
         with zero extends (kinds "reg_full"/"slot_full", m = t_p).
         The row is the same device value a cold admission computes, so
-        tokens stay bit-identical (the house invariant)."""
+        tokens stay bit-identical (the house invariant).
+
+        SESSION records (a 6-tuple whose rec[5] names the owning
+        conversation, see :meth:`park_session`) are conversation-
+        private: their rows past the original prompt were written by
+        DECODE steps, not chunk-grid prefill, so they are bit-exact
+        continuations of that one conversation but not of a cold
+        chunked admission.  Foreign traffic must never match them —
+        and the owning session's request matches its own record FIRST
+        (before any anonymous donor), so the continuation takes the
+        same donor whichever tier the record came back from."""
         if not self.auto_prefix:
             return None
         c = self.chunk
@@ -1741,10 +1920,20 @@ class ServingEngine:
         for s, rec in enumerate(self._slot_prompts):
             if rec is None:
                 continue
+            rec_sess = rec[5] if len(rec) > 5 else None
+            if rec_sess is not None and rec_sess != session:
+                continue  # another conversation's decode rows
             stoks, said, canon = rec[0], rec[1], rec[2]
             if said != aid:
                 continue
             lcp = _lcp(pnp, stoks)
+            if rec_sess is not None:
+                # the conversation's own parked KV wins outright when
+                # it is usable: tiers all converge to this one match
+                m = (min(lcp, canon, t_p - 1) // c) * c
+                if m >= max(1, self.auto_prefix_min):
+                    return ("slot", s, m)
+                continue
             if (lcp == t_p == len(stoks) and canon == t_p
                     and rec[3] is not None):
                 return ("slot_full", s, t_p)
@@ -1875,7 +2064,8 @@ class ServingEngine:
               prompt_logprobs: Optional[int] = None,
               logit_bias: Optional[Dict[int, float]] = None,
               min_tokens: int = 0,
-              grammar: Union[bool, int] = False) -> int:
+              grammar: Union[bool, int] = False,
+              session: Optional[str] = None) -> int:
         """Prefill *prompt* into a free slot; returns the slot id.
         Raises RuntimeError when the engine is full (callers queue).
         With ``prefix`` (a :meth:`register_prefix` handle), the prompt
@@ -1904,7 +2094,7 @@ class ServingEngine:
             seed=seed, seed_stream=seed_stream, adapter=adapter,
             stop=stop, ignore_eos=ignore_eos, logprobs=logprobs,
             prompt_logprobs=prompt_logprobs, logit_bias=logit_bias,
-            min_tokens=min_tokens, grammar=grammar)
+            min_tokens=min_tokens, grammar=grammar, session=session)
         try:
             while self.admit_step(st):
                 pass
@@ -1931,7 +2121,8 @@ class ServingEngine:
                     prompt_logprobs: Optional[int] = None,
                     logit_bias: Optional[Dict[int, float]] = None,
                     min_tokens: int = 0,
-                    grammar: Union[bool, int] = False) -> AdmitState:
+                    grammar: Union[bool, int] = False,
+                    session: Optional[str] = None) -> AdmitState:
         """Validate a request, reserve a free slot, and set up its
         chunked prefill WITHOUT running it: the returned
         :class:`AdmitState` is advanced one chunk per
@@ -2094,7 +2285,8 @@ class ServingEngine:
             # prompt_logprobs needs every position's logits, so it
             # forces a full (cold) prefill — no automatic prefix reuse
             auto_src = (None if plp_n
-                        else self._auto_match(prompt_np[0], t_p, aid))
+                        else self._auto_match(prompt_np[0], t_p, aid,
+                                              session or None))
             start = auto_src[2] if auto_src is not None else 0
             n = t_p - start
         if self.chunk is not None and n > 0:
@@ -3685,6 +3877,7 @@ class ServingEngine:
             assert self._pool is not None
             out.update(self._pool.stats())
             out["kv_preemptions"] = self._kv_preemptions
+            out["kv_sessions_parked"] = len(self.session_slots())
         return out
 
     def release(self, slot: int) -> None:

@@ -94,6 +94,12 @@ class TraceConfig:
     slow_reader_bytes_per_s: int = 512
     abandon_frac: float = 0.05
     abandon_after_ms: float = 400.0
+    # session-revisit dimension (PR 20): (P, gap_ms) — each request
+    # revisits an earlier conversation with probability P after at
+    # least gap_ms of think time, exercising the warm-resume path.
+    # None (the default) keeps the historical draw sequence — and so
+    # the byte stream of every existing seed+config pair.
+    session_revisit: Optional[Tuple[float, float]] = None
 
     def __post_init__(self) -> None:
         if self.n_requests <= 0:
@@ -120,6 +126,13 @@ class TraceConfig:
                      self.abandon_frac):
             if not 0.0 <= frac <= 1.0:
                 raise ValueError("fractions must be in [0, 1]")
+        if self.session_revisit is not None:
+            p_rev, gap_ms = self.session_revisit
+            if not 0.0 <= p_rev <= 1.0:
+                raise ValueError(
+                    "session_revisit probability must be in [0, 1]")
+            if gap_ms < 0:
+                raise ValueError("session_revisit gap must be >= 0")
 
 
 @dataclass
@@ -137,9 +150,16 @@ class TraceRequest:
     tokens: List[int]
     max_new_tokens: int
     behavior: ClientBehavior = field(default_factory=ClientBehavior)
+    # session-revisit dimension: the conversation this request
+    # belongs to ("" = anonymous) and whether it CONTINUES an
+    # earlier visit (the replay harness chains its prompt onto the
+    # session's history).  Emitted only when set, so unsessioned
+    # traces keep their historical bytes.
+    session: str = ""
+    cont: bool = False
 
     def to_record(self) -> Dict[str, object]:
-        return {
+        rec: Dict[str, object] = {
             "rid": self.rid, "t_ms": round(self.t_ms, 3),
             "tenant": self.tenant, "slo_class": self.slo_class,
             "priority": self.priority, "prefix_id": self.prefix_id,
@@ -151,6 +171,10 @@ class TraceRequest:
                 "abandon_after_ms": self.behavior.abandon_after_ms,
             },
         }
+        if self.session:
+            rec["session"] = self.session
+            rec["cont"] = self.cont
+        return rec
 
 
 def _prefix_block(seed: int, config: TraceConfig,
@@ -205,6 +229,9 @@ def generate(config: TraceConfig, seed: int) -> List[TraceRequest]:
     burst = False
     t_s = 0.0
     out: List[TraceRequest] = []
+    # session-revisit state (only touched when the dimension is on)
+    session_ids: List[str] = []
+    session_last_ms: Dict[str, float] = {}
     for i in range(config.n_requests):
         t_s += rng.expovariate(rates[burst])
         switch = rng.random()  # drawn unconditionally: fixed order
@@ -244,11 +271,34 @@ def generate(config: TraceConfig, seed: int) -> List[TraceRequest]:
             if slow else 0,
             abandon_after_ms=config.abandon_after_ms
             * (0.5 + rng.random()) if abandon else 0.0)
+        # session-revisit draws come LAST in the per-request block
+        # and ONLY when the dimension is enabled: a None config
+        # consumes zero draws, so every pre-existing seed+config
+        # pair still produces a byte-identical trace
+        session = ""
+        cont = False
+        if config.session_revisit is not None:
+            p_rev, gap_ms = config.session_revisit
+            if session_ids and rng.random() < p_rev:
+                session = session_ids[
+                    rng.randrange(len(session_ids))]
+                cont = True
+                # the revisit happens after the conversation's think
+                # time; advancing the GLOBAL clock (never rewinding)
+                # keeps trace timestamps monotonic for the loader
+                t_s = max(t_s,
+                          (session_last_ms[session] + gap_ms)
+                          / 1000.0)
+            else:
+                session = f"s{i:05d}"
+                session_ids.append(session)
+            session_last_ms[session] = t_s * 1000.0
         out.append(TraceRequest(
             rid=f"r{i:05d}", t_ms=t_s * 1000.0, tenant=tenant,
             slo_class=slo_class, priority=priority,
             prefix_id=prefix_id, tokens=prefix + suffix,
-            max_new_tokens=max_new, behavior=behavior))
+            max_new_tokens=max_new, behavior=behavior,
+            session=session, cont=cont))
     return out
 
 
@@ -331,10 +381,18 @@ def _parse_record(rec: Dict[str, object],
     assert isinstance(rid, str) and isinstance(tenant, str)
     assert isinstance(slo_class, str)
     assert isinstance(priority, int) and isinstance(prefix_id, int)
+    # optional session fields (absent in unsessioned traces)
+    session_raw = rec.get("session", "")
+    if not isinstance(session_raw, str):
+        raise TraceError(f"line {lineno}: 'session' must be str")
+    cont_raw = rec.get("cont", False)
+    if not isinstance(cont_raw, bool):
+        raise TraceError(f"line {lineno}: 'cont' must be bool")
     return TraceRequest(
         rid=rid, t_ms=t_ms, tenant=tenant, slo_class=slo_class,
         priority=priority, prefix_id=prefix_id, tokens=tokens,
-        max_new_tokens=max_new, behavior=behavior)
+        max_new_tokens=max_new, behavior=behavior,
+        session=session_raw, cont=cont_raw)
 
 
 def loads_trace(text: str
@@ -400,7 +458,8 @@ def summarize(requests: List[TraceRequest]) -> Dict[str, object]:
     by_class: Dict[str, int] = {}
     by_tenant: Dict[str, int] = {}
     by_prefix: Dict[str, int] = {}
-    slow = abandoners = unary = 0
+    slow = abandoners = unary = revisits = 0
+    sessions = set()
     for r in requests:
         by_class[r.slo_class] = by_class.get(r.slo_class, 0) + 1
         by_tenant[r.tenant] = by_tenant.get(r.tenant, 0) + 1
@@ -412,6 +471,10 @@ def summarize(requests: List[TraceRequest]) -> Dict[str, object]:
             slow += 1
         if r.behavior.abandon_after_ms > 0:
             abandoners += 1
+        if r.session:
+            sessions.add(r.session)
+            if r.cont:
+                revisits += 1
     lens = sorted(len(r.tokens) for r in requests)
     outs = sorted(r.max_new_tokens for r in requests)
 
@@ -426,6 +489,7 @@ def summarize(requests: List[TraceRequest]) -> Dict[str, object]:
             by_prefix.items(), key=lambda kv: -kv[1])[:5]),
         "unary": unary, "slow_readers": slow,
         "abandoners": abandoners,
+        "sessions": len(sessions), "revisits": revisits,
         "prompt_len": {"p50": pct(lens, 0.5), "p95": pct(lens, 0.95),
                        "max": lens[-1]},
         "max_new_tokens": {"p50": pct(outs, 0.5),
@@ -468,6 +532,29 @@ def parse_tenant_mix(
     return tuple(names), tuple(weights) if weighted else None
 
 
+def parse_session_revisit(
+        spec: Optional[str]) -> Optional[Tuple[float, float]]:
+    """Parse ``--session-revisit P[:GAP_MS]`` (gap defaults to
+    1000 ms of think time).  None in, None out — absence keeps the
+    unsessioned draw sequence and its byte-identical traces."""
+    if not spec:
+        return None
+    p_s, sep, gap_s = spec.partition(":")
+    try:
+        p_rev = float(p_s)
+        gap_ms = float(gap_s) if sep else 1000.0
+    except ValueError:
+        raise ValueError(
+            f"--session-revisit: bad spec {spec!r} "
+            "(want P or P:GAP_MS)")
+    if not 0.0 <= p_rev <= 1.0:
+        raise ValueError(
+            "--session-revisit: P must be in [0, 1]")
+    if gap_ms < 0:
+        raise ValueError("--session-revisit: GAP_MS must be >= 0")
+    return p_rev, gap_ms
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         description="Generate a seeded production-shaped trace "
@@ -499,6 +586,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "weighted (e.g. 'team-a:3,team-b:1' sends "
                         "75%% of traffic as team-a); supersedes "
                         "--tenant")
+    p.add_argument("--session-revisit", default=None,
+                   metavar="P[:GAP_MS]",
+                   help="session dimension: every request carries a "
+                        "session id, and with probability P it "
+                        "REVISITS an earlier conversation after at "
+                        "least GAP_MS (default 1000) of think time — "
+                        "replays then exercise the warm-resume "
+                        "tiers.  Unset keeps traces unsessioned and "
+                        "byte-identical to earlier versions")
     p.add_argument("--unary-frac", type=float, default=0.25)
     p.add_argument("--slow-reader-frac", type=float, default=0.05)
     p.add_argument("--slow-reader-bytes-per-s", type=int, default=512)
@@ -508,6 +604,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     tenants, tenant_weights = parse_tenant_mix(
         args.tenants, tuple(args.tenant) if args.tenant
         else ("default",))
+    try:
+        session_revisit = parse_session_revisit(args.session_revisit)
+    except ValueError as e:
+        p.error(str(e))
     config = TraceConfig(
         n_requests=args.requests, base_rate_rps=args.base_rate,
         burst_rate_rps=args.burst_rate,
@@ -521,7 +621,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         slow_reader_frac=args.slow_reader_frac,
         slow_reader_bytes_per_s=args.slow_reader_bytes_per_s,
         abandon_frac=args.abandon_frac,
-        abandon_after_ms=args.abandon_after_ms)
+        abandon_after_ms=args.abandon_after_ms,
+        session_revisit=session_revisit)
     requests = generate(config, args.seed)
     write_trace(args.out, config, args.seed, requests)
     print(json.dumps({"trace": args.out, "seed": args.seed,
